@@ -1,0 +1,63 @@
+"""mxnet_tpu — a TPU-native deep learning framework.
+
+API-parity target: Apache MXNet 1.4.x (the reference at /root/reference);
+architecture: JAX/XLA/Pallas-first (see ARCHITECTURE.md). Import as::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+from __future__ import annotations
+
+import jax as _jax
+# Full dtype surface (float64/int64) like the reference; creation APIs still
+# default to float32 (mshadow default_real_t), so TPU-hostile f64 only appears
+# when a user explicitly asks for it.
+_jax.config.update("jax_enable_x64", True)
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import engine
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+
+from .ndarray import NDArray
+
+__version__ = "0.1.0"
+
+
+def waitall():
+    engine.waitall()
+
+
+# submodules loaded lazily to keep import light and avoid cycles
+def __getattr__(name):
+    import importlib
+    lazy = {
+        "sym": ".symbol", "symbol": ".symbol",
+        "gluon": ".gluon",
+        "mod": ".module", "module": ".module",
+        "optimizer": ".optimizer",
+        "metric": ".metric",
+        "initializer": ".initializer",
+        "init": ".initializer",
+        "lr_scheduler": ".lr_scheduler",
+        "callback": ".callback",
+        "io": ".io",
+        "recordio": ".recordio",
+        "image": ".image",
+        "kvstore": ".kvstore",
+        "kv": ".kvstore",
+        "parallel": ".parallel",
+        "profiler": ".profiler",
+        "test_utils": ".test_utils",
+        "executor": ".executor",
+        "visualization": ".visualization",
+        "viz": ".visualization",
+    }
+    if name in lazy:
+        m = importlib.import_module(lazy[name], __name__)
+        globals()[name] = m
+        return m
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,328 @@
+"""Tape-based autograd over eager ops.
+
+Parity surface: ``python/mxnet/autograd.py`` in the reference (record/pause/
+train_mode/predict_mode/mark_variables/backward/grad + custom Function), whose
+C++ core is ``Imperative::Backward`` (src/imperative/imperative.cc:278-508):
+replay recorded ops through the nnvm Gradient pass.
+
+TPU-native design: each recorded eager op captures a ``jax.vjp`` closure at
+invoke time (the JAX trace *is* the gradient pass — no per-op FGradient
+registry needed). ``backward()`` topologically walks the tape and pulls
+cotangents through the stored closures, accumulating into ``.grad`` per
+``grad_req`` ('write'/'add'/'null'), exactly the reference's observable
+semantics including delayed/accumulated grads.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _state.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _st().training
+    _state.training = bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+
+    def __enter__(self):
+        s = _st()
+        self._prev_record = s.recording
+        self._prev_train = s.training
+        if self._enter_is_record is not None:
+            s.recording = self._enter_is_record
+        if self._enter_train_mode is not None:
+            s.training = self._enter_train_mode
+        return self
+
+    def __exit__(self, *a):
+        s = _st()
+        s.recording = self._prev_record
+        s.training = self._prev_train
+
+
+def record(train_mode=True):
+    """Context: record ops for autograd (reference autograd.py:122)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape structures (analog of Imperative::AGInfo, include/mxnet/imperative.h:42)
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op invocation."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "custom_backward")
+
+    def __init__(self, vjp_fn, inputs, out_meta, name=""):
+        self.vjp_fn = vjp_fn          # cotangents -> input cotangents
+        self.inputs = inputs          # list[AGInfo | None] aligned w/ op inputs
+        self.out_meta = out_meta      # list[(shape, dtype)]
+        self.name = name
+        self.custom_backward = None   # optional override (custom Function)
+
+
+class AGInfo:
+    """Autograd info attached to an NDArray."""
+
+    __slots__ = ("node", "out_idx", "grad", "grad_req", "array_ref")
+
+    def __init__(self, node=None, out_idx=0, grad=None, grad_req="write"):
+        self.node = node
+        self.out_idx = out_idx
+        self.grad = grad              # NDArray sink for leaves/marked vars
+        self.grad_req = grad_req
+        self.array_ref = None
+
+    @property
+    def is_leaf(self):
+        return self.node is None
+
+
+def record_op(vjp_fn, input_arrays, output_arrays, name=""):
+    """Called by the eager invoke path when recording.
+
+    input_arrays/output_arrays are NDArrays; inputs without AGInfo contribute
+    no gradient (constant).
+    """
+    infos = [x._ag if hasattr(x, "_ag") else None for x in input_arrays]
+    out_meta = [(o.shape, o.dtype) for o in output_arrays]
+    node = TapeNode(vjp_fn, infos, out_meta, name)
+    for i, o in enumerate(output_arrays):
+        info = AGInfo(node=node, out_idx=i)
+        # keep the leaf grad sink if the output *is* a marked variable?  No:
+        # outputs are fresh arrays; marking happens via attach_grad on them.
+        o._ag = info
+    return node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad sinks to arrays (reference autograd.py mark_variables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        info = v._ag or AGInfo()
+        if info.node is not None:
+            # keep graph linkage; add leaf sink
+            pass
+        info.grad = g
+        info.grad_req = req
+        v._ag = info
+
+
+def _toposort(head_infos):
+    """Topo-order of TapeNodes reachable from heads (children before parents).
+
+    Iterative DFS — the tape can be 10k+ nodes deep (long training loops,
+    unrolled RNNs); recursion would blow the interpreter stack.
+    """
+    seen = set()
+    order = []
+    stack = []
+    for info in head_infos:
+        if info is not None and info.node is not None:
+            stack.append((info.node, False))
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for info in node.inputs:
+            if info is not None and info.node is not None and id(info.node) not in seen:
+                stack.append((info.node, False))
+    return order  # parents first; we iterate reversed
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables."""
+    from .ndarray import ndarray as _nd
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    # cotangent store: id(node) -> list of per-output cotangents (jax arrays)
+    cts = {}
+    written = set()  # leaves written this pass (grad_req='write' overwrites
+                     # once per backward, then sums further contributions)
+    head_infos = []
+    for h, hg in zip(heads, head_grads):
+        info = h._ag
+        head_infos.append(info)
+        if info is None or info.node is None:
+            if info is not None and info.grad is not None:
+                # head is itself a leaf: d head / d head = 1
+                g = hg._data if hg is not None else jnp.ones_like(h._data)
+                _accumulate_leaf(info, g, written)
+            continue
+        node = info.node
+        slot = cts.setdefault(id(node), [None] * len(node.out_meta))
+        g = hg._data if hg is not None else jnp.ones_like(h._data)
+        slot[info.out_idx] = g if slot[info.out_idx] is None else slot[info.out_idx] + g
+
+    order = _toposort(head_infos)
+    for node in reversed(order):
+        slot = cts.get(id(node))
+        if slot is None:
+            continue
+        full = [c if c is not None else jnp.zeros(m[0], m[1])
+                for c, m in zip(slot, node.out_meta)]
+        cot = tuple(full) if len(full) > 1 else full[0]
+        if node.custom_backward is not None:
+            in_cts = node.custom_backward(cot)
+        else:
+            in_cts = node.vjp_fn(cot)
+        for info, g in zip(node.inputs, in_cts):
+            if info is None or g is None:
+                continue
+            if info.grad is not None:
+                _accumulate_leaf(info, g, written)
+            if info.node is not None:
+                pslot = cts.setdefault(id(info.node),
+                                       [None] * len(info.node.out_meta))
+                cur = pslot[info.out_idx]
+                pslot[info.out_idx] = g if cur is None else cur + g
+
+    if not retain_graph:
+        for info in head_infos:
+            pass  # tape nodes are GC'd with the arrays; nothing to free
+
+
+def _accumulate_leaf(info, g, written):
+    gr = info.grad
+    if info.grad_req == "null" or gr is None:
+        return
+    g = g.astype(gr._data.dtype).reshape(gr._data.shape)
+    if info.grad_req == "add" or id(info) in written:
+        gr._data = gr._data + g
+    else:  # 'write': first contribution this pass overwrites prior contents
+        gr._data = g
+        written.add(id(info))
+    gr._version += 1
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient API (reference autograd.py:grad)."""
+    from .ndarray import ndarray as _nd
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher-order eager grad): "
+                                  "use symbolic executor for higher-order")
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    saved = [(v._ag.grad if v._ag else None, v._ag.grad_req if v._ag else None)
+             for v in variables]
+    sinks = []
+    for v in variables:
+        z = _nd.zeros(v.shape, dtype=v.dtype, ctx=v.context)
+        info = v._ag or AGInfo()
+        info.grad = z
+        info.grad_req = "write"
+        v._ag = info
+        sinks.append(z)
+    backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+    for v, (g, req) in zip(variables, saved):
+        v._ag.grad = g
+        if req is not None:
+            v._ag.grad_req = req
+    return sinks[0] if single else sinks
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable Function (reference autograd.py:385-511)
+# ---------------------------------------------------------------------------
+
+class Function:
+    """User-defined differentiable op for eager mode.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    def __call__(self, *inputs):
+        from .ndarray import ndarray as _nd
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            def custom_backward(cot):
+                cots = (cot,) if not isinstance(cot, tuple) else cot
+                ograds = [_nd.NDArray(c) for c in cots]
+                with pause():
+                    igrads = self.backward(*ograds)
+                if not isinstance(igrads, (list, tuple)):
+                    igrads = [igrads]
+                return [g._data if g is not None else None for g in igrads]
+            node = record_op(None, list(inputs), outs, name=type(self).__name__)
+            node.custom_backward = custom_backward
+        return outs[0] if single else outs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

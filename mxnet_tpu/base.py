@@ -1,0 +1,49 @@
+"""Base utilities: errors, dtype maps, registry helpers.
+
+Re-designs the role of the reference's ``python/mxnet/base.py`` (ctypes
+plumbing + error translation, reference: python/mxnet/base.py) for a
+JAX-native in-process core: there is no C ABI hop on the compute path, so
+"base" reduces to shared type tables and error types.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "_NP_DTYPES", "mx_real_t", "normalize_dtype"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by mxnet_tpu runtime (parity: MXGetLastError surface)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# Default real type matches the reference (mshadow default_real_t = float32).
+mx_real_t = _np.float32
+
+_NP_DTYPES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "bfloat16": "bfloat16",  # resolved via ml_dtypes by jax.numpy
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+
+def normalize_dtype(dtype):
+    """Map user dtype spec (str/np.dtype/None) to a numpy-compatible dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes
+            return _np.dtype(ml_dtypes.bfloat16)
+        return _np.dtype(_NP_DTYPES.get(dtype, dtype))
+    return _np.dtype(dtype)

@@ -1,0 +1,59 @@
+"""Execution engine facade.
+
+The reference's ThreadedEngine (src/engine/threaded_engine.h:269) exists to
+overlap per-op kernel launches and enforce read/write ordering per variable.
+On TPU, PJRT already runs every dispatched computation asynchronously and
+XLA/PJRT orders executions on a device stream, so the *device-side* engine
+degenerates to sync-point tracking — exactly the design predicted in
+SURVEY.md §7. What remains engine-like on the host (threaded IO prefetch,
+custom python ops, cross-host coordination) is handled by the C++ host engine
+in ``mxnet_tpu/src/engine`` (see :mod:`mxnet_tpu.runtime`).
+
+This module keeps the reference's escape hatches:
+* ``MXNET_ENGINE_TYPE=NaiveEngine`` → every op blocks until complete
+  (debug mode; reference src/engine/engine.cc:33-41).
+* ``waitall()`` → block on all outstanding async work.
+* async exception propagation: jax surfaces device errors at sync points;
+  we translate them to MXNetError at wait()/asnumpy() like
+  threaded_engine.cc:474-487 does.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["naive_mode", "waitall", "on_complete", "sync_point"]
+
+_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def naive_mode() -> bool:
+    return _NAIVE
+
+
+def sync_point(arrays):
+    """Called after every eager dispatch with the produced jax arrays."""
+    if _NAIVE:
+        for a in arrays:
+            if hasattr(a, "block_until_ready"):
+                a.block_until_ready()
+
+
+def on_complete(array):
+    """Block until one array's async computation completes (WaitForVar)."""
+    try:
+        if hasattr(array, "block_until_ready"):
+            array.block_until_ready()
+    except Exception as e:  # surface async device errors like the reference
+        raise MXNetError(str(e)) from e
+
+
+def waitall():
+    """Block until all async device work completes (parity: MXNDArrayWaitAll)."""
+    try:
+        jax.effects_barrier()
+    except Exception as e:
+        raise MXNetError(str(e)) from e

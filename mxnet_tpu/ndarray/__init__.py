@@ -1,0 +1,14 @@
+"""Eager NDArray package (parity: python/mxnet/ndarray/)."""
+from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,
+                      concat, invoke, waitall, save, load, moveaxis,
+                      imperative_invoke)
+from . import register as _register
+from . import random
+from . import sparse
+from .sparse import csr_matrix, row_sparse_array
+
+_register.populate(__name__)
+
+# `out=` capable aliases used across the reference codebase
+zeros_like = globals().get("zeros_like")
+ones_like = globals().get("ones_like")

@@ -1,0 +1,655 @@
+"""Eager NDArray.
+
+Parity surface: ``python/mxnet/ndarray/ndarray.py`` (4k LoC in the
+reference) backed by ``src/ndarray/ndarray.cc`` + the dependency engine.
+TPU-native design:
+
+* The payload is a ``jax.Array`` — **every eager op dispatch is already
+  asynchronous** on PJRT, so the reference's ThreadedEngine var-tracking
+  collapses into buffer futures; ``wait_to_read``/``asnumpy`` are the sync
+  points (engine.py translates async device errors there, matching
+  threaded_engine.cc:474-487 exception semantics).
+* NDArray is *mutable by rebinding*: in-place ops swap ``_data`` (functional
+  update under the hood — XLA donates buffers inside jit; eager rebind is a
+  new buffer, same as the reference's copy-on-write-ish Chunk swap).
+* Autograd: ``_ag`` carries tape linkage (AGInfo); recording wraps the op in
+  ``jax.vjp`` (see mxnet_tpu/autograd.py).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, normalize_dtype, numeric_types, mx_real_t
+from ..context import Context, current_context, cpu
+from .. import engine as _engine
+from .. import autograd as _autograd
+from ..ops import registry as _registry
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
+           "concat", "invoke", "waitall", "save", "load", "moveaxis",
+           "imperative_invoke"]
+
+
+def _as_jax(x, dtype=None, ctx=None):
+    dev = (ctx or current_context()).jax_device
+    return jax.device_put(jnp.asarray(x, dtype=dtype), dev)
+
+
+class NDArray:
+    """Multi-dimensional, fixed-size array on a device context."""
+
+    __slots__ = ("_data", "_ctx", "_ag", "_version", "__weakref__")
+
+    _collect_stats = False
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._ctx = ctx or _infer_ctx(data)
+        self._ag = None
+        self._version = 0
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def data(self):
+        """Raw jax array (mxnet_tpu extension; stable read snapshot)."""
+        return self._data
+
+    @property
+    def grad(self):
+        if self._ag is None:
+            return None
+        return self._ag.grad
+
+    # ------------------------------------------------------------ conversion
+    def asnumpy(self):
+        try:
+            return _np.asarray(self._data)
+        except Exception as e:
+            raise MXNetError(str(e)) from e
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def astype(self, dtype, copy=True):
+        dt = normalize_dtype(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        return invoke("Cast", [self], {"dtype": dtype})
+
+    def copy(self):
+        return invoke("_copy", [self], {})
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._rebind(jax.device_put(self._data, other._ctx.jax_device))
+            return other
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        raise TypeError("copyto: expected NDArray or Context")
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        out = NDArray(jax.device_put(self._data, context.jax_device), ctx=context)
+        return out
+
+    def as_in_ctx(self, context):
+        return self.as_in_context(context)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # ----------------------------------------------------------------- sync
+    def wait_to_read(self):
+        _engine.on_complete(self._data)
+
+    def wait_to_write(self):
+        _engine.on_complete(self._data)
+
+    # ------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        grad_buf = zeros(self.shape, dtype=self.dtype, ctx=self._ctx)
+        info = _autograd.AGInfo(node=None, grad=grad_buf, grad_req=grad_req)
+        self._ag = info
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _autograd.backward([self], [out_grad] if out_grad is not None else None,
+                           retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------- mutation
+    def _rebind(self, new_data):
+        self._data = new_data
+        self._version += 1
+        _engine.sync_point([new_data])
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, numeric_types):
+            v = value
+        else:
+            v = jnp.asarray(_np.asarray(value), dtype=self.dtype)
+        if key is None or (isinstance(key, slice) and key == slice(None)):
+            if isinstance(v, (int, float)):
+                self._rebind(jnp.full(self.shape, v, self.dtype))
+            else:
+                self._rebind(jnp.broadcast_to(
+                    jnp.asarray(v, self.dtype), self.shape))
+            return
+        key = _norm_index(key)
+        self._rebind(self._data.at[key].set(v))
+
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        key = _norm_index(key)
+        out = self._data[key]
+        nd = NDArray(out, ctx=self._ctx)
+        if _autograd.is_recording() and self._ag is not None:
+            _, vjp = jax.vjp(lambda d: d[key], self._data)
+            _autograd.record_op(lambda ct: vjp(ct), [self], [nd], name="getitem")
+        return nd
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # ------------------------------------------------------------ operators
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._rebind(out._data)
+        return self
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binary_r("broadcast_sub", "_rminus_scalar", self, other)
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._rebind(out._data)
+        return self
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._rebind(out._data)
+        return self
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary_r("broadcast_div", "_rdiv_scalar", self, other)
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._rebind(out._data)
+        return self
+
+    def __mod__(self, other):
+        return _binary("broadcast_mod", "_mod_scalar", self, other)
+
+    def __rmod__(self, other):
+        return _binary_r("broadcast_mod", "_rmod_scalar", self, other)
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __rpow__(self, other):
+        return _binary_r("broadcast_power", "_rpower_scalar", self, other)
+
+    def __neg__(self):
+        return invoke("negative", [self], {})
+
+    def __abs__(self):
+        return invoke("abs", [self], {})
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return _binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return _binary("broadcast_not_equal", "_not_equal_scalar", self, other)
+
+    def __gt__(self, other):
+        return _binary("broadcast_greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        return _binary("broadcast_greater_equal", "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _binary("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _binary("broadcast_lesser_equal", "_lesser_equal_scalar", self, other)
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(str(s) for s in self.shape), self._ctx)
+
+    # ------------------------------------------------ fluent method wrappers
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return invoke("Reshape", [self], {"shape": shape, **kwargs})
+
+    def reshape_like(self, other):
+        return invoke("Reshape", [self], {"shape": other.shape})
+
+    def flatten(self):
+        return invoke("Flatten", [self], {})
+
+    def transpose(self, axes=None):
+        return invoke("transpose", [self], {"axes": axes})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", [self], {"axis": axis})
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", [self], {"shape": shape})
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", [self, other], {})
+
+    def slice(self, begin, end, step=None):
+        return invoke("slice", [self], {"begin": begin, "end": end, "step": step})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return invoke("one_hot", [self], {"depth": depth, **kw})
+
+    def sum(self, axis=None, keepdims=False):
+        return invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, **kw):
+        return invoke("topk", [self], kw)
+
+    def clip(self, a_min, a_max):
+        return invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return invoke("abs", [self], {})
+
+    def sign(self):
+        return invoke("sign", [self], {})
+
+    def sqrt(self):
+        return invoke("sqrt", [self], {})
+
+    def square(self):
+        return invoke("square", [self], {})
+
+    def exp(self):
+        return invoke("exp", [self], {})
+
+    def log(self):
+        return invoke("log", [self], {})
+
+    def relu(self):
+        return invoke("relu", [self], {})
+
+    def sigmoid(self):
+        return invoke("sigmoid", [self], {})
+
+    def tanh(self):
+        return invoke("tanh", [self], {})
+
+    def softmax(self, axis=-1):
+        return invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return invoke("log_softmax", [self], {"axis": axis})
+
+    def dot(self, other, **kw):
+        return invoke("dot", [self, other], kw)
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke("pick", [self, index], {"axis": axis, "keepdims": keepdims})
+
+    def flip(self, axis):
+        return invoke("flip", [self], {"axis": axis})
+
+    def tile(self, reps):
+        return invoke("tile", [self], {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def pad(self, **kw):
+        return invoke("pad", [self], kw)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("split", [self], {"num_outputs": num_outputs,
+                                        "axis": axis,
+                                        "squeeze_axis": squeeze_axis})
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+
+def _binary(op_name, scalar_op, lhs, rhs):
+    if isinstance(rhs, NDArray):
+        return invoke(op_name, [lhs, rhs], {})
+    return invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+
+
+def _binary_r(op_name, rscalar_op, lhs, rhs):
+    """rhs OP lhs where rhs is scalar or NDArray (reflected operators)."""
+    if isinstance(rhs, NDArray):
+        return invoke(op_name, [rhs, lhs], {})
+    return invoke(rscalar_op, [lhs], {"scalar": float(rhs)})
+
+
+def _infer_ctx(data):
+    try:
+        dev = list(data.devices())[0]
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+    except Exception:
+        return current_context()
+
+
+def _norm_index(key):
+    if isinstance(key, NDArray):
+        return key._data.astype(jnp.int32)
+    if isinstance(key, tuple):
+        return tuple(_norm_index(k) for k in key)
+    return key
+
+
+# ---------------------------------------------------------------------------
+# op invocation (analog of MXImperativeInvokeEx → Imperative::Invoke,
+# reference src/c_api/c_api_ndarray.cc:81-143 / src/imperative/imperative.cc:87)
+# ---------------------------------------------------------------------------
+
+def invoke(op_name, inputs, params, out=None):
+    op = _registry.get(op_name)
+    params = {k: v for k, v in params.items() if v is not None or k in ("axis",)}
+    # explicit device placement for no-input ops (creation/random): reference
+    # semantics place the output on the requested ctx
+    req_ctx = params.pop("ctx", None)
+    if req_ctx is not None and not isinstance(req_ctx, Context):
+        req_ctx = None
+    arrs = [x._data if isinstance(x, NDArray) else jnp.asarray(x) for x in inputs]
+    if "_training" in op.param_names and "_training" not in params:
+        params["_training"] = _autograd.is_training()
+
+    recording = (_autograd.is_recording()
+                 and any(isinstance(x, NDArray) and x._ag is not None
+                         for x in inputs))
+    # only floating-point inputs are differentiable; ints/bools are constants
+    diff_idx = [i for i, a in enumerate(arrs)
+                if jnp.issubdtype(a.dtype, jnp.floating)]
+    if recording and not diff_idx:
+        recording = False
+    if recording:
+        diff_arrs = [arrs[i] for i in diff_idx]
+
+        def fn(*xs):
+            full = list(arrs)
+            for i, x in zip(diff_idx, xs):
+                full[i] = x
+            if op.is_random:
+                from .. import random as _random
+                with _random.trace_scope(_base_key):
+                    return op.fn(*full, **params)
+            return op.fn(*full, **params)
+
+        if op.is_random:
+            from .. import random as _random
+            _base_key = _random.next_key()
+        out_data, vjp_fn = jax.vjp(fn, *diff_arrs)
+    else:
+        if req_ctx is not None:
+            with jax.default_device(req_ctx.jax_device):
+                out_data = op.fn(*arrs, **params)
+        else:
+            out_data = op.fn(*arrs, **params)
+        vjp_fn = None
+
+    single = not isinstance(out_data, tuple)
+    outs_data = (out_data,) if single else out_data
+    if req_ctx is not None:
+        ctx = req_ctx
+    elif inputs and isinstance(inputs[0], NDArray):
+        ctx = inputs[0]._ctx
+    else:
+        ctx = current_context()
+    out_nds = [NDArray(d, ctx=ctx) for d in outs_data]
+    _engine.sync_point([d for d in outs_data])
+
+    if recording:
+        _autograd.record_op(vjp_fn, [inputs[i] for i in diff_idx], out_nds,
+                            name=op_name)
+
+    if out is not None:
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for t, o in zip(targets, out_nds):
+            t._rebind(o._data)
+            t._ag = o._ag  # carry tape linkage so autograd flows through out=
+        return out
+    return out_nds[0] if single else tuple(out_nds)
+
+
+def imperative_invoke(op_name, *inputs, out=None, **params):
+    return invoke(op_name, list(inputs), params, out=out)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+        if dtype is None:
+            dtype = src.dtype
+    elif isinstance(source_array, _np.ndarray):
+        src = source_array
+        if dtype is None:
+            dtype = src.dtype
+    else:
+        # python lists/scalars default to float32 (reference
+        # python/mxnet/ndarray/ndarray.py `array`: float32 unless source
+        # carries an explicit dtype)
+        src = _np.asarray(source_array)
+        if dtype is None:
+            dtype = mx_real_t
+    return NDArray(_as_jax(src, normalize_dtype(dtype), ctx), ctx=ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dt = normalize_dtype(dtype) or _np.float32
+    return NDArray(_as_jax(jnp.zeros(shape, dt), None, ctx), ctx=ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dt = normalize_dtype(dtype) or _np.float32
+    return NDArray(_as_jax(jnp.ones(shape, dt), None, ctx), ctx=ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dt = normalize_dtype(dtype) or _np.float32
+    return NDArray(_as_jax(jnp.full(shape, val, dt), None, ctx), ctx=ctx or current_context())
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    return invoke("_arange", [], {"start": start, "stop": stop, "step": step,
+                                  "repeat": repeat, "dtype": dtype or "float32"})
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination), ctx=tensor._ctx)
+
+
+def concat(*data, dim=1):
+    return invoke("Concat", list(data), {"dim": dim})
+
+
+def waitall():
+    _engine.waitall()
+
+
+# ---------------------------------------------------------------------------
+# serialization — reference binary format surface (ndarray.cc:1583-1795);
+# we use .npz-style container with the same API (save/load dict or list).
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"MXTPU001"
+
+
+def save(fname, data):
+    import struct
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        arrays = [data[k] for k in keys]
+    else:
+        keys = None
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        npz = {}
+        if keys is None:
+            for i, a in enumerate(arrays):
+                npz["arr_%d" % i] = a.asnumpy()
+            _np.savez(f, __keys__=_np.asarray([], dtype="U1"), **npz)
+        else:
+            for k, a in zip(keys, arrays):
+                npz["data_" + k] = a.asnumpy()
+            _np.savez(f, __keys__=_np.asarray(keys), **npz)
+
+
+def load(fname):
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError("invalid NDArray file %s" % fname)
+        z = _np.load(f, allow_pickle=False)
+        keys = list(z["__keys__"])
+        if not keys:
+            out = []
+            i = 0
+            while "arr_%d" % i in z:
+                out.append(array(z["arr_%d" % i]))
+                i += 1
+            return out
+        return {str(k): array(z["data_" + str(k)]) for k in keys}

@@ -1,0 +1,42 @@
+"""Generate module-level eager op functions from the registry.
+
+Parity: the reference generates one Python function per registered op at
+import time by introspecting the C op registry
+(python/mxnet/ndarray/register.py:20-43). Here codegen is a thin closure per
+op: split NDArray inputs from keyword hyperparams, route through
+ndarray.invoke (the Imperative::Invoke analog).
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _registry
+from . import ndarray as _nd
+
+
+def _make_op_func(op):
+    def fn(*args, out=None, name=None, **kwargs):
+        inputs = []
+        for a in args:
+            if isinstance(a, _nd.NDArray):
+                inputs.append(a)
+            elif a is None:
+                inputs.append(None)
+            else:
+                # allow raw numerics/ndarrays as inputs
+                inputs.append(_nd.array(a))
+        # drop trailing None inputs (optional args like bias with no_bias)
+        while inputs and inputs[-1] is None:
+            inputs.pop()
+        inputs = [x for x in inputs if x is not None]
+        return _nd.invoke(op.name, inputs, kwargs, out=out)
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def populate(module_name):
+    mod = sys.modules[module_name]
+    for name in _registry.list_ops():
+        op = _registry.get(name)
+        setattr(mod, name, _make_op_func(op))

@@ -1,0 +1,333 @@
+"""Sparse NDArrays: RowSparse and CSR.
+
+Parity: python/mxnet/ndarray/sparse.py + src/operator/tensor/cast_storage /
+dot-inl.h sparse kernels (storage types enum include/mxnet/ndarray.h:61-65).
+
+TPU-native design (SURVEY.md §7 hard-part 2): there is no sparse HLO; we keep
+the *storage format* (indices+values / indptr+indices+data as dense jax
+arrays — static shapes, MXU-friendly segment ops) and lower sparse compute to
+gather/scatter/segment-sum, which XLA maps well to TPU. Row-sparse is the
+format that matters in practice (embedding grads, optimizer lazy updates) and
+it round-trips exactly. `nnz`-dependent shapes are materialized eagerly
+(host-side), matching the reference's eager cast_storage semantics.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..context import current_context
+from ..base import normalize_dtype
+from . import ndarray as _ndarray
+from .ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "csr_matrix", "row_sparse_array",
+           "cast_storage", "dot", "zeros", "retain"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Shared base: shadows the dense `_data` slot with a lazily-materialized
+    dense view so every inherited NDArray method (arithmetic, size, copy,
+    astype, ...) works on sparse inputs by falling back to dense — the
+    reference's storage-fallback behavior (src/common/exec_utils.h)."""
+
+    __slots__ = ("_dense_cache",)
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._make_dense()
+        return self._dense_cache
+
+    def _invalidate(self):
+        self._dense_cache = None
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: (indices[i] -> data[i, :]) pairs + dense logical shape."""
+
+    __slots__ = ("_indices", "_values", "_shape")
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self._values = data if not isinstance(data, NDArray) else data._data
+        self._indices = (indices if not isinstance(indices, NDArray)
+                         else indices._data).astype(jnp.int64)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+        self._ag = None
+        self._version = 0
+        self._dense_cache = None
+
+    def _make_dense(self):
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        return dense.at[self._indices].set(self._values)
+
+    # -- NDArray surface overrides -----------------------------------------
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def data(self):
+        return NDArray(self._values, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError("cast row_sparse -> %s not supported" % stype)
+
+    def todense(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._indices = self._indices
+            other._values = self._values
+            other._shape = self._shape
+            other._invalidate()
+            return other
+        return super().copyto(other)
+
+    def wait_to_read(self):
+        from .. import engine as _engine
+        _engine.on_complete(self._values)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % (
+            "x".join(str(s) for s in self._shape), self._ctx)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix."""
+
+    __slots__ = ("_indptr", "_indices", "_values", "_shape")
+
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        self._values = data if not isinstance(data, NDArray) else data._data
+        self._indptr = (indptr if not isinstance(indptr, NDArray)
+                        else indptr._data).astype(jnp.int64)
+        self._indices = (indices if not isinstance(indices, NDArray)
+                         else indices._data).astype(jnp.int64)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+        self._ag = None
+        self._version = 0
+        self._dense_cache = None
+
+    def _make_dense(self):
+        rows = self._row_ids()
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        return dense.at[rows, self._indices].set(self._values)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr, ctx=self._ctx)
+
+    @property
+    def data(self):
+        return NDArray(self._values, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError("cast csr -> %s not supported" % stype)
+
+    def _row_ids(self):
+        # expand indptr -> per-nnz row index
+        counts = self._indptr[1:] - self._indptr[:-1]
+        return jnp.repeat(jnp.arange(self._shape[0], dtype=jnp.int64), counts,
+                          total_repeat_length=self._values.shape[0])
+
+    def todense(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def wait_to_read(self):
+        from .. import engine as _engine
+        _engine.on_complete(self._values)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            # row slice: rebuild via dense for simplicity
+            return cast_storage(NDArray(self.todense()._data[key], ctx=self._ctx), "csr")
+        return self.todense()[key]
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % (
+            "x".join(str(s) for s in self._shape), self._ctx)
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(_np.asarray(data), dtype=normalize_dtype(dtype)),
+                          jnp.asarray(_np.asarray(indptr)),
+                          jnp.asarray(_np.asarray(indices)), shape, ctx=ctx)
+    # from dense
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    return _csr_from_dense(dense, ctx)
+
+
+def _csr_from_dense(dense_np, ctx=None):
+    rows, cols = _np.nonzero(dense_np)
+    vals = dense_np[rows, cols]
+    indptr = _np.zeros(dense_np.shape[0] + 1, dtype=_np.int64)
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr)
+    return CSRNDArray(jnp.asarray(vals), jnp.asarray(indptr),
+                      jnp.asarray(cols.astype(_np.int64)), dense_np.shape, ctx=ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _np.asarray(data) if not isinstance(data, NDArray) else data.asnumpy()
+        indices = _np.asarray(indices) if not isinstance(indices, NDArray) else indices.asnumpy()
+        return RowSparseNDArray(jnp.asarray(data, dtype=normalize_dtype(dtype)),
+                                jnp.asarray(indices), shape, ctx=ctx)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    return _rsp_from_dense(dense, ctx)
+
+
+def _rsp_from_dense(dense_np, ctx=None):
+    nz_rows = _np.where(_np.any(dense_np != 0, axis=tuple(range(1, dense_np.ndim))))[0]
+    return RowSparseNDArray(jnp.asarray(dense_np[nz_rows]),
+                            jnp.asarray(nz_rows.astype(_np.int64)),
+                            dense_np.shape, ctx=ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = normalize_dtype(dtype) or _np.float32
+    if stype == "row_sparse":
+        ncol = shape[1:] if len(shape) > 1 else ()
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(ncol), dt),
+                                jnp.zeros((0,), jnp.int64), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((shape[0] + 1,), jnp.int64),
+                          jnp.zeros((0,), jnp.int64), shape, ctx=ctx)
+    return _ndarray.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# ops (cast_storage / dot / retain / elemwise helpers)
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype):
+    """reference: src/operator/tensor/cast_storage (dense<->sparse)."""
+    if stype == arr.stype:
+        return arr
+    if stype == "default":
+        return arr.todense()
+    dense_np = arr.asnumpy()
+    if stype == "row_sparse":
+        return _rsp_from_dense(dense_np, ctx=arr._ctx)
+    if stype == "csr":
+        if dense_np.ndim != 2:
+            raise ValueError("csr requires 2-D")
+        return _csr_from_dense(dense_np, ctx=arr._ctx)
+    raise ValueError(stype)
+
+
+def retain(rsp, row_ids):
+    """sparse_retain: keep only requested rows (reference sparse_retain op)."""
+    ids = row_ids._data.astype(jnp.int64) if isinstance(row_ids, NDArray) else jnp.asarray(row_ids)
+    # membership of each stored index in ids
+    dense = rsp.todense()._data
+    vals = dense[ids]
+    return RowSparseNDArray(vals, ids, rsp.shape, ctx=rsp._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot: csr @ dense and csr.T @ dense.
+
+    Lowered to segment-sum/gather — static shapes, TPU friendly.
+    """
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and not isinstance(rhs, BaseSparseNDArray):
+        rows = lhs._row_ids()
+        cols = lhs._indices
+        vals = lhs._values
+        d = rhs._data
+        if transpose_a:
+            # out[c, :] += vals * d[row, :]
+            contrib = vals[:, None] * d[rows]
+            out = jax.ops.segment_sum(contrib, cols, num_segments=lhs.shape[1]) \
+                if hasattr(jax.ops, "segment_sum") else \
+                jnp.zeros((lhs.shape[1], d.shape[1]), d.dtype).at[cols].add(contrib)
+            return NDArray(out, ctx=lhs._ctx)
+        contrib = vals[:, None] * d[cols]
+        out = jnp.zeros((lhs.shape[0], d.shape[1]), d.dtype).at[rows].add(contrib)
+        return NDArray(out, ctx=lhs._ctx)
+    if isinstance(lhs, RowSparseNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = rhs.todense()
+    return _ndarray.invoke("dot", [lhs, rhs],
+                           {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+
+def add(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        idx = jnp.concatenate([lhs._indices, rhs._indices])
+        vals = jnp.concatenate([lhs._values, rhs._values])
+        # combine duplicates via dense scatter-add (logical dense add)
+        dense = jnp.zeros(lhs.shape, vals.dtype).at[idx].add(vals)
+        return _rsp_from_dense(_np.asarray(dense), ctx=lhs._ctx)
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return l + r

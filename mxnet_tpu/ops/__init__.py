@@ -1,0 +1,14 @@
+"""Operator library: one registry, pure JAX implementations.
+
+Importing this package registers all ops (analog of the reference's static
+registration at library load; src/operator/*.cc NNVM_REGISTER_OP blocks).
+"""
+from . import registry
+from .registry import register, get, list_ops, alias, Operator
+
+from . import elemwise      # noqa: F401
+from . import reduce        # noqa: F401
+from . import matrix        # noqa: F401
+from . import nn            # noqa: F401
+from . import random_ops    # noqa: F401
+from . import init_ops      # noqa: F401

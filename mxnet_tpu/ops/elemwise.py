@@ -1,0 +1,237 @@
+"""Elementwise / broadcast / scalar operators.
+
+Parity targets: the reference's elemwise machinery (src/operator/mshadow_op.h
+functor library, src/operator/tensor/elemwise_*.cc) — here each functor is a
+jnp expression; XLA fuses chains of these into single kernels, replacing the
+reference's hand-tuned Kernel<OP,xpu>::Launch machinery.
+
+MXNet distinguishes ``elemwise_add`` (no broadcasting) from ``broadcast_add``;
+XLA broadcasting subsumes both, so both names map to one fn.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# binary (broadcasting)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: jnp.equal(a, b).astype(a.dtype),
+    "not_equal": lambda a, b: jnp.not_equal(a, b).astype(a.dtype),
+    "greater": lambda a, b: jnp.greater(a, b).astype(a.dtype),
+    "greater_equal": lambda a, b: jnp.greater_equal(a, b).astype(a.dtype),
+    "lesser": lambda a, b: jnp.less(a, b).astype(a.dtype),
+    "lesser_equal": lambda a, b: jnp.less_equal(a, b).astype(a.dtype),
+    "logical_and": lambda a, b: jnp.logical_and(a, b).astype(a.dtype),
+    "logical_or": lambda a, b: jnp.logical_or(a, b).astype(a.dtype),
+    "logical_xor": lambda a, b: jnp.logical_xor(a, b).astype(a.dtype),
+}
+
+for _name, _f in _BINARY.items():
+    def _make(f):
+        def op(lhs, rhs):
+            return f(lhs, rhs)
+        return op
+    _fn = _make(_f)
+    _fn.__name__ = "broadcast_" + _name
+    register("broadcast_" + _name)(_fn)
+    alias("broadcast_" + _name, "elemwise_" + _name, "_" + _name)
+
+alias("broadcast_add", "broadcast_plus", "_plus")
+alias("broadcast_sub", "broadcast_minus", "_minus")
+alias("broadcast_div", "_true_divide")
+alias("broadcast_maximum", "maximum")
+alias("broadcast_minimum", "minimum")
+alias("broadcast_power", "pow")
+
+
+# ---------------------------------------------------------------------------
+# binary with scalar
+# ---------------------------------------------------------------------------
+
+def _scalar_op(name, f, reverse_f=None):
+    def op(data, *, scalar=1.0):
+        return f(data, jnp.asarray(scalar, dtype=data.dtype))
+    op.__name__ = name
+    register(name)(op)
+    if reverse_f is not None:
+        def rop(data, *, scalar=1.0):
+            return reverse_f(jnp.asarray(scalar, dtype=data.dtype), data)
+        rop.__name__ = "_r" + name.lstrip("_")
+        register("_r" + name.lstrip("_"))(rop)
+
+
+_scalar_op("_plus_scalar", jnp.add)
+_scalar_op("_minus_scalar", jnp.subtract)
+_scalar_op("_rminus_scalar", lambda a, s: jnp.subtract(s, a))
+_scalar_op("_mul_scalar", jnp.multiply)
+_scalar_op("_div_scalar", jnp.divide)
+_scalar_op("_rdiv_scalar", lambda a, s: jnp.divide(s, a))
+_scalar_op("_mod_scalar", jnp.mod)
+_scalar_op("_rmod_scalar", lambda a, s: jnp.mod(s, a))
+_scalar_op("_power_scalar", jnp.power)
+_scalar_op("_rpower_scalar", lambda a, s: jnp.power(s, a))
+_scalar_op("_maximum_scalar", jnp.maximum)
+_scalar_op("_minimum_scalar", jnp.minimum)
+_scalar_op("_equal_scalar", lambda a, s: jnp.equal(a, s).astype(a.dtype))
+_scalar_op("_not_equal_scalar", lambda a, s: jnp.not_equal(a, s).astype(a.dtype))
+_scalar_op("_greater_scalar", lambda a, s: jnp.greater(a, s).astype(a.dtype))
+_scalar_op("_greater_equal_scalar", lambda a, s: jnp.greater_equal(a, s).astype(a.dtype))
+_scalar_op("_lesser_scalar", lambda a, s: jnp.less(a, s).astype(a.dtype))
+_scalar_op("_lesser_equal_scalar", lambda a, s: jnp.less_equal(a, s).astype(a.dtype))
+
+
+# ---------------------------------------------------------------------------
+# unary math (mshadow_op.h:59-195 functors)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "round": jnp.round,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    def _make_u(f):
+        def op(data):
+            return f(data)
+        return op
+    _fn = _make_u(_f)
+    _fn.__name__ = _name
+    register(_name)(_fn)
+
+alias("negative", "_np_negative")
+alias("relu", "_relu")
+
+
+@register("clip")
+def clip(data, *, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("_copy")
+def _copy(data):
+    return jnp.asarray(data)
+
+
+alias("_copy", "identity", "stop_gradient_identity_marker_unused")
+
+
+@register("BlockGrad")
+def block_grad(data):
+    return jax.lax.stop_gradient(data)
+
+
+alias("BlockGrad", "stop_gradient")
+
+
+def _make_loss_core(data, grad_scale, normalization):
+    @jax.custom_vjp
+    def f(x):
+        return x * 1.0
+
+    def fwd(x):
+        return x * 1.0, x.shape
+
+    def bwd(shape, g):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / shape[0]
+        elif normalization == "valid":
+            scale = scale / max(1, int(jnp.prod(jnp.asarray(shape))))
+        return (jnp.ones(shape, g.dtype) * scale,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("make_loss")
+def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Head-gradient source (reference src/operator/make_loss-inl.h): backward
+    seeds grad_scale regardless of incoming cotangent."""
+    return _make_loss_core(data, grad_scale, normalization)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("Cast")
+def cast(data, *, dtype="float32"):
+    from ..base import normalize_dtype
+    return data.astype(normalize_dtype(dtype))
+
+
+alias("Cast", "cast")
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("smooth_l1")
+def smooth_l1(data, *, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)

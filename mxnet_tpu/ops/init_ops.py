@@ -1,0 +1,49 @@
+"""Creation ops (zeros/ones/full/arange/eye/linspace).
+
+Parity: src/operator/tensor/init_op.cc. These take no array inputs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, alias
+from ..base import normalize_dtype
+
+
+def _dt(dtype):
+    return normalize_dtype(dtype or "float32")
+
+
+@register("_zeros")
+def zeros(*, shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(tuple(shape) if hasattr(shape, "__len__") else (shape,), _dt(dtype))
+
+
+@register("_ones")
+def ones(*, shape=(), dtype="float32", ctx=None):
+    return jnp.ones(tuple(shape) if hasattr(shape, "__len__") else (shape,), _dt(dtype))
+
+
+@register("_full")
+def full(*, shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(tuple(shape) if hasattr(shape, "__len__") else (shape,),
+                    value, _dt(dtype))
+
+
+@register("_arange")
+def arange(*, start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None,
+           infer_range=False):
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace")
+def linspace(*, start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", ctx=None):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=_dt(dtype))
+
+
+@register("_eye")
+def eye(*, N, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=_dt(dtype))

@@ -1,0 +1,406 @@
+"""Shape-manipulation, indexing, joining and linear-algebra ops.
+
+Parity: src/operator/tensor/matrix_op.cc, dot-inl.h, indexing_op.cc,
+ordering_op.cc, init_op.cc in the reference. All static-shape so XLA can tile
+matmuls onto the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+@register("Reshape")
+def reshape(data, *, shape=None, reverse=False):
+    """MXNet reshape with special codes 0 (copy dim), -1 (infer),
+    -2 (copy rest), -3 (merge two), -4 (split, consumes two following)."""
+    if shape is None:
+        raise ValueError("reshape requires shape")
+    src = list(data.shape)
+    if reverse:
+        src = src[::-1]
+        shape = list(shape)[::-1]
+    out = []
+    i = 0  # index into src
+    it = iter(range(len(shape)))
+    shape = list(shape)
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s)
+            if i < len(src):
+                i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+alias("Reshape", "reshape")
+
+
+@register("Flatten")
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+alias("Flatten", "flatten")
+
+
+@register("transpose")
+def transpose(data, *, axes=None):
+    if axes is None or (hasattr(axes, "__len__") and len(axes) == 0):
+        return jnp.transpose(data)
+    return jnp.transpose(data, tuple(axes))
+
+
+@register("expand_dims")
+def expand_dims(data, *, axis):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, *, axis=None):
+    return jnp.squeeze(data, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+
+
+@register("slice")
+def slice_op(data, *, begin, end, step=None):
+    nd = data.ndim
+    begin = list(begin) + [None] * (nd - len(begin))
+    end = list(end) + [None] * (nd - len(end))
+    step = list(step or []) + [None] * (nd - len(step or []))
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, *, axis, begin, end):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, *, axes=None):
+    axes = range(data.ndim) if axes is None or len(axes) == 0 else axes
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("Concat")
+def concat(*data, dim=1):
+    return jnp.concatenate(data, axis=dim)
+
+
+alias("Concat", "concat")
+
+
+@register("stack")
+def stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", num_outputs=lambda p: int(p.get("num_outputs", 1)))
+def split(data, *, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+alias("split", "SliceChannel")
+
+
+@register("tile")
+def tile(data, *, reps):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat")
+def repeat(data, *, repeats, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad")
+def pad(data, *, mode="constant", pad_width=None, constant_value=0.0):
+    # MXNet pad_width is flat (before,after) per axis
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(data.ndim)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    return jnp.pad(data, pw, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+alias("pad", "Pad")
+
+
+@register("flip")
+def flip(data, *, axis):
+    return jnp.flip(data, axis=axis)
+
+
+alias("flip", "reverse")
+
+
+@register("swapaxes")
+def swapaxes(data, *, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+alias("swapaxes", "SwapAxis")
+
+
+@register("depth_to_space")
+def depth_to_space(data, *, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+@register("space_to_depth")
+def space_to_depth(data, *, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: reduce over last axis of a and first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+alias("batch_dot", "linalg_gemm2_batched_unused")
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+@register("take")
+def take(a, indices, *, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode != "wrap" else "wrap")
+
+
+@register("pick")
+def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("one_hot")
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import normalize_dtype
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    out = oh * on_value + (1.0 - oh) * off_value
+    return out.astype(normalize_dtype(dtype))
+
+
+@register("where_index_unused")
+def _where_index(data):
+    raise NotImplementedError
+
+
+@register("boolean_mask_dense")
+def boolean_mask_dense(data, mask):
+    # dynamic-shape op: not traceable; eager-only fallback
+    import numpy as np
+    return jnp.asarray(np.asarray(data)[np.asarray(mask).astype(bool)])
+
+
+# ---------------------------------------------------------------------------
+# ordering
+# ---------------------------------------------------------------------------
+
+@register("sort")
+def sort(data, *, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import normalize_dtype
+    out = jnp.argsort(data if is_ascend else -data, axis=axis)
+    return out.astype(normalize_dtype(dtype))
+
+
+@register("topk", num_outputs=lambda p: 2 if p.get("ret_typ") == "both" else 1)
+def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import normalize_dtype
+    d = jnp.moveaxis(data, axis, -1)
+    vals, idx = jax.lax.top_k(-d if is_ascend else d, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(normalize_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        raise NotImplementedError("topk ret_typ=mask")
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+@register("diag")
+def diag(data, *, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register("histogram", num_outputs=2)
+def histogram(data, *, bin_cnt=10, range=None):
+    lo, hi = range if range is not None else (float(data.min()), float(data.max()))
+    counts, edges = jnp.histogram(data, bins=bin_cnt, range=(lo, hi))
+    return counts.astype(jnp.int64), edges.astype(data.dtype)
+
+
+@register("ravel_multi_index")
+def ravel_multi_index(data, *, shape):
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.asarray(list(reversed(strides)), dtype=data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@register("unravel_index")
+def unravel_index(data, *, shape):
+    idx = data.astype(jnp.int64)
+    out = []
+    for s in reversed(shape):
+        out.append(idx % s)
+        idx = idx // s
+    return jnp.stack(list(reversed(out)), axis=0).astype(data.dtype)
+
+
+@register("sequence_mask")
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data * 1.0
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :].astype(steps.dtype)  # (T, B)
+    shape = [1] * data.ndim
+    shape[axis] = maxlen
+    batch_axis = 1 if axis == 0 else 0
+    shape[batch_axis] = data.shape[batch_axis]
+    mask = jnp.reshape(mask if axis == 0 else mask.T, shape)
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+alias("sequence_mask", "SequenceMask")
+
+
+@register("sequence_last")
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length - 1).astype(jnp.int32)
+    d = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jax.vmap(lambda t, i: t[i], in_axes=(1, 0))(d, idx)
+
+
+alias("sequence_last", "SequenceLast")
+
+
+@register("sequence_reverse")
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    d = jnp.moveaxis(data, axis, 0)
+    T = d.shape[0]
+    steps = jnp.arange(T)
+
+    def rev_one(col, L):
+        idx = jnp.where(steps < L, L - 1 - steps, steps)
+        return col[idx]
+
+    out = jax.vmap(rev_one, in_axes=(1, 0), out_axes=1)(d, sequence_length.astype(jnp.int32))
+    return jnp.moveaxis(out, 0, axis)
+
+
+alias("sequence_reverse", "SequenceReverse")

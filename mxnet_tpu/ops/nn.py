@@ -1,0 +1,674 @@
+"""Neural-network operators.
+
+Parity: src/operator/nn/ in the reference (Convolution, FullyConnected,
+BatchNorm, Pooling, Activation, Dropout, softmax family, LayerNorm, Embedding
+— fully_connected.cc:239-326 is the canonical registration). TPU-native
+design notes:
+
+* FullyConnected / Convolution / Deconvolution map straight to
+  ``lax.dot_general`` / ``lax.conv_general_dilated`` → MXU. Layout semantics
+  stay NCHW (reference default) while XLA's layout assignment is free to pick
+  the TPU-optimal physical layout.
+* Where the reference dispatches to MIOpen/cuDNN autotuned kernels
+  (src/operator/nn/cudnn/), we rely on XLA conv emitters; no algo search.
+* BatchNorm keeps running stats as explicit aux arrays (reference aux_states
+  moving_mean/moving_var), returned as extra outputs so the functional core
+  stays pure; the Gluon/Module layers wire them back to aux storage.
+* Dropout draws from :mod:`mxnet_tpu.random` (trace-safe key threading).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+from .. import random as _random
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected")
+def fully_connected(data, weight, bias=None, *, num_hidden=0, no_bias=False,
+                    flatten=True):
+    if flatten:
+        x = jnp.reshape(data, (data.shape[0], -1))
+    else:
+        x = data
+    # weight layout: (num_hidden, in_units) — reference convention
+    out = jnp.matmul(x, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+alias("FullyConnected", "fully_connected")
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+def _tuplize(v, n):
+    if v is None:
+        return (0,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+@register("Convolution")
+def convolution(data, weight, bias=None, *, kernel, num_filter,
+                stride=None, dilate=None, pad=None, num_group=1,
+                no_bias=False, layout=None):
+    n = _conv_dims(kernel)
+    stride = _tuplize(stride, n) or (1,) * n
+    stride = tuple(s if s else 1 for s in stride)
+    dilate = tuple(d if d else 1 for d in _tuplize(dilate, n))
+    padding = [(p, p) for p in _tuplize(pad, n)]
+    if n == 1:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        ("NCH", "OIH", "NCH"))
+    elif n == 2:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if not no_bias and bias is not None:
+        out = out + jnp.reshape(bias, (1, -1) + (1,) * n)
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, bias=None, *, kernel, num_filter,
+                  stride=None, dilate=None, pad=None, adj=None,
+                  target_shape=None, num_group=1, no_bias=True, layout=None):
+    n = _conv_dims(kernel)
+    stride = tuple(s if s else 1 for s in _tuplize(stride, n))
+    dilate = tuple(d if d else 1 for d in _tuplize(dilate, n))
+    pad_ = _tuplize(pad, n)
+    adj_ = _tuplize(adj, n)
+    # Transposed convolution == gradient of convolution wrt its input.
+    # weight layout (reference): (in_channels, num_filter//num_group, *kernel)
+    spatial = data.shape[2:]
+    out_spatial = tuple(
+        (spatial[i] - 1) * stride[i] - 2 * pad_[i]
+        + dilate[i] * (kernel[i] - 1) + 1 + adj_[i]
+        for i in range(n))
+    if target_shape:
+        out_spatial = tuple(target_shape)
+    # lax.conv_transpose with flipped kernel reproduces gradient-of-conv.
+    if n == 2:
+        dn = lax.conv_dimension_numbers(
+            (data.shape[0], data.shape[1]) + out_spatial,
+            weight.shape, ("NCHW", "IOHW", "NCHW"))
+    elif n == 1:
+        dn = lax.conv_dimension_numbers(
+            (data.shape[0], data.shape[1]) + out_spatial,
+            weight.shape, ("NCH", "IOH", "NCH"))
+    else:
+        dn = lax.conv_dimension_numbers(
+            (data.shape[0], data.shape[1]) + out_spatial,
+            weight.shape, ("NCDHW", "IODHW", "NCDHW"))
+    pads = []
+    for i in range(n):
+        lo = dilate[i] * (kernel[i] - 1) - pad_[i]
+        hi = dilate[i] * (kernel[i] - 1) - pad_[i] + adj_[i]
+        pads.append((lo, hi))
+    if num_group != 1:
+        # grouped deconv: split channels, run per group, concat
+        xs = jnp.split(data, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        outs = [lax.conv_general_dilated(
+            x, w, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilate,
+            dimension_numbers=dn)
+            for x, w in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = lax.conv_general_dilated(
+            data, weight, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilate,
+            dimension_numbers=dn)
+    if not no_bias and bias is not None:
+        out = out + jnp.reshape(bias, (1, -1) + (1,) * n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+@register("Pooling")
+def pooling(data, *, kernel=(), pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, cudnn_off=False):
+    n = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * n
+        pad = (0,) * n
+    stride = tuple(s if s else 1 for s in _tuplize(stride, n)) if not global_pool else (1,) * n
+    pad_ = _tuplize(pad, n) if not global_pool else (0,) * n
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad_)
+    if pooling_convention == "full":
+        # ceil-mode output: add extra padding on the high side when needed
+        extra = []
+        for i in range(n):
+            size = data.shape[2 + i] + 2 * pad_[i] - kernel[i]
+            rem = size % stride[i]
+            extra.append(0 if rem == 0 else stride[i] - rem)
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pad_, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        p2 = lax.reduce_window(jnp.square(data), 0.0, lax.add, window, strides, padding)
+        return jnp.sqrt(p2)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+alias("Pooling", "pooling")
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               _training=True):
+    """Returns (out, new_moving_mean, new_moving_var).
+
+    The reference mutates aux states in place (src/operator/nn/batch_norm.cc);
+    our pure-functional form returns updated stats and the layer/executor
+    commits them — same observable semantics, XLA-friendly.
+    """
+    red_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _training and not use_global_stats:
+        mean = jnp.mean(data, axis=red_axes)
+        var = jnp.var(data, axis=red_axes)
+        new_mean = moving_mean * momentum + mean * (1.0 - momentum)
+        new_var = moving_var * momentum + var * (1.0 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    shape = [1] * data.ndim
+    shape[axis % data.ndim] = data.shape[axis % data.ndim]
+    inv = lax.rsqrt(var + eps)
+    out = (data - jnp.reshape(mean, shape)) * jnp.reshape(inv * g, shape) \
+        + jnp.reshape(beta, shape)
+    return out, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis % data.ndim] = data.shape[axis % data.ndim]
+    return out * jnp.reshape(gamma, shape) + jnp.reshape(beta, shape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, *, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * jnp.reshape(gamma, shape) + jnp.reshape(beta, shape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / nrm
+
+
+@register("LRN")
+def lrn(data, *, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window = jnp.stack([padded[:, i:i + data.shape[1]] for i in range(nsize)], 0).sum(0)
+    return data / jnp.power(knorm + alpha * window / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+@register("Activation")
+def activation(data, *, act_type="relu"):
+    acts = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+    }
+    return acts[act_type](data)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, *, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        a, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, a * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "prelu":
+        g = gamma
+        shape = [1] * data.ndim
+        if g.ndim == 1 and data.ndim > 1:
+            shape[1] = g.shape[0]
+            g = jnp.reshape(g, shape)
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "rrelu":
+        key = _random.next_key()
+        slope_r = jax.random.uniform(key, data.shape, data.dtype,
+                                     lower_bound, upper_bound)
+        return jnp.where(data >= 0, data, slope_r * data)
+    raise ValueError(act_type)
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+
+@register("softmax")
+def softmax(data, *, axis=-1, temperature=None, length=None):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, *, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, *, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    lp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(lp, lab[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+def _softmax_output_impl(data, label, grad_scale, ignore_label, multi_output,
+                         use_ignore, normalization, smooth_alpha):
+    axis = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
+                         use_ignore, normalization, smooth_alpha):
+    return _softmax_output_impl(data, label, grad_scale, ignore_label,
+                                multi_output, use_ignore, normalization,
+                                smooth_alpha)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization, smooth_alpha):
+    out = _softmax_output_impl(data, label, grad_scale, ignore_label,
+                               multi_output, use_ignore, normalization,
+                               smooth_alpha)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, multi_output, use_ignore,
+                        normalization, smooth_alpha, res, g):
+    """Loss-layer gradient: softmax(data) - one_hot(label), the reference's
+    SoftmaxOutput backward (src/operator/softmax_output-inl.h) — the incoming
+    cotangent is ignored (SoftmaxOutput is a head/loss op)."""
+    out, label = res
+    axis = 1 if multi_output else -1
+    ncls = out.shape[axis]
+    lab = label.astype(jnp.int32)
+    oh = jax.nn.one_hot(lab, ncls, dtype=out.dtype)
+    if smooth_alpha:
+        oh = oh * (1.0 - smooth_alpha) + smooth_alpha / ncls
+    if multi_output:
+        # label shape (N, spatial...) -> one_hot gives (..., C); move C to axis 1
+        oh = jnp.moveaxis(oh, -1, 1)
+    grad = out - oh
+    if use_ignore:
+        mask = (lab != jnp.asarray(ignore_label, jnp.int32))
+        if multi_output:
+            grad = grad * mask[:, None].astype(grad.dtype)
+        else:
+            grad = grad * mask[..., None].astype(grad.dtype)
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.maximum(jnp.sum((lab != jnp.asarray(ignore_label, jnp.int32))
+                                    .astype(grad.dtype)), 1.0)
+        scale = scale / valid
+    return (grad * scale, jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput")
+def softmax_output(data, label=None, *, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    if label is None:
+        axis = 1 if multi_output else -1
+        return jax.nn.softmax(data, axis=axis)
+    return _softmax_output_core(data, label, grad_scale, ignore_label,
+                                multi_output, use_ignore, normalization,
+                                smooth_alpha)
+
+
+@register("CTCLoss")
+def ctc_loss(data, label, *, use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    # data: (T, N, C) activations (pre-softmax), label: (N, L)
+    logp = jax.nn.log_softmax(data, axis=-1)
+    T, N, C = data.shape
+    lab = label.astype(jnp.int32)
+    L = lab.shape[1]
+    blank = 0 if blank_label == "first" else C - 1
+    # extended label sequence with blanks: length 2L+1
+    ext = jnp.full((N, 2 * L + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    S = 2 * L + 1
+    neg_inf = -1e30
+
+    # label_lengths: count of non-(-1/0-pad) entries; MXNet pads with -1 or 0
+    pad_mask = (lab >= 0) & (lab != 0) if blank == 0 else (lab >= 0)
+    lab_len = jnp.sum(pad_mask.astype(jnp.int32), axis=1)
+    ext_len = 2 * lab_len + 1
+
+    def step(alpha_prev, logp_t):
+        # alpha: (N, S)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)  # (N, S)
+        a0 = alpha_prev
+        a1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha_prev[:, :-1]], 1)
+        a2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha_prev[:, :-2]], 1)
+        # skip allowed only when ext[s] != blank and ext[s] != ext[s-2]
+        ext_m2 = jnp.concatenate([jnp.full((N, 2), -2, jnp.int32), ext[:, :-2]], 1)
+        can_skip = (ext != blank) & (ext != ext_m2)
+        a2 = jnp.where(can_skip, a2, neg_inf)
+        alpha = jnp.logaddexp(jnp.logaddexp(a0, a1), a2) + emit
+        return alpha, alpha
+
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    first_lab = ext[:, 1]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(logp[0], first_lab[:, None], 1)[:, 0])
+    alpha_T, _ = lax.scan(step, alpha0, logp[1:])
+    idx_last = (ext_len - 1)[:, None]
+    idx_prev = (ext_len - 2)[:, None]
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(alpha_T, idx_last, 1),
+        jnp.take_along_axis(alpha_T, jnp.maximum(idx_prev, 0), 1))[:, 0]
+    return -ll
+
+
+alias("CTCLoss", "ctc_loss")
+
+
+# ---------------------------------------------------------------------------
+# Dropout / Embedding
+# ---------------------------------------------------------------------------
+
+@register("Dropout", is_random=True)
+def dropout(data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
+            _training=True):
+    # mode='always': apply dropout regardless of train/predict (MC dropout;
+    # reference src/operator/nn/dropout-inl.h DropoutParam::mode)
+    if (not _training and mode != "always") or p <= 0.0:
+        return data * 1.0
+    key = _random.next_key()
+    shape = list(data.shape)
+    for a in axes or ():
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    return jnp.where(mask, data / keep, jnp.zeros_like(data))
+
+
+@register("Embedding")
+def embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# RNN (fused; reference: src/operator/rnn-inl.h, cudnn_rnn-inl.h)
+# ---------------------------------------------------------------------------
+
+def _lstm_cell(x, h, c, wx, wh, bx, bh):
+    gates = x @ wx.T + h @ wh.T + bx + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_cell(x, h, wx, wh, bx, bh):
+    xr, xz, xn = jnp.split(x @ wx.T + bx, 3, axis=-1)
+    hr, hz, hn = jnp.split(h @ wh.T + bh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_cell(x, h, wx, wh, bx, bh, act):
+    return act(x @ wx.T + h @ wh.T + bx + bh)
+
+
+def _rnn_param_shapes(mode, input_size, state_size, num_layers, bidirectional):
+    mult = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    dirs = 2 if bidirectional else 1
+    shapes = []
+    for layer in range(num_layers):
+        for d in range(dirs):
+            in_sz = input_size if layer == 0 else state_size * dirs
+            shapes.append(("wx", (mult * state_size, in_sz)))
+            shapes.append(("wh", (mult * state_size, state_size)))
+    for layer in range(num_layers):
+        for d in range(dirs):
+            shapes.append(("bx", (mult * state_size,)))
+            shapes.append(("bh", (mult * state_size,)))
+    return shapes
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    return sum(int(jnp.prod(jnp.asarray(s))) for _, s in
+               _rnn_param_shapes(mode, input_size, state_size, num_layers, bidirectional))
+
+
+def _unpack_rnn_params(params, mode, input_size, state_size, num_layers,
+                       bidirectional):
+    shapes = _rnn_param_shapes(mode, input_size, state_size, num_layers, bidirectional)
+    out, off = [], 0
+    for _, s in shapes:
+        n = 1
+        for d in s:
+            n *= d
+        out.append(jnp.reshape(lax.dynamic_slice(params, (off,), (n,)), s))
+        off += n
+    return out
+
+
+@register("RNN", num_outputs=lambda p: 3 if p.get("mode") == "lstm" and p.get("state_outputs") else (2 if p.get("state_outputs") else 1))
+def rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        projection_size=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, lstm_state_clip_nan=False):
+    """Fused multi-layer RNN over ``lax.scan`` (time major: (T, N, I)).
+
+    The scan body is a dense cell -> XLA fuses gates into MXU matmuls; this is
+    the TPU analog of the reference's miopenRNN fused kernels
+    (src/operator/cudnn_rnn-inl.h:43).
+    """
+    T, N, I = data.shape
+    dirs = 2 if bidirectional else 1
+    flat = _unpack_rnn_params(parameters, mode, I, state_size, num_layers,
+                              bidirectional)
+    mult = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    n_gate_pairs = num_layers * dirs
+    wxs = flat[0:2 * n_gate_pairs:2]
+    whs = flat[1:2 * n_gate_pairs:2]
+    bxs = flat[2 * n_gate_pairs::2]
+    bhs = flat[2 * n_gate_pairs + 1::2]
+
+    h0 = state  # (L*dirs, N, H)
+    c0 = state_cell if mode == "lstm" else None
+    x = data
+    h_finals, c_finals = [], []
+    act = jnp.tanh if mode != "rnn_relu" else jax.nn.relu
+
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(dirs):
+            li = layer * dirs + d
+            wx, wh, bx, bh = wxs[li], whs[li], bxs[li], bhs[li]
+            xs = x if d == 0 else jnp.flip(x, axis=0)
+            if mode == "lstm":
+                def step(carry, xt):
+                    h, c = carry
+                    h2, c2 = _lstm_cell(xt, h, c, wx, wh, bx, bh)
+                    return (h2, c2), h2
+                (hT, cT), ys = lax.scan(step, (h0[li], c0[li]), xs)
+                c_finals.append(cT)
+            elif mode == "gru":
+                def step(h, xt):
+                    h2 = _gru_cell(xt, h, wx, wh, bx, bh)
+                    return h2, h2
+                hT, ys = lax.scan(step, h0[li], xs)
+            else:
+                def step(h, xt):
+                    h2 = _rnn_cell(xt, h, wx, wh, bx, bh, act)
+                    return h2, h2
+                hT, ys = lax.scan(step, h0[li], xs)
+            h_finals.append(hT)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs_dir.append(ys)
+        x = jnp.concatenate(outs_dir, axis=-1) if dirs == 2 else outs_dir[0]
+        if p > 0.0 and layer < num_layers - 1:
+            key = _random.next_key()
+            mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), jnp.zeros_like(x))
+
+    hF = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        cF = jnp.stack(c_finals, axis=0)
+        if state_outputs:
+            return x, hF, cF
+        return x
+    if state_outputs:
+        return x, hF
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Upsampling / resize
+# ---------------------------------------------------------------------------
+
+@register("UpSampling")
+def upsampling(*data, scale=2, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    x = data[0]
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        if len(data) > 1 and multi_input_mode == "concat":
+            outs = [out]
+            for d in data[1:]:
+                s = out.shape[2] // d.shape[2]
+                outs.append(jnp.repeat(jnp.repeat(d, s, axis=2), s, axis=3))
+            out = jnp.concatenate(outs, axis=1)
+        return out
+    raise NotImplementedError("bilinear UpSampling via Deconvolution")
+
+
+@register("_contrib_BilinearResize2D")
+def bilinear_resize(data, *, height=0, width=0, scale_height=None, scale_width=None):
+    n, c, h, w = data.shape
+    oh = height or int(h * scale_height)
+    ow = width or int(w * scale_width)
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pool(data, *, output_size=1):
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    n, c, h, w = data.shape
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")

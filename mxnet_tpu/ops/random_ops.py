@@ -1,0 +1,131 @@
+"""Random sampling operators.
+
+Parity: src/operator/random/sample_op.cc + multisample/multinomial/shuffle.
+Design: stateful facade over stateless JAX PRNG (see mxnet_tpu/random.py and
+SURVEY.md §7 hard-part 5). Every op draws a key via random.next_key() — global
+chain in eager mode, threaded key input inside traced graphs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+from .. import random as _random
+from ..base import normalize_dtype
+
+
+def _dt(dtype):
+    return normalize_dtype(dtype or "float32")
+
+
+@register("_random_uniform", is_random=True)
+def random_uniform(*, low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.uniform(_random.next_key(), tuple(shape), _dt(dtype),
+                              low, high)
+
+
+@register("_random_normal", is_random=True)
+def random_normal(*, loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    return loc + scale * jax.random.normal(_random.next_key(), tuple(shape),
+                                           _dt(dtype))
+
+
+alias("_random_normal", "_random_gaussian")
+
+
+@register("_random_gamma", is_random=True)
+def random_gamma(*, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None):
+    return beta * jax.random.gamma(_random.next_key(), alpha, tuple(shape),
+                                   _dt(dtype))
+
+
+@register("_random_exponential", is_random=True)
+def random_exponential(*, lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.exponential(_random.next_key(), tuple(shape),
+                                  _dt(dtype)) / lam
+
+
+@register("_random_poisson", is_random=True)
+def random_poisson(*, lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.poisson(_random.next_key(), lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", is_random=True)
+def random_negbinomial(*, k=1, p=1.0, shape=(1,), dtype="float32", ctx=None):
+    key1, key2 = jax.random.split(_random.next_key())
+    lam = jax.random.gamma(key1, k, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(key2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", is_random=True)
+def random_gen_negbinomial(*, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", ctx=None):
+    key1, key2 = jax.random.split(_random.next_key())
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(key1, r, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(key2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", is_random=True)
+def random_randint(*, low=0, high=1, shape=(1,), dtype="int32", ctx=None):
+    return jax.random.randint(_random.next_key(), tuple(shape), low, high,
+                              _dt(dtype))
+
+
+# sample_* variants: per-element distribution parameters as inputs
+@register("_sample_uniform", is_random=True)
+def sample_uniform(low, high, *, shape=(), dtype="float32"):
+    out_shape = low.shape + tuple(shape)
+    u = jax.random.uniform(_random.next_key(), out_shape, _dt(dtype))
+    ext = (...,) + (None,) * len(tuple(shape))
+    return low[ext] + u * (high - low)[ext]
+
+
+@register("_sample_normal", is_random=True)
+def sample_normal(mu, sigma, *, shape=(), dtype="float32"):
+    out_shape = mu.shape + tuple(shape)
+    z = jax.random.normal(_random.next_key(), out_shape, _dt(dtype))
+    ext = (...,) + (None,) * len(tuple(shape))
+    return mu[ext] + z * sigma[ext]
+
+
+@register("_sample_gamma", is_random=True)
+def sample_gamma(alpha, beta, *, shape=(), dtype="float32"):
+    out_shape = alpha.shape + tuple(shape)
+    ext = (...,) + (None,) * len(tuple(shape))
+    g = jax.random.gamma(_random.next_key(),
+                         jnp.broadcast_to(alpha[ext], out_shape), dtype=_dt(dtype))
+    return g * beta[ext]
+
+
+@register("_sample_multinomial", is_random=True)
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32"):
+    # data: (..., K) probabilities
+    n = 1
+    for s in tuple(shape) or ():
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    flat = logits.reshape(-1, logits.shape[-1])
+    keys = jax.random.split(_random.next_key(), flat.shape[0])
+    draws = jax.vmap(lambda k, lg: jax.random.categorical(k, lg, shape=(max(n, 1),)))(keys, flat)
+    out_shape = data.shape[:-1] + tuple(shape) if shape else data.shape[:-1]
+    out = draws.reshape(out_shape).astype(_dt(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(flat, -1), draws, axis=-1).reshape(out_shape)
+        return out, lp
+    return out
+
+
+@register("_shuffle", is_random=True)
+def shuffle(data):
+    return jax.random.permutation(_random.next_key(), data, axis=0)
+
+
+@register("_sample_unique_zipfian", is_random=True)
+def sample_unique_zipfian(*, range_max, shape=(1,)):
+    # approximate: log-uniform proposals (used by sampled softmax)
+    u = jax.random.uniform(_random.next_key(), tuple(shape))
+    out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
+    return jnp.clip(out, 0, range_max - 1)

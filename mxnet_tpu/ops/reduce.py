@@ -1,0 +1,103 @@
+"""Reductions and broadcast-to ops.
+
+Parity: src/operator/tensor/broadcast_reduce-inl.h + broadcast_reduce_op.
+XLA handles reduction tiling on the MXU/VPU; these are thin jnp wrappers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list,)):
+        return tuple(axis)
+    return axis
+
+
+def _reduce(name, f):
+    def op(data, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            if isinstance(ax, int):
+                ax = (ax,)
+            ax = tuple(i for i in range(data.ndim)
+                       if i not in tuple(a % data.ndim for a in ax))
+        return f(data, axis=ax, keepdims=keepdims)
+    op.__name__ = name
+    register(name)(op)
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+alias("sum", "sum_axis")
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@register("norm")
+def norm(data, *, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, *, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, *, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+@register("broadcast_to")
+def broadcast_to(data, *, shape):
+    # MXNet semantics: 0 in target shape means "keep this dim"
+    tgt = tuple(s if s != 0 else data.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis")
+def broadcast_axis(data, *, axis, size):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+alias("broadcast_axis", "broadcast_axes")
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("cumsum")
+def cumsum(data, *, axis=None, dtype=None):
+    return jnp.cumsum(data, axis=axis, dtype=dtype)
+
+
+@register("square_sum")
+def square_sum(data, *, axis=None, keepdims=False):
+    return jnp.sum(jnp.square(data), axis=_norm_axis(axis), keepdims=keepdims)

@@ -1,0 +1,87 @@
+"""Central operator registry.
+
+The reference keeps a single NNVM registry consumed by both the imperative
+runtime and the symbolic executor (SURVEY.md §1; reference:
+include/mxnet/op_attr_types.h, src/operator/nn/fully_connected.cc:239-326 for
+the registration pattern). We keep that key design point — one registry, two
+front-ends — but each op is a **pure JAX function**:
+
+* gradients come from ``jax.vjp`` (no hand-written FGradient),
+* shape/type inference comes from ``jax.eval_shape`` (no FInferShape),
+* CPU/TPU portability comes from XLA (no per-device kernels),
+* fusion/memory planning come from ``jax.jit`` (no PlanMemory pass).
+
+Op functions take positional array arguments followed by keyword hyper
+parameters and return one array or a tuple of arrays. Ops that need
+randomness draw keys via :mod:`mxnet_tpu.random` (stateful facade; traced
+graphs thread an explicit key input).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["Operator", "register", "get", "list_ops", "alias"]
+
+_REGISTRY: dict[str, "Operator"] = {}
+
+
+class Operator:
+    """A registered op: a pure jax fn + metadata for the two front-ends."""
+
+    __slots__ = ("name", "fn", "num_outputs", "param_names", "is_random",
+                 "doc", "generic_out")
+
+    def __init__(self, name, fn, num_outputs=1, is_random=False):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs  # int, or callable(params)->int
+        self.is_random = is_random
+        self.doc = fn.__doc__ or ""
+        sig = inspect.signature(fn)
+        self.param_names = [
+            p.name for p in sig.parameters.values()
+            if p.kind == inspect.Parameter.KEYWORD_ONLY
+        ]
+
+    def resolve_num_outputs(self, params):
+        if callable(self.num_outputs):
+            return self.num_outputs(params)
+        return self.num_outputs
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __repr__(self):
+        return "Operator(%s)" % self.name
+
+
+def register(name=None, num_outputs=1, is_random=False):
+    """Decorator: register a pure jax function as an operator."""
+    def deco(fn):
+        opname = name or fn.__name__
+        op = Operator(opname, fn, num_outputs=num_outputs, is_random=is_random)
+        if opname in _REGISTRY:
+            raise ValueError("duplicate op registration: %s" % opname)
+        _REGISTRY[opname] = op
+        return fn
+    return deco
+
+
+def alias(existing, *names):
+    op = _REGISTRY[existing]
+    for n in names:
+        _REGISTRY[n] = op
+    return op
+
+
+def get(name) -> Operator:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError("operator %r is not registered (have %d ops)"
+                       % (name, len(_REGISTRY)))
+
+
+def list_ops():
+    return sorted(_REGISTRY.keys())

@@ -1,0 +1,86 @@
+"""Stateful RNG facade over JAX's stateless PRNG.
+
+The reference has per-device stateful RNG resources
+(``src/resource.cc``, ``ResourceRequest::kRandom``) and a test discipline
+built on ``mx.random.seed`` (tests/python/unittest/common.py ``with_seed``).
+TPU-native design (SURVEY.md §7 hard-part 5): a *key chain* — a module-level
+key that is split on every draw — reproduces the stateful surface, while
+traced (jitted) graphs never touch global state: during tracing, draws pull
+subkeys from an explicit key argument threaded by the executor, so compiled
+functions get fresh randomness per invocation with zero recompilation.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _np
+
+__all__ = ["seed", "next_key", "TraceRng", "current_trace_rng"]
+
+_state = threading.local()
+
+
+def _chain():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global RNG (parity: mx.random.seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+    _np.random.seed(int(seed_state) % (2**32))
+
+
+class TraceRng:
+    """Collects key requests while tracing a graph.
+
+    The executor creates one per trace; each random op calls ``next_key()``
+    which folds a fresh per-site subkey out of a single key *input* to the
+    compiled function. At run time the executor feeds a new key each call.
+    """
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.count = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self.base_key, self.count)
+        self.count += 1
+        return k
+
+
+def current_trace_rng():
+    return getattr(_state, "trace_rng", None)
+
+
+class _trace_scope:
+    def __init__(self, rng):
+        self.rng = rng
+
+    def __enter__(self):
+        self.prev = getattr(_state, "trace_rng", None)
+        _state.trace_rng = self.rng
+        return self.rng
+
+    def __exit__(self, *a):
+        _state.trace_rng = self.prev
+
+
+def trace_scope(base_key):
+    return _trace_scope(TraceRng(base_key))
+
+
+def next_key():
+    """Draw a fresh PRNG key.
+
+    Inside a trace scope: pull from the trace's key input (keeps compiled
+    graphs pure). Outside: advance the global key chain (eager mode).
+    """
+    tr = current_trace_rng()
+    if tr is not None:
+        return tr.next_key()
+    key = _chain()
+    _state.key, sub = jax.random.split(key)
+    return sub

@@ -1,0 +1,124 @@
+"""Shared test harness.
+
+Parity: python/mxnet/test_utils.py in the reference — ``default_context()``
+(:53, env-switchable device so one suite runs everywhere),
+``assert_almost_equal`` (:474), ``check_numeric_gradient`` (:794, finite
+differences), ``check_consistency`` (:1213, cross-device parity — the main
+cpu↔tpu tool), ``rand_ndarray``. Same roles, TPU-flavored.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .context import Context, cpu, tpu
+from . import ndarray as nd
+from . import autograd
+
+
+def default_context():
+    dev = os.environ.get("MXNET_TEST_DEVICE", "cpu")
+    return Context(dev, 0)
+
+
+def set_default_context(ctx):
+    os.environ["MXNET_TEST_DEVICE"] = ctx.device_type
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg="%s vs %s" % names)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8):
+    try:
+        assert_almost_equal(a, b, rtol, atol)
+        return True
+    except AssertionError:
+        return False
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    arr = np.random.uniform(-1.0, 1.0, size=shape).astype(dtype or np.float32)
+    if stype == "default":
+        return nd.array(arr, ctx=ctx)
+    if density is not None:
+        mask = np.random.uniform(0, 1, size=(shape[0],) + (1,) * (len(shape) - 1)) < density
+        arr = arr * mask
+    if stype == "row_sparse":
+        return nd.sparse.row_sparse_array(arr, ctx=ctx)
+    if stype == "csr":
+        return nd.sparse.csr_matrix(arr, ctx=ctx)
+    raise ValueError(stype)
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
+    """Finite-difference check of eager autograd for fn(*NDArrays)->NDArray."""
+    inputs = [x if isinstance(x, nd.NDArray) else nd.array(x) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    def f_np(*arrs):
+        outs = fn(*[nd.array(a.astype(np.float64).astype(np.float32)) for a in arrs])
+        return float(outs.sum().asscalar())
+
+    base = [x.asnumpy().astype(np.float64) for x in inputs]
+    for xi, (xb, ga) in enumerate(zip(base, analytic)):
+        num = np.zeros_like(xb)
+        flat = xb.reshape(-1)
+        nflat = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = f_np(*[b.astype(np.float32) for b in base])
+            flat[i] = orig - eps
+            fm = f_np(*[b.astype(np.float32) for b in base])
+            flat[i] = orig
+            nflat[i] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(ga, num, rtol=rtol, atol=atol,
+                                   err_msg="analytic vs numeric grad for input %d" % xi)
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-5, atol=1e-7):
+    """Run fn on each context and cross-compare outputs
+    (reference test_utils.check_consistency:1213)."""
+    ctx_list = ctx_list or [cpu(), default_context()]
+    outs = []
+    for c in ctx_list:
+        ins = [nd.array(x.asnumpy() if isinstance(x, nd.NDArray) else x, ctx=c)
+               for x in inputs]
+        o = fn(*ins)
+        outs.append(o.asnumpy())
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+
+
+def with_seed(seed=None):
+    """Decorator: reproducible RNG per test (reference tests common.py:113)."""
+    import functools
+
+    def deco(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            from . import random as _random
+            s = seed if seed is not None else np.random.randint(0, 2**31)
+            _random.seed(s)
+            try:
+                return f(*args, **kwargs)
+            except Exception:
+                print("test failed with seed %d" % s)
+                raise
+        return wrapper
+    return deco

@@ -1,0 +1,213 @@
+"""Autograd tape tests (parity model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(mx.nd.log(x) * 2.0)  # = x^2
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_multi_input():
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = (a * b).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy())
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_grad_req_add():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 3 * 2 * x.asnumpy())
+
+
+def test_grad_req_write_overwrites():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()  # write
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3.0
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0]))
+
+
+def test_fanout_accumulation():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + x * 3.0
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2 * 2.0 + 3.0]))
+
+
+def test_detach_blocks_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2.0
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))  # only d(z)/dx via second factor
+
+
+def test_block_grad_op():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.BlockGrad(x * 2.0) * x
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+
+
+def test_pause():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2.0
+        with ag.pause():
+            c = x * 5.0  # not recorded
+        z = y * c.detach()
+    z.backward()
+    assert_almost_equal(x.grad, np.array([20.0]))
+
+
+def test_is_recording_training():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+    with ag.record(train_mode=False):
+        assert not ag.is_training()
+    with ag.pause():
+        assert not ag.is_recording()
+
+
+def test_softmax_grad():
+    check_numeric_gradient(lambda x: mx.nd.softmax(x, axis=-1).square().sum(),
+                           [np.random.uniform(-1, 1, (3, 4)).astype(np.float32)])
+
+
+def test_fc_grad():
+    x = np.random.uniform(-1, 1, (2, 3)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    check_numeric_gradient(
+        lambda x_, w_, b_: mx.nd.FullyConnected(x_, w_, b_, num_hidden=4).square().sum(),
+        [x, w, b])
+
+
+def test_conv_grad():
+    x = np.random.uniform(-1, 1, (1, 2, 5, 5)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (3, 2, 3, 3)).astype(np.float32)
+    check_numeric_gradient(
+        lambda x_, w_: mx.nd.Convolution(x_, w_, no_bias=True, kernel=(3, 3),
+                                         num_filter=3).square().sum(),
+        [x, w], rtol=5e-2, atol=2e-2)
+
+
+def test_grad_function_api():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    with ag.record():
+        y = (x * x).sum()
+        g = ag.grad(y, [x])[0] if x._ag else None
+    # grad() requires marked vars; mark then redo
+    x2 = mx.nd.array([1.0, 2.0, 3.0])
+    x2.attach_grad()
+    with ag.record():
+        y2 = (x2 * x2).sum()
+    g2 = ag.grad(y2, x2)
+    assert_almost_equal(g2, 2 * x2.asnumpy())
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self._saved
+            return dy * y * (1 - y)
+
+    x = mx.nd.random.uniform(-2, 2, shape=(5,))
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    xn = x.asnumpy()
+    sig = 1 / (1 + np.exp(-xn))
+    assert_almost_equal(x.grad, sig * (1 - sig), rtol=1e-5)
+
+
+def test_dropout_grad_and_mode():
+    x = mx.nd.ones((1000,))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.Dropout(x, p=0.5)
+    y.backward()
+    yn = y.asnumpy()
+    keep = yn != 0
+    assert 0.3 < keep.mean() < 0.7
+    assert_almost_equal(yn[keep], np.full(keep.sum(), 2.0))
+    # grad is mask-scaled
+    assert_almost_equal(x.grad.asnumpy()[keep], np.full(keep.sum(), 2.0))
+    # not training: identity
+    y2 = mx.nd.Dropout(x, p=0.5)
+    assert_almost_equal(y2, x)
+
+
+def test_getitem_grad():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with ag.record():
+        y = x[0].sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([[1.0, 1.0], [0.0, 0.0]]))
+
+
+def test_mark_variables():
+    x = mx.nd.array([1.0, 2.0])
+    g = mx.nd.zeros((2,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(g, 2 * x.asnumpy())

@@ -1,0 +1,253 @@
+"""NDArray eager tests (parity model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal, default_context,
+                                  rand_ndarray, with_seed)
+
+
+def test_creation():
+    x = mx.nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert x.asnumpy().sum() == 0
+    y = mx.nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    z = mx.nd.full((2, 2), 7.0)
+    assert (z.asnumpy() == 7).all()
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.dtype == np.float32
+    b = mx.nd.array(np.array([1, 2], dtype=np.int64))
+    assert b.dtype == np.int64
+    r = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(r, np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_elementwise():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, np.array([[6, 8], [10, 12]]))
+    assert_almost_equal(a - b, np.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal(a * b, np.array([[5, 12], [21, 32]]))
+    assert_almost_equal(b / a, np.array([[5, 3], [7 / 3, 2]]), rtol=1e-6)
+    assert_almost_equal(a + 1, np.array([[2, 3], [4, 5]]))
+    assert_almost_equal(2 * a, np.array([[2, 4], [6, 8]]))
+    assert_almost_equal(1 / a, 1 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(2 - a, 2 - a.asnumpy())
+    assert_almost_equal(mx.nd.sqrt(a), np.sqrt(a.asnumpy()), rtol=1e-6)
+    assert_almost_equal(mx.nd.exp(a), np.exp(a.asnumpy()), rtol=1e-6)
+    assert_almost_equal(mx.nd.log(a), np.log(a.asnumpy()), rtol=1e-6)
+    assert_almost_equal(mx.nd.negative(a), -a.asnumpy())
+    assert_almost_equal(mx.nd.maximum(a, b), np.maximum(a.asnumpy(), b.asnumpy()))
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal(a == b, np.array([0.0, 1.0, 0.0]))
+    assert_almost_equal(a > b, np.array([0.0, 0.0, 1.0]))
+    assert_almost_equal(a <= 2, np.array([1.0, 1.0, 0.0]))
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 2))
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert_almost_equal(a[0], np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1, 2], np.arange(20, 24))
+    assert_almost_equal(a[:, 1], a.asnumpy()[:, 1])
+    assert_almost_equal(a[0:1], a.asnumpy()[0:1])
+    a[0] = 0
+    assert a.asnumpy()[0].sum() == 0
+    a[:] = 5
+    assert (a.asnumpy() == 5).all()
+
+
+def test_setitem_array():
+    a = mx.nd.zeros((3, 3))
+    a[1] = mx.nd.ones((3,))
+    assert a.asnumpy()[1].sum() == 3
+
+
+def test_reshape_transpose():
+    a = mx.nd.array(np.arange(12).astype(np.float32))
+    b = a.reshape((3, 4))
+    assert b.shape == (3, 4)
+    c = b.reshape((-1, 2))
+    assert c.shape == (6, 2)
+    d = b.reshape((0, -1))  # mxnet special code 0: keep dim
+    assert d.shape == (3, 4)
+    t = b.T
+    assert t.shape == (4, 3)
+    assert_almost_equal(t, b.asnumpy().T)
+    e = b.reshape((-3,))
+    assert e.shape == (12,)
+    f = a.reshape((-4, 3, 4))
+    assert f.shape == (3, 4)
+
+
+def test_reduce():
+    a = mx.nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    assert a.sum().asscalar() == 66
+    assert_almost_equal(a.sum(axis=0), a.asnumpy().sum(axis=0))
+    assert_almost_equal(a.mean(axis=1, keepdims=True), a.asnumpy().mean(axis=1, keepdims=True))
+    assert a.max().asscalar() == 11
+    assert a.min().asscalar() == 0
+    assert_almost_equal(mx.nd.sum(a, axis=(0, 1)), 66)
+    assert_almost_equal(a.norm(), np.sqrt((a.asnumpy() ** 2).sum()), rtol=1e-6)
+    assert_almost_equal(mx.nd.sum(a, axis=0, exclude=True), a.asnumpy().sum(axis=1))
+
+
+def test_dot():
+    a = rand_ndarray((4, 5))
+    b = rand_ndarray((5, 6))
+    assert_almost_equal(mx.nd.dot(a, b), a.asnumpy() @ b.asnumpy(), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mx.nd.dot(a, b.T, transpose_b=True),
+                        a.asnumpy() @ b.asnumpy(), rtol=1e-4, atol=1e-5)
+    x = rand_ndarray((2, 3, 4))
+    y = rand_ndarray((2, 4, 5))
+    assert_almost_equal(mx.nd.batch_dot(x, y),
+                        np.matmul(x.asnumpy(), y.asnumpy()), rtol=1e-4, atol=1e-5)
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    c2 = mx.nd.concat(a, b, dim=1)
+    assert c2.shape == (2, 6)
+    parts = mx.nd.split(c2, num_outputs=2, axis=1)
+    assert parts[0].shape == (2, 3)
+    assert_almost_equal(parts[0], a)
+    s = mx.nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_slice_ops():
+    a = mx.nd.array(np.arange(24).reshape(4, 6).astype(np.float32))
+    s = mx.nd.slice(a, begin=(1, 2), end=(3, 5))
+    assert_almost_equal(s, a.asnumpy()[1:3, 2:5])
+    s2 = mx.nd.slice_axis(a, axis=1, begin=1, end=4)
+    assert_almost_equal(s2, a.asnumpy()[:, 1:4])
+
+
+def test_take_pick_onehot():
+    a = mx.nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    idx = mx.nd.array(np.array([0, 2], dtype=np.int32), dtype="int32")
+    t = mx.nd.take(a, idx)
+    assert_almost_equal(t, a.asnumpy()[[0, 2]])
+    p = mx.nd.pick(a, mx.nd.array([1, 0, 3]), axis=1)
+    assert_almost_equal(p, np.array([1.0, 4.0, 11.0]))
+    oh = mx.nd.one_hot(mx.nd.array([0, 2]), depth=3)
+    assert_almost_equal(oh, np.array([[1, 0, 0], [0, 0, 1]], dtype=np.float32))
+
+
+def test_ordering():
+    a = mx.nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    assert_almost_equal(mx.nd.sort(a), np.sort(a.asnumpy()))
+    assert_almost_equal(mx.nd.argsort(a), np.argsort(a.asnumpy()).astype(np.float32))
+    v, i = mx.nd.topk(a, k=2, ret_typ="both")
+    assert_almost_equal(v, np.array([[3.0, 2.0], [5.0, 4.0]]))
+
+
+def test_astype_copy():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[:] = 9
+    assert (a.asnumpy() == 1).all()
+
+
+def test_context_placement():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(default_context())
+    assert_almost_equal(a, b)
+
+
+def test_broadcast():
+    a = mx.nd.ones((1, 3))
+    b = mx.nd.broadcast_to(a, shape=(4, 3))
+    assert b.shape == (4, 3)
+    c = mx.nd.broadcast_axis(mx.nd.ones((1, 1)), axis=(0, 1), size=(2, 5))
+    assert c.shape == (2, 5)
+
+
+def test_expand_squeeze_flip():
+    a = mx.nd.ones((2, 3))
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert a.expand_dims(0).squeeze(0).shape == (2, 3)
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert_almost_equal(x.flip(axis=1), np.array([[2, 1], [4, 3]]))
+
+
+def test_where_clip():
+    cond = mx.nd.array([1.0, 0.0, 1.0])
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    y = mx.nd.array([4.0, 5.0, 6.0])
+    assert_almost_equal(mx.nd.where(cond, x, y), np.array([1.0, 5.0, 3.0]))
+    assert_almost_equal(x.clip(1.5, 2.5), np.array([1.5, 2.0, 2.5]))
+
+
+@with_seed(42)
+def test_random_reproducible():
+    mx.random.seed(7)
+    a = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert (a == b).all()
+    c = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert not (b == c).all()
+
+
+@with_seed()
+def test_random_moments():
+    u = mx.nd.random.uniform(0, 1, shape=(10000,))
+    assert abs(u.asnumpy().mean() - 0.5) < 0.02
+    n = mx.nd.random.normal(0, 1, shape=(10000,))
+    assert abs(n.asnumpy().mean()) < 0.05
+    assert abs(n.asnumpy().std() - 1.0) < 0.05
+    r = mx.nd.random.randint(0, 10, shape=(1000,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    d = {"w": mx.nd.ones((2, 3)), "b": mx.nd.zeros((4,))}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"])
+    lst = [mx.nd.ones((2,)), mx.nd.zeros((3,))]
+    mx.nd.save(fname, lst)
+    l2 = mx.nd.load(fname)
+    assert len(l2) == 2 and l2[0].shape == (2,)
+
+
+def test_waitall_sync():
+    a = mx.nd.ones((100, 100))
+    for _ in range(5):
+        a = a * 1.00001
+    mx.nd.waitall()
+    a.wait_to_read()
+    assert a.asnumpy().shape == (100, 100)
+
+
+def test_iter_len():
+    a = mx.nd.array(np.arange(6).reshape(3, 2).astype(np.float32))
+    assert len(a) == 3
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3
+    assert_almost_equal(rows[1], np.array([2.0, 3.0]))

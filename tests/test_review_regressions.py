@@ -1,0 +1,99 @@
+"""Regression tests for code-review findings (round 1 review)."""
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.test_utils as tu
+
+
+def test_softmax_output_loss_gradient():
+    data = mx.nd.array([[1., 2., 3.], [1., 0., 0.]])
+    data.attach_grad()
+    label = mx.nd.array([2., 0.])
+    with mx.autograd.record():
+        out = mx.nd.SoftmaxOutput(data, label)
+    out.backward()
+    sm = np.exp(data.asnumpy())
+    sm /= sm.sum(1, keepdims=True)
+    oh = np.eye(3)[[2, 0]]
+    np.testing.assert_allclose(data.grad.asnumpy(), sm - oh, atol=1e-5)
+
+
+def test_out_kwarg_carries_autograd():
+    a = mx.nd.array([1., 2.])
+    a.attach_grad()
+    c = mx.nd.zeros((2,))
+    with mx.autograd.record():
+        mx.nd.broadcast_mul(a, a, out=c)
+        d = c * 2
+    d.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 4 * a.asnumpy())
+
+
+def test_ndarray_key_setitem():
+    x = mx.nd.array([[1., 2.], [3., 4.]])
+    idx = mx.nd.array(np.array([0], dtype=np.int32))
+    x[idx] = 9.0
+    assert x.asnumpy()[0, 0] == 9.0
+    assert x.asnumpy()[1, 0] == 3.0
+
+
+def test_sparse_inherited_dense_fallback():
+    s = tu.rand_ndarray((4, 3), "csr", density=0.5)
+    assert s.size == 12
+    assert s.ndim == 2
+    (s + 1).asnumpy()
+    s.copy()
+    s.astype("float64")
+    r = tu.rand_ndarray((6, 2), "row_sparse", density=0.5)
+    assert r.size == 12
+    (r * 2).asnumpy()
+
+
+def test_deep_backward_no_recursion_limit():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x
+        for _ in range(1500):
+            y = y + 1.0
+    y.backward()
+    assert x.grad.asnumpy()[0] == 1.0
+
+
+def test_random_ctx_placement():
+    r = mx.nd.random.uniform(shape=(2, 2), ctx=mx.cpu(1))
+    assert r.context.device_type == "cpu"
+    assert r.context.device_id == 1
+
+
+def test_make_loss_grad_scale():
+    x = mx.nd.array([1., 2.])
+    x.attach_grad()
+    with mx.autograd.record():
+        l = mx.nd.make_loss(x, grad_scale=0.1)
+    l.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.1, 0.1], rtol=1e-6)
+
+
+def test_dropout_mode_always():
+    y = mx.nd.Dropout(mx.nd.ones((1000,)), p=0.5, mode="always")
+    frac = (y.asnumpy() != 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_int_inputs_are_autograd_constants():
+    xi = mx.nd.array(np.array([1, 2], dtype=np.int32))
+    xi.attach_grad()
+    with mx.autograd.record():
+        z = (xi * xi).sum()
+    z.backward()
+    assert (xi.grad.asnumpy() == 0).all()
+    # embedding: int indices + float weight
+    w = mx.nd.random.normal(shape=(5, 3))
+    w.attach_grad()
+    idx = mx.nd.array(np.array([0, 2], dtype=np.int32))
+    with mx.autograd.record():
+        e = mx.nd.Embedding(idx, w, input_dim=5, output_dim=3).sum()
+    e.backward()
+    rowsums = w.grad.asnumpy().sum(axis=1)
+    np.testing.assert_allclose(rowsums, [3., 0., 3., 0., 0.])
