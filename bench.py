@@ -1,0 +1,87 @@
+"""Benchmark: ResNet-50 synthetic-data training throughput (img/s) on one chip.
+
+Mirrors the reference's `train_imagenet.py --benchmark 1` measurement
+(docs/faq/perf.md:228-237; BASELINE.md). vs_baseline compares against the
+reference's published V100 number at the same batch size:
+363.69 img/s (batch 128, MXNet 1.2 + cuDNN, docs/faq/perf.md:237).
+
+One JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 363.69  # V100 ResNet-50 train, batch 128
+DTYPE = "bfloat16"       # v5e MXU-native
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainStep, make_mesh
+
+    try:
+        devices = jax.devices("tpu")
+    except RuntimeError:
+        devices = []
+    on_tpu = bool(devices)
+    if not on_tpu:
+        devices = jax.devices("cpu")[:1]
+    BATCH = 128 if on_tpu else 8  # CPU fallback: smoke-size only
+    mesh = make_mesh({"dp": 1}, devices=devices[:1])
+
+    sym = models.resnet_symbol(num_classes=1000, num_layers=50)
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=(BATCH, 3, 224, 224))
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    param_shapes = {n: tuple(s) for n, s in zip(arg_names, arg_shapes)
+                    if n not in ("data", "softmax_label")}
+    aux_shapes_d = {n: tuple(s) for n, s in zip(aux_names, aux_shapes)}
+
+    step = SPMDTrainStep(sym, mesh, lr=0.05)
+    step.compile(param_shapes, aux_shapes_d,
+                 {"data": (BATCH, 3, 224, 224)},
+                 {"softmax_label": (BATCH,)})
+    params, aux, opt = step.init(param_shapes, aux_shapes_d)
+    cast = lambda t: jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 else x, t)
+    if DTYPE == "bfloat16":
+        params, aux, opt = cast(params), cast(aux), cast(opt)
+
+    rng = np.random.RandomState(0)
+    data = {"data": jnp.asarray(
+        rng.randn(BATCH, 3, 224, 224), jnp.bfloat16
+        if DTYPE == "bfloat16" else jnp.float32)}
+    label = {"softmax_label": jnp.asarray(
+        rng.randint(0, 1000, (BATCH,)), jnp.float32)}
+    key = jax.random.PRNGKey(0)
+
+    # warmup (compile)
+    for _ in range(3):
+        params, aux, opt, outs = step(params, aux, opt, data, label, key)
+    jax.block_until_ready(outs[0])
+
+    n_steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, aux, opt, outs = step(params, aux, opt, data, label, key)
+    jax.block_until_ready(outs[0])
+    dt = time.perf_counter() - t0
+    img_s = BATCH * n_steps / dt
+
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_b%d_%s%s"
+                  % (BATCH, DTYPE, "" if on_tpu else "_CPU_FALLBACK"),
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,322 @@
+"""Graph executor: bind a Symbol, run it as compiled XLA modules.
+
+Parity surface: ``python/mxnet/executor.py`` + the C++ GraphExecutor
+(reference src/executor/graph_executor.cc: Init :297, Forward :64,
+Backward :77, simple_bind/bind entries :1594-1637). TPU-native design
+(SURVEY.md §7): every pass the reference runs at bind time — PlanMemory,
+DetectInplaceAddTo, AttachOpExecs, op bulking — is XLA's job. ``bind``
+traces the Symbol DAG into a pure function and ``jax.jit``s it:
+
+* forward (predict) module,
+* forward (train) module,
+* fused forward+backward module (one XLA program: the reference's bulked
+  whole-graph endgame, with shared intermediates instead of a tape).
+
+Auxiliary states (BatchNorm moving stats) are explicit inputs/outputs of the
+pure function; the executor commits them after each training forward —
+observably identical to the reference's in-place aux mutation.
+
+Gradients follow ``grad_req`` ('write'/'add'/'null') into caller-provided
+``args_grad`` buffers, like GraphExecutor.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from . import random as _random
+from . import autograd as _autograd
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Executor", "simple_bind"]
+
+
+def _graph_eval_fn(symbol):
+    """Build eval(arg_vals, aux_vals, key, training) -> (outputs, aux_updates).
+
+    Pure function over jax values; traced under jit.
+    """
+    nodes = symbol._topo()
+    entries = list(symbol._entries)
+
+    def eval_fn(arg_vals, aux_vals, key, training):
+        values = {}
+
+        def read(src, oi):
+            if src.is_variable:
+                if src.name in arg_vals:
+                    return arg_vals[src.name]
+                if src.name in aux_vals:
+                    return aux_vals[src.name]
+                raise MXNetError("unbound variable %r" % src.name)
+            v = values[id(src)]
+            return v[oi] if isinstance(v, tuple) else v
+
+        aux_updates = {}
+        with _random.trace_scope(key):
+            for node in nodes:
+                if node.is_variable:
+                    continue
+                ins = [read(s, oi) for (s, oi) in node.inputs]
+                params = dict(node.params)
+                if "_training" in node.op.param_names:
+                    params["_training"] = training
+                out = node.op.fn(*ins, **params)
+                values[id(node)] = out
+                # route aux output slots back to their aux variable names
+                if node.op.aux_outputs:
+                    outs = out if isinstance(out, tuple) else (out,)
+                    for in_slot, out_slot in zip(node.op.aux_inputs,
+                                                 node.op.aux_outputs):
+                        src, _ = node.inputs[in_slot]
+                        if src.is_variable and src.name in aux_vals:
+                            aux_updates[src.name] = outs[out_slot]
+        outputs = [read(n, oi) if n.is_variable else
+                   (values[id(n)][oi] if isinstance(values[id(n)], tuple)
+                    else values[id(n)])
+                   for (n, oi) in entries]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
+class Executor:
+    """A bound, compiled computation graph."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        # ---- normalize args ------------------------------------------------
+        if isinstance(args, dict):
+            self.arg_dict = {k: args[k] for k in self._arg_names}
+        else:
+            if args is None or len(args) != len(self._arg_names):
+                raise MXNetError("bind: need %d args (%s)"
+                                 % (len(self._arg_names), self._arg_names))
+            self.arg_dict = dict(zip(self._arg_names, args))
+        self.arg_arrays = [self.arg_dict[k] for k in self._arg_names]
+
+        if isinstance(aux_states, dict):
+            self.aux_dict = {k: aux_states[k] for k in self._aux_names}
+        elif aux_states is None:
+            self.aux_dict = {}
+            if self._aux_names:
+                raise MXNetError("bind: aux_states required for %s"
+                                 % self._aux_names)
+        else:
+            self.aux_dict = dict(zip(self._aux_names, aux_states))
+        self.aux_arrays = [self.aux_dict[k] for k in self._aux_names]
+
+        # ---- grad bookkeeping ---------------------------------------------
+        if isinstance(grad_req, str):
+            self._grad_req = {k: grad_req for k in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = {k: grad_req.get(k, "null") for k in self._arg_names}
+        if args_grad is None:
+            self.grad_dict = {}
+            self._grad_req = {k: "null" for k in self._arg_names}
+        elif isinstance(args_grad, dict):
+            self.grad_dict = dict(args_grad)
+        else:
+            self.grad_dict = dict(zip(self._arg_names, args_grad))
+        for k in self._arg_names:
+            if k not in self.grad_dict:
+                self._grad_req[k] = "null"
+        self.grad_arrays = [self.grad_dict.get(k) for k in self._arg_names]
+        self._req_args = [k for k in self._arg_names
+                          if self._grad_req.get(k, "null") != "null"]
+
+        # ---- compiled callables -------------------------------------------
+        eval_fn = _graph_eval_fn(symbol)
+        self._eval_fn = eval_fn
+        dev = self._ctx.jax_device
+
+        @jax.jit
+        def fwd_predict(arg_vals, aux_vals, key):
+            outs, _ = eval_fn(arg_vals, aux_vals, key, False)
+            return outs
+
+        @jax.jit
+        def fwd_train(arg_vals, aux_vals, key):
+            return eval_fn(arg_vals, aux_vals, key, True)
+
+        req = list(self._req_args)
+
+        @jax.jit
+        def fwd_bwd(arg_vals, aux_vals, key, ograds):
+            diff = {k: arg_vals[k] for k in req}
+            rest = {k: v for k, v in arg_vals.items() if k not in diff}
+
+            def f(d):
+                outs, auxu = eval_fn({**rest, **d}, aux_vals, key, True)
+                return outs, auxu
+
+            outs, vjp, auxu = jax.vjp(f, diff, has_aux=True)
+            grads = vjp(list(ograds))[0]
+            return outs, auxu, grads
+
+        self._fwd_predict = fwd_predict
+        self._fwd_train = fwd_train
+        self._fwd_bwd = fwd_bwd
+        self.outputs = []
+        self._pending = None  # (grads, aux_updates) from fused train step
+        self._ones_cache = None
+
+    # ---------------------------------------------------------------- run
+    def _arg_vals(self):
+        return {k: v._data for k, v in self.arg_dict.items()}
+
+    def _aux_vals(self):
+        return {k: v._data for k, v in self.aux_dict.items()}
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                if isinstance(v, NDArray):
+                    self.arg_dict[k]._rebind(v._data.astype(
+                        self.arg_dict[k].dtype))
+                else:
+                    self.arg_dict[k]._rebind(
+                        jnp.asarray(_np.asarray(v), self.arg_dict[k].dtype))
+        key = _random.next_key()
+        if is_train:
+            if self._req_args:
+                if self._ones_cache is None:
+                    self._ones_cache = [jnp.ones(o, _np.float32)
+                                        for o in self._out_shapes()]
+                ones = self._ones_cache
+                outs, auxu, grads = self._fwd_bwd(
+                    self._arg_vals(), self._aux_vals(), key, ones)
+                self._pending = (grads, auxu)
+            else:
+                outs, auxu = self._fwd_train(self._arg_vals(),
+                                             self._aux_vals(), key)
+                self._pending = (None, auxu)
+            # commit aux updates (reference mutates aux in place each fwd)
+            for k, v in self._pending[1].items():
+                self.aux_dict[k]._rebind(v)
+        else:
+            outs = self._fwd_predict(self._arg_vals(), self._aux_vals(), key)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def _out_shapes(self):
+        eval_fn = self._eval_fn
+        outs = jax.eval_shape(
+            lambda a, x, k: eval_fn(a, x, k, True)[0],
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in self.arg_dict.items()},
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in self.aux_dict.items()},
+            jax.ShapeDtypeStruct((2,), _np.uint32))
+        return [o.shape for o in outs]
+
+    def backward(self, out_grads=None, is_train=True):
+        if not self._req_args:
+            return
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = [g._data for g in out_grads]
+            key = _random.next_key()
+            outs, auxu, grads = self._fwd_bwd(
+                self._arg_vals(), self._aux_vals(), key, ograds)
+        else:
+            if self._pending is None or self._pending[0] is None:
+                raise MXNetError("backward called before forward(is_train=True)")
+            grads = self._pending[0]
+        for k in self._req_args:
+            g = grads[k]
+            buf = self.grad_dict[k]
+            if self._grad_req[k] == "add":
+                buf._rebind(buf._data + g.astype(buf.dtype))
+            else:
+                buf._rebind(g.astype(buf.dtype))
+
+    # ------------------------------------------------------------- utility
+    @property
+    def arg_names(self):
+        return self._arg_names
+
+    @property
+    def aux_names(self):
+        return self._aux_names
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(v._data.astype(self.arg_dict[k].dtype))
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %r" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._rebind(v._data.astype(self.aux_dict[k].dtype))
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (jit handles recompile per shape)."""
+        new_args = {}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**kwargs)
+        for name, shp in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if shp is not None and tuple(shp) != cur.shape:
+                new_args[name] = _nd.zeros(shp, ctx=self._ctx, dtype=cur.dtype)
+            else:
+                new_args[name] = cur
+        new_grads = {k: _nd.zeros(new_args[k].shape, ctx=self._ctx)
+                     for k in self.grad_dict}
+        new_aux = {}
+        for name, shp in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[name]
+            new_aux[name] = cur if shp is None or tuple(shp) == cur.shape \
+                else _nd.zeros(shp, ctx=self._ctx, dtype=cur.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+
+def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
+                group2ctx=None, **kwargs):
+    """Infer shapes from partial bindings, allocate arrays, bind.
+
+    reference: GraphExecutor::Init simple_bind path (graph_executor.cc:1594).
+    """
+    ctx = ctx or current_context()
+    shape_kwargs = {k: v for k, v in kwargs.items()
+                    if isinstance(v, (tuple, list))}
+    arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    type_dict = type_dict or {}
+    args = {}
+    for name, shp in zip(arg_names, arg_shapes):
+        dt = type_dict.get(name, _np.float32)
+        args[name] = _nd.zeros(shp, ctx=ctx, dtype=dt)
+    if isinstance(grad_req, str):
+        req_map = {k: grad_req for k in arg_names}
+    elif isinstance(grad_req, (list, tuple)):
+        req_map = dict(zip(arg_names, grad_req))
+    else:
+        req_map = {k: grad_req.get(k, "null") for k in arg_names}
+    args_grad = {k: _nd.zeros(args[k].shape, ctx=ctx, dtype=args[k].dtype)
+                 for k in arg_names if req_map.get(k, "null") != "null"}
+    aux = {name: _nd.zeros(shp, ctx=ctx)
+           for name, shp in zip(aux_names, aux_shapes)}
+    return Executor(symbol, ctx, args, args_grad, req_map, aux)

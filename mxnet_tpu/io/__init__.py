@@ -1,0 +1,45 @@
+"""IO package (parity: python/mxnet/io/)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter)
+
+
+def MNISTIter(image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+              batch_size=128, shuffle=True, flat=False, **kwargs):
+    """MNIST idx-format iterator (reference src/io/iter_mnist.cc surface)."""
+    import gzip
+    import os
+    import struct
+    import numpy as np
+
+    def read_idx(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+            dt = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+                  13: np.float32, 14: np.float64}[(magic >> 8) & 0xFF]
+            return np.frombuffer(f.read(), dtype=dt).reshape(dims)
+
+    imgs = read_idx(image).astype(np.float32) / 255.0
+    labs = read_idx(label).astype(np.float32)
+    if flat:
+        imgs = imgs.reshape(imgs.shape[0], -1)
+    else:
+        imgs = imgs.reshape(imgs.shape[0], 1, imgs.shape[1], imgs.shape[2])
+    return NDArrayIter(imgs, labs, batch_size=batch_size, shuffle=shuffle,
+                       label_name="softmax_label")
+
+
+def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
+            batch_size=128, **kwargs):
+    """CSV iterator (reference src/io/iter_csv.cc surface)."""
+    import numpy as np
+    data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+    data = data.reshape((-1,) + tuple(data_shape))
+    label = None
+    if label_csv:
+        label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+        label = label.reshape((-1,) + tuple(label_shape))
+    return NDArrayIter(data, label, batch_size=batch_size, **{
+        k: v for k, v in kwargs.items() if k in ("shuffle", "last_batch_handle")})

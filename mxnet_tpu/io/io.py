@@ -1,0 +1,315 @@
+"""Data iterators.
+
+Parity surface: ``python/mxnet/io/io.py`` (DataIter :178, NDArrayIter :489,
+MXDataIter :788, DataBatch, DataDesc, ResizeIter, PrefetchingIter). The C++
+iterator stack (src/io/) is replaced by: numpy-backed batching here, the C++
+RecordIO reader (mxnet_tpu/recordio.py + native parser), and a background
+prefetch thread that overlaps host batch prep with device steps — the role of
+the reference's PrefetcherIter (src/io/iter_prefetcher.h:47).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import queue as _queue
+
+import numpy as _np
+
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape", "dtype",
+                                                   "layout"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [d.shape for d in self.data] if self.data else []
+        return "DataBatch: data shapes: %s" % shapes
+
+
+class DataIter:
+    """Base iterator (reference DataIter :178)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = collections.OrderedDict([(default_name, data[0])])
+        else:
+            data = collections.OrderedDict(
+                [("_%d_%s" % (i, default_name), d) for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    return collections.OrderedDict(
+        (k, v if isinstance(v, _np.ndarray) else v.asnumpy())
+        for k, v in data.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference NDArrayIter :489)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = next(iter(self.data.values())).shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = _np.arange(self.num_data)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data.items()]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label.items()]
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        self.cursor = -1
+
+    def iter_next(self):
+        self.cursor += 1
+        return self.cursor < self.num_batches
+
+    def _take(self, arrays):
+        start = self.cursor * self.batch_size
+        end = min(start + self.batch_size, self.num_data)
+        sel = self.idx[start:end]
+        pad = self.batch_size - len(sel)
+        if pad > 0 and self.last_batch_handle != "discard":
+            sel = _np.concatenate([sel, self.idx[:pad]])
+        return [_nd.array(v[sel], dtype=v.dtype) for v in arrays.values()]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        start = self.cursor * self.batch_size
+        end = start + self.batch_size
+        if end > self.num_data and self.last_batch_handle == "pad":
+            return end - self.num_data
+        return 0
+
+    def getindex(self):
+        start = self.cursor * self.batch_size
+        end = min(start + self.batch_size, self.num_data)
+        return self.idx[start:end]
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (reference ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference PrefetchingIter; C++ analog
+    src/io/iter_prefetcher.h). Overlaps host batch prep with device compute —
+    on TPU this hides the numpy->device transfer behind the previous step."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._depth = prefetch_depth
+        self._queue = None
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop = False
+
+        def worker():
+            while not self._stop:
+                try:
+                    batches = [i.next() for i in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                except Exception as e:  # propagate async errors to consumer
+                    self._queue.put(e)
+                    return
+                self._queue.put(batches)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     if isinstance(r, dict) else d
+                     for d in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     if isinstance(r, dict) else d
+                     for d in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        self._stop = True
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for i in self.iters:
+            i.reset()
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        batches = item
+        if len(batches) == 1:
+            return batches[0]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([(b.label or []) for b in batches], []),
+            pad=max(b.pad or 0 for b in batches))
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False

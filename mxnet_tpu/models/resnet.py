@@ -1,0 +1,134 @@
+"""Symbolic ResNet builder (v1/v2), written TPU-first.
+
+Role parity: the reference's example/image-classification/symbols/resnet.py
+(training symbol used by train_imagenet.py and the perf tables in
+docs/faq/perf.md). Fresh implementation: standard He/identity-mapping
+residual topology expressed over our op registry; XLA fuses BN+ReLU into the
+convs, so no manual fusion tricks are needed.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+__all__ = ["get_symbol", "resnet"]
+
+
+def _conv(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    return sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
+                           stride=stride, pad=pad, no_bias=True, name=name)
+
+
+def _bn(data, name, fix_gamma=False):
+    return sym.BatchNorm(data=data, fix_gamma=fix_gamma, eps=2e-5,
+                         momentum=0.9, name=name)
+
+
+def residual_unit_v1(data, num_filter, stride, dim_match, name, bottle_neck):
+    if bottle_neck:
+        conv1 = _conv(data, num_filter // 4, (1, 1), stride, (0, 0), name + "_conv1")
+        bn1 = _bn(conv1, name + "_bn1")
+        act1 = sym.Activation(bn1, act_type="relu")
+        conv2 = _conv(act1, num_filter // 4, (3, 3), (1, 1), (1, 1), name + "_conv2")
+        bn2 = _bn(conv2, name + "_bn2")
+        act2 = sym.Activation(bn2, act_type="relu")
+        conv3 = _conv(act2, num_filter, (1, 1), (1, 1), (0, 0), name + "_conv3")
+        bn3 = _bn(conv3, name + "_bn3")
+        body = bn3
+    else:
+        conv1 = _conv(data, num_filter, (3, 3), stride, (1, 1), name + "_conv1")
+        bn1 = _bn(conv1, name + "_bn1")
+        act1 = sym.Activation(bn1, act_type="relu")
+        conv2 = _conv(act1, num_filter, (3, 3), (1, 1), (1, 1), name + "_conv2")
+        bn2 = _bn(conv2, name + "_bn2")
+        body = bn2
+    if dim_match:
+        shortcut = data
+    else:
+        sc = _conv(data, num_filter, (1, 1), stride, (0, 0), name + "_sc_conv")
+        shortcut = _bn(sc, name + "_sc_bn")
+    return sym.Activation(body + shortcut, act_type="relu")
+
+
+def residual_unit_v2(data, num_filter, stride, dim_match, name, bottle_neck):
+    bn1 = _bn(data, name + "_bn1")
+    act1 = sym.Activation(bn1, act_type="relu")
+    if bottle_neck:
+        conv1 = _conv(act1, num_filter // 4, (1, 1), (1, 1), (0, 0), name + "_conv1")
+        bn2 = _bn(conv1, name + "_bn2")
+        act2 = sym.Activation(bn2, act_type="relu")
+        conv2 = _conv(act2, num_filter // 4, (3, 3), stride, (1, 1), name + "_conv2")
+        bn3 = _bn(conv2, name + "_bn3")
+        act3 = sym.Activation(bn3, act_type="relu")
+        body = _conv(act3, num_filter, (1, 1), (1, 1), (0, 0), name + "_conv3")
+    else:
+        conv1 = _conv(act1, num_filter, (3, 3), stride, (1, 1), name + "_conv1")
+        bn2 = _bn(conv1, name + "_bn2")
+        act2 = sym.Activation(bn2, act_type="relu")
+        body = _conv(act2, num_filter, (3, 3), (1, 1), (1, 1), name + "_conv2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv(act1, num_filter, (1, 1), stride, (0, 0), name + "_sc")
+    return body + shortcut
+
+
+_CONFIGS = {
+    18: ([2, 2, 2, 2], False),
+    34: ([3, 4, 6, 3], False),
+    50: ([3, 4, 6, 3], True),
+    101: ([3, 4, 23, 3], True),
+    152: ([3, 8, 36, 3], True),
+    20: ([3, 3, 3], False),       # CIFAR variants
+    56: ([9, 9, 9], False),
+    110: ([18, 18, 18], False),
+}
+
+
+def resnet(num_classes=1000, num_layers=50, version=1, image_shape=(3, 224, 224),
+           dtype="float32"):
+    units, bottle_neck = _CONFIGS[num_layers]
+    cifar = len(units) == 3
+    filter_list = ([16, 16, 32, 64] if cifar else
+                   ([64, 256, 512, 1024, 2048] if bottle_neck
+                    else [64, 64, 128, 256, 512]))
+    unit = residual_unit_v2 if version == 2 else residual_unit_v1
+
+    data = sym.Variable("data")
+    if dtype != "float32":
+        data = sym.Cast(data, dtype=dtype)
+    if cifar:
+        body = _conv(data, filter_list[0], (3, 3), (1, 1), (1, 1), "conv0")
+        body = _bn(body, "bn0")
+        body = sym.Activation(body, act_type="relu")
+    else:
+        body = _conv(data, filter_list[0], (7, 7), (2, 2), (3, 3), "conv0")
+        body = _bn(body, "bn0")
+        body = sym.Activation(body, act_type="relu")
+        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           pool_type="max")
+    for i, n_units in enumerate(units):
+        stride = (1, 1) if i == 0 else (2, 2)
+        body = unit(body, filter_list[i + 1], stride, False,
+                    "stage%d_unit1" % (i + 1), bottle_neck)
+        for j in range(n_units - 1):
+            body = unit(body, filter_list[i + 1], (1, 1), True,
+                        "stage%d_unit%d" % (i + 1, j + 2), bottle_neck)
+    if version == 2:
+        body = _bn(body, "bn_final")
+        body = sym.Activation(body, act_type="relu")
+    pool = sym.Pooling(body, global_pool=True, kernel=(7, 7), pool_type="avg")
+    flat = sym.Flatten(pool)
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    if dtype != "float32":
+        fc = sym.Cast(fc, dtype="float32")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
+               conv_workspace=256, dtype="float32", **kwargs):
+    """reference-style entry (example/image-classification symbols API)."""
+    if isinstance(image_shape, str):
+        image_shape = tuple(int(x) for x in image_shape.split(","))
+    version = kwargs.get("version", 1)
+    return resnet(num_classes=num_classes, num_layers=num_layers,
+                  version=version, image_shape=image_shape, dtype=dtype)

@@ -1,0 +1,4 @@
+"""Module package (parity: python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule

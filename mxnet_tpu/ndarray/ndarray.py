@@ -525,6 +525,28 @@ def invoke(op_name, inputs, params, out=None):
         ctx = inputs[0]._ctx
     else:
         ctx = current_context()
+
+    # commit hidden aux-update outputs in place (reference eager BatchNorm
+    # mutates moving_mean/moving_var aux inputs) and trim to visible outputs
+    if op.aux_outputs:
+        training = params.get("_training", True)
+        if training:
+            for in_slot, out_slot in zip(op.aux_inputs, op.aux_outputs):
+                if in_slot < len(inputs) and isinstance(inputs[in_slot], NDArray):
+                    inputs[in_slot]._rebind(outs_data[out_slot])
+        n_vis = op.resolve_num_visible_outputs(params)
+        if vjp_fn is not None and n_vis < len(outs_data):
+            # tape sees only visible outputs; pad hidden cotangents with zeros
+            hidden = [(o.shape, o.dtype) for o in outs_data[n_vis:]]
+            orig_vjp = vjp_fn
+
+            def vjp_fn(cot, _orig=orig_vjp, _hidden=hidden):
+                cots = cot if isinstance(cot, tuple) else (cot,)
+                padded = tuple(cots) + tuple(jnp.zeros(s, d) for s, d in _hidden)
+                return _orig(padded)
+        outs_data = outs_data[:n_vis]
+        single = n_vis == 1
+
     out_nds = [NDArray(d, ctx=ctx) for d in outs_data]
     _engine.sync_point([d for d in outs_data])
 

@@ -201,16 +201,18 @@ alias("Pooling", "pooling")
 # Normalization
 # ---------------------------------------------------------------------------
 
-@register("BatchNorm", num_outputs=3)
+@register("BatchNorm", num_outputs=5)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
                output_mean_var=False, axis=1, cudnn_off=False,
                _training=True):
-    """Returns (out, new_moving_mean, new_moving_var).
+    """Returns (out, batch_mean, batch_var, new_moving_mean, new_moving_var).
 
-    The reference mutates aux states in place (src/operator/nn/batch_norm.cc);
-    our pure-functional form returns updated stats and the layer/executor
-    commits them — same observable semantics, XLA-friendly.
+    Visible outputs follow the reference's FNumVisibleOutputs (3 when
+    output_mean_var else 1); the trailing two are the updated aux states —
+    the reference mutates moving stats in place (src/operator/nn/batch_norm.cc),
+    our pure-functional form returns them and the invoke layer/executor
+    commits them. Same observable semantics, XLA-friendly.
     """
     red_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
@@ -227,7 +229,8 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     inv = lax.rsqrt(var + eps)
     out = (data - jnp.reshape(mean, shape)) * jnp.reshape(inv * g, shape) \
         + jnp.reshape(beta, shape)
-    return out, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
+    return (out, lax.stop_gradient(mean), lax.stop_gradient(var),
+            lax.stop_gradient(new_mean), lax.stop_gradient(new_var))
 
 
 @register("LayerNorm")
@@ -672,3 +675,217 @@ def adaptive_avg_pool(data, *, output_size=1):
         x = data.reshape(n, c, oh, h // oh, ow, w // ow)
         return x.mean(axis=(3, 5))
     return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+# ---------------------------------------------------------------------------
+# Symbolic-layer metadata: parameter-shape inference hooks + aux slots.
+# Role parity: the backward direction of the reference's FInferShape
+# (e.g. src/operator/nn/fully_connected.cc FullyConnectedShape infers the
+# weight shape from data + num_hidden) and aux_states declaration
+# (batch_norm.cc moving_mean/moving_var).
+# ---------------------------------------------------------------------------
+from .registry import set_op_meta as _set_op_meta
+
+
+def _fc_shapes(ins, p):
+    data, weight, bias = (ins + [None] * 3)[:3]
+    nh = int(p.get("num_hidden", 0))
+    out = list(ins)
+    if data is not None:
+        in_units = 1
+        if p.get("flatten", True):
+            for d in data[1:]:
+                in_units *= d
+        else:
+            in_units = data[-1]
+        if len(ins) > 1 and ins[1] is None:
+            out[1] = (nh, in_units)
+    if len(ins) > 2 and ins[2] is None:
+        out[2] = (nh,)
+    return out
+
+
+def _conv_shapes(ins, p):
+    data, weight, bias = (ins + [None] * 3)[:3]
+    nf = int(p["num_filter"])
+    k = tuple(p["kernel"])
+    ng = int(p.get("num_group", 1))
+    out = list(ins)
+    if data is not None and len(ins) > 1 and ins[1] is None:
+        out[1] = (nf, data[1] // ng) + k
+    if len(ins) > 2 and ins[2] is None:
+        out[2] = (nf,)
+    return out
+
+
+def _deconv_shapes(ins, p):
+    data, weight, bias = (ins + [None] * 3)[:3]
+    nf = int(p["num_filter"])
+    k = tuple(p["kernel"])
+    ng = int(p.get("num_group", 1))
+    out = list(ins)
+    if data is not None and len(ins) > 1 and ins[1] is None:
+        out[1] = (data[1], nf // ng) + k
+    if len(ins) > 2 and ins[2] is None:
+        out[2] = (nf,)
+    return out
+
+
+def _bn_shapes(ins, p):
+    data = ins[0]
+    out = list(ins)
+    if data is not None:
+        ax = int(p.get("axis", 1)) % len(data)
+        c = (data[ax],)
+        for i in range(1, min(5, len(ins))):
+            if out[i] is None:
+                out[i] = c
+    return out
+
+
+def _ln_shapes(ins, p):
+    data = ins[0]
+    out = list(ins)
+    if data is not None:
+        ax = int(p.get("axis", -1)) % len(data)
+        c = (data[ax],)
+        for i in range(1, min(3, len(ins))):
+            if out[i] is None:
+                out[i] = c
+    return out
+
+
+def _in_shapes(ins, p):
+    data = ins[0]
+    out = list(ins)
+    if data is not None:
+        c = (data[1],)
+        for i in range(1, min(3, len(ins))):
+            if out[i] is None:
+                out[i] = c
+    return out
+
+
+def _embedding_shapes(ins, p):
+    out = list(ins)
+    if len(ins) > 1 and ins[1] is None:
+        out[1] = (int(p["input_dim"]), int(p["output_dim"]))
+    return out
+
+
+def _rnn_shapes(ins, p):
+    data, params_, state = (ins + [None] * 4)[:3]
+    out = list(ins)
+    if data is not None:
+        H = int(p["state_size"])
+        L = int(p["num_layers"])
+        dirs = 2 if p.get("bidirectional") else 1
+        I = data[2]
+        if len(ins) > 1 and out[1] is None:
+            out[1] = (rnn_param_size(p.get("mode", "lstm"), I, H, L,
+                                     bool(p.get("bidirectional", False))),)
+        if len(ins) > 2 and out[2] is None:
+            out[2] = (L * dirs, data[1], H)
+        if len(ins) > 3 and out[3] is None:
+            out[3] = (L * dirs, data[1], H)
+    return out
+
+
+def _prelu_shapes(ins, p):
+    out = list(ins)
+    if p.get("act_type") == "prelu" and len(ins) > 1 and ins[1] is None and ins[0] is not None:
+        out[1] = (ins[0][1] if len(ins[0]) > 1 else 1,)
+    return out
+
+
+_set_op_meta("FullyConnected", shape_hook=_fc_shapes)
+_set_op_meta("Convolution", shape_hook=_conv_shapes)
+_set_op_meta("Deconvolution", shape_hook=_deconv_shapes)
+_set_op_meta("BatchNorm", shape_hook=_bn_shapes,
+             aux_inputs=(3, 4), aux_outputs=(3, 4),
+             num_visible_outputs=lambda p: 3 if p.get("output_mean_var") else 1)
+_set_op_meta("LayerNorm", shape_hook=_ln_shapes)
+_set_op_meta("InstanceNorm", shape_hook=_in_shapes)
+_set_op_meta("Embedding", shape_hook=_embedding_shapes)
+_set_op_meta("RNN", shape_hook=_rnn_shapes)
+_set_op_meta("LeakyReLU", shape_hook=_prelu_shapes)
+
+
+# ---------------------------------------------------------------------------
+# Regression output heads (reference: src/operator/regression_output-inl.h)
+# Forward is identity/sigmoid; backward seeds (pred - label)/batch like the
+# reference, via custom_vjp (loss-head convention as SoftmaxOutput).
+# ---------------------------------------------------------------------------
+
+def _regression_core(transform, grad_fn):
+    @_partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return transform(data)
+
+    def fwd(data, label, grad_scale):
+        out = transform(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        # reference scales by per-sample output count (label.Size()/batch),
+        # NOT by batch size (src/operator/regression_output-inl.h backward)
+        num_output = max(label.size // label.shape[0], 1)
+        grad = grad_fn(out, label) * (grad_scale / num_output)
+        return (grad, jnp.zeros_like(label))
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_linreg_core = _regression_core(lambda x: x * 1.0, lambda o, l: o - l.reshape(o.shape))
+_maereg_core = _regression_core(lambda x: x * 1.0,
+                                lambda o, l: jnp.sign(o - l.reshape(o.shape)))
+_logreg_core = _regression_core(jax.nn.sigmoid,
+                                lambda o, l: o - l.reshape(o.shape))
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label=None, *, grad_scale=1.0):
+    if label is None:
+        return data * 1.0
+    return _linreg_core(data, label, grad_scale)
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label=None, *, grad_scale=1.0):
+    if label is None:
+        return data * 1.0
+    return _maereg_core(data, label, grad_scale)
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label=None, *, grad_scale=1.0):
+    if label is None:
+        return jax.nn.sigmoid(data)
+    return _logreg_core(data, label, grad_scale)
+
+
+def _softmax_out_shapes(ins, p):
+    out = list(ins)
+    data = ins[0]
+    if data is not None and len(ins) > 1 and ins[1] is None:
+        if p.get("multi_output"):
+            out[1] = (data[0],) + tuple(data[2:])
+        else:
+            out[1] = tuple(data[:-1])
+    return out
+
+
+def _reg_out_shapes(ins, p):
+    out = list(ins)
+    if ins[0] is not None and len(ins) > 1 and ins[1] is None:
+        out[1] = tuple(ins[0])
+    return out
+
+
+_set_op_meta("SoftmaxOutput", shape_hook=_softmax_out_shapes)
+_set_op_meta("softmax_cross_entropy", shape_hook=_softmax_out_shapes)
+_set_op_meta("LinearRegressionOutput", shape_hook=_reg_out_shapes)
+_set_op_meta("MAERegressionOutput", shape_hook=_reg_out_shapes)
+_set_op_meta("LogisticRegressionOutput", shape_hook=_reg_out_shapes)

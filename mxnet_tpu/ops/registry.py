@@ -30,7 +30,8 @@ class Operator:
     """A registered op: a pure jax fn + metadata for the two front-ends."""
 
     __slots__ = ("name", "fn", "num_outputs", "param_names", "is_random",
-                 "doc", "generic_out")
+                 "doc", "shape_hook", "aux_inputs", "aux_outputs",
+                 "num_visible_outputs", "input_names", "input_optional")
 
     def __init__(self, name, fn, num_outputs=1, is_random=False):
         self.name = name
@@ -38,16 +39,38 @@ class Operator:
         self.num_outputs = num_outputs  # int, or callable(params)->int
         self.is_random = is_random
         self.doc = fn.__doc__ or ""
+        # symbolic-layer metadata (set via set_op_meta):
+        self.shape_hook = None        # fn(in_shapes, params) -> completed in_shapes
+        self.aux_inputs = ()          # input slots that are auxiliary states
+        self.aux_outputs = ()         # output slots holding updated aux values
+        self.num_visible_outputs = None  # outputs exposed to the graph (prefix)
         sig = inspect.signature(fn)
         self.param_names = [
             p.name for p in sig.parameters.values()
             if p.kind == inspect.Parameter.KEYWORD_ONLY
         ]
+        # positional (array) inputs: name -> has_default
+        self.input_names = []
+        self.input_optional = []
+        for p in sig.parameters.values():
+            if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                          inspect.Parameter.POSITIONAL_ONLY):
+                self.input_names.append(p.name)
+                self.input_optional.append(p.default is not inspect.Parameter.empty)
 
     def resolve_num_outputs(self, params):
         if callable(self.num_outputs):
             return self.num_outputs(params)
         return self.num_outputs
+
+    def resolve_num_visible_outputs(self, params):
+        """Outputs exposed to the graph (reference FNumVisibleOutputs);
+        the hidden suffix carries updated aux state."""
+        if self.num_visible_outputs is None:
+            return self.resolve_num_outputs(params)
+        if callable(self.num_visible_outputs):
+            return self.num_visible_outputs(params)
+        return self.num_visible_outputs
 
     def __call__(self, *args, **kwargs):
         return self.fn(*args, **kwargs)
@@ -66,6 +89,22 @@ def register(name=None, num_outputs=1, is_random=False):
         _REGISTRY[opname] = op
         return fn
     return deco
+
+
+def set_op_meta(name, shape_hook=None, aux_inputs=None, aux_outputs=None,
+                num_visible_outputs=None):
+    """Attach symbolic-layer metadata (parameter-shape inference hook and
+    auxiliary-state slots — the reference's FInferShape / aux_states)."""
+    op = _REGISTRY[name]
+    if shape_hook is not None:
+        op.shape_hook = shape_hook
+    if aux_inputs is not None:
+        op.aux_inputs = tuple(aux_inputs)
+    if aux_outputs is not None:
+        op.aux_outputs = tuple(aux_outputs)
+    if num_visible_outputs is not None:
+        op.num_visible_outputs = num_visible_outputs
+    return op
 
 
 def alias(existing, *names):

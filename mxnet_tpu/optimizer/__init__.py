@@ -1,0 +1,5 @@
+"""Optimizer package (parity: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdaGrad, AdaDelta,
+                        RMSProp, Ftrl, Signum, SignSGD, Nadam, FTML,
+                        DCASGD, LBSGD, Test, create, register, Updater,
+                        get_updater)

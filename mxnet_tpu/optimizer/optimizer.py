@@ -1,0 +1,502 @@
+"""Optimizers.
+
+Parity surface: ``python/mxnet/optimizer/optimizer.py`` (reference, 1,578 LoC
+— registry :41-128, SGD :452 with fp16 multi-precision, Adam, etc.). The
+update math lives in :mod:`mxnet_tpu.ops.optimizer_ops` as registered ops
+(the reference's "updates are ops" design, src/operator/optimizer_op.cc),
+dispatched through the same eager invoke path so XLA jits/fuses them; the
+Trainer/Module fused train-step path calls the same op functions inside one
+compiled program.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "Signum", "SignSGD", "Nadam", "FTML",
+           "DCASGD", "LBSGD", "Test", "create", "register", "Updater",
+           "get_updater"]
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an optimizer class under its lowercase name
+    (reference Optimizer.register :41)."""
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    try:
+        return _OPT_REGISTRY[name.lower()](**kwargs)
+    except KeyError:
+        raise ValueError("Cannot find optimizer %s; candidates: %s"
+                         % (name, sorted(_OPT_REGISTRY)))
+
+
+class Optimizer:
+    """Base optimizer (reference Optimizer :128-450)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_info = None
+        self.param_dict = param_dict or {}
+        self.multi_precision = multi_precision
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- state ---------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    # -- schedule ------------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler overwrites learning rate")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].get("lr_mult", 1.0) \
+                if isinstance(self.param_dict[name], dict) else 1.0
+        if name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    # -- update --------------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            inner, w32 = state
+            g32 = grad.astype("float32")
+            self.update(index, w32, g32, inner)
+            weight._rebind(w32._data.astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    def _clip_kw(self):
+        return {"rescale_grad": self.rescale_grad,
+                "clip_gradient": (self.clip_gradient
+                                  if self.clip_gradient is not None else -1.0)}
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision (reference SGD :452)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _nd.invoke("sgd_update", [weight, grad],
+                       {"lr": lr, "wd": wd, **self._clip_kw()}, out=weight)
+        else:
+            _nd.invoke("sgd_mom_update", [weight, grad, state],
+                       {"lr": lr, "wd": wd, "momentum": self.momentum,
+                        **self._clip_kw()}, out=[weight, state])
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _nd.invoke("sgd_update", [weight, grad],
+                       {"lr": lr, "wd": wd, **self._clip_kw()}, out=weight)
+        else:
+            _nd.invoke("nag_mom_update", [weight, grad, state],
+                       {"lr": lr, "wd": wd, "momentum": self.momentum,
+                        **self._clip_kw()}, out=[weight, state])
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        _nd.invoke("adam_update", [weight, grad, mean, var],
+                   {"lr": lr_t, "beta1": self.beta1, "beta2": self.beta2,
+                    "epsilon": self.epsilon, "wd": wd, **self._clip_kw()},
+                   out=[weight, mean, var])
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        _nd.invoke("adagrad_update", [weight, grad, state],
+                   {"lr": lr, "wd": wd, "epsilon": self.float_stable_eps,
+                    **self._clip_kw()}, out=[weight, state])
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_d = state
+        _nd.invoke("adadelta_update", [weight, grad, acc_g, acc_d],
+                   {"rho": self.rho, "epsilon": self.epsilon, "wd": wd,
+                    **self._clip_kw()}, out=[weight, acc_g, acc_d])
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        if self.centered:
+            return (z(), z(), z())
+        return z()
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        cw = self.clip_weights if self.clip_weights else -1.0
+        if self.centered:
+            n, g, delta = state
+            _nd.invoke("rmspropalex_update", [weight, grad, n, g, delta],
+                       {"lr": lr, "wd": wd, "gamma1": self.gamma1,
+                        "gamma2": self.gamma2, "epsilon": self.epsilon,
+                        "clip_weights": cw, **self._clip_kw()},
+                       out=[weight, n, g, delta])
+        else:
+            _nd.invoke("rmsprop_update", [weight, grad, state],
+                       {"lr": lr, "wd": wd, "gamma1": self.gamma1,
+                        "epsilon": self.epsilon, "clip_weights": cw,
+                        **self._clip_kw()}, out=[weight, state])
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        _nd.invoke("ftrl_update", [weight, grad, z, n],
+                   {"lr": lr, "wd": wd, "lamda1": self.lamda1,
+                    "beta": self.beta, **self._clip_kw()},
+                   out=[weight, z, n])
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _nd.invoke("signsgd_update", [weight, grad],
+                       {"lr": lr, "wd": wd, **self._clip_kw()}, out=weight)
+        else:
+            _nd.invoke("signum_update", [weight, grad, state],
+                       {"lr": lr, "wd": wd, "momentum": self.momentum,
+                        "wd_lh": self.wd_lh, **self._clip_kw()},
+                       out=[weight, state])
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (z(), z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        _nd.invoke("ftml_update", [weight, grad, d, v, z],
+                   {"lr": lr, "wd": wd, "beta1": self.beta1,
+                    "beta2": self.beta2, "epsilon": self.epsilon, "t": t,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_grad": (self.clip_gradient
+                                  if self.clip_gradient is not None else -1.0)},
+                   out=[weight, d, v, z])
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        m_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        m_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * m_t
+        m_schedule_next = self.m_schedule * m_t_1
+        mean, var = state
+        mean *= self.beta1
+        mean += (1.0 - self.beta1) * grad
+        var *= self.beta2
+        var += (1.0 - self.beta2) * grad * grad
+        g_prime = grad / (1.0 - self.m_schedule)
+        m_prime = mean / (1.0 - m_schedule_next)
+        v_prime = var / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - m_t) * g_prime + m_t_1 * m_prime
+        weight -= lr * m_bar / (v_prime.sqrt() + self.epsilon)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        z = lambda: _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (z() if self.momentum != 0.0 else None, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev_w = state
+        comp = grad + wd * weight + self.lamda * grad * grad * (weight - prev_w)
+        if mom is None:
+            delta = -lr * comp
+        else:
+            mom *= self.momentum
+            mom -= lr * comp
+            delta = mom
+        prev_w._rebind(weight._data)
+        weight += delta
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling (reference LBSGD;
+    simplified: warmup handled by lr_scheduler)."""
+
+    def __init__(self, eta=0.001, **kwargs):
+        super().__init__(**kwargs)
+        self.eta = eta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        wn = float(weight.norm().asscalar())
+        gn = float(grad.norm().asscalar()) * self.rescale_grad
+        if wn > 0 and gn > 0:
+            lr = lr * self.eta * wn / (gn + wd * wn + 1e-9)
+        if state is None:
+            _nd.invoke("sgd_update", [weight, grad],
+                       {"lr": lr, "wd": wd, **self._clip_kw()}, out=weight)
+        else:
+            _nd.invoke("sgd_mom_update", [weight, grad, state],
+                       {"lr": lr, "wd": wd, "momentum": self.momentum,
+                        **self._clip_kw()}, out=[weight, state])
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._rebind(weight._data)
+
+
+class Updater:
+    """Dispatches (index, grad, weight) to the optimizer, creating state
+    lazily per index (reference Updater, optimizer.py:1500+). This is what a
+    kvstore applies on 'server' side."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        st = {k: (v.asnumpy() if isinstance(v, NDArray) else
+                  tuple(x.asnumpy() if isinstance(x, NDArray) else x for x in v)
+                  if isinstance(v, tuple) else v)
+              for k, v in self.states.items()}
+        return pickle.dumps((st, self.optimizer) if dump_optimizer else st)
+
+    def set_states(self, states):
+        import pickle
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple) and len(obj) == 2 and isinstance(obj[1], Optimizer):
+            st, self.optimizer = obj
+        else:
+            st = obj
+        out = {}
+        for k, v in st.items():
+            if isinstance(v, tuple):
+                out[k] = tuple(_nd.array(x) if isinstance(x, _np.ndarray) else x
+                               for x in v)
+            elif isinstance(v, _np.ndarray):
+                out[k] = _nd.array(v)
+            else:
+                out[k] = v
+        self.states = out
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

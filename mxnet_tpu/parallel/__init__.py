@@ -1,0 +1,10 @@
+"""Parallelism over device meshes.
+
+This layer replaces the reference's entire distribution stack
+(src/kvstore/comm.h device reduce, comm_tree.h topology trees,
+kvstore_nccl.h RCCL, kvstore_dist.h ps-lite — SURVEY.md §2.3) with
+XLA-native SPMD: pick a `jax.sharding.Mesh`, annotate shardings, let GSPMD
+insert collectives over ICI/DCN.
+"""
+from .mesh import make_mesh, data_parallel_sharding, replicated
+from .spmd import SPMDTrainStep

@@ -1,0 +1,14 @@
+"""Symbolic API package (parity: python/mxnet/symbol/)."""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     invoke_sym)
+from . import register as _register
+
+_register.populate(__name__)
+
+# zeros/ones for symbol graphs
+def zeros(shape, dtype="float32", **kw):
+    return invoke_sym("_zeros", [], {"shape": tuple(shape), "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kw):
+    return invoke_sym("_ones", [], {"shape": tuple(shape), "dtype": dtype})

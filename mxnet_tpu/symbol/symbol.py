@@ -1,0 +1,466 @@
+"""Symbolic graph construction.
+
+Parity surface: ``python/mxnet/symbol/symbol.py`` (reference, 2,970 LoC) whose
+C++ core is nnvm Symbol/Graph. TPU-native design: Symbol is a lightweight
+Python DAG over the same op registry the eager path uses; *all* graph
+optimization (memory planning, fusion, inplace, bulking — the reference's
+src/executor/ passes) is delegated to XLA when the graph is bound
+(executor.py traces the DAG into one jitted function). Shape/type inference
+runs ``jax.eval_shape`` over the traced graph, with per-op parameter-shape
+hooks to fill in unknown parameter shapes from data shapes (the reference's
+FInferShape backward-inference, e.g. fully_connected.cc weight shape).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+_name_counter = threading.local()
+
+
+def _auto_name(prefix):
+    if not hasattr(_name_counter, "counts"):
+        _name_counter.counts = {}
+    c = _name_counter.counts.get(prefix, 0)
+    _name_counter.counts[prefix] = c + 1
+    return "%s%d" % (prefix, c)
+
+
+class Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "inputs", "params", "attrs")
+
+    def __init__(self, op, name, inputs, params, attrs=None):
+        self.op = op                # Operator or None (variable)
+        self.name = name
+        self.inputs = inputs        # list[(Node, int)]
+        self.params = params or {}  # op hyper-parameters
+        self.attrs = attrs or {}    # user attrs (__ctx_group__, lr_mult, ...)
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_outputs(self):
+        """Graph-visible output count (hidden aux-update outputs excluded)."""
+        if self.op is None:
+            return 1
+        return self.op.resolve_num_visible_outputs(self.params)
+
+
+class Symbol:
+    """An output list over a DAG of Nodes."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self, entries):
+        self._entries = list(entries)  # list[(Node, int)]
+
+    # ------------------------------------------------------------- topology
+    def _topo(self):
+        """All nodes in topological order (inputs before consumers)."""
+        seen = set()
+        order = []
+        stack = [(n, False) for n, _ in reversed(self._entries)]
+        while stack:
+            node, expanded = stack.pop()
+            if expanded:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for (inp, _) in reversed(node.inputs):
+                if id(inp) not in seen:
+                    stack.append((inp, False))
+        return order
+
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return "group"
+
+    def list_arguments(self):
+        """Variable names in topo order, excluding auxiliary states."""
+        aux = set(self.list_auxiliary_states())
+        return [n.name for n in self._topo()
+                if n.is_variable and n.name not in aux]
+
+    def list_auxiliary_states(self):
+        """Variables wired into ops' aux input slots (e.g. BatchNorm moving
+        stats; reference aux_states concept)."""
+        aux = []
+        seen = set()
+        for n in self._topo():
+            if n.is_variable:
+                continue
+            aux_in = getattr(n.op, "aux_inputs", ()) or ()
+            for i in aux_in:
+                if i < len(n.inputs):
+                    v = n.inputs[i][0]
+                    if v.is_variable and v.name not in seen:
+                        seen.add(v.name)
+                        aux.append(v.name)
+        return aux
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._entries:
+            if node.num_outputs() > 1:
+                out.append("%s_output%d" % (node.name, idx))
+            else:
+                out.append("%s_output" % node.name)
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_variable]
+
+    @property
+    def num_outputs(self):
+        return len(self._entries)
+
+    # ------------------------------------------------------------ selection
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._entries[index])
+        return Symbol([self._entries[index]])
+
+    def __iter__(self):
+        for i in range(len(self._entries)):
+            yield self[i]
+
+    def __len__(self):
+        return len(self._entries)
+
+    def get_internals(self):
+        """Symbol exposing every node's outputs (reference get_internals)."""
+        entries = []
+        for n in self._topo():
+            for i in range(n.num_outputs()):
+                entries.append((n, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        node = self._entries[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # ----------------------------------------------------------- attributes
+    def attr(self, key):
+        return self._entries[0][0].attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._entries[0][0].attrs.update(kwargs)
+
+    def attr_dict(self):
+        out = {}
+        for n in self._topo():
+            if n.attrs:
+                out[n.name] = dict(n.attrs)
+        return out
+
+    # ------------------------------------------------------------ operators
+    def __add__(self, other):
+        return _sym_binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _sym_binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _sym_binary_r("broadcast_sub", "_rminus_scalar", self, other)
+
+    def __mul__(self, other):
+        return _sym_binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _sym_binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _sym_binary_r("broadcast_div", "_rdiv_scalar", self, other)
+
+    def __pow__(self, other):
+        return _sym_binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return invoke_sym("negative", [self], {})
+
+    def __copy__(self):
+        return Symbol(self._entries)
+
+    def __eq__(self, other):
+        if isinstance(other, Symbol):
+            return _sym_binary("broadcast_equal", "_equal_scalar", self, other)
+        if other is None:
+            return False
+        return _sym_binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        return "<Symbol %s>" % self.name
+
+    # --------------------------------------------------------------- infer
+    def infer_shape(self, *args, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes); None entries where
+        inference failed (reference symbol.py infer_shape)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        known = dict(kwargs)
+        if args:
+            for name, shp in zip(self.list_arguments(), args):
+                if shp is not None:
+                    known[name] = shp
+        shapes = _infer_shapes(self, known)
+        args_order = self.list_arguments()
+        aux_order = self.list_auxiliary_states()
+        arg_shapes = [shapes.get(("var", nm)) for nm in args_order]
+        aux_shapes = [shapes.get(("var", nm)) for nm in aux_order]
+        out_shapes = []
+        for node, idx in self._entries:
+            s = shapes.get((id(node), idx))
+            out_shapes.append(s)
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            missing = [nm for nm, s in zip(args_order, arg_shapes) if s is None]
+            raise MXNetError("infer_shape incomplete; unknown for args %s"
+                             % missing)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        # all-float32 default (full dtype propagation happens at bind time)
+        n_args = len(self.list_arguments())
+        dt = _np.float32
+        return ([dt] * n_args,
+                [dt] * len(self._entries),
+                [dt] * len(self.list_auxiliary_states()))
+
+    # ----------------------------------------------------------------- eval
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        from ..executor import simple_bind
+        return simple_bind(self, ctx, grad_req=grad_req, type_dict=type_dict,
+                           group2ctx=group2ctx, **kwargs)
+
+    # ---------------------------------------------------------------- serde
+    def tojson(self):
+        nodes = self._topo()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "attrs": ({k: json.dumps(_jsonable(v)) for k, v in n.params.items()}
+                          if n.params else {}),
+                "user_attrs": dict(n.attrs),
+                "inputs": [[nid[id(src)], oi, 0] for (src, oi) in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
+        heads = [[nid[id(n)], oi, 0] for (n, oi) in self._entries]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxnet_tpu_version": "0.1.0"}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------- gradient
+    def gradient(self, wrt):  # kept for parity; bind-time autodiff is primary
+        raise NotImplementedError("use executor.backward (jax.vjp at bind)")
+
+
+def _jsonable(v):
+    if isinstance(v, tuple):
+        return list(v)
+    if isinstance(v, _np.dtype):
+        return str(v)
+    return v
+
+
+def _sym_binary(op_name, scalar_op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return invoke_sym(op_name, [lhs, rhs], {})
+    return invoke_sym(scalar_op, [lhs], {"scalar": float(rhs)})
+
+
+def _sym_binary_r(op_name, rscalar_op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return invoke_sym(op_name, [rhs, lhs], {})
+    return invoke_sym(rscalar_op, [lhs], {"scalar": float(rhs)})
+
+
+def invoke_sym(op_name, inputs, params, name=None):
+    """Create a graph node applying op to input symbols."""
+    op = _registry.get(op_name)
+    params = {k: v for k, v in params.items() if v is not None}
+    entries = []
+    for s in inputs:
+        if isinstance(s, Symbol):
+            if len(s._entries) == 1:
+                entries.append(s._entries[0])
+            else:
+                entries.extend(s._entries)
+        else:
+            raise TypeError("symbol op %s expects Symbol inputs, got %r"
+                            % (op_name, type(s)))
+    name = name or _auto_name(op_name.lower().lstrip("_") + "_")
+    node = Node(op, name, entries, params)
+    # ops with aux outputs expose only the visible prefix to the graph
+    # (BatchNorm: out [+ mean/var if output_mean_var] visible; updated moving
+    # stats routed to aux storage) — reference FNumVisibleOutputs
+    n_out = op.resolve_num_visible_outputs(params)
+    return Symbol([(node, i) for i in range(n_out)]) if n_out > 1 \
+        else Symbol([(node, 0)])
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        attrs["__init__"] = str(init)
+    if stype is not None:
+        attrs["__storage_type__"] = stype
+    attrs.update(kwargs)
+    node = Node(None, name, [], {}, attrs)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+# ---------------------------------------------------------------------------
+# shape inference over the DAG
+# ---------------------------------------------------------------------------
+
+def _infer_shapes(sym, known_var_shapes):
+    """Forward shape propagation with parameter-shape hooks.
+
+    Returns dict: ("var", name) -> shape for variables,
+    (id(node), out_idx) -> shape for op outputs.
+    """
+    import jax
+
+    shapes = {}
+    for name, s in known_var_shapes.items():
+        shapes[("var", name)] = tuple(s)
+    nodes = sym._topo()
+    for n in nodes:
+        if n.is_variable:
+            if ("var", n.name) not in shapes and "__shape__" in n.attrs:
+                shapes[("var", n.name)] = tuple(n.attrs["__shape__"])
+            continue
+        in_shapes = []
+        for (src, oi) in n.inputs:
+            key = ("var", src.name) if src.is_variable else (id(src), oi)
+            in_shapes.append(shapes.get(key))
+        hook = getattr(n.op, "shape_hook", None)
+        if hook is not None and any(s is None for s in in_shapes):
+            try:
+                completed = hook(in_shapes, n.params)
+            except Exception as e:
+                # surface hook bugs instead of silently degrading to
+                # "infer_shape incomplete" (reference names the failing op)
+                import warnings
+                warnings.warn("shape hook for op %r (node %r) failed: %s: %s"
+                              % (n.op.name, n.name, type(e).__name__, e))
+                completed = in_shapes
+            if completed:
+                for (src, oi), s in zip(n.inputs, completed):
+                    if s is None:
+                        continue
+                    key = ("var", src.name) if src.is_variable else (id(src), oi)
+                    if shapes.get(key) is None:
+                        shapes[key] = tuple(s)
+                in_shapes = [tuple(s) if s is not None else None for s in completed]
+        if any(s is None for s in in_shapes):
+            continue
+        try:
+            structs = [jax.ShapeDtypeStruct(s, _np.float32) for s in in_shapes]
+            out = jax.eval_shape(lambda *xs: n.op.fn(*xs, **n.params), *structs)
+        except Exception:
+            continue
+        outs = out if isinstance(out, tuple) else (out,)
+        for i, o in enumerate(outs):
+            shapes[(id(n), i)] = tuple(o.shape)
+    return shapes
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data["nodes"]:
+        if jn["op"] == "null":
+            node = Node(None, jn["name"], [], {}, jn.get("user_attrs", {}))
+        else:
+            params = {k: _untuple(json.loads(v)) for k, v in jn.get("attrs", {}).items()}
+            inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
+            node = Node(_registry.get(jn["op"]), jn["name"], inputs, params,
+                        jn.get("user_attrs", {}))
+        nodes.append(node)
+    entries = [(nodes[i], oi) for i, oi, _ in data["heads"]]
+    return Symbol(entries)
+
+
+def _untuple(v):
+    if isinstance(v, list):
+        return tuple(v)
+    return v

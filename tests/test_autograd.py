@@ -119,11 +119,13 @@ def test_is_recording_training():
 
 
 def test_softmax_grad():
+    np.random.seed(7)
     check_numeric_gradient(lambda x: mx.nd.softmax(x, axis=-1).square().sum(),
                            [np.random.uniform(-1, 1, (3, 4)).astype(np.float32)])
 
 
 def test_fc_grad():
+    np.random.seed(11)
     x = np.random.uniform(-1, 1, (2, 3)).astype(np.float32)
     w = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
     b = np.zeros(4, np.float32)
@@ -133,6 +135,7 @@ def test_fc_grad():
 
 
 def test_conv_grad():
+    np.random.seed(13)
     x = np.random.uniform(-1, 1, (1, 2, 5, 5)).astype(np.float32)
     w = np.random.uniform(-1, 1, (3, 2, 3, 3)).astype(np.float32)
     check_numeric_gradient(

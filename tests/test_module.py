@@ -1,0 +1,136 @@
+"""Module API tests (parity model: tests/python/unittest/test_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _toy_data(n=256, d=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, classes).astype(np.float32)
+    y = (X @ W).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def _mlp(classes=4):
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_and_score():
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    train.reset()
+    score = dict(mod.score(train, "acc"))
+    assert score["accuracy"] > 0.9, score
+
+
+def test_module_predict():
+    X, y = _toy_data(64)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label, for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    out = mod.predict(train)
+    assert out.shape == (64, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(64),
+                               rtol=1e-5)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _toy_data(64)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(train.provide_data, train.provide_label, for_training=False)
+    mod2.init_params(arg_params=mod2._arg_params, aux_params=mod2._aux_params)
+    p1 = mod.predict(train).asnumpy()
+    train.reset()
+    p2 = mod2.predict(train).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_module_get_set_params():
+    X, y = _toy_data(64)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    args, auxs = mod.get_params()
+    assert "fc1_weight" in args
+    args2 = {k: v * 0 for k, v in args.items()}
+    mod.set_params(args2, auxs)
+    new_args, _ = mod.get_params()
+    assert new_args["fc1_weight"].asnumpy().sum() == 0
+
+
+def test_module_input_grads():
+    X, y = _toy_data(32)
+    train = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label, for_training=True,
+             inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier())
+    batch = next(iter(train))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_module_optimizer_states_roundtrip(tmp_path):
+    X, y = _toy_data(64)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    mod.load_optimizer_states(fname)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        # weights must be bucket-invariant (RNN-unroll pattern): reduce the
+        # variable-length axis before the shared FC layers
+        data = sym.Variable("data")
+        pooled = sym.mean(data, axis=1, keepdims=True)
+        net = sym.FullyConnected(pooled, num_hidden=8, name="fc1")
+        net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataDesc, DataBatch
+    mod.bind([DataDesc("data", (4, 10))], [DataDesc("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    for key in (10, 5, 10):
+        batch = DataBatch(
+            data=[mx.nd.ones((4, key))],
+            label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[DataDesc("data", (4, key))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert len(mod._buckets) == 2

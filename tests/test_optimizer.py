@@ -1,0 +1,131 @@
+"""Optimizer tests (parity model: tests/python/unittest/test_optimizer.py —
+compare update ops against numpy reference math)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _setup(seed=0, shape=(4, 3)):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(*shape).astype(np.float32)
+    g = rng.randn(*shape).astype(np.float32)
+    return mx.nd.array(w), mx.nd.array(g), w, g
+
+
+def test_sgd_matches_numpy():
+    weight, grad, w, g = _setup()
+    o = opt.SGD(learning_rate=0.1, wd=0.01, momentum=0.9)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    mom = -0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(weight.asnumpy(), w + mom, rtol=1e-5)
+    w2 = w + mom
+    o.update(0, weight, grad, state)
+    mom2 = 0.9 * mom - 0.1 * (g + 0.01 * w2)
+    np.testing.assert_allclose(weight.asnumpy(), w2 + mom2, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    weight, grad, w, g = _setup()
+    o = opt.Adam(learning_rate=0.01, wd=0.0)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    m = 0.1 * g
+    v = 0.001 * g * g
+    lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expect = w - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(weight.asnumpy(), expect, rtol=1e-4)
+
+
+def test_adagrad():
+    weight, grad, w, g = _setup()
+    o = opt.AdaGrad(learning_rate=0.1)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    h = g * g
+    np.testing.assert_allclose(weight.asnumpy(),
+                               w - 0.1 * g / np.sqrt(h + 1e-7), rtol=1e-5)
+
+
+def test_rmsprop():
+    weight, grad, w, g = _setup()
+    o = opt.RMSProp(learning_rate=0.1, gamma1=0.9)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    n = 0.1 * g * g
+    np.testing.assert_allclose(weight.asnumpy(),
+                               w - 0.1 * g / np.sqrt(n + 1e-8), rtol=1e-5)
+
+
+def test_clip_and_rescale():
+    weight, grad, w, g = _setup()
+    o = opt.SGD(learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.1)
+    o.update(0, weight, grad, None)
+    eff = np.clip(g * 0.5, -0.1, 0.1)
+    np.testing.assert_allclose(weight.asnumpy(), w - eff, rtol=1e-5)
+
+
+def test_lr_scheduler_integration():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    sched = FactorScheduler(step=2, factor=0.5)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    weight, grad, w, g = _setup()
+    for _ in range(5):
+        o.update(0, weight, grad, None)
+    assert sched.base_lr < 1.0
+
+
+def test_create_registry():
+    assert isinstance(opt.create("sgd"), opt.SGD)
+    assert isinstance(opt.create("adam", learning_rate=0.1), opt.Adam)
+    with pytest.raises(ValueError):
+        opt.create("nosuchopt")
+
+
+def test_updater_state_dict():
+    weight, grad, w, g = _setup()
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    upd(0, grad, weight)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+
+
+def test_lr_wd_mult():
+    weight, grad, w, g = _setup()
+    o = opt.SGD(learning_rate=0.1, param_idx2name={0: "w"})
+    o.set_lr_mult({"w": 0.0})
+    o.update(0, weight, grad, None)
+    np.testing.assert_allclose(weight.asnumpy(), w)
+
+
+def test_multi_precision():
+    rng = np.random.RandomState(0)
+    w16 = rng.randn(4).astype(np.float16)
+    weight = mx.nd.array(w16, dtype="float16")
+    grad = mx.nd.array(rng.randn(4).astype(np.float16), dtype="float16")
+    o = opt.SGD(learning_rate=0.1, multi_precision=True)
+    state = o.create_state_multi_precision(0, weight)
+    o.update_multi_precision(0, weight, grad, state)
+    assert weight.dtype == np.float16
+
+
+def test_schedulers():
+    from mxnet_tpu import lr_scheduler as lrs
+    s = lrs.MultiFactorScheduler([3, 6], factor=0.1, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(4) == pytest.approx(0.1)
+    assert s(7) == pytest.approx(0.01)
+    p = lrs.PolyScheduler(max_update=10, base_lr=1.0, pwr=1)
+    assert p(0) == pytest.approx(1.0)
+    assert p(10) == pytest.approx(0.0, abs=1e-6)
+    c = lrs.CosineScheduler(max_update=10, base_lr=1.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(10) == pytest.approx(0.0, abs=1e-6)
+    w = lrs.FactorScheduler(step=100, base_lr=1.0, warmup_steps=5,
+                            warmup_begin_lr=0.1)
+    assert w(1) < 1.0

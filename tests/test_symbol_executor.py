@@ -1,0 +1,172 @@
+"""Symbol + Executor tests (parity model: tests/python/unittest/
+test_symbol.py + test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def test_compose_and_arguments():
+    x = sym.Variable("data")
+    fc = sym.FullyConnected(x, num_hidden=4, name="fc")
+    act = sym.Activation(fc, act_type="relu")
+    assert act.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    outs = act.list_outputs()
+    assert len(outs) == 1 and outs[0].startswith("activation_") \
+        and outs[0].endswith("_output")
+
+
+def test_auto_variable_creation():
+    net = sym.Convolution(data=sym.Variable("data"), kernel=(3, 3),
+                          num_filter=4, name="c")
+    assert net.list_arguments() == ["data", "c_weight", "c_bias"]
+    net2 = sym.Convolution(data=sym.Variable("data"), kernel=(3, 3),
+                           num_filter=4, no_bias=True, name="c2")
+    assert net2.list_arguments() == ["data", "c2_weight"]
+    loss = sym.SoftmaxOutput(net, name="softmax")
+    assert "softmax_label" in loss.list_arguments()
+
+
+def test_infer_shape_with_weight_inference():
+    x = sym.Variable("data")
+    net = sym.FullyConnected(x, num_hidden=7, name="fc")
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(5, 3))
+    assert arg_shapes == [(5, 3), (7, 3), (7,)]
+    assert out_shapes == [(5, 7)]
+
+
+def test_infer_shape_partial():
+    x = sym.Variable("a") + sym.Variable("b")
+    arg_shapes, out_shapes, _ = x.infer_shape_partial(a=(2, 2))
+    assert arg_shapes[0] == (2, 2)
+
+
+def test_symbol_arithmetic_and_getitem():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / b
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([4.0]), "b": mx.nd.array([2.0])})
+    out = ex.forward()[0]
+    assert out.asscalar() == pytest.approx((4 + 2) * 2 - 2.0)
+
+
+def test_group_and_slicing():
+    a = sym.Variable("a")
+    s1 = a * 2
+    s2 = a + 1
+    g = sym.Group([s1, s2])
+    assert g.num_outputs == 2
+    ex = g.bind(mx.cpu(), {"a": mx.nd.array([3.0])})
+    o1, o2 = ex.forward()
+    assert o1.asscalar() == 6.0 and o2.asscalar() == 4.0
+    first = g[0]
+    assert first.num_outputs == 1
+
+
+def test_get_internals():
+    x = sym.Variable("data")
+    fc = sym.FullyConnected(x, num_hidden=4, name="fc")
+    act = sym.Activation(fc, act_type="relu", name="act")
+    internals = act.get_internals()
+    names = internals.list_outputs()
+    assert any("fc" in n for n in names)
+
+
+def test_json_roundtrip_with_exec():
+    x = sym.Variable("data")
+    net = sym.FullyConnected(x, num_hidden=3, name="fc")
+    net = sym.Activation(net, act_type="tanh")
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    args = {"data": mx.nd.ones((2, 4)),
+            "fc_weight": mx.nd.ones((3, 4)),
+            "fc_bias": mx.nd.zeros((3,))}
+    o1 = net.bind(mx.cpu(), dict(args)).forward()[0]
+    o2 = net2.bind(mx.cpu(), dict(args)).forward()[0]
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy())
+
+
+def test_executor_backward_matches_eager():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = sym.broadcast_mul(sym.sin(x), y) + sym.square(x)
+    xv = np.random.randn(3, 2).astype(np.float32)
+    yv = np.random.randn(3, 2).astype(np.float32)
+    args = {"x": mx.nd.array(xv), "y": mx.nd.array(yv)}
+    grads = {"x": mx.nd.zeros((3, 2)), "y": mx.nd.zeros((3, 2))}
+    ex = z.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(grads["x"].asnumpy(),
+                               np.cos(xv) * yv + 2 * xv, rtol=1e-5)
+    np.testing.assert_allclose(grads["y"].asnumpy(), np.sin(xv), rtol=1e-5)
+
+
+def test_executor_explicit_out_grads():
+    x = sym.Variable("x")
+    z = x * 3.0
+    args = {"x": mx.nd.array([1.0, 2.0])}
+    grads = {"x": mx.nd.zeros((2,))}
+    ex = z.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(grads["x"].asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add_and_null():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = x * y
+    args = {"x": mx.nd.array([2.0]), "y": mx.nd.array([3.0])}
+    grads = {"x": mx.nd.zeros((1,))}
+    ex = z.bind(mx.cpu(), args, args_grad=grads,
+                grad_req={"x": "add", "y": "null"})
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(grads["x"].asnumpy(), [6.0])
+
+
+def test_batchnorm_aux_states():
+    d = sym.Variable("data")
+    bn = sym.BatchNorm(d, fix_gamma=False, momentum=0.5, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    ex = bn.simple_bind(mx.cpu(), data=(8, 3))
+    ex.arg_dict["data"][:] = mx.nd.array(
+        np.random.randn(8, 3).astype(np.float32) * 2 + 1)
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(before, after)
+    # predict mode does not touch aux
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), after)
+
+
+def test_dropout_in_graph_fresh_randomness():
+    d = sym.Variable("data")
+    net = sym.Dropout(d, p=0.5)
+    ex = net.bind(mx.cpu(), {"data": mx.nd.ones((100,))})
+    a = ex.forward(is_train=True)[0].asnumpy()
+    b = ex.forward(is_train=True)[0].asnumpy()
+    assert not np.allclose(a, b), "dropout mask must differ across runs"
+    c = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(c, np.ones(100))
+
+
+def test_simple_bind_shape_error():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4)
+    with pytest.raises(MXNetError):
+        net.infer_shape()  # no shapes given
+
+
+def test_reshape_executor():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 8))
+    ex2 = ex.reshape(data=(5, 8))
+    assert ex2.arg_dict["data"].shape == (5, 8)
+    assert ex2.arg_dict["fc_weight"].shape == (4, 8)
