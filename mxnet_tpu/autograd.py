@@ -113,7 +113,7 @@ class TapeNode:
 class AGInfo:
     """Autograd info attached to an NDArray."""
 
-    __slots__ = ("node", "out_idx", "grad", "grad_req", "array_ref")
+    __slots__ = ("node", "out_idx", "grad", "grad_req", "array_ref", "fresh")
 
     def __init__(self, node=None, out_idx=0, grad=None, grad_req="write"):
         self.node = node
@@ -121,6 +121,8 @@ class AGInfo:
         self.grad = grad              # NDArray sink for leaves/marked vars
         self.grad_req = grad_req
         self.array_ref = None
+        self.fresh = False            # grad written since last optimizer step
+                                      # (reference Parameter._fresh_grad)
 
     @property
     def is_leaf(self):
@@ -253,6 +255,7 @@ def _accumulate_leaf(info, g, written):
         gr._data = g
         written.add(id(info))
     gr._version += 1
+    info.fresh = True
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,

@@ -1,0 +1,28 @@
+"""Gluon: the imperative / define-by-run API
+(parity: python/mxnet/gluon/ — 13.5k LoC in the reference)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "utils", "rnn", "data",
+           "model_zoo"]
+
+
+def __getattr__(name):
+    # rnn/data/model_zoo load lazily (they pull in larger dependencies)
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        import importlib
+        try:
+            mod = importlib.import_module("." + name, __name__)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                "module %r has no attribute %r (%s)" % (__name__, name, e))
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,436 @@
+"""Gluon Parameter / ParameterDict.
+
+Parity surface: ``python/mxnet/gluon/parameter.py`` (920 LoC — Parameter with
+deferred shape init, grad_req, lr/wd multipliers; ParameterDict with prefix
+scoping and shared dicts; Constant).
+
+TPU-native notes: a Parameter owns one NDArray (single logical copy — data
+parallelism on TPU replicates/shards via the SPMD mesh instead of per-device
+copies, SURVEY.md §2.3), plus an attached grad sink wired into the eager tape.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError, normalize_dtype
+from ..context import Context, current_context
+from .. import initializer as _init
+from ..ndarray import ndarray as _nd
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its deferred shape inference completed."""
+
+
+# While a shape-inference probe (jax.eval_shape) is tracing, parameters must
+# complete *shapes only* — allocating inside the trace would capture tracers.
+_shape_only = threading.local()
+
+
+class shape_only_scope:
+    def __enter__(self):
+        self._prev = getattr(_shape_only, "on", False)
+        _shape_only.on = True
+        return self
+
+    def __exit__(self, *a):
+        _shape_only.on = self._prev
+
+
+def _in_shape_only_mode():
+    return getattr(_shape_only, "on", False)
+
+
+class Parameter:
+    """A trainable weight tracked by Blocks and Trainer.
+
+    Supports deferred initialization: a shape with 0-entries is completed at
+    the first forward (reference parameter.py `_finish_deferred_init`).
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = normalize_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data = None
+        self._grad = None
+        self._deferred_init = None   # (init, ctx) awaiting shape
+        self._trainer = None
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self._shape, self.dtype)
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # complete unknown (0) dims only
+        assert len(self._shape) == len(new_shape) and all(
+            s == 0 or s == n for s, n in zip(self._shape, new_shape)), \
+            "Expected shape %s is incompatible with given shape %s" % (
+                str(self._shape), str(new_shape))
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                self._data._ag = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad_stype(self):
+        return self._grad_stype
+
+    # ------------------------------------------------------------------ init
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = _init.Uniform()
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        own_init = init or self.init
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (own_init, default_init, ctx[0])
+                return
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self._shape)))
+        self._finish_init(own_init, default_init, ctx[0])
+
+    def _finish_init(self, own_init, default_init, ctx):
+        arr = _nd.zeros(self._shape, dtype=self.dtype, ctx=ctx)
+        desc = _init.InitDesc(self.name)
+        if own_init is not None:
+            # a parameter-specific init bypasses the name-suffix dispatch
+            # (reference: InitDesc attrs['__init__'] → _init_weight directly)
+            own = _init.create(own_init) \
+                if not isinstance(own_init, _init.Initializer) else own_init
+            desc.global_init = own
+            own._init_weight(desc, arr)
+        else:
+            dflt = _init.create(default_init) \
+                if not isinstance(default_init, _init.Initializer) \
+                else default_init
+            desc.global_init = dflt
+            dflt(desc, arr)
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self, shape):
+        """Complete a deferred init once the forward pass reveals shapes."""
+        self.shape = shape
+        if self._deferred_init is None:
+            return
+        if _in_shape_only_mode():
+            return  # allocation happens after the eval_shape probe exits
+        own_init, default_init, ctx = self._deferred_init
+        self._finish_init(own_init, default_init, ctx)
+
+    def _init_grad(self):
+        self._grad = _nd.zeros(self._data.shape, dtype=self._data.dtype,
+                               ctx=self._data.context)
+        self._data.attach_grad(grad_req=self._grad_req)
+        self._data._ag.grad = self._grad
+
+    # ------------------------------------------------------------------ data
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass." % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params" % self.name)
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        if self._grad_req == "null":
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        self._check_initialized()
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init is not None:
+            return [self._deferred_init[2]]
+        self._check_initialized()
+        return [self._data.context]
+
+    def set_data(self, data):
+        if not isinstance(data, _nd.NDArray):
+            data = _nd.array(data)
+        if self._shape is not None and not any(s == 0 for s in self._shape):
+            assert tuple(data.shape) == tuple(self._shape), \
+                "set_data: shape %s != parameter shape %s" % (
+                    data.shape, self._shape)
+        else:
+            self._shape = tuple(data.shape)
+        if self._data is None:
+            self._data = data.astype(self.dtype, copy=False)
+            self._deferred_init = None
+            if self._grad_req != "null":
+                self._init_grad()
+        else:
+            ag = self._data._ag
+            self._data._rebind(data.astype(self.dtype, copy=False)._data)
+            self._data._ag = ag
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def reset_ctx(self, ctx):
+        self._check_initialized()
+        self._data = self._data.as_in_context(
+            ctx[0] if isinstance(ctx, list) else ctx)
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def cast(self, dtype):
+        self.dtype = normalize_dtype(dtype)
+        if self._data is None:
+            return
+        self._data = self._data.astype(dtype)
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def var(self):
+        """Symbol variable for this parameter (symbolic export path)."""
+        from .. import symbol as _sym
+        return _sym.Variable(self.name, shape=self._shape,
+                             dtype=str(_np.dtype(self.dtype)))
+
+    def __reduce__(self):  # pickling for DataLoader workers
+        return (_rebuild_parameter,
+                (self.name, self._grad_req, self._shape, str(_np.dtype(self.dtype)),
+                 self._data.asnumpy() if self._data is not None else None))
+
+
+def _rebuild_parameter(name, grad_req, shape, dtype, data):
+    p = Parameter(name, grad_req=grad_req, shape=shape, dtype=dtype)
+    if data is not None:
+        p.set_data(_nd.array(data))
+    return p
+
+
+class Constant(Parameter):
+    """Non-differentiable parameter with a fixed value
+    (reference parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _nd.NDArray):
+            value = _nd.array(value)
+        self.value = value
+
+        class _CInit(_init.Initializer):
+            def _init_weight(self2, _, arr):
+                value.copyto(arr)
+
+            __call__ = _init_weight
+        initializer = _CInit()
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(_np.dtype(value.dtype)), init=initializer)
+
+
+class ParameterDict:
+    """Prefix-scoped dict of Parameters (reference ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    # ----------------------------------------------------------------- dict
+    def __repr__(self):
+        return "%s(\n%s\n)" % (
+            type(self).__name__,
+            "\n".join("  " + repr(p) for p in self._params.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    # ------------------------------------------------------------------- get
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get or create a Parameter named ``prefix + name``."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+            return param
+        # reconcile attributes with the existing (possibly shared) parameter
+        for k, v in kwargs.items():
+            if v is None:
+                continue
+            if k == "shape":
+                param.shape = v
+            elif k == "dtype":
+                param.dtype = normalize_dtype(v)
+            elif k == "init" and param.init is None:
+                param.init = v
+            elif k in ("grad_req", "lr_mult", "wd_mult",
+                       "allow_deferred_init"):
+                setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '%s'." % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(
+                    "Cannot update self with other because they have different "
+                    "Parameters with the same name '%s'" % k)
+            self._params[k] = v
+
+    # ------------------------------------------------------------------ bulk
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = _init.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for _, v in self.items():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for _, v in self.items():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for _, v in self.items():
+            setattr(v, name, value)
+
+    # ------------------------------------------------------------- serialize
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be stripped before saving, but "
+                    "Parameter's name '%s' does not start with it"
+                    % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        _nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        arg_dict = _nd.load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise IOError(
+                        "Parameter '%s' is missing in file '%s'"
+                        % (name, filename))
+        for name, arr in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError(
+                        "Parameter '%s' loaded from file '%s' is not present "
+                        "in ParameterDict" % (name, filename))
+                continue
+            self[name].set_data(arr)

@@ -1,0 +1,98 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py — split_data,
+split_and_load, clip_global_norm, download helpers)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+from ..context import Context
+from ..ndarray import ndarray as _nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray along batch_axis into num_slice slices.
+
+    On TPU the SPMD path shards instead of slicing (SURVEY.md §2.3), but the
+    surface is kept for API parity and for CPU-mesh data feeding.
+    """
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d" % (str(data.shape), num_slice, batch_axis))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data into len(ctx_list) slices and load one per context."""
+    if not isinstance(data, _nd.NDArray):
+        data = _nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norms is <= max_norm."""
+    import math
+
+    def _norm_sq(array):
+        x = array.reshape((-1,))
+        return _nd.invoke("dot", [x, x], {})
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = _nd.invoke("sqrt", [sum(
+        _norm_sq(arr).as_in_context(ctx) for arr in arrays)], {})
+    norm_val = float(total_norm.asscalar())
+    if check_isfinite and not math.isfinite(norm_val):
+        import warnings
+        warnings.warn(UserWarning(
+            "nan or inf is detected. Clipping results will be undefined."),
+            stacklevel=2)
+    scale = max_norm / (norm_val + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return norm_val if check_isfinite else total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download a file (parity surface; this sandbox has no egress, so the
+    function only resolves cache hits and errors otherwise)."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        "download(%s): no network egress in this environment and no cached "
+        "copy at %s" % (url, fname))

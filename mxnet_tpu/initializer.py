@@ -249,3 +249,8 @@ class Mixed(Initializer):
                 init(name, arr)
                 return
         raise ValueError("Parameter %s did not match Mixed patterns" % name)
+
+
+# string aliases the reference accepts in Parameter(init=...) / Gluon layers
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One

@@ -558,7 +558,11 @@ def invoke(op_name, inputs, params, out=None):
         targets = out if isinstance(out, (list, tuple)) else [out]
         for t, o in zip(targets, out_nds):
             t._rebind(o._data)
-            t._ag = o._ag  # carry tape linkage so autograd flows through out=
+            if o._ag is not None:
+                # carry tape linkage so autograd flows through out=; when not
+                # recording (e.g. optimizer updates), keep the target's own
+                # AGInfo so leaf grad sinks survive in-place updates
+                t._ag = o._ag
         return out
     return out_nds[0] if single else tuple(out_nds)
 

@@ -428,9 +428,11 @@ def softmax_output(data, label=None, *, grad_scale=1.0, ignore_label=-1.0,
 
 
 @register("CTCLoss")
-def ctc_loss(data, label, *, use_data_lengths=False, use_label_lengths=False,
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
+             use_data_lengths=False, use_label_lengths=False,
              blank_label="first"):
-    # data: (T, N, C) activations (pre-softmax), label: (N, L)
+    # data: (T, N, C) activations (pre-softmax), label: (N, L); optional
+    # per-sample lengths (reference src/operator/nn/ctc_loss: 4-input op)
     logp = jax.nn.log_softmax(data, axis=-1)
     T, N, C = data.shape
     lab = label.astype(jnp.int32)
@@ -442,9 +444,12 @@ def ctc_loss(data, label, *, use_data_lengths=False, use_label_lengths=False,
     S = 2 * L + 1
     neg_inf = -1e30
 
-    # label_lengths: count of non-(-1/0-pad) entries; MXNet pads with -1 or 0
-    pad_mask = (lab >= 0) & (lab != 0) if blank == 0 else (lab >= 0)
-    lab_len = jnp.sum(pad_mask.astype(jnp.int32), axis=1)
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        # count of non-(-1/0-pad) entries; MXNet pads with -1 or 0
+        pad_mask = (lab >= 0) & (lab != 0) if blank == 0 else (lab >= 0)
+        lab_len = jnp.sum(pad_mask.astype(jnp.int32), axis=1)
     ext_len = 2 * lab_len + 1
 
     def step(alpha_prev, logp_t):
@@ -465,7 +470,12 @@ def ctc_loss(data, label, *, use_data_lengths=False, use_label_lengths=False,
     first_lab = ext[:, 1]
     alpha0 = alpha0.at[:, 1].set(
         jnp.take_along_axis(logp[0], first_lab[:, None], 1)[:, 0])
-    alpha_T, _ = lax.scan(step, alpha0, logp[1:])
+    alpha_T, alpha_seq = lax.scan(step, alpha0, logp[1:])
+    if use_data_lengths and data_lengths is not None:
+        # per-sample final alpha at t = data_length-1
+        alpha_all = jnp.concatenate([alpha0[None], alpha_seq], axis=0)  # (T,N,S)
+        t_idx = jnp.clip(data_lengths.astype(jnp.int32) - 1, 0, T - 1)
+        alpha_T = alpha_all[t_idx, jnp.arange(N)]                       # (N,S)
     idx_last = (ext_len - 1)[:, None]
     idx_prev = (ext_len - 2)[:, None]
     ll = jnp.logaddexp(

@@ -98,17 +98,20 @@ class Optimizer:
         else:
             lr = self.lr
         name = self.idx2name.get(index, index if isinstance(index, str) else None)
-        if name in self.param_dict:
-            lr *= self.param_dict[name].get("lr_mult", 1.0) \
-                if isinstance(self.param_dict[name], dict) else 1.0
-        if name in self.lr_mult:
+        if index in self.param_dict:
+            # Gluon Trainer path: param_dict[index] is a Parameter whose
+            # lr_mult is read live (reference optimizer.py _get_lr)
+            lr *= getattr(self.param_dict[index], "lr_mult", 1.0)
+        elif name in self.lr_mult:
             lr *= self.lr_mult[name]
         return lr
 
     def _get_wd(self, index):
         wd = self.wd
         name = self.idx2name.get(index, index if isinstance(index, str) else None)
-        if name in self.wd_mult:
+        if index in self.param_dict:
+            wd *= getattr(self.param_dict[index], "wd_mult", 1.0)
+        elif name in self.wd_mult:
             wd *= self.wd_mult[name]
         return wd
 
