@@ -456,8 +456,9 @@ class HybridBlock(Block):
         abstract-evaluating the forward (jax.eval_shape — zero FLOPs; the
         reference runs a symbolic infer_shape pass instead)."""
         from .parameter import shape_only_scope
-        abstract = [jnp.zeros(a.shape, a.dtype) if hasattr(a, "shape") else a
-                    for a in args]
+        abstract = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype) if hasattr(a, "shape")
+            else a, list(args))
 
         def probe(*xs):
             tctx = _TraceCtx({}, training=False)
@@ -524,43 +525,48 @@ class HybridBlock(Block):
             for p in self.collect_params().values():
                 p._check_initialized()
         except DeferredInitializationError:
-            self.infer_shape(*[a for a in args
-                               if isinstance(a, _nd.NDArray)])
+            self.infer_shape(*args)
 
+        # args may be a pytree mixing NDArrays with lists/statics (e.g. a
+        # recurrent cell stepped with a state list)
+        leaves, treedef = jax.tree_util.tree_flatten(list(args))
         training = _autograd.is_training()
-        sig = (tuple((a.shape, str(a.dtype)) if isinstance(a, _nd.NDArray)
-                     else ("static", repr(a)) for a in args), training)
+        sig = (treedef,
+               tuple((a.shape, str(a.dtype)) if isinstance(a, _nd.NDArray)
+                     else ("static", repr(a)) for a in leaves), training)
         runner = self._cached_graph.get(sig)
         if runner is None:
-            runner = self._build_cache(args, training)
+            runner = self._build_cache(treedef, leaves, training)
             self._cached_graph[sig] = runner
-        return runner(args)
+        return runner(leaves)
 
-    def _build_cache(self, ex_args, training):
+    def _build_cache(self, treedef, ex_leaves, training):
         block = self
         # param binding order is fixed at build time
         params = [p for p in self.collect_params().values()
                   if p._data is not None]
         param_names = [p.name for p in params]
-        static_args = [None if isinstance(a, _nd.NDArray) else a
-                       for a in ex_args]
+        static_leaves = [None if isinstance(a, _nd.NDArray) else a
+                         for a in ex_leaves]
 
         def traced(param_arrays, in_arrays, key):
             tctx = _TraceCtx(dict(zip(param_names, param_arrays)), training)
             with _trace_scope(tctx):
                 with _random.trace_scope(key):
                     it = iter(in_arrays)
-                    call_args = [next(it) if s is None else s
-                                 for s in static_args]
+                    call_leaves = [next(it) if s is None else s
+                                   for s in static_leaves]
+                    call_args = jax.tree_util.tree_unflatten(
+                        treedef, call_leaves)
                     out = block.hybrid_forward_entry(*call_args)
-            outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
-            return outs, tctx.aux_updates
+            return out, tctx.aux_updates  # out may be any pytree
 
         jitted = jax.jit(traced)
+        tree = jax.tree_util
 
-        def run(args):
+        def run(leaves):
             param_arrays = [p._data._data for p in params]
-            in_nds = [a for a in args if isinstance(a, _nd.NDArray)]
+            in_nds = [a for a in leaves if isinstance(a, _nd.NDArray)]
             in_arrays = [a._data for a in in_nds]
             key = _random.next_key()
 
@@ -568,10 +574,11 @@ class HybridBlock(Block):
                          and (any(p._data._ag is not None for p in params)
                               or any(a._ag is not None for a in in_nds)))
             if not recording:
-                outs, aux = jitted(param_arrays, in_arrays, key)
+                out_pytree, aux = jitted(param_arrays, in_arrays, key)
                 _apply_aux(params, param_names, aux)
-                out_nds = [_nd.NDArray(o) for o in outs]
-                return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
+                flat, out_td = tree.tree_flatten(out_pytree)
+                return tree.tree_unflatten(
+                    out_td, [_nd.NDArray(o) for o in flat])
 
             diff_idx = [i for i, p in enumerate(params)
                         if p.grad_req != "null"]
@@ -583,20 +590,21 @@ class HybridBlock(Block):
                 return jitted(pa, diff_ins, key)
 
             diff_params = [param_arrays[i] for i in diff_idx]
-            (outs, aux), vjp = jax.vjp(fwd, diff_params, in_arrays)
+            (out_pytree, aux), vjp = jax.vjp(fwd, diff_params, in_arrays)
             _apply_aux(params, param_names, aux)
-            out_nds = [_nd.NDArray(o) for o in outs]
+            flat, out_td = tree.tree_flatten(out_pytree)
+            out_nds = [_nd.NDArray(o) for o in flat]
             tape_inputs = [params[i]._data for i in diff_idx] + in_nds
-            zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux)
+            zero_aux = tree.tree_map(jnp.zeros_like, aux)
 
             def tape_vjp(cot):
-                cots = cot if isinstance(cot, tuple) else (cot,)
-                dp, di = vjp((tuple(cots), zero_aux))
+                cots = list(cot) if isinstance(cot, tuple) else [cot]
+                dp, di = vjp((tree.tree_unflatten(out_td, cots), zero_aux))
                 return list(dp) + list(di)
 
             _autograd.record_op(tape_vjp, tape_inputs, out_nds,
                                 name="CachedOp(%s)" % block.name)
-            return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
+            return tree.tree_unflatten(out_td, out_nds)
 
         return run
 

@@ -164,6 +164,11 @@ class CTCLoss(Loss):
             pred = F.swapaxes(pred, dim1=0, dim2=1)
         if self._batch_axis == 1:
             label = F.swapaxes(label, dim1=0, dim2=1)
+        if pred_lengths is None and label_lengths is not None:
+            # interior None inputs would shift label_lengths into the
+            # data_lengths slot; materialize full-length data lengths instead
+            T = pred.shape[0]
+            pred_lengths = F.ones_like(F.sum(label, axis=1)) * T
         loss = F.CTCLoss(pred, label, pred_lengths, label_lengths,
                          use_data_lengths=pred_lengths is not None,
                          use_label_lengths=label_lengths is not None,

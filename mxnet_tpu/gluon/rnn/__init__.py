@@ -1,0 +1,6 @@
+"""Recurrent layers and cells (parity: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *
+from .rnn_layer import *
+
+from . import rnn_cell
+from . import rnn_layer

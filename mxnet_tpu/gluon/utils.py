@@ -46,7 +46,10 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
 
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
-    """Rescale arrays so that the sum of their 2-norms is <= max_norm."""
+    """Rescale arrays so that the sum of their 2-norms is <= max_norm.
+
+    With check_isfinite=False the whole computation stays on device (no host
+    sync) — the reference documents the same async contract."""
     import math
 
     def _norm_sq(array):
@@ -56,17 +59,21 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     ctx = arrays[0].context
     total_norm = _nd.invoke("sqrt", [sum(
         _norm_sq(arr).as_in_context(ctx) for arr in arrays)], {})
-    norm_val = float(total_norm.asscalar())
-    if check_isfinite and not math.isfinite(norm_val):
-        import warnings
-        warnings.warn(UserWarning(
-            "nan or inf is detected. Clipping results will be undefined."),
-            stacklevel=2)
-    scale = max_norm / (norm_val + 1e-8)
-    if scale < 1.0:
-        for arr in arrays:
-            arr *= scale
-    return norm_val if check_isfinite else total_norm
+    # scale = min(max_norm / (norm + eps), 1) applied unconditionally keeps
+    # the op graph free of a data-dependent host branch
+    scale = _nd.invoke("clip", [max_norm / (total_norm + 1e-8)],
+                       {"a_min": 0.0, "a_max": 1.0})
+    for arr in arrays:
+        arr *= scale
+    if check_isfinite:
+        norm_val = float(total_norm.asscalar())
+        if not math.isfinite(norm_val):
+            import warnings
+            warnings.warn(UserWarning(
+                "nan or inf is detected. Clipping results will be "
+                "undefined."), stacklevel=2)
+        return norm_val
+    return total_norm
 
 
 def check_sha1(filename, sha1_hash):
