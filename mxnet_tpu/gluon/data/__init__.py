@@ -1,0 +1,9 @@
+"""Gluon data API (parity: python/mxnet/gluon/data/)."""
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+
+from . import dataset
+from . import sampler
+from . import dataloader
+from . import vision

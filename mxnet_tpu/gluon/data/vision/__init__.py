@@ -1,0 +1,4 @@
+"""Vision datasets and transforms (parity: python/mxnet/gluon/data/vision/)."""
+from .datasets import *
+from . import transforms
+from . import datasets

@@ -61,12 +61,11 @@ def _format_sequence(length, inputs, layout, merge, in_layout=None):
     axis = layout.find("T")
     batch_axis = layout.find("N")
     if isinstance(inputs, (list, tuple)):
-        in_axis = in_layout.find("T") if in_layout else axis
+        # list elements are per-step (batch, C) tensors: batch is axis 0
+        batch_size = _shape_of(inputs[0])[0]
         if merge is True:
             F = _F_of(inputs[0])
             inputs = F.stack(*inputs, axis=axis)
-        batch_size = _shape_of(inputs[0] if isinstance(inputs, (list, tuple))
-                               else inputs)[batch_axis]
         return inputs, axis, batch_size
     batch_size = _shape_of(inputs)[batch_axis]
     if merge is False:
@@ -456,10 +455,11 @@ class ModifierCell(HybridRecurrentCell):
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
-    def begin_state(self, func=None, **kwargs):
+    def begin_state(self, batch_size=0, func=None, **kwargs):
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func, **kwargs)
+        begin = self.base_cell.begin_state(batch_size=batch_size, func=func,
+                                           **kwargs)
         self.base_cell._modified = True
         return begin
 
@@ -476,6 +476,7 @@ class ZoneoutCell(ModifierCell):
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states
         self._prev_output = None
+        self._prev_trace = None
 
     def _alias(self):
         return "zoneout"
@@ -483,15 +484,22 @@ class ZoneoutCell(ModifierCell):
     def reset(self):
         super().reset()
         self._prev_output = None
+        self._prev_trace = None
 
     def hybrid_forward(self, F, inputs, states):
+        from ..block import _current_trace
         cell = self.base_cell
         p_outputs, p_states = self.zoneout_outputs, self.zoneout_states
         next_output, next_states = cell(inputs, states)
 
         def mask(p, like):
             return F.Dropout(F.ones_like(like), p=p)
-        prev_output = self._prev_output
+        # the remembered output is only valid within the same trace (or in
+        # eager mode): a tracer from a finished jit trace must not leak in
+        trace_id = id(_current_trace()) if _current_trace() is not None \
+            else None
+        prev_output = self._prev_output \
+            if self._prev_trace == trace_id else None
         if prev_output is None:
             prev_output = F.zeros_like(next_output)
         output = (F.where(mask(p_outputs, next_output), next_output,
@@ -501,6 +509,7 @@ class ZoneoutCell(ModifierCell):
                        for new_s, old_s in zip(next_states, states)]
                       if p_states != 0.0 else next_states)
         self._prev_output = output
+        self._prev_trace = trace_id
         return output, new_states
 
 

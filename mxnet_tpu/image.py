@@ -1,0 +1,506 @@
+"""Imperative image API (parity: python/mxnet/image/image.py, 1,342 LoC —
+imdecode/imresize/crops/augmenters/CreateAugmenter/ImageIter).
+
+Decode runs on host via OpenCV (same as the reference's USE_OPENCV path);
+augmentation math runs as registry ops so it can also fuse into compiled
+input pipelines.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "SequentialAug",
+           "RandomOrderAug", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "HorizontalFlipAug",
+           "CastAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+           "CreateAugmenter", "ImageIter"]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an HWC uint8 NDArray."""
+    cv2 = _cv2()
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().astype(_np.uint8)
+    img = cv2.imdecode(_np.frombuffer(bytes(buf), dtype=_np.uint8), flag)
+    if img is None:
+        raise MXNetError("imdecode failed")
+    if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return _nd.array(img, dtype=_np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def _interp_method(interp, sizes=()):
+    if interp == 9 and sizes:  # auto: area for shrink, cubic for enlarge
+        oh, ow, nh, nw = sizes
+        return 3 if nh < oh and nw < ow else 2
+    if interp == 10:
+        return _pyrandom.randint(0, 4)
+    return interp
+
+
+def imresize(src, w, h, interp=1):
+    return _nd.invoke("_image_resize", [src], {"size": (w, h),
+                                               "interp": interp})
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals size, keeping aspect."""
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h,
+                    _interp_method(interp, (h, w, new_h, new_w)))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = _nd.invoke("_image_crop", [src], {"x": x0, "y": y0, "width": w,
+                                            "height": h})
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1],
+                       _interp_method(interp, (h, w, size[1], size[0])))
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - (mean if isinstance(mean, NDArray)
+                     else _nd.array(_np.asarray(mean, _np.float32)))
+    if std is not None:
+        src = src / (std if isinstance(std, NDArray)
+                     else _nd.array(_np.asarray(std, _np.float32)))
+    return src
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    import math
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+        new_ratio = math.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(math.sqrt(target_area * new_ratio)))
+        new_h = int(round(math.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (reference image.py Augmenter family)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for aug in ts:
+            src = aug(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        sizes = (src.shape[0], src.shape[1], self.size[1], self.size[0])
+        return imresize(src, *self.size,
+                        interp=_interp_method(self.interp, sizes))
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return _nd.invoke("_image_flip_left_right", [src], {})
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        return _nd.invoke("_image_random_brightness", [src],
+                          {"min_factor": max(0, 1 - self.brightness),
+                           "max_factor": 1 + self.brightness})
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        return _nd.invoke("_image_random_contrast", [src],
+                          {"min_factor": max(0, 1 - self.contrast),
+                           "max_factor": 1 + self.contrast})
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        return _nd.invoke("_image_random_saturation", [src],
+                          {"min_factor": max(0, 1 - self.saturation),
+                           "max_factor": 1 + self.saturation})
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        return _nd.invoke("_image_random_hue", [src],
+                          {"min_factor": max(0, 1 - self.hue),
+                           "max_factor": 1 + self.hue})
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval=None, eigvec=None):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+
+    def __call__(self, src):
+        return _nd.invoke("_image_random_lighting", [src],
+                          {"alpha_std": self.alphastd})
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = _nd.array(_np.array(
+            [[0.21, 0.21, 0.21], [0.72, 0.72, 0.72], [0.07, 0.07, 0.07]],
+            dtype=_np.float32))
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            src = _nd.invoke("dot", [src.astype("float32"), self.mat], {})
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter list (reference image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Image data iterator over an imglist or a .rec file with augmenters
+    (reference image.py ImageIter — the python-side analog of
+    ImageRecordIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", last_batch_handle="pad", **kw):
+        from .io import io as _io
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self._shuffle = shuffle
+        self._allow_read = True
+        self.imgrec = None
+        self.imglist = {}
+        self.seq = []
+        if path_imgrec:
+            from . import recordio
+            idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.isfile(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = _np.array(parts[1:-1], dtype=_np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+                self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+        else:
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (_np.asarray(label, dtype=_np.float32)
+                                   .reshape(-1), fname)
+            self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+        if num_parts > 1 and self.seq is not None:
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kw.items()
+                                           if k in CreateAugmenter.__code__
+                                           .co_varnames})
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        from .io.io import DataDesc
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        from .io.io import DataDesc
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self._shuffle and self.seq is not None:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        from . import recordio
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        from .io.io import DataBatch
+        batch_data = _np.zeros((self.batch_size,) + self.data_shape,
+                               dtype=_np.float32)
+        shape = (self.batch_size, self.label_width) if self.label_width > 1 \
+            else (self.batch_size,)
+        batch_label = _np.zeros(shape, dtype=_np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy() if isinstance(img, NDArray) else img
+                batch_data[i] = arr.transpose(2, 0, 1)  # HWC -> CHW
+                batch_label[i] = label if self.label_width > 1 else \
+                    _np.asarray(label).reshape(-1)[0]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        return DataBatch(data=[_nd.array(batch_data)],
+                         label=[_nd.array(batch_label)], pad=pad)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self

@@ -1,0 +1,190 @@
+"""Image operators (parity: src/operator/image/image_random-inl.h —
+to_tensor, normalize, flips, color jitter, lighting; plus resize/crop used
+by gluon transforms).
+
+Layout convention matches the reference: images are HWC (or NHWC batched)
+uint8/float; ``to_tensor`` converts to CHW float32 scaled to [0,1].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .. import random as _random
+
+
+@register("_image_to_tensor")
+def to_tensor(data):
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def normalize(data, *, mean=0.0, std=1.0):
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    if data.ndim == 3:  # CHW
+        shape = (-1, 1, 1)
+    else:               # NCHW
+        shape = (1, -1, 1, 1)
+    return (data - jnp.reshape(mean, shape)) / jnp.reshape(std, shape)
+
+
+def _flip(data, axis3):
+    # axis3: axis in the HWC case; batched adds one
+    return jnp.flip(data, axis=axis3 if data.ndim == 3 else axis3 + 1)
+
+
+@register("_image_flip_left_right")
+def flip_left_right(data):
+    return _flip(data, 1)
+
+
+@register("_image_flip_top_bottom")
+def flip_top_bottom(data):
+    return _flip(data, 0)
+
+
+def _bernoulli():
+    key = _random.next_key()
+    return jax.random.bernoulli(key, 0.5)
+
+
+@register("_image_random_flip_left_right", is_random=True)
+def random_flip_left_right(data):
+    return jnp.where(_bernoulli(), _flip(data, 1), data)
+
+
+@register("_image_random_flip_top_bottom", is_random=True)
+def random_flip_top_bottom(data):
+    return jnp.where(_bernoulli(), _flip(data, 0), data)
+
+
+def _uniform(lo, hi):
+    key = _random.next_key()
+    return jax.random.uniform(key, (), jnp.float32, lo, hi)
+
+
+def _blend(a, b, alpha):
+    out = alpha * a + (1.0 - alpha) * b
+    return out
+
+
+@register("_image_random_brightness", is_random=True)
+def random_brightness(data, *, min_factor, max_factor):
+    alpha = _uniform(min_factor, max_factor)
+    return data.astype(jnp.float32) * alpha
+
+
+_GRAY = jnp.array([0.299, 0.587, 0.114], jnp.float32)
+
+
+def _to_gray(x):
+    # x: ...HWC
+    return jnp.sum(x * _GRAY, axis=-1, keepdims=True)
+
+
+@register("_image_random_contrast", is_random=True)
+def random_contrast(data, *, min_factor, max_factor):
+    alpha = _uniform(min_factor, max_factor)
+    x = data.astype(jnp.float32)
+    gray_mean = jnp.mean(_to_gray(x), axis=(-3, -2), keepdims=True)
+    return _blend(x, gray_mean, alpha)
+
+
+@register("_image_random_saturation", is_random=True)
+def random_saturation(data, *, min_factor, max_factor):
+    alpha = _uniform(min_factor, max_factor)
+    x = data.astype(jnp.float32)
+    return _blend(x, _to_gray(x), alpha)
+
+
+@register("_image_random_hue", is_random=True)
+def random_hue(data, *, min_factor, max_factor):
+    """Hue rotation via the YIQ linear approximation the reference uses
+    (image_random-inl.h RandomHue)."""
+    alpha = _uniform(min_factor, max_factor)
+    theta = (alpha - 1.0) * jnp.pi  # factor 1.0 -> no change
+    u, w = jnp.cos(theta), jnp.sin(theta)
+    t_yiq = jnp.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], jnp.float32)
+    t_rgb = jnp.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], jnp.float32)
+    rot = jnp.array([[1.0, 0.0, 0.0],
+                     [0.0, 0.0, 0.0],
+                     [0.0, 0.0, 0.0]], jnp.float32) + \
+        u * jnp.array([[0., 0., 0.], [0., 1., 0.], [0., 0., 1.]],
+                      jnp.float32) + \
+        w * jnp.array([[0., 0., 0.], [0., 0., 1.], [0., -1., 0.]],
+                      jnp.float32)
+    m = t_rgb @ rot @ t_yiq
+    x = data.astype(jnp.float32)
+    return jnp.einsum("...c,dc->...d", x, m)
+
+
+@register("_image_random_color_jitter", is_random=True)
+def random_color_jitter(data, *, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0):
+    x = data.astype(jnp.float32)
+    if brightness > 0:
+        x = x * _uniform(max(0.0, 1 - brightness), 1 + brightness)
+    if contrast > 0:
+        a = _uniform(max(0.0, 1 - contrast), 1 + contrast)
+        x = _blend(x, jnp.mean(_to_gray(x), axis=(-3, -2), keepdims=True), a)
+    if saturation > 0:
+        a = _uniform(max(0.0, 1 - saturation), 1 + saturation)
+        x = _blend(x, _to_gray(x), a)
+    if hue > 0:
+        x = random_hue(x, min_factor=1 - hue, max_factor=1 + hue)
+    return x
+
+
+@register("_image_random_lighting", is_random=True)
+def random_lighting(data, *, alpha_std=0.05):
+    """AlexNet-style PCA lighting noise (reference RandomLighting)."""
+    key = _random.next_key()
+    alpha = jax.random.normal(key, (3,), jnp.float32) * alpha_std
+    eig_val = jnp.array([55.46, 4.794, 1.148], jnp.float32)
+    eig_vec = jnp.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    rgb = eig_vec @ (alpha * eig_val)
+    return data.astype(jnp.float32) + rgb
+
+
+@register("_image_resize")
+def image_resize(data, *, size, keep_ratio=False, interp=1):
+    """Resize HWC/NHWC to `size` (w, h) or square int; bilinear by default."""
+    if isinstance(size, int):
+        ow = oh = size
+    else:
+        ow, oh = size
+    batched = data.ndim == 4
+    x = data if batched else data[None]
+    n, h, w, c = x.shape
+    if keep_ratio and not isinstance(size, int):
+        pass  # full ratio-preserving handled at the transform level
+    # OpenCV interp codes -> jax.image methods; area (3) has no jax
+    # equivalent and degrades to linear (antialiased) — closest for shrink
+    method = {0: "nearest", 1: "linear", 2: "cubic", 3: "linear",
+              4: "lanczos3"}.get(int(interp), "linear")
+    out = jax.image.resize(x.astype(jnp.float32), (n, oh, ow, c), method)
+    if data.dtype == jnp.uint8:
+        out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+    else:
+        out = out.astype(data.dtype)
+    return out if batched else out[0]
+
+
+@register("_image_crop")
+def image_crop(data, *, x, y, width, height):
+    if data.ndim == 3:
+        return jax.lax.dynamic_slice(
+            data, (y, x, 0), (height, width, data.shape[2]))
+    return jax.lax.dynamic_slice(
+        data, (0, y, x, 0), (data.shape[0], height, width, data.shape[3]))

@@ -1,0 +1,247 @@
+"""RecordIO file format (parity: python/mxnet/recordio.py — MXRecordIO,
+MXIndexedRecordIO, IRHeader, pack/unpack/pack_img/unpack_img).
+
+Binary format is byte-compatible with the reference
+(dmlc-core recordio: magic 0xced7230a, cflag:3|length:29 word, 4-byte
+alignment), so .rec files produced by the reference's im2rec load here and
+vice versa. A C++ fast reader (src/recordio.cc) accelerates bulk scans; this
+module is the always-available pure-Python implementation and the API
+surface.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(rec):
+    return rec >> 29, rec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.fp.close()
+        self.is_open = False
+        self.pid = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        """Pickling support for DataLoader workers (reference reopens the
+        file in the child process)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        fp = d.pop("fp", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        is_open = d["is_open"]
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def _check_pid(self):
+        # after fork, reopen to get an independent file offset
+        if self.pid != os.getpid():
+            self.close() if self.is_open else None
+            self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid()
+        self.fp.write(_MAGIC_BYTES)
+        self.fp.write(struct.pack("<I", _encode_lrec(0, len(buf))))
+        self.fp.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        magic = self.fp.read(4)
+        if len(magic) < 4:
+            return None
+        if magic != _MAGIC_BYTES:
+            raise IOError("Invalid RecordIO magic in %s" % self.uri)
+        lrec, = struct.unpack("<I", self.fp.read(4))
+        cflag, length = _decode_lrec(lrec)
+        buf = self.fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.read(pad)
+        if cflag != 0:
+            # multi-part record (continuation); assemble
+            parts = [buf]
+            while cflag in (1, 2):
+                magic = self.fp.read(4)
+                lrec, = struct.unpack("<I", self.fp.read(4))
+                cflag, length = _decode_lrec(lrec)
+                part = self.fp.read(length)
+                pad = (4 - length % 4) % 4
+                if pad:
+                    self.fp.read(pad)
+                parts.append(part)
+                if cflag == 3:
+                    break
+            buf = b"".join(parts)
+        return buf
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fp.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a .idx sidecar for random access."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid()
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# ---------------------------------------------------------------------------
+# image record packing (reference recordio.py IRHeader/pack/unpack)
+# ---------------------------------------------------------------------------
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + raw bytes into a record payload."""
+    import numbers
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        head = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                           header.id2)
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32).reshape(-1)
+        head = struct.pack(_IR_FORMAT, label.size, 0.0, header.id,
+                           header.id2)
+        head += label.tobytes()
+    return head + s
+
+
+def unpack(s):
+    """Unpack a record payload into (IRHeader, raw bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(s[:header.flag * 4], dtype=_np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack a header + image array; encodes with OpenCV."""
+    import cv2
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record payload into (IRHeader, decoded BGR image array)."""
+    import cv2
+    header, s = unpack(s)
+    img = cv2.imdecode(_np.frombuffer(s, dtype=_np.uint8), iscolor)
+    return header, img

@@ -1,0 +1,233 @@
+"""Gluon data + recordio + image tests
+(model: reference tests/python/unittest/test_gluon_data.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, image, recordio
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import transforms
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_array_dataset():
+    X = np.random.randn(10, 3).astype("float32")
+    Y = np.arange(10).astype("float32")
+    ds = gdata.ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x, y = ds[3]
+    assert x.shape == (3,) and y == 3.0
+    with pytest.raises(AssertionError):
+        gdata.ArrayDataset(X, Y[:5])
+
+
+def test_simple_dataset_ops():
+    ds = gdata.SimpleDataset(list(range(10)))
+    assert len(ds.take(4)) == 4
+    assert list(ds.filter(lambda x: x % 2 == 0)) == [0, 2, 4, 6, 8]
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    s = ds.sample(gdata.SequentialSampler(5))
+    assert len(s) == 5
+
+
+def test_samplers():
+    assert list(gdata.SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(gdata.RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(10), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 3, 1]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(10), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3, 3]
+    assert len(bs) == 3
+    bs = gdata.BatchSampler(gdata.SequentialSampler(10), 3, "rollover")
+    assert [len(b) for b in list(bs)] == [3, 3, 3]
+    assert [len(b) for b in list(bs)] == [3, 3, 3]  # rolled-over 1 + 10 -> 3x3+2
+
+
+def test_dataloader_basic():
+    X = np.random.randn(20, 4).astype("float32")
+    Y = np.arange(20).astype("float32")
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=6)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 4)
+    assert batches[-1][0].shape == (2, 4)
+    assert len(loader) == 4
+    # shuffle covers all samples
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=5,
+                              shuffle=True)
+    seen = np.concatenate([b[1].asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_dataloader_multiworker():
+    X = np.random.randn(12, 2).astype("float32")
+    Y = np.arange(12).astype("float32")
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=4,
+                              num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 3
+    seen = np.concatenate([b[1].asnumpy() for b in batches])
+    assert sorted(seen.tolist()) == list(range(12))
+
+
+def test_recordio_roundtrip(tmp_path):
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(5):
+        w.write(b"record-%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(rec, "r")
+    for i in range(5):
+        assert r.read() == b"record-%d" % i
+    assert r.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        w.write_idx(i, b"payload-%d" % (i * 7))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(3) == b"payload-21"
+    assert r.read_idx(0) == b"payload-0"
+    assert r.keys == [0, 1, 2, 3, 4]
+
+
+def test_irheader_pack_unpack():
+    hdr = recordio.IRHeader(0, 3.5, 7, 0)
+    s = recordio.pack(hdr, b"imagedata")
+    hdr2, data = recordio.unpack(s)
+    assert hdr2.label == 3.5 and hdr2.id == 7 and data == b"imagedata"
+    # multi-label
+    hdr = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = recordio.pack(hdr, b"x")
+    hdr2, data = recordio.unpack(s)
+    assert list(hdr2.label) == [1.0, 2.0, 3.0] and data == b"x"
+
+
+def test_image_record_dataset(tmp_path):
+    rec = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    w.close()
+    ds = gdata.vision.ImageRecordDataset(rec)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert img.shape == (8, 8, 3) and label == 2.0
+
+
+def test_image_folder_dataset(tmp_path):
+    import cv2
+    for cls in ["cat", "dog"]:
+        os.makedirs(str(tmp_path / cls))
+        for i in range(2):
+            cv2.imwrite(str(tmp_path / cls / ("%d.jpg" % i)),
+                        (np.random.rand(6, 6, 3) * 255).astype(np.uint8))
+    ds = gdata.vision.ImageFolderDataset(str(tmp_path))
+    assert len(ds) == 4
+    assert ds.synsets == ["cat", "dog"]
+    img, label = ds[0]
+    assert img.shape == (6, 6, 3) and label == 0
+
+
+def test_transforms_to_tensor_normalize():
+    img = mx.nd.array((np.arange(48).reshape(4, 4, 3) % 256)
+                      .astype(np.uint8), dtype=np.uint8)
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 4, 4)
+    assert abs(float(t.asnumpy().max()) - 47 / 255) < 1e-6
+    n = transforms.Normalize([0.5, 0.5, 0.5], [2, 2, 2])(t)
+    assert_almost_equal(n.asnumpy(), (t.asnumpy() - 0.5) / 2, rtol=1e-5)
+
+
+def test_transforms_geometric():
+    img = mx.nd.array((np.random.rand(10, 8, 3) * 255).astype(np.uint8))
+    assert transforms.Resize(16)(img).shape == (16, 16, 3)
+    assert transforms.Resize((6, 4))(img).shape == (4, 6, 3)
+    assert transforms.CenterCrop(4)(img).shape == (4, 4, 3)
+    assert transforms.RandomResizedCrop(5)(img).shape == (5, 5, 3)
+    f = transforms.RandomFlipLeftRight()(img)
+    assert f.shape == img.shape
+
+
+def test_transforms_color():
+    img = mx.nd.array((np.random.rand(6, 6, 3) * 255).astype(np.uint8))
+    for t in [transforms.RandomBrightness(0.3),
+              transforms.RandomContrast(0.3),
+              transforms.RandomSaturation(0.3),
+              transforms.RandomHue(0.1),
+              transforms.RandomColorJitter(0.2, 0.2, 0.2, 0.1),
+              transforms.RandomLighting(0.1)]:
+        out = t(img.astype("float32"))
+        assert out.shape == img.shape
+
+
+def test_transform_compose_in_loader():
+    imgs = [(np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+            for _ in range(6)]
+    ds = gdata.SimpleDataset([(im, float(i)) for i, im in enumerate(imgs)])
+    tfn = transforms.Compose([transforms.ToTensor()])
+    tds = ds.transform_first(lambda x: tfn(mx.nd.array(x, dtype=np.uint8)))
+    loader = gdata.DataLoader(tds, batch_size=3)
+    b = next(iter(loader))
+    assert b[0].shape == (3, 3, 8, 8)
+
+
+def test_image_module():
+    import cv2
+    img = (np.random.rand(12, 10, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".jpg", img)
+    decoded = image.imdecode(buf.tobytes())
+    assert decoded.shape == (12, 10, 3)
+    r = image.imresize(decoded, 5, 6)
+    assert r.shape == (6, 5, 3)
+    rs = image.resize_short(decoded, 6)
+    assert min(rs.shape[:2]) == 6
+    c, rect = image.center_crop(decoded, (4, 4))
+    assert c.shape == (4, 4, 3)
+    c2, _ = image.random_crop(decoded, (4, 4))
+    assert c2.shape == (4, 4, 3)
+    augs = image.CreateAugmenter((3, 6, 6), rand_crop=True, rand_mirror=True,
+                                 brightness=0.1, mean=True, std=True)
+    out = decoded
+    for a in augs:
+        out = a(out)
+    assert out.shape == (6, 6, 3) and out.dtype == np.float32
+
+
+def test_image_iter(tmp_path):
+    rec = str(tmp_path / "ii.rec")
+    idx = str(tmp_path / "ii.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(7):
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img))
+    w.close()
+    it = image.ImageIter(batch_size=3, data_shape=(3, 8, 8),
+                         path_imgrec=rec, path_imgidx=idx)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 8, 8)
+    assert batch.label[0].shape == (3,)
+    n = 1 + sum(1 for _ in it)
+    assert n >= 2
+
+
+def test_pack_numpy_scalar_label():
+    """Review regression: numpy scalar labels must pack as plain labels."""
+    hdr = recordio.IRHeader(0, np.float32(3.0), 1, 0)
+    h2, data = recordio.unpack(recordio.pack(hdr, b"z"))
+    assert h2.label == 3.0 and h2.flag == 0
+    # 2-D label flattens to element count, not row count
+    hdr = recordio.IRHeader(0, np.ones((2, 3), np.float32), 1, 0)
+    h2, _ = recordio.unpack(recordio.pack(hdr, b"z"))
+    assert h2.flag == 6 and len(h2.label) == 6

@@ -246,3 +246,26 @@ def test_clip_global_norm_async_path():
     assert hasattr(total, "asnumpy")  # NDArray, not a synced float
     new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
     assert abs(new_norm - 1.0) < 1e-4
+
+
+def test_unroll_list_inputs():
+    """Review regression: list-of-steps input must infer batch from axis 0."""
+    cell = rnn.GRUCell(6, input_size=4)
+    cell.initialize()
+    steps = [mx.nd.ones((5, 4)) for _ in range(3)]
+    outs, states = cell.unroll(3, steps, layout="TNC")
+    assert len(outs) == 3 and outs[0].shape == (5, 6)
+    assert states[0].shape == (5, 6)
+
+
+def test_zoneout_hybridize_no_tracer_leak():
+    """Review regression: stepping a hybridized ZoneoutCell across batch
+    sizes must not leak tracers between traces."""
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=3),
+                           zoneout_outputs=0.5)
+    cell.initialize()
+    cell.hybridize()
+    with autograd.record():
+        for bs in (2, 3, 2):
+            out, _ = cell(mx.nd.ones((bs, 3)), cell.begin_state(bs))
+            assert out.shape == (bs, 4)

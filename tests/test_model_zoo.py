@@ -1,0 +1,82 @@
+"""Model zoo tests (model: reference tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def _fwd(name, shape=(1, 3, 224, 224), classes=10):
+    net = vision.get_model(name, classes=classes)
+    net.initialize()
+    out = net(mx.nd.array(np.random.randn(*shape).astype("float32")))
+    assert out.shape == (shape[0], classes), (name, out.shape)
+    return net
+
+
+def test_resnet_family_forward():
+    _fwd("resnet18_v1")
+    _fwd("resnet18_v2")
+
+
+def test_squeezenet_forward():
+    _fwd("squeezenet1.0")
+    _fwd("squeezenet1.1")
+
+
+def test_mobilenet_forward():
+    _fwd("mobilenet0.25")
+    _fwd("mobilenetv2_0.25")
+
+
+def test_alexnet_forward():
+    _fwd("alexnet")
+
+
+def test_inception_forward():
+    _fwd("inceptionv3", shape=(1, 3, 299, 299))
+
+
+def test_all_models_construct():
+    names = ["resnet34_v1", "resnet50_v1", "resnet101_v1", "resnet152_v1",
+             "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
+             "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg16_bn",
+             "densenet121", "densenet161", "densenet169", "densenet201",
+             "mobilenet1.0", "mobilenet0.5", "mobilenetv2_1.0",
+             "mobilenetv2_0.5"]
+    for name in names:
+        net = vision.get_model(name, classes=7)
+        assert len(net.collect_params()) > 0, name
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("resnet999_v9")
+
+
+def test_resnet_train_step():
+    net = vision.get_model("resnet18_v1", classes=4)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.randn(2, 3, 32, 32).astype("float32"))
+    y = mx.nd.array(np.array([0, 1], dtype="float32"))
+    with autograd.record():
+        L = loss_fn(net(x), y).mean()
+    L.backward()
+    trainer.step(2)
+    # at least one conv weight moved
+    p = net.features[0].weight
+    assert np.abs(p.grad().asnumpy()).sum() > 0
+
+
+def test_resnet_hybridize_matches_eager():
+    net = vision.get_model("resnet18_v2", classes=5)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(1, 3, 32, 32).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
