@@ -49,6 +49,7 @@ def __getattr__(name):
         "image": ".image",
         "kvstore": ".kvstore",
         "kv": ".kvstore",
+        "monitor": ".monitor",
         "parallel": ".parallel",
         "profiler": ".profiler",
         "test_utils": ".test_utils",

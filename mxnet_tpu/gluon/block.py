@@ -109,14 +109,20 @@ def _name_manager():
 # resolve to tracers through this thread-local (the CachedOp input binding).
 # ---------------------------------------------------------------------------
 
+_trace_counter = [0]
+
+
 class _TraceCtx:
-    __slots__ = ("param_arrays", "tracer_names", "aux_updates", "training")
+    __slots__ = ("param_arrays", "tracer_names", "aux_updates", "training",
+                 "seq")
 
     def __init__(self, param_arrays, training):
         self.param_arrays = param_arrays        # param full name -> tracer
         self.tracer_names = {id(v): k for k, v in param_arrays.items()}
         self.aux_updates = {}                   # param full name -> new value
         self.training = training
+        _trace_counter[0] += 1
+        self.seq = _trace_counter[0]            # unique per trace (no id reuse)
 
 
 _trace_state = threading.local()
@@ -606,7 +612,19 @@ class HybridBlock(Block):
                                 name="CachedOp(%s)" % block.name)
             return tree.tree_unflatten(out_td, out_nds)
 
-        return run
+        def profiled_run(leaves):
+            from .. import profiler as _profiler
+            if not _profiler.is_active("symbolic"):
+                return run(leaves)
+            with _profiler.op_timer("CachedOp(%s)" % block.name,
+                                    "cached_op"):
+                out = run(leaves)
+                for o in tree.tree_leaves(out):
+                    if isinstance(o, _nd.NDArray):
+                        o.wait_to_read()
+            return out
+
+        return profiled_run
 
     def hybrid_forward_entry(self, *args):
         """Entry point for tracing: dispatch through forward() so the whole

@@ -92,7 +92,10 @@ class DataLoader:
                 "specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
-        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        if prefetch is None:
+            prefetch = 2 * self._num_workers
+        # at least one batch must be in flight for the pool to make progress
+        self._prefetch = max(1 if self._num_workers else 0, int(prefetch))
         if batchify_fn is None:
             self._batchify_fn = default_mp_batchify_fn \
                 if self._num_workers > 0 else default_batchify_fn

@@ -496,8 +496,8 @@ class ZoneoutCell(ModifierCell):
             return F.Dropout(F.ones_like(like), p=p)
         # the remembered output is only valid within the same trace (or in
         # eager mode): a tracer from a finished jit trace must not leak in
-        trace_id = id(_current_trace()) if _current_trace() is not None \
-            else None
+        tctx = _current_trace()
+        trace_id = tctx.seq if tctx is not None else None
         prev_output = self._prev_output \
             if self._prev_trace == trace_id else None
         if prev_output is None:

@@ -1,0 +1,94 @@
+"""Monitor: per-op / per-parameter output statistics
+(parity: python/mxnet/monitor.py:33 — Monitor with install/tic/toc,
+stat_func, regex pattern, sort).
+
+The reference installs a callback on every executor op output. Here the
+equivalents are: ``install(exe)`` on a symbolic Executor (wraps forward to
+collect output stats) and ``tic()/toc()`` snapshots of any NDArray source
+— Gluon users pass blocks whose parameters are inspected."""
+from __future__ import annotations
+
+import re
+
+import numpy as _np
+
+from .ndarray import ndarray as _nd
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return _np.abs(x).mean()
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.blocks = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        """Attach to a symbolic Executor: collect output stats per forward."""
+        self.exes.append(exe)
+
+    def install_block(self, block):
+        """Gluon path: collect stats of a Block's parameters + outputs."""
+        self.blocks.append(block)
+
+        def hook(blk, inputs, outputs):
+            if not self.activated:
+                return
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
+            for i, o in enumerate(outs):
+                if isinstance(o, _nd.NDArray):
+                    name = "%s_output%d" % (blk.name, i)
+                    if self.re_prog.match(name):
+                        self.queue.append((self.step, name,
+                                           self.stat_func(o.asnumpy())))
+        block.register_forward_hook(hook)
+
+    def tic(self):
+        """Start collecting for this iteration."""
+        if self.step % self.interval == 0:
+            self.activated = True
+        self.queue = []
+
+    def toc(self):
+        """Finish the iteration; returns [(step, name, stat), ...]."""
+        if not self.activated:
+            self.step += 1
+            return []
+        for exe in self.exes:
+            for name, arr in zip(exe._symbol.list_outputs(), exe.outputs):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(arr.asnumpy())))
+            for name, arr in zip(exe.arg_names, exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(arr.asnumpy())))
+        for block in self.blocks:
+            for name, p in block.collect_params().items():
+                if p._data is not None and self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(p.data().asnumpy())))
+        self.activated = False
+        self.step += 1
+        res = self.queue
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for step, name, stat in res:
+            print("Batch: %7d %30s %s" % (step, name, str(stat)))
+        return res

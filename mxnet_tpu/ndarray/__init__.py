@@ -4,6 +4,7 @@ from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,
                       imperative_invoke)
 from . import register as _register
 from . import random
+from . import contrib
 from . import sparse
 from .sparse import csr_matrix, row_sparse_array
 

@@ -1,0 +1,193 @@
+"""Control-flow and contrib ndarray ops (parity:
+python/mxnet/ndarray/contrib.py — foreach/while_loop/cond backed by
+src/operator/control_flow.cc:1255/1316/1378 subgraph ops).
+
+TPU-native design: in eager mode these run as Python control flow over
+NDArrays (the reference's imperative semantics), fully differentiable
+through the tape. When the inputs are raw jax values (inside a hybridized
+trace), they lower to ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` so
+compiled graphs get real XLA control flow — the design SURVEY.md §7
+hard-part 4 calls for.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ndarray import NDArray, invoke
+
+__all__ = ["foreach", "while_loop", "cond", "isinf", "isnan", "isfinite"]
+
+
+def _is_nd(x):
+    if isinstance(x, NDArray):
+        return True
+    if isinstance(x, (list, tuple)):
+        return any(_is_nd(v) for v in x)
+    return False
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def foreach(body, data, init_states):
+    """Run body over data slices along axis 0, threading states
+    (reference contrib.foreach; symbolic analog `_foreach`
+    control_flow.cc:1255)."""
+    if _is_nd(data) or _is_nd(init_states):
+        return _foreach_eager(body, data, init_states)
+    return _foreach_lax(body, data, init_states)
+
+
+def _foreach_eager(body, data, init_states):
+    data_list, single_data = _as_list(data)
+    states, single_state = _as_list(init_states)
+    n = data_list[0].shape[0]
+    outputs = []
+    single_out = True
+    for i in range(n):
+        eles = [d[i] for d in data_list]
+        x = eles[0] if single_data else eles
+        st = states[0] if single_state else states
+        outs, new_st = body(x, st)
+        states, _ = _as_list(new_st)
+        outs, single_out = _as_list(outs)
+        outputs.append(outs)
+    stacked = [invoke("stack", [o[j] for o in outputs], {"axis": 0})
+               for j in range(len(outputs[0]))]
+    out = stacked[0] if single_out else stacked
+    fin = states[0] if single_state else states
+    return out, fin
+
+
+def _foreach_lax(body, data, init_states):
+    data_list, single_data = _as_list(data)
+    states, single_state = _as_list(init_states)
+
+    def step(carry, xs):
+        st = carry[0] if single_state else list(carry)
+        x = xs[0] if single_data else list(xs)
+        outs, new_st = body(x, st)
+        new_st = [new_st] if single_state and not isinstance(
+            new_st, (list, tuple)) else list(
+            new_st if isinstance(new_st, (list, tuple)) else [new_st])
+        outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+        return tuple(new_st), tuple(outs)
+
+    final, ys = lax.scan(step, tuple(states), tuple(data_list))
+    out = ys[0] if len(ys) == 1 else list(ys)
+    fin = final[0] if single_state else list(final)
+    return out, fin
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run func while cond(loop_vars) holds, up to max_iterations; step
+    outputs are stacked and padded to max_iterations (reference
+    contrib.while_loop / `_while_loop` control_flow.cc:1316)."""
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    if _is_nd(loop_vars):
+        return _while_eager(cond, func, loop_vars, max_iterations)
+    return _while_lax(cond, func, loop_vars, max_iterations)
+
+
+def _bool_of(x):
+    if isinstance(x, NDArray):
+        return bool(x.asscalar())
+    return bool(x)
+
+
+def _while_eager(cond, func, loop_vars, max_iterations):
+    loop_vars, single = _as_list(loop_vars)
+    steps = 0
+    outputs = []
+    out_fmt = None
+    while steps < max_iterations and _bool_of(
+            cond(*loop_vars)):
+        step_out, loop_vars = func(*loop_vars)
+        step_out, out_fmt_single = _as_list(step_out)
+        out_fmt = out_fmt_single
+        outputs.append(step_out)
+        if not isinstance(loop_vars, (list, tuple)):
+            loop_vars = [loop_vars]
+        else:
+            loop_vars = list(loop_vars)
+        steps += 1
+    if not outputs:
+        raise ValueError("while_loop produced no step output "
+                         "(condition false initially)")
+    # pad to max_iterations with zeros (reference semantics)
+    stacked = []
+    for j in range(len(outputs[0])):
+        arr = invoke("stack", [o[j] for o in outputs], {"axis": 0})
+        if steps < max_iterations:
+            pad_shape = (max_iterations - steps,) + arr.shape[1:]
+        else:
+            pad_shape = None
+        if pad_shape:
+            zeros = NDArray(jnp.zeros(pad_shape, arr.dtype))
+            arr = invoke("Concat", [arr, zeros], {"dim": 0})
+        stacked.append(arr)
+    out = stacked[0] if out_fmt else stacked
+    fin = loop_vars[0] if single else loop_vars
+    return out, fin
+
+
+def _while_lax(cond, func, loop_vars, max_iterations):
+    loop_vars, single = _as_list(loop_vars)
+    # discover step-output structure with eval_shape
+    out_shape = jax.eval_shape(lambda *vs: func(*vs)[0], *loop_vars)
+    out_list, out_single = _as_list(out_shape)
+    buffers = tuple(jnp.zeros((max_iterations,) + tuple(o.shape), o.dtype)
+                    for o in out_list)
+
+    def body_fn(carry):
+        i, vars_, bufs = carry
+        step_out, new_vars = func(*vars_)
+        step_out, _ = _as_list(step_out)
+        new_vars = list(new_vars) if isinstance(new_vars, (list, tuple)) \
+            else [new_vars]
+        bufs = tuple(
+            lax.dynamic_update_slice(b, o[None].astype(b.dtype),
+                                     (i,) + (0,) * o.ndim)
+            for b, o in zip(bufs, step_out))
+        return i + 1, tuple(new_vars), bufs
+
+    def cond_fn(carry):
+        i, vars_, _ = carry
+        return jnp.logical_and(i < max_iterations,
+                               jnp.squeeze(cond(*vars_)).astype(bool))
+
+    i, final_vars, bufs = lax.while_loop(
+        cond_fn, body_fn, (jnp.int32(0), tuple(loop_vars), buffers))
+    out = bufs[0] if out_single else list(bufs)
+    fin = final_vars[0] if single else list(final_vars)
+    return out, fin
+
+
+def cond(pred, then_func, else_func):
+    """Evaluate then_func() or else_func() based on pred (reference
+    contrib.cond / `_cond` control_flow.cc:1378)."""
+    if isinstance(pred, NDArray):
+        return then_func() if _bool_of(pred) else else_func()
+    return lax.cond(jnp.squeeze(pred).astype(bool),
+                    lambda _: then_func(), lambda _: else_func(), None)
+
+
+def isinf(data):
+    return invoke("abs", [data], {}) == float("inf")
+
+
+def isnan(data):
+    return data != data
+
+
+def isfinite(data):
+    import numpy as _np
+    fin = invoke("abs", [data], {}) != float("inf")
+    notnan = (data == data)
+    return fin * notnan

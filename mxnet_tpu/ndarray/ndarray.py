@@ -473,6 +473,10 @@ def _norm_index(key):
 # ---------------------------------------------------------------------------
 
 def invoke(op_name, inputs, params, out=None):
+    from .. import profiler as _profiler
+    _prof = _profiler._active and _profiler._state.profile_imperative
+    if _prof:
+        _prof_t0 = _profiler._now_us()
     op = _registry.get(op_name)
     params = {k: v for k, v in params.items() if v is not None or k in ("axis",)}
     # explicit device placement for no-input ops (creation/random): reference
@@ -549,6 +553,17 @@ def invoke(op_name, inputs, params, out=None):
 
     out_nds = [NDArray(d, ctx=ctx) for d in outs_data]
     _engine.sync_point([d for d in outs_data])
+    if _prof:
+        # profiling measures to completion (the reference's engine events
+        # cover kernel execution, not just dispatch)
+        for d in outs_data:
+            if hasattr(d, "block_until_ready"):
+                try:
+                    d.block_until_ready()
+                except Exception:
+                    pass
+        _profiler.record_event(op_name, "operator", _prof_t0,
+                               _profiler._now_us() - _prof_t0)
 
     if recording:
         _autograd.record_op(vjp_fn, [inputs[i] for i in diff_idx], out_nds,

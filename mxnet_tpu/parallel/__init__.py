@@ -8,3 +8,5 @@ insert collectives over ICI/DCN.
 """
 from .mesh import make_mesh, data_parallel_sharding, replicated
 from .spmd import SPMDTrainStep
+from .ring_attention import (blockwise_attention, ring_attention,
+                             make_ring_attention, attention_reference)

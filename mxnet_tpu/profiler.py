@@ -1,0 +1,279 @@
+"""Profiler (parity: python/mxnet/profiler.py over src/profiler/ —
+chrome://tracing JSON dump, aggregate per-op stats, pause/resume, custom
+Task/Frame/Event/Counter/Marker objects).
+
+TPU-native design: the reference hooks each engine OprBlock
+(src/engine/threaded_engine.h:80). Here the analogs are the eager invoke
+path (one event per op, measured to completion — profiling forces a sync
+like MXNET_PROFILER on a stream does), the CachedOp jitted runner and the
+symbolic Executor (one event per compiled graph execution), plus
+device-side XLA traces via ``jax.profiler`` when a trace dir is configured.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "dump", "dumps", "pause", "resume",
+           "Task", "Frame", "Event", "Counter", "Marker"]
+
+_lock = threading.Lock()
+
+
+class _ProfilerState:
+    def __init__(self):
+        self.running = False
+        self.filename = "profile.json"
+        self.aggregate_stats = False
+        self.profile_imperative = True
+        self.profile_symbolic = True
+        self.profile_memory = False
+        self.profile_api = False
+        self.trace_dir = None       # jax.profiler XLA trace output
+        self.events = []            # chrome trace events
+        self.agg = {}               # name -> [count, total_us, min, max]
+        self.epoch = time.monotonic()
+
+
+_state = _ProfilerState()
+_active = False  # fast-path flag read by the dispatch hooks
+
+
+def _now_us():
+    return (time.monotonic() - _state.epoch) * 1e6
+
+
+def set_config(**kwargs):
+    """Configure (reference profiler.py set_config :33-151). Accepts
+    filename, profile_all, profile_symbolic, profile_imperative,
+    profile_memory, profile_api, aggregate_stats, continuous_dump (ignored),
+    trace_dir (XLA device trace)."""
+    if kwargs.pop("profile_all", False):
+        _state.profile_symbolic = True
+        _state.profile_imperative = True
+        _state.profile_memory = True
+        _state.profile_api = True
+    _state.filename = kwargs.pop("filename", _state.filename)
+    _state.aggregate_stats = kwargs.pop("aggregate_stats",
+                                        _state.aggregate_stats)
+    _state.profile_symbolic = kwargs.pop("profile_symbolic",
+                                         _state.profile_symbolic)
+    _state.profile_imperative = kwargs.pop("profile_imperative",
+                                           _state.profile_imperative)
+    _state.profile_memory = kwargs.pop("profile_memory",
+                                       _state.profile_memory)
+    _state.profile_api = kwargs.pop("profile_api", _state.profile_api)
+    _state.trace_dir = kwargs.pop("trace_dir", _state.trace_dir)
+    kwargs.pop("continuous_dump", None)
+    if kwargs:
+        raise ValueError("unknown profiler config keys: %s"
+                         % sorted(kwargs))
+
+
+profiler_set_config = set_config
+
+
+def set_state(state="stop"):
+    """'run' or 'stop' (reference set_state)."""
+    global _active
+    assert state in ("run", "stop")
+    run = state == "run"
+    if run and not _state.running and _state.trace_dir:
+        import jax
+        jax.profiler.start_trace(_state.trace_dir)
+    if not run and _state.running and _state.trace_dir:
+        import jax
+        jax.profiler.stop_trace()
+    _state.running = run
+    _active = run
+
+
+profiler_set_state = set_state
+
+
+def pause():
+    global _active
+    _active = False
+
+
+def resume():
+    global _active
+    _active = _state.running
+
+
+def record_event(name, cat, start_us, dur_us, tid=0):
+    """Internal: called by dispatch hooks."""
+    with _lock:
+        _state.events.append({"name": name, "cat": cat, "ph": "X",
+                              "ts": start_us, "dur": dur_us, "pid": 0,
+                              "tid": tid})
+        if _state.aggregate_stats:
+            ent = _state.agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+            ent[0] += 1
+            ent[1] += dur_us
+            ent[2] = min(ent[2], dur_us)
+            ent[3] = max(ent[3], dur_us)
+
+
+class _OpTimer:
+    """Context manager used by the invoke/CachedOp hooks."""
+
+    __slots__ = ("name", "cat", "arrays", "t0")
+
+    def __init__(self, name, cat, arrays=None):
+        self.name = name
+        self.cat = cat
+        self.arrays = arrays
+
+    def __enter__(self):
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if self.arrays:
+            for a in self.arrays():
+                if hasattr(a, "block_until_ready"):
+                    try:
+                        a.block_until_ready()
+                    except Exception:
+                        pass
+        record_event(self.name, self.cat, self.t0, _now_us() - self.t0)
+
+
+def is_active(kind="imperative"):
+    if not _active:
+        return False
+    if kind == "imperative":
+        return _state.profile_imperative
+    if kind == "symbolic":
+        return _state.profile_symbolic
+    return True
+
+
+def op_timer(name, cat="operator", result_arrays=None):
+    return _OpTimer(name, cat, result_arrays)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the chrome://tracing JSON file."""
+    with _lock:
+        trace = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 0,
+                 "args": {"name": "mxnet_tpu worker"}}] + _state.events,
+            "displayTimeUnit": "ms",
+        }
+        with open(_state.filename, "w") as f:
+            json.dump(trace, f)
+        if finished:
+            _state.events = []
+    return _state.filename
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats as text (reference MXAggregateProfileStatsPrintEx)."""
+    with _lock:
+        lines = ["Profile Statistics:",
+                 "%-40s %10s %14s %14s %14s %14s" % (
+                     "Name", "Calls", "Total(us)", "Avg(us)", "Min(us)",
+                     "Max(us)")]
+        if sort_by == "avg":
+            def sort_key(kv):
+                return kv[1][1] / max(kv[1][0], 1)
+        else:
+            key_idx = {"total": 1, "min": 2, "max": 3,
+                       "count": 0}.get(sort_by, 1)
+
+            def sort_key(kv):
+                return kv[1][key_idx]
+        items = sorted(_state.agg.items(), key=sort_key,
+                       reverse=not ascending)
+        for name, (count, total, mn, mx) in items:
+            lines.append("%-40s %10d %14.1f %14.1f %14.1f %14.1f" % (
+                name[:40], count, total, total / max(count, 1), mn, mx))
+        if reset:
+            _state.agg = {}
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# User-defined profiling objects (reference profiler.py Task/Frame/Event/...)
+# ---------------------------------------------------------------------------
+
+class _Span:
+    def __init__(self, name, cat):
+        self.name = name
+        self._cat = cat
+        self._t0 = None
+
+    def start(self):
+        self._t0 = _now_us()
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        record_event(self.name, self._cat, self._t0, _now_us() - self._t0)
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Span):
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name, "task")
+
+
+class Frame(_Span):
+    def __init__(self, domain=None, name="frame"):
+        super().__init__(name, "frame")
+
+
+class Event(_Span):
+    def __init__(self, name="event"):
+        super().__init__(name, "event")
+
+
+class Counter:
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self._value = value
+
+    def set_value(self, value):
+        self._value = value
+        with _lock:
+            _state.events.append({"name": self.name, "ph": "C",
+                                  "ts": _now_us(), "pid": 0,
+                                  "args": {self.name: value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+
+    def mark(self, scope="process"):
+        with _lock:
+            _state.events.append({"name": self.name, "ph": "i",
+                                  "ts": _now_us(), "pid": 0, "tid": 0,
+                                  "s": "p" if scope == "process" else "t"})

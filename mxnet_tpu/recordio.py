@@ -204,7 +204,9 @@ def pack(header, s):
     import numbers
     header = IRHeader(*header)
     if isinstance(header.label, numbers.Number):
-        head = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+        # scalar labels always write flag=0 (reference recordio.py pack);
+        # flag>0 means "label array of that many floats follows"
+        head = struct.pack(_IR_FORMAT, 0, header.label, header.id,
                            header.id2)
     else:
         label = _np.asarray(header.label, dtype=_np.float32).reshape(-1)

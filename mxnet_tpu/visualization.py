@@ -1,0 +1,128 @@
+"""Network visualization (parity: python/mxnet/visualization.py —
+print_summary over a Symbol, plot_network via graphviz when available)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a per-node table of a Symbol graph with params + output shapes
+    (reference visualization.py print_summary)."""
+    if positions is None:
+        positions = [0.44, 0.64, 0.74, 1.0]
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {h[0] for h in conf.get("heads", [])}
+    shape_dict = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        arg_shapes, out_shapes, aux_shapes = \
+            internals.infer_shape_partial(**shape)
+        for name, s in zip(symbol.list_arguments(), arg_shapes):
+            shape_dict[name] = s
+        for name, s in zip(internals.list_outputs(), out_shapes):
+            shape_dict[name] = s
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    lines = ["_" * line_length, _row(to_display, positions),
+             "=" * line_length]
+    total_params = 0
+
+    input_names = set(shape or {})
+
+    def param_count(node):
+        name = node["name"]
+        if node["op"] != "null" or name in input_names \
+                or name.endswith("_label"):
+            return 0  # data/label inputs are not parameters
+        s = shape_dict.get(name)
+        if s is None:
+            return 0
+        n = 1
+        for d in s:
+            n *= d
+        return n
+
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue  # params are accounted to their consumer
+        n_params = 0
+        prevs = []
+        for in_idx in node.get("inputs", []):
+            prev = nodes[in_idx[0]]
+            if prev["op"] == "null":
+                n_params += param_count(prev)
+                continue
+            prevs.append(prev["name"])
+        total_params += n_params
+        out_shape = shape_dict.get(name + "_output",
+                                   shape_dict.get(name, ""))
+        lines.append(_row(["%s (%s)" % (name, op), str(out_shape),
+                           str(n_params), ",".join(prevs)], positions))
+    lines.append("=" * line_length)
+    lines.append("Total params: %d" % total_params)
+    lines.append("_" * line_length)
+    text = "\n".join(lines)
+    print(text)
+    return text
+
+
+def _row(fields, positions):
+    line = ""
+    for field, pos in zip(fields, positions):
+        line += str(field)
+        line = line[:pos - 1]
+        line += " " * (pos - len(line))
+    return line
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Return a graphviz Digraph of the symbol graph. Falls back to a text
+    edge list object when graphviz is unavailable (this image has no
+    graphviz python package by default)."""
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    edges = []
+    for i, node in enumerate(nodes):
+        for in_idx in node.get("inputs", []):
+            src = nodes[in_idx[0]]
+            if hide_weights and src["op"] == "null" and \
+                    src["name"] != "data":
+                continue
+            edges.append((src["name"], node["name"]))
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        class _TextGraph:
+            def __init__(self, edges, nodes):
+                self.edges = edges
+                self.nodes = [n["name"] for n in nodes]
+
+            def render(self, *a, **k):
+                raise RuntimeError("graphviz not installed")
+
+            def __repr__(self):
+                return "digraph {\n" + "\n".join(
+                    '  "%s" -> "%s";' % e for e in self.edges) + "\n}"
+        return _TextGraph(edges, nodes)
+    dot = Digraph(name=title)
+    seen = set()
+    for node in nodes:
+        if hide_weights and node["op"] == "null" and \
+                node["name"] != "data":
+            continue
+        label = node["name"] if node["op"] == "null" else \
+            "%s\n%s" % (node["op"], node["name"])
+        dot.node(node["name"], label=label)
+        seen.add(node["name"])
+    for src, dst in edges:
+        if src in seen and dst in seen:
+            dot.edge(src, dst)
+    return dot

@@ -1,0 +1,128 @@
+"""Control-flow op tests
+(model: reference tests/python/unittest/test_contrib_control_flow.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import contrib
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_foreach_cumsum():
+    def body(x, state):
+        new = state + x
+        return new, new
+    data = mx.nd.array(np.arange(5, dtype="float32"))
+    init = mx.nd.array(np.array([0.0], dtype="float32"))
+    outs, final = contrib.foreach(body, data, init)
+    assert_almost_equal(outs.asnumpy().reshape(-1),
+                        np.cumsum(np.arange(5)).astype("float32"))
+    assert float(final.asscalar()) == 10.0
+
+
+def test_foreach_multi_state():
+    def body(x, states):
+        s0, s1 = states
+        return x + s0, [s0 + 1, s1 * 2]
+    data = mx.nd.array(np.ones((3, 2), dtype="float32"))
+    outs, (f0, f1) = contrib.foreach(
+        body, data, [mx.nd.zeros((2,)), mx.nd.ones((2,))])
+    assert outs.shape == (3, 2)
+    assert float(f0[0].asscalar()) == 3.0
+    assert float(f1[0].asscalar()) == 8.0
+
+
+def test_foreach_grad():
+    w = mx.nd.array(np.array([2.0], dtype="float32"))
+    w.attach_grad()
+    data = mx.nd.array(np.arange(1, 4, dtype="float32"))
+    with autograd.record():
+        def body(x, state):
+            out = x * w
+            return out, state + out
+        outs, final = contrib.foreach(body, data,
+                                      mx.nd.zeros((1,)))
+        loss = final.sum()
+    loss.backward()
+    # d(sum w*x)/dw = sum x = 6
+    assert float(w.grad.asscalar()) == 6.0
+
+
+def test_while_loop_eager():
+    def cond(i, s):
+        return i < 4
+    def func(i, s):
+        return i * 2, [i + 1, s + i]
+    outs, (i_fin, s_fin) = contrib.while_loop(
+        cond, func,
+        [mx.nd.array([0.0]), mx.nd.array([0.0])], max_iterations=6)
+    assert outs.shape == (6, 1)  # padded to max_iterations
+    assert_almost_equal(outs.asnumpy()[:4, 0],
+                        np.array([0, 2, 4, 6], dtype="float32"))
+    assert float(i_fin.asscalar()) == 4.0
+    assert float(s_fin.asscalar()) == 6.0
+
+
+def test_cond_eager():
+    x = mx.nd.array([3.0])
+    out = contrib.cond(x.sum() > 2,
+                       lambda: x * 2,
+                       lambda: x - 1)
+    assert float(out.asscalar()) == 6.0
+    out = contrib.cond(x.sum() > 5,
+                       lambda: x * 2,
+                       lambda: x - 1)
+    assert float(out.asscalar()) == 2.0
+
+
+def test_foreach_lax_inside_jit():
+    """Traced path lowers to lax.scan inside a compiled function."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(data):
+        def body(x, s):
+            n = s + x
+            return n, n
+        outs, fin = contrib.foreach(body, data, jnp.zeros((1,)))
+        return outs, fin
+    outs, fin = run(jnp.arange(4, dtype=jnp.float32).reshape(4, 1))
+    assert np.allclose(np.asarray(outs).reshape(-1), [0, 1, 3, 6])
+    assert float(np.asarray(fin)[0]) == 6.0
+
+
+def test_while_loop_lax_inside_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(start):
+        def cond(i, s):
+            return i < 3
+        def func(i, s):
+            return s * 1.0, [i + 1, s + 2.0]
+        return contrib.while_loop(cond, func, [start, jnp.zeros(())],
+                                  max_iterations=5)
+    outs, (i_fin, s_fin) = run(jnp.zeros((), jnp.int32))
+    assert np.asarray(outs).shape == (5,)
+    assert np.allclose(np.asarray(outs)[:3], [0, 2, 4])
+    assert int(i_fin) == 3
+
+
+def test_cond_lax_inside_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(x):
+        return contrib.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+    assert np.allclose(np.asarray(run(jnp.ones(3))), [2, 2, 2])
+    assert np.allclose(np.asarray(run(-jnp.ones(3))), [-2, -2, -2])
+
+
+def test_isnan_isinf():
+    x = mx.nd.array(np.array([1.0, np.inf, np.nan], dtype="float32"))
+    assert list(contrib.isnan(x).asnumpy()) == [0, 0, 1]
+    assert list(contrib.isinf(x).asnumpy()) == [0, 1, 0]
+    assert list(contrib.isfinite(x).asnumpy()) == [1, 0, 0]

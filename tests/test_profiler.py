@@ -1,0 +1,128 @@
+"""Profiler / monitor / visualization tests
+(model: reference tests/python/unittest/test_profiler.py)."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, monitor, profiler, visualization
+from mxnet_tpu.gluon import nn
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fname, profile_all=True,
+                        aggregate_stats=True)
+    profiler.set_state("run")
+    a = mx.nd.ones((16, 16))
+    mx.nd.invoke("dot", [a, a], {})
+    (a * 3).sum()
+    profiler.set_state("stop")
+    out = profiler.dump()
+    trace = json.load(open(out))
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "dot" in names
+    assert "_mul_scalar" in names
+    assert all("ts" in e for e in trace["traceEvents"] if e.get("ph") == "X")
+
+
+def test_profiler_aggregate_stats(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        aggregate_stats=True)
+    profiler.set_state("run")
+    a = mx.nd.ones((8,))
+    for _ in range(3):
+        a + a
+    profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert "broadcast_add" in table
+    line = [ln for ln in table.splitlines() if "broadcast_add" in ln][0]
+    assert int(line.split()[1]) >= 3  # call count
+
+
+def test_profiler_cached_op_events(tmp_path):
+    fname = str(tmp_path / "c.json")
+    profiler.set_config(filename=fname)
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+    profiler.set_state("run")
+    net(mx.nd.ones((2, 3)))
+    profiler.set_state("stop")
+    trace = json.load(open(profiler.dump()))
+    assert any("CachedOp" in str(e.get("name"))
+               for e in trace["traceEvents"])
+
+
+def test_profiler_pause_resume(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "pr.json"),
+                        aggregate_stats=True)
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    profiler.pause()
+    mx.nd.ones((4,)) + 1
+    profiler.resume()
+    mx.nd.ones((4,)) * 2
+    profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert "_plus_scalar" not in table
+    assert "_mul_scalar" in table
+
+
+def test_profiler_custom_objects(tmp_path):
+    fname = str(tmp_path / "obj.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    with profiler.Task(name="mytask"):
+        pass
+    c = profiler.Counter(name="ctr")
+    c += 2
+    profiler.Marker(name="mk").mark()
+    profiler.set_state("stop")
+    trace = json.load(open(profiler.dump()))
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert {"mytask", "ctr", "mk"} <= names
+
+
+def test_monitor_block():
+    mon = monitor.Monitor(1, pattern=".*weight")
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    mon.install_block(net)
+    mon.tic()
+    net(mx.nd.ones((2, 3)))
+    res = mon.toc()
+    assert len(res) == 1 and "weight" in res[0][1]
+    # interval: every other step inactive
+    mon2 = monitor.Monitor(2, pattern=".*")
+    mon2.install_block(net)
+    mon2.tic(); net(mx.nd.ones((2, 3))); r0 = mon2.toc()
+    mon2.tic(); net(mx.nd.ones((2, 3))); r1 = mon2.toc()
+    assert len(r0) > 0 and len(r1) == 0
+
+
+def test_monitor_executor():
+    from mxnet_tpu import symbol as sym
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    exe = net.bind(ctx=mx.cpu(), args={
+        "data": mx.nd.ones((2, 3)),
+        "fc_weight": mx.nd.ones((4, 3)),
+        "fc_bias": mx.nd.zeros((4,))})
+    mon = monitor.Monitor(1, pattern=".*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    res = mon.toc()
+    assert any("fc" in name for _, name, _ in res)
+
+
+def test_print_summary_and_plot():
+    from mxnet_tpu import symbol as sym
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    text = visualization.print_summary(net, shape={"data": (1, 20)})
+    assert "fc1" in text and "Total params: 210" in text
+    g = visualization.plot_network(net)
+    assert g is not None
