@@ -109,7 +109,9 @@ def _name_manager():
 # resolve to tracers through this thread-local (the CachedOp input binding).
 # ---------------------------------------------------------------------------
 
-_trace_counter = [0]
+import itertools as _itertools
+
+_trace_counter = _itertools.count(1)  # next() is atomic at the C level
 
 
 class _TraceCtx:
@@ -121,8 +123,7 @@ class _TraceCtx:
         self.tracer_names = {id(v): k for k, v in param_arrays.items()}
         self.aux_updates = {}                   # param full name -> new value
         self.training = training
-        _trace_counter[0] += 1
-        self.seq = _trace_counter[0]            # unique per trace (no id reuse)
+        self.seq = next(_trace_counter)         # unique per trace
 
 
 _trace_state = threading.local()

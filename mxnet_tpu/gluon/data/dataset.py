@@ -132,16 +132,45 @@ class ArrayDataset(Dataset):
 
 class RecordFileDataset(Dataset):
     """Dataset over a RecordIO (.rec) file with a .idx index
-    (reference dataset.py RecordFileDataset over MXIndexedRecordIO)."""
+    (reference dataset.py RecordFileDataset over MXIndexedRecordIO).
+
+    Uses the native zero-copy scanner (src/recordio.cc) when the C++
+    runtime is available; falls back to the pure-python reader."""
 
     def __init__(self, filename):
         from ... import recordio
         self._filename = filename
         idx_file = os.path.splitext(filename)[0] + ".idx"
         self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+        # the native scanner indexes records in FILE order; only use it when
+        # the .idx enumerates exactly that order (a shuffled/subset idx must
+        # take the seek-based path or items would silently permute)
+        self._native = None
+        try:
+            from ... import runtime
+            if runtime.available():
+                native = runtime.NativeRecordReader(filename)
+                offs = [self._record.idx[k] for k in self._record.keys]
+                if len(native) == len(offs) and \
+                        all(a < b for a, b in zip(offs, offs[1:])):
+                    self._native = native
+                else:
+                    native.close()
+        except Exception:
+            self._native = None
 
     def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        if self._native is not None:
+            return self._native[idx]
         return self._record.read_idx(self._record.keys[idx])
 
     def __len__(self):
         return len(self._record.keys)
+
+    def __getstate__(self):
+        # native handle is not picklable; workers reopen lazily
+        d = dict(self.__dict__)
+        d["_native"] = None
+        return d

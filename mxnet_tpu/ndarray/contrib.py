@@ -67,19 +67,21 @@ def _foreach_eager(body, data, init_states):
 def _foreach_lax(body, data, init_states):
     data_list, single_data = _as_list(data)
     states, single_state = _as_list(init_states)
+    single_out = {}  # filled while tracing the first step
 
     def step(carry, xs):
         st = carry[0] if single_state else list(carry)
         x = xs[0] if single_data else list(xs)
         outs, new_st = body(x, st)
-        new_st = [new_st] if single_state and not isinstance(
-            new_st, (list, tuple)) else list(
-            new_st if isinstance(new_st, (list, tuple)) else [new_st])
-        outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+        new_st, _ = _as_list(new_st)
+        outs, so = _as_list(outs)
+        single_out["v"] = so
         return tuple(new_st), tuple(outs)
 
     final, ys = lax.scan(step, tuple(states), tuple(data_list))
-    out = ys[0] if len(ys) == 1 else list(ys)
+    # unwrap by the body's actual output structure (same rule as the eager
+    # path), not by element count
+    out = ys[0] if single_out["v"] else list(ys)
     fin = final[0] if single_state else list(final)
     return out, fin
 
@@ -118,8 +120,17 @@ def _while_eager(cond, func, loop_vars, max_iterations):
             loop_vars = list(loop_vars)
         steps += 1
     if not outputs:
-        raise ValueError("while_loop produced no step output "
-                         "(condition false initially)")
+        # zero iterations: return zero-filled padded outputs, matching the
+        # lax path's buffers; discover the step-output structure abstractly
+        out_abs = jax.eval_shape(lambda *vs: func(*vs)[0],
+                                 *[jnp.zeros(v.shape, v.dtype)
+                                   for v in loop_vars])
+        out_list, out_single = _as_list(out_abs)
+        zeros = [NDArray(jnp.zeros((max_iterations,) + tuple(o.shape),
+                                   o.dtype)) for o in out_list]
+        out = zeros[0] if out_single else zeros
+        fin = loop_vars[0] if single else loop_vars
+        return out, fin
     # pad to max_iterations with zeros (reference semantics)
     stacked = []
     for j in range(len(outputs[0])):

@@ -1,0 +1,275 @@
+// Host-side dependency engine.
+//
+// TPU-native role: PJRT already schedules device work asynchronously, so the
+// device half of the reference's ThreadedEngine (src/engine/threaded_engine.h
+// :269, threaded_engine_perdevice.cc) collapses into buffer futures. What the
+// host still needs — and what this engine provides — is the reference's
+// var-serialized async scheduling for HOST work: IO prefetch, custom python
+// ops (src/operator/custom/custom-inl.h:50 runs these on a dedicated worker),
+// checkpoint writes. Semantics match include/mxnet/engine.h: NewVariable,
+// PushAsync(fn, const_vars, mutable_vars), WaitForVar, WaitForAll; reads on a
+// var run concurrently, writes serialize against all earlier ops, and ops
+// never run before their dependencies — the invariant the reference's
+// tests/cpp/engine/threaded_engine_test.cc stresses.
+//
+// Exposed as a flat C ABI (the reference's L4 discipline) consumed from
+// python via ctypes (mxnet_tpu/runtime.py).
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef void (*EngineFn)(void* arg);
+}
+
+namespace mxtpu {
+
+struct Opr;
+
+struct VarRecord {
+  Opr* opr;
+  bool write;
+};
+
+struct Var {
+  std::deque<VarRecord> queue;  // ops waiting for this var, FIFO
+  int active_readers = 0;
+  bool active_writer = false;
+  bool alive = true;
+};
+
+struct Opr {
+  EngineFn fn;
+  void* arg;
+  std::vector<int64_t> const_vars;
+  std::vector<int64_t> mut_vars;
+  int wait = 0;  // vars that have not yet granted this op
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) : shutdown_(false), pending_(0) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i) {
+      workers_.emplace_back([this]() { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  int64_t NewVariable() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    vars_.emplace(id, Var());
+    return id;
+  }
+
+  void DeleteVariable(int64_t id) {
+    // deletion is itself a write op: runs after all users finish
+    // (reference Engine::DeleteVariable, include/mxnet/engine.h:220)
+    int64_t vid = id;
+    Engine* self = this;
+    struct DelCtx { Engine* e; int64_t v; };
+    auto* ctx = new DelCtx{self, vid};
+    PushAsync(
+        [](void* a) {
+          auto* c = static_cast<DelCtx*>(a);
+          std::unique_lock<std::mutex> lk(c->e->mu_);
+          auto it = c->e->vars_.find(c->v);
+          if (it != c->e->vars_.end()) it->second.alive = false;
+          delete c;
+        },
+        ctx, nullptr, 0, &vid, 1);
+  }
+
+  void PushAsync(EngineFn fn, void* arg, const int64_t* cvars, int n_const,
+                 const int64_t* mvars, int n_mut) {
+    Opr* opr = new Opr();
+    opr->fn = fn;
+    opr->arg = arg;
+    for (int i = 0; i < n_const; ++i) opr->const_vars.push_back(cvars[i]);
+    for (int i = 0; i < n_mut; ++i) opr->mut_vars.push_back(mvars[i]);
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      ++pending_;
+      opr->wait = static_cast<int>(opr->const_vars.size() +
+                                   opr->mut_vars.size());
+      if (opr->wait == 0) {
+        ready_.push(opr);
+        ready_cv_.notify_one();
+      } else {
+        for (int64_t v : opr->const_vars)
+          vars_[v].queue.push_back({opr, false});
+        for (int64_t v : opr->mut_vars)
+          vars_[v].queue.push_back({opr, true});
+        for (int64_t v : opr->const_vars) TryGrant(v);
+        for (int64_t v : opr->mut_vars) TryGrant(v);
+      }
+    }
+  }
+
+  void WaitForVar(int64_t id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this, id]() {
+      auto it = vars_.find(id);
+      if (it == vars_.end()) return true;
+      const Var& v = it->second;
+      return v.queue.empty() && v.active_readers == 0 && !v.active_writer;
+    });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this]() { return pending_ == 0; });
+  }
+
+  int PendingCount() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return pending_;
+  }
+
+ private:
+  // Grant queued ops on var v while the head of the queue can run:
+  // consecutive reads run together; a write runs exclusively. Called with
+  // mu_ held.
+  void TryGrant(int64_t vid) {
+    Var& v = vars_[vid];
+    while (!v.queue.empty()) {
+      VarRecord& head = v.queue.front();
+      if (head.write) {
+        if (v.active_readers > 0 || v.active_writer) break;
+        v.active_writer = true;
+        Opr* o = head.opr;
+        v.queue.pop_front();
+        Granted(o);
+      } else {
+        if (v.active_writer) break;
+        ++v.active_readers;
+        Opr* o = head.opr;
+        v.queue.pop_front();
+        Granted(o);
+      }
+    }
+  }
+
+  // Erase a deleted variable once nothing references it anymore (called
+  // with mu_ held) — prevents the unbounded vars_ growth of a
+  // var-per-iteration usage pattern.
+  void MaybeErase(int64_t vid) {
+    auto it = vars_.find(vid);
+    if (it == vars_.end()) return;
+    const Var& v = it->second;
+    if (!v.alive && v.queue.empty() && v.active_readers == 0 &&
+        !v.active_writer) {
+      vars_.erase(it);
+    }
+  }
+
+  void Granted(Opr* o) {
+    if (--o->wait == 0) {
+      ready_.push(o);
+      ready_cv_.notify_one();
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* opr = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        ready_cv_.wait(lk, [this]() { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        opr = ready_.front();
+        ready_.pop();
+      }
+      opr->fn(opr->arg);
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        for (int64_t vid : opr->const_vars) {
+          auto it = vars_.find(vid);
+          if (it == vars_.end()) continue;
+          --it->second.active_readers;
+          TryGrant(vid);
+          MaybeErase(vid);
+        }
+        for (int64_t vid : opr->mut_vars) {
+          auto it = vars_.find(vid);
+          if (it == vars_.end()) continue;
+          it->second.active_writer = false;
+          TryGrant(vid);
+          MaybeErase(vid);
+        }
+        --pending_;
+      }
+      delete opr;
+      done_cv_.notify_all();
+    }
+  }
+
+  friend struct DelHelper;
+
+ public:
+  std::mutex mu_;
+  std::unordered_map<int64_t, Var> vars_;
+
+ private:
+  std::queue<Opr*> ready_;
+  std::condition_variable ready_cv_;
+  std::condition_variable done_cv_;
+  std::vector<std::thread> workers_;
+  bool shutdown_;
+  int pending_;
+  int64_t next_var_ = 1;
+};
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void* EngineCreate(int num_workers) {
+  return new mxtpu::Engine(num_workers);
+}
+
+void EngineDestroy(void* h) { delete static_cast<mxtpu::Engine*>(h); }
+
+int64_t EngineNewVariable(void* h) {
+  return static_cast<mxtpu::Engine*>(h)->NewVariable();
+}
+
+void EngineDeleteVariable(void* h, int64_t v) {
+  static_cast<mxtpu::Engine*>(h)->DeleteVariable(v);
+}
+
+void EnginePushAsync(void* h, EngineFn fn, void* arg, const int64_t* cvars,
+                     int n_const, const int64_t* mvars, int n_mut) {
+  static_cast<mxtpu::Engine*>(h)->PushAsync(fn, arg, cvars, n_const, mvars,
+                                            n_mut);
+}
+
+void EngineWaitForVar(void* h, int64_t v) {
+  static_cast<mxtpu::Engine*>(h)->WaitForVar(v);
+}
+
+void EngineWaitForAll(void* h) {
+  static_cast<mxtpu::Engine*>(h)->WaitForAll();
+}
+
+int EnginePendingCount(void* h) {
+  return static_cast<mxtpu::Engine*>(h)->PendingCount();
+}
+
+}  // extern "C"

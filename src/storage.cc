@@ -1,0 +1,150 @@
+// Pooled host storage manager.
+//
+// Parity: the reference's per-device caching allocators
+// (src/storage/pooled_storage_manager.h:51 GPUPooledStorageManager,
+// cpu_shared_storage_manager.h). Device (HBM) memory on TPU is owned by
+// PJRT/XLA, so the native allocator's remaining job is HOST memory: staging
+// buffers for infeed, decoded-image batches, checkpoint serialization.
+// Strategy mirrors the reference's pow2-rounding pool
+// (MXNET_GPU_MEM_POOL_TYPE=Round): freed blocks are kept in size-class free
+// lists and reused, eliminating malloc/free churn in the data pipeline.
+//
+// C ABI consumed via ctypes (mxnet_tpu/runtime.py).
+
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+class StoragePool {
+ public:
+  explicit StoragePool(size_t reserve_limit = 0)
+      : limit_(reserve_limit), pooled_bytes_(0), used_bytes_(0) {}
+
+  ~StoragePool() { ReleaseAll(); }
+
+  void* Alloc(size_t size) {
+    size_t cls = RoundSize(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = free_.find(cls);
+      if (it != free_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= cls;
+        used_bytes_ += cls;
+        sizes_[p] = cls;
+        return p;
+      }
+    }
+    void* p = std::malloc(cls);
+    if (p == nullptr) return nullptr;
+    std::lock_guard<std::mutex> lk(mu_);
+    sizes_[p] = cls;
+    used_bytes_ += cls;
+    return p;
+  }
+
+  void Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;  // not ours / double free: no-op
+    size_t cls = it->second;
+    used_bytes_ -= cls;
+    // drop the live-block entry so a double Free is detected above;
+    // Alloc re-registers the size when the pooled block is reused
+    sizes_.erase(it);
+    if (limit_ == 0 || pooled_bytes_ + cls <= limit_) {
+      free_[cls].push_back(p);
+      pooled_bytes_ += cls;
+    } else {
+      std::free(p);
+    }
+  }
+
+  void DirectFree(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;  // unknown or already freed: no-op
+    used_bytes_ -= it->second;
+    sizes_.erase(it);
+    std::free(p);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : free_) {
+      for (void* p : kv.second) {
+        sizes_.erase(p);
+        std::free(p);
+      }
+      kv.second.clear();
+    }
+    pooled_bytes_ = 0;
+  }
+
+  size_t PooledBytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pooled_bytes_;
+  }
+
+  size_t UsedBytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return used_bytes_;
+  }
+
+ private:
+  static size_t RoundSize(size_t size) {
+    // round up to the next power of two >= 64 (reference pow2 pool)
+    size_t cls = 64;
+    while (cls < size) cls <<= 1;
+    return cls;
+  }
+
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> free_;
+  std::unordered_map<void*, size_t> sizes_;
+  size_t limit_;
+  size_t pooled_bytes_;
+  size_t used_bytes_;
+};
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void* StorageCreate(uint64_t reserve_limit) {
+  return new mxtpu::StoragePool(reserve_limit);
+}
+
+void StorageDestroy(void* h) { delete static_cast<mxtpu::StoragePool*>(h); }
+
+void* StorageAlloc(void* h, uint64_t size) {
+  return static_cast<mxtpu::StoragePool*>(h)->Alloc(size);
+}
+
+void StorageFree(void* h, void* p) {
+  static_cast<mxtpu::StoragePool*>(h)->Free(p);
+}
+
+void StorageDirectFree(void* h, void* p) {
+  static_cast<mxtpu::StoragePool*>(h)->DirectFree(p);
+}
+
+void StorageReleaseAll(void* h) {
+  static_cast<mxtpu::StoragePool*>(h)->ReleaseAll();
+}
+
+uint64_t StoragePooledBytes(void* h) {
+  return static_cast<mxtpu::StoragePool*>(h)->PooledBytes();
+}
+
+uint64_t StorageUsedBytes(void* h) {
+  return static_cast<mxtpu::StoragePool*>(h)->UsedBytes();
+}
+
+}  // extern "C"

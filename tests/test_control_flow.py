@@ -126,3 +126,34 @@ def test_isnan_isinf():
     assert list(contrib.isnan(x).asnumpy()) == [0, 0, 1]
     assert list(contrib.isinf(x).asnumpy()) == [0, 1, 0]
     assert list(contrib.isfinite(x).asnumpy()) == [1, 0, 0]
+
+
+def test_while_loop_zero_iterations():
+    """Review regression: initially-false condition returns padded zeros
+    (matching the lax path) instead of raising."""
+    outs, fin = contrib.while_loop(
+        lambda i: i > 100, lambda i: (i * 2, [i + 1]),
+        [mx.nd.array([5.0])], max_iterations=3)
+    assert outs.shape == (3, 1)
+    assert float(outs.asnumpy().sum()) == 0.0
+    assert float(fin[0].asscalar()) == 5.0
+
+
+def test_foreach_lax_single_element_list_output():
+    """Review regression: a body returning a 1-element list keeps list
+    structure under the lax path, matching eager."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(x, s):
+        return [x + s], s + x
+
+    eager_out, _ = contrib.foreach(body, mx.nd.ones((3, 2)),
+                                   mx.nd.zeros((2,)))
+    assert isinstance(eager_out, list) and len(eager_out) == 1
+
+    @jax.jit
+    def run(d):
+        return contrib.foreach(body, d, jnp.zeros((2,)))
+    lax_out, _ = run(jnp.ones((3, 2)))
+    assert isinstance(lax_out, list) and len(lax_out) == 1
