@@ -1,0 +1,2 @@
+"""Contrib APIs (parity: python/mxnet/contrib/)."""
+from . import quantization

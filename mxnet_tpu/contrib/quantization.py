@@ -1,0 +1,226 @@
+"""Post-training int8 quantization (parity:
+python/mxnet/contrib/quantization.py:84-205 — quantize_model with
+naive/entropy calibration over the quantize_graph_pass).
+
+The graph pass rewrites FullyConnected / Convolution nodes into
+quantize → int8 compute (int32 accumulate) → dequantize subgraphs; ranges
+come from calibration ('naive' min/max or 'entropy' KL-optimal thresholds)
+or are computed at runtime when calib_mode='none'.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from ..symbol.symbol import Node, Symbol
+
+__all__ = ["quantize_model", "quantize_graph", "_get_optimal_threshold"]
+
+_QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
+                "Convolution": "_contrib_quantized_conv"}
+
+
+def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
+                   th_dict=None):
+    """Rewrite quantizable nodes into int8 subgraphs (reference
+    quantize_graph_pass.cc). th_dict maps node name -> (min, max) of the
+    node's DATA input from calibration."""
+    th_dict = th_dict or {}
+    excluded = set(excluded_sym_names)
+    mapping = {}  # id(old_node) -> new Node
+
+    def mapped_entry(entry):
+        node, idx = entry
+        return (mapping[id(node)], idx)
+
+    for node in sym._topo():
+        if node.is_variable:
+            mapping[id(node)] = node
+            continue
+        op_name = node.op.name
+        if op_name in _QUANTIZABLE and node.name not in excluded:
+            data_e = mapped_entry(node.inputs[0])
+            weight_e = mapped_entry(node.inputs[1])
+            no_bias = node.params.get("no_bias", False)
+            bias_e = mapped_entry(node.inputs[2]) \
+                if not no_bias and len(node.inputs) > 2 else None
+            qv2 = _registry.get("_contrib_quantize_v2")
+            q_params = {"out_type": quantized_dtype}
+            if node.name in th_dict:
+                lo, hi = th_dict[node.name]
+                q_params["min_calib_range"] = float(lo)
+                q_params["max_calib_range"] = float(hi)
+            qd = Node(qv2, node.name + "_quantize", [data_e], dict(q_params))
+            qw = Node(qv2, node.name + "_quantize_weight", [weight_e],
+                      {"out_type": "int8"})
+            ins = [(qd, 0), (qw, 0)]
+            if bias_e is not None:
+                qb = Node(qv2, node.name + "_quantize_bias", [bias_e],
+                          {"out_type": "int8"})
+                ins.append((qb, 0))
+                ranges = [(qd, 1), (qd, 2), (qw, 1), (qw, 2), (qb, 1),
+                          (qb, 2)]
+            else:
+                qb = None
+                ranges = [(qd, 1), (qd, 2), (qw, 1), (qw, 2)]
+            qparams = dict(node.params)
+            if qb is None:
+                qparams["no_bias"] = True
+            qop = _registry.get(_QUANTIZABLE[op_name])
+            # op signature has fixed bias slot; insert a zero-range pair
+            if qb is None:
+                # reuse weight ranges as placeholder bias ranges; no_bias
+                # makes the op ignore the bias inputs entirely
+                ins.append((qw, 0))
+                ranges += [(qw, 1), (qw, 2)]
+            qnode = Node(qop, node.name + "_quantized", ins + ranges,
+                         qparams)
+            deq = Node(_registry.get("_contrib_dequantize"),
+                       node.name + "_dequantize",
+                       [(qnode, 0), (qnode, 1), (qnode, 2)], {})
+            mapping[id(node)] = deq
+        else:
+            new_inputs = [mapped_entry(e) for e in node.inputs]
+            mapping[id(node)] = Node(node.op, node.name, new_inputs,
+                                     dict(node.params), dict(node.attrs))
+    return Symbol([(mapping[id(n)], i) for n, i in sym._entries])
+
+
+def _collect_layer_stats(sym, arg_params, aux_params, calib_data,
+                         data_names, num_calib_examples, collect):
+    """Run forward passes over calibration batches, feeding `collect` with
+    per-quantizable-layer input activations."""
+    from .. import ndarray as nd
+    from ..executor import _graph_eval_fn
+
+    # internals symbol exposing each quantizable node's data input
+    targets = {}
+    for node in sym._topo():
+        if not node.is_variable and node.op.name in _QUANTIZABLE:
+            targets[node.name] = node.inputs[0]
+    if not targets:
+        return
+    probe = Symbol(list(targets.values()))
+    eval_fn = _graph_eval_fn(probe)
+    import jax
+    key = jax.random.PRNGKey(0)
+    seen = 0
+    calib_data.reset()
+    for batch in calib_data:
+        arg_vals = {}
+        for name, arr in zip(data_names, batch.data):
+            arg_vals[name] = arr._data if hasattr(arr, "_data") else arr
+        for k, v in arg_params.items():
+            arg_vals[k] = v._data if hasattr(v, "_data") else v
+        aux_vals = {k: (v._data if hasattr(v, "_data") else v)
+                    for k, v in aux_params.items()}
+        outs, _ = eval_fn(arg_vals, aux_vals, key, False)
+        for lname, out in zip(targets.keys(), outs):
+            collect(lname, _np.asarray(out))
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+
+
+def _smooth_distribution(p, eps=0.0001):
+    """Move eps mass to zero entries (reference _smooth_distribution)."""
+    is_zeros = (p == 0).astype(_np.float64)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        return None
+    eps1 = eps * n_zeros / n_nonzeros
+    return p.astype(_np.float64) - eps1 * (1 - is_zeros) + eps * is_zeros
+
+
+def _get_optimal_threshold(arr, num_bins=1601, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| (reference
+    _get_optimal_thresholds / TensorRT-style calibration,
+    contrib/quantization.py)."""
+    arr = _np.asarray(arr).ravel()
+    amax = float(_np.abs(arr).max()) if arr.size else 0.0
+    if amax == 0.0:
+        return 0.0
+    hist, edges = _np.histogram(arr, bins=num_bins, range=(-amax, amax))
+    zero_bin = num_bins // 2
+    half_q = num_quantized_bins // 2
+    best_div, best_t = _np.inf, amax
+    for i in range(half_q + 1, zero_bin + 1):
+        p_start, p_stop = zero_bin - i, zero_bin + i + 1
+        sliced = hist[p_start:p_stop].astype(_np.float64)
+        p = sliced.copy()
+        # clipped outlier mass lands in the edge bins
+        p[0] += hist[:p_start].sum()
+        p[-1] += hist[p_stop:].sum()
+        is_nonzero = (p != 0)
+        # quantize the candidate range into num_quantized_bins, then expand
+        # each quantized bin's mass uniformly over its NONZERO source bins
+        num_merged = p.size // num_quantized_bins
+        q = _np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            start = j * num_merged
+            stop = p.size if j == num_quantized_bins - 1 \
+                else start + num_merged
+            total = sliced[start:stop].sum()
+            norm = is_nonzero[start:stop].sum()
+            if norm:
+                q[start:stop] = is_nonzero[start:stop] * (total / norm)
+        p_s = _smooth_distribution(p / p.sum())
+        q_sum = q.sum()
+        if p_s is None or q_sum == 0:
+            continue
+        q_s = _smooth_distribution(q / q_sum)
+        if q_s is None:
+            continue
+        div = float(_np.sum(p_s * _np.log(p_s / q_s)))
+        if div < best_div:
+            best_div = div
+            best_t = (i + 0.5) * (2.0 * amax / num_bins)
+    return best_t
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", ctx=None,
+                   label_names=("softmax_label",), logger=None):
+    """Quantize a symbolic model (reference quantize_model :84-205).
+
+    Returns (quantized_symbol, arg_params, aux_params); parameters stay
+    fp32 (quantization happens in-graph, so checkpoints remain portable).
+    """
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise ValueError("unknown quantized_dtype %s" % quantized_dtype)
+    if quantized_dtype == "auto":
+        quantized_dtype = "int8"
+    th_dict = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_data required for calib_mode=%r"
+                             % calib_mode)
+        stats = {}
+
+        def collect(name, arr):
+            lo, hi = float(arr.min()), float(arr.max())
+            if calib_mode == "naive":
+                if name in stats:
+                    stats[name] = (min(stats[name][0], lo),
+                                   max(stats[name][1], hi))
+                else:
+                    stats[name] = (lo, hi)
+            else:  # entropy: keep samples for KL thresholding
+                stats.setdefault(name, []).append(arr.ravel())
+
+        _collect_layer_stats(sym, arg_params, aux_params, calib_data,
+                             data_names, num_calib_examples, collect)
+        if calib_mode == "naive":
+            th_dict = dict(stats)
+        elif calib_mode == "entropy":
+            for name, chunks in stats.items():
+                t = _get_optimal_threshold(_np.concatenate(chunks))
+                th_dict[name] = (-t, t)
+        else:
+            raise ValueError("unknown calib_mode %s" % calib_mode)
+    qsym = quantize_graph(sym, excluded_sym_names, quantized_dtype, th_dict)
+    return qsym, arg_params, aux_params

@@ -14,3 +14,5 @@ from . import random_ops    # noqa: F401
 from . import init_ops      # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import image_ops     # noqa: F401
+from . import quantization  # noqa: F401
+from . import contrib_ops   # noqa: F401

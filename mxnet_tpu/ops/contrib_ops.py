@@ -1,0 +1,388 @@
+"""Contrib / detection / spatial operators (parity: src/operator/contrib/ —
+ROIAlign roi_align.cc, MultiBox multibox_*.cc (SSD), box_nms bounding_box.cc,
+boolean_mask, index_copy, fft; legacy spatial ops roi_pooling,
+bilinear_sampler, spatial_transformer, grid_generator, svm_output).
+
+All are pure-XLA lowerings; gather/dynamic-slice based kernels keep static
+shapes (SURVEY.md §7 hard-part 1) by padding/masking instead of producing
+data-dependent sizes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling / align
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling")
+def roi_pooling(data, rois, *, pooled_size, spatial_scale):
+    """Max-pool regions of interest (reference src/operator/roi_pooling.cc).
+
+    data: (N, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[bi]  # (C, H, W)
+        ys = jnp.arange(h)[None, :]
+        xs = jnp.arange(w)[None, :]
+        out = jnp.zeros((c, ph, pw), data.dtype)
+        hb = rh / ph
+        wb = rw / pw
+        rows = []
+        for py in range(ph):
+            cols = []
+            y_lo = y1 + jnp.floor(py * hb).astype(jnp.int32)
+            y_hi = y1 + jnp.ceil((py + 1) * hb).astype(jnp.int32)
+            ymask = (jnp.arange(h) >= y_lo) & (jnp.arange(h) < jnp.maximum(
+                y_hi, y_lo + 1)) & (jnp.arange(h) <= y2)
+            for px in range(pw):
+                x_lo = x1 + jnp.floor(px * wb).astype(jnp.int32)
+                x_hi = x1 + jnp.ceil((px + 1) * wb).astype(jnp.int32)
+                xmask = (jnp.arange(w) >= x_lo) & \
+                    (jnp.arange(w) < jnp.maximum(x_hi, x_lo + 1)) & \
+                    (jnp.arange(w) <= x2)
+                m = ymask[:, None] & xmask[None, :]
+                cell = jnp.where(m[None], img, -jnp.inf)
+                cols.append(jnp.max(cell, axis=(1, 2)))
+            rows.append(jnp.stack(cols, axis=-1))
+        out = jnp.stack(rows, axis=-2)  # (C, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign")
+def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=-1,
+              position_sensitive=False):
+    """Bilinear ROI align (reference contrib/roi_align.cc)."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+    sr = 2 if sample_ratio <= 0 else sample_ratio
+
+    def bilinear(img, y, x):
+        y = jnp.clip(y, 0.0, h - 1.0)
+        x = jnp.clip(x, 0.0, w - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        ly, lx = y - y0, x - x0
+        v = (img[:, y0, x0] * (1 - ly) * (1 - lx)
+             + img[:, y1, x0] * ly * (1 - lx)
+             + img[:, y0, x1] * (1 - ly) * lx
+             + img[:, y1, x1] * ly * lx)
+        return v
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        img = data[bi]
+        bin_h = rh / ph
+        bin_w = rw / pw
+        out = []
+        for py in range(ph):
+            row = []
+            for px in range(pw):
+                acc = 0.0
+                for iy in range(sr):
+                    for ix in range(sr):
+                        y = y1 + (py + (iy + 0.5) / sr) * bin_h
+                        x = x1 + (px + (ix + 0.5) / sr) * bin_w
+                        acc = acc + bilinear(img, y, x)
+                row.append(acc / (sr * sr))
+            out.append(jnp.stack(row, axis=-1))
+        return jnp.stack(out, axis=-2)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Spatial transformer family (legacy ops)
+# ---------------------------------------------------------------------------
+
+@register("GridGenerator")
+def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """Affine/warp grid (reference spatial ops). affine: data (N, 6)."""
+    th, tw = target_shape
+    if transform_type == "affine":
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, th)
+        xs = jnp.linspace(-1.0, 1.0, tw)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, coords)  # (N, 2, HW)
+        return out.reshape(n, 2, th, tw)
+    # 'warp': data is (N, 2, H, W) flow field
+    n, _, h, w = data.shape
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+    gx, gy = jnp.meshgrid(xs, ys)
+    x = (data[:, 0] + gx) * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+    y = (data[:, 1] + gy) * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+    return jnp.stack([x, y], axis=1)
+
+
+def _grid_sample(data, grid):
+    """Bilinear sample data (N,C,H,W) at grid (N,2,Ho,Wo) in [-1,1]."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    lx, ly = gx - x0, gy - y0
+
+    def gather(img, yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        vals = img[:, yc, xc]  # (C, Ho, Wo)
+        return jnp.where(valid[None], vals, 0.0)
+
+    def one(img, x0_, y0_, lx_, ly_):
+        v00 = gather(img, y0_, x0_)
+        v01 = gather(img, y0_, x0_ + 1)
+        v10 = gather(img, y0_ + 1, x0_)
+        v11 = gather(img, y0_ + 1, x0_ + 1)
+        return (v00 * (1 - ly_) * (1 - lx_) + v01 * (1 - ly_) * lx_
+                + v10 * ly_ * (1 - lx_) + v11 * ly_ * lx_)
+
+    return jax.vmap(one)(data, x0, y0, lx, ly)
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid):
+    """Sample data at grid locations (reference bilinear_sampler.cc)."""
+    return _grid_sample(data, grid)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, *, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear"):
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=tuple(target_shape))
+    return _grid_sample(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Detection: multibox (SSD), box_nms
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior")
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes per feature-map cell (reference multibox_prior.cc)."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")
+    anchors = []
+    # reference layout: (sizes[0],r) for all ratios, then (s,ratios[0])
+    specs = [(sizes[0], r) for r in ratios] + \
+            [(s, ratios[0]) for s in sizes[1:]]
+    for s, r in specs:
+        sr = jnp.sqrt(r)
+        bw = s * sr / 2
+        bh = s / sr / 2
+        anchors.append(jnp.stack(
+            [cx - bw, cy - bh, cx + bw, cy + bh], axis=-1))
+    out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _box_iou_corner(a, b):
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:4], b[..., None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+@register("_contrib_box_iou")
+def box_iou(lhs, rhs, *, format="corner"):
+    return _box_iou_corner(lhs, rhs)
+
+
+@register("_contrib_box_nms")
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner", background_id=-1):
+    """Greedy NMS with static shapes: suppressed entries become -1 rows
+    (reference bounding_box.cc box_nms)."""
+    single = data.ndim == 2
+    if single:
+        data = data[None]
+    b, n, k = data.shape
+    scores = data[..., score_index]
+    boxes = data[..., coord_start:coord_start + 4]
+    class_aware = id_index >= 0 and not force_suppress
+    ids = data[..., id_index] if id_index >= 0 else jnp.zeros((b, n))
+
+    def one(sample_scores, sample_boxes, sample_ids, sample_data):
+        order = jnp.argsort(-sample_scores)
+        sboxes = sample_boxes[order]
+        sscores = sample_scores[order]
+        sdata = sample_data[order]
+        sids = sample_ids[order]
+        iou = _box_iou_corner(sboxes, sboxes)
+        keep = sscores > valid_thresh
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i)
+            if class_aware:
+                sup = sup & (sids == sids[i])
+            return jnp.where(keep[i], keep & ~sup, keep)
+        keep = lax.fori_loop(0, n, body, keep)
+        if topk > 0:
+            keep = keep & (jnp.cumsum(keep.astype(jnp.int32)) <= topk)
+        return jnp.where(keep[:, None], sdata, -jnp.ones_like(sdata))
+
+    out = jax.vmap(one)(scores, boxes, ids, data)
+    return out[0] if single else out
+
+
+@register("_contrib_MultiBoxDetection")
+def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk=-1):
+    """Decode SSD predictions to detections (reference
+    multibox_detection.cc): cls_prob (B, num_cls, A), loc_pred (B, A*4),
+    anchor (1, A, 4) -> (B, A, 6) [cls_id, score, x1, y1, x2, y2]."""
+    b, num_cls, a = cls_prob.shape
+    loc = loc_pred.reshape(b, a, 4)
+    anc = anchor.reshape(a, 4)
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    cx = loc[..., 0] * variances[0] * aw + acx
+    cy = loc[..., 1] * variances[1] * ah + acy
+    bw = jnp.exp(loc[..., 2] * variances[2]) * aw / 2
+    bh = jnp.exp(loc[..., 3] * variances[3]) * ah / 2
+    x1, y1, x2, y2 = cx - bw, cy - bh, cx + bw, cy + bh
+    if clip:
+        x1, y1 = jnp.clip(x1, 0, 1), jnp.clip(y1, 0, 1)
+        x2, y2 = jnp.clip(x2, 0, 1), jnp.clip(y2, 0, 1)
+    # best non-background class per anchor
+    fg = cls_prob[:, 1:] if background_id == 0 else cls_prob
+    cls_id = jnp.argmax(fg, axis=1).astype(jnp.float32)
+    score = jnp.max(fg, axis=1)
+    valid = score > threshold
+    cls_id = jnp.where(valid, cls_id, -1.0)
+    det = jnp.stack([cls_id, score, x1, y1, x2, y2], axis=-1)
+    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=threshold,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# boolean_mask / index_copy / SVM / fft
+# ---------------------------------------------------------------------------
+
+@register("_contrib_boolean_mask")
+def boolean_mask(data, index, *, axis=0):
+    """Static-shape variant: masked-out rows are zeroed and compacted to the
+    front; the count of kept rows is data-dependent, so on TPU the output
+    keeps full length (XLA needs static shapes; reference returns a
+    dynamically-sized array on CPU/GPU)."""
+    mask = index.astype(bool)
+    n = data.shape[axis]
+    order = jnp.argsort(~mask, stable=True)  # kept rows first
+    gathered = jnp.take(data, order, axis=axis)
+    kept = jnp.sort(mask)[::-1]
+    shape = [1] * data.ndim
+    shape[axis] = n
+    return gathered * kept.reshape(shape).astype(data.dtype)
+
+
+@register("_contrib_index_copy")
+def index_copy(old_tensor, index_vector, new_tensor):
+    idx = index_vector.astype(jnp.int32)
+    return old_tensor.at[idx].set(new_tensor)
+
+
+@register("SVMOutput")
+def svm_output(data, label=None, *, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Hinge-loss head (reference svm_output.cc): forward is identity; the
+    custom vjp applies the SVM gradient."""
+    if label is None:
+        return data * 1.0
+
+    @jax.custom_vjp
+    def core(d, lab):
+        return d * 1.0
+
+    def fwd(d, lab):
+        return d * 1.0, (d, lab)
+
+    def bwd(res, g):
+        d, lab = res
+        n, c = d.shape[0], d.shape[-1]
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), c, dtype=d.dtype)
+        sign = 2.0 * onehot - 1.0  # +1 for true class, -1 otherwise
+        violate = (margin - sign * d) > 0
+        if use_linear:
+            grad = jnp.where(violate, -sign, 0.0)
+        else:
+            grad = jnp.where(violate, -2.0 * (margin - sign * d) * sign, 0.0)
+        return (regularization_coefficient * grad, None)
+
+    core.defvjp(fwd, bwd)
+    return core(data, label)
+
+
+@register("_contrib_fft")
+def contrib_fft(data, *, compute_size=128):
+    """FFT over the last axis, packed [real, imag] interleaved as the
+    reference does (contrib/fft.cc): output last dim is 2x input."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    packed = jnp.stack([out.real, out.imag], axis=-1)
+    return packed.reshape(*data.shape[:-1], data.shape[-1] * 2) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft")
+def contrib_ifft(data, *, compute_size=128):
+    n = data.shape[-1] // 2
+    unpacked = data.reshape(*data.shape[:-1], n, 2)
+    comp = unpacked[..., 0] + 1j * unpacked[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
+
+
+@register("_contrib_count_sketch")
+def count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """Count-sketch projection (reference contrib/count_sketch.cc)."""
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    contrib = data * ss[None, :]
+    out = jnp.zeros(data.shape[:-1] + (int(out_dim),), data.dtype)
+    return out.at[..., hh].add(contrib)

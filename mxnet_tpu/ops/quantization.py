@@ -1,0 +1,159 @@
+"""Int8 quantization operators (parity: src/operator/quantization/ —
+quantize/quantize_v2/dequantize/requantize + quantized_fully_connected /
+quantized_conv; python surface python/mxnet/contrib/quantization.py).
+
+TPU-native: int8 matmuls lower to lax.dot_general with an int32
+accumulator, which XLA maps onto the MXU's integer path; the float32
+scale/offset bookkeeping mirrors the reference's min/max-range calibration
+scheme so calibrated models produce the same numerics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _range_of(out_type):
+    if out_type == "uint8":
+        return 0.0, 255.0
+    if out_type == "int8":
+        return -127.0, 127.0
+    raise ValueError("unsupported quantized type %r" % out_type)
+
+
+@register("_contrib_quantize", num_outputs=3)
+def quantize(data, min_range, max_range, *, out_type="uint8"):
+    """Quantize float data given calibration range (reference quantize op)."""
+    lo = jnp.reshape(min_range, ())
+    hi = jnp.reshape(max_range, ())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(hi - lo, 1e-8)
+        q = jnp.clip(jnp.round((data - lo) * scale), 0, 255) \
+            .astype(jnp.uint8)
+    else:
+        amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+        scale = 127.0 / jnp.maximum(amax, 1e-8)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+        lo, hi = -amax, amax
+    return q, jnp.reshape(lo, (1,)), jnp.reshape(hi, (1,))
+
+
+@register("_contrib_quantize_v2", num_outputs=3)
+def quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    if min_calib_range is None or max_calib_range is None:
+        lo = jnp.min(data)
+        hi = jnp.max(data)
+    else:
+        lo = jnp.asarray(min_calib_range, jnp.float32)
+        hi = jnp.asarray(max_calib_range, jnp.float32)
+    return quantize(data, lo, hi, out_type=out_type)
+
+
+@register("_contrib_dequantize")
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    lo = jnp.reshape(min_range, ())
+    hi = jnp.reshape(max_range, ())
+    if data.dtype == jnp.uint8:
+        scale = jnp.maximum(hi - lo, 1e-8) / 255.0
+        return data.astype(jnp.float32) * scale + lo
+    amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    if data.dtype == jnp.int32:  # accumulator from a quantized matmul/conv
+        return data.astype(jnp.float32) * (amax / (2.0 ** 31 - 1))
+    return data.astype(jnp.float32) * (amax / 127.0)
+
+
+@register("_contrib_requantize", num_outputs=3)
+def requantize(data, min_range, max_range, *, min_calib_range=None,
+               max_calib_range=None, out_type="int8"):
+    """int32 accumulator -> int8 with a new calibrated range."""
+    # float value represented by one int32 step
+    in_scale = jnp.maximum(jnp.abs(jnp.reshape(min_range, ())),
+                           jnp.abs(jnp.reshape(max_range, ()))) / \
+        (2.0 ** 31 - 1)
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is not None and max_calib_range is not None:
+        lo = jnp.asarray(min_calib_range, jnp.float32)
+        hi = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        lo, hi = jnp.min(real), jnp.max(real)
+    return quantize(real, lo, hi, out_type=out_type)
+
+
+def _q_scale(lo, hi, dtype):
+    if dtype == jnp.uint8:
+        return 255.0 / jnp.maximum(hi - lo, 1e-8), lo
+    amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    return 127.0 / jnp.maximum(amax, 1e-8), 0.0
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias, *,
+                              num_hidden, no_bias=False, flatten=True):
+    """int8 x int8 -> int32 FC (reference quantized_fully_connected)."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    d_lo, d_hi = jnp.reshape(min_data, ()), jnp.reshape(max_data, ())
+    w_lo, w_hi = jnp.reshape(min_weight, ()), jnp.reshape(max_weight, ())
+    d_scale, _ = _q_scale(d_lo, d_hi, data.dtype)
+    w_scale, _ = _q_scale(w_lo, w_hi, weight.dtype)
+    out_scale = 1.0 / (d_scale * w_scale)  # float value of one int32 step
+    if not no_bias and bias is not None:
+        b_lo, b_hi = jnp.reshape(min_bias, ()), jnp.reshape(max_bias, ())
+        b_scale, _ = _q_scale(b_lo, b_hi, bias.dtype)
+        b_int32 = jnp.round(bias.astype(jnp.float32) / b_scale
+                            / out_scale).astype(jnp.int32)
+        acc = acc + b_int32
+    out_max = (2.0 ** 31 - 1) * out_scale
+    return acc, jnp.reshape(-out_max, (1,)), jnp.reshape(out_max, (1,))
+
+
+@register("_contrib_quantized_conv", num_outputs=3)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, *, kernel, num_filter,
+                   stride=None, dilate=None, pad=None, num_group=1,
+                   no_bias=False, layout=None):
+    """int8 convolution with int32 accumulation (reference quantized_conv)."""
+    n = len(kernel)
+    stride = tuple(s if s else 1 for s in (stride or (1,) * n))
+    dilate = tuple(d if d else 1 for d in (dilate or (1,) * n))
+    padding = [(p, p) for p in (pad or (0,) * n)]
+    fmt = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+           3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, fmt)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=padding, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    d_lo, d_hi = jnp.reshape(min_data, ()), jnp.reshape(max_data, ())
+    w_lo, w_hi = jnp.reshape(min_weight, ()), jnp.reshape(max_weight, ())
+    d_scale, _ = _q_scale(d_lo, d_hi, data.dtype)
+    w_scale, _ = _q_scale(w_lo, w_hi, weight.dtype)
+    out_scale = 1.0 / (d_scale * w_scale)
+    if not no_bias and bias is not None:
+        b_lo, b_hi = jnp.reshape(min_bias, ()), jnp.reshape(max_bias, ())
+        b_scale, _ = _q_scale(b_lo, b_hi, bias.dtype)
+        b_int32 = jnp.round(bias.astype(jnp.float32) / b_scale
+                            / out_scale).astype(jnp.int32)
+        acc = acc + jnp.reshape(b_int32, (1, -1) + (1,) * n)
+    out_max = (2.0 ** 31 - 1) * out_scale
+    return acc, jnp.reshape(-out_max, (1,)), jnp.reshape(out_max, (1,))
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def quantized_flatten(data, min_range, max_range):
+    return data.reshape(data.shape[0], -1), min_range, max_range
+
+
+alias("_contrib_quantize", "quantize")
+alias("_contrib_dequantize", "dequantize")
