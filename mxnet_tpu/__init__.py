@@ -8,11 +8,13 @@ architecture: JAX/XLA/Pallas-first (see ARCHITECTURE.md). Import as::
 """
 from __future__ import annotations
 
-import jax as _jax
-# Full dtype surface (float64/int64) like the reference; creation APIs still
-# default to float32 (mshadow default_real_t), so TPU-hostile f64 only appears
-# when a user explicitly asks for it.
-_jax.config.update("jax_enable_x64", True)
+from . import config
+# float32/int32 by default (mshadow default_real_t); float64/int64 are
+# opt-in via MXNET_ENABLE_X64=1 because x64 doubles every index array and
+# pushes XLA onto f64 paths the MXU doesn't have.
+if config.flags.enable_x64:
+    import jax as _jax
+    _jax.config.update("jax_enable_x64", True)
 
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus

@@ -10,7 +10,17 @@ from __future__ import annotations
 import numpy as _np
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
-           "_NP_DTYPES", "mx_real_t", "normalize_dtype"]
+           "_NP_DTYPES", "mx_real_t", "normalize_dtype", "index_dtype"]
+
+
+def index_dtype():
+    """Widest available integer dtype: int64 when x64 is opted in
+    (MXNET_ENABLE_X64=1), else int32. Ops that the reference types as
+    int64 (shape_array, histogram counts, ...) use this so the default
+    f32/i32 mode neither warns nor silently emits a different dtype than
+    requested."""
+    import jax
+    return _np.int64 if jax.config.jax_enable_x64 else _np.int32
 
 
 class MXNetError(RuntimeError):

@@ -1,0 +1,159 @@
+"""Unified typed config/flag registry.
+
+The reference scatters ~60 runtime knobs as raw ``dmlc::GetEnv`` reads
+documented only in docs/faq/env_var.md:35-232, plus per-object
+``DMLC_DECLARE_PARAMETER`` kwargs. SURVEY.md §5 prescribes unifying them:
+one registry where every flag has a name, type, default, and docstring, is
+initialised from the environment once, and can be inspected or overridden
+programmatically.
+
+Usage::
+
+    from mxnet_tpu import config
+    config.flags.engine_type          # "ThreadedEngine" | "NaiveEngine"
+    config.describe()                 # -> list of (name, env, value, doc)
+    with config.override(enable_x64=True): ...
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+__all__ = ["Flag", "flags", "register_flag", "describe", "override"]
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class Flag(NamedTuple):
+    name: str          # python attribute name
+    env: str           # environment variable consulted at startup
+    type: Callable     # parser applied to the env string
+    default: Any
+    doc: str
+
+
+_REGISTRY: Dict[str, Flag] = {}
+_LOCK = threading.Lock()
+
+
+class _Flags:
+    """Attribute-style access to resolved flag values."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self._tls = threading.local()
+
+    def _resolve(self, name: str) -> Any:
+        flag = _REGISTRY[name]
+        raw = os.environ.get(flag.env)
+        if raw is None:
+            return flag.default
+        try:
+            return flag.type(raw)
+        except (TypeError, ValueError):
+            return flag.default
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        overrides = getattr(self._tls, "overrides", None)
+        if overrides and name in overrides:
+            return overrides[name]
+        if name not in self._values:
+            if name not in _REGISTRY:
+                raise AttributeError("no such flag: %r" % name)
+            self._values[name] = self._resolve(name)
+        return self._values[name]
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in _REGISTRY:
+            raise KeyError("no such flag: %r" % name)
+        self._values[name] = value
+
+    def reload(self, name: Optional[str] = None) -> None:
+        """Re-read flag(s) from the environment."""
+        if name is None:
+            self._values.clear()
+        else:
+            self._values.pop(name, None)
+
+
+flags = _Flags()
+
+
+def register_flag(name: str, env: str, type: Callable, default: Any,
+                  doc: str) -> Flag:
+    with _LOCK:
+        f = Flag(name, env, type, default, doc)
+        _REGISTRY[name] = f
+        return f
+
+
+def describe() -> List[Dict[str, Any]]:
+    """Introspect every flag (the env_var.md analog, but queryable)."""
+    out = []
+    for f in sorted(_REGISTRY.values()):
+        out.append({"name": f.name, "env": f.env,
+                    "value": getattr(flags, f.name),
+                    "default": f.default, "doc": f.doc})
+    return out
+
+
+@contextlib.contextmanager
+def override(**kwargs):
+    """Thread-local temporary flag overrides."""
+    tls = flags._tls
+    prev = getattr(tls, "overrides", None)
+    merged = dict(prev or {})
+    for k in kwargs:
+        if k not in _REGISTRY:
+            raise KeyError("no such flag: %r" % k)
+    merged.update(kwargs)
+    tls.overrides = merged
+    try:
+        yield
+    finally:
+        tls.overrides = prev
+
+
+# ---------------------------------------------------------------------------
+# Core flags (reference env vars they correspond to are noted in the doc).
+# ---------------------------------------------------------------------------
+register_flag("enable_x64", "MXNET_ENABLE_X64", _parse_bool, False,
+              "Enable float64/int64 JAX dtypes. Off by default: the "
+              "reference computes in float32 (mshadow default_real_t) and "
+              "f64 is hostile to the TPU MXU.")
+register_flag("engine_type", "MXNET_ENGINE_TYPE", str, "ThreadedEngine",
+              "Execution engine: ThreadedEngine (async, default) or "
+              "NaiveEngine (block after every op; debug). Parity: "
+              "src/engine/engine.cc:33-41.")
+register_flag("cpu_worker_nthreads", "MXNET_CPU_WORKER_NTHREADS", int, 4,
+              "Host thread-pool width for IO decode/augment work "
+              "(parity: MXNET_CPU_WORKER_NTHREADS).")
+register_flag("exec_bulk_exec_inference", "MXNET_EXEC_BULK_EXEC_INFERENCE",
+              _parse_bool, True,
+              "Fuse whole inference graphs into one jitted module "
+              "(parity: bulked engine segments).")
+register_flag("exec_bulk_exec_train", "MXNET_EXEC_BULK_EXEC_TRAIN",
+              _parse_bool, True,
+              "Fuse forward+backward into one jitted module.")
+register_flag("enforce_determinism", "MXNET_ENFORCE_DETERMINISM",
+              _parse_bool, False,
+              "Restrict nondeterminism (parity: env_var.md:226). XLA:TPU "
+              "kernels are deterministic by default; this additionally "
+              "refuses to auto-seed the global RNG from entropy "
+              "(mxnet_tpu.random._chain).")
+register_flag("profiler_autostart", "MXNET_PROFILER_AUTOSTART",
+              _parse_bool, False,
+              "Start the profiler when mxnet_tpu.profiler is first "
+              "imported (parity: env_var.md:179).")
+register_flag("test_device", "MXNET_TEST_DEVICE", str, "cpu",
+              "Device type test_utils.default_context() returns (cpu|tpu) "
+              "— the reference's env-switchable default_context (:53).")
+register_flag("test_platform", "MXNET_TEST_PLATFORM", str, "cpu",
+              "Platform the test suite pins JAX to at session start "
+              "(cpu|tpu); read by tests/conftest.py.")

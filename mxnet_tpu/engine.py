@@ -19,15 +19,14 @@ This module keeps the reference's escape hatches:
 """
 from __future__ import annotations
 
-import os
-
 import jax
 
 from .base import MXNetError
+from .config import flags
 
 __all__ = ["naive_mode", "waitall", "on_complete", "sync_point"]
 
-_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+_NAIVE = flags.engine_type == "NaiveEngine"
 
 
 def naive_mode() -> bool:
@@ -52,8 +51,14 @@ def on_complete(array):
 
 
 def waitall():
-    """Block until all async device work completes (parity: MXNDArrayWaitAll)."""
+    """Block until all async device work completes (parity: MXNDArrayWaitAll).
+
+    ``jax.effects_barrier()`` only orders effectful computations; blocking on
+    every live array is what actually drains outstanding async executions,
+    matching the reference's WaitForAll (threaded_engine.cc)."""
     try:
         jax.effects_barrier()
+        for a in jax.live_arrays():
+            a.block_until_ready()
     except Exception as e:
         raise MXNetError(str(e)) from e

@@ -142,18 +142,26 @@ class Executor:
         self._eval_fn = eval_fn
         dev = self._ctx.jax_device
 
-        @jax.jit
+        # MXNET_EXEC_BULK_EXEC_{INFERENCE,TRAIN}=0 disables whole-graph
+        # compilation (the reference's bulked-segment toggle): the graph
+        # then runs op-by-op eagerly — slow, but each op's error surfaces
+        # at its own call site (debugging escape hatch).
+        from .config import flags as _flags
+        _jit_inf = jax.jit if _flags.exec_bulk_exec_inference else (lambda f: f)
+        _jit_train = jax.jit if _flags.exec_bulk_exec_train else (lambda f: f)
+
+        @_jit_inf
         def fwd_predict(arg_vals, aux_vals, key):
             outs, _ = eval_fn(arg_vals, aux_vals, key, False)
             return outs
 
-        @jax.jit
+        @_jit_train
         def fwd_train(arg_vals, aux_vals, key):
             return eval_fn(arg_vals, aux_vals, key, True)
 
         req = list(self._req_args)
 
-        @jax.jit
+        @_jit_train
         def fwd_bwd(arg_vals, aux_vals, key, ograds):
             diff = {k: arg_vals[k] for k in req}
             rest = {k: v for k, v in arg_vals.items() if k not in diff}

@@ -33,8 +33,21 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
            "imperative_invoke"]
 
 
+_X64_NARROW = {_np.dtype(_np.int64): _np.int32,
+               _np.dtype(_np.uint64): _np.uint32,
+               _np.dtype(_np.float64): _np.float32}
+
+
 def _as_jax(x, dtype=None, ctx=None):
     dev = (ctx or current_context()).jax_device
+    if not jax.config.jax_enable_x64:
+        # narrow 64-bit requests deliberately (and silently) when x64 is
+        # off — jax would truncate anyway but with a per-call warning
+        if dtype is not None and _np.dtype(dtype) in _X64_NARROW:
+            dtype = _X64_NARROW[_np.dtype(dtype)]
+        elif dtype is None and isinstance(x, _np.ndarray) and \
+                x.dtype in _X64_NARROW:
+            dtype = _X64_NARROW[x.dtype]
     return jax.device_put(jnp.asarray(x, dtype=dtype), dev)
 
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from .registry import register
 from .. import random as _random
@@ -79,7 +80,10 @@ def random_brightness(data, *, min_factor, max_factor):
     return data.astype(jnp.float32) * alpha
 
 
-_GRAY = jnp.array([0.299, 0.587, 0.114], jnp.float32)
+# Plain numpy: a module-level jnp.array would force JAX backend
+# initialization at import time (device work before the caller can pick a
+# platform). jnp broadcasting accepts the np constant directly.
+_GRAY = _np.array([0.299, 0.587, 0.114], _np.float32)
 
 
 def _to_gray(x):

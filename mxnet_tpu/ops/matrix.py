@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register, alias
+from ..base import index_dtype as _index_dtype
 
 
 @register("Reshape")
@@ -321,19 +322,19 @@ def diag(data, *, k=0):
 
 @register("shape_array")
 def shape_array(data):
-    return jnp.asarray(data.shape, dtype=jnp.int64)
+    return jnp.asarray(data.shape, dtype=_index_dtype())
 
 
 @register("size_array")
 def size_array(data):
-    return jnp.asarray([data.size], dtype=jnp.int64)
+    return jnp.asarray([data.size], dtype=_index_dtype())
 
 
 @register("histogram", num_outputs=2)
 def histogram(data, *, bin_cnt=10, range=None):
     lo, hi = range if range is not None else (float(data.min()), float(data.max()))
     counts, edges = jnp.histogram(data, bins=bin_cnt, range=(lo, hi))
-    return counts.astype(jnp.int64), edges.astype(data.dtype)
+    return counts.astype(_index_dtype()), edges.astype(data.dtype)
 
 
 @register("ravel_multi_index")
@@ -349,7 +350,7 @@ def ravel_multi_index(data, *, shape):
 
 @register("unravel_index")
 def unravel_index(data, *, shape):
-    idx = data.astype(jnp.int64)
+    idx = data.astype(_index_dtype())
     out = []
     for s in reversed(shape):
         out.append(idx % s)

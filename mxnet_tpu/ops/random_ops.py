@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from .registry import register, alias
 from .. import random as _random
-from ..base import normalize_dtype
+from ..base import normalize_dtype, index_dtype as _index_dtype
 
 
 def _dt(dtype):
@@ -127,5 +127,5 @@ def shuffle(data):
 def sample_unique_zipfian(*, range_max, shape=(1,)):
     # approximate: log-uniform proposals (used by sampled softmax)
     u = jax.random.uniform(_random.next_key(), tuple(shape))
-    out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
+    out = jnp.exp(u * jnp.log(float(range_max))).astype(_index_dtype()) - 1
     return jnp.clip(out, 0, range_max - 1)

@@ -48,9 +48,14 @@ def _block_update(q, k_blk, v_blk, m, l, o, mask=None):
     """One online-softmax accumulation step.
 
     m: running rowmax (B,H,Tq,1); l: running denom; o: running numerator.
+    Accumulators are float32 regardless of the input dtype (flash-attention
+    discipline): in bf16 the -1e30 init saturates and low-precision
+    accumulation loses accuracy; the QK/PV matmuls run on the MXU with f32
+    accumulation via preferred_element_type.
     """
     d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) / math.sqrt(d)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     m_blk = jnp.max(s, axis=-1, keepdims=True)
@@ -61,7 +66,9 @@ def _block_update(q, k_blk, v_blk, m, l, o, mask=None):
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
     l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_blk.dtype),
+                                  v_blk,
+                                  preferred_element_type=jnp.float32)
     return m_new, l_new, o_new
 
 
@@ -90,12 +97,12 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
         m, l, o = _block_update(q, k_blk, v_blk, m, l, o, mask)
         return (m, l, o), None
 
-    m0 = jnp.full((b, h, t, 1), _NEG_INF, q.dtype)
-    l0 = jnp.zeros((b, h, t, 1), q.dtype)
-    o0 = jnp.zeros((b, h, t, d), q.dtype)
+    m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, t, d), jnp.float32)
     (m, l, o), _ = lax.scan(step, (m0, l0, o0),
                             (jnp.arange(n_blocks), kb, vb))
-    return o / jnp.maximum(l, 1e-30)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 def ring_attention(q, k, v, axis_name="sp", causal=False):
@@ -109,9 +116,9 @@ def ring_attention(q, k, v, axis_name="sp", causal=False):
     q_pos = my_idx * t_loc + jnp.arange(t_loc)[:, None]
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    m = jnp.full_like(q[..., :1], _NEG_INF)
-    l = jnp.zeros_like(q[..., :1])
-    o = jnp.zeros_like(q)
+    m = jnp.full(q[..., :1].shape, _NEG_INF, jnp.float32)
+    l = jnp.zeros(q[..., :1].shape, jnp.float32)
+    o = jnp.zeros(q.shape, jnp.float32)
     k_cur, v_cur = k, v
     # n is the static ring size, so unroll in python: each step attends to
     # the held KV shard then rotates it one ICI hop — except after the last
@@ -126,13 +133,16 @@ def ring_attention(q, k, v, axis_name="sp", causal=False):
         if s < n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
-    return o / jnp.maximum(l, 1e-30)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 def make_ring_attention(mesh, axis_name="sp", causal=False):
     """Build a jitted ring-attention fn over `mesh`: inputs (B,H,T,D) are
     sharded on T over `axis_name`; output sharded the same way."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # JAX >= 0.8
+    except ImportError:  # pragma: no cover - older JAX
+        from jax.experimental.shard_map import shard_map
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(

@@ -59,12 +59,21 @@ class SPMDTrainStep:
 
         dn, ln = self._data_names, self._label_names
         mom_coeff = momentum
+        # Mixed precision (reference: multi-precision SGD,
+        # python/mxnet/optimizer/optimizer.py:452): master weights stay
+        # float32; compute runs in `dtype` (bf16 on the MXU). The cast sits
+        # inside the differentiated function so grads come back f32.
+        compute_dtype = dtype
 
         def step(params, aux, opt_state, data, label, key):
             n_batch = data[dn[0]].shape[0]
             scale = (1.0 / n_batch) if rescale_grad is None else rescale_grad
 
             def loss_fn(p):
+                if compute_dtype is not None:
+                    p = {k: (v.astype(compute_dtype)
+                             if v.dtype == jnp.float32 else v)
+                         for k, v in p.items()}
                 arg_vals = {**p, **data, **label}
                 outs, auxu = eval_fn(arg_vals, aux, key, True)
                 # loss heads (SoftmaxOutput etc.) carry custom VJPs seeded by

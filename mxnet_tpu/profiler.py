@@ -42,6 +42,14 @@ _state = _ProfilerState()
 _active = False  # fast-path flag read by the dispatch hooks
 
 
+def _maybe_autostart():
+    # MXNET_PROFILER_AUTOSTART=1 starts profiling as soon as the profiler
+    # module loads (parity: env_var.md:179); called at end of module init.
+    from .config import flags
+    if flags.profiler_autostart:
+        set_state("run")
+
+
 def _now_us():
     return (time.monotonic() - _state.epoch) * 1e6
 
@@ -277,3 +285,6 @@ class Marker:
             _state.events.append({"name": self.name, "ph": "i",
                                   "ts": _now_us(), "pid": 0, "tid": 0,
                                   "s": "p" if scope == "process" else "t"})
+
+
+_maybe_autostart()

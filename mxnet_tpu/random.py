@@ -23,6 +23,12 @@ _state = threading.local()
 
 def _chain():
     if not hasattr(_state, "key"):
+        from .config import flags
+        if flags.enforce_determinism:
+            raise RuntimeError(
+                "MXNET_ENFORCE_DETERMINISM is set but mx.random.seed() was "
+                "never called on this thread — refusing to auto-seed from "
+                "entropy (parity: env_var.md:226 restricts nondeterminism).")
         _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
     return _state.key
 

@@ -90,15 +90,31 @@ class MXRecordIO:
             self.close() if self.is_open else None
             self.open()
 
+    # length field is 29 bits; larger payloads must go out as multi-part
+    # records (cflag 1=first, 2=middle, 3=last) or the length silently
+    # overflows into the cflag bits (dmlc-core splits the same way).
+    _MAX_PART = (1 << 29) - 1
+
+    def _write_part(self, cflag, part):
+        self.fp.write(_MAGIC_BYTES)
+        self.fp.write(struct.pack("<I", _encode_lrec(cflag, len(part))))
+        self.fp.write(part)
+        pad = (4 - len(part) % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
     def write(self, buf):
         assert self.writable
         self._check_pid()
-        self.fp.write(_MAGIC_BYTES)
-        self.fp.write(struct.pack("<I", _encode_lrec(0, len(buf))))
-        self.fp.write(buf)
-        pad = (4 - len(buf) % 4) % 4
-        if pad:
-            self.fp.write(b"\x00" * pad)
+        if len(buf) <= self._MAX_PART:
+            self._write_part(0, buf)
+            return
+        view = memoryview(buf)  # zero-copy slicing: these records are huge
+        n_parts = (len(buf) + self._MAX_PART - 1) // self._MAX_PART
+        for i in range(n_parts):
+            cflag = 1 if i == 0 else (3 if i == n_parts - 1 else 2)
+            self._write_part(cflag,
+                             view[i * self._MAX_PART:(i + 1) * self._MAX_PART])
 
     def read(self):
         assert not self.writable

@@ -98,7 +98,10 @@ class NativeEngine:
     """Var-serialized async host scheduler (reference ThreadedEngine
     semantics: include/mxnet/engine.h PushAsync/WaitForVar/WaitForAll)."""
 
-    def __init__(self, num_workers=4):
+    def __init__(self, num_workers=None):
+        if num_workers is None:
+            from .config import flags
+            num_workers = flags.cpu_worker_nthreads
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native runtime unavailable "

@@ -18,12 +18,14 @@ from . import autograd
 
 
 def default_context():
-    dev = os.environ.get("MXNET_TEST_DEVICE", "cpu")
-    return Context(dev, 0)
+    from .config import flags
+    return Context(flags.test_device, 0)
 
 
 def set_default_context(ctx):
+    from .config import flags
     os.environ["MXNET_TEST_DEVICE"] = ctx.device_type
+    flags.reload("test_device")
 
 
 def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):

@@ -15,6 +15,7 @@
 // Exposed as a flat C ABI (the reference's L4 discipline) consumed from
 // python via ctypes (mxnet_tpu/runtime.py).
 
+#include <algorithm>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -22,6 +23,7 @@
 #include <queue>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 extern "C" {
@@ -101,8 +103,18 @@ class Engine {
     Opr* opr = new Opr();
     opr->fn = fn;
     opr->arg = arg;
-    for (int i = 0; i < n_const; ++i) opr->const_vars.push_back(cvars[i]);
-    for (int i = 0; i < n_mut; ++i) opr->mut_vars.push_back(mvars[i]);
+    // Dedupe var ids: a duplicate entry (listed twice in mutable, or in
+    // both const and mutable) would enqueue the op twice on one var queue;
+    // the second entry can never be granted and the engine deadlocks. The
+    // reference engine rejects duplicates — we dedupe, with mutable
+    // winning over const.
+    std::unordered_set<int64_t> seen;
+    for (int i = 0; i < n_mut; ++i) {
+      if (seen.insert(mvars[i]).second) opr->mut_vars.push_back(mvars[i]);
+    }
+    for (int i = 0; i < n_const; ++i) {
+      if (seen.insert(cvars[i]).second) opr->const_vars.push_back(cvars[i]);
+    }
     {
       std::unique_lock<std::mutex> lk(mu_);
       ++pending_;

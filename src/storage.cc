@@ -25,7 +25,16 @@ class StoragePool {
   explicit StoragePool(size_t reserve_limit = 0)
       : limit_(reserve_limit), pooled_bytes_(0), used_bytes_(0) {}
 
-  ~StoragePool() { ReleaseAll(); }
+  ~StoragePool() {
+    ReleaseAll();
+    // Free blocks still outstanding (allocated, never Free'd): the pool
+    // owns every allocation it handed out, so teardown must reclaim them
+    // or they leak.
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : sizes_) std::free(kv.first);
+    sizes_.clear();
+    used_bytes_ = 0;
+  }
 
   void* Alloc(size_t size) {
     size_t cls = RoundSize(size);

@@ -17,8 +17,9 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 os.environ.setdefault("MXNET_TEST_DEVICE", "cpu")
 
 import jax  # noqa: E402
+from mxnet_tpu.config import flags  # noqa: E402  (no jax side effects)
 
-if os.environ.get("MXNET_TEST_PLATFORM", "cpu") == "cpu":
+if flags.test_platform == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402

@@ -1,0 +1,62 @@
+"""Typed config/flag registry (SURVEY §5: unify env_var.md sprawl +
+DMLC_DECLARE_PARAMETER into one introspectable registry)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu import config
+
+
+def test_defaults_and_describe():
+    rows = {r["name"]: r for r in config.describe()}
+    assert rows["enable_x64"]["env"] == "MXNET_ENABLE_X64"
+    assert rows["engine_type"]["value"] in ("ThreadedEngine", "NaiveEngine")
+    for r in rows.values():
+        assert r["doc"]  # every flag is documented
+
+
+def test_env_parsing_and_reload():
+    os.environ["MXNET_CPU_WORKER_NTHREADS"] = "7"
+    try:
+        config.flags.reload("cpu_worker_nthreads")
+        assert config.flags.cpu_worker_nthreads == 7
+    finally:
+        del os.environ["MXNET_CPU_WORKER_NTHREADS"]
+        config.flags.reload("cpu_worker_nthreads")
+    assert config.flags.cpu_worker_nthreads == 4
+
+
+def test_override_context():
+    assert config.flags.enforce_determinism is False
+    with config.override(enforce_determinism=True):
+        assert config.flags.enforce_determinism is True
+    assert config.flags.enforce_determinism is False
+    with pytest.raises(KeyError):
+        with config.override(not_a_flag=1):
+            pass
+
+
+def test_unknown_flag_raises():
+    with pytest.raises(AttributeError):
+        config.flags.nope
+
+
+def test_enforce_determinism_blocks_autoseed():
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import mxnet_tpu as mx\n"
+        "try:\n"
+        "    mx.random.next_key()\n"
+        "except RuntimeError as e:\n"
+        "    assert 'MXNET_ENFORCE_DETERMINISM' in str(e)\n"
+        "    mx.random.seed(7)\n"
+        "    mx.random.next_key()\n"  # seeded: fine
+        "    print('BLOCKED_THEN_OK')\n")
+    env = dict(os.environ, MXNET_ENFORCE_DETERMINISM="1")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "BLOCKED_THEN_OK" in r.stdout

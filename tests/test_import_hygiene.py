@@ -1,0 +1,59 @@
+"""Import-time hygiene: `import mxnet_tpu` must do NO device work.
+
+Round-1 regression: a module-level `jnp.array` constant
+(ops/image_ops.py) forced full JAX backend initialization the moment the
+package was imported — on the driver machine that meant initializing the
+TPU plugin before bench.py/dryrun_multichip could pin a platform, killing
+both runs. These tests run in a subprocess (the parent test process has
+long since initialized a backend) and assert that importing the framework
+initializes no XLA backend and flips no global JAX config.
+"""
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(code, timeout=120, env_extra=None):
+    import os
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                          capture_output=True, text=True, env=env)
+
+
+def test_import_initializes_no_backend():
+    code = (
+        "import jax\n"
+        "import jax._src.xla_bridge as xb\n"
+        "import mxnet_tpu\n"
+        "import mxnet_tpu.ops.image_ops\n"
+        "assert not xb._backends, "
+        "'backends initialized at import: %r' % list(xb._backends)\n"
+        "print('CLEAN')\n")
+    r = _run(code)
+    assert r.returncode == 0, r.stderr
+    assert "CLEAN" in r.stdout
+
+
+def test_import_does_not_enable_x64_by_default():
+    code = (
+        "import jax\n"
+        "import mxnet_tpu\n"
+        "assert not jax.config.jax_enable_x64\n"
+        "print('F32DEFAULT')\n")
+    r = _run(code, env_extra={"MXNET_ENABLE_X64": ""})
+    assert r.returncode == 0, r.stderr
+    assert "F32DEFAULT" in r.stdout
+
+
+def test_x64_opt_in_via_env():
+    code = (
+        "import jax\n"
+        "import mxnet_tpu\n"
+        "assert jax.config.jax_enable_x64\n"
+        "print('X64ON')\n")
+    r = _run(code, env_extra={"MXNET_ENABLE_X64": "1"})
+    assert r.returncode == 0, r.stderr
+    assert "X64ON" in r.stdout

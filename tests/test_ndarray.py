@@ -18,8 +18,11 @@ def test_creation():
     assert (z.asnumpy() == 7).all()
     a = mx.nd.array([[1, 2], [3, 4]])
     assert a.dtype == np.float32
+    # int64 narrows to int32 unless x64 is opted in (MXNET_ENABLE_X64=1);
+    # the default matches the reference's f32/i32 compute types.
+    import jax
     b = mx.nd.array(np.array([1, 2], dtype=np.int64))
-    assert b.dtype == np.int64
+    assert b.dtype == (np.int64 if jax.config.jax_enable_x64 else np.int32)
     r = mx.nd.arange(0, 10, 2)
     assert_almost_equal(r, np.arange(0, 10, 2, dtype=np.float32))
 

@@ -193,3 +193,52 @@ def test_storage_double_free_is_noop():
     assert pool.pooled_bytes == pooled
     pool.direct_free(p)  # already pooled: no-op, no crash
     pool.close()
+
+
+def test_engine_duplicate_vars_no_deadlock():
+    """A var listed twice (in mutable, or in both const and mutable) must
+    not deadlock the var queue (advisor finding: the second queue entry
+    could never be granted)."""
+    eng = runtime.NativeEngine(2)
+    v = eng.new_variable()
+    w = eng.new_variable()
+    ran = []
+    eng.push(lambda: ran.append("dup-mut"), mutable_vars=[v, v])
+    eng.push(lambda: ran.append("const+mut"), const_vars=[v, w],
+             mutable_vars=[v])
+    eng.push(lambda: ran.append("dup-const"), const_vars=[w, w])
+    done = threading.Event()
+
+    def waiter():
+        eng.wait_all()
+        done.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    assert done.wait(timeout=10.0), "engine deadlocked on duplicate vars"
+    assert sorted(ran) == ["const+mut", "dup-const", "dup-mut"]
+    eng.close()
+
+
+def test_recordio_multipart_write_roundtrip(tmp_path):
+    """Payloads over the 29-bit length field go out as multi-part records
+    (cflag 1/2/3) and read back whole. Uses a tiny patched part size so the
+    test doesn't need a 512MB payload."""
+    path = str(tmp_path / "multi.rec")
+    w = recordio.MXRecordIO(path, "w")
+    orig = recordio.MXRecordIO._MAX_PART
+    recordio.MXRecordIO._MAX_PART = 16
+    try:
+        payload = bytes(range(256)) * 3  # 768 bytes -> 48 parts
+        w.write(b"small")
+        w.write(payload)
+        w.write(b"after")
+    finally:
+        recordio.MXRecordIO._MAX_PART = orig
+        w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == b"small"
+    assert r.read() == payload
+    assert r.read() == b"after"
+    assert r.read() is None
+    r.close()
