@@ -85,12 +85,39 @@ def _graph_eval_fn(symbol):
 
 
 class Executor:
-    """A bound, compiled computation graph."""
+    """A bound, compiled computation graph.
+
+    ``ctx`` may be a LIST of contexts: the executor then builds a 1-D 'dp'
+    device mesh over them and runs every compiled module SPMD — args named
+    in ``batch_args`` are sharded on their leading (batch) axis, parameters
+    and aux states are replicated, and GSPMD inserts the gradient
+    all-reduce inside the fused fwd+bwd program. This is the TPU-native
+    collapse of the reference's DataParallelExecutorGroup
+    (python/mxnet/module/executor_group.py:143): instead of N replicated
+    executors + host-side kvstore reduce, one XLA program spans the mesh
+    and the reduce rides ICI.
+    """
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None, group2ctx=None):
+                 grad_req="write", aux_states=None, group2ctx=None,
+                 batch_args=None):
         self._symbol = symbol
-        self._ctx = ctx or current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctxs = [Context(c) for c in ctx] or [current_context()]
+        else:
+            ctxs = [ctx or current_context()]
+        self._ctx = ctxs[0]
+        self._ctxs = ctxs
+        self._mesh = None
+        self._batch_args = frozenset(batch_args or ())
+        devices = []
+        for c in ctxs:
+            d = c.jax_device
+            if d not in devices:
+                devices.append(d)
+        if len(devices) > 1:
+            from jax.sharding import Mesh
+            self._mesh = Mesh(_np.asarray(devices), ("dp",))
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
@@ -137,6 +164,24 @@ class Executor:
         self._req_args = [k for k in self._arg_names
                           if self._grad_req.get(k, "null") != "null"]
 
+        # ---- mesh placement ------------------------------------------------
+        # Committed input shardings drive GSPMD: batch args sharded on dp,
+        # everything else replicated. The jitted modules below then compile
+        # as SPMD programs spanning the mesh; gradient all-reduce and
+        # cross-replica BatchNorm stats fall out of sharding propagation.
+        self._dp_sharding = self._rep_sharding = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._dp_sharding = NamedSharding(self._mesh, P("dp"))
+            self._rep_sharding = NamedSharding(self._mesh, P())
+            for name, arr in self.arg_dict.items():
+                arr._rebind(jax.device_put(arr._data, self._input_sharding(name)))
+            for arr in self.aux_dict.values():
+                arr._rebind(jax.device_put(arr._data, self._rep_sharding))
+            for arr in self.grad_dict.values():
+                if arr is not None:
+                    arr._rebind(jax.device_put(arr._data, self._rep_sharding))
+
         # ---- compiled callables -------------------------------------------
         eval_fn = _graph_eval_fn(symbol)
         self._eval_fn = eval_fn
@@ -182,21 +227,42 @@ class Executor:
         self._ones_cache = None
 
     # ---------------------------------------------------------------- run
+    def _input_sharding(self, name):
+        return self._dp_sharding if name in self._batch_args \
+            else self._rep_sharding
+
+    def _placed(self, nd_arr, sharding):
+        """Value of an NDArray, re-committed to `sharding` if a write
+        replaced it with a differently-placed array (writes like
+        ``arr[:] = v`` adopt v's placement). No-op when already placed."""
+        d = nd_arr._data
+        if not d.sharding.is_equivalent_to(sharding, d.ndim):
+            d = jax.device_put(d, sharding)
+            nd_arr._rebind(d)
+        return d
+
     def _arg_vals(self):
-        return {k: v._data for k, v in self.arg_dict.items()}
+        if self._mesh is None:
+            return {k: v._data for k, v in self.arg_dict.items()}
+        return {k: self._placed(v, self._input_sharding(k))
+                for k, v in self.arg_dict.items()}
 
     def _aux_vals(self):
-        return {k: v._data for k, v in self.aux_dict.items()}
+        if self._mesh is None:
+            return {k: v._data for k, v in self.aux_dict.items()}
+        return {k: self._placed(v, self._rep_sharding)
+                for k, v in self.aux_dict.items()}
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 if isinstance(v, NDArray):
-                    self.arg_dict[k]._rebind(v._data.astype(
-                        self.arg_dict[k].dtype))
+                    val = v._data.astype(self.arg_dict[k].dtype)
                 else:
-                    self.arg_dict[k]._rebind(
-                        jnp.asarray(_np.asarray(v), self.arg_dict[k].dtype))
+                    val = jnp.asarray(_np.asarray(v), self.arg_dict[k].dtype)
+                if self._mesh is not None:
+                    val = jax.device_put(val, self._input_sharding(k))
+                self.arg_dict[k]._rebind(val)
         key = _random.next_key()
         if is_train:
             if self._req_args:
@@ -265,13 +331,19 @@ class Executor:
                          allow_extra_params=False):
         for k, v in arg_params.items():
             if k in self.arg_dict:
-                self.arg_dict[k]._rebind(v._data.astype(self.arg_dict[k].dtype))
+                val = v._data.astype(self.arg_dict[k].dtype)
+                if self._mesh is not None:
+                    val = jax.device_put(val, self._input_sharding(k))
+                self.arg_dict[k]._rebind(val)
             elif not allow_extra_params:
                 raise MXNetError("unknown arg %r" % k)
         if aux_params:
             for k, v in aux_params.items():
                 if k in self.aux_dict:
-                    self.aux_dict[k]._rebind(v._data.astype(self.aux_dict[k].dtype))
+                    val = v._data.astype(self.aux_dict[k].dtype)
+                    if self._mesh is not None:
+                        val = jax.device_put(val, self._rep_sharding)
+                    self.aux_dict[k]._rebind(val)
                 elif not allow_extra_params:
                     raise MXNetError("unknown aux %r" % k)
 
@@ -292,8 +364,10 @@ class Executor:
             cur = self.aux_dict[name]
             new_aux[name] = cur if shp is None or tuple(shp) == cur.shape \
                 else _nd.zeros(shp, ctx=self._ctx, dtype=cur.dtype)
-        return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self._grad_req, new_aux)
+        return Executor(self._symbol,
+                        self._ctxs if self._mesh is not None else self._ctx,
+                        new_args, new_grads, self._grad_req, new_aux,
+                        batch_args=self._batch_args)
 
     @property
     def output_dict(self):
@@ -301,12 +375,15 @@ class Executor:
 
 
 def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
-                group2ctx=None, **kwargs):
+                group2ctx=None, batch_args=None, **kwargs):
     """Infer shapes from partial bindings, allocate arrays, bind.
 
+    ``ctx`` may be a list of contexts for SPMD data parallelism (see
+    Executor); ``batch_args`` names the args sharded on their batch axis.
     reference: GraphExecutor::Init simple_bind path (graph_executor.cc:1594).
     """
     ctx = ctx or current_context()
+    alloc_ctx = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
     shape_kwargs = {k: v for k, v in kwargs.items()
                     if isinstance(v, (tuple, list))}
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
@@ -316,15 +393,16 @@ def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
     args = {}
     for name, shp in zip(arg_names, arg_shapes):
         dt = type_dict.get(name, _np.float32)
-        args[name] = _nd.zeros(shp, ctx=ctx, dtype=dt)
+        args[name] = _nd.zeros(shp, ctx=alloc_ctx, dtype=dt)
     if isinstance(grad_req, str):
         req_map = {k: grad_req for k in arg_names}
     elif isinstance(grad_req, (list, tuple)):
         req_map = dict(zip(arg_names, grad_req))
     else:
         req_map = {k: grad_req.get(k, "null") for k in arg_names}
-    args_grad = {k: _nd.zeros(args[k].shape, ctx=ctx, dtype=args[k].dtype)
+    args_grad = {k: _nd.zeros(args[k].shape, ctx=alloc_ctx, dtype=args[k].dtype)
                  for k in arg_names if req_map.get(k, "null") != "null"}
-    aux = {name: _nd.zeros(shp, ctx=ctx)
+    aux = {name: _nd.zeros(shp, ctx=alloc_ctx)
            for name, shp in zip(aux_names, aux_shapes)}
-    return Executor(symbol, ctx, args, args_grad, req_map, aux)
+    return Executor(symbol, ctx, args, args_grad, req_map, aux,
+                    batch_args=batch_args)

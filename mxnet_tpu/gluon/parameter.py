@@ -134,15 +134,17 @@ class Parameter:
         own_init = init or self.init
         if self._shape is None or any(s == 0 for s in self._shape):
             if self.allow_deferred_init:
-                self._deferred_init = (own_init, default_init, ctx[0])
+                self._deferred_init = (own_init, default_init, list(ctx))
                 return
             raise ValueError(
                 "Cannot initialize Parameter '%s' because it has invalid "
                 "shape: %s." % (self.name, str(self._shape)))
-        self._finish_init(own_init, default_init, ctx[0])
+        self._finish_init(own_init, default_init, list(ctx))
 
     def _finish_init(self, own_init, default_init, ctx):
-        arr = _nd.zeros(self._shape, dtype=self.dtype, ctx=ctx)
+        ctx_list = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        self._ctx_list = list(ctx_list)
+        arr = _nd.zeros(self._shape, dtype=self.dtype, ctx=ctx_list[0])
         desc = _init.InitDesc(self.name)
         if own_init is not None:
             # a parameter-specific init bypasses the name-suffix dispatch
@@ -157,6 +159,21 @@ class Parameter:
                 else default_init
             desc.global_init = dflt
             dflt(desc, arr)
+        # Multiple distinct devices => replicate over a dp mesh: the single
+        # logical copy spans the mesh, sharded batches (split_and_load)
+        # compute SPMD against it, and backward's grads arrive pre-reduced
+        # (GSPMD psum) — the TPU-native collapse of per-device param copies
+        # + kvstore reduce (reference gluon/trainer.py:293).
+        devices = []
+        for c in ctx_list:
+            d = c.jax_device
+            if d not in devices:
+                devices.append(d)
+        if len(devices) > 1:
+            import jax
+            from ..parallel.mesh import dp_mesh, replicated
+            arr._rebind(jax.device_put(
+                arr._data, replicated(dp_mesh(devices))))
         self._data = arr
         self._deferred_init = None
         if self._grad_req != "null":
@@ -173,8 +190,8 @@ class Parameter:
         self._finish_init(own_init, default_init, ctx)
 
     def _init_grad(self):
-        self._grad = _nd.zeros(self._data.shape, dtype=self._data.dtype,
-                               ctx=self._data.context)
+        # zeros_like inherits the data's placement (incl. mesh replication)
+        self._grad = _nd.zeros_like(self._data)
         self._data.attach_grad(grad_req=self._grad_req)
         self._data._ag.grad = self._grad
 
@@ -213,9 +230,11 @@ class Parameter:
 
     def list_ctx(self):
         if self._data is None and self._deferred_init is not None:
-            return [self._deferred_init[2]]
+            ctx = self._deferred_init[2]
+            return list(ctx) if isinstance(ctx, (list, tuple)) else [ctx]
         self._check_initialized()
-        return [self._data.context]
+        return list(getattr(self, "_ctx_list", None)
+                    or [self._data.context])
 
     def set_data(self, data):
         if not isinstance(data, _nd.NDArray):

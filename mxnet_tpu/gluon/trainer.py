@@ -73,8 +73,11 @@ class Trainer:
             if self._compression_params:
                 kv.set_gradient_compression(self._compression_params)
             if update_on_kvstore is None:
-                update_on_kvstore = kv.type.startswith("dist") or \
-                    kv.type == "tpu_sync"
+                # dist_*: optimizer runs on the server (reference default).
+                # tpu_sync has no server — grads arrive pre-reduced from the
+                # SPMD program; the updater applies them to the replicated
+                # parameters directly.
+                update_on_kvstore = kv.type.startswith("dist")
             self._update_on_kvstore = update_on_kvstore
             for i, param in enumerate(self._params):
                 if param.grad_req == "null":

@@ -36,9 +36,40 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    """Split data into len(ctx_list) slices and load one per context."""
+    """Load data onto the contexts for data-parallel compute.
+
+    Reference semantics (gluon/utils.py): N contexts -> N batch slices, one
+    per device, each fed through a replicated model. TPU-native semantics:
+    when the contexts resolve to multiple distinct devices, the slices are
+    ONE jax array sharded on the batch axis over a 'dp' mesh — returned as
+    a single-element list so reference-style ``for x in split_and_load(...)``
+    loops run once over the global batch, SPMD underneath (parameters
+    initialized with the same ctx list are mesh-replicated, and gradient
+    reduction happens inside XLA instead of in Trainer/kvstore python).
+    """
     if not isinstance(data, _nd.NDArray):
         data = _nd.array(data, ctx=ctx_list[0])
+    devices = []
+    for c in ctx_list:
+        d = c.jax_device
+        if d not in devices:
+            devices.append(d)
+    if len(devices) > 1:
+        import jax
+        from ..parallel.mesh import dp_mesh, data_parallel_sharding
+        n = len(devices)
+        if data.shape[batch_axis] % n != 0:
+            if even_split:
+                raise ValueError(
+                    "data with shape %s cannot be split evenly on axis %d "
+                    "across %d devices" % (data.shape, batch_axis, n))
+            # uneven: fall back to host-side slices on the first device
+            slices = split_data(data, n, batch_axis, even_split=False)
+            return [s.as_in_context(ctx_list[0]) for s in slices]
+        sharding = data_parallel_sharding(dp_mesh(devices), batch_axis)
+        arr = _nd.NDArray(jax.device_put(data._data, sharding),
+                          ctx=ctx_list[0])
+        return [arr]
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
     slices = split_data(data, len(ctx_list), batch_axis, even_split)

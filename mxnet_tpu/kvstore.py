@@ -94,11 +94,10 @@ class KVStore:
                     raise MXNetError("key %r not initialized" % k)
                 self._updater(k, agg, self._store[k])
             else:
-                if k in self._store and self._type != "local_allreduce":
-                    # default behavior: aggregate into stored value
-                    self._store[k] = agg
-                else:
-                    self._store[k] = agg
+                # no updater: the merged push REPLACES the stored value
+                # (reference kvstore_local.h PushImpl `local = merged`;
+                # python/mxnet/kvstore.py push docstring examples)
+                self._store[k] = agg
 
     def _reduce(self, vs):
         """Sum a list of per-device values (CommDevice::Reduce analog —

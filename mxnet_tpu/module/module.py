@@ -105,7 +105,12 @@ class Module(BaseModule):
         shape_kwargs = {}
         for desc in self._data_shapes + (self._label_shapes or []):
             shape_kwargs[desc[0]] = desc[1]
-        ctx = self._context[0]
+        # context=[c0, c1, ...] selects SPMD data parallelism: the executor
+        # builds a 'dp' mesh over the devices, shards data/label on the
+        # batch axis, replicates parameters, and GSPMD all-reduces the
+        # gradients inside the compiled step (the reference's
+        # DataParallelExecutorGroup + kvstore reduce, collapsed into XLA).
+        ctx = self._context if len(self._context) > 1 else self._context[0]
         req = {}
         for name in self._symbol.list_arguments():
             if name in self._data_names:
@@ -117,8 +122,9 @@ class Module(BaseModule):
             else:
                 req[name] = grad_req if for_training else "null"
         from ..executor import simple_bind
-        self._exec = simple_bind(self._symbol, ctx, grad_req=req,
-                                 **shape_kwargs)
+        self._exec = simple_bind(
+            self._symbol, ctx, grad_req=req,
+            batch_args=self._data_names + self._label_names, **shape_kwargs)
         if self._arg_params is not None:
             self._exec.copy_params_from(self._arg_params, self._aux_params,
                                         allow_extra_params=True)
@@ -192,10 +198,12 @@ class Module(BaseModule):
                 kv = kvstore
             if self._compression_params:
                 kv.set_gradient_compression(self._compression_params)
-            # update_on_kvstore: reference default for dist_* and local with
-            # optimizer offload; with one executor the updater path is
-            # equivalent — keep kv for push/pull parity when dist
-            update_on_kvstore = kv.type.startswith("dist") or kv.type == "tpu_sync"
+            # update_on_kvstore: reference default for dist_* (optimizer
+            # runs on the server). tpu_sync has no server — its gradient
+            # all-reduce happens inside the compiled SPMD step (GSPMD psum
+            # over the executor's mesh), so the update applies directly to
+            # the executor's replicated weights via the updater path.
+            update_on_kvstore = kv.type.startswith("dist")
         self._kvstore = kv
         self._update_on_kvstore = update_on_kvstore
 

@@ -28,9 +28,16 @@ from .. import engine as _engine
 from .. import autograd as _autograd
 from ..ops import registry as _registry
 
-__all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
-           "concat", "invoke", "waitall", "save", "load", "moveaxis",
-           "imperative_invoke"]
+__all__ = ["NDArray", "array", "zeros", "zeros_like", "ones", "full",
+           "arange", "empty", "concat", "invoke", "waitall", "save", "load",
+           "moveaxis", "imperative_invoke"]
+
+
+def zeros_like(other):
+    """Zeros with the shape/dtype/placement of `other` — placement includes
+    mesh sharding, so optimizer state created from a replicated weight is
+    itself replicated (jnp.zeros_like preserves sharding)."""
+    return NDArray(jnp.zeros_like(other._data), ctx=other.context)
 
 
 _X64_NARROW = {_np.dtype(_np.int64): _np.int32,
@@ -130,7 +137,14 @@ class NDArray:
 
     def copyto(self, other):
         if isinstance(other, NDArray):
-            other._rebind(jax.device_put(self._data, other._ctx.jax_device))
+            # writing into a buffer preserves the buffer's placement —
+            # including mesh sharding/replication, which a bare
+            # ``device_put(..., ctx.jax_device)`` would collapse to one chip
+            if other.shape == self.shape:
+                dst = other._data.sharding
+            else:
+                dst = other._ctx.jax_device
+            other._rebind(jax.device_put(self._data, dst))
             return other
         if isinstance(other, Context):
             return self.as_in_context(other)

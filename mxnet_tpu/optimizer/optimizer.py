@@ -146,7 +146,7 @@ class SGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _nd.zeros_like(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -169,7 +169,7 @@ class NAG(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _nd.zeros_like(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -191,8 +191,8 @@ class Adam(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (_nd.zeros_like(weight),
+                _nd.zeros_like(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -215,7 +215,7 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _nd.zeros_like(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -232,8 +232,8 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (_nd.zeros_like(weight),
+                _nd.zeros_like(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -255,7 +255,7 @@ class RMSProp(Optimizer):
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        z = lambda: _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        z = lambda: _nd.zeros_like(weight)
         if self.centered:
             return (z(), z(), z())
         return z()
@@ -285,8 +285,8 @@ class Ftrl(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (_nd.zeros_like(weight),
+                _nd.zeros_like(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -308,7 +308,7 @@ class Signum(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _nd.zeros_like(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -338,7 +338,7 @@ class FTML(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        z = lambda: _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        z = lambda: _nd.zeros_like(weight)
         return (z(), z(), z())
 
     def update(self, index, weight, grad, state):
@@ -365,8 +365,8 @@ class Nadam(Optimizer):
         self.m_schedule = 1.0
 
     def create_state(self, index, weight):
-        return (_nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (_nd.zeros_like(weight),
+                _nd.zeros_like(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -401,7 +401,7 @@ class DCASGD(Optimizer):
         self.lamda = lamda
 
     def create_state(self, index, weight):
-        z = lambda: _nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        z = lambda: _nd.zeros_like(weight)
         return (z() if self.momentum != 0.0 else None, weight.copy())
 
     def update(self, index, weight, grad, state):
@@ -450,7 +450,7 @@ class LBSGD(SGD):
 @register
 class Test(Optimizer):
     def create_state(self, index, weight):
-        return _nd.zeros(weight.shape, ctx=weight.context)
+        return _nd.zeros_like(weight)
 
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad

@@ -6,8 +6,23 @@ import numpy as _np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "data_parallel_sharding", "replicated", "P",
-           "NamedSharding", "Mesh"]
+__all__ = ["make_mesh", "dp_mesh", "data_parallel_sharding", "replicated",
+           "P", "NamedSharding", "Mesh"]
+
+
+def dp_mesh(devices):
+    """1-D data-parallel mesh over `devices` (order-preserving, cached so
+    executors/parameters/loaders built from the same context list share one
+    Mesh object)."""
+    return _dp_mesh_cached(tuple(devices))
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _dp_mesh_cached(devices):
+    return Mesh(_np.asarray(devices), ("dp",))
 
 
 def make_mesh(axes, devices=None):

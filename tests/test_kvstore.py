@@ -1,0 +1,114 @@
+"""KVStore behavior contract (model: reference
+tests/python/unittest/test_kvstore.py + python/mxnet/kvstore.py docstring
+examples)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kv_mod
+
+SHAPE = (4, 3)
+
+
+def test_init_and_pull():
+    kv = kv_mod.create("local")
+    kv.init("3", mx.nd.ones(SHAPE) * 2)
+    a = mx.nd.zeros(SHAPE)
+    kv.pull("3", out=a)
+    np.testing.assert_array_equal(a.asnumpy(), 2 * np.ones(SHAPE))
+
+
+def test_push_replaces_without_updater():
+    # reference kvstore_local.h PushImpl: no updater => local = merged
+    kv = kv_mod.create("local")
+    kv.init("3", mx.nd.ones(SHAPE) * 2)
+    kv.push("3", mx.nd.ones(SHAPE) * 8)
+    a = mx.nd.zeros(SHAPE)
+    kv.pull("3", out=a)
+    np.testing.assert_array_equal(a.asnumpy(), 8 * np.ones(SHAPE))
+
+
+def test_push_multi_value_sums():
+    # "aggregate the value and then push" example: 4 device grads sum to 4
+    kv = kv_mod.create("local")
+    kv.init("3", mx.nd.zeros(SHAPE))
+    kv.push("3", [mx.nd.ones(SHAPE) for _ in range(4)])
+    a = mx.nd.zeros(SHAPE)
+    kv.pull("3", out=a)
+    np.testing.assert_array_equal(a.asnumpy(), 4 * np.ones(SHAPE))
+
+
+def test_updater_aggregation():
+    # custom updater: stored += merged (the classic kvstore test updater)
+    kv = kv_mod.create("local")
+    kv.init("9", mx.nd.ones(SHAPE))
+
+    def update(key, input_, stored):
+        stored += input_ * 2
+    kv._set_updater(update)
+    kv.push("9", [mx.nd.ones(SHAPE)] * 4)
+    a = mx.nd.zeros(SHAPE)
+    kv.pull("9", out=a)
+    # 1 + 2*sum(4 ones) = 9
+    np.testing.assert_array_equal(a.asnumpy(), 9 * np.ones(SHAPE))
+
+
+def test_push_uninitialized_key_with_updater_raises():
+    kv = kv_mod.create("local")
+    kv._set_updater(lambda k, g, w: None)
+    with pytest.raises(mx.MXNetError):
+        kv.push("nope", mx.nd.ones(SHAPE))
+
+
+def test_list_key_push_pull():
+    kv = kv_mod.create("local")
+    keys = ["4", "5", "6"]
+    for k in keys:
+        kv.init(k, mx.nd.zeros(SHAPE))
+    kv.push(keys, [mx.nd.ones(SHAPE)] * len(keys))
+    outs = [mx.nd.zeros(SHAPE) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        np.testing.assert_array_equal(o.asnumpy(), np.ones(SHAPE))
+
+
+def test_row_sparse_pull():
+    kv = kv_mod.create("local")
+    dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("rs", mx.nd.array(dense))
+    out = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("rs", out=out, row_ids=mx.nd.array([0, 2]))
+    expect = np.zeros((4, 3), np.float32)
+    expect[[0, 2]] = dense[[0, 2]]
+    np.testing.assert_array_equal(out.asnumpy(), expect)
+
+
+def test_gradient_compression_roundtrip():
+    kv = kv_mod.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("g", mx.nd.zeros((8,)))
+    g = mx.nd.array(np.array([1.0, -1.0, 0.1, -0.1, 0.6, -0.6, 0.0, 2.0],
+                             np.float32))
+    kv.push("g", g)
+    a = mx.nd.zeros((8,))
+    kv.pull("g", out=a)
+    got = a.asnumpy()
+    # quantized to {-thr, 0, +thr}
+    assert set(np.unique(got)).issubset({-0.5, 0.0, 0.5})
+    # error feedback: residual carries the difference to the next push
+    kv.push("g", mx.nd.zeros((8,)))
+    b = mx.nd.zeros((8,))
+    kv.pull("g", out=b)
+    assert set(np.unique(b.asnumpy())).issubset({-0.5, 0.0, 0.5})
+
+
+def test_invalid_type_rejected():
+    with pytest.raises(ValueError):
+        kv_mod.create("bogus")
+
+
+def test_rank_and_num_workers_single_process():
+    kv = kv_mod.create("dist_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    assert kv.get_num_dead_node() == 0
