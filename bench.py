@@ -109,25 +109,55 @@ def main():
     except Exception:
         pass
 
-    # warmup (compile)
+    def force(*arrays):
+        # Forced HOST FETCH: device_get must materialize the bytes, so it
+        # cannot return before every step in the dependency chain has run.
+        # (round 2 used block_until_ready, which does not reliably block on
+        # proxy/tunnel backends — it reported a physically impossible 661%
+        # MFU. A host fetch is the ground truth.)
+        vals = [np.asarray(jax.device_get(a)) for a in arrays]
+        return float(vals[0].ravel()[0])
+
+    # warmup (compile + settle)
     for i in range(3):
         key = jax.random.fold_in(base_key, i)
         params, aux, opt, outs = step(params, aux, opt, data, label, key)
-    jax.block_until_ready(outs[0])
+    force(outs[0], next(iter(params.values())))
 
     n_steps = 30 if on_tpu else 3
     t0 = time.perf_counter()
     for i in range(n_steps):
         key = jax.random.fold_in(base_key, 100 + i)
         params, aux, opt, outs = step(params, aux, opt, data, label, key)
-    jax.block_until_ready(outs[0])
+    # end timing on a host fetch of BOTH the last outputs and the updated
+    # params: the params chain through every step, so this transitively
+    # waits for all n_steps programs.
+    force(outs[0], next(iter(params.values())))
     dt = time.perf_counter() - t0
     img_s = batch * n_steps / dt
+    step_ms = dt / n_steps * 1e3
+
+    # cross-check: fully synchronous per-step latency (fetch every step).
+    # An async-dispatch bug shows up as sync_step_ms >> step_ms.
+    n_sync = 5 if on_tpu else 1
+    t1 = time.perf_counter()
+    for i in range(n_sync):
+        key = jax.random.fold_in(base_key, 200 + i)
+        params, aux, opt, outs = step(params, aux, opt, data, label, key)
+        force(outs[0])
+    sync_step_ms = (time.perf_counter() - t1) / n_sync * 1e3
 
     mfu = 0.0
     if on_tpu:
         mfu = (img_s / batch) * flops_per_step / _peak_flops(
             devices[0].device_kind)
+        # A broken harness must fail loudly, not record an impossible number
+        # (raise, not assert: asserts vanish under python -O).
+        if not 0.0 < mfu <= 1.0:
+            raise RuntimeError(
+                "measured MFU %.3f is outside (0, 1] — timing harness is not "
+                "measuring execution (step_ms=%.2f sync_step_ms=%.2f)"
+                % (mfu, step_ms, sync_step_ms))
 
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_b%d_bf16%s"
@@ -136,6 +166,8 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "mfu": round(mfu, 4),
+        "step_ms": round(step_ms, 3),
+        "sync_step_ms": round(sync_step_ms, 3),
         "device": devices[0].device_kind,
         "flops_per_step": flops_per_step,
     }))

@@ -231,6 +231,21 @@ class Executor:
         return self._dp_sharding if name in self._batch_args \
             else self._rep_sharding
 
+    def _to_exec_device(self, val):
+        dev = self._ctx.jax_device
+        if dev is not None and val.sharding.device_set != {dev}:
+            val = jax.device_put(val, dev)
+        return val
+
+    def _place_input(self, val, name, replicated=False):
+        """Place a host/foreign-device value where this executor computes:
+        the named input's mesh sharding when SPMD, else the executor device."""
+        if self._mesh is not None:
+            return jax.device_put(
+                val, self._rep_sharding if replicated
+                else self._input_sharding(name))
+        return self._to_exec_device(val)
+
     def _placed(self, nd_arr, sharding):
         """Value of an NDArray, re-committed to `sharding` if a write
         replaced it with a differently-placed array (writes like
@@ -260,9 +275,10 @@ class Executor:
                     val = v._data.astype(self.arg_dict[k].dtype)
                 else:
                     val = jnp.asarray(_np.asarray(v), self.arg_dict[k].dtype)
-                if self._mesh is not None:
-                    val = jax.device_put(val, self._input_sharding(k))
-                self.arg_dict[k]._rebind(val)
+                # feed may come from a host iterator (NDArrayIter on cpu()):
+                # place it where the executor computes or jit sees mixed
+                # platforms
+                self.arg_dict[k]._rebind(self._place_input(val, k))
         key = _random.next_key()
         if is_train:
             if self._req_args:
@@ -302,7 +318,8 @@ class Executor:
         if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            ograds = [g._data for g in out_grads]
+            ograds = [self._place_input(g._data, None, replicated=True)
+                      for g in out_grads]
             key = _random.next_key()
             outs, auxu, grads = self._fwd_bwd(
                 self._arg_vals(), self._aux_vals(), key, ograds)
@@ -332,18 +349,15 @@ class Executor:
         for k, v in arg_params.items():
             if k in self.arg_dict:
                 val = v._data.astype(self.arg_dict[k].dtype)
-                if self._mesh is not None:
-                    val = jax.device_put(val, self._input_sharding(k))
-                self.arg_dict[k]._rebind(val)
+                self.arg_dict[k]._rebind(self._place_input(val, k))
             elif not allow_extra_params:
                 raise MXNetError("unknown arg %r" % k)
         if aux_params:
             for k, v in aux_params.items():
                 if k in self.aux_dict:
                     val = v._data.astype(self.aux_dict[k].dtype)
-                    if self._mesh is not None:
-                        val = jax.device_put(val, self._rep_sharding)
-                    self.aux_dict[k]._rebind(val)
+                    self.aux_dict[k]._rebind(
+                        self._place_input(val, k, replicated=True))
                 elif not allow_extra_params:
                     raise MXNetError("unknown aux %r" % k)
 

@@ -97,3 +97,21 @@ def test_int_inputs_are_autograd_constants():
     e.backward()
     rowsums = w.grad.asnumpy().sum(axis=1)
     np.testing.assert_allclose(rowsums, [3., 0., 3., 0., 0.])
+
+
+def test_module_fit_feed_from_other_device():
+    """Module.fit feed data must be placed on the executor's device (round-3
+    verify found CPU NDArrayIter + tpu() executor crashing with mixed
+    platforms). Reproduced here with two virtual CPU devices."""
+    import mxnet_tpu as mx_
+    ctx1 = mx_.Context("cpu", 1)
+    data = mx_.sym.Variable("data")
+    net = mx_.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = mx_.sym.SoftmaxOutput(net, name="softmax")
+    X = np.random.RandomState(0).randn(32, 8).astype("float32")
+    Y = (X[:, 0] > 0).astype("float32")
+    it = mx_.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mod = mx_.mod.Module(net, context=ctx1)
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    mod.score(it, mx_.metric.Accuracy())
