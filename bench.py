@@ -1,4 +1,5 @@
-"""Benchmark: ResNet-50 synthetic-data training throughput on one chip.
+"""Benchmark: ResNet-50 synthetic-data training throughput on one chip,
+measured THROUGH the product API (`Module.fit`), not around it.
 
 Mirrors the reference's `train_imagenet.py --benchmark 1` measurement
 (reference docs/faq/perf.md:228-237; BASELINE.md). vs_baseline compares
@@ -6,18 +7,18 @@ against the reference's published V100 number at the same batch size:
 363.69 img/s (batch 128, MXNet 1.2 + cuDNN, docs/faq/perf.md:237).
 
 Methodology:
-* master weights / optimizer state / BN stats in float32, compute in
-  bfloat16 (mixed precision — the TPU analog of the reference's
-  multi-precision fp16 path, docs/faq/perf.md:181-194);
-* fresh PRNG key per step (folded), donated buffers, fused
-  fwd+bwd+update in one XLA program;
-* reports MFU = achieved FLOP/s / chip peak, with FLOPs taken from XLA's
-  cost analysis of the compiled step (falling back to the analytic
-  3 x 2 x 4.1 GFLOP/img ResNet-50 estimate).
-
-Robustness: the TPU backend is probed in a subprocess with a timeout so a
-wedged tunnel cannot hang the bench; on probe failure we pin the CPU
-platform and mark the result `_CPU_FALLBACK`.
+* `Module.fit(kvstore='tpu_sync', optimizer_params={'multi_precision':
+  True})` — the fused one-XLA-program step (module/fused.py): fwd+bwd+
+  optimizer update, f32 master weights, bf16 compute (the TPU analog of the
+  reference's fp16 multi-precision path, docs/faq/perf.md:181-194);
+* one device-resident synthetic batch repeated (the reference's
+  --benchmark 1 semantics), `eval_metric=None` so no per-batch host sync;
+* timing ends on a FORCED HOST FETCH of updated params (device_get):
+  block_until_ready does not reliably block on proxy backends and round 2
+  recorded an impossible number because of it; a fully-synchronous
+  per-step cross-check is also reported;
+* MFU = achieved FLOP/s / chip peak, FLOPs from XLA's cost analysis of the
+  compiled fused step (fallback: analytic 3 x 2 x 4.1 GFLOP/img).
 
 One JSON line on stdout: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -58,6 +59,32 @@ def probe_tpu(timeout: float) -> bool:
         return False
 
 
+class _OneBatchIter:
+    """Reference --benchmark 1 semantics: one device-resident batch,
+    repeated; zero input-pipeline cost so the step program is what's
+    measured."""
+
+    def __init__(self, batch, steps, provide_data, provide_label):
+        self._batch = batch
+        self._steps = steps
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+        self.batch_size = provide_data[0].shape[0]
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._i >= self._steps:
+            raise StopIteration
+        self._i += 1
+        return self._batch
+
+    def reset(self):
+        self._i = 0
+
+
 def main():
     probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
     want_cpu = os.environ.get("BENCH_PLATFORM", "") == "cpu"
@@ -66,42 +93,80 @@ def main():
     import jax
     if not on_tpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
-    import mxnet_tpu  # noqa: F401
+    import mxnet_tpu as mx
     from mxnet_tpu import models
-    from mxnet_tpu.parallel import SPMDTrainStep, make_mesh
+    from mxnet_tpu.io import DataBatch, DataDesc
 
-    devices = jax.devices()[:1]
-    on_tpu = devices[0].platform != "cpu"
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    ctx = mx.tpu() if on_tpu else mx.cpu()
     batch = 128 if on_tpu else 8  # CPU fallback: smoke-size only
+    steps = 30 if on_tpu else 3
 
     sym = models.resnet_symbol(num_classes=1000, num_layers=50)
-    arg_shapes, _, aux_shapes = sym.infer_shape(data=(batch, 3, 224, 224))
-    arg_names = sym.list_arguments()
-    aux_names = sym.list_auxiliary_states()
-    param_shapes = {n: tuple(s) for n, s in zip(arg_names, arg_shapes)
-                    if n not in ("data", "softmax_label")}
-    aux_shapes_d = {n: tuple(s) for n, s in zip(aux_names, aux_shapes)}
-
-    mesh = make_mesh({"dp": 1}, devices=devices)
-    step = SPMDTrainStep(sym, mesh, lr=0.05, dtype=jnp.bfloat16)
-    step.compile(param_shapes, aux_shapes_d,
-                 {"data": (batch, 3, 224, 224)},
-                 {"softmax_label": (batch,)})
-    params, aux, opt = step.init(param_shapes, aux_shapes_d)
-
     rng = np.random.RandomState(0)
-    data = {"data": jnp.asarray(rng.randn(batch, 3, 224, 224), jnp.bfloat16)}
-    label = {"softmax_label": jnp.asarray(
-        rng.randint(0, 1000, (batch,)), jnp.float32)}
-    base_key = jax.random.PRNGKey(0)
+    data_nd = mx.nd.array(rng.randn(batch, 3, 224, 224).astype(np.float32),
+                          ctx=ctx)
+    label_nd = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32),
+                           ctx=ctx)
+    it = _OneBatchIter(
+        DataBatch(data=[data_nd], label=[label_nd]), steps,
+        [DataDesc("data", (batch, 3, 224, 224))],
+        [DataDesc("softmax_label", (batch,))])
 
-    # FLOPs/step from XLA cost analysis of the compiled step
+    mod = mx.mod.Module(sym, context=ctx)
+
+    def force():
+        # host fetch: cannot return before the whole dependency chain ran
+        arr = mod._exec.arg_dict[mod._param_names[0]]._data
+        return float(np.asarray(jax.device_get(arr)).ravel()[0])
+
+    times = []
+
+    def epoch_cb(epoch, symbol, arg_p, aux_p):
+        force()
+        times.append(time.perf_counter())
+
+    # epoch 0 = warmup/compile; epochs 1..2 timed (through Module.fit)
+    mod.fit(it, num_epoch=3, eval_metric=None, kvstore="tpu_sync",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                              "multi_precision": True},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.0),
+            epoch_end_callback=epoch_cb)
+    if mod._fused is None:
+        raise RuntimeError("tpu_sync did not engage the fused train step — "
+                           "bench would measure the eager path")
+    dt = times[-1] - times[0]
+    n_timed = steps * (len(times) - 1)
+    img_s = batch * n_timed / dt
+    step_ms = dt / n_timed * 1e3
+
+    # cross-check: fully synchronous per-step latency (fetch every step).
+    # An async-dispatch bug shows up as sync_step_ms >> step_ms.
+    n_sync = 5 if on_tpu else 1
+    batch_obj = it._batch
+    t1 = time.perf_counter()
+    for _ in range(n_sync):
+        mod.forward_backward(batch_obj)
+        mod.update()
+        force()
+    sync_step_ms = (time.perf_counter() - t1) / n_sync * 1e3
+
+    # FLOPs/step from XLA cost analysis of the compiled fused program
     flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
     try:
-        cost = step._jitted.lower(
-            params, aux, opt, data, label, base_key).compile().cost_analysis()
+        import jax.numpy as jnp
+        ex = mod._exec
+        fused = mod._fused
+        npar = len(fused.param_names)
+        lowered = fused._jitted.lower(
+            ex._arg_vals(), ex._aux_vals(), mod._fused_opt_state,
+            jnp.zeros((npar,), jnp.float32), jnp.zeros((npar,), jnp.float32),
+            np.float32(1.0), np.int32(1), jax.random.PRNGKey(0))
+        cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         if cost and cost.get("flops", 0) > 0:
@@ -109,58 +174,19 @@ def main():
     except Exception:
         pass
 
-    def force(*arrays):
-        # Forced HOST FETCH: device_get must materialize the bytes, so it
-        # cannot return before every step in the dependency chain has run.
-        # (round 2 used block_until_ready, which does not reliably block on
-        # proxy/tunnel backends — it reported a physically impossible 661%
-        # MFU. A host fetch is the ground truth.)
-        vals = [np.asarray(jax.device_get(a)) for a in arrays]
-        return float(vals[0].ravel()[0])
-
-    # warmup (compile + settle)
-    for i in range(3):
-        key = jax.random.fold_in(base_key, i)
-        params, aux, opt, outs = step(params, aux, opt, data, label, key)
-    force(outs[0], next(iter(params.values())))
-
-    n_steps = 30 if on_tpu else 3
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        key = jax.random.fold_in(base_key, 100 + i)
-        params, aux, opt, outs = step(params, aux, opt, data, label, key)
-    # end timing on a host fetch of BOTH the last outputs and the updated
-    # params: the params chain through every step, so this transitively
-    # waits for all n_steps programs.
-    force(outs[0], next(iter(params.values())))
-    dt = time.perf_counter() - t0
-    img_s = batch * n_steps / dt
-    step_ms = dt / n_steps * 1e3
-
-    # cross-check: fully synchronous per-step latency (fetch every step).
-    # An async-dispatch bug shows up as sync_step_ms >> step_ms.
-    n_sync = 5 if on_tpu else 1
-    t1 = time.perf_counter()
-    for i in range(n_sync):
-        key = jax.random.fold_in(base_key, 200 + i)
-        params, aux, opt, outs = step(params, aux, opt, data, label, key)
-        force(outs[0])
-    sync_step_ms = (time.perf_counter() - t1) / n_sync * 1e3
-
     mfu = 0.0
     if on_tpu:
-        mfu = (img_s / batch) * flops_per_step / _peak_flops(
-            devices[0].device_kind)
+        mfu = (img_s / batch) * flops_per_step / _peak_flops(dev.device_kind)
         # A broken harness must fail loudly, not record an impossible number
         # (raise, not assert: asserts vanish under python -O).
         if not 0.0 < mfu <= 1.0:
             raise RuntimeError(
-                "measured MFU %.3f is outside (0, 1] — timing harness is not "
-                "measuring execution (step_ms=%.2f sync_step_ms=%.2f)"
+                "measured MFU %.3f is outside (0, 1] — timing harness is "
+                "not measuring execution (step_ms=%.2f sync_step_ms=%.2f)"
                 % (mfu, step_ms, sync_step_ms))
 
     print(json.dumps({
-        "metric": "resnet50_train_img_per_sec_b%d_bf16%s"
+        "metric": "resnet50_module_fit_img_per_sec_b%d_bf16%s"
                   % (batch, "" if on_tpu else "_CPU_FALLBACK"),
         "value": round(img_s, 2),
         "unit": "img/s",
@@ -168,7 +194,7 @@ def main():
         "mfu": round(mfu, 4),
         "step_ms": round(step_ms, 3),
         "sync_step_ms": round(sync_step_ms, 3),
-        "device": devices[0].device_kind,
+        "device": dev.device_kind,
         "flops_per_step": flops_per_step,
     }))
 

@@ -151,6 +151,18 @@ register_flag("profiler_autostart", "MXNET_PROFILER_AUTOSTART",
               _parse_bool, False,
               "Start the profiler when mxnet_tpu.profiler is first "
               "imported (parity: env_var.md:179).")
+register_flag("module_fused_step", "MXNET_MODULE_FUSED_STEP", _parse_bool,
+              True,
+              "Route Module training through the fused one-XLA-program "
+              "step (fwd+bwd+reduce+optimizer update) when the kvstore is "
+              "tpu_sync, or automatically on TPU with a local kvstore. "
+              "Off: per-parameter eager updates (reference "
+              "update_on_kvstore=False semantics).")
+register_flag("trainer_fused_update", "MXNET_TRAINER_FUSED_UPDATE",
+              _parse_bool, True,
+              "Gluon Trainer.step applies all parameter updates in one "
+              "jitted program (one dispatch/step) instead of one eager op "
+              "per parameter. Numerically identical to the eager path.")
 register_flag("test_device", "MXNET_TEST_DEVICE", str, "cpu",
               "Device type test_utils.default_context() returns (cpu|tpu) "
               "— the reference's env-switchable default_context (:53).")

@@ -268,7 +268,9 @@ class Executor:
         return {k: self._placed(v, self._rep_sharding)
                 for k, v in self.aux_dict.items()}
 
-    def forward(self, is_train=False, **kwargs):
+    def set_inputs(self, **kwargs):
+        """Feed input arrays (by arg name) into the bound buffers, placing
+        them where the executor computes."""
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 if isinstance(v, NDArray):
@@ -279,6 +281,9 @@ class Executor:
                 # place it where the executor computes or jit sees mixed
                 # platforms
                 self.arg_dict[k]._rebind(self._place_input(val, k))
+
+    def forward(self, is_train=False, **kwargs):
+        self.set_inputs(**kwargs)
         key = _random.next_key()
         if is_train:
             if self._req_args:

@@ -129,14 +129,24 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if eval_metric is None and eval_data is not None and \
+                validation_metric is None:
+            raise ValueError(
+                "eval_metric=None (benchmark mode) needs an explicit "
+                "validation_metric when eval_data is given")
         if validation_metric is None:
             validation_metric = eval_metric
-        if not isinstance(eval_metric, _metric.EvalMetric):
+        # eval_metric=None: benchmark mode — no metric updates, so no
+        # device->host sync per batch (the reference's --benchmark 1 path
+        # still pays this; on a TPU tunnel it would dominate)
+        if eval_metric is not None and \
+                not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
-            eval_metric.reset()
+            if eval_metric is not None:
+                eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
@@ -153,7 +163,8 @@ class BaseModule:
                                  sparse_row_id_fn=sparse_row_id_fn)
                 except StopIteration:
                     end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                if eval_metric is not None:
+                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -162,7 +173,8 @@ class BaseModule:
                                          eval_metric=eval_metric,
                                          locals=locals()))
                 nbatch += 1
-            for name, val in eval_metric.get_name_value():
+            for name, val in (eval_metric.get_name_value()
+                              if eval_metric is not None else []):
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
                              time.time() - tic)

@@ -58,6 +58,11 @@ class Module(BaseModule):
         self._exec = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused = None
+        self._fused_opt_state = None
+        self._fused_pending = None
+        self._fused_ran = False
+        self._monitor_installed = False
 
     # ------------------------------------------------------------ properties
     @property
@@ -94,6 +99,11 @@ class Module(BaseModule):
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
+        self._drop_fused()
+        # reference parity (module.py bind): a rebind invalidates the
+        # optimizer binding too — init_optimizer must run again (fit does),
+        # which also re-engages the fused step for the new executor
+        self.optimizer_initialized = False
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
@@ -214,20 +224,111 @@ class Module(BaseModule):
                 kv.set_optimizer(self._optimizer)
         if not update_on_kvstore:
             self._updater = _opt.get_updater(self._optimizer)
+        self._init_fused_step(kv)
         self.optimizer_initialized = True
 
+    def _drop_fused(self):
+        """Invalidate the fused step (rebind/monitor), first mirroring its
+        optimizer state into the eager Updater so momentum/moments survive."""
+        if self._fused is not None:
+            if self._fused_opt_state is not None and \
+                    self._updater is not None:
+                self._updater.states = self._fused.state_to_updater(
+                    self._fused_opt_state)
+            self._fused = None
+            self._fused_opt_state = None
+            self._fused_pending = None
+            self._fused_ran = False
+
+    def _init_fused_step(self, kv):
+        """Build the fused one-program train step (module/fused.py) when it
+        can faithfully replace the eager fwd/bwd/update path: tpu_sync
+        kvstore (always), or local/no kvstore on a TPU context (auto)."""
+        from ..config import flags as _flags
+        self._fused = None
+        self._fused_ran = False
+        if not self.for_training or not _flags.module_fused_step:
+            return
+        if self.inputs_need_grad or self._monitor_installed:
+            return
+        kv_type = kv.type if kv is not None else None
+        if self._update_on_kvstore:
+            return  # optimizer runs on the (dist) kvstore server
+        on_tpu = all(c.device_type == "tpu" for c in self._context)
+        if not (kv_type == "tpu_sync"
+                or (on_tpu and kv_type in (None, "local", "device"))):
+            return
+        # 'add' grad accumulation needs the eager grad buffers
+        if any(self._exec._grad_req.get(n) == "add"
+               for n in self._param_names):
+            return
+        if self._optimizer.fused_ops() is None:
+            return
+        # fp16 params need the eager multi-precision path (f32 master copy
+        # per weight, optimizer.py:71-75) — fused state layout differs
+        if any(self._exec.arg_dict[n].dtype != _np.float32
+               for n in self._param_names):
+            return
+        from .fused import FusedStep
+        # multi_precision on a TPU module = bf16 compute over f32 master
+        # weights (the reference's fp16 multi-precision SGD, optimizer.py
+        # :452, mapped to the MXU's native dtype)
+        compute_dtype = None
+        if getattr(self._optimizer, "multi_precision", False):
+            import jax.numpy as _jnp
+            compute_dtype = _jnp.bfloat16
+        self._fused = FusedStep(self._exec, self._optimizer,
+                                self._param_names,
+                                compute_dtype=compute_dtype,
+                                data_names=self._data_names)
+        self._fused_opt_state = self._fused.init_state()
+
     # --------------------------------------------------------------- running
-    def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        if is_train is None:
-            is_train = self.for_training
+    def _feed(self, data_batch):
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
             feed[name] = arr
         if self._label_shapes and data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
-        self._exec.forward(is_train=is_train, **feed)
+        return feed
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._exec.forward(is_train=is_train, **self._feed(data_batch))
+
+    def forward_backward(self, data_batch):
+        """fit's per-batch entry. On the fused path this launches ONE
+        compiled program (fwd+bwd+reduce+optimizer update); the parameter/
+        optimizer-state commit is deferred to update(). Bare forward()/
+        backward() always take the eager path, so custom training loops see
+        reference semantics (weights never move before update())."""
+        if self._fused is not None and self.optimizer_initialized:
+            self._forward_fused(self._feed(data_batch))
+        else:
+            self.forward(data_batch, is_train=True)
+            self.backward()
+
+    def _forward_fused(self, feed):
+        from .. import random as _random
+        from ..ndarray.ndarray import NDArray
+        ex = self._exec
+        ex.set_inputs(**feed)
+        key = _random.next_key()
+        outs, new_args, new_aux, new_opt = self._fused.run(
+            ex._arg_vals(), ex._aux_vals(), self._fused_opt_state, key)
+        # aux (BN stats) commit at forward time, like the eager path
+        for k, v in new_aux.items():
+            ex.aux_dict[k]._rebind(v)
+        ex.outputs = [NDArray(o, ctx=ex._ctx) for o in outs]
+        ex._pending = None
+        # params/opt state commit only in update(): a skipped update()
+        # (e.g. NaN-loss guard) must leave weights and the LR schedule
+        # untouched, as in the eager path
+        self._fused_pending = (new_args, new_opt)
+        self._fused_ran = True
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
@@ -237,6 +338,16 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        if self._fused_ran:
+            new_args, new_opt = self._fused_pending
+            ex = self._exec
+            for k in self._fused.param_names:
+                ex.arg_dict[k]._rebind(new_args[k])
+            self._fused_opt_state = new_opt
+            self._fused.commit_counts()
+            self._fused_pending = None
+            self._fused_ran = False
+            return
         if self._update_on_kvstore and self._kvstore is not None:
             for name in self._param_names:
                 grad = self._exec.grad_dict.get(name)
@@ -276,6 +387,11 @@ class Module(BaseModule):
                 {}, dict(zip(self._output_names, self._exec.outputs)))
 
     def install_monitor(self, mon):
+        # monitors watch per-op values — incompatible with the fused
+        # whole-step program, so its construction is skipped (or dropped,
+        # preserving accumulated optimizer state)
+        self._monitor_installed = True
+        self._drop_fused()
         mon.install(self._exec)
 
     # ------------------------------------------------------------ checkpoint
@@ -290,6 +406,11 @@ class Module(BaseModule):
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.save_optimizer_states(fname)
         else:
+            if self._fused is not None and self._fused_opt_state is not None:
+                # fused state is authoritative; mirror into the updater
+                # layout so the on-disk format matches the eager path
+                self._updater.states = self._fused.state_to_updater(
+                    self._fused_opt_state)
             with open(fname, "wb") as f:
                 f.write(self._updater.get_states())
 
@@ -300,6 +421,9 @@ class Module(BaseModule):
         else:
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
+            if self._fused is not None:
+                self._fused_opt_state = self._fused.state_from_updater(
+                    self._updater.states)
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):

@@ -92,9 +92,12 @@ class Optimizer:
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
 
-    def _get_lr(self, index):
+    def _get_lr(self, index, num_update=None):
+        """lr for a param; `num_update` overrides the schedule position
+        (the fused step peeks the post-bump count before committing it)."""
         if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
+            lr = self.lr_scheduler(self.num_update if num_update is None
+                                   else num_update)
         else:
             lr = self.lr
         name = self.idx2name.get(index, index if isinstance(index, str) else None)
@@ -133,6 +136,35 @@ class Optimizer:
                 "clip_gradient": (self.clip_gradient
                                   if self.clip_gradient is not None else -1.0)}
 
+    # -- fused (in-jit) update ----------------------------------------------
+    def fused_ops(self):
+        """Functional form of this optimizer for the fused train step.
+
+        Returns ``None`` (not fusable — the eager per-parameter path is
+        used), or ``(state_init, update)`` where
+
+        * ``state_init(w)`` -> tuple of jnp arrays (the optimizer state);
+        * ``update(w, g, state, lr, wd, rescale, t)`` ->
+          ``(new_w, new_state)`` — pure jnp, traced under jit with
+          ``lr``/``wd``/``rescale``/``t`` as dynamic scalars (so LR
+          schedules don't recompile).
+
+        CONTRACT: the state tuple must flatten the eager ``create_state``
+        result in order (None -> (), single array -> (x,), tuple -> as-is)
+        so the eager Updater's states and the fused states interconvert —
+        Trainer checkpoints and the fused/eager parity tests rely on it.
+        Non-scalar hyperparameters (momentum, betas, clip) are baked in at
+        build time; mutate them before ``init_optimizer``/first ``step``.
+
+        Reference analog: the one-op-per-update design of
+        src/operator/optimizer_op.cc, taken one step further — on TPU the
+        update op fuses into the same XLA program as fwd+bwd+psum.
+        """
+        return None
+
+    def _clip_const(self):
+        return -1.0 if self.clip_gradient is None else self.clip_gradient
+
 
 @register
 class SGD(Optimizer):
@@ -159,6 +191,24 @@ class SGD(Optimizer):
                        {"lr": lr, "wd": wd, "momentum": self.momentum,
                         **self._clip_kw()}, out=[weight, state])
 
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        mom, clip = self.momentum, self._clip_const()
+        if mom == 0.0:
+            return (lambda w: (),
+                    lambda w, g, s, lr, wd, rescale, t: (
+                        _O.sgd_update(w, g, lr=lr, wd=wd,
+                                      rescale_grad=rescale,
+                                      clip_gradient=clip), ()))
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, nm = _O.sgd_mom_update(w, g, s[0], lr=lr, momentum=mom,
+                                       wd=wd, rescale_grad=rescale,
+                                       clip_gradient=clip)
+            return nw, (nm,)
+        return (lambda w: (jnp.zeros_like(w),)), upd
+
 
 @register
 class NAG(Optimizer):
@@ -181,6 +231,24 @@ class NAG(Optimizer):
             _nd.invoke("nag_mom_update", [weight, grad, state],
                        {"lr": lr, "wd": wd, "momentum": self.momentum,
                         **self._clip_kw()}, out=[weight, state])
+
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        mom, clip = self.momentum, self._clip_const()
+        if mom == 0.0:
+            return (lambda w: (),
+                    lambda w, g, s, lr, wd, rescale, t: (
+                        _O.sgd_update(w, g, lr=lr, wd=wd,
+                                      rescale_grad=rescale,
+                                      clip_gradient=clip), ()))
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, nm = _O.nag_mom_update(w, g, s[0], lr=lr, momentum=mom,
+                                       wd=wd, rescale_grad=rescale,
+                                       clip_gradient=clip)
+            return nw, (nm,)
+        return (lambda w: (jnp.zeros_like(w),)), upd
 
 
 @register
@@ -207,6 +275,22 @@ class Adam(Optimizer):
                     "epsilon": self.epsilon, "wd": wd, **self._clip_kw()},
                    out=[weight, mean, var])
 
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        b1, b2, eps, clip = self.beta1, self.beta2, self.epsilon, \
+            self._clip_const()
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            tf = t.astype(jnp.float32) if hasattr(t, "astype") else t
+            lr_t = lr * jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
+            nw, nm, nv = _O.adam_update(w, g, s[0], s[1], lr=lr_t, beta1=b1,
+                                        beta2=b2, epsilon=eps, wd=wd,
+                                        rescale_grad=rescale,
+                                        clip_gradient=clip)
+            return nw, (nm, nv)
+        return (lambda w: (jnp.zeros_like(w), jnp.zeros_like(w))), upd
+
 
 @register
 class AdaGrad(Optimizer):
@@ -223,6 +307,18 @@ class AdaGrad(Optimizer):
         _nd.invoke("adagrad_update", [weight, grad, state],
                    {"lr": lr, "wd": wd, "epsilon": self.float_stable_eps,
                     **self._clip_kw()}, out=[weight, state])
+
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        eps, clip = self.float_stable_eps, self._clip_const()
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, nh = _O.adagrad_update(w, g, s[0], lr=lr, wd=wd, epsilon=eps,
+                                       rescale_grad=rescale,
+                                       clip_gradient=clip)
+            return nw, (nh,)
+        return (lambda w: (jnp.zeros_like(w),)), upd
 
 
 @register
@@ -242,6 +338,19 @@ class AdaDelta(Optimizer):
         _nd.invoke("adadelta_update", [weight, grad, acc_g, acc_d],
                    {"rho": self.rho, "epsilon": self.epsilon, "wd": wd,
                     **self._clip_kw()}, out=[weight, acc_g, acc_d])
+
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        rho, eps, clip = self.rho, self.epsilon, self._clip_const()
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, ng, nd = _O.adadelta_update(w, g, s[0], s[1], rho=rho,
+                                            epsilon=eps, wd=wd,
+                                            rescale_grad=rescale,
+                                            clip_gradient=clip)
+            return nw, (ng, nd)
+        return (lambda w: (jnp.zeros_like(w), jnp.zeros_like(w))), upd
 
 
 @register
@@ -277,6 +386,29 @@ class RMSProp(Optimizer):
                         "epsilon": self.epsilon, "clip_weights": cw,
                         **self._clip_kw()}, out=[weight, state])
 
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        g1, g2, eps, clip = self.gamma1, self.gamma2, self.epsilon, \
+            self._clip_const()
+        cw = self.clip_weights if self.clip_weights else -1.0
+        if self.centered:
+            def upd(w, g, s, lr, wd, rescale, t):
+                nw, nn, ng, ndel = _O.rmspropalex_update(
+                    w, g, s[0], s[1], s[2], lr=lr, wd=wd, gamma1=g1,
+                    gamma2=g2, epsilon=eps, clip_weights=cw,
+                    rescale_grad=rescale, clip_gradient=clip)
+                return nw, (nn, ng, ndel)
+            return (lambda w: (jnp.zeros_like(w),) * 3), upd
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, nn = _O.rmsprop_update(w, g, s[0], lr=lr, wd=wd, gamma1=g1,
+                                       epsilon=eps, clip_weights=cw,
+                                       rescale_grad=rescale,
+                                       clip_gradient=clip)
+            return nw, (nn,)
+        return (lambda w: (jnp.zeros_like(w),)), upd
+
 
 @register
 class Ftrl(Optimizer):
@@ -296,6 +428,19 @@ class Ftrl(Optimizer):
                    {"lr": lr, "wd": wd, "lamda1": self.lamda1,
                     "beta": self.beta, **self._clip_kw()},
                    out=[weight, z, n])
+
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        lamda1, beta, clip = self.lamda1, self.beta, self._clip_const()
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, nz, nn = _O.ftrl_update(w, g, s[0], s[1], lr=lr, wd=wd,
+                                        lamda1=lamda1, beta=beta,
+                                        rescale_grad=rescale,
+                                        clip_gradient=clip)
+            return nw, (nz, nn)
+        return (lambda w: (jnp.zeros_like(w), jnp.zeros_like(w))), upd
 
 
 @register
@@ -321,6 +466,24 @@ class Signum(Optimizer):
                        {"lr": lr, "wd": wd, "momentum": self.momentum,
                         "wd_lh": self.wd_lh, **self._clip_kw()},
                        out=[weight, state])
+
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        mom, wd_lh, clip = self.momentum, self.wd_lh, self._clip_const()
+        if mom == 0.0:
+            return (lambda w: (),
+                    lambda w, g, s, lr, wd, rescale, t: (
+                        _O.signsgd_update(w, g, lr=lr, wd=wd,
+                                          rescale_grad=rescale,
+                                          clip_gradient=clip), ()))
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, nm = _O.signum_update(w, g, s[0], lr=lr, momentum=mom, wd=wd,
+                                      wd_lh=wd_lh, rescale_grad=rescale,
+                                      clip_gradient=clip)
+            return nw, (nm,)
+        return (lambda w: (jnp.zeros_like(w),)), upd
 
 
 @register
@@ -353,6 +516,22 @@ class FTML(Optimizer):
                     "clip_grad": (self.clip_gradient
                                   if self.clip_gradient is not None else -1.0)},
                    out=[weight, d, v, z])
+
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        b1, b2, eps, clip = self.beta1, self.beta2, self.epsilon, \
+            self._clip_const()
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            tf = t.astype(jnp.float32) if hasattr(t, "astype") else t
+            nw, ndd, nv, nz = _O.ftml_update(w, g, s[0], s[1], s[2], lr=lr,
+                                             wd=wd, beta1=b1, beta2=b2,
+                                             epsilon=eps, t=tf,
+                                             rescale_grad=rescale,
+                                             clip_grad=clip)
+            return nw, (ndd, nv, nz)
+        return (lambda w: (jnp.zeros_like(w),) * 3), upd
 
 
 @register
@@ -430,6 +609,9 @@ class LBSGD(SGD):
     def __init__(self, eta=0.001, **kwargs):
         super().__init__(**kwargs)
         self.eta = eta
+
+    def fused_ops(self):
+        return None  # layer-wise scaling reads norms on host (asscalar)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
