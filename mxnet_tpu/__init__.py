@@ -16,6 +16,16 @@ if config.flags.enable_x64:
     import jax as _jax
     _jax.config.update("jax_enable_x64", True)
 
+# Under a launcher (tools/launch.py sets MXNET_COORDINATOR_ADDRESS /
+# DMLC_PS_ROOT_URI), join the process group NOW — jax.distributed must
+# initialize before any JAX call touches a backend, and user scripts touch
+# arrays long before they create a kvstore. No-op outside a launcher.
+import os as _os
+if _os.environ.get("MXNET_COORDINATOR_ADDRESS") \
+        or _os.environ.get("DMLC_PS_ROOT_URI"):
+    from .parallel import dist as _dist
+    _dist.init()
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import engine

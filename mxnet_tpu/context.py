@@ -77,19 +77,25 @@ import functools
 
 @functools.lru_cache(maxsize=None)
 def _platform_devices(platform):
+    """This process's ADDRESSABLE devices for a platform. Local, not
+    global: in a multi-process group (jax.distributed) a Context must
+    resolve to a device this worker can touch — the reference's per-worker
+    local gpu(i) semantics. backend= is required: bare local_devices()
+    lists only the default backend, which would make mx.cpu() resolve to a
+    TPU on accelerator hosts."""
     try:
-        return tuple(jax.devices(platform))
+        return tuple(jax.local_devices(backend=platform))
     except RuntimeError:
         return ()
 
 
 def _accel_devices():
-    """All non-CPU jax devices (TPU chips), or [] if none."""
+    """This process's non-CPU jax devices (TPU chips), or [] if none."""
     for plat in ("tpu", "gpu"):
         devs = _platform_devices(plat)
         if devs:
             return list(devs)
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return devs
 
 
@@ -100,12 +106,12 @@ def _resolve_device(device_type, device_id):
             return cpus[device_id % len(cpus)]
         # No CPU PJRT client exposed (accelerator-only runtime): fall back to
         # default device; host staging still happens via numpy.
-        return jax.devices()[0]
+        return jax.local_devices()[0]
     accels = _accel_devices()
     if accels:
         return accels[device_id % len(accels)]
     # tpu requested but only CPU available (test mode): map onto cpu devices
-    devs = jax.devices()
+    devs = jax.local_devices()
     return devs[device_id % len(devs)]
 
 

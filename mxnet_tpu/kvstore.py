@@ -46,6 +46,13 @@ class KVStore:
         self._optimizer = None
         self._compression_params = None
         self._residuals = {}
+        # dist_*: join the launcher's process group (reference: ps-lite van
+        # connects on kvstore_dist construction); cross-process reduction
+        # then happens in push. Single-process dist degrades to local.
+        self._dist = False
+        if kv_type.startswith("dist"):
+            from .parallel import dist as _dist
+            self._dist = _dist.init() and _dist.num_workers() > 1
 
     # ------------------------------------------------------------- metadata
     @property
@@ -54,19 +61,13 @@ class KVStore:
 
     @property
     def rank(self):
-        try:
-            import jax
-            return jax.process_index()
-        except Exception:
-            return 0
+        from .parallel import dist as _dist
+        return _dist.rank()
 
     @property
     def num_workers(self):
-        try:
-            import jax
-            return jax.process_count()
-        except Exception:
-            return 1
+        from .parallel import dist as _dist
+        return _dist.num_workers()
 
     def get_num_dead_node(self, node_id=0):
         """Failure-detection surface (reference kvstore.h:353 via ps-lite
@@ -78,7 +79,20 @@ class KVStore:
     def init(self, key, value):
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            self._store[k] = v[0].copy() if isinstance(v, list) else v.copy()
+            v0 = v[0] if isinstance(v, list) else v
+            if self._dist:
+                # reference: init lands on the server once; here rank 0's
+                # value is broadcast so every replica starts identical
+                from .parallel import dist as _dist
+                if isinstance(v0, _sp.BaseSparseNDArray):
+                    dense = _dist.broadcast(v0.todense()._data)
+                    self._store[k] = _sp.cast_storage(
+                        NDArray(dense, ctx=v0.context), v0.stype)
+                else:
+                    self._store[k] = NDArray(_dist.broadcast(v0._data),
+                                             ctx=v0.context)
+            else:
+                self._store[k] = v0.copy()
 
     # ----------------------------------------------------------------- push
     def push(self, key, value, priority=0):
@@ -88,7 +102,12 @@ class KVStore:
                 vs = [vs]
             agg = self._reduce(vs)
             if self._compression_params:
+                # compress on the worker BEFORE the wire (reference
+                # gradient_compression.h: quantize worker-side, server sums
+                # quantized grads); residual error-feedback stays local
                 agg = self._compress(k, agg)
+            if self._dist:
+                agg = self._dist_reduce(agg)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("key %r not initialized" % k)
@@ -98,6 +117,17 @@ class KVStore:
                 # (reference kvstore_local.h PushImpl `local = merged`;
                 # python/mxnet/kvstore.py push docstring examples)
                 self._store[k] = agg
+
+    def _dist_reduce(self, agg):
+        """Cross-process sum (the reference's worker->server aggregation,
+        as a symmetric all-reduce). Every rank must push the same keys in
+        the same order — dist_sync semantics."""
+        from .parallel import dist as _dist
+        if isinstance(agg, _sp.BaseSparseNDArray):
+            stype = agg.stype
+            dense = _dist.allreduce_sum(agg.todense()._data)
+            return _sp.cast_storage(NDArray(dense), stype)
+        return NDArray(_dist.allreduce_sum(agg._data), ctx=agg.context)
 
     def _reduce(self, vs):
         """Sum a list of per-device values (CommDevice::Reduce analog —
@@ -208,7 +238,8 @@ class KVStore:
             self._updater.set_states(f.read())
 
     def _barrier(self):
-        pass
+        from .parallel import dist as _dist
+        _dist.barrier()
 
     def _send_command_to_servers(self, head, body):
         pass

@@ -10,3 +10,4 @@ from .mesh import make_mesh, data_parallel_sharding, replicated
 from .spmd import SPMDTrainStep
 from .ring_attention import (blockwise_attention, ring_attention,
                              make_ring_attention, attention_reference)
+from . import dist

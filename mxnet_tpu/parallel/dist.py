@@ -1,0 +1,162 @@
+"""Multi-process distributed backend over ``jax.distributed``.
+
+Reference analog: the ps-lite worker/server runtime —
+``src/kvstore/kvstore_dist.h:44`` (worker push/pull RPCs),
+``src/kvstore/kvstore_dist_server.h:155`` (server request handler), and the
+process launcher ``tools/launch.py``.
+
+TPU-native redesign (SURVEY.md §2.3/§7): there is no parameter server. The
+PJRT coordination service provides rendezvous/liveness, and reductions ride
+XLA collectives (ICI/DCN on TPU pods, Gloo on CPU test fleets). The
+reference's server-side "aggregate then update once" becomes a symmetric
+all-reduce with the optimizer update replicated on every worker — identical
+arithmetic (every rank applies the same aggregated gradient to the same
+replica), one hop fewer.
+
+The *fast* path for multi-host training is not this module: it is the fused
+SPMD train step over a global mesh (module/fused.py, parallel/spmd.py),
+where GSPMD inserts the cross-host collectives inside the compiled program.
+This module is the KVStore-compatibility path (``dist_sync``/``dist_async``)
+and the process-group utility layer.
+
+Environment (set by tools/launch.py; DMLC_* honored for reference parity):
+
+=========================  ==============================  ================
+purpose                    native name                     reference name
+=========================  ==============================  ================
+coordinator address        MXNET_COORDINATOR_ADDRESS       DMLC_PS_ROOT_URI
+                                                           (+_PORT)
+world size                 MXNET_NUM_WORKERS               DMLC_NUM_WORKER
+process rank               MXNET_WORKER_RANK               DMLC_WORKER_ID
+=========================  ==============================  ================
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+__all__ = ["init", "initialized", "rank", "num_workers", "barrier",
+           "allreduce_sum", "broadcast", "env_spec"]
+
+_INITIALIZED = False
+
+
+def env_spec():
+    """(coordinator, num_workers, rank) from the environment, or
+    (None, None, None) when no launcher context is present."""
+    addr = os.environ.get("MXNET_COORDINATOR_ADDRESS")
+    if addr is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        addr = "%s:%s" % (os.environ["DMLC_PS_ROOT_URI"],
+                          os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    n = os.environ.get("MXNET_NUM_WORKERS",
+                       os.environ.get("DMLC_NUM_WORKER"))
+    r = os.environ.get("MXNET_WORKER_RANK",
+                       os.environ.get("DMLC_WORKER_ID"))
+    return (addr,
+            int(n) if n is not None else None,
+            int(r) if r is not None else None)
+
+
+def _externally_initialized():
+    """True when the user bootstrapped jax.distributed themselves (the
+    standard JAX multi-host recipe) — treat that as our process group.
+    Checks the coordination client directly so probing does NOT initialize
+    a backend."""
+    try:
+        from jax._src import distributed as _jd
+        return _jd.global_state.client is not None
+    except Exception:
+        return False
+
+
+def init(coordinator=None, num_workers_=None, rank_=None):
+    """Join the process group (idempotent). Arguments default to the
+    launcher environment; an externally-initialized jax.distributed counts
+    as joined; a no-launcher run is a 1-process group."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    if _externally_initialized():
+        _INITIALIZED = True
+        return True
+    env_addr, env_n, env_r = env_spec()
+    coordinator = coordinator or env_addr
+    num_workers_ = num_workers_ if num_workers_ is not None else env_n
+    rank_ = rank_ if rank_ is not None else env_r
+    if coordinator is None or not num_workers_ or num_workers_ <= 1:
+        return False  # single-process: nothing to join
+    if rank_ is None:
+        raise ValueError(
+            "distributed launch is missing the worker rank: set "
+            "MXNET_WORKER_RANK (or DMLC_WORKER_ID), or pass rank_=; "
+            "every worker registering as rank 0 would hang the group")
+    import jax
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_workers_,
+                                   process_id=rank_)
+    except RuntimeError as e:
+        raise RuntimeError(
+            "jax.distributed must initialize before any JAX backend use. "
+            "Import mxnet_tpu (or call mxnet_tpu.parallel.dist.init()) "
+            "before creating arrays — under tools/launch.py the import "
+            "does this automatically. Original error: %s" % e) from e
+    _INITIALIZED = True
+    return True
+
+
+def initialized():
+    return _INITIALIZED or _externally_initialized()
+
+
+def rank():
+    if not initialized():
+        return 0
+    import jax
+    return jax.process_index()
+
+
+def num_workers():
+    if not initialized():
+        return 1
+    import jax
+    return jax.process_count()
+
+
+def barrier(tag="mxnet_tpu_barrier"):
+    """Block until every process reaches the same point (reference
+    kvstore_dist.h Barrier RPC)."""
+    if not initialized():
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
+def allreduce_sum(value):
+    """Sum an array over all processes; every rank gets the result.
+
+    value: numpy/jax array (host or device). Returns a jax array. The
+    collective is an all-gather + on-host-group sum — the kvstore
+    compatibility path; fused SPMD programs get their reductions from
+    GSPMD instead.
+    """
+    if not initialized():
+        return value
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(value)
+    return gathered.sum(axis=0, dtype=gathered.dtype)
+
+
+def broadcast(value, root=0):
+    """Every rank receives `root`'s value (reference init-on-server)."""
+    if not initialized():
+        return value
+    from jax.experimental import multihost_utils
+    import jax.numpy as jnp
+    v = jnp.asarray(value)
+    if root == 0:
+        # broadcast_one_to_all ignores non-root inputs (they only fix
+        # shape/dtype)
+        return multihost_utils.broadcast_one_to_all(v)
+    return multihost_utils.process_allgather(v)[root]

@@ -1,0 +1,35 @@
+"""Shared, side-effect-free helpers for the dist training worker and its
+pytest driver (importing this must not touch jax config — the pytest
+session's platform would be contaminated)."""
+import numpy as np
+
+PER_WORKER_BATCH = 16
+N_SAMPLES_PER_WORKER = 32
+EPOCHS = 2
+
+
+def make_net():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def full_data(nworker):
+    rng = np.random.RandomState(42)
+    n = N_SAMPLES_PER_WORKER * nworker
+    X = rng.randn(n, 8).astype(np.float32)
+    Y = rng.randint(0, 4, (n,)).astype(np.float32)
+    return X, Y
+
+
+def fixed_params(sym):
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(3)
+    shapes, _, _ = sym.infer_shape(data=(PER_WORKER_BATCH, 8))
+    return {name: mx.nd.array(
+        rng.uniform(-0.1, 0.1, shp).astype(np.float32))
+        for name, shp in zip(sym.list_arguments(), shapes)
+        if name not in ("data", "softmax_label")}

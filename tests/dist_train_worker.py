@@ -1,0 +1,59 @@
+"""N-process data-parallel Module.fit over dist_sync (launched by
+tools/launch.py). Each worker trains on its contiguous shard; gradients
+aggregate across processes through the kvstore (update_on_kvstore, the
+reference's server-side update — python/mxnet/model.py:123-170). Verifies:
+
+* final params identical on every rank (broadcast compare);
+* rank 0 dumps params for the driver test to compare against an
+  equivalent single-process full-batch run.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.parallel import dist  # noqa: E402
+from tests.dist_train_common import (  # noqa: E402
+    make_net, full_data, fixed_params, PER_WORKER_BATCH,
+    N_SAMPLES_PER_WORKER, EPOCHS)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    X, Y = full_data(n)
+    # contiguous shard per worker (reference SplitSampler semantics)
+    lo, hi = rank * N_SAMPLES_PER_WORKER, (rank + 1) * N_SAMPLES_PER_WORKER
+    it = mx.io.NDArrayIter(X[lo:hi], Y[lo:hi],
+                           batch_size=PER_WORKER_BATCH,
+                           label_name="softmax_label")
+    sym = make_net()
+    mod = mx.mod.Module(sym)
+    mod.fit(it, num_epoch=EPOCHS, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / (PER_WORKER_BATCH * n)},
+            arg_params=fixed_params(sym), initializer=None)
+    args, _ = mod.get_params()
+    # every rank must hold identical params
+    for name in sorted(args):
+        mine = np.asarray(args[name].asnumpy())
+        theirs = np.asarray(dist.broadcast(mine, root=0))
+        np.testing.assert_allclose(mine, theirs, rtol=0, atol=0,
+                                   err_msg="rank %d diverged on %s"
+                                           % (rank, name))
+    if rank == 0 and os.environ.get("DIST_TRAIN_DUMP"):
+        np.savez(os.environ["DIST_TRAIN_DUMP"],
+                 **{k: v.asnumpy() for k, v in args.items()})
+    print("rank %d/%d: dist training converged identically" % (rank, n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

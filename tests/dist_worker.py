@@ -1,0 +1,112 @@
+"""Worker for the N-process dist_sync kvstore test.
+
+Ports the invariants of the reference's nightly dist test
+(tests/nightly/dist_sync_kvstore.py:66-429) onto the jax.distributed
+backend: init broadcast, sync push/pull with a server-side ('test')
+optimizer, aggregate-replace pushes, row_sparse keys, gradient compression
+across the wire, rank/num_workers/barrier.
+
+Run via the launcher (each invariant is collective — all ranks execute in
+lockstep):
+
+    python tools/launch.py -n 3 python tests/dist_worker.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # CPU fleet; Gloo collectives
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+SHAPE = (2, 3)
+BIG_SHAPE = (120, 120)
+RATE = 2
+
+
+def check_diff(nd, expected, rank):
+    a = nd.asnumpy()
+    assert np.abs(a - expected).sum() == 0, (rank, a, expected)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    my_rank = kv.rank
+    nworker = kv.num_workers
+    expected_n = int(os.environ["MXNET_NUM_WORKERS"])
+    assert nworker == expected_n, (nworker, expected_n)
+    assert my_rank == int(os.environ["MXNET_WORKER_RANK"])
+
+    # --- init is a broadcast: rank 0's (random) value wins everywhere -----
+    rng = np.random.RandomState(100 + my_rank)
+    kv.init("b0", mx.nd.array(rng.randn(*SHAPE).astype(np.float32)))
+    rank0_val = np.random.RandomState(100).randn(*SHAPE).astype(np.float32)
+    got = mx.nd.zeros(SHAPE)
+    kv.pull("b0", out=got)
+    np.testing.assert_allclose(got.asnumpy(), rank0_val, rtol=1e-6)
+
+    # --- sync push/pull with server-side optimizer (reference
+    # check_default_keys): each rank pushes ones*(rank+1); the 'test'
+    # optimizer does w += rescale * sum(grads); after i+1 rounds
+    # w = (n+1)*n*rate/2*(i+1) + 1 ----------------------------------------
+    for keys, shape in ((["3", "5", "7"], SHAPE), (["99"], BIG_SHAPE)):
+        kv2 = mx.kv.create("dist_sync")
+        kv2.set_optimizer(mx.optimizer.create("test", rescale_grad=RATE))
+        for k in keys:
+            kv2.init(k, mx.nd.ones(shape))
+        for i in range(3):
+            for k in keys:
+                kv2.push(k, mx.nd.ones(shape) * (my_rank + 1))
+                expected = (nworker + 1) * nworker * RATE / 2 * (i + 1) + 1
+                val = mx.nd.zeros(shape)
+                kv2.pull(k, out=val)
+                check_diff(val, expected, my_rank)
+
+    # --- no-updater push: merged+all-reduced value REPLACES the store ----
+    kv.init("r0", mx.nd.zeros(SHAPE))
+    kv.push("r0", mx.nd.ones(SHAPE) * (my_rank + 1))
+    val = mx.nd.zeros(SHAPE)
+    kv.pull("r0", out=val)
+    check_diff(val, nworker * (nworker + 1) / 2, my_rank)
+
+    # --- row_sparse keys (reference check_row_sparse_keys) ----------------
+    kv.init("rsp", mx.nd.zeros(SHAPE).tostype("row_sparse"))
+    v = np.zeros(SHAPE, np.float32)
+    v[my_rank % SHAPE[0]] = my_rank + 1
+    kv.push("rsp", mx.nd.array(v).tostype("row_sparse"))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("rsp", out=out, ignore_sparse=False)
+    expected = np.zeros(SHAPE, np.float32)
+    for r in range(nworker):
+        expected[r % SHAPE[0]] += r + 1
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+
+    # --- gradient compression crosses the wire (reference
+    # test_sync_2bit_compression): each worker quantizes to {-t, 0, +t}
+    # before the reduce, so the aggregate is sum of the quantized grads ---
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvc.init("c0", mx.nd.zeros(SHAPE))
+    kvc.push("c0", mx.nd.ones(SHAPE))  # 1.0 >= 0.5 -> quantized to +0.5
+    out = mx.nd.zeros(SHAPE)
+    kvc.pull("c0", out=out)
+    check_diff(out, 0.5 * nworker, my_rank)
+    # error feedback: residual 0.5 carried into the next push
+    kvc.push("c0", mx.nd.zeros(SHAPE))  # 0 + residual 0.5 -> +0.5 again
+    kvc.pull("c0", out=out)
+    check_diff(out, 0.5 * nworker, my_rank)
+
+    # --- barrier ----------------------------------------------------------
+    kv._barrier()
+    assert kv.get_num_dead_node() == 0
+    print("rank %d/%d: all dist_sync invariants OK" % (my_rank, nworker))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

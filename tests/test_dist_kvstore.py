@@ -1,0 +1,90 @@
+"""Multi-process dist_sync kvstore: N real processes over jax.distributed
+(Gloo on CPU), launched through tools/launch.py — the CI analog of the
+reference's nightly dist test (tests/nightly/dist_sync_kvstore.py) per its
+runtime_functions.sh local-N-process recipe (ci/docker/runtime_functions.sh
+:901-930).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+WORKER = os.path.join(ROOT, "tests", "dist_worker.py")
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_dist_sync_invariants(n):
+    env = dict(os.environ)
+    # workers pin CPU themselves; drop the suite's forced device count to
+    # keep per-process startup light
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", str(n), sys.executable, WORKER],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    for rank in range(n):
+        assert "rank %d/%d: all dist_sync invariants OK" % (rank, n) \
+            in r.stdout, r.stdout[-4000:]
+
+
+def test_launcher_propagates_failure():
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", sys.executable, "-c",
+         "import sys, os; sys.exit(3 if os.environ['MXNET_WORKER_RANK'] "
+         "== '1' else 0)"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 3
+
+
+def test_single_process_dist_degrades_to_local():
+    import mxnet_tpu as mx
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 1 and kv.rank == 0
+    kv.init("a", mx.nd.ones((2, 2)))
+    kv.push("a", mx.nd.ones((2, 2)) * 3)
+    out = mx.nd.zeros((2, 2))
+    kv.pull("a", out=out)
+    assert (out.asnumpy() == 3).all()
+
+
+def test_dist_training_matches_single_process(tmp_path):
+    """2-process data-parallel Module.fit(dist_sync) == single-process
+    full-batch training (no BN, so the math is exactly equivalent)."""
+    import numpy as np
+    n = 2
+    dump = str(tmp_path / "dist_params.npz")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["DIST_TRAIN_DUMP"] = dump
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", str(n), sys.executable,
+         os.path.join(ROOT, "tests", "dist_train_worker.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+
+    # single-process equivalent: full batch = n shards concatenated,
+    # same rescale -> identical aggregated gradient per step
+    from tests.dist_train_common import (make_net, full_data, fixed_params,
+                                         PER_WORKER_BATCH, EPOCHS)
+    import mxnet_tpu as mx
+    X, Y = full_data(n)
+    order = np.concatenate([  # interleave shards the way N workers step
+        np.arange(len(X)).reshape(n, -1, PER_WORKER_BATCH)
+        .transpose(1, 0, 2).reshape(-1)])
+    it = mx.io.NDArrayIter(X[order], Y[order],
+                           batch_size=PER_WORKER_BATCH * n,
+                           label_name="softmax_label")
+    sym = make_net()
+    mod = mx.mod.Module(sym)
+    mod.fit(it, num_epoch=EPOCHS, kvstore="local", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / (PER_WORKER_BATCH * n)},
+            arg_params=fixed_params(sym), initializer=None)
+    args, _ = mod.get_params()
+    dist_params = np.load(dump)
+    for name in dist_params.files:
+        np.testing.assert_allclose(args[name].asnumpy(), dist_params[name],
+                                   rtol=2e-5, atol=2e-6, err_msg=name)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Local N-process launcher for dist_sync / dist_async training.
+
+Reference analog: ``tools/launch.py`` (which spawns ps-lite schedulers/
+servers/workers over ssh/mpirun/yarn). The TPU-native runtime needs no
+scheduler or server processes — only N workers pointed at a PJRT
+coordination service — so this launcher:
+
+* picks a free coordinator port on localhost,
+* spawns N copies of the command with MXNET_COORDINATOR_ADDRESS /
+  MXNET_NUM_WORKERS / MXNET_WORKER_RANK set (DMLC_* aliases too, so
+  reference-era scripts reading DMLC_NUM_WORKER keep working),
+* streams each worker's output with a ``[worker N]`` prefix,
+* on any worker failing, kills the rest and exits non-zero.
+
+Multi-host launches (one process per host over DCN) use the same
+environment contract — point MXNET_COORDINATOR_ADDRESS at host 0 and run
+one process per host with distinct ranks; this script is the single-host
+convenience wrapper the reference's ``-n N`` local mode provided.
+
+Usage::
+
+    python tools/launch.py -n 4 [--env K=V ...] python train.py \
+        --kv-store dist_sync
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _stream(proc, rank_, out):
+    for line in proc.stdout:
+        out.write("[worker %d] %s" % (rank_, line))
+        out.flush()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--coordinator-port", type=int, default=None)
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra K=V for the workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+
+    port = args.coordinator_port or _free_port()
+    addr = "127.0.0.1:%d" % port
+    procs = []
+    threads = []
+    for r in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXNET_COORDINATOR_ADDRESS": addr,
+            "MXNET_NUM_WORKERS": str(args.num_workers),
+            "MXNET_WORKER_RANK": str(r),
+            # reference-era names
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(r),
+            "DMLC_ROLE": "worker",
+        })
+        for kv in args.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        p = subprocess.Popen(args.command, env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(p, r, sys.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    rc = 0
+    try:
+        # poll ALL workers: a failed one wedges the rest at their next
+        # collective, so on first failure terminate the stragglers
+        import time
+        pending = set(procs)
+        while pending:
+            for p in list(pending):
+                r = p.poll()
+                if r is None:
+                    continue
+                pending.discard(p)
+                if r != 0 and rc == 0:
+                    rc = r
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+            if pending:
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        rc = 130
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
