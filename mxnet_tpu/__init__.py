@@ -24,7 +24,7 @@ import os as _os
 if _os.environ.get("MXNET_COORDINATOR_ADDRESS") \
         or _os.environ.get("DMLC_PS_ROOT_URI"):
     from .parallel import dist as _dist
-    _dist.init()
+    _dist.init(strict=False)
 
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus

@@ -1,6 +1,7 @@
 """IO package (parity: python/mxnet/io/)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter)
+from .image_record_iter import ImageRecordIter
 
 
 def MNISTIter(image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",

@@ -126,7 +126,7 @@ class KVStore:
         if isinstance(agg, _sp.BaseSparseNDArray):
             stype = agg.stype
             dense = _dist.allreduce_sum(agg.todense()._data)
-            return _sp.cast_storage(NDArray(dense), stype)
+            return _sp.cast_storage(NDArray(dense, ctx=agg.context), stype)
         return NDArray(_dist.allreduce_sum(agg._data), ctx=agg.context)
 
     def _reduce(self, vs):

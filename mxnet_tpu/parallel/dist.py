@@ -70,16 +70,25 @@ def _externally_initialized():
         return False
 
 
-def init(coordinator=None, num_workers_=None, rank_=None):
+def init(coordinator=None, num_workers_=None, rank_=None, strict=True):
     """Join the process group (idempotent). Arguments default to the
     launcher environment; an externally-initialized jax.distributed counts
-    as joined; a no-launcher run is a 1-process group."""
+    as joined; a no-launcher run is a 1-process group.
+
+    strict=False (the import-time auto-join) quietly skips instead of
+    raising on an incomplete/legacy environment — e.g. a reference-era
+    ps-lite launcher exporting DMLC_PS_ROOT_URI to scheduler/server-role
+    or rank-less processes; importing the library must not crash them.
+    """
     global _INITIALIZED
     if _INITIALIZED:
         return True
     if _externally_initialized():
         _INITIALIZED = True
         return True
+    role = os.environ.get("DMLC_ROLE")
+    if role is not None and role != "worker":
+        return False  # ps-lite scheduler/server processes never join
     env_addr, env_n, env_r = env_spec()
     coordinator = coordinator or env_addr
     num_workers_ = num_workers_ if num_workers_ is not None else env_n
@@ -87,6 +96,8 @@ def init(coordinator=None, num_workers_=None, rank_=None):
     if coordinator is None or not num_workers_ or num_workers_ <= 1:
         return False  # single-process: nothing to join
     if rank_ is None:
+        if not strict:
+            return False
         raise ValueError(
             "distributed launch is missing the worker rank: set "
             "MXNET_WORKER_RANK (or DMLC_WORKER_ID), or pass rank_=; "

@@ -1,0 +1,151 @@
+"""Threaded ImageRecordIter (io/image_record_iter.py): decode/augment
+workers over the native dependency engine + device prefetch queue —
+the reference's ImageRecordIOParser2 + PrefetcherIter path
+(src/io/iter_image_recordio_2.cc:677, iter_prefetcher.h:47)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.io import ImageRecordIter
+
+N_IMAGES = 37
+SIDE = 40
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    """Small .rec of solid-color JPEGs; label i encodes the color level."""
+    import cv2
+    d = tmp_path_factory.mktemp("rec")
+    rec_path = str(d / "data.rec")
+    idx_path = str(d / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(N_IMAGES):
+        img = np.full((SIDE, SIDE, 3), i * 5 % 250, np.uint8)
+        ok, buf = cv2.imencode(".png", img)  # lossless: values must survive
+        assert ok
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(header, buf.tobytes()))
+    w.close()
+    return rec_path
+
+
+def test_basic_iteration(rec_file):
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         preprocess_threads=3, round_batch=True)
+    seen_labels = []
+    nb = 0
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 32, 32)
+        assert batch.label[0].shape == (8,)
+        labels = batch.label[0].asnumpy()
+        data = batch.data[0].asnumpy()
+        # each image is solid-color: every pixel equals label*5 % 250
+        for j in range(8):
+            expected = (labels[j] * 5) % 250
+            assert np.all(data[j] == expected), (labels[j], data[j][0, 0, 0])
+        seen_labels.extend(labels.tolist())
+        nb += 1
+    # round_batch wraps the tail: ceil(37/8)=5 batches, 40 samples
+    assert nb == 5 and len(seen_labels) == 40
+    assert set(int(x) for x in seen_labels) == set(range(N_IMAGES))
+    it.close()
+
+
+def test_epochs_and_shuffle(rec_file):
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         shuffle=True, preprocess_threads=2, seed=11)
+    def epoch_labels():
+        out = []
+        for b in it:
+            out.extend(b.label[0].asnumpy().tolist())
+        it.reset()
+        return out
+    e0, e1 = epoch_labels(), epoch_labels()
+    assert e0 != e1, "shuffle must reorder between epochs"
+    assert set(int(x) for x in e0) == set(range(N_IMAGES))
+    it.close()
+
+
+def test_augment_mean_std_mirror(rec_file):
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=4,
+                         mean_r=10.0, mean_g=10.0, mean_b=10.0,
+                         std_r=2.0, std_g=2.0, std_b=2.0,
+                         preprocess_threads=2)
+    b = next(iter(it))
+    labels = b.label[0].asnumpy()
+    data = b.data[0].asnumpy()
+    for j in range(4):
+        expected = ((labels[j] * 5) % 250 - 10.0) / 2.0
+        np.testing.assert_allclose(data[j], expected, rtol=1e-6)
+    it.close()
+
+
+def test_sharding(rec_file):
+    seen = []
+    for part in range(2):
+        it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=4,
+                             num_parts=2, part_index=part, round_batch=False,
+                             preprocess_threads=2)
+        for b in it:
+            seen.extend(b.label[0].asnumpy().tolist())
+        it.close()
+    # parts are disjoint and cover all full batches of each shard
+    assert len(seen) == len(set(seen))
+
+
+def test_uses_native_engine_when_available(rec_file):
+    from mxnet_tpu import runtime
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         preprocess_threads=2)
+    if runtime.available():
+        assert it._engine is not None, \
+            "native engine must schedule the pipeline when libmxtpu exists"
+    next(iter(it))
+    it.close()
+
+
+def test_fit_from_record_iter(rec_file):
+    """End-to-end: Module.fit consumes the threaded iterator."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         scale=1.0 / 255, preprocess_threads=2)
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01})
+    it.close()
+
+
+def test_corrupt_record_raises_not_hangs(tmp_path):
+    """A corrupt image must surface as an error from next(), not hang the
+    consumer or stage garbage (round-3 review finding)."""
+    rec_path = str(tmp_path / "bad.rec")
+    idx_path = str(tmp_path / "bad.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    header = recordio.IRHeader(0, 0.0, 0, 0)
+    for i in range(8):
+        w.write_idx(i, recordio.pack(header, b"not-a-jpeg-at-all"))
+    w.close()
+    it = ImageRecordIter(rec_path, data_shape=(3, 16, 16), batch_size=4,
+                         preprocess_threads=2)
+    with pytest.raises(Exception) as ei:
+        for _ in it:
+            pass
+    assert "pipeline failed" in str(ei.value) or "corrupt" in str(ei.value)
+    it.close()
+
+
+def test_round_batch_wraps_small_dataset(rec_file):
+    """batch_size > dataset: round_batch must wrap repeatedly (review
+    finding: single wrap yielded zero batches)."""
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=100,
+                         preprocess_threads=2, round_batch=True)
+    batches = list(it)
+    assert len(batches) == 1 and batches[0].data[0].shape[0] == 100
+    it.close()
