@@ -62,6 +62,7 @@ def __getattr__(name):
         "kvstore": ".kvstore",
         "kv": ".kvstore",
         "monitor": ".monitor",
+        "operator": ".operator",
         "parallel": ".parallel",
         "profiler": ".profiler",
         "test_utils": ".test_utils",

@@ -1,0 +1,112 @@
+"""Custom operators written in Python (``mx.operator``).
+
+Parity surface: ``python/mxnet/operator.py`` — ``CustomOp`` (:417),
+``CustomOpProp`` (:481), ``@operator.register`` (:610) — backed in the
+reference by a dedicated custom-op worker thread so python callbacks never
+block the engine (``src/operator/custom/custom-inl.h:50-163``).
+
+TPU-native execution: eagerly the op runs directly on NDArrays; inside a
+compiled program (hybridize / symbolic executor / fused train step) it runs
+as a ``jax.pure_callback`` — XLA's native "escape to host" — wrapped in a
+``jax.custom_vjp`` whose backward is another host callback into
+``CustomOp.backward``. Shapes/dtypes come from the Prop's
+``infer_shape``/``infer_type``, so tracing (jit, eval_shape) works without
+executing the python body.
+
+Keyword arguments passed at call sites reach the Prop constructor as
+STRINGS, exactly like the reference (they cross its C boundary as char*).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom operators (reference operator.py:417)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the grad request."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Describes a custom op's signature (reference operator.py:481)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type else _np.float32
+        return ([t] * len(self.list_arguments()),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return (in_stype, ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``
+    (reference operator.py:610)."""
+    def deco(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_prop_cls(op_type):
+    try:
+        return _REGISTRY[op_type]
+    except KeyError:
+        raise KeyError("custom op %r is not registered (have: %s)"
+                       % (op_type, sorted(_REGISTRY)))
+
+
+def make_prop(op_type, kwargs):
+    """Instantiate the Prop; call-site kwargs arrive as strings (reference
+    semantics: they cross the C boundary as char*)."""
+    return get_prop_cls(op_type)(**{k: str(v) for k, v in kwargs.items()})

@@ -16,3 +16,4 @@ from . import optimizer_ops # noqa: F401
 from . import image_ops     # noqa: F401
 from . import quantization  # noqa: F401
 from . import contrib_ops   # noqa: F401
+from . import custom_op     # noqa: F401

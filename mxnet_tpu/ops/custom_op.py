@@ -1,0 +1,166 @@
+"""The ``Custom`` operator: runs user Python (mx.operator.CustomOp) inside
+any execution mode.
+
+Reference: ``src/operator/custom/custom-inl.h:50-163`` — the reference
+pushes custom-op callbacks onto a dedicated worker thread so Python never
+blocks the engine. The XLA-native equivalent is ``jax.pure_callback``: the
+compiled program escapes to host for exactly this op, and tracing uses the
+Prop's declared shapes/dtypes instead of running Python. Gradients flow
+through a ``jax.custom_vjp`` whose backward is a host callback into
+``CustomOp.backward`` — so custom ops work eagerly, under hybridize, in
+the symbolic executor, and inside the fused train step, with autograd.
+
+Statefulness: the reference gives each executor its own operator instance,
+so a forward may stash intermediates for its backward. Here every
+*execution* of the forward callback creates a fresh instance and returns a
+token (an extra int32 output); the token rides the custom_vjp residuals
+into the backward callback, which pops the instance from a bounded live
+table. Interleaved forwards of the same op therefore never share state.
+Eager non-recording calls bypass the callback machinery entirely and run
+the operator directly.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+
+import numpy as _np
+
+from .registry import register
+
+# token -> operator instance awaiting its backward. Bounded: a forward
+# whose backward never runs (inference under record, abandoned graphs)
+# must not pin its stashed state forever.
+_LIVE_CAP = 256
+_LIVE = collections.OrderedDict()
+_LIVE_LOCK = threading.Lock()
+_TOKENS = itertools.count(1)
+
+
+def _custom_num_outputs(params):
+    from .. import operator as _operator
+    prop = _operator.make_prop(
+        params["op_type"], {k: v for k, v in params.items()
+                            if k not in ("op_type", "_training")})
+    return len(prop.list_outputs())
+
+
+def _to_nd(x):
+    from ..ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    return NDArray(jnp.asarray(_np.asarray(x)))
+
+
+def _new_operator(op_type, kwargs, sig):
+    from .. import operator as _operator
+    from ..context import current_context
+    prop = _operator.make_prop(op_type, kwargs)
+    return prop.create_operator(current_context(),
+                                [list(s) for s, _ in sig],
+                                [d for _, d in sig])
+
+
+def _stash(op):
+    with _LIVE_LOCK:
+        token = next(_TOKENS) & 0x7FFFFFFF
+        _LIVE[token] = op
+        while len(_LIVE) > _LIVE_CAP:
+            _LIVE.popitem(last=False)
+    return token
+
+
+def _take(token, op_type, kwargs, sig):
+    with _LIVE_LOCK:
+        op = _LIVE.pop(int(token), None)
+    if op is None:
+        # evicted or replayed: fall back to a fresh (stateless) instance
+        op = _new_operator(op_type, kwargs, sig)
+    return op
+
+
+@register("Custom", num_outputs=_custom_num_outputs)
+def custom(*inputs, op_type, _training=False, **kwargs):
+    """Dispatch to the registered CustomOpProp/CustomOp (reference
+    ``mx.nd.Custom`` / ``mx.symbol.Custom``)."""
+    import jax
+    import jax.numpy as jnp
+    from .. import operator as _operator
+
+    prop = _operator.make_prop(op_type, kwargs)
+    if prop.list_auxiliary_states():
+        raise NotImplementedError(
+            "custom ops with auxiliary states are not supported yet")
+    in_shapes = [list(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [_np.dtype(x.dtype) for x in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    out_spec = tuple(jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
+                     for s, t in zip(out_shapes, out_types))
+    sig = tuple((tuple(x.shape), _np.dtype(x.dtype)) for x in inputs)
+    n_in, n_out = len(inputs), len(out_spec)
+    is_train = bool(_training)
+
+    def run_forward(op, xs):
+        in_data = [_to_nd(x) for x in xs]
+        out_data = [_to_nd(_np.zeros(tuple(s.shape), s.dtype))
+                    for s in out_spec]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_data, out_data=out_data, aux=[])
+        return tuple(_np.asarray(o.asnumpy(), s.dtype)
+                     for o, s in zip(out_data, out_spec))
+
+    # eager fast path: concrete inputs outside any trace run the operator
+    # directly on-device NDArrays — no host round trip through callbacks
+    if not any(isinstance(x, jax.core.Tracer) for x in inputs):
+        op = _new_operator(op_type, kwargs, sig)
+        outs = tuple(jnp.asarray(o)
+                     for o in run_forward(op, [_np.asarray(x)
+                                               for x in inputs]))
+        return outs if n_out > 1 else outs[0]
+
+    def fwd_cb(*xs):
+        op = _new_operator(op_type, kwargs, sig)
+        outs = run_forward(op, xs)
+        return outs + (_np.int32(_stash(op)),)
+
+    def bwd_cb(token, *args):
+        op = _take(token, op_type, kwargs, sig)
+        ins = [_to_nd(x) for x in args[:n_in]]
+        outs = [_to_nd(x) for x in args[n_in:n_in + n_out]]
+        cots = [_to_nd(x) for x in args[n_in + n_out:]]
+        in_grad = [_to_nd(_np.zeros(tuple(s), d)) for s, d in sig]
+        op.backward(req=["write"] * n_in, out_grad=cots, in_data=ins,
+                    out_data=outs, in_grad=in_grad, aux=[])
+        return tuple(_np.asarray(g.asnumpy(), d)
+                     for g, (_, d) in zip(in_grad, sig))
+
+    cb_spec = out_spec + (jax.ShapeDtypeStruct((), _np.int32),)
+
+    @jax.custom_vjp
+    def run(*ins):
+        res = jax.pure_callback(fwd_cb, cb_spec, *ins)
+        return tuple(res[:n_out])
+
+    def run_fwd(*ins):
+        res = jax.pure_callback(fwd_cb, cb_spec, *ins)
+        outs = tuple(res[:n_out])
+        return outs, (ins, outs, res[n_out])
+
+    def run_bwd(res, cots):
+        ins, outs, token = res
+        grad_spec = tuple(jax.ShapeDtypeStruct(s, d) for s, d in sig)
+        grads = jax.pure_callback(bwd_cb, grad_spec, token, *ins, *outs,
+                                  *cots)
+        # integer inputs take float0 cotangents
+        fixed = []
+        for g, (shape, dt) in zip(grads, sig):
+            if _np.issubdtype(dt, _np.floating):
+                fixed.append(g)
+            else:
+                fixed.append(_np.zeros(shape, jax.dtypes.float0))
+        return tuple(fixed)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*inputs)
+    return outs if n_out > 1 else outs[0]

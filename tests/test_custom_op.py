@@ -1,0 +1,209 @@
+"""Custom Python operators (mx.operator.CustomOp) — the reference's
+custom-op surface (python/mxnet/operator.py, tests/python/unittest/
+test_operator.py::test_custom_op), executed eagerly, in the symbolic
+executor, hybridized (jit via pure_callback), and with gradients."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import operator as mxop
+
+
+@mxop.register("sqr")
+class SqrProp(mxop.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mxop.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+@mxop.register("twin")
+class TwinProp(mxop.CustomOpProp):
+    """Two inputs, two outputs, second output a different shape."""
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "total"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], [1]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Twin()
+
+
+class Twin(mxop.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        s = in_data[0] + in_data[1]
+        self.assign(out_data[0], req[0], s)
+        self.assign(out_data[1], req[1], s.sum().reshape((1,)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g = out_grad[0] + out_grad[1].reshape(())  # broadcast scalar
+        self.assign(in_grad[0], req[0], g)
+        self.assign(in_grad[1], req[1], g)
+
+
+def test_eager_forward_backward():
+    x = mx.nd.array(np.array([[1., 2.], [3., 4.]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="sqr")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_eager_multi_io():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((2, 3)) * 2
+    a.attach_grad()
+    with mx.autograd.record():
+        s, tot = mx.nd.Custom(a, b, op_type="twin")
+        loss = s.sum() + tot.sum()
+    loss.backward()
+    np.testing.assert_allclose(s.asnumpy(), 3 * np.ones((2, 3)))
+    np.testing.assert_allclose(tot.asnumpy(), [18.0])
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * np.ones((2, 3)))
+
+
+def test_symbolic_executor():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sqr", name="sq")
+    # shape inference runs the Prop's infer_shape, not the python body
+    args, outs, _ = y.infer_shape(data=(4, 5))
+    assert outs[0] == (4, 5)
+    from mxnet_tpu.executor import simple_bind
+    ex = simple_bind(y, mx.cpu(), data=(4, 5))
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), x ** 2, rtol=1e-6)
+    ex.backward(out_grads=mx.nd.ones((4, 5)))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-6)
+
+
+def test_custom_in_module_fit():
+    """Custom op inside a full compiled training step (fused program +
+    pure_callback escape)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="sqr")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype(np.float32)
+    Y = rng.randint(0, 4, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None  # ran inside the one-program step
+
+
+def test_hybridized_gluon_block():
+    from mxnet_tpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Custom(x, op_type="sqr")
+
+    net = Net()
+    net.hybridize()
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = net(x)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_stateful_custom_op():
+    """Forward stashes state; backward uses it (reference per-executor
+    operator instance semantics)."""
+    @mxop.register("stateful_scale")
+    class StatefulProp(mxop.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Stateful()
+
+    class Stateful(mxop.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self._saved = in_data[0].asnumpy().copy()
+            self.assign(out_data[0], req[0], in_data[0] * 3)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            assert hasattr(self, "_saved")  # same instance as forward
+            self.assign(in_grad[0], req[0], out_grad[0] * 3)
+
+    x = mx.nd.ones((2, 2))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="stateful_scale")
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3 * np.ones((2, 2)))
+
+
+def test_unregistered_op_type_raises():
+    with pytest.raises(KeyError):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="never_registered")
+
+
+def test_interleaved_stateful_instances():
+    """Two same-shape forwards before their backwards must NOT share one
+    operator instance (round-3 review finding: a shared cache corrupted
+    stashed state)."""
+    @mxop.register("stash_mul")
+    class StashProp(mxop.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return StashMul()
+
+    class StashMul(mxop.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self._x = in_data[0].asnumpy().copy()
+            self.assign(out_data[0], req[0], in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            # grad = stashed forward input (detects cross-talk)
+            self.assign(in_grad[0], req[0], mx.nd.array(self._x))
+
+    x1 = mx.nd.array(np.full((2,), 2.0, np.float32)); x1.attach_grad()
+    x2 = mx.nd.array(np.full((2,), 5.0, np.float32)); x2.attach_grad()
+    with mx.autograd.record():
+        y1 = mx.nd.Custom(x1, op_type="stash_mul")
+        y2 = mx.nd.Custom(x2, op_type="stash_mul")  # same shape, later fwd
+    y1.backward()
+    y2.backward()
+    np.testing.assert_allclose(x1.grad.asnumpy(), [2.0, 2.0])
+    np.testing.assert_allclose(x2.grad.asnumpy(), [5.0, 5.0])
+
+
+def test_unhashable_kwargs():
+    @mxop.register("kw_shape")
+    class KwProp(mxop.CustomOpProp):
+        def __init__(self, shape="(1,)"):
+            super().__init__()
+            self._shape = eval(shape)
+        def infer_shape(self, in_shape):
+            return in_shape, [list(self._shape)], []
+        def create_operator(self, ctx, shapes, dtypes):
+            return KwOp(self._shape)
+
+    class KwOp(mxop.CustomOp):
+        def __init__(self, shape):
+            self._shape = shape
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        mx.nd.ones(self._shape) * in_data[0].sum())
+
+    y = mx.nd.Custom(mx.nd.ones((3,)), op_type="kw_shape", shape=[2, 2])
+    assert y.shape == (2, 2)
+    np.testing.assert_allclose(y.asnumpy(), 3 * np.ones((2, 2)))
