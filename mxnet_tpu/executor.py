@@ -288,8 +288,10 @@ class Executor:
         if is_train:
             if self._req_args:
                 if self._ones_cache is None:
-                    self._ones_cache = [jnp.ones(o, _np.float32)
-                                        for o in self._out_shapes()]
+                    # cotangent dtype must match the output dtype (fp16
+                    # graphs seed fp16 ones)
+                    self._ones_cache = [jnp.ones(o.shape, o.dtype)
+                                        for o in self._out_structs()]
                 ones = self._ones_cache
                 outs, auxu, grads = self._fwd_bwd(
                     self._arg_vals(), self._aux_vals(), key, ones)
@@ -306,16 +308,18 @@ class Executor:
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         return self.outputs
 
-    def _out_shapes(self):
+    def _out_structs(self):
         eval_fn = self._eval_fn
-        outs = jax.eval_shape(
+        return jax.eval_shape(
             lambda a, x, k: eval_fn(a, x, k, True)[0],
             {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
              for k, v in self.arg_dict.items()},
             {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
              for k, v in self.aux_dict.items()},
             jax.ShapeDtypeStruct((2,), _np.uint32))
-        return [o.shape for o in outs]
+
+    def _out_shapes(self):
+        return [o.shape for o in self._out_structs()]
 
     def backward(self, out_grads=None, is_train=True):
         if not self._req_args:
@@ -408,11 +412,12 @@ def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
-    type_dict = type_dict or {}
-    args = {}
-    for name, shp in zip(arg_names, arg_shapes):
-        dt = type_dict.get(name, _np.float32)
-        args[name] = _nd.zeros(shp, ctx=alloc_ctx, dtype=dt)
+    # type_dict seeds dtype propagation: unnamed params adopt the dtypes
+    # inference derives (fp16 data -> fp16 weights, f32 BN stats — the
+    # reference's simple_bind type_dict path, graph_executor.cc:1594)
+    arg_types, _, aux_types = symbol.infer_type(**(type_dict or {}))
+    args = {name: _nd.zeros(shp, ctx=alloc_ctx, dtype=dt)
+            for name, shp, dt in zip(arg_names, arg_shapes, arg_types)}
     if isinstance(grad_req, str):
         req_map = {k: grad_req for k in arg_names}
     elif isinstance(grad_req, (list, tuple)):
@@ -421,7 +426,7 @@ def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
         req_map = {k: grad_req.get(k, "null") for k in arg_names}
     args_grad = {k: _nd.zeros(args[k].shape, ctx=alloc_ctx, dtype=args[k].dtype)
                  for k in arg_names if req_map.get(k, "null") != "null"}
-    aux = {name: _nd.zeros(shp, ctx=alloc_ctx)
-           for name, shp in zip(aux_names, aux_shapes)}
+    aux = {name: _nd.zeros(shp, ctx=alloc_ctx, dtype=dt)
+           for name, shp, dt in zip(aux_names, aux_shapes, aux_types)}
     return Executor(symbol, ctx, args, args_grad, req_map, aux,
                     batch_args=batch_args)

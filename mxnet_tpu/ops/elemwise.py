@@ -219,6 +219,17 @@ def cast(data, *, dtype="float32"):
 alias("Cast", "cast")
 
 
+def _cast_dtypes(in_dtypes, params):
+    import numpy as _np2
+    from ..base import normalize_dtype
+    return list(in_dtypes), [_np2.dtype(normalize_dtype(
+        params.get("dtype", "float32")))]
+
+
+from .registry import set_op_meta as _set_op_meta  # noqa: E402
+_set_op_meta("Cast", dtype_hook=_cast_dtypes)
+
+
 @register("zeros_like")
 def zeros_like(data):
     return jnp.zeros_like(data)

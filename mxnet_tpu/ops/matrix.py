@@ -405,3 +405,24 @@ def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, a
 
 
 alias("sequence_reverse", "SequenceReverse")
+
+
+def _param_dtype_out(in_dtypes, params):
+    """argsort/topk indices take the `dtype` param (default f32), not the
+    input dtype; topk ret_typ=value/both lead with the input dtype."""
+    import numpy as _np2
+    from ..base import normalize_dtype
+    idx_dt = _np2.dtype(normalize_dtype(params.get("dtype", "float32")))
+    d = in_dtypes[0] if in_dtypes and in_dtypes[0] is not None \
+        else _np2.dtype("float32")
+    ret = params.get("ret_typ", "indices")
+    if ret == "value":
+        return list(in_dtypes), [d]
+    if ret == "both":
+        return list(in_dtypes), [d, idx_dt]
+    return list(in_dtypes), [idx_dt]
+
+
+from .registry import set_op_meta as _set_op_meta  # noqa: E402
+_set_op_meta("argsort", dtype_hook=_param_dtype_out)
+_set_op_meta("topk", dtype_hook=_param_dtype_out)

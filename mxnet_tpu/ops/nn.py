@@ -216,9 +216,13 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     """
     red_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # mixed precision: stats/scale math in f32 regardless of data dtype
+    # (reference cuDNN BN computes fp16 inputs with f32 stats and f32
+    # gamma/beta/aux); output returns in the data dtype
+    x32 = data.astype(jnp.float32) if data.dtype != jnp.float32 else data
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=red_axes)
-        var = jnp.var(data, axis=red_axes)
+        mean = jnp.mean(x32, axis=red_axes)
+        var = jnp.var(x32, axis=red_axes)
         new_mean = moving_mean * momentum + mean * (1.0 - momentum)
         new_var = moving_var * momentum + var * (1.0 - momentum)
     else:
@@ -227,9 +231,10 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     shape = [1] * data.ndim
     shape[axis % data.ndim] = data.shape[axis % data.ndim]
     inv = lax.rsqrt(var + eps)
-    out = (data - jnp.reshape(mean, shape)) * jnp.reshape(inv * g, shape) \
+    out = (x32 - jnp.reshape(mean, shape)) * jnp.reshape(inv * g, shape) \
         + jnp.reshape(beta, shape)
-    return (out, lax.stop_gradient(mean), lax.stop_gradient(var),
+    return (out.astype(data.dtype), lax.stop_gradient(mean),
+            lax.stop_gradient(var),
             lax.stop_gradient(new_mean), lax.stop_gradient(new_var))
 
 
@@ -811,7 +816,17 @@ def _prelu_shapes(ins, p):
 _set_op_meta("FullyConnected", shape_hook=_fc_shapes)
 _set_op_meta("Convolution", shape_hook=_conv_shapes)
 _set_op_meta("Deconvolution", shape_hook=_deconv_shapes)
-_set_op_meta("BatchNorm", shape_hook=_bn_shapes,
+def _bn_dtypes(in_dtypes, params):
+    """fp16/bf16 data keeps f32 gamma/beta/moving stats and f32 batch
+    stats (reference BN FInferType pins aux float32)."""
+    import numpy as _np2
+    d = in_dtypes[0] if in_dtypes and in_dtypes[0] is not None \
+        else _np2.dtype("float32")
+    f32 = _np2.dtype("float32")
+    return [d, f32, f32, f32, f32], [d, f32, f32, f32, f32]
+
+
+_set_op_meta("BatchNorm", shape_hook=_bn_shapes, dtype_hook=_bn_dtypes,
              aux_inputs=(3, 4), aux_outputs=(3, 4),
              num_visible_outputs=lambda p: 3 if p.get("output_mean_var") else 1)
 _set_op_meta("LayerNorm", shape_hook=_ln_shapes)

@@ -101,3 +101,15 @@ def cumsum(data, *, axis=None, dtype=None):
 @register("square_sum")
 def square_sum(data, *, axis=None, keepdims=False):
     return jnp.sum(jnp.square(data), axis=_norm_axis(axis), keepdims=keepdims)
+
+
+def _f32_out_dtypes(in_dtypes, params):
+    """Index-returning ops always emit float32 (reference argmax/argmin
+    return real_t indices), independent of the input dtype."""
+    import numpy as _np2
+    return list(in_dtypes), [_np2.dtype("float32")]
+
+
+from .registry import set_op_meta as _set_op_meta  # noqa: E402
+for _name in ("argmax", "argmin", "argmax_channel"):
+    _set_op_meta(_name, dtype_hook=_f32_out_dtypes)

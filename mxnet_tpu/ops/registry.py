@@ -30,8 +30,9 @@ class Operator:
     """A registered op: a pure jax fn + metadata for the two front-ends."""
 
     __slots__ = ("name", "fn", "num_outputs", "param_names", "is_random",
-                 "doc", "shape_hook", "aux_inputs", "aux_outputs",
-                 "num_visible_outputs", "input_names", "input_optional")
+                 "doc", "shape_hook", "dtype_hook", "aux_inputs",
+                 "aux_outputs", "num_visible_outputs", "input_names",
+                 "input_optional")
 
     def __init__(self, name, fn, num_outputs=1, is_random=False):
         self.name = name
@@ -41,6 +42,7 @@ class Operator:
         self.doc = fn.__doc__ or ""
         # symbolic-layer metadata (set via set_op_meta):
         self.shape_hook = None        # fn(in_shapes, params) -> completed in_shapes
+        self.dtype_hook = None        # fn(in_dtypes, params) -> (in_dtypes, out_dtypes)
         self.aux_inputs = ()          # input slots that are auxiliary states
         self.aux_outputs = ()         # output slots holding updated aux values
         self.num_visible_outputs = None  # outputs exposed to the graph (prefix)
@@ -91,13 +93,16 @@ def register(name=None, num_outputs=1, is_random=False):
     return deco
 
 
-def set_op_meta(name, shape_hook=None, aux_inputs=None, aux_outputs=None,
-                num_visible_outputs=None):
-    """Attach symbolic-layer metadata (parameter-shape inference hook and
-    auxiliary-state slots — the reference's FInferShape / aux_states)."""
+def set_op_meta(name, shape_hook=None, dtype_hook=None, aux_inputs=None,
+                aux_outputs=None, num_visible_outputs=None):
+    """Attach symbolic-layer metadata (parameter-shape/dtype inference
+    hooks and auxiliary-state slots — the reference's FInferShape /
+    FInferType / aux_states)."""
     op = _REGISTRY[name]
     if shape_hook is not None:
         op.shape_hook = shape_hook
+    if dtype_hook is not None:
+        op.dtype_hook = dtype_hook
     if aux_inputs is not None:
         op.aux_inputs = tuple(aux_inputs)
     if aux_outputs is not None:

@@ -254,12 +254,32 @@ class Symbol:
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):
-        # all-float32 default (full dtype propagation happens at bind time)
-        n_args = len(self.list_arguments())
-        dt = _np.float32
-        return ([dt] * n_args,
-                [dt] * len(self._entries),
-                [dt] * len(self.list_auxiliary_states()))
+        """Propagate dtypes through the DAG (reference
+        src/executor/infer_graph_attr_pass.cc:41-72 / op FInferType).
+
+        Positional args pair with list_arguments(); kwargs name variables.
+        Unknown variable inputs of an op adopt the op's promoted input
+        dtype (the reference's same-type constraint), hooks override for
+        ops with fixed signatures (Cast, BatchNorm's f32 stats, ...).
+        """
+        arg_names = self.list_arguments()
+        known = {}
+        for name, dt in zip(arg_names, args):
+            if dt is not None:
+                known[name] = _np.dtype(dt)
+        for name, dt in kwargs.items():
+            if dt is not None:
+                known[name] = _np.dtype(dt)
+        types = _infer_types(self, known)
+        f32 = _np.dtype(_np.float32)
+        arg_types = [types.get(("var", n), f32) for n in arg_names]
+        out_types = []
+        for (n, oi) in self._entries:
+            key = ("var", n.name) if n.is_variable else (id(n), oi)
+            out_types.append(types.get(key, f32))
+        aux_types = [types.get(("var", n), f32)
+                     for n in self.list_auxiliary_states()]
+        return arg_types, out_types, aux_types
 
     # ----------------------------------------------------------------- eval
     def eval(self, ctx=None, **kwargs):
@@ -358,7 +378,9 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
-        attrs["__dtype__"] = str(dtype)
+        # canonical name: str(np.float16) is "<class 'numpy.float16'>",
+        # which no consumer could parse
+        attrs["__dtype__"] = str(_np.dtype(dtype))
     if lr_mult is not None:
         attrs["__lr_mult__"] = lr_mult
     if wd_mult is not None:
@@ -437,6 +459,53 @@ def _infer_shapes(sym, known_var_shapes):
         for i, o in enumerate(outs):
             shapes[(id(n), i)] = tuple(o.shape)
     return shapes
+
+
+def _infer_types(sym, known_var_types):
+    """Forward dtype propagation with per-op hooks.
+
+    Returns dict: ("var", name) -> dtype for variables,
+    (id(node), out_idx) -> dtype for op outputs. Default rule: an op's
+    outputs take the promotion (jnp.result_type) of its known input
+    dtypes, and unknown VARIABLE inputs adopt that promoted dtype — the
+    same-dtype constraint most reference ops register as FInferType.
+    ``dtype_hook(in_dtypes, params) -> (in_dtypes, out_dtypes)`` overrides
+    (Cast's target dtype, BatchNorm's pinned-f32 stats, ...).
+    """
+    import jax.numpy as jnp
+
+    f32 = _np.dtype(_np.float32)
+    types = {}
+    for name, t in known_var_types.items():
+        types[("var", name)] = _np.dtype(t)
+    for n in sym._topo():
+        if n.is_variable:
+            if ("var", n.name) not in types and "__dtype__" in n.attrs:
+                from ..base import normalize_dtype
+                raw = n.attrs["__dtype__"]
+                try:
+                    types[("var", n.name)] = _np.dtype(raw)
+                except TypeError:
+                    types[("var", n.name)] = _np.dtype(normalize_dtype(raw))
+            continue
+        keys = [("var", s.name) if s.is_variable else (id(s), oi)
+                for (s, oi) in n.inputs]
+        in_dtypes = [types.get(k) for k in keys]
+        hook = getattr(n.op, "dtype_hook", None)
+        if hook is not None:
+            completed, out_dtypes = hook(in_dtypes, n.params)
+        else:
+            knowns = [d for d in in_dtypes if d is not None]
+            target = _np.dtype(jnp.result_type(*knowns)) if knowns else f32
+            completed = [d if d is not None else target for d in in_dtypes]
+            nout = n.op.resolve_num_outputs(n.params)
+            out_dtypes = [target] * nout
+        for k, (src, _), d in zip(keys, n.inputs, completed):
+            if d is not None and src.is_variable and types.get(k) is None:
+                types[k] = _np.dtype(d)
+        for i, d in enumerate(out_dtypes):
+            types[(id(n), i)] = _np.dtype(d)
+    return types
 
 
 def load(fname):
