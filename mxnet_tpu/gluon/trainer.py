@@ -155,7 +155,8 @@ class Trainer:
                 continue  # skip stale grads (reference trainer.py :340)
             if self._update_on_kvstore:
                 self._kvstore.push(i, param.grad())
-                self._kvstore.pull(i, param.data())
+                # weights must always come back, even from a sparse store
+                self._kvstore.pull(i, param.data(), ignore_sparse=False)
             else:
                 work.append((i, param))
             info.fresh = False

@@ -148,16 +148,25 @@ class KVStore:
 
     # ----------------------------------------------------------------- pull
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """reference kvstore.pull: row_sparse values are SKIPPED under the
+        default ignore_sparse=True (use row_sparse_pull for them);
+        ignore_sparse=False copies them (densifying into dense outs)."""
         keys, outs = _key_value(key, out)
         for k, os_ in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
             src = self._store[k]
+            if isinstance(src, _sp.RowSparseNDArray) and ignore_sparse:
+                continue
             if not isinstance(os_, list):
                 os_ = [os_]
             for o in os_:
                 if isinstance(src, _sp.BaseSparseNDArray):
-                    src.todense().copyto(o)
+                    if isinstance(o, _sp.RowSparseNDArray) and \
+                            isinstance(src, _sp.RowSparseNDArray):
+                        src.copyto(o)
+                    else:
+                        src.todense().copyto(o)
                 else:
                     src.copyto(o)
 

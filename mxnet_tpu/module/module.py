@@ -354,7 +354,9 @@ class Module(BaseModule):
                 if grad is None:
                     continue
                 self._kvstore.push(name, grad)
-                self._kvstore.pull(name, self._exec.arg_dict[name])
+                # weights must always come back, even from a sparse store
+                self._kvstore.pull(name, self._exec.arg_dict[name],
+                                   ignore_sparse=False)
         else:
             for i, name in enumerate(self._param_names):
                 grad = self._exec.grad_dict.get(name)

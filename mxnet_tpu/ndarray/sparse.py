@@ -10,6 +10,24 @@ gather/scatter/segment-sum, which XLA maps well to TPU. Row-sparse is the
 format that matters in practice (embedding grads, optimizer lazy updates) and
 it round-trips exactly. `nnz`-dependent shapes are materialized eagerly
 (host-side), matching the reference's eager cast_storage semantics.
+
+What executes SPARSE (never touching the dense logical shape):
+
+* ``dot(csr, dense)`` / ``dot(csr.T, dense)`` — gather + scatter-add over
+  nnz (reference src/operator/tensor/dot-inl.h);
+* ``retain`` — sorted search over stored indices;
+* row-sparse ``add`` (the kvstore reduce) — index-union on host (indices
+  are tiny), values segment-summed on device;
+* lazy optimizer updates (SGD/Adam/AdaGrad in optimizer.py) — only the
+  gradient's stored rows are gathered, updated and scattered back
+  (reference src/operator/optimizer_op-inl.h row_sparse kernels);
+* kvstore ``row_sparse_pull`` — retain over the stored value.
+
+Everything else falls back to dense via ``todense()`` — the reference's
+storage-fallback behavior, chosen deliberately: on TPU a dense masked op
+over a static shape usually beats a dynamic-shaped "sparse" one unless nnz
+is tiny. (v5p+ SparseCore embeddings would slot in behind this same API;
+not targeted while the bench chip is v5e.)
 """
 from __future__ import annotations
 
@@ -128,6 +146,12 @@ class RowSparseNDArray(BaseSparseNDArray):
     def retain(self, row_ids):
         return retain(self, row_ids)
 
+    def copy(self):
+        # storage-preserving (NDArray.copy would densify); jnp arrays are
+        # immutable so sharing them is a true copy
+        return RowSparseNDArray(self._values, self._indices, self._shape,
+                                ctx=self._ctx)
+
 
 class CSRNDArray(BaseSparseNDArray):
     """Compressed sparse row matrix."""
@@ -203,6 +227,10 @@ class CSRNDArray(BaseSparseNDArray):
             # row slice: rebuild via dense for simplicity
             return cast_storage(NDArray(self.todense()._data[key], ctx=self._ctx), "csr")
         return self.todense()[key]
+
+    def copy(self):
+        return CSRNDArray(self._values, self._indptr, self._indices,
+                          self._shape, ctx=self._ctx)
 
     def __repr__(self):
         return "\n<CSRNDArray %s @%s>" % (
@@ -284,13 +312,45 @@ def cast_storage(arr, stype):
     raise ValueError(stype)
 
 
+def write_rows(rsp, rows, new_vals):
+    """Overwrite/insert the given rows of a RowSparseNDArray in place,
+    keeping it sparse (the reference dist-server row_sparse weight update,
+    kvstore_dist_server.h:517-716). `rows` must be unique."""
+    wi = _np.asarray(rsp.indices.asnumpy())
+    ri = _np.asarray(rows)
+    uniq = _np.unique(_np.concatenate([wi, ri]))
+    cols = rsp.shape[1:]
+    out = jnp.zeros((len(uniq),) + tuple(cols), rsp.dtype)
+    if len(wi):
+        out = out.at[jnp.asarray(_np.searchsorted(uniq, wi))].set(rsp._values)
+    out = out.at[jnp.asarray(_np.searchsorted(uniq, ri))].set(
+        jnp.asarray(new_vals, rsp.dtype))
+    rsp._indices = jnp.asarray(uniq.astype(_np.int64))
+    rsp._values = out
+    rsp._invalidate()
+    return rsp
+
+
 def retain(rsp, row_ids):
-    """sparse_retain: keep only requested rows (reference sparse_retain op)."""
-    ids = row_ids._data.astype(jnp.int64) if isinstance(row_ids, NDArray) else jnp.asarray(row_ids)
-    # membership of each stored index in ids
-    dense = rsp.todense()._data
-    vals = dense[ids]
-    return RowSparseNDArray(vals, ids, rsp.shape, ctx=rsp._ctx)
+    """sparse_retain: keep only requested rows (reference sparse_retain
+    op). Executes sparse: a sorted-search over the stored indices (no
+    dense materialization — O(nnz log nnz + |ids|) instead of O(size))."""
+    ids = row_ids._data.astype(jnp.int64) if isinstance(row_ids, NDArray) \
+        else jnp.asarray(_np.asarray(row_ids)).astype(jnp.int64)
+    idx = rsp._indices
+    vals = rsp._values
+    if vals.shape[0] == 0:
+        zeros_row = jnp.zeros((ids.shape[0],) + rsp.shape[1:], rsp.dtype)
+        return RowSparseNDArray(zeros_row, ids, rsp.shape, ctx=rsp._ctx)
+    order = jnp.argsort(idx)
+    sidx, svals = idx[order], vals[order]
+    pos = jnp.clip(jnp.searchsorted(sidx, ids), 0, sidx.shape[0] - 1)
+    hit = sidx[pos] == ids
+    picked = svals[pos]
+    out_vals = jnp.where(
+        hit.reshape((-1,) + (1,) * (picked.ndim - 1)), picked,
+        jnp.zeros_like(picked))
+    return RowSparseNDArray(out_vals, ids, rsp.shape, ctx=rsp._ctx)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
@@ -323,11 +383,19 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
 
 def add(lhs, rhs):
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
-        idx = jnp.concatenate([lhs._indices, rhs._indices])
+        # union of stored rows, values segment-summed ON DEVICE: only the
+        # (tiny) index vectors touch the host to fix the result nnz —
+        # never the dense logical shape (kvstore reduce of embedding-table
+        # grads must not allocate the table)
+        li = _np.asarray(lhs.indices.asnumpy())
+        ri = _np.asarray(rhs.indices.asnumpy())
+        uniq, inv = _np.unique(_np.concatenate([li, ri]),
+                               return_inverse=True)
         vals = jnp.concatenate([lhs._values, rhs._values])
-        # combine duplicates via dense scatter-add (logical dense add)
-        dense = jnp.zeros(lhs.shape, vals.dtype).at[idx].add(vals)
-        return _rsp_from_dense(_np.asarray(dense), ctx=lhs._ctx)
+        summed = jnp.zeros((len(uniq),) + vals.shape[1:], vals.dtype) \
+            .at[jnp.asarray(inv)].add(vals)
+        return RowSparseNDArray(summed, jnp.asarray(uniq.astype(_np.int64)),
+                                lhs.shape, ctx=lhs._ctx)
     l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
     return l + r

@@ -25,6 +25,38 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
 _OPT_REGISTRY = {}
 
 
+def _sparse_grad_rows(opt, grad):
+    """(rows, prepped_values) for a row-sparse gradient: rescale + clip on
+    the stored values only. Lazy-update semantics (reference
+    src/operator/optimizer_op-inl.h row_sparse kernels): rows absent from
+    the gradient receive NO update — no weight decay, no momentum decay —
+    which is what makes embedding-scale sparse training cheap."""
+    import jax.numpy as jnp
+    g = grad._values * opt.rescale_grad
+    if opt.clip_gradient is not None and opt.clip_gradient > 0:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    return grad._indices, g
+
+
+def _gather_rows(weight, rows):
+    """Current weight values for the gradient's rows; sparse weights stay
+    sparse (missing rows read as zero)."""
+    from ..ndarray import sparse as _sp
+    if isinstance(weight, _sp.RowSparseNDArray):
+        return _sp.retain(weight, rows)._values
+    return weight._data[rows]
+
+
+def _apply_rows(weight, rows, new_rows):
+    """Write updated row values back; dense weights scatter in place,
+    sparse weights union-insert (dist-server rsp weight semantics)."""
+    from ..ndarray import sparse as _sp
+    if isinstance(weight, _sp.RowSparseNDArray):
+        _sp.write_rows(weight, rows, new_rows)
+    else:
+        weight._rebind(weight._data.at[rows].set(new_rows))
+
+
 def register(klass):
     """Register an optimizer class under its lowercase name
     (reference Optimizer.register :41)."""
@@ -124,8 +156,17 @@ class Optimizer:
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == _np.float16:
+            from ..ndarray import sparse as _sp
             inner, w32 = state
-            g32 = grad.astype("float32")
+            if isinstance(grad, _sp.RowSparseNDArray):
+                # keep the gradient sparse across the precision cast, or
+                # the lazy path silently densifies into non-lazy semantics
+                import jax.numpy as jnp
+                g32 = _sp.RowSparseNDArray(
+                    grad._values.astype(jnp.float32), grad._indices,
+                    grad.shape, ctx=grad.context)
+            else:
+                g32 = grad.astype("float32")
             self.update(index, w32, g32, inner)
             weight._rebind(w32._data.astype(weight.dtype))
         else:
@@ -183,6 +224,19 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.RowSparseNDArray) and self.lazy_update:
+            rows, g = _sparse_grad_rows(self, grad)
+            wr = _gather_rows(weight, rows)
+            g = g + wd * wr
+            if state is None:
+                _apply_rows(weight, rows, wr - lr * g)
+            else:
+                m = state._data
+                mr = self.momentum * m[rows] - lr * g
+                state._rebind(m.at[rows].set(mr))
+                _apply_rows(weight, rows, wr + mr)
+            return
         if state is None:
             _nd.invoke("sgd_update", [weight, grad],
                        {"lr": lr, "wd": wd, **self._clip_kw()}, out=weight)
@@ -257,6 +311,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_nd.zeros_like(weight),
@@ -270,6 +325,20 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1
         mean, var = state
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.RowSparseNDArray) and self.lazy_update:
+            import jax.numpy as jnp
+            rows, g = _sparse_grad_rows(self, grad)
+            wr = _gather_rows(weight, rows)
+            m, v = mean._data, var._data
+            g = g + wd * wr
+            mr = self.beta1 * m[rows] + (1 - self.beta1) * g
+            vr = self.beta2 * v[rows] + (1 - self.beta2) * jnp.square(g)
+            mean._rebind(m.at[rows].set(mr))
+            var._rebind(v.at[rows].set(vr))
+            _apply_rows(weight, rows,
+                        wr - lr_t * mr / (jnp.sqrt(vr) + self.epsilon))
+            return
         _nd.invoke("adam_update", [weight, grad, mean, var],
                    {"lr": lr_t, "beta1": self.beta1, "beta2": self.beta2,
                     "epsilon": self.epsilon, "wd": wd, **self._clip_kw()},
@@ -304,6 +373,19 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.RowSparseNDArray):
+            # AdaGrad's reference sparse kernel is unconditionally lazy
+            import jax.numpy as jnp
+            rows, g = _sparse_grad_rows(self, grad)
+            wr = _gather_rows(weight, rows)
+            h = state._data
+            g = g + wd * wr
+            hr = h[rows] + jnp.square(g)
+            state._rebind(h.at[rows].set(hr))
+            _apply_rows(weight, rows,
+                        wr - lr * g / jnp.sqrt(hr + self.float_stable_eps))
+            return
         _nd.invoke("adagrad_update", [weight, grad, state],
                    {"lr": lr, "wd": wd, "epsilon": self.float_stable_eps,
                     **self._clip_kw()}, out=[weight, state])

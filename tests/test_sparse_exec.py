@@ -1,0 +1,173 @@
+"""Sparse EXECUTION (round-3): ops that must run without materializing the
+dense logical shape — csr dot, retain, row-sparse reduce, lazy optimizer
+updates, kvstore row_sparse paths. Reference: src/operator/tensor/dot-inl.h,
+src/operator/optimizer_op-inl.h, kvstore_dist_server.h:517-716."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+from mxnet_tpu import optimizer as opt
+
+
+def _rand_rsp(shape, rows, seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    vals = rng.randn(len(rows), *shape[1:]).astype(np.float32) * scale
+    dense = np.zeros(shape, np.float32)
+    dense[list(rows)] = vals
+    rsp = sp.row_sparse_array((vals, np.array(rows, np.int64)), shape=shape)
+    return rsp, dense
+
+
+def test_csr_dot_and_transpose():
+    rng = np.random.RandomState(0)
+    dense = rng.randn(6, 5).astype(np.float32)
+    dense[dense < 0.3] = 0  # sparsify
+    csr = sp.csr_matrix(dense)
+    rhs = mx.nd.array(rng.randn(5, 4).astype(np.float32))
+    out = sp.dot(csr, rhs)
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs.asnumpy(),
+                               rtol=1e-5)
+    rhs2 = mx.nd.array(rng.randn(6, 3).astype(np.float32))
+    out_t = sp.dot(csr, rhs2, transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), dense.T @ rhs2.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_retain_sparse_no_densify():
+    rsp, dense = _rand_rsp((100, 3), [2, 50, 97])
+    kept = sp.retain(rsp, mx.nd.array(np.array([2, 7, 97], np.int64)))
+    assert kept.stype == "row_sparse"
+    expected = np.zeros((100, 3), np.float32)
+    expected[[2, 97]] = dense[[2, 97]]
+    np.testing.assert_allclose(kept.asnumpy(), expected, rtol=1e-6)
+
+
+def test_rsp_add_unions_rows():
+    a, da = _rand_rsp((50, 4), [1, 10, 30], seed=1)
+    b, db = _rand_rsp((50, 4), [10, 44], seed=2)
+    s = sp.add(a, b)
+    assert s.stype == "row_sparse"
+    assert sorted(np.asarray(s.indices.asnumpy()).tolist()) == [1, 10, 30, 44]
+    np.testing.assert_allclose(s.asnumpy(), da + db, rtol=1e-6)
+
+
+@pytest.mark.parametrize("name,kw", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+])
+def test_lazy_update_touches_only_grad_rows(name, kw):
+    shape = (40, 3)
+    rows = [3, 17, 25]
+    o = opt.create(name, wd=0.01, rescale_grad=0.5, **kw)
+    w = mx.nd.array(np.random.RandomState(0).randn(*shape)
+                    .astype(np.float32))
+    w0 = w.asnumpy().copy()
+    grad, gdense = _rand_rsp(shape, rows, seed=3)
+    state = o.create_state(0, w)
+    o.update(0, w, grad, state)
+    w1 = w.asnumpy()
+    untouched = np.setdiff1d(np.arange(shape[0]), rows)
+    # untouched rows: IDENTICAL (no wd decay — lazy semantics)
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert np.abs(w1[rows] - w0[rows]).max() > 0
+
+    # touched rows match the dense-math update restricted to those rows
+    o2 = opt.create(name, wd=0.01, rescale_grad=0.5, **kw)
+    wd_ = mx.nd.array(w0.copy())
+    st2 = o2.create_state(0, wd_)
+    o2.update(0, wd_, mx.nd.array(gdense), st2)
+    np.testing.assert_allclose(w1[rows], wd_.asnumpy()[rows],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_kvstore_rsp_push_stays_sparse():
+    kv = mx.kv.create("local")
+    kv.init("emb", sp.zeros("row_sparse", (30, 4)))
+    g1, d1 = _rand_rsp((30, 4), [0, 5], seed=4)
+    g2, d2 = _rand_rsp((30, 4), [5, 12], seed=5)
+    kv.push("emb", [g1, g2])
+    assert isinstance(kv._store["emb"], sp.RowSparseNDArray)
+    out = mx.nd.zeros((30, 4))
+    kv.pull("emb", out=out, ignore_sparse=False)
+    np.testing.assert_allclose(out.asnumpy(), d1 + d2, rtol=1e-6)
+
+
+def test_kvstore_pull_ignores_sparse_by_default():
+    kv = mx.kv.create("local")
+    kv.init("emb", sp.zeros("row_sparse", (10, 2)))
+    out = mx.nd.ones((10, 2))
+    kv.pull("emb", out=out)  # ignore_sparse=True: skipped
+    np.testing.assert_array_equal(out.asnumpy(), np.ones((10, 2)))
+    kv.pull("emb", out=out, ignore_sparse=False)
+    np.testing.assert_array_equal(out.asnumpy(), np.zeros((10, 2)))
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    rsp, dense = _rand_rsp((20, 3), [2, 9, 15], seed=6)
+    kv.init("w", rsp)
+    out = sp.zeros("row_sparse", (20, 3))
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array(
+        np.array([2, 9], np.int64)))
+    expected = np.zeros((20, 3), np.float32)
+    expected[[2, 9]] = dense[[2, 9]]
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+
+
+def test_sparse_embedding_training_pattern():
+    """The canonical row-sparse consumer: embedding-style rows updated
+    lazily across steps; cold rows never move."""
+    vocab, dim = 200, 8
+    table = mx.nd.array(np.random.RandomState(0)
+                        .randn(vocab, dim).astype(np.float32) * 0.1)
+    t0 = table.asnumpy().copy()
+    o = opt.create("adagrad", learning_rate=0.5, rescale_grad=1.0)
+    state = o.create_state(0, table)
+    hot = set()
+    for step in range(5):
+        rows = [(step * 7) % vocab, (step * 13 + 1) % vocab]
+        hot.update(rows)
+        g, _ = _rand_rsp((vocab, dim), sorted(set(rows)), seed=step)
+        o.update(0, table, g, state)
+    t1 = table.asnumpy()
+    cold = np.setdiff1d(np.arange(vocab), sorted(hot))
+    np.testing.assert_array_equal(t1[cold], t0[cold])
+    assert np.abs(t1[sorted(hot)] - t0[sorted(hot)]).max() > 0
+
+
+def test_sparse_weight_lazy_update():
+    """Row-sparse WEIGHT (the dist-server rsp table) updated in place,
+    staying sparse (round-3 review: the lazy branch crashed on sparse
+    weights)."""
+    shape = (60, 4)
+    w = sp.row_sparse_array(
+        (np.ones((2, 4), np.float32), np.array([5, 20], np.int64)),
+        shape=shape)
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=1.0)
+    g, gd = _rand_rsp(shape, [5, 33], seed=9)
+    o.update(0, w, g, o.create_state(0, w))
+    assert w.stype == "row_sparse"
+    assert sorted(np.asarray(w.indices.asnumpy()).tolist()) == [5, 20, 33]
+    dense = w.asnumpy()
+    np.testing.assert_allclose(dense[5], 1.0 - gd[5], rtol=1e-5)
+    np.testing.assert_allclose(dense[20], 1.0)   # untouched row kept
+    np.testing.assert_allclose(dense[33], -gd[33], rtol=1e-5)
+
+
+def test_adam_lazy_update_flag_respected():
+    shape = (20, 2)
+    w0 = np.random.RandomState(0).randn(*shape).astype(np.float32)
+    g, _ = _rand_rsp(shape, [3], seed=1)
+    # lazy (default): untouched rows frozen
+    o1 = opt.create("adam", learning_rate=0.1, wd=0.1)
+    w1 = mx.nd.array(w0.copy())
+    o1.update(0, w1, g, o1.create_state(0, w1))
+    np.testing.assert_array_equal(w1.asnumpy()[0], w0[0])
+    # lazy_update=False: dense semantics, wd decays every row
+    o2 = opt.create("adam", learning_rate=0.1, wd=0.1, lazy_update=False)
+    w2 = mx.nd.array(w0.copy())
+    o2.update(0, w2, g, o2.create_state(0, w2))
+    assert (w2.asnumpy()[0] != w0[0]).any()
