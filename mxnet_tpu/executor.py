@@ -101,6 +101,16 @@ class Executor:
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None, group2ctx=None,
                  batch_args=None):
+        if group2ctx:
+            # the reference's manual model parallelism (graph_executor.cc
+            # :1594-1637) does not map to SPMD: refuse loudly instead of
+            # silently running single-device
+            raise MXNetError(
+                "group2ctx manual device placement is not supported on "
+                "TPU: express model parallelism with a device mesh "
+                "instead (Module(context=[...]) data parallelism, or "
+                "parallel.SPMDTrainStep(tp_axis=..., tp_rule=...) for "
+                "tensor parallelism)")
         self._symbol = symbol
         if isinstance(ctx, (list, tuple)):
             ctxs = [Context(c) for c in ctx] or [current_context()]
@@ -283,6 +293,16 @@ class Executor:
                 self.arg_dict[k]._rebind(self._place_input(val, k))
 
     def forward(self, is_train=False, **kwargs):
+        from . import profiler as _profiler
+        if _profiler.is_active("symbolic"):
+            with _profiler.op_timer(
+                    "Executor::forward%s" % ("_train" if is_train else ""),
+                    "symbolic",
+                    lambda: [o._data for o in self.outputs]):
+                return self._forward_impl(is_train, **kwargs)
+        return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         self.set_inputs(**kwargs)
         key = _random.next_key()
         if is_train:
@@ -324,6 +344,16 @@ class Executor:
     def backward(self, out_grads=None, is_train=True):
         if not self._req_args:
             return
+        from . import profiler as _profiler
+        if _profiler.is_active("symbolic"):
+            with _profiler.op_timer(
+                    "Executor::backward", "symbolic",
+                    lambda: [self.grad_dict[k]._data
+                             for k in self._req_args]):
+                return self._backward_impl(out_grads)
+        return self._backward_impl(out_grads)
+
+    def _backward_impl(self, out_grads=None):
         if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
@@ -429,4 +459,4 @@ def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
     aux = {name: _nd.zeros(shp, ctx=alloc_ctx, dtype=dt)
            for name, shp, dt in zip(aux_names, aux_shapes, aux_types)}
     return Executor(symbol, ctx, args, args_grad, req_map, aux,
-                    batch_args=batch_args)
+                    group2ctx=group2ctx, batch_args=batch_args)

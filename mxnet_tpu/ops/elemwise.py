@@ -167,7 +167,7 @@ def _copy(data):
     return jnp.asarray(data)
 
 
-alias("_copy", "identity", "stop_gradient_identity_marker_unused")
+alias("_copy", "identity")
 
 
 @register("BlockGrad")

@@ -210,7 +210,6 @@ def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
     return jnp.matmul(a, b)
 
 
-alias("batch_dot", "linalg_gemm2_batched_unused")
 
 
 @register("khatri_rao")
@@ -260,11 +259,6 @@ def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
     oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
     out = oh * on_value + (1.0 - oh) * off_value
     return out.astype(normalize_dtype(dtype))
-
-
-@register("where_index_unused")
-def _where_index(data):
-    raise NotImplementedError
 
 
 @register("boolean_mask_dense")
