@@ -63,6 +63,8 @@ def __getattr__(name):
         "kv": ".kvstore",
         "monitor": ".monitor",
         "operator": ".operator",
+        "rnn": ".rnn",
+        "model": ".model",
         "parallel": ".parallel",
         "profiler": ".profiler",
         "test_utils": ".test_utils",

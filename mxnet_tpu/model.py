@@ -1,6 +1,10 @@
-"""Checkpoint helpers + BatchEndParam (parity: python/mxnet/model.py —
-save_checkpoint :383, load_checkpoint :413; the legacy FeedForward trainer is
-superseded by Module, kept as a thin alias)."""
+"""Checkpoint helpers + BatchEndParam + the legacy FeedForward trainer
+(parity: python/mxnet/model.py — save_checkpoint :383, load_checkpoint
+:413, FeedForward :536-1012). FeedForward predates Module in the
+reference and countless v0.x-era scripts use it; here it is a faithful
+facade over Module (which the reference's own docs recommend migrating
+to), so those scripts run unchanged while training goes through the
+fused TPU step."""
 from __future__ import annotations
 
 import collections
@@ -8,7 +12,8 @@ import collections
 from . import symbol as _symbol
 from .ndarray import ndarray as _nd
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint"]
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "FeedForward"]
 
 BatchEndParam = collections.namedtuple(
     "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
@@ -37,3 +42,154 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy v0.x trainer (reference model.py:536): symbol + ctx +
+    optimizer bundled, with fit/predict/score/save/load. Implemented over
+    Module — identical training semantics, fused step underneath."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as _init
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or _init.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _ctx_list(self):
+        if self.ctx is None:
+            return None
+        return self.ctx if isinstance(self.ctx, (list, tuple)) else [self.ctx]
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module import Module
+        from .io import NDArrayIter
+        import numpy as _np
+        if not hasattr(X, "provide_data"):  # numpy (X, y) path
+            X = NDArrayIter(_np.asarray(X), _np.asarray(y),
+                            batch_size=self.numpy_batch_size, shuffle=True)
+        label_names = [n for n in self.symbol.list_arguments()
+                       if n.endswith("label")] or ["softmax_label"]
+        self._module = Module(self.symbol,
+                              data_names=[d[0] for d in X.provide_data],
+                              label_names=label_names,
+                              context=self._ctx_list())
+        opt_params = dict(self.kwargs)
+        self._module.fit(
+            X, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer, optimizer_params=opt_params,
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params,
+            allow_missing=self.arg_params is not None,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+            monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+        from .io import NDArrayIter
+        if not hasattr(X, "provide_data"):
+            X = NDArrayIter(_np.asarray(X),
+                            batch_size=min(self.numpy_batch_size,
+                                           len(_np.asarray(X))))
+        mod = self._predict_module(X)
+        if return_data:
+            # reference contract: (outputs, datas, labels)
+            if reset:
+                X.reset()
+            outs, datas, labels = [], [], []
+            for i, (batch_outs, _, batch) in enumerate(
+                    mod.iter_predict(X, num_batch=num_batch, reset=False)):
+                outs.append(batch_outs[0].asnumpy())
+                datas.append(batch.data[0].asnumpy())
+                if batch.label:
+                    labels.append(batch.label[0].asnumpy())
+            return (_np.concatenate(outs),
+                    _np.concatenate(datas),
+                    _np.concatenate(labels) if labels else None)
+        out = mod.predict(X, num_batch=num_batch, reset=reset,
+                          always_output_list=False)
+        if isinstance(out, list):
+            return [o.asnumpy() for o in out]
+        return out.asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None, reset=True):
+        from . import metric as _metric
+        mod = self._predict_module(X)
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        mod.score(X, eval_metric, num_batch=num_batch, reset=reset)
+        return eval_metric.get()[1]
+
+    def _predict_module(self, X):
+        from .module import Module
+        if self._module is not None and self._module.binded:
+            return self._module
+        assert self.arg_params is not None, "call fit() or load() first"
+        label_names = [n for n in self.symbol.list_arguments()
+                       if n.endswith("label")]
+        mod = Module(self.symbol,
+                     data_names=[d[0] for d in X.provide_data],
+                     label_names=label_names, context=self._ctx_list())
+        mod.bind(X.provide_data,
+                 X.provide_label if label_names else None,
+                 for_training=False)
+        mod.init_params(arg_params=self.arg_params,
+                        aux_params=self.aux_params,
+                        allow_missing=False,
+                        allow_extra=self.allow_extra_params)
+        self._module = mod
+        return mod
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        assert self.arg_params is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params,
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Train a new model from scratch (reference model.py:958)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -17,3 +17,4 @@ from . import image_ops     # noqa: F401
 from . import quantization  # noqa: F401
 from . import contrib_ops   # noqa: F401
 from . import custom_op     # noqa: F401
+from . import vision_ops    # noqa: F401

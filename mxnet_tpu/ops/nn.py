@@ -238,6 +238,17 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
             lax.stop_gradient(new_mean), lax.stop_gradient(new_var))
 
 
+@register("_rnn_begin_state")
+def _rnn_begin_state(ref, *, state_shape, batch_axis=0):
+    """Zero initial RNN state whose batch dim comes from `ref` (entries of
+    0 in state_shape are replaced by ref.shape[batch_axis]); keeps
+    shape inference flowing forward when cells unroll with default
+    states."""
+    shp = tuple(ref.shape[batch_axis] if int(s) == 0 else int(s)
+                for s in state_shape)
+    return jnp.zeros(shp, ref.dtype)
+
+
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     mean = jnp.mean(data, axis=axis, keepdims=True)

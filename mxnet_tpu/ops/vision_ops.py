@@ -1,0 +1,333 @@
+"""Vision operators the reference test suite exercises: Correlation, Crop
+(v1), DeformableConvolution, Proposal, SyncBatchNorm.
+
+Reference kernels: src/operator/correlation.cc, src/operator/crop.cc,
+src/operator/contrib/deformable_convolution.cc (+ deformable_im2col),
+src/operator/contrib/proposal.cc, src/operator/contrib/sync_batch_norm.cc.
+
+TPU-native notes: everything is static-shaped, vectorized jnp (gradients
+via jax autodiff — no hand-written backward kernels); Proposal emits a
+fixed rpn_post_nms_top_n rows with -1 padding (the reference pads by
+repeating; -1 rows match our box_nms convention); SyncBatchNorm is
+BatchNorm — under SPMD with the batch axis sharded, XLA computes the
+cross-replica statistics automatically, which IS the sync the reference
+implements by hand with AllReduce (sync_batch_norm.cc).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias, get as _get_op, set_op_meta
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet; reference src/operator/correlation.cc:33-82)
+# ---------------------------------------------------------------------------
+
+@register("Correlation")
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    k = int(kernel_size)
+    md, s1, s2, p = int(max_displacement), int(stride1), int(stride2), \
+        int(pad_size)
+    n, c, h, w = data1.shape
+    t1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    t2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    hp, wp = h + 2 * p, w + 2 * p
+    kr = (k - 1) // 2
+    border = md + kr
+    top_h = -(-(hp - 2 * border) // s1)   # ceil
+    top_w = -(-(wp - 2 * border) // s1)
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    sumelems = k * k * c
+    ones = jnp.ones((1, 1, k, k), t1.dtype)
+
+    def boxsum(x):  # (n, hp', wp') -> valid kxk window sums
+        return lax.conv_general_dilated(
+            x[:, None], ones, (1, 1), "VALID")[:, 0]
+
+    outs = []
+    for ti in range(ngw * ngw):
+        s2o = (ti % ngw - ngr) * s2
+        s2p = (ti // ngw - ngr) * s2
+        shifted = jnp.roll(t2, shift=(-s2p, -s2o), axis=(2, 3))
+        prod = (t1 * shifted) if is_multiply else jnp.abs(t1 - shifted)
+        summed = boxsum(prod.sum(axis=1))  # (n, hp-k+1, wp-k+1)
+        # out[i,j] = window starting at (i*s1+md - kr + kr, ...) ==
+        # boxsum index y1 = i*s1 + md - ... window top-left = y1 (x1)
+        # where y1 = i*s1 + md maps into boxsum at y1 - 0 since boxsum
+        # index is the window's top-left in the padded map
+        sl = summed[:, md:md + top_h * s1:s1, md:md + top_w * s1:s1]
+        outs.append(sl / sumelems)
+    return jnp.stack(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Crop v1 (reference src/operator/crop.cc — center/offset crop to h_w or to
+# a reference symbol's spatial size)
+# ---------------------------------------------------------------------------
+
+@register("Crop")
+def crop_v1(data, crop_like=None, *, offset=(0, 0), h_w=(0, 0),
+            center_crop=False, num_args=1):
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+        if th <= 0 or tw <= 0:
+            raise ValueError("Crop without crop_like needs h_w")
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (h - th) // 2, (w - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+set_op_meta("Crop", num_visible_outputs=1)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (reference contrib/deformable_convolution.cc via
+# deformable_im2col: bilinear sampling at offset kernel taps, then GEMM)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, ys, xs):
+    """img (C,H,W); ys/xs (...,): bilinear sample, zero outside."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    vals = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            ok = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            v = img[:, yc, xc]  # (C, ...)
+            vals = vals + v * (wy * wx * ok)[None]
+    return vals
+
+
+@register("_contrib_DeformableConvolution")
+def deformable_convolution(data, offset, weight, bias=None, *, kernel,
+                           num_filter, stride=(1, 1), pad=(0, 0),
+                           dilate=(1, 1), num_deformable_group=1,
+                           num_group=1, no_bias=False, workspace=1024,
+                           layout="NCHW"):
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    dg = int(num_deformable_group)
+    n, c, h, w = data.shape
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    # offsets: (N, 2*dg*kh*kw, oh, ow), channel ((g*kh+a)*kw+b)*2 + {y,x}
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+
+    def sample_one(img, off_b):
+        # img (C,H,W), off_b (dg, kh*kw, 2, oh, ow)
+        cols = []
+        cpg = c // dg  # channels per deformable group
+        for g in range(dg):
+            taps = []
+            for a in range(kh):
+                for b_ in range(kw):
+                    t = a * kw + b_
+                    ys = (jnp.arange(oh) * sh - ph + a * dh)[:, None] \
+                        + off_b[g, t, 0]
+                    xs = (jnp.arange(ow) * sw - pw + b_ * dw)[None, :] \
+                        + off_b[g, t, 1]
+                    taps.append(_bilinear_gather(
+                        img[g * cpg:(g + 1) * cpg], ys, xs))
+            cols.append(jnp.stack(taps, axis=1))  # (cpg, kh*kw, oh, ow)
+        return jnp.concatenate(cols, axis=0)  # (C, kh*kw, oh, ow)
+
+    sampled = jax.vmap(sample_one)(data, off)  # (N, C, kh*kw, oh, ow)
+    wmat = weight.reshape(num_filter, -1)  # (F, C/ng * kh*kw)
+    ng = int(num_group)
+    cg = c // ng
+    fg = num_filter // ng
+    outs = []
+    for g in range(ng):
+        sg = sampled[:, g * cg:(g + 1) * cg].reshape(n, cg * kh * kw, oh, ow)
+        wg = wmat[g * fg:(g + 1) * fg]
+        outs.append(jnp.einsum("fk,nkhw->nfhw", wg, sg))
+    out = jnp.concatenate(outs, axis=1)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _deform_conv_shapes(in_shapes, params):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return in_shapes
+    kh, kw = (int(x) for x in params["kernel"])
+    nf = int(params["num_filter"])
+    ng = int(params.get("num_group", 1))
+    dg = int(params.get("num_deformable_group", 1))
+    stride = params.get("stride", (1, 1))
+    pad = params.get("pad", (0, 0))
+    dilate = params.get("dilate", (1, 1))
+    n, c, h, w = dshape
+    oh = (h + 2 * int(pad[0]) - (int(dilate[0]) * (kh - 1) + 1)) \
+        // int(stride[0]) + 1
+    ow = (w + 2 * int(pad[1]) - (int(dilate[1]) * (kw - 1) + 1)) \
+        // int(stride[1]) + 1
+    completed = list(in_shapes)
+    completed[1] = (n, 2 * dg * kh * kw, oh, ow)
+    completed[2] = (nf, c // ng, kh, kw)
+    if len(completed) > 3 and completed[3] is None and \
+            not params.get("no_bias", False):
+        completed[3] = (nf,)
+    return completed
+
+
+set_op_meta("_contrib_DeformableConvolution", shape_hook=_deform_conv_shapes)
+
+
+# ---------------------------------------------------------------------------
+# Proposal (RPN; reference src/operator/contrib/proposal.cc)
+# ---------------------------------------------------------------------------
+
+def _make_anchors(base_size, scales, ratios):
+    """Reference GenerateAnchors (proposal.cc): base box (0,0,bs-1,bs-1),
+    ratio enum then scale enum."""
+    bs = float(base_size)
+    px, py = (bs - 1) * 0.5, (bs - 1) * 0.5
+    size = bs * bs
+    anchors = []
+    for r in ratios:
+        size_ratio = size / r
+        ws = round(_np.sqrt(size_ratio))
+        hs = round(ws * r)
+        for s in scales:
+            w2, h2 = ws * s, hs * s
+            anchors.append([px - (w2 - 1) * 0.5, py - (h2 - 1) * 0.5,
+                            px + (w2 - 1) * 0.5, py + (h2 - 1) * 0.5])
+    return _np.asarray(anchors, _np.float32)
+
+
+@register("_contrib_Proposal",
+          num_outputs=lambda p: 2 if p.get("output_score") else 1)
+def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    n, _, fh, fw = cls_prob.shape
+    A = len(scales) * len(ratios)
+    base = _make_anchors(feature_stride, scales, ratios)  # (A, 4)
+    shift_x = jnp.arange(fw) * feature_stride
+    shift_y = jnp.arange(fh) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)  # (fh, fw)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)
+    anchors = (base[None] + shifts[:, None]).reshape(-1, 4)  # (fh*fw*A, 4)
+
+    def one(scores_map, deltas_map, info):
+        # scores: fg channels (A..2A); layout (A, fh, fw) -> (fh*fw*A,)
+        scores = scores_map[A:].transpose(1, 2, 0).reshape(-1)
+        d = deltas_map.reshape(A, 4, fh, fw).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        if iou_loss:
+            # IoU-loss decode: deltas are direct corner offsets
+            # (proposal.cc IoUTransformInv)
+            x1 = anchors[:, 0] + d[:, 0]
+            y1 = anchors[:, 1] + d[:, 1]
+            x2 = anchors[:, 2] + d[:, 2]
+            y2 = anchors[:, 3] + d[:, 3]
+        else:
+            widths = anchors[:, 2] - anchors[:, 0] + 1.0
+            heights = anchors[:, 3] - anchors[:, 1] + 1.0
+            ctr_x = anchors[:, 0] + 0.5 * (widths - 1)
+            ctr_y = anchors[:, 1] + 0.5 * (heights - 1)
+            pred_x = d[:, 0] * widths + ctr_x
+            pred_y = d[:, 1] * heights + ctr_y
+            pred_w = jnp.exp(d[:, 2]) * widths
+            pred_h = jnp.exp(d[:, 3]) * heights
+            x1 = pred_x - 0.5 * (pred_w - 1)
+            y1 = pred_y - 0.5 * (pred_h - 1)
+            x2 = pred_x + 0.5 * (pred_w - 1)
+            y2 = pred_y + 0.5 * (pred_h - 1)
+        # clip to image
+        imh, imw = info[0], info[1]
+        x1 = jnp.clip(x1, 0, imw - 1.0)
+        y1 = jnp.clip(y1, 0, imh - 1.0)
+        x2 = jnp.clip(x2, 0, imw - 1.0)
+        y2 = jnp.clip(y2, 0, imh - 1.0)
+        # min-size filter (scaled by im_info[2])
+        min_sz = rpn_min_size * info[2]
+        keep = ((x2 - x1 + 1) >= min_sz) & ((y2 - y1 + 1) >= min_sz)
+        scores = jnp.where(keep, scores, -1.0)
+        pre_n = min(rpn_pre_nms_top_n, scores.shape[0]) \
+            if rpn_pre_nms_top_n > 0 else scores.shape[0]
+        top_scores, order = lax.top_k(scores, pre_n)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)[order]
+        # greedy NMS over the pre-nms set
+        packed = jnp.concatenate(
+            [jnp.zeros((pre_n, 1)), top_scores[:, None], boxes], axis=1)
+        nms = _get_op("_contrib_box_nms").fn(
+            packed, overlap_thresh=threshold, valid_thresh=0.0,
+            topk=rpn_post_nms_top_n, coord_start=2, score_index=1,
+            id_index=-1, force_suppress=True)
+        kept = nms[:, 1] >= 0
+        # compact the survivors to the front, pad with -1 rows
+        idx = jnp.argsort(~kept, stable=True)[:rpn_post_nms_top_n]
+        rows = nms[idx]
+        valid = kept[idx]
+        rois = jnp.where(valid[:, None], rows[:, 2:6],
+                         -jnp.ones_like(rows[:, 2:6]))
+        rscores = jnp.where(valid, rows[:, 1], -jnp.ones_like(rows[:, 1]))
+        return rois, rscores
+
+    rois, rscores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(n, dtype=rois.dtype)[:, None, None],
+        (n, rois.shape[1], 1))
+    out = jnp.concatenate([batch_idx, rois], axis=2) \
+        .reshape(-1, 5)
+    if output_score:
+        return out, rscores.reshape(-1, 1)
+    return out
+
+
+alias("_contrib_Proposal", "Proposal")
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm: on TPU this IS BatchNorm — with the batch axis sharded
+# over the mesh, XLA's sharding propagation makes jnp.mean/var over the
+# batch a cross-replica reduction, which is exactly the AllReduce the
+# reference hand-writes in src/operator/contrib/sync_batch_norm.cc. The
+# `key`/`ndev` bookkeeping of the reference's host barrier is unnecessary.
+# ---------------------------------------------------------------------------
+
+@register("_contrib_SyncBatchNorm", num_outputs=5)
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    output_mean_var=False, ndev=1, key=None,
+                    _training=True):
+    from .nn import batch_norm
+    return batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                      momentum=momentum, fix_gamma=fix_gamma,
+                      use_global_stats=use_global_stats,
+                      output_mean_var=output_mean_var, _training=_training)
+
+
+from .nn import _bn_shapes as _nn_bn_shapes  # noqa: E402
+from .nn import _bn_dtypes as _nn_bn_dtypes  # noqa: E402
+set_op_meta("_contrib_SyncBatchNorm", shape_hook=_nn_bn_shapes,
+            dtype_hook=_nn_bn_dtypes, aux_inputs=(3, 4), aux_outputs=(3, 4),
+            num_visible_outputs=lambda p: 3 if p.get("output_mean_var")
+            else 1)
+alias("_contrib_SyncBatchNorm", "SyncBatchNorm")
+alias("Correlation", "_contrib_Correlation")

@@ -1,0 +1,5 @@
+"""Symbolic RNN package (parity: python/mxnet/rnn/)."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell)
+from .io import BucketSentenceIter

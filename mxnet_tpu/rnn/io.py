@@ -1,0 +1,111 @@
+"""Bucketed sequence iterator (parity: python/mxnet/rnn/io.py
+BucketSentenceIter :33-211) — groups variable-length sentences into
+buckets so each bucket compiles one static-shape program (the TPU-native
+reason to keep bucketing: XLA recompiles per shape, so buckets bound the
+number of compilations exactly like the reference bounds cuDNN plans)."""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ..io.io import DataBatch, DataDesc
+from ..ndarray import ndarray as _nd
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter:
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        if not buckets:
+            lens = _np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(lens)
+                       if n >= batch_size]
+        buckets.sort()
+        self.data = [[] for _ in buckets]
+        ndiscard = 0
+        for sent in sentences:
+            buck = _np.searchsorted(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = _np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        # explicit 2-D shape: a bucket with zero sentences must still be
+        # (0, bucket_len), not a 1-D empty array
+        self.data = [_np.asarray(x, dtype=dtype).reshape(-1, blen)
+                     for x, blen in zip(self.data, buckets)]
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.invalid_label = invalid_label
+        self.nddata = []
+        self.ndlabel = []
+        self.major_axis = layout.find("N")
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+
+        if self.major_axis == 0:
+            self.provide_data = [DataDesc(
+                data_name, (batch_size, self.default_bucket_key),
+                layout=layout)]
+            self.provide_label = [DataDesc(
+                label_name, (batch_size, self.default_bucket_key),
+                layout=layout)]
+        else:
+            self.provide_data = [DataDesc(
+                data_name, (self.default_bucket_key, batch_size),
+                layout=layout)]
+            self.provide_label = [DataDesc(
+                label_name, (self.default_bucket_key, batch_size),
+                layout=layout)]
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend([(i, j) for j in
+                             range(0, len(buck) - batch_size + 1,
+                                   batch_size)])
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        _pyrandom.shuffle(self.idx)
+        for buck in self.data:
+            _np.random.shuffle(buck)
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            # label = next-token shift (reference io.py:185)
+            label = _np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(_nd.array(buck))
+            self.ndlabel.append(_nd.array(label))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        if self.major_axis == 1:
+            data = self.nddata[i][j:j + self.batch_size].T
+            label = self.ndlabel[i][j:j + self.batch_size].T
+        else:
+            data = self.nddata[i][j:j + self.batch_size]
+            label = self.ndlabel[i][j:j + self.batch_size]
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[i],
+            provide_data=[DataDesc(self.data_name, data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, label.shape,
+                                    layout=self.layout)])

@@ -1,0 +1,415 @@
+"""Symbolic RNN cells (``mx.rnn``).
+
+Parity surface: ``python/mxnet/rnn/rnn_cell.py`` (BaseRNNCell :121,
+RNNCell :341, LSTMCell :396, GRUCell :476, FusedRNNCell :543,
+SequentialRNNCell :756, BidirectionalCell :830, DropoutCell). These build
+SYMBOL graphs; gluon.rnn covers the imperative side. The v0.x bucketing
+examples (lstm_bucketing.py etc.) drive this API.
+
+TPU notes: an unrolled cell graph compiles into one XLA program at bind
+time (per-timestep FullyConnected ops fuse into MXU matmul chains);
+FusedRNNCell routes to the lax.scan-based fused RNN operator — prefer it
+for long sequences (compile time stays flat).
+"""
+from __future__ import annotations
+
+from .. import symbol as _sym
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell"]
+
+
+class RNNParams:
+    """Container for cell parameter symbols, shared by name (reference
+    rnn_cell.py:95)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = _sym.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract cell: ``cell(inputs, states) -> (output, states)`` over
+    symbols, plus ``unroll`` (reference rnn_cell.py:121)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._init_counter = -1
+        self._counter = -1
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def begin_state(self, func=None, **kwargs):
+        """Initial state symbols. Default: named Variables — simple_bind
+        allocates them zero-filled, which reproduces the reference's
+        zero initial state; pass shapes at bind time for inference.
+        (unroll with begin_state=None instead derives zero states from
+        the input symbol, so no extra bind args are needed.)"""
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if func is None:
+                state = _sym.Variable(
+                    "%sbegin_state_%d" % (self._prefix, self._init_counter),
+                    **kwargs)
+            else:
+                state = func(
+                    name="%sbegin_state_%d" % (self._prefix,
+                                               self._init_counter),
+                    **{**info, **kwargs})
+            states.append(state)
+        return states
+
+    def _zero_state_from(self, ref, batch_axis=0):
+        """Zero states shaped off a reference symbol's batch dim — shape
+        inference flows forward, unlike free begin-state Variables."""
+        return [_sym._rnn_begin_state(ref, state_shape=info["shape"],
+                                      batch_axis=batch_axis)
+                for info in self.state_info]
+
+    def unpack_weights(self, args):
+        return dict(args)
+
+    def pack_weights(self, args):
+        return dict(args)
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        """Unroll for `length` steps (reference rnn_cell.py:254)."""
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [_sym.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif not isinstance(inputs, (list, tuple)):
+            inputs = list(_sym.SliceChannel(inputs, num_outputs=length,
+                                            axis=axis, squeeze_axis=1))
+        if begin_state is None:
+            begin_state = self._zero_state_from(inputs[0])
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(inputs[i], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = [_sym.expand_dims(o, axis=axis) for o in outputs]
+            outputs = _sym.Concat(*outputs, dim=axis)
+        return outputs, states
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell (reference rnn_cell.py:341)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = _sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                  num_hidden=self._num_hidden,
+                                  name="%si2h" % name)
+        h2h = _sym.FullyConnected(states[0], weight=self._hW, bias=self._hB,
+                                  num_hidden=self._num_hidden,
+                                  name="%sh2h" % name)
+        output = _sym.Activation(i2h + h2h, act_type=self._activation,
+                                 name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (reference rnn_cell.py:396; gate order i,f,c,o)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        from ..initializer import LSTMBias
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        # forget gate starts open (reference rnn_cell.py:396 LSTMBias)
+        self._hB = self.params.get("h2h_bias",
+                                   init=LSTMBias(forget_bias=forget_bias))
+        self._forget_bias = forget_bias
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = _sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                  num_hidden=self._num_hidden * 4,
+                                  name="%si2h" % name)
+        h2h = _sym.FullyConnected(states[0], weight=self._hW, bias=self._hB,
+                                  num_hidden=self._num_hidden * 4,
+                                  name="%sh2h" % name)
+        gates = i2h + h2h
+        sliced = list(_sym.SliceChannel(gates, num_outputs=4, axis=1,
+                                        name="%sslice" % name))
+        in_gate = _sym.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = _sym.Activation(sliced[1], act_type="sigmoid")
+        in_transform = _sym.Activation(sliced[2], act_type="tanh")
+        out_gate = _sym.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * _sym.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (reference rnn_cell.py:476; gate order r,z,n)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev = states[0]
+        i2h = _sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                  num_hidden=self._num_hidden * 3,
+                                  name="%si2h" % name)
+        h2h = _sym.FullyConnected(prev, weight=self._hW, bias=self._hB,
+                                  num_hidden=self._num_hidden * 3,
+                                  name="%sh2h" % name)
+        i2h_r, i2h_z, i2h_n = list(_sym.SliceChannel(
+            i2h, num_outputs=3, axis=1, name="%si2h_slice" % name))
+        h2h_r, h2h_z, h2h_n = list(_sym.SliceChannel(
+            h2h, num_outputs=3, axis=1, name="%sh2h_slice" % name))
+        reset = _sym.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = _sym.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = _sym.Activation(i2h_n + reset * h2h_n, act_type="tanh")
+        next_h = (1.0 - update) * next_h_tmp + update * prev
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN over the RNN operator (reference
+    rnn_cell.py:543 — cuDNN there, lax.scan here). Parameters live in one
+    packed vector like the reference."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 prefix=None, params=None, forget_bias=1.0):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._param = self.params.get("parameters")
+
+    @property
+    def state_info(self):
+        b = self._num_layers * (2 if self._bidirectional else 1)
+        info = [{"shape": (b, 0, self._num_hidden), "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append({"shape": (b, 0, self._num_hidden),
+                         "__layout__": "LNC"})
+        return info
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            inputs = _sym.Concat(*[_sym.expand_dims(i, axis=0)
+                                   for i in inputs], dim=0)  # (T, N, C)
+        else:
+            if layout == "NTC":
+                inputs = _sym.transpose(inputs, axes=(1, 0, 2))
+        if begin_state is None:
+            begin_state = self._zero_state_from(inputs, batch_axis=1)
+        states = list(begin_state)
+        state = states[0]
+        state_cell = states[1] if self._mode == "lstm" else None
+        args = [inputs, self._param, state]
+        if state_cell is not None:
+            args.append(state_cell)
+        outs = _sym.RNN(*args, state_size=self._num_hidden,
+                        num_layers=self._num_layers, mode=self._mode,
+                        bidirectional=self._bidirectional, p=self._dropout,
+                        state_outputs=self._get_next_state,
+                        name="%srnn" % self._prefix)
+        if self._get_next_state:
+            outs = list(outs)
+            output, states = outs[0], outs[1:]
+        else:
+            output, states = outs, []
+        if layout == "NTC":
+            output = _sym.transpose(output, axes=(1, 0, 2))
+        if merge_outputs is False:
+            output = list(_sym.SliceChannel(output, num_outputs=length,
+                                            axis=axis, squeeze_axis=1))
+        return output, states
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells applied in sequence (reference rnn_cell.py:756)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return sum((c.state_info for c in self._cells), [])
+
+    def begin_state(self, **kwargs):
+        return sum((c.begin_state(**kwargs) for c in self._cells), [])
+
+    def __call__(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            out, st = cell(inputs, states[p:p + n])
+            inputs = out
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def reset(self):
+        super().reset()
+        for c in self._cells:
+            c.reset()
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Forward + backward cells over the sequence (reference
+    rnn_cell.py:830)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._l_cell = l_cell
+        self._r_cell = r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l_cell.state_info + self._r_cell.state_info
+
+    def begin_state(self, **kwargs):
+        return (self._l_cell.begin_state(**kwargs)
+                + self._r_cell.begin_state(**kwargs))
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = list(_sym.SliceChannel(inputs, num_outputs=length,
+                                            axis=axis, squeeze_axis=1))
+        if begin_state is None:
+            begin_state = (self._l_cell._zero_state_from(inputs[0])
+                           + self._r_cell._zero_state_from(inputs[0]))
+        nl = len(self._l_cell.state_info)
+        l_out, l_states = self._l_cell.unroll(
+            length, inputs=list(inputs), begin_state=begin_state[:nl],
+            layout=layout, merge_outputs=False)
+        r_out, r_states = self._r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=begin_state[nl:], layout=layout,
+            merge_outputs=False)
+        outputs = [
+            _sym.Concat(l, r, dim=1,
+                        name="%st%d" % (self._output_prefix, i))
+            for i, (l, r) in enumerate(zip(l_out, reversed(r_out)))]
+        if merge_outputs:
+            outputs = [_sym.expand_dims(o, axis=axis) for o in outputs]
+            outputs = _sym.Concat(*outputs, dim=axis)
+        return outputs, l_states + r_states
+
+    def reset(self):
+        super().reset()
+        self._l_cell.reset()
+        self._r_cell.reset()
+
+
+class DropoutCell(BaseRNNCell):
+    """Applies dropout to inputs (reference rnn_cell.py:710)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self._dropout > 0:
+            inputs = _sym.Dropout(inputs, p=self._dropout)
+        return inputs, states

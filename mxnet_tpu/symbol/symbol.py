@@ -386,7 +386,10 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if wd_mult is not None:
         attrs["__wd_mult__"] = wd_mult
     if init is not None:
-        attrs["__init__"] = str(init)
+        # Initializer objects serialize via dumps() (json the registry can
+        # recreate); plain strings pass through (reference attr contract)
+        attrs["__init__"] = init.dumps() if hasattr(init, "dumps") \
+            else str(init)
     if stype is not None:
         attrs["__storage_type__"] = stype
     attrs.update(kwargs)

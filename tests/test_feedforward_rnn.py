@@ -1,0 +1,185 @@
+"""Legacy FeedForward trainer + mx.rnn symbolic package (reference
+python/mxnet/model.py:536, python/mxnet/rnn/) — the v0.x user surface."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_feedforward_fit_predict_score_save_load(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    model = mx.model.FeedForward(
+        _net(), num_epoch=20, learning_rate=0.5, numpy_batch_size=16)
+    model.fit(X, Y)  # plain numpy in, like the v0.x examples
+    acc = model.score(mx.io.NDArrayIter(X, Y, batch_size=16,
+                                        label_name="softmax_label"))
+    assert acc > 0.8, acc
+    probs = model.predict(X)
+    assert probs.shape == (64, 2)
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    loaded = mx.model.FeedForward.load(prefix, 20)
+    probs2 = loaded.predict(X)
+    np.testing.assert_allclose(probs, probs2, rtol=1e-5)
+
+
+def test_feedforward_create():
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = (X[:, 1] > 0).astype(np.float32)
+    model = mx.model.FeedForward.create(
+        _net(), X, Y, num_epoch=2, learning_rate=0.1, numpy_batch_size=16)
+    assert model.arg_params is not None
+
+
+@pytest.mark.parametrize("cell_cls,n_states", [
+    (lambda: mx.rnn.RNNCell(8), 1),
+    (lambda: mx.rnn.LSTMCell(8), 2),
+    (lambda: mx.rnn.GRUCell(8), 1),
+])
+def test_rnn_cell_unroll_shapes(cell_cls, n_states):
+    cell = cell_cls()
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(5, data, layout="NTC",
+                                  merge_outputs=True)
+    assert len(states) == n_states
+    kw = {"data": (4, 5, 6)}
+    for name in outputs.list_arguments():
+        if "begin_state" in name:
+            kw[name] = (4, 8)
+    _, out_shapes, _ = outputs.infer_shape(**kw)
+    assert out_shapes[0] == (4, 5, 8)
+
+
+def test_rnn_sequential_bidirectional():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.DropoutCell(0.0))
+    stack.add(mx.rnn.GRUCell(8, prefix="l1_"))
+    data = mx.sym.Variable("data")
+    outputs, states = stack.unroll(4, data, merge_outputs=True)
+    assert len(states) == 3  # 2 (lstm) + 0 (dropout) + 1 (gru)
+
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(5, prefix="f_"),
+                                  mx.rnn.LSTMCell(5, prefix="b_"))
+    outs, st = bi.unroll(4, mx.sym.Variable("data"), merge_outputs=True)
+    kw = {"data": (2, 4, 3)}
+    for name in outs.list_arguments():
+        if "begin_state" in name:
+            kw[name] = (2, 5)
+    _, out_shapes, _ = outs.infer_shape(**kw)
+    assert out_shapes[0] == (2, 4, 10)  # fwd/bwd concat
+
+
+def test_rnn_lstm_trains_via_module():
+    """The canonical v0.x pattern: unrolled LSTM -> Module.fit (e.g.
+    example/rnn/lstm_bucketing.py shape)."""
+    T, B, C, H = 6, 8, 4, 16
+    cell = mx.rnn.LSTMCell(H, prefix="lstm_")
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    outputs, _ = cell.unroll(T, data, layout="NTC", merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, H))
+    pred = mx.sym.FullyConnected(pred, num_hidden=3, name="pred")
+    net = mx.sym.SoftmaxOutput(pred, mx.sym.Reshape(label, shape=(-1,)),
+                               name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, T, C).astype(np.float32)
+    Y = rng.randint(0, 3, (32, T)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=B, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="adam", eval_metric=None,
+            optimizer_params={"learning_rate": 0.01})
+
+
+def test_fused_rnn_cell_unroll():
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm",
+                               get_next_state=True)
+    data = mx.sym.Variable("data")
+    output, states = cell.unroll(5, data, layout="NTC")
+    assert len(states) == 2
+    kw = {"data": (4, 5, 6)}
+    for name in output.list_arguments():
+        if "begin_state" in name:
+            kw[name] = (2, 4, 8)
+    arg_shapes, out_shapes, _ = output.infer_shape(**kw)
+    assert out_shapes[0] == (4, 5, 8)
+    # packed parameter vector got a concrete inferred shape
+    d = dict(zip(output.list_arguments(), arg_shapes))
+    assert np.prod(d["lstm_parameters"]) > 0
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 50, rng.randint(2, 12)))
+                 for _ in range(200)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[5, 10, 15], invalid_label=-1)
+    seen_keys = set()
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        assert batch.label[0].shape == (8, batch.bucket_key)
+        # label is the next-token shift of data
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+        seen_keys.add(batch.bucket_key)
+        n += 1
+    assert n > 0 and len(seen_keys) >= 2
+    it.reset()
+    assert sum(1 for _ in it) == n
+
+
+def test_bucket_iter_empty_bucket_ok():
+    """A bucket with zero sentences must not crash construction (round-3
+    review finding)."""
+    it = mx.rnn.BucketSentenceIter([[1, 2, 3]] * 20, batch_size=8,
+                                   buckets=[5, 10])
+    n = sum(1 for _ in it)
+    assert n > 0
+
+
+def test_lstm_forget_bias_applied():
+    cell = mx.rnn.LSTMCell(4, forget_bias=2.0, prefix="fb_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(2, data, merge_outputs=True)
+    from mxnet_tpu.executor import simple_bind
+    import mxnet_tpu.initializer as init
+    ex = simple_bind(outputs, mx.cpu(), data=(2, 2, 3))
+    mod_init = init.Uniform(0.01)
+    for name in ex.arg_dict:
+        if name != "data":
+            from mxnet_tpu.initializer import InitDesc
+            # replicate Module.init_params attr routing
+            attrs = {}
+            for node in outputs._topo():
+                if node.is_variable and node.name == name:
+                    attrs = dict(node.attrs)
+            mod_init(InitDesc(name, attrs), ex.arg_dict[name])
+    b = ex.arg_dict["fb_h2h_bias"].asnumpy()
+    np.testing.assert_allclose(b[4:8], 2.0)  # forget gate rows
+    assert np.abs(b[:4]).max() < 0.1
+
+
+def test_feedforward_predict_return_data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    model = mx.model.FeedForward(_net(), num_epoch=1, learning_rate=0.1,
+                                 numpy_batch_size=16)
+    model.fit(X, Y)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    outs, datas, labels = model.predict(it, return_data=True)
+    assert outs.shape == (32, 2) and datas.shape == (32, 8)
+    assert labels.shape == (32,)

@@ -1,0 +1,173 @@
+"""Vision ops vs brute-force references: Correlation, Crop v1,
+DeformableConvolution, Proposal, SyncBatchNorm (reference
+src/operator/correlation.cc, crop.cc, contrib/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _np_correlation(d1, d2, k, md, s1, s2, pad, is_multiply):
+    """Direct transcription of the reference loop nest
+    (correlation.cc:33-82)."""
+    n, c, h, w = d1.shape
+    t1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    t2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    hp, wp = h + 2 * pad, w + 2 * pad
+    kr = (k - 1) // 2
+    border = md + kr
+    top_h = int(np.ceil((hp - 2 * border) / s1))
+    top_w = int(np.ceil((wp - 2 * border) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    out = np.zeros((n, ngw * ngw, top_h, top_w), np.float32)
+    sumelems = k * k * c
+    for b in range(n):
+        for i in range(top_h):
+            for j in range(top_w):
+                y1, x1 = i * s1 + md, j * s1 + md
+                for tc in range(ngw * ngw):
+                    s2o = (tc % ngw - ngr) * s2
+                    s2p = (tc // ngw - ngr) * s2
+                    y2, x2 = y1 + s2p, x1 + s2o
+                    p1 = t1[b, :, y1:y1 + k, x1:x1 + k]
+                    p2 = t2[b, :, y2:y2 + k, x2:x2 + k]
+                    v = (p1 * p2).sum() if is_multiply else \
+                        np.abs(p1 - p2).sum()
+                    out[b, tc, i, j] = v / sumelems
+    return out
+
+
+@pytest.mark.parametrize("k,md,s1,s2,pad,mult", [
+    (1, 1, 1, 1, 1, True),
+    (3, 2, 1, 2, 2, True),
+    (1, 2, 2, 1, 2, False),
+])
+def test_correlation_matches_reference_loop(k, md, s1, s2, pad, mult):
+    rng = np.random.RandomState(0)
+    d1 = rng.randn(2, 3, 8, 9).astype(np.float32)
+    d2 = rng.randn(2, 3, 8, 9).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                            kernel_size=k, max_displacement=md,
+                            stride1=s1, stride2=s2, pad_size=pad,
+                            is_multiply=mult)
+    expected = _np_correlation(d1, d2, k, md, s1, s2, pad, mult)
+    assert out.shape == expected.shape
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_correlation_grads():
+    rng = np.random.RandomState(1)
+    a = mx.nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    b = mx.nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    a.attach_grad(); b.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Correlation(a, b, kernel_size=1, max_displacement=1,
+                              pad_size=1)
+        loss = y.sum()
+    loss.backward()
+    assert np.abs(a.grad.asnumpy()).sum() > 0
+    assert np.abs(b.grad.asnumpy()).sum() > 0
+
+
+def test_crop_v1():
+    x = mx.nd.array(np.arange(2 * 3 * 6 * 8, dtype=np.float32)
+                    .reshape(2, 3, 6, 8))
+    y = mx.nd.Crop(x, h_w=(4, 5), offset=(1, 2))
+    np.testing.assert_array_equal(y.asnumpy(),
+                                  x.asnumpy()[:, :, 1:5, 2:7])
+    ref = mx.nd.zeros((2, 3, 4, 4))
+    y2 = mx.nd.Crop(x, ref, center_crop=True, num_args=2)
+    np.testing.assert_array_equal(y2.asnumpy(),
+                                  x.asnumpy()[:, :, 1:5, 2:6])
+    # symbolic
+    d = mx.sym.Variable("data")
+    s = mx.sym.Crop(d, h_w=(4, 5), offset=(1, 2))
+    _, outs, _ = s.infer_shape(data=(2, 3, 6, 8))
+    assert outs[0] == (2, 3, 4, 5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """With zero offsets, DeformableConvolution == Convolution."""
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 4, 7, 7).astype(np.float32))
+    wgt = mx.nd.array(rng.randn(6, 4, 3, 3).astype(np.float32))
+    bias = mx.nd.array(rng.randn(6).astype(np.float32))
+    off = mx.nd.zeros((2, 2 * 3 * 3, 7, 7))
+    y = mx.nd._contrib_DeformableConvolution(
+        x, off, wgt, bias, kernel=(3, 3), num_filter=6, pad=(1, 1))
+    ref = mx.nd.Convolution(x, wgt, bias, kernel=(3, 3), num_filter=6,
+                            pad=(1, 1))
+    np.testing.assert_allclose(y.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """A constant integer offset samples a shifted feature map."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 9, 9).astype(np.float32)
+    wgt = rng.randn(3, 2, 1, 1).astype(np.float32)
+    off = np.zeros((1, 2, 9, 9), np.float32)
+    off[:, 0] = 1.0  # dy = +1 everywhere
+    y = mx.nd._contrib_DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(wgt),
+        kernel=(1, 1), num_filter=3, no_bias=True)
+    shifted = np.zeros_like(x)
+    shifted[:, :, :-1] = x[:, :, 1:]  # sample at y+1
+    ref = np.einsum("fc,nchw->nfhw", wgt[:, :, 0, 0], shifted)
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_grads_flow_to_offset():
+    rng = np.random.RandomState(2)
+    x = mx.nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    wgt = mx.nd.array(rng.randn(2, 2, 3, 3).astype(np.float32))
+    off = mx.nd.array(0.3 * rng.randn(1, 18, 6, 6).astype(np.float32))
+    off.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd._contrib_DeformableConvolution(
+            x, off, wgt, kernel=(3, 3), num_filter=2, pad=(1, 1),
+            no_bias=True)
+        loss = (y * y).sum()
+    loss.backward()
+    assert np.abs(off.grad.asnumpy()).sum() > 0
+
+
+def test_proposal_shapes_and_sanity():
+    rng = np.random.RandomState(0)
+    n, fh, fw = 1, 6, 8
+    A = 3 * 3  # 3 scales x 3 ratios
+    cls = mx.nd.array(rng.rand(n, 2 * A, fh, fw).astype(np.float32))
+    bbox = mx.nd.array(0.1 * rng.randn(n, 4 * A, fh, fw).astype(np.float32))
+    im_info = mx.nd.array(np.array([[fh * 16, fw * 16, 1.0]], np.float32))
+    rois = mx.nd._contrib_Proposal(
+        cls, bbox, im_info, rpn_pre_nms_top_n=60, rpn_post_nms_top_n=20,
+        threshold=0.7, rpn_min_size=4, scales=(4, 8, 16),
+        ratios=(0.5, 1, 2), feature_stride=16)
+    assert rois.shape == (20, 5)
+    r = rois.asnumpy()
+    valid = r[r[:, 1] >= 0]
+    assert len(valid) > 0
+    # batch index 0, boxes inside the image, x2>=x1, y2>=y1
+    assert (valid[:, 0] == 0).all()
+    assert (valid[:, 1] >= 0).all() and (valid[:, 3] <= fw * 16 - 1).all()
+    assert (valid[:, 3] >= valid[:, 1]).all()
+    assert (valid[:, 4] >= valid[:, 2]).all()
+    # output_score variant
+    rois2, scores = mx.nd._contrib_Proposal(
+        cls, bbox, im_info, rpn_pre_nms_top_n=60, rpn_post_nms_top_n=20,
+        scales=(4, 8, 16), ratios=(0.5, 1, 2), output_score=True)
+    assert scores.shape == (20, 1)
+
+
+def test_sync_batch_norm_matches_bn():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 3, 5, 5).astype(np.float32))
+    gamma, beta = mx.nd.ones((3,)), mx.nd.zeros((3,))
+    mmean, mvar = mx.nd.zeros((3,)), mx.nd.ones((3,))
+    with mx.autograd.record():  # training mode uses batch stats
+        y1 = mx.nd._contrib_SyncBatchNorm(x, gamma, beta, mmean.copy(),
+                                          mvar.copy(), ndev=8, key="bn0")
+        y2 = mx.nd.BatchNorm(x, gamma, beta, mmean.copy(), mvar.copy())
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-5)
