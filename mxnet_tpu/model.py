@@ -83,6 +83,12 @@ class FeedForward:
         if not hasattr(X, "provide_data"):  # numpy (X, y) path
             X = NDArrayIter(_np.asarray(X), _np.asarray(y),
                             batch_size=self.numpy_batch_size, shuffle=True)
+        if self.epoch_size is not None:
+            # reference model.py:536 — an "epoch" is epoch_size batches of a
+            # (possibly never-ending) stream; reset_internal=False means the
+            # underlying iterator only rewinds when it genuinely runs dry
+            from .io import ResizeIter
+            X = ResizeIter(X, self.epoch_size, reset_internal=False)
         label_names = [n for n in self.symbol.list_arguments()
                        if n.endswith("label")] or ["softmax_label"]
         self._module = Module(self.symbol,
@@ -120,10 +126,16 @@ class FeedForward:
             outs, datas, labels = [], [], []
             for i, (batch_outs, _, batch) in enumerate(
                     mod.iter_predict(X, num_batch=num_batch, reset=False)):
+                # iter_predict trims outputs by pad; data/label must be
+                # trimmed the same way or rows misalign (reference
+                # model.py:677 trims all three)
+                pad = getattr(batch, "pad", None) or 0
                 outs.append(batch_outs[0].asnumpy())
-                datas.append(batch.data[0].asnumpy())
+                d = batch.data[0].asnumpy()
+                datas.append(d[:d.shape[0] - pad] if pad else d)
                 if batch.label:
-                    labels.append(batch.label[0].asnumpy())
+                    lab = batch.label[0].asnumpy()
+                    labels.append(lab[:lab.shape[0] - pad] if pad else lab)
             return (_np.concatenate(outs),
                     _np.concatenate(datas),
                     _np.concatenate(labels) if labels else None)
@@ -134,7 +146,16 @@ class FeedForward:
         return out.asnumpy()
 
     def score(self, X, eval_metric="acc", num_batch=None, reset=True):
+        import numpy as _np
         from . import metric as _metric
+        from .io import NDArrayIter
+        if not hasattr(X, "provide_data"):
+            # reference _init_iter(is_train=False): numpy without labels is
+            # scored against zeros rather than crashing
+            X = _np.asarray(X)
+            X = NDArrayIter(X, _np.zeros(X.shape[0], dtype=_np.float32),
+                            batch_size=min(self.numpy_batch_size, len(X)),
+                            label_name="softmax_label")
         mod = self._predict_module(X)
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)

@@ -93,9 +93,12 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return list(zip(self._output_names,
-                        [o.shape for o in self._exec.outputs])) \
-            if self._exec.outputs else None
+        if self._exec.outputs:
+            return list(zip(self._output_names,
+                            [o.shape for o in self._exec.outputs]))
+        # before the first forward: shapes from an abstract trace (no device
+        # work) — needed by containers like SequentialModule at bind time
+        return list(zip(self._output_names, self._exec._out_shapes()))
 
     # ------------------------------------------------------------------ bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,

@@ -1,0 +1,168 @@
+"""Modules implemented directly in Python.
+
+Parity with the reference's ``PythonModule`` / ``PythonLossModule``
+(``python/mxnet/module/python_module.py:31,240``): a ``PythonModule`` is a
+parameter-free stage presenting the BaseModule interface whose compute is
+arbitrary user Python; ``PythonLossModule`` is the common case — a loss whose
+gradient w.r.t. its input scores is supplied as ``grad_func`` — used as the
+tail of a :class:`~.sequential_module.SequentialModule` chain.
+
+TPU note: compute here runs eagerly on device via NDArray (jax under the
+hood); a user needing the loss *inside* the compiled program should express
+it symbolically instead.  This class exists for the reference's extension
+workflow (e.g. losses that are easier to state as ``d loss / d scores``).
+"""
+import logging
+
+from .base_module import BaseModule
+from .. import ndarray as nd
+
+
+class PythonModule(BaseModule):
+    """Subclass and override ``forward``/``backward`` (and ``update`` if the
+    module owns parameters) to implement a module in plain Python."""
+
+    def __init__(self, data_names, label_names, output_names, logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names) if label_names is not None else None
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+        self.params_initialized = True  # parameter-free by default
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names if self._label_names is not None else []
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    # -- parameters (none by default) --------------------------------------
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        pass
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        pass
+
+    def update(self):
+        pass
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+    # -- setup -------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        if grad_req != "write":
+            raise ValueError("PythonModule only supports grad_req='write'")
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        names = [x[0] for x in data_shapes]
+        assert names == self._data_names, (names, self._data_names)
+        self._data_shapes = list(data_shapes)
+
+        self._label_shapes = list(label_shapes) if label_shapes else None
+        if self._label_shapes is not None:
+            assert self._label_names is not None
+            assert [x[0] for x in self._label_shapes] == self._label_names
+
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        """Return ``[(name, shape), ...]`` given bound data/label shapes."""
+        raise NotImplementedError
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        if self._label_shapes is None:
+            return  # no labels -> nothing to score
+        if pre_sliced:
+            raise RuntimeError("PythonModule does not support pre-sliced labels")
+        eval_metric.update(labels, self.get_outputs())
+
+
+class PythonLossModule(PythonModule):
+    """A loss stage: passes scores through on forward, emits
+    ``grad_func(scores, labels)`` as the input gradient on backward."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names, label_names, [name + "_output"],
+                         logger=logger)
+        assert len(self._data_names) == 1
+        assert len(self._label_names) == 1
+        self._name = name
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        return [(self._name + "_output", self._data_shapes[0][1])]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if is_train is None:
+            is_train = self.for_training
+        if is_train:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        assert merge_multi_context
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, "loss module takes no out_grads"
+        assert self.for_training
+        self._backward_impl()
+
+    def _backward_impl(self):
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "pass grad_func or override _backward_impl")
+        grad = self._grad_func(self._scores, self._labels)
+        if not isinstance(grad, nd.NDArray):
+            grad = nd.array(grad)
+        self._scores_grad = grad
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert merge_multi_context
+        return [self._scores_grad]
+
+    def install_monitor(self, mon):
+        raise NotImplementedError("no executors to monitor in a loss module")

@@ -1,0 +1,225 @@
+"""Container module that chains several modules into one pipeline.
+
+Parity with the reference's ``SequentialModule``
+(``python/mxnet/module/sequential_module.py:28``): each sub-module is bound
+with the previous module's output shapes as its data shapes, ``forward``
+threads the batch through the chain, and ``backward`` threads gradients in
+reverse (each stage's ``get_input_grads`` become the previous stage's
+``out_grads``).  Meta flags per stage: ``take_labels`` routes the original
+batch labels to that stage, ``auto_wiring`` renames the previous stage's
+outputs to the stage's expected data names.
+
+TPU note: each sub-module keeps its own fused/jit step; the chain itself is
+plain Python, so stages may live on different shardings (the v1-style
+"manual pipeline" use-case).  For a single fused program prefer composing
+Symbols before binding one Module.
+"""
+import logging
+
+from .base_module import BaseModule
+from ..io.io import DataBatch
+from ..initializer import Uniform
+
+
+class SequentialModule(BaseModule):
+    """Chain of modules; data flows first->last, gradients last->first."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._meta_keys = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        self._data_shapes = None
+        self._label_shapes = None
+
+    def add(self, module, **kwargs):
+        """Append ``module``; returns ``self`` for chaining.
+
+        Keyword meta: ``take_labels=True`` feeds the chain's labels to this
+        stage; ``auto_wiring=True`` renames incoming arrays to the stage's
+        ``data_names``.
+        """
+        for key in kwargs:
+            if key not in self._meta_keys:
+                raise ValueError("unknown meta %r (known: %s)"
+                                 % (key, sorted(self._meta_keys)))
+        self._modules.append(module)
+        self._metas.append(dict(kwargs))
+        # Chain composition invalidates any previous bind.
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def label_names(self):
+        names = []
+        for mod, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS):
+                names.extend(mod.label_names)
+        return names
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    # -- parameters --------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = {}, {}
+        for mod in self._modules:
+            arg, aux = mod.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        initializer = initializer if initializer is not None else Uniform(0.01)
+        for mod in self._modules:
+            mod.init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params, allow_missing=True,
+                            force_init=force_init, allow_extra=True)
+
+        # Cross-stage duplicate parameter names would silently desync on
+        # update; refuse them up front (reference does the same check).
+        seen = set()
+        for mod in self._modules:
+            arg, aux = mod.get_params()
+            for name in list(arg) + list(aux):
+                if name in seen:
+                    raise ValueError(
+                        "duplicate parameter %r across chained modules" % name)
+                seen.add(name)
+        self.params_initialized = True
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        for mod in self._modules:
+            mod.set_params(arg_params, aux_params, allow_missing=True,
+                           force_init=force_init, allow_extra=True)
+        self.params_initialized = True
+
+    # -- bind / optimizer --------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        if shared_module is not None:
+            raise ValueError("shared_module not supported for SequentialModule")
+        assert self._modules, "add modules before bind"
+
+        self.binded = False
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes) if label_shapes else None
+
+        my_inputs_need_grad = bool(inputs_need_grad or
+                                   (for_training and len(self._modules) > 1))
+
+        cur_shapes = list(data_shapes)
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            if meta.get(self.META_AUTO_WIRING):
+                names = mod.data_names
+                assert len(names) == len(cur_shapes)
+                cur_shapes = [(name, shp) for name, (_, shp)
+                              in zip(names, cur_shapes)]
+            stage_labels = (self._label_shapes
+                            if meta.get(self.META_TAKE_LABELS) else None)
+            mod.bind(data_shapes=cur_shapes, label_shapes=stage_labels,
+                     for_training=for_training,
+                     inputs_need_grad=(inputs_need_grad if i == 0
+                                       else my_inputs_need_grad),
+                     force_rebind=force_rebind, grad_req=grad_req)
+            cur_shapes = list(mod.output_shapes)
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        for mod in self._modules:
+            mod.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        self.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = DataBatch(data=list(data_batch.data),
+                          label=data_batch.label, pad=getattr(data_batch, "pad", None))
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            mod.forward(batch, is_train=is_train)
+            if i == len(self._modules) - 1:
+                break
+            batch = DataBatch(data=mod.get_outputs(),
+                              label=(data_batch.label
+                                     if self._metas[i + 1].get(self.META_TAKE_LABELS)
+                                     else None),
+                              pad=getattr(data_batch, "pad", None))
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for i in range(len(self._modules) - 1, -1, -1):
+            mod = self._modules[i]
+            mod.backward(out_grads=out_grads)
+            if i == 0:
+                break
+            out_grads = mod.get_input_grads()
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[-1].get_outputs(
+            merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[0].get_input_grads(
+            merge_multi_context=merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        assert self.binded and self.params_initialized
+        for mod, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS):
+                mod.update_metric(eval_metric, labels, pre_sliced=pre_sliced)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for mod in self._modules:
+            mod.install_monitor(mon)

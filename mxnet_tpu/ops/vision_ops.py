@@ -287,6 +287,14 @@ def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
         rois = jnp.where(valid[:, None], rows[:, 2:6],
                          -jnp.ones_like(rows[:, 2:6]))
         rscores = jnp.where(valid, rows[:, 1], -jnp.ones_like(rows[:, 1]))
+        # fewer anchors than rpn_post_nms_top_n: pad to the fixed output
+        # contract (reference always emits rpn_post_nms_top_n rows)
+        short = rpn_post_nms_top_n - rois.shape[0]
+        if short > 0:
+            rois = jnp.concatenate(
+                [rois, -jnp.ones((short, 4), rois.dtype)], axis=0)
+            rscores = jnp.concatenate(
+                [rscores, -jnp.ones((short,), rscores.dtype)], axis=0)
         return rois, rscores
 
     rois, rscores = jax.vmap(one)(cls_prob, bbox_pred, im_info)

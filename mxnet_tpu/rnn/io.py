@@ -5,6 +5,7 @@ reason to keep bucketing: XLA recompiles per shape, so buckets bound the
 number of compilations exactly like the reference bounds cuDNN plans)."""
 from __future__ import annotations
 
+import logging
 import random as _pyrandom
 
 import numpy as _np
@@ -34,6 +35,10 @@ class BucketSentenceIter:
             buff = _np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
+        if ndiscard:
+            logging.warning("discarded %d sentences longer than the largest "
+                            "bucket", ndiscard)
+        self.ndiscard = ndiscard
         # explicit 2-D shape: a bucket with zero sentences must still be
         # (0, bucket_len), not a 1-D empty array
         self.data = [_np.asarray(x, dtype=dtype).reshape(-1, blen)

@@ -281,6 +281,10 @@ class FusedRNNCell(BaseRNNCell):
                layout="NTC", merge_outputs=None):
         self.reset()
         axis = layout.find("T")
+        if inputs is None:
+            # base-class contract: per-step named placeholders
+            inputs = [_sym.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
         if isinstance(inputs, (list, tuple)):
             inputs = _sym.Concat(*[_sym.expand_dims(i, axis=0)
                                    for i in inputs], dim=0)  # (T, N, C)
@@ -369,7 +373,10 @@ class BidirectionalCell(BaseRNNCell):
                layout="NTC", merge_outputs=None):
         self.reset()
         axis = layout.find("T")
-        if not isinstance(inputs, (list, tuple)):
+        if inputs is None:
+            inputs = [_sym.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif not isinstance(inputs, (list, tuple)):
             inputs = list(_sym.SliceChannel(inputs, num_outputs=length,
                                             axis=axis, squeeze_axis=1))
         if begin_state is None:

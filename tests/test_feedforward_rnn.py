@@ -183,3 +183,67 @@ def test_feedforward_predict_return_data():
     outs, datas, labels = model.predict(it, return_data=True)
     assert outs.shape == (32, 2) and datas.shape == (32, 8)
     assert labels.shape == (32,)
+
+
+def test_feedforward_predict_return_data_with_pad():
+    """Outputs/data/labels must stay row-aligned when the last batch pads
+    (reference model.py:677 trims all three by pad)."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(70, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    model = mx.model.FeedForward(_net(), num_epoch=1, learning_rate=0.1,
+                                 numpy_batch_size=16)
+    model.fit(X, Y)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    outs, datas, labels = model.predict(it, return_data=True)
+    assert outs.shape[0] == datas.shape[0] == labels.shape[0] == 70
+    np.testing.assert_allclose(datas, X, rtol=1e-6)
+    np.testing.assert_allclose(labels, Y, rtol=1e-6)
+
+
+def test_feedforward_epoch_size_streaming():
+    """epoch_size bounds an epoch for streaming iterators (model.py:536)."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(64, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    seen = []
+
+    def batch_cb(param):
+        seen.append((param.epoch, param.nbatch))
+
+    model = mx.model.FeedForward(_net(), num_epoch=3, epoch_size=2,
+                                 learning_rate=0.1)
+    model.fit(it, batch_end_callback=batch_cb)
+    # 3 epochs x 2 batches each, not 3 x 4
+    per_epoch = {}
+    for ep, _ in seen:
+        per_epoch[ep] = per_epoch.get(ep, 0) + 1
+    assert per_epoch == {0: 2, 1: 2, 2: 2}, per_epoch
+
+
+def test_bucket_iter_reports_discards():
+    sents = [[1, 2, 3]] * 8 + [[1] * 50] * 3  # 3 sentences exceed max bucket
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[5, 10])
+    assert it.ndiscard == 3
+
+
+def test_feedforward_score_numpy():
+    rng = np.random.RandomState(4)
+    X = rng.randn(48, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    model = mx.model.FeedForward(_net(), num_epoch=1, learning_rate=0.1)
+    model.fit(X, Y)
+    acc = model.score(X)  # scored against zero labels, reference semantics
+    assert 0.0 <= acc <= 1.0
+
+
+def test_fused_unroll_default_placeholders():
+    out, _ = mx.rnn.FusedRNNCell(8, prefix="lstm_").unroll(3)
+    args = out.list_arguments()
+    assert "t0_data" in args and "t2_data" in args, args
+    l = mx.rnn.LSTMCell(4, prefix="l_")
+    r = mx.rnn.LSTMCell(4, prefix="r_")
+    outs, _ = mx.rnn.BidirectionalCell(l, r).unroll(3)
+    args = outs[0].list_arguments()
+    assert "t0_data" in args, args

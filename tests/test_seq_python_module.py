@@ -1,0 +1,155 @@
+"""SequentialModule / PythonModule tests (parity model:
+tests/python/unittest/test_module.py test_module_layout + python module
+examples)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _toy_data(n=256, d=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, classes).astype(np.float32)
+    y = (X @ W).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def _stage1():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=32, name="fc1")
+    return sym.Activation(net, act_type="relu", name="relu1")
+
+
+def _stage2(classes=4):
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=classes,
+                             name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_sequential_module_fit():
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(_stage1(), label_names=None, context=mx.cpu())) \
+       .add(mx.mod.Module(_stage2(), context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    seq.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    train.reset()
+    score = dict(seq.score(train, "acc"))
+    assert score["accuracy"] > 0.9, score
+
+    # params from both stages are visible through the container
+    arg_params, _ = seq.get_params()
+    assert "fc1_weight" in arg_params and "fc2_weight" in arg_params
+
+
+def test_sequential_module_matches_single_module():
+    """A 2-stage chain must train identically to the same net in one Module."""
+    X, y = _toy_data(128)
+    classes = 4
+
+    def fused_sym():
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=32,
+                                 name="fc1")
+        net = sym.Activation(net, act_type="relu", name="relu1")
+        net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+        return sym.SoftmaxOutput(net, name="softmax")
+
+    init = mx.initializer.Xavier(rnd_type="gaussian", magnitude=2.0)
+    batch = 32
+    train1 = mx.io.NDArrayIter(X, y, batch_size=batch)
+    train2 = mx.io.NDArrayIter(X, y, batch_size=batch)
+
+    single = mx.mod.Module(fused_sym(), context=mx.cpu())
+    single.bind(train1.provide_data, train1.provide_label)
+    mx.random.seed(7)
+    single.init_params(init)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(_stage1(), label_names=None, context=mx.cpu())) \
+       .add(mx.mod.Module(_stage2(classes), context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    seq.bind(train2.provide_data, train2.provide_label)
+    arg_params, aux_params = single.get_params()
+    seq.init_params(init, arg_params=arg_params, aux_params=aux_params,
+                    force_init=True)
+
+    for m in (single, seq):
+        m.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+    for _ in range(3):
+        train1.reset(); train2.reset()
+        for b1, b2 in zip(train1, train2):
+            single.forward_backward(b1); single.update()
+            seq.forward_backward(b2); seq.update()
+
+    a1, _ = single.get_params()
+    a2, _ = seq.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_sequential_module_rejects_unknown_meta_and_dup_params():
+    seq = mx.mod.SequentialModule()
+    with pytest.raises(ValueError):
+        seq.add(mx.mod.Module(_stage1(), label_names=None), bogus_meta=True)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(_stage1(), label_names=None, context=mx.cpu())) \
+       .add(mx.mod.Module(_stage1(), label_names=None, context=mx.cpu()),
+            auto_wiring=True)
+    seq.bind([("data", (8, 8))])
+    with pytest.raises(ValueError, match="duplicate parameter"):
+        seq.init_params()
+
+
+def _softmax_ce_grad(scores, labels):
+    s = scores.asnumpy()
+    e = np.exp(s - s.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    lab = labels.asnumpy().astype(np.int64)
+    p[np.arange(len(lab)), lab] -= 1.0  # SoftmaxOutput grad semantics (no batch normalization)
+    return p
+
+
+def test_python_loss_module_chain():
+    """net Module + PythonLossModule(grad_func) trains like SoftmaxOutput."""
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net, label_names=None, context=mx.cpu())) \
+       .add(mx.mod.PythonLossModule(grad_func=_softmax_ce_grad),
+            take_labels=True, auto_wiring=True)
+    seq.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier(), eval_metric=None)
+
+    # score by argmax of the raw scores the loss module passes through
+    train.reset()
+    correct = total = 0
+    for batch in train:
+        seq.forward(batch, is_train=False)
+        pred = seq.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum(); total += len(lab)
+    assert correct / total > 0.9
+
+
+def test_python_module_shapes_and_metric():
+    mod = mx.mod.PythonLossModule()
+    mod.bind([("data", (16, 4))], [("softmax_label", (16,))])
+    assert mod.output_shapes == [("pyloss_output", (16, 4))]
+    assert mod.get_params() == ({}, {})
+    batch = mx.io.DataBatch(data=[mx.nd.array(np.random.rand(16, 4))],
+                            label=[mx.nd.array(np.zeros(16))])
+    mod.forward(batch, is_train=True)
+    assert mod.get_outputs()[0].shape == (16, 4)

@@ -171,3 +171,22 @@ def test_sync_batch_norm_matches_bn():
                                           mvar.copy(), ndev=8, key="bn0")
         y2 = mx.nd.BatchNorm(x, gamma, beta, mmean.copy(), mvar.copy())
     np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-5)
+
+
+def test_proposal_pads_when_anchors_fewer_than_post_nms():
+    """Anchor count < rpn_post_nms_top_n must still emit the fixed-shape
+    output with -1 padding (reference proposal.cc pads unconditionally)."""
+    rng = np.random.RandomState(3)
+    n, fh, fw = 1, 4, 4
+    A = 3 * 3
+    cls = mx.nd.array(rng.rand(n, 2 * A, fh, fw).astype(np.float32))
+    bbox = mx.nd.array(0.1 * rng.randn(n, 4 * A, fh, fw).astype(np.float32))
+    im_info = mx.nd.array(np.array([[fh * 16, fw * 16, 1.0]], np.float32))
+    rois = mx.nd._contrib_Proposal(
+        cls, bbox, im_info, rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+        threshold=0.7, rpn_min_size=4, scales=(4, 8, 16),
+        ratios=(0.5, 1, 2), feature_stride=16)
+    assert rois.shape == (300, 5)  # 144 anchors -> padded to 300
+    r = rois.asnumpy()
+    assert (r[:, 1] >= 0).sum() <= 144
+    assert (r[-1] == -1).any()  # tail rows are -1 padding
