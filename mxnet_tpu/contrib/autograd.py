@@ -1,0 +1,80 @@
+"""The pre-1.0 autograd API (parity: python/mxnet/contrib/autograd.py).
+
+Thin facade over :mod:`mxnet_tpu.autograd` — v0.x scripts that used
+``train_section()`` / ``compute_gradient`` / ``grad_and_loss`` keep
+working; the modern module is the real implementation.
+"""
+import functools
+
+from .. import autograd as _ag
+from ..ndarray import ndarray as _nd
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Set training mode AND recording (the old API conflated the two);
+    returns the previous recording state."""
+    prev = _ag.is_recording()
+    _ag.set_recording(is_train)
+    _ag.set_training(is_train)
+    return prev
+
+
+def train_section():
+    """Context manager: record operations for autograd (old name)."""
+    return _ag.record(train_mode=True)
+
+
+def test_section():
+    """Context manager: stop recording inside a train_section (old name)."""
+    return _ag.pause(train_mode=False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    if not isinstance(outputs, (list, tuple)):
+        raise TypeError("outputs must be a list or tuple of NDArrays")
+    _ag.backward(list(outputs), head_grads=out_grads,
+                 retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated old name for :func:`backward`."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Wrap ``func`` to return ``(grads, outputs)`` of selected args."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            nums = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in nums]
+        for x in variables:
+            if not isinstance(x, _nd.NDArray):
+                raise TypeError("autograd input must be NDArray")
+        grads = [_nd.zeros_like(x) for x in variables]
+        _ag.mark_variables(variables, grads)
+        with _ag.record():
+            outputs = func(*args)
+        _ag.backward([outputs] if isinstance(outputs, _nd.NDArray)
+                     else list(outputs))
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Wrap ``func`` to return only the gradients of selected args."""
+    fn = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return fn(*args)[0]
+    return wrapped

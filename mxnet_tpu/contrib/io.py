@@ -1,0 +1,58 @@
+"""Contrib data iterators (parity: python/mxnet/contrib/io.py:24).
+
+``DataLoaderIter`` adapts a ``gluon.data.DataLoader`` to the symbolic
+``DataIter`` interface so Gluon pipelines feed ``Module.fit`` — short
+final batches are padded up to ``batch_size`` (static shapes keep XLA
+from recompiling on the tail batch) and ``getpad`` reports the padding.
+"""
+from ..io.io import DataIter, DataDesc
+from .. import ndarray as nd
+
+
+class DataLoaderIter(DataIter):
+    """Iterate a gluon DataLoader as a DataIter."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        data, label = next(self._iter)
+        self.batch_size = data.shape[0]
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, tuple(data.shape), dtype)]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape), dtype)]
+        self._current_batch = None
+        self.reset()
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def iter_next(self):
+        try:
+            self._current_batch = next(self._iter)
+        except StopIteration:
+            self._current_batch = None
+        return self._current_batch is not None
+
+    def _padded(self, arr):
+        arr = arr.astype(self.dtype) if arr.dtype != self.dtype else arr
+        pad = self.batch_size - arr.shape[0]
+        if pad:
+            ret = nd.zeros((self.batch_size,) + tuple(arr.shape[1:]),
+                           dtype=self.dtype)
+            ret[:arr.shape[0]] = arr
+            return ret
+        return arr
+
+    def getdata(self):
+        return [self._padded(self._current_batch[0])]
+
+    def getlabel(self):
+        return [self._padded(self._current_batch[1])]
+
+    def getpad(self):
+        return self.batch_size - self._current_batch[0].shape[0]
+
+    def getindex(self):
+        return None

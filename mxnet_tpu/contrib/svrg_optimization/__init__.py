@@ -1,0 +1,3 @@
+"""SVRG optimization (parity: python/mxnet/contrib/svrg_optimization/)."""
+from .svrg_module import SVRGModule
+from .svrg_optimizer import _SVRGOptimizer, _AssignmentOptimizer

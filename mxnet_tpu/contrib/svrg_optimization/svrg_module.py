@@ -1,0 +1,221 @@
+"""SVRGModule — stochastic variance-reduced gradient training (parity:
+python/mxnet/contrib/svrg_optimization/svrg_module.py:30).
+
+Algorithm (Johnson & Zhang 2013): every ``update_freq`` epochs snapshot
+the weights w~ and compute the full-dataset gradient g~; each batch then
+steps with ``g(w) - g(w~) + g~`` instead of ``g(w)``.
+
+TPU design: the snapshot lives in a second Module bound to the same
+symbol, so both per-batch gradient evaluations are compiled XLA programs
+over device-resident params; the SVRG combination is device-side NDArray
+arithmetic (no host roundtrip).  The fused single-program step is
+disabled here on purpose — SVRG must edit gradients between backward and
+update, which is exactly the eager grad_dict contract.  In distributed
+mode full gradients are aggregated through the kvstore under ``*_full``
+keys via ``_SVRGOptimizer`` (reference svrg_module.py:292-358).
+"""
+import logging
+
+from ...module.module import Module
+from .svrg_optimizer import _SVRGOptimizer
+
+
+class SVRGModule(Module):
+    """Module with SVRG gradient updates every ``update_freq`` epochs."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, update_freq=None, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        if not isinstance(update_freq, int) or update_freq <= 0:
+            raise ValueError("update_freq must be a positive integer, "
+                             "got %r" % (update_freq,))
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, **kwargs)
+        # name -> NDArray: average full-dataset gradient at the snapshot
+        self._param_dict = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind, shared_module,
+                               grad_req)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        super().init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params, allow_missing=allow_missing,
+                            force_init=force_init, allow_extra=allow_extra)
+        if self._mod_aux.binded:
+            arg, aux = self.get_params()
+            self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                      force_init=True, allow_extra=True)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        super().init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        # SVRG edits grad_dict between backward and update; the fused
+        # one-program step has no such seam
+        self._drop_fused()
+        if self._update_on_kvstore and self._kvstore is not None:
+            # server must assign *_full keys and optimize the rest
+            self._optimizer = _SVRGOptimizer(
+                default_optimizer=self._optimizer)
+            self._kvstore.set_optimizer(self._optimizer)
+        from ... import ndarray as nd
+        for name in self._param_names:
+            w = self._exec.arg_dict[name]
+            self._param_dict[name] = nd.zeros(w.shape, dtype=w.dtype)
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if is_train is None:
+            is_train = self.for_training
+        if is_train and self._mod_aux.binded:
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def forward_backward(self, data_batch):
+        # always the eager two-pass path (see init_optimizer)
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def backward(self, out_grads=None):
+        super().backward(out_grads)
+        if self._mod_aux.binded:
+            self._mod_aux.backward(out_grads)
+
+    def update(self):
+        self._update_svrg_gradients()
+        super().update()
+
+    # -- SVRG machinery ----------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Snapshot current weights into the aux module and average the
+        gradient over the whole of ``train_data`` (reference :292)."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg_params=arg, aux_params=aux)
+        train_data.reset()
+        for name in self._param_names:
+            self._param_dict[name][:] = 0
+        nbatch = 0
+        padding = 0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            nbatch += 1
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is not None:
+                    self._param_dict[name] += g
+            padding = batch.pad or 0
+        true_num_batch = nbatch - padding / train_data.batch_size
+        for name in self._param_names:
+            self._param_dict[name] /= true_num_batch
+        if self._kvstore is not None and self._kvstore.type.startswith("dist"):
+            self._accumulate_kvstore()
+
+    def _accumulate_kvstore(self):
+        """Aggregate full grads across workers through ``*_full`` keys."""
+        kv = self._kvstore
+        for name in self._param_names:
+            key = name + "_full"
+            if key not in getattr(kv, "_store", {}):
+                from ... import ndarray as nd
+                kv.init(key, nd.zeros_like(self._param_dict[name]))
+            kv.push(key, self._param_dict[name])
+            kv._barrier()
+            kv.pull(key, self._param_dict[name], ignore_sparse=False)
+            self._param_dict[name] /= kv.num_workers
+
+    def _update_svrg_gradients(self):
+        """grad <- g(w) - g(w~) + g~ , all device-side (reference :360)."""
+        for name in self._param_names:
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            g_aux = self._mod_aux._exec.grad_dict[name]
+            g[:] = g - g_aux + self._param_dict[name]
+
+    # -- training loop -----------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """BaseModule.fit plus the full-gradient refresh at every
+        ``update_freq``-th epoch (reference :395)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from ... import metric as _metric
+        from ...initializer import Uniform
+        from ...model import BatchEndParam
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if eval_metric is not None and \
+                not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            if eval_metric is not None:
+                eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                if eval_metric is not None:
+                    self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(params)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg, aux)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+
+
+def _as_list(obj):
+    return obj if isinstance(obj, (list, tuple)) else [obj]

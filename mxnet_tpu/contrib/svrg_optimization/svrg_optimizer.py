@@ -1,0 +1,60 @@
+"""Optimizers backing SVRGModule (parity: python/mxnet/contrib/
+svrg_optimization/svrg_optimizer.py:26,50).
+
+``_AssignmentOptimizer`` turns a kvstore "update" into plain assignment so
+full gradients can be accumulated/broadcast through the store;
+``_SVRGOptimizer`` routes ``*_full`` keys to assignment and everything
+else to the user's real optimizer.  Both exist for the distributed
+(update-on-kvstore) path and are registered like any other optimizer.
+"""
+from ... import optimizer as _opt
+
+
+@_opt.register
+class _AssignmentOptimizer(_opt.Optimizer):
+    """kvstore helper: store the pushed (aggregated) gradient as the value."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        weight[:] = grad
+
+
+@_opt.register
+class _SVRGOptimizer(_opt.Optimizer):
+    """Wrapper dispatching by key: ``*_full`` -> assignment, else the
+    wrapped default optimizer."""
+
+    def __init__(self, default_optimizer, **kwargs):
+        base_params = self._base_params(**kwargs)
+        super().__init__(**base_params)
+        if isinstance(default_optimizer, str):
+            self.default_opt = _opt.create(default_optimizer, **kwargs)
+        else:
+            self.default_opt = default_optimizer
+        self.aux_opt = _opt.create(_AssignmentOptimizer.__name__)
+
+    @staticmethod
+    def _base_params(**kwargs):
+        base = ("rescale_grad", "param_idx2name", "wd", "clip_gradient",
+                "learning_rate", "lr_scheduler", "sym", "begin_num_update",
+                "multi_precision", "param_dict")
+        return {k: v for k, v in kwargs.items() if k in base}
+
+    def _is_full_key(self, index):
+        name = index
+        if isinstance(index, int):
+            name = self.idx2name.get(index, "")
+        return isinstance(name, str) and name.endswith("_full")
+
+    def create_state(self, index, weight):
+        if self._is_full_key(index):
+            return self.aux_opt.create_state(index, weight)
+        return self.default_opt.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        if self._is_full_key(index):
+            self.aux_opt.update(index, weight, grad, state)
+        else:
+            self.default_opt.update(index, weight, grad, state)

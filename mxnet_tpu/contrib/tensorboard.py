@@ -1,0 +1,47 @@
+"""TensorBoard logging callback (parity: python/mxnet/contrib/
+tensorboard.py:24).
+
+Uses ``tensorboard``'s pure-python ``SummaryWriter`` if available (the
+reference wants ``mxboard``, which wraps the same event-file format); if
+neither import resolves the callback degrades to a logged error, exactly
+like the reference.
+"""
+import logging
+
+
+def _make_writer(logging_dir):
+    try:
+        from mxboard import SummaryWriter           # reference's choice
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        return None
+
+
+class LogMetricsCallback:
+    """Batch/epoch-end callback writing each metric as a TB scalar."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = _make_writer(logging_dir)
+        if self.summary_writer is None:
+            logging.error("no SummaryWriter backend found; install mxboard "
+                          "or a tensorboard-compatible writer")
+
+    def __call__(self, param):
+        if param.eval_metric is None or self.summary_writer is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value,
+                                           global_step=param.epoch)

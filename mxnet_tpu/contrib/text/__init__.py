@@ -1,0 +1,5 @@
+"""Text utilities (parity: python/mxnet/contrib/text/)."""
+from . import embedding
+from . import utils
+from . import vocab
+from .vocab import Vocabulary

@@ -1,0 +1,271 @@
+"""Pre-trained token embeddings (parity: python/mxnet/contrib/text/
+embedding.py:133-705 — _TokenEmbedding, GloVe, FastText, CustomEmbedding,
+CompositeEmbedding, register/create).
+
+The embedding matrix lives as an ``NDArray`` (device-resident jax array),
+so ``get_vecs_by_tokens`` is a device gather and the matrix can seed a
+``gluon.nn.Embedding`` weight directly.
+
+Environment note: this build runs with zero egress, so GloVe/FastText do
+not download; they load their standard-named files from ``embedding_root``
+(default ``~/.mxnet/embeddings``) and raise with the expected path if the
+file is absent.
+"""
+import io
+import logging
+import os
+import warnings
+
+import numpy as np
+
+from . import vocab as _vocab
+from .vocab import UNKNOWN_IDX
+from ... import ndarray as nd
+from ...base import MXNetError
+
+
+class _Registry:
+    def __init__(self):
+        self.cls_by_name = {}
+
+
+_REG = _Registry()
+
+
+def register(embedding_cls):
+    """Register a ``_TokenEmbedding`` subclass under its lowercase name."""
+    name = embedding_cls.__name__.lower()
+    _REG.cls_by_name[name] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding, e.g. ``create('glove', ...)``."""
+    name = embedding_name.lower()
+    if name not in _REG.cls_by_name:
+        raise KeyError("unknown embedding %r; registered: %s"
+                       % (embedding_name, sorted(_REG.cls_by_name)))
+    return _REG.cls_by_name[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names, per embedding or as a full dict."""
+    if embedding_name is not None:
+        return list(_REG.cls_by_name[embedding_name.lower()]
+                    .pretrained_file_name_sha1)
+    return {name: list(cls.pretrained_file_name_sha1)
+            for name, cls in _REG.cls_by_name.items()}
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Base: a vocabulary plus an aligned ``idx_to_vec`` matrix."""
+
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = None
+        self._idx_to_vec = None
+
+    # -- loading -----------------------------------------------------------
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        cls._check_pretrained_file_names(pretrained_file_name)
+        embedding_root = os.path.expanduser(embedding_root)
+        path = os.path.join(embedding_root, cls.__name__.lower(),
+                            pretrained_file_name)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                "pretrained embedding file %s not found; downloads are "
+                "disabled in this environment — place the file there "
+                "manually" % path)
+        return path
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if cls.pretrained_file_name_sha1 and \
+                pretrained_file_name not in cls.pretrained_file_name_sha1:
+            raise KeyError(
+                "cannot find pretrained file %s for %s; valid: %s"
+                % (pretrained_file_name, cls.__name__,
+                   sorted(cls.pretrained_file_name_sha1)))
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Parse ``token<d>v1<d>v2...`` lines; first occurrence of a token
+        wins; 1-element lines (headers) are skipped; index 0 is the unknown
+        vector (loaded if present in the file, else ``init_unknown_vec``)."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError("`pretrained_file_path` must be a valid path "
+                             "to the pre-trained token embedding file")
+        logging.info("loading embedding vectors from %s", pretrained_file_path)
+
+        vec_len = None
+        rows = []
+        seen = set()
+        loaded_unknown = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f, 1):
+                elems = line.rstrip().split(elem_delim)
+                if len(elems) <= 1:
+                    raise MXNetError(
+                        "line %d of %s: unexpected data format"
+                        % (line_num, pretrained_file_path))
+                token, vec = elems[0], [float(x) for x in elems[1:]]
+                if token == self.unknown_token and loaded_unknown is None:
+                    loaded_unknown = vec
+                    seen.add(token)
+                elif token in seen:
+                    warnings.warn("line %d: duplicate embedding for token %s "
+                                  "skipped" % (line_num, token))
+                elif len(vec) == 1:
+                    warnings.warn("line %d: token %s with 1-d vector is "
+                                  "likely a header; skipped" % (line_num, token))
+                else:
+                    if vec_len is None:
+                        vec_len = len(vec)
+                    elif len(vec) != vec_len:
+                        raise MXNetError(
+                            "line %d: vector dimension %d != %d"
+                            % (line_num, len(vec), vec_len))
+                    rows.append(vec)
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = len(self._idx_to_token) - 1
+                    seen.add(token)
+
+        self._vec_len = vec_len
+        unk = (np.asarray(loaded_unknown, np.float32)
+               if loaded_unknown is not None
+               else init_unknown_vec(shape=vec_len).asnumpy().astype(np.float32))
+        mat = np.vstack([unk[None, :],
+                         np.asarray(rows, np.float32).reshape(-1, vec_len)])
+        self._idx_to_vec = nd.array(mat)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        """Re-index this embedding's vectors by ``vocabulary``'s indices
+        (tokens absent from the source get the unknown vector)."""
+        if vocabulary is None:
+            return
+        src_tok2idx = self._token_to_idx
+        src_vecs = self._idx_to_vec.asnumpy()
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = (list(vocabulary.reserved_tokens)
+                                 if vocabulary.reserved_tokens else None)
+        sel = np.array([src_tok2idx.get(t, UNKNOWN_IDX)
+                        for t in self._idx_to_token], np.int32)
+        self._idx_to_vec = nd.array(src_vecs[sel])
+
+    # -- lookups -----------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        """(len(vocab), vec_len) NDArray aligned with ``idx_to_token``."""
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vector(s) for token(s); unknown tokens get the unknown vector.
+        ``lower_case_backup`` retries a miss with the lowercased token."""
+        single = not isinstance(tokens, list)
+        seq = [tokens] if single else tokens
+        if lower_case_backup:
+            indices = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), UNKNOWN_IDX))
+                for t in seq]
+        else:
+            indices = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in seq]
+        vecs = self._idx_to_vec[nd.array(indices, dtype="int32")]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (device-side scatter)."""
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+        if not isinstance(new_vectors, nd.NDArray):
+            new_vectors = nd.array(new_vectors)
+        if new_vectors.ndim == 1:
+            new_vectors = new_vectors.reshape(1, -1)
+        if len(tokens) != new_vectors.shape[0]:
+            raise ValueError("`tokens` and `new_vectors` length mismatch")
+        indices = []
+        for t in tokens:
+            if t not in self._token_to_idx:
+                raise ValueError(
+                    "token %r is unknown; to update the unknown-token vector "
+                    "use unknown_token explicitly" % (t,))
+            indices.append(self._token_to_idx[t])
+        self._idx_to_vec[nd.array(indices, dtype="int32")] = new_vectors
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe vectors (nlp.stanford.edu/projects/glove); loads the standard
+    txt file from ``embedding_root`` — see module docstring on downloads."""
+
+    pretrained_file_name_sha1 = {
+        f: None for f in (
+            ["glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+             "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt"]
+            + ["glove.twitter.27B.%dd.txt" % d for d in (25, 50, 100, 200)])}
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText .vec files (fasttext.cc); loaded from ``embedding_root``."""
+
+    pretrained_file_name_sha1 = {
+        f: None for f in ("wiki.simple.vec", "wiki.zh.vec", "wiki.en.vec")}
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """User-provided embedding file of ``token<delim>v1<delim>...`` lines."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings' vectors over one vocabulary."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        super().__init__()
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = (list(vocabulary.reserved_tokens)
+                                 if vocabulary.reserved_tokens else None)
+        parts = []
+        for emb in token_embeddings:
+            sel = np.array([emb.token_to_idx.get(t, UNKNOWN_IDX)
+                            for t in self._idx_to_token], np.int32)
+            parts.append(emb.idx_to_vec.asnumpy()[sel])
+        mat = np.concatenate(parts, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = nd.array(mat)

@@ -1,0 +1,89 @@
+"""Token indexing (parity: python/mxnet/contrib/text/vocab.py:30).
+
+Index 0 is always the unknown token; reserved tokens follow; counter keys
+are then indexed most-frequent-first (ties broken by token sort order),
+subject to ``most_freq_count`` / ``min_freq``.
+"""
+import collections
+
+UNKNOWN_IDX = 0
+
+
+class Vocabulary:
+    """Indexes unknown/reserved tokens plus the frequent keys of a Counter."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq <= 0:
+            raise ValueError("`min_freq` must be positive")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if unknown_token in rset:
+                raise ValueError("`reserved_tokens` cannot contain "
+                                 "`unknown_token`")
+            if len(rset) != len(reserved_tokens):
+                raise ValueError("`reserved_tokens` cannot contain duplicates")
+
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._reserved_tokens = None
+        if reserved_tokens is not None:
+            self._reserved_tokens = list(reserved_tokens)
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        if not isinstance(counter, collections.Counter):
+            raise TypeError("`counter` must be a collections.Counter")
+        special = set(self._idx_to_token)
+        # frequency desc, then token order for stable ties
+        ordered = sorted(counter.items(), key=lambda kv: kv[0])
+        ordered.sort(key=lambda kv: kv[1], reverse=True)
+        cap = len(special) + (len(counter) if most_freq_count is None
+                              else most_freq_count)
+        for token, freq in ordered:
+            if freq < min_freq or len(self._idx_to_token) == cap:
+                break
+            if token not in special:
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to UNKNOWN_IDX."""
+        single = not isinstance(tokens, list)
+        seq = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in seq]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index/indices -> token(s); out-of-range raises ValueError."""
+        single = not isinstance(indices, list)
+        seq = [indices] if single else indices
+        out = []
+        for idx in seq:
+            if not isinstance(idx, int) or not 0 <= idx < len(self._idx_to_token):
+                raise ValueError("Token index %s is invalid" % (idx,))
+            out.append(self._idx_to_token[idx])
+        return out[0] if single else out

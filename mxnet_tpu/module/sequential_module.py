@@ -138,6 +138,8 @@ class SequentialModule(BaseModule):
         assert self._modules, "add modules before bind"
 
         self.binded = False
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else None
 

@@ -104,14 +104,20 @@ class BaseRNNCell:
     def pack_weights(self, args):
         return dict(args)
 
+    @staticmethod
+    def _default_inputs(length, input_prefix):
+        """Per-step named placeholders for ``unroll(inputs=None)`` — the one
+        place the naming contract lives."""
+        return [_sym.Variable("%st%d_data" % (input_prefix, i))
+                for i in range(length)]
+
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
         """Unroll for `length` steps (reference rnn_cell.py:254)."""
         self.reset()
         axis = layout.find("T")
         if inputs is None:
-            inputs = [_sym.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
+            inputs = self._default_inputs(length, input_prefix)
         elif not isinstance(inputs, (list, tuple)):
             inputs = list(_sym.SliceChannel(inputs, num_outputs=length,
                                             axis=axis, squeeze_axis=1))
@@ -282,9 +288,7 @@ class FusedRNNCell(BaseRNNCell):
         self.reset()
         axis = layout.find("T")
         if inputs is None:
-            # base-class contract: per-step named placeholders
-            inputs = [_sym.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
+            inputs = self._default_inputs(length, input_prefix)
         if isinstance(inputs, (list, tuple)):
             inputs = _sym.Concat(*[_sym.expand_dims(i, axis=0)
                                    for i in inputs], dim=0)  # (T, N, C)
@@ -374,8 +378,7 @@ class BidirectionalCell(BaseRNNCell):
         self.reset()
         axis = layout.find("T")
         if inputs is None:
-            inputs = [_sym.Variable("%st%d_data" % (input_prefix, i))
-                      for i in range(length)]
+            inputs = self._default_inputs(length, input_prefix)
         elif not isinstance(inputs, (list, tuple)):
             inputs = list(_sym.SliceChannel(inputs, num_outputs=length,
                                             axis=axis, squeeze_axis=1))

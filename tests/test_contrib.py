@@ -1,0 +1,238 @@
+"""contrib package tests: text, svrg_optimization, io, autograd, tensorboard
+(parity models: tests/python/unittest/test_contrib_text.py,
+test_contrib_svrg_module.py / test_contrib_svrg_optimizer.py)."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text as ctext
+from mxnet_tpu.contrib.svrg_optimization import (SVRGModule, _SVRGOptimizer,
+                                                 _AssignmentOptimizer)
+
+
+# ---------------------------------------------------------------- text
+def _counter():
+    return ctext.utils.count_tokens_from_str(
+        "life is great ! \n life is good . \n", to_lower=False)
+
+
+def test_count_tokens_from_str():
+    c = ctext.utils.count_tokens_from_str(
+        " Life is great ! \n life is good . \n", to_lower=True)
+    assert c == collections.Counter(
+        {"life": 2, "is": 2, "great": 1, "good": 1, "!": 1, ".": 1})
+    c2 = ctext.utils.count_tokens_from_str(
+        "*Life*is*great*!*\n*life*is*good*.*\n", token_delim=r"\*",
+        to_lower=True)
+    assert c2["life"] == 2
+
+
+def test_vocabulary_indexing():
+    v = ctext.Vocabulary(_counter(), most_freq_count=None, min_freq=1,
+                         unknown_token="<unk>", reserved_tokens=["<pad>"])
+    assert v.token_to_idx["<unk>"] == 0
+    assert v.token_to_idx["<pad>"] == 1
+    # most frequent first: 'life'/'is' (freq 2) before freq-1 tokens
+    assert v.to_indices("is") in (2, 3) and v.to_indices("life") in (2, 3)
+    assert v.to_indices("nonexistent") == 0
+    assert v.to_tokens(0) == "<unk>"
+    assert v.to_tokens(v.to_indices(["great", "good"])) == ["great", "good"]
+    with pytest.raises(ValueError):
+        v.to_tokens(len(v))
+    # thresholds
+    v2 = ctext.Vocabulary(_counter(), min_freq=2)
+    assert len(v2) == 3  # unk + life + is
+    v3 = ctext.Vocabulary(_counter(), most_freq_count=2)
+    assert len(v3) == 3
+
+
+def _write_embedding(path):
+    with open(path, "w") as f:
+        f.write("a 0.1 0.2 0.3\n")
+        f.write("b 1.0 2.0 3.0\n")
+        f.write("c -1.0 -2.0 -3.0\n")
+
+
+def test_custom_embedding(tmp_path):
+    p = str(tmp_path / "emb.txt")
+    _write_embedding(p)
+    emb = ctext.embedding.CustomEmbedding(p)
+    assert emb.vec_len == 3
+    assert emb.idx_to_vec.shape == (4, 3)  # unk + 3 tokens
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("b").asnumpy(), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["zzz"]).asnumpy(), [[0, 0, 0]])
+    # lower_case_backup
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["B"], lower_case_backup=True).asnumpy(),
+        [[1.0, 2.0, 3.0]])
+    emb.update_token_vectors("a", mx.nd.array([[9.0, 9.0, 9.0]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("a").asnumpy(), [9.0, 9.0, 9.0])
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("zzz", mx.nd.array([[1.0, 1.0, 1.0]]))
+
+
+def test_embedding_with_vocabulary_and_composite(tmp_path):
+    p = str(tmp_path / "emb.txt")
+    _write_embedding(p)
+    counter = collections.Counter(["a", "a", "c", "d"])
+    vocab = ctext.Vocabulary(counter)
+    emb = ctext.embedding.CustomEmbedding(p, vocabulary=vocab)
+    assert len(emb.idx_to_token) == len(vocab)
+    # token 'd' not in the file -> unknown vector (zeros)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("d").asnumpy(), [0, 0, 0])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("c").asnumpy(), [-1.0, -2.0, -3.0])
+
+    comp = ctext.embedding.CompositeEmbedding(
+        vocab, [ctext.embedding.CustomEmbedding(p),
+                ctext.embedding.CustomEmbedding(p)])
+    assert comp.vec_len == 6
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("c").asnumpy(),
+        [-1.0, -2.0, -3.0, -1.0, -2.0, -3.0])
+
+
+def test_embedding_registry():
+    assert "glove" in ctext.embedding.get_pretrained_file_names()
+    assert any("840B" in n for n in
+               ctext.embedding.get_pretrained_file_names("glove"))
+    with pytest.raises(Exception):
+        # zero-egress environment: missing local file must raise, not hang
+        ctext.embedding.create("glove",
+                               pretrained_file_name="glove.6B.50d.txt")
+
+
+# ---------------------------------------------------------------- svrg
+def _lin_data(n=128, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, 1).astype(np.float32)
+    y = (X @ w).ravel() + 0.01 * rng.randn(n).astype(np.float32)
+    return X, y
+
+
+def _lin_sym():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(out, name="lro")
+
+
+def test_svrg_module_fit_decreases_loss():
+    X, y = _lin_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                           label_name="lro_label")
+    mod = SVRGModule(_lin_sym(), label_names=("lro_label",), update_freq=2)
+    losses = []
+
+    def cb(param):
+        losses.append(param.eval_metric.get()[1])
+
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05}, eval_metric="mse",
+            batch_end_callback=cb)
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_svrg_grad_equals_full_grad_at_snapshot():
+    """Right after the snapshot, w == w~ so g(w) - g(w~) + g~ == g~."""
+    X, y = _lin_data(64)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, label_name="lro_label")
+    mod = SVRGModule(_lin_sym(), label_names=("lro_label",), update_freq=1)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(it)
+    full = {k: v.asnumpy().copy() for k, v in mod._param_dict.items()}
+    it.reset()
+    batch = next(it)
+    mod.forward_backward(batch)
+    mod._update_svrg_gradients()
+    for name in mod._param_names:
+        g = mod._exec.grad_dict.get(name)
+        if g is None:
+            continue
+        np.testing.assert_allclose(g.asnumpy(), full[name],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_svrg_optimizer_routing():
+    opt = _SVRGOptimizer(default_optimizer="sgd", learning_rate=1.0,
+                         rescale_grad=1.0)
+    w = mx.nd.array([1.0, 1.0])
+    g = mx.nd.array([0.5, 0.5])
+    st = opt.create_state("fc_weight_full", w)
+    opt.update("fc_weight_full", w, g, st)
+    np.testing.assert_allclose(w.asnumpy(), [0.5, 0.5])  # assigned
+    w2 = mx.nd.array([1.0, 1.0])
+    st2 = opt.create_state("fc_weight", w2)
+    opt.update("fc_weight", w2, g, st2)
+    np.testing.assert_allclose(w2.asnumpy(), [0.5, 0.5])  # sgd lr=1: w - g
+    assert isinstance(opt.aux_opt, _AssignmentOptimizer)
+
+
+def test_svrg_update_freq_validation():
+    with pytest.raises(ValueError):
+        SVRGModule(_lin_sym(), update_freq=0)
+
+
+# ---------------------------------------------------------------- io
+def test_dataloader_iter_with_module():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    X, y = _lin_data(70)
+    ds = mx.gluon.data.ArrayDataset(X, y)
+    loader = mx.gluon.data.DataLoader(ds, batch_size=16)
+    it = DataLoaderIter(loader, label_name="lro_label")
+    assert it.batch_size == 16
+    batches = list(it)
+    assert len(batches) == 5  # 4 full + 1 padded
+    assert batches[-1].pad == 16 - 70 % 16
+    assert batches[-1].data[0].shape == (16, 4)
+    it.reset()
+    mod = mx.mod.Module(_lin_sym(), label_names=("lro_label",))
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01}, eval_metric="mse")
+
+
+# ---------------------------------------------------------------- autograd
+def test_contrib_autograd_old_api():
+    from mxnet_tpu.contrib import autograd as old_ag
+    x = mx.nd.array([1.0, 2.0, 3.0])
+
+    def loss_fn(x):
+        return (x * x).sum()
+
+    g_and_l = old_ag.grad_and_loss(loss_fn)
+    grads, loss = g_and_l(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2.0, 4.0, 6.0])
+    np.testing.assert_allclose(loss.asnumpy(), 14.0)
+
+    g_fn = old_ag.grad(loss_fn)
+    np.testing.assert_allclose(g_fn(x)[0].asnumpy(), [2.0, 4.0, 6.0])
+
+    # train/test sections and compute_gradient
+    y = mx.nd.array([2.0, -1.0])
+    gy = mx.nd.zeros_like(y)
+    old_ag.mark_variables([y], [gy])
+    with old_ag.train_section():
+        z = (y * y * y).sum()
+    old_ag.compute_gradient([z])
+    np.testing.assert_allclose(gy.asnumpy(), [12.0, 3.0])
+
+
+# ---------------------------------------------------------------- tensorboard
+def test_tensorboard_callback_graceful():
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    cb = LogMetricsCallback("/tmp/tb_test_logs")
+    metric = mx.metric.create("acc")
+    metric.update([mx.nd.array([1.0, 0.0])],
+                  [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    param = mx.model.BatchEndParam(epoch=0, nbatch=1, eval_metric=metric,
+                                   locals=None)
+    cb(param)  # must not raise whether or not a writer backend exists
