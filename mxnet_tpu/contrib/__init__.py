@@ -1,6 +1,7 @@
 """Contrib APIs (parity: python/mxnet/contrib/)."""
 from . import autograd
 from . import io
+from . import onnx
 from . import quantization
 from . import svrg_optimization
 from . import tensorboard

@@ -1,0 +1,7 @@
+"""ONNX import/export (parity: python/mxnet/contrib/onnx/).
+
+Self-contained: serializes against the ONNX protobuf wire format directly
+(no ``onnx`` package dependency), see ``_proto.py``.
+"""
+from .onnx2mx import import_model, get_model_metadata
+from .mx2onnx import export_model

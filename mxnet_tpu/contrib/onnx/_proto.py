@@ -1,0 +1,312 @@
+"""Minimal protobuf wire codec for the ONNX messages this package uses.
+
+The environment has no ``onnx`` python package, so serialization is done
+directly against the (stable, versioned) protobuf wire format of
+onnx.proto — the subset of messages/fields needed for model import and
+export: ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto,
+ValueInfoProto, TypeProto, TensorShapeProto, OperatorSetIdProto.
+
+Field kinds: ``int`` (varint), ``float`` (fixed32), ``string``/``bytes``
+(length-delimited), ``msg`` (embedded message).  Repeated scalar numerics
+accept both packed and unpacked encodings on decode and emit packed, per
+proto3.  Unknown fields are skipped on decode, so files produced by full
+ONNX implementations parse fine.
+"""
+import struct
+
+
+# ---------------------------------------------------------------- wire io
+def _enc_varint(out, v):
+    if v < 0:
+        v &= (1 << 64) - 1  # two's-complement int64, as protobuf does
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _dec_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return result, pos
+
+
+def _signed64(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _skip(buf, pos, wire_type):
+    if wire_type == 0:
+        _, pos = _dec_varint(buf, pos)
+    elif wire_type == 1:
+        pos += 8
+    elif wire_type == 2:
+        n, pos = _dec_varint(buf, pos)
+        pos += n
+    elif wire_type == 5:
+        pos += 4
+    else:
+        raise ValueError("unsupported wire type %d" % wire_type)
+    return pos
+
+
+_WIRE = {"int": 0, "float": 5, "string": 2, "bytes": 2, "msg": 2}
+
+
+class Message:
+    """Base: subclasses define FIELDS = {name: (field_no, kind, repeated[, cls])}."""
+
+    FIELDS = {}
+
+    def __init__(self, **kwargs):
+        for name, spec in self.FIELDS.items():
+            setattr(self, name, [] if spec[2] else _default(spec[1]))
+        for k, v in kwargs.items():
+            if k not in self.FIELDS:
+                raise AttributeError("%s has no field %r"
+                                     % (type(self).__name__, k))
+            setattr(self, k, v)
+
+    # -- encode ------------------------------------------------------------
+    def encode(self):
+        out = bytearray()
+        for name, spec in self.FIELDS.items():
+            num, kind, repeated = spec[0], spec[1], spec[2]
+            val = getattr(self, name)
+            if repeated:
+                if not val:
+                    continue
+                if kind == "int":       # packed
+                    payload = bytearray()
+                    for v in val:
+                        _enc_varint(payload, int(v))
+                    _enc_varint(out, num << 3 | 2)
+                    _enc_varint(out, len(payload))
+                    out += payload
+                elif kind == "float":   # packed
+                    payload = struct.pack("<%df" % len(val), *val)
+                    _enc_varint(out, num << 3 | 2)
+                    _enc_varint(out, len(payload))
+                    out += payload
+                else:
+                    for v in val:
+                        self._enc_one(out, num, kind, v)
+            else:
+                if _is_default(kind, val):
+                    continue
+                self._enc_one(out, num, kind, val)
+        return bytes(out)
+
+    @staticmethod
+    def _enc_one(out, num, kind, val):
+        _enc_varint(out, num << 3 | _WIRE[kind])
+        if kind == "int":
+            _enc_varint(out, int(val))
+        elif kind == "float":
+            out += struct.pack("<f", val)
+        elif kind == "string":
+            data = val.encode("utf-8")
+            _enc_varint(out, len(data))
+            out += data
+        elif kind == "bytes":
+            _enc_varint(out, len(val))
+            out += val
+        elif kind == "msg":
+            data = val.encode()
+            _enc_varint(out, len(data))
+            out += data
+
+    # -- decode ------------------------------------------------------------
+    @classmethod
+    def decode(cls, buf, start=0, end=None):
+        self = cls()
+        by_num = {spec[0]: (name, spec) for name, spec in cls.FIELDS.items()}
+        pos = start
+        end = len(buf) if end is None else end
+        while pos < end:
+            key, pos = _dec_varint(buf, pos)
+            num, wt = key >> 3, key & 7
+            if num not in by_num:
+                pos = _skip(buf, pos, wt)
+                continue
+            name, spec = by_num[num]
+            kind, repeated = spec[1], spec[2]
+            if kind == "int":
+                if wt == 2:  # packed
+                    n, pos = _dec_varint(buf, pos)
+                    stop = pos + n
+                    vals = []
+                    while pos < stop:
+                        v, pos = _dec_varint(buf, pos)
+                        vals.append(_signed64(v))
+                    getattr(self, name).extend(vals) if repeated \
+                        else setattr(self, name, vals[-1])
+                else:
+                    v, pos = _dec_varint(buf, pos)
+                    v = _signed64(v)
+                    getattr(self, name).append(v) if repeated \
+                        else setattr(self, name, v)
+            elif kind == "float":
+                if wt == 2:  # packed
+                    n, pos = _dec_varint(buf, pos)
+                    vals = list(struct.unpack_from("<%df" % (n // 4), buf, pos))
+                    pos += n
+                    getattr(self, name).extend(vals) if repeated \
+                        else setattr(self, name, vals[-1])
+                else:
+                    v = struct.unpack_from("<f", buf, pos)[0]
+                    pos += 4
+                    getattr(self, name).append(v) if repeated \
+                        else setattr(self, name, v)
+            elif kind in ("string", "bytes", "msg"):
+                n, pos = _dec_varint(buf, pos)
+                raw = bytes(buf[pos:pos + n])
+                pos += n
+                if kind == "string":
+                    v = raw.decode("utf-8")
+                elif kind == "bytes":
+                    v = raw
+                else:
+                    v = spec[3].decode(raw)
+                getattr(self, name).append(v) if repeated \
+                    else setattr(self, name, v)
+        return self
+
+    def __repr__(self):
+        parts = []
+        for name in self.FIELDS:
+            v = getattr(self, name)
+            if v not in (None, [], "", b"", 0, 0.0):
+                parts.append("%s=%r" % (name, v))
+        return "%s(%s)" % (type(self).__name__, ", ".join(parts))
+
+
+def _default(kind):
+    return {"int": 0, "float": 0.0, "string": "", "bytes": b"",
+            "msg": None}[kind]
+
+
+def _is_default(kind, val):
+    if kind == "msg":
+        return val is None
+    return val == _default(kind)
+
+
+# ------------------------------------------------------------ onnx schema
+class TensorProto(Message):
+    # onnx.TensorProto.DataType
+    FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+    BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 9, 10, 11, 12, 13
+    BFLOAT16 = 16
+    FIELDS = {
+        "dims": (1, "int", True),
+        "data_type": (2, "int", False),
+        "float_data": (4, "float", True),
+        "int32_data": (5, "int", True),
+        "string_data": (6, "bytes", True),
+        "int64_data": (7, "int", True),
+        "name": (8, "string", False),
+        "raw_data": (9, "bytes", False),
+    }
+
+
+class Dimension(Message):
+    FIELDS = {
+        "dim_value": (1, "int", False),
+        "dim_param": (2, "string", False),
+    }
+
+
+class TensorShapeProto(Message):
+    FIELDS = {"dim": (1, "msg", True, Dimension)}
+
+
+class TensorTypeProto(Message):
+    FIELDS = {
+        "elem_type": (1, "int", False),
+        "shape": (2, "msg", False, TensorShapeProto),
+    }
+
+
+class TypeProto(Message):
+    FIELDS = {"tensor_type": (1, "msg", False, TensorTypeProto)}
+
+
+class ValueInfoProto(Message):
+    FIELDS = {
+        "name": (1, "string", False),
+        "type": (2, "msg", False, TypeProto),
+        "doc_string": (3, "string", False),
+    }
+
+
+class AttributeProto(Message):
+    # onnx.AttributeProto.AttributeType
+    FLOAT, INT, STRING, TENSOR = 1, 2, 3, 4
+    GRAPH, FLOATS, INTS, STRINGS = 5, 6, 7, 8
+    FIELDS = {
+        "name": (1, "string", False),
+        "f": (2, "float", False),
+        "i": (3, "int", False),
+        "s": (4, "bytes", False),
+        "t": (5, "msg", False, TensorProto),
+        "floats": (7, "float", True),
+        "ints": (8, "int", True),
+        "strings": (9, "bytes", True),
+        "type": (20, "int", False),
+    }
+
+
+class NodeProto(Message):
+    FIELDS = {
+        "input": (1, "string", True),
+        "output": (2, "string", True),
+        "name": (3, "string", False),
+        "op_type": (4, "string", False),
+        "attribute": (5, "msg", True, AttributeProto),
+        "doc_string": (6, "string", False),
+        "domain": (7, "string", False),
+    }
+
+
+class GraphProto(Message):
+    FIELDS = {
+        "node": (1, "msg", True, NodeProto),
+        "name": (2, "string", False),
+        "initializer": (5, "msg", True, TensorProto),
+        "doc_string": (10, "string", False),
+        "input": (11, "msg", True, ValueInfoProto),
+        "output": (12, "msg", True, ValueInfoProto),
+        "value_info": (13, "msg", True, ValueInfoProto),
+    }
+
+
+class OperatorSetIdProto(Message):
+    FIELDS = {
+        "domain": (1, "string", False),
+        "version": (2, "int", False),
+    }
+
+
+class ModelProto(Message):
+    FIELDS = {
+        "ir_version": (1, "int", False),
+        "producer_name": (2, "string", False),
+        "producer_version": (3, "string", False),
+        "domain": (4, "string", False),
+        "model_version": (5, "int", False),
+        "doc_string": (6, "string", False),
+        "graph": (7, "msg", False, GraphProto),
+        "opset_import": (8, "msg", True, OperatorSetIdProto),
+    }

@@ -1,0 +1,371 @@
+"""mxnet_tpu -> ONNX graph exporter (parity: python/mxnet/contrib/onnx/
+mx2onnx/export_model.py + _op_translations.py).
+
+Serializes a Symbol + params to an ONNX ModelProto (opset 9) covering the
+op subset the reference's exporter handles for MLP/CNN inference graphs.
+Training-only heads (SoftmaxOutput, *RegressionOutput) export as their
+inference forms, as in the reference.
+"""
+import numpy as _np
+
+from . import _proto as P
+from ...base import MXNetError
+
+_OPSET = 9
+
+_NP_TO_ONNX = {
+    _np.dtype(_np.float32): P.TensorProto.FLOAT,
+    _np.dtype(_np.float16): P.TensorProto.FLOAT16,
+    _np.dtype(_np.float64): P.TensorProto.DOUBLE,
+    _np.dtype(_np.int32): P.TensorProto.INT32,
+    _np.dtype(_np.int64): P.TensorProto.INT64,
+    _np.dtype(_np.uint8): P.TensorProto.UINT8,
+    _np.dtype(_np.int8): P.TensorProto.INT8,
+    _np.dtype(_np.bool_): P.TensorProto.BOOL,
+}
+
+
+def numpy_to_tensor(arr, name):
+    arr = _np.ascontiguousarray(arr)
+    if arr.dtype not in _NP_TO_ONNX:
+        raise MXNetError("cannot export dtype %s" % arr.dtype)
+    return P.TensorProto(name=name, dims=list(arr.shape),
+                         data_type=_NP_TO_ONNX[arr.dtype],
+                         raw_data=arr.tobytes())
+
+
+def _value_info(name, shape, elem_type=P.TensorProto.FLOAT):
+    dims = [P.Dimension(dim_value=int(d)) for d in shape]
+    return P.ValueInfoProto(
+        name=name,
+        type=P.TypeProto(tensor_type=P.TensorTypeProto(
+            elem_type=elem_type,
+            shape=P.TensorShapeProto(dim=dims))))
+
+
+def _attr_i(name, v):
+    return P.AttributeProto(name=name, i=int(v), type=P.AttributeProto.INT)
+
+
+def _attr_f(name, v):
+    return P.AttributeProto(name=name, f=float(v),
+                            type=P.AttributeProto.FLOAT)
+
+
+def _attr_ints(name, vs):
+    return P.AttributeProto(name=name, ints=[int(v) for v in vs],
+                            type=P.AttributeProto.INTS)
+
+
+def _attr_s(name, v):
+    return P.AttributeProto(name=name, s=v.encode("utf-8"),
+                            type=P.AttributeProto.STRING)
+
+
+class _Exporter:
+    def __init__(self, sym, params):
+        self.sym = sym
+        self.params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
+                           _np.asarray(v)) for k, v in params.items()}
+        self.nodes = []
+        self.initializers = []
+        self.extra_inits = set()
+
+    def _vname(self, node, idx):
+        if node.is_variable:
+            return node.name
+        if node.num_outputs() > 1:
+            return "%s_out%d" % (node.name, idx)
+        return node.name
+
+    def _ins(self, node, n=None):
+        ents = node.inputs if n is None else node.inputs[:n]
+        return [self._vname(p, i) for p, i in ents]
+
+    def _emit(self, op_type, inputs, outputs, name, attrs=()):
+        self.nodes.append(P.NodeProto(op_type=op_type, input=list(inputs),
+                                      output=list(outputs), name=name,
+                                      attribute=list(attrs)))
+
+    def _shape_init(self, name, values):
+        """int64 constant initializer (e.g. Reshape target shape)."""
+        self.initializers.append(
+            numpy_to_tensor(_np.asarray(values, _np.int64), name))
+        self.extra_inits.add(name)
+
+    def _scalar_init(self, name, value):
+        self.initializers.append(
+            numpy_to_tensor(_np.asarray(value, _np.float32), name))
+        self.extra_inits.add(name)
+
+    def run(self, input_shapes, input_dtype):
+        sym = self.sym
+        topo = sym._topo()
+        args = sym.list_arguments()
+        aux = set(sym.list_auxiliary_states())
+
+        for node in topo:
+            if node.is_variable:
+                continue
+            self._convert(node)
+
+        # only variables the emitted nodes actually reference become graph
+        # inputs — training heads drop their label inputs here, like the
+        # reference exporter
+        used = {n for nd_ in self.nodes for n in nd_.input}
+        data_names = [n for n in args
+                      if n not in self.params and n in used]
+        if len(data_names) != len(input_shapes):
+            raise MXNetError(
+                "export_model: %d data inputs (%s) but %d input_shapes"
+                % (len(data_names), data_names, len(input_shapes)))
+
+        graph_inputs = [
+            _value_info(n, s, _NP_TO_ONNX[_np.dtype(input_dtype)])
+            for n, s in zip(data_names, input_shapes)]
+        for name in list(args) + sorted(aux):
+            if name in self.params and name in used:
+                self.initializers.append(
+                    numpy_to_tensor(self.params[name], name))
+                graph_inputs.append(
+                    _value_info(name, self.params[name].shape,
+                                _NP_TO_ONNX[self.params[name].dtype]))
+
+        outputs = []
+        out_shapes = None
+        try:
+            shape_kwargs = dict(zip(data_names, input_shapes))
+            _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
+        except Exception:
+            pass
+        for i, (ent, oi) in enumerate(sym._entries):
+            vi_name = self._vname(ent, oi)
+            shape = out_shapes[i] if out_shapes else ()
+            outputs.append(_value_info(vi_name, shape))
+
+        graph = P.GraphProto(node=self.nodes, name="mxnet_tpu_model",
+                             initializer=self.initializers,
+                             input=graph_inputs, output=outputs)
+        return P.ModelProto(
+            ir_version=4, producer_name="mxnet_tpu",
+            producer_version="0.1", graph=graph,
+            opset_import=[P.OperatorSetIdProto(domain="", version=_OPSET)])
+
+    # -- op translations ---------------------------------------------------
+    def _convert(self, node):
+        fn = _TRANSLATIONS.get(node.op.name)
+        if fn is None:
+            raise MXNetError("op %r has no ONNX translation"
+                             % node.op.name)
+        fn(self, node, node.params)
+
+
+def _simple(onnx_op, attr_fn=None, n_in=None):
+    def tr(ex, node, p):
+        attrs = attr_fn(p) if attr_fn else ()
+        ex._emit(onnx_op, ex._ins(node, n_in), [ex._vname(node, 0)],
+                 node.name, attrs)
+    return tr
+
+
+def _tr_fc(ex, node, p):
+    ins = ex._ins(node)
+    data = ins[0]
+    if not p.get("no_bias", False) and len(ins) < 3:
+        raise MXNetError("FullyConnected with implicit bias slot")
+    if p.get("flatten", True):
+        flat = node.name + "_flat"
+        ex._emit("Flatten", [data], [flat], flat, [_attr_i("axis", 1)])
+        data = flat
+    gemm_in = [data, ins[1]] + ([ins[2]] if len(ins) > 2 else [])
+    ex._emit("Gemm", gemm_in, [ex._vname(node, 0)], node.name,
+             [_attr_f("alpha", 1.0), _attr_f("beta", 1.0),
+              _attr_i("transA", 0), _attr_i("transB", 1)])
+
+
+def _tr_conv(ex, node, p):
+    kernel = tuple(p["kernel"])
+    n = len(kernel)
+    attrs = [
+        _attr_ints("kernel_shape", kernel),
+        _attr_ints("strides", p.get("stride") or (1,) * n),
+        _attr_ints("dilations", p.get("dilate") or (1,) * n),
+        _attr_ints("pads", tuple(p.get("pad") or (0,) * n) * 2),
+        _attr_i("group", p.get("num_group", 1)),
+    ]
+    ex._emit("Conv", ex._ins(node), [ex._vname(node, 0)], node.name, attrs)
+
+
+def _tr_pool(ex, node, p):
+    pool_type = p.get("pool_type", "max")
+    if pool_type not in ("max", "avg"):
+        raise MXNetError("pool_type %r not exportable" % pool_type)
+    if p.get("global_pool", False):
+        op = "GlobalMaxPool" if pool_type == "max" else "GlobalAveragePool"
+        ex._emit(op, ex._ins(node), [ex._vname(node, 0)], node.name)
+        return
+    kernel = tuple(p["kernel"])
+    n = len(kernel)
+    attrs = [
+        _attr_ints("kernel_shape", kernel),
+        _attr_ints("strides", p.get("stride") or (1,) * n),
+        _attr_ints("pads", tuple(p.get("pad") or (0,) * n) * 2),
+    ]
+    op = "MaxPool" if pool_type == "max" else "AveragePool"
+    if pool_type == "avg":
+        attrs.append(_attr_i("count_include_pad",
+                             1 if p.get("count_include_pad", True) else 0))
+    ex._emit(op, ex._ins(node), [ex._vname(node, 0)], node.name, attrs)
+
+
+def _tr_bn(ex, node, p):
+    attrs = [_attr_f("epsilon", p.get("eps", 1e-3)),
+             _attr_f("momentum", p.get("momentum", 0.9))]
+    ex._emit("BatchNormalization", ex._ins(node, 5),
+             [ex._vname(node, 0)], node.name, attrs)
+
+
+def _tr_activation(ex, node, p):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = p.get("act_type", "relu")
+    if act not in table:
+        raise MXNetError("Activation %r not exportable" % act)
+    ex._emit(table[act], ex._ins(node), [ex._vname(node, 0)], node.name)
+
+
+def _tr_leaky(ex, node, p):
+    act = p.get("act_type", "leaky")
+    if act == "leaky":
+        ex._emit("LeakyRelu", ex._ins(node, 1), [ex._vname(node, 0)],
+                 node.name, [_attr_f("alpha", p.get("slope", 0.25))])
+    elif act == "elu":
+        ex._emit("Elu", ex._ins(node, 1), [ex._vname(node, 0)], node.name,
+                 [_attr_f("alpha", p.get("slope", 1.0))])
+    elif act == "prelu":
+        ex._emit("PRelu", ex._ins(node), [ex._vname(node, 0)], node.name)
+    else:
+        raise MXNetError("LeakyReLU %r not exportable" % act)
+
+
+def _tr_reshape(ex, node, p):
+    shape_name = node.name + "_shape"
+    ex._shape_init(shape_name, p["shape"])
+    ex._emit("Reshape", ex._ins(node) + [shape_name],
+             [ex._vname(node, 0)], node.name)
+
+
+def _tr_scalar(onnx_op, reverse=False):
+    def tr(ex, node, p):
+        c_name = node.name + "_scalar"
+        ex._scalar_init(c_name, p["scalar"])
+        ins = ex._ins(node)
+        ordered = [c_name, ins[0]] if reverse else [ins[0], c_name]
+        ex._emit(onnx_op, ordered, [ex._vname(node, 0)], node.name)
+    return tr
+
+
+def _tr_reduce(onnx_op):
+    def tr(ex, node, p):
+        attrs = [_attr_i("keepdims", 1 if p.get("keepdims") else 0)]
+        ax = p.get("axis")
+        if ax is not None and ax != ():
+            ax = (ax,) if isinstance(ax, int) else tuple(ax)
+            attrs.append(_attr_ints("axes", ax))
+        ex._emit(onnx_op, ex._ins(node), [ex._vname(node, 0)],
+                 node.name, attrs)
+    return tr
+
+
+def _tr_softmax_output(ex, node, p):
+    # inference form: softmax over the scores input only
+    ex._emit("Softmax", ex._ins(node, 1), [ex._vname(node, 0)], node.name,
+             [_attr_i("axis", 1)])
+
+
+def _tr_identity_head(ex, node, p):
+    ex._emit("Identity", ex._ins(node, 1), [ex._vname(node, 0)], node.name)
+
+
+_TRANSLATIONS = {
+    "FullyConnected": _tr_fc,
+    "Convolution": _tr_conv,
+    "Pooling": _tr_pool,
+    "BatchNorm": _tr_bn,
+    "Activation": _tr_activation,
+    "LeakyReLU": _tr_leaky,
+    "Reshape": _tr_reshape,
+    "SoftmaxOutput": _tr_softmax_output,
+    "LinearRegressionOutput": _tr_identity_head,
+    "LogisticRegressionOutput": lambda ex, node, p: ex._emit(
+        "Sigmoid", ex._ins(node, 1), [ex._vname(node, 0)], node.name),
+    "MAERegressionOutput": _tr_identity_head,
+    "Flatten": _simple("Flatten", lambda p: [_attr_i("axis", 1)]),
+    "softmax": _simple("Softmax",
+                       lambda p: [_attr_i("axis", p.get("axis", -1))]),
+    "transpose": _simple("Transpose",
+                         lambda p: [_attr_ints("perm", p["axes"])]
+                         if p.get("axes") else []),
+    "Concat": lambda ex, node, p: ex._emit(
+        "Concat", ex._ins(node), [ex._vname(node, 0)], node.name,
+        [_attr_i("axis", p.get("dim", 1))]),
+    "Dropout": _simple("Dropout",
+                       lambda p: [_attr_f("ratio", p.get("p", 0.5))], n_in=1),
+    "clip": _simple("Clip", lambda p: [_attr_f("min", p["a_min"]),
+                                       _attr_f("max", p["a_max"])]),
+    "dot": _simple("MatMul"),
+    "elemwise_add": _simple("Add"),
+    "elemwise_sub": _simple("Sub"),
+    "elemwise_mul": _simple("Mul"),
+    "elemwise_div": _simple("Div"),
+    "broadcast_add": _simple("Add"),
+    "broadcast_sub": _simple("Sub"),
+    "broadcast_mul": _simple("Mul"),
+    "broadcast_div": _simple("Div"),
+    "broadcast_power": _simple("Pow"),
+    "_plus_scalar": _tr_scalar("Add"),
+    "_minus_scalar": _tr_scalar("Sub"),
+    "_rminus_scalar": _tr_scalar("Sub", reverse=True),
+    "_mul_scalar": _tr_scalar("Mul"),
+    "_div_scalar": _tr_scalar("Div"),
+    "relu": _simple("Relu"),
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "exp": _simple("Exp"),
+    "log": _simple("Log"),
+    "sqrt": _simple("Sqrt"),
+    "negative": _simple("Neg"),
+    "abs": _simple("Abs"),
+    "mean": _tr_reduce("ReduceMean"),
+    "sum": _tr_reduce("ReduceSum"),
+    "max": _tr_reduce("ReduceMax"),
+    "min": _tr_reduce("ReduceMin"),
+    "expand_dims": _simple("Unsqueeze",
+                           lambda p: [_attr_ints("axes", (p["axis"],))]),
+    "squeeze": _simple(
+        "Squeeze",
+        lambda p: [_attr_ints("axes", (p["axis"],)
+                              if isinstance(p.get("axis"), int)
+                              else tuple(p.get("axis") or ()))]),
+    "cast": lambda ex, node, p: ex._emit(
+        "Cast", ex._ins(node), [ex._vname(node, 0)], node.name,
+        [_attr_i("to", _NP_TO_ONNX[_np.dtype(p["dtype"])])]),
+}
+
+
+def export_model(sym, params, input_shape, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Serialize (sym, params) to ``onnx_file_path`` (reference
+    contrib/onnx/mx2onnx/export_model.py:32).  ``input_shape`` is a list
+    of shapes, one per data input."""
+    if not isinstance(input_shape, (list, tuple)):
+        raise TypeError("input_shape must be a list of shapes")
+    if input_shape and isinstance(input_shape[0], int):
+        input_shape = [tuple(input_shape)]
+    model = _Exporter(sym, params).run(list(input_shape), input_type)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.encode())
+    if verbose:
+        import logging
+        logging.info("exported ONNX model to %s", onnx_file_path)
+    return onnx_file_path

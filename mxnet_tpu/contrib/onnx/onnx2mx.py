@@ -1,0 +1,403 @@
+"""ONNX -> mxnet_tpu graph importer (parity: python/mxnet/contrib/onnx/
+onnx2mx/import_model.py + import_onnx.py GraphProto._convert_operator).
+
+Builds a Symbol + arg/aux params from a serialized ModelProto.  Covers
+the operator subset the reference's importer exercises for CNN/MLP
+models; unsupported ops raise with the op name so gaps are loud.
+"""
+import numpy as _np
+
+from . import _proto as P
+from ...symbol.symbol import Variable, Group, invoke_sym
+from ... import ndarray as _nd
+from ...base import MXNetError
+
+_DTYPES = {
+    P.TensorProto.FLOAT: _np.float32,
+    P.TensorProto.UINT8: _np.uint8,
+    P.TensorProto.INT8: _np.int8,
+    P.TensorProto.INT32: _np.int32,
+    P.TensorProto.INT64: _np.int64,
+    P.TensorProto.BOOL: _np.bool_,
+    P.TensorProto.FLOAT16: _np.float16,
+    P.TensorProto.DOUBLE: _np.float64,
+}
+
+
+def tensor_to_numpy(t):
+    """TensorProto -> numpy (raw_data or the typed repeated fields)."""
+    if t.data_type not in _DTYPES:
+        raise MXNetError("unsupported ONNX tensor dtype %d" % t.data_type)
+    dtype = _DTYPES[t.data_type]
+    shape = tuple(t.dims)
+    if t.raw_data:
+        arr = _np.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = _np.asarray(t.float_data, dtype=dtype)
+    elif t.int64_data:
+        arr = _np.asarray(t.int64_data, dtype=dtype)
+    elif t.int32_data:
+        arr = _np.asarray(t.int32_data, dtype=dtype)
+    else:
+        arr = _np.zeros(int(_np.prod(shape)) if shape else 0, dtype=dtype)
+    return arr.reshape(shape)
+
+
+def _attrs(node):
+    """AttributeProto list -> python dict."""
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == P.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode("utf-8")
+        elif a.type == P.AttributeProto.TENSOR:
+            out[a.name] = tensor_to_numpy(a.t)
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = tuple(a.floats)
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = tuple(a.ints)
+        elif a.type == P.AttributeProto.STRINGS:
+            out[a.name] = tuple(s.decode("utf-8") for s in a.strings)
+        else:
+            raise MXNetError("unsupported ONNX attribute type %d (%s)"
+                             % (a.type, a.name))
+    return out
+
+
+class _Importer:
+    def __init__(self, graph):
+        self.graph = graph
+        self.params = {n.name: tensor_to_numpy(n) for n in graph.initializer}
+        self.syms = {}        # onnx value name -> Symbol
+        self.aux_names = set()
+        self.used_params = set()
+
+    def run(self):
+        for vi in self.graph.input:
+            if vi.name not in self.params:
+                self.syms[vi.name] = Variable(vi.name)
+        for node in self.graph.node:
+            self._convert(node)
+        outs = [self.syms[o.name] for o in self.graph.output]
+        sym = outs[0] if len(outs) == 1 else Group(outs)
+        args = set(sym.list_arguments())
+        aux = set(sym.list_auxiliary_states())
+        arg_params = {k: _nd.array(v) for k, v in self.params.items()
+                      if k in args}
+        aux_params = {k: _nd.array(v) for k, v in self.params.items()
+                      if k in aux}
+        return sym, arg_params, aux_params
+
+    # -- helpers -----------------------------------------------------------
+    def _in(self, node, i):
+        """Symbol for input slot i (params become Variables on first use)."""
+        name = node.input[i]
+        if name == "":
+            return None
+        if name not in self.syms:
+            if name not in self.params:
+                raise MXNetError("ONNX graph references unknown value %r"
+                                 % name)
+            # carry the initializer's shape so bind-time shape inference
+            # doesn't depend on an op-specific hook
+            self.syms[name] = Variable(name, shape=self.params[name].shape)
+        return self.syms[name]
+
+    def _const(self, node, i, kind="ints"):
+        """Static value of input i, which must come from an initializer
+        (data-dependent shapes can't trace into XLA)."""
+        name = node.input[i]
+        if name not in self.params:
+            raise MXNetError(
+                "ONNX %s requires a constant (initializer) input %r — "
+                "data-dependent values are unsupported on the jit path"
+                % (node.op_type, name))
+        self.used_params.add(name)
+        v = self.params[name]
+        return tuple(int(x) for x in v.reshape(-1)) if kind == "ints" else v
+
+    def _out(self, node, sym):
+        for i, out_name in enumerate(node.output):
+            self.syms[out_name] = sym[i] if len(node.output) > 1 else sym
+
+    # -- op conversion -----------------------------------------------------
+    def _convert(self, node):
+        op = node.op_type
+        fn = getattr(self, "_cv_" + op, None)
+        if fn is None:
+            raise MXNetError("ONNX op %r is not supported by the importer"
+                             % op)
+        fn(node, _attrs(node))
+
+    def _simple(self, node, mx_op, params=None, n_in=None):
+        n = len(node.input) if n_in is None else n_in
+        ins = [self._in(node, i) for i in range(n)]
+        self._out(node, invoke_sym(mx_op, [s for s in ins if s is not None],
+                                   params or {}, name=node.name or None))
+
+    # elementwise / unary
+    def _cv_Add(self, node, a):
+        self._simple(node, "broadcast_add")
+
+    def _cv_Sub(self, node, a):
+        self._simple(node, "broadcast_sub")
+
+    def _cv_Mul(self, node, a):
+        self._simple(node, "broadcast_mul")
+
+    def _cv_Div(self, node, a):
+        self._simple(node, "broadcast_div")
+
+    def _cv_Relu(self, node, a):
+        self._simple(node, "Activation", {"act_type": "relu"})
+
+    def _cv_Sigmoid(self, node, a):
+        self._simple(node, "sigmoid")
+
+    def _cv_Tanh(self, node, a):
+        self._simple(node, "tanh")
+
+    def _cv_Softplus(self, node, a):
+        self._simple(node, "Activation", {"act_type": "softrelu"})
+
+    def _cv_Exp(self, node, a):
+        self._simple(node, "exp")
+
+    def _cv_Log(self, node, a):
+        self._simple(node, "log")
+
+    def _cv_Sqrt(self, node, a):
+        self._simple(node, "sqrt")
+
+    def _cv_Neg(self, node, a):
+        self._simple(node, "negative")
+
+    def _cv_Abs(self, node, a):
+        self._simple(node, "abs")
+
+    def _cv_Pow(self, node, a):
+        self._simple(node, "broadcast_power")
+
+    def _cv_Identity(self, node, a):
+        self.syms[node.output[0]] = self._in(node, 0)
+
+    def _cv_LeakyRelu(self, node, a):
+        self._simple(node, "LeakyReLU",
+                     {"act_type": "leaky", "slope": a.get("alpha", 0.01)})
+
+    def _cv_Elu(self, node, a):
+        self._simple(node, "LeakyReLU",
+                     {"act_type": "elu", "slope": a.get("alpha", 1.0)})
+
+    def _cv_PRelu(self, node, a):
+        self._simple(node, "LeakyReLU", {"act_type": "prelu"})
+
+    def _cv_Clip(self, node, a):
+        lo, hi = a.get("min"), a.get("max")
+        if lo is None and len(node.input) > 1 and node.input[1]:
+            lo = float(self._const(node, 1, kind="array").reshape(()))
+        if hi is None and len(node.input) > 2 and node.input[2]:
+            hi = float(self._const(node, 2, kind="array").reshape(()))
+        self._simple(node, "clip",
+                     {"a_min": float(lo), "a_max": float(hi)}, n_in=1)
+
+    def _cv_Softmax(self, node, a):
+        self._simple(node, "softmax", {"axis": a.get("axis", -1)})
+
+    def _cv_Constant(self, node, a):
+        value = a.get("value")
+        if value is None:
+            raise MXNetError("Constant node without a tensor value")
+        self.params[node.output[0]] = value
+        self.syms[node.output[0]] = Variable(node.output[0],
+                                             shape=value.shape)
+
+    # structure
+    def _cv_Flatten(self, node, a):
+        axis = a.get("axis", 1)
+        if axis != 1:
+            raise MXNetError("Flatten axis != 1 unsupported")
+        self._simple(node, "Flatten")
+
+    def _cv_Reshape(self, node, a):
+        shape = a.get("shape")  # opset < 5 kept it as an attribute
+        if shape is None:
+            shape = self._const(node, 1)
+        self._simple(node, "Reshape", {"shape": tuple(shape)}, n_in=1)
+
+    def _cv_Transpose(self, node, a):
+        self._simple(node, "transpose", {"axes": tuple(a.get("perm", ()))})
+
+    def _cv_Concat(self, node, a):
+        ins = [self._in(node, i) for i in range(len(node.input))]
+        self._out(node, invoke_sym(
+            "Concat", ins,
+            {"num_args": len(ins), "dim": a.get("axis", 1)},
+            name=node.name or None))
+
+    def _cv_Squeeze(self, node, a):
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = self._const(node, 1)
+        self._simple(node, "squeeze", {"axis": tuple(axes)}, n_in=1)
+
+    def _cv_Unsqueeze(self, node, a):
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = self._const(node, 1)
+        s = self._in(node, 0)
+        for ax in sorted(axes):
+            s = invoke_sym("expand_dims", [s], {"axis": int(ax)})
+        self.syms[node.output[0]] = s
+
+    def _cv_Dropout(self, node, a):
+        self._simple(node, "Dropout", {"p": a.get("ratio", 0.5)}, n_in=1)
+
+    def _cv_Cast(self, node, a):
+        to = _DTYPES.get(a.get("to"))
+        if to is None:
+            raise MXNetError("Cast to unsupported dtype %r" % a.get("to"))
+        self._simple(node, "cast", {"dtype": _np.dtype(to).name})
+
+    # reductions
+    def _reduce(self, node, a, mx_op):
+        axes = a.get("axes")
+        self._simple(node, mx_op,
+                     {"axis": tuple(axes) if axes else None,
+                      "keepdims": bool(a.get("keepdims", 1))}, n_in=1)
+
+    def _cv_ReduceMean(self, node, a):
+        self._reduce(node, a, "mean")
+
+    def _cv_ReduceSum(self, node, a):
+        self._reduce(node, a, "sum")
+
+    def _cv_ReduceMax(self, node, a):
+        self._reduce(node, a, "max")
+
+    def _cv_ReduceMin(self, node, a):
+        self._reduce(node, a, "min")
+
+    # linear algebra
+    def _cv_MatMul(self, node, a):
+        self._simple(node, "dot")
+
+    def _cv_Gemm(self, node, a):
+        alpha = a.get("alpha", 1.0)
+        beta = a.get("beta", 1.0)
+        if alpha != 1.0 or beta != 1.0:
+            raise MXNetError("Gemm with alpha/beta != 1 unsupported")
+        trans_a = a.get("transA", 0)
+        trans_b = a.get("transB", 0)
+        x = self._in(node, 0)
+        w = self._in(node, 1)
+        b = self._in(node, 2) if len(node.input) > 2 else None
+        if trans_a:
+            x = invoke_sym("transpose", [x], {"axes": (1, 0)})
+        w_name = node.input[1]
+        if trans_b and w_name in self.params:
+            # FullyConnected expects (out, in) — ONNX transB=1 matches
+            num_hidden = self.params[w_name].shape[0]
+            ins = [x, w] + ([b] if b is not None else [])
+            self._out(node, invoke_sym(
+                "FullyConnected", ins,
+                {"num_hidden": num_hidden, "no_bias": b is None},
+                name=node.name or None))
+            return
+        if trans_b:
+            w = invoke_sym("transpose", [w], {"axes": (1, 0)})
+        y = invoke_sym("dot", [x, w], {})
+        if b is not None:
+            y = invoke_sym("broadcast_add", [y, b], {})
+        self.syms[node.output[0]] = y
+
+    # NN layers
+    def _cv_Conv(self, node, a):
+        kernel = tuple(a.get("kernel_shape", ()))
+        pads = tuple(a.get("pads", (0,) * (2 * len(kernel))))
+        n = len(kernel)
+        if pads[:n] != pads[n:]:
+            raise MXNetError("asymmetric Conv pads unsupported")
+        w_name = node.input[1]
+        if w_name not in self.params:
+            raise MXNetError("Conv weight must be an initializer")
+        num_filter = self.params[w_name].shape[0]
+        params = {
+            "kernel": kernel,
+            "stride": tuple(a.get("strides", (1,) * n)),
+            "dilate": tuple(a.get("dilations", (1,) * n)),
+            "pad": pads[:n],
+            "num_filter": num_filter,
+            "num_group": a.get("group", 1),
+            "no_bias": len(node.input) < 3 or node.input[2] == "",
+        }
+        self._simple(node, "Convolution", params)
+
+    def _cv_MaxPool(self, node, a):
+        self._pool(node, a, "max")
+
+    def _cv_AveragePool(self, node, a):
+        self._pool(node, a, "avg")
+
+    def _pool(self, node, a, pool_type):
+        kernel = tuple(a.get("kernel_shape", ()))
+        n = len(kernel)
+        pads = tuple(a.get("pads", (0,) * (2 * n)))
+        if pads[:n] != pads[n:]:
+            raise MXNetError("asymmetric pool pads unsupported")
+        count_include_pad = a.get("count_include_pad", 0)
+        self._simple(node, "Pooling", {
+            "kernel": kernel, "pool_type": pool_type,
+            "stride": tuple(a.get("strides", (1,) * n)),
+            "pad": pads[:n],
+            "count_include_pad": bool(count_include_pad)}, n_in=1)
+
+    def _cv_GlobalAveragePool(self, node, a):
+        self._simple(node, "Pooling",
+                     {"pool_type": "avg", "global_pool": True, "kernel": ()})
+
+    def _cv_GlobalMaxPool(self, node, a):
+        self._simple(node, "Pooling",
+                     {"pool_type": "max", "global_pool": True, "kernel": ()})
+
+    def _cv_BatchNormalization(self, node, a):
+        self._simple(node, "BatchNorm", {
+            "eps": a.get("epsilon", 1e-5),
+            "momentum": a.get("momentum", 0.9),
+            "fix_gamma": False,
+            # inference graphs (the ONNX norm) use the running stats
+            "use_global_stats": True}, n_in=5)
+
+
+def import_model(model_file):
+    """Read a .onnx file -> (sym, arg_params, aux_params) (reference
+    contrib/onnx/onnx2mx/import_model.py:21)."""
+    with open(model_file, "rb") as f:
+        data = f.read()
+    model = P.ModelProto.decode(data)
+    if model.graph is None:
+        raise MXNetError("%s contains no graph" % model_file)
+    return _Importer(model.graph).run()
+
+
+def get_model_metadata(model_file):
+    """Shapes of graph inputs/outputs (reference import_model.py:60)."""
+    with open(model_file, "rb") as f:
+        model = P.ModelProto.decode(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def _shape(vi):
+        tt = vi.type.tensor_type if vi.type else None
+        if tt is None or tt.shape is None:
+            return (vi.name, None)
+        return (vi.name, tuple(d.dim_value for d in tt.shape.dim))
+
+    return {
+        "input_tensor_data": [_shape(vi) for vi in g.input
+                              if vi.name not in inits],
+        "output_tensor_data": [_shape(vi) for vi in g.output],
+    }

@@ -165,6 +165,7 @@ class SVRGModule(Module):
         from ... import metric as _metric
         from ...initializer import Uniform
         from ...model import BatchEndParam
+        from ...module.base_module import _as_list
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -215,7 +216,3 @@ class SVRGModule(Module):
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
-
-
-def _as_list(obj):
-    return obj if isinstance(obj, (list, tuple)) else [obj]

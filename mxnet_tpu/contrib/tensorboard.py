@@ -33,6 +33,7 @@ class LogMetricsCallback:
     def __init__(self, logging_dir, prefix=None):
         self.prefix = prefix
         self.summary_writer = _make_writer(logging_dir)
+        self._step = 0
         if self.summary_writer is None:
             logging.error("no SummaryWriter backend found; install mxboard "
                           "or a tensorboard-compatible writer")
@@ -40,8 +41,12 @@ class LogMetricsCallback:
     def __call__(self, param):
         if param.eval_metric is None or self.summary_writer is None:
             return
+        # own monotone counter, not param.epoch: as a batch_end_callback
+        # every batch of an epoch would otherwise land on the same step and
+        # overwrite the previous point
+        self._step += 1
         for name, value in param.eval_metric.get_name_value():
             if self.prefix is not None:
                 name = "%s-%s" % (self.prefix, name)
             self.summary_writer.add_scalar(name, value,
-                                           global_step=param.epoch)
+                                           global_step=self._step)

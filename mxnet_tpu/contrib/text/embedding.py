@@ -101,8 +101,13 @@ class _TokenEmbedding(_vocab.Vocabulary):
                              "to the pre-trained token embedding file")
         logging.info("loading embedding vectors from %s", pretrained_file_path)
 
+        # tokens indexed before loading (unknown + reserved + any counter
+        # keys) each own a matrix row up front — file rows append after
+        # them, so indices and rows stay aligned for every token
+        n_pre = len(self._idx_to_token)
         vec_len = None
         rows = []
+        pre_rows = {}   # pre-indexed token idx -> vector found in the file
         seen = set()
         loaded_unknown = None
         with io.open(pretrained_file_path, "r", encoding=encoding) as f:
@@ -113,33 +118,44 @@ class _TokenEmbedding(_vocab.Vocabulary):
                         "line %d of %s: unexpected data format"
                         % (line_num, pretrained_file_path))
                 token, vec = elems[0], [float(x) for x in elems[1:]]
-                if token == self.unknown_token and loaded_unknown is None:
-                    loaded_unknown = vec
-                    seen.add(token)
-                elif token in seen:
+                if token in seen:
                     warnings.warn("line %d: duplicate embedding for token %s "
                                   "skipped" % (line_num, token))
-                elif len(vec) == 1:
+                    continue
+                if token == self.unknown_token:
+                    loaded_unknown = vec
+                    seen.add(token)
+                    continue
+                if len(vec) == 1:
                     warnings.warn("line %d: token %s with 1-d vector is "
-                                  "likely a header; skipped" % (line_num, token))
+                                  "likely a header; skipped"
+                                  % (line_num, token))
+                    continue
+                if vec_len is None:
+                    vec_len = len(vec)
+                elif len(vec) != vec_len:
+                    raise MXNetError("line %d: vector dimension %d != %d"
+                                     % (line_num, len(vec), vec_len))
+                seen.add(token)
+                if token in self._token_to_idx:   # reserved/pre-indexed
+                    pre_rows[self._token_to_idx[token]] = vec
                 else:
-                    if vec_len is None:
-                        vec_len = len(vec)
-                    elif len(vec) != vec_len:
-                        raise MXNetError(
-                            "line %d: vector dimension %d != %d"
-                            % (line_num, len(vec), vec_len))
                     rows.append(vec)
                     self._idx_to_token.append(token)
                     self._token_to_idx[token] = len(self._idx_to_token) - 1
-                    seen.add(token)
 
+        if vec_len is None:
+            raise MXNetError("no embedding vectors found in %s"
+                             % pretrained_file_path)
         self._vec_len = vec_len
-        unk = (np.asarray(loaded_unknown, np.float32)
-               if loaded_unknown is not None
-               else init_unknown_vec(shape=vec_len).asnumpy().astype(np.float32))
-        mat = np.vstack([unk[None, :],
-                         np.asarray(rows, np.float32).reshape(-1, vec_len)])
+        mat = np.zeros((n_pre + len(rows), vec_len), np.float32)
+        if rows:
+            mat[n_pre:] = np.asarray(rows, np.float32)
+        for idx, vec in pre_rows.items():
+            mat[idx] = vec
+        mat[UNKNOWN_IDX] = (np.asarray(loaded_unknown, np.float32)
+                            if loaded_unknown is not None
+                            else init_unknown_vec(shape=vec_len).asnumpy())
         self._idx_to_vec = nd.array(mat)
 
     def _build_embedding_for_vocabulary(self, vocabulary):

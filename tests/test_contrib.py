@@ -236,3 +236,36 @@ def test_tensorboard_callback_graceful():
     param = mx.model.BatchEndParam(epoch=0, nbatch=1, eval_metric=metric,
                                    locals=None)
     cb(param)  # must not raise whether or not a writer backend exists
+
+
+def test_custom_embedding_with_reserved_tokens(tmp_path):
+    """Reserved tokens must own matrix rows: indices and vectors stay
+    aligned (regression: rows shifted when reserved_tokens was passed)."""
+    p = str(tmp_path / "emb.txt")
+    _write_embedding(p)
+    emb = ctext.embedding.CustomEmbedding(p, reserved_tokens=["<pad>"])
+    assert emb.idx_to_vec.shape[0] == len(emb.idx_to_token) == 5
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("a").asnumpy(), [0.1, 0.2, 0.3])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("c").asnumpy(), [-1.0, -2.0, -3.0])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("<pad>").asnumpy(), [0, 0, 0])
+
+
+def test_tensorboard_steps_monotone(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    cb = LogMetricsCallback(str(tmp_path / "tb"))
+    calls = []
+
+    class FakeWriter:
+        def add_scalar(self, name, value, global_step=None):
+            calls.append(global_step)
+
+    cb.summary_writer = FakeWriter()
+    metric = mx.metric.create("acc")
+    metric.update([mx.nd.array([1.0])], [mx.nd.array([[0.1, 0.9]])])
+    for i in range(3):
+        cb(mx.model.BatchEndParam(epoch=0, nbatch=i, eval_metric=metric,
+                                  locals=None))
+    assert calls == sorted(set(calls)), calls  # strictly increasing

@@ -1,0 +1,144 @@
+"""ONNX import/export round-trip tests (parity model:
+tests/python-pytest/onnx/).  No `onnx` package exists in this image, so
+interop is proven by round-tripping through the wire format itself:
+export writes real protobuf bytes, import parses them back, and the
+reconstructed graph must be numerically identical."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+def _forward(sym, params, data, label_names=()):
+    mod = mx.mod.Module(sym, label_names=list(label_names))
+    mod.bind([("data", data.shape)], for_training=False)
+    mod.init_params(arg_params=params[0], aux_params=params[1],
+                    allow_missing=False)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(data)]), is_train=False)
+    return mod.get_outputs()[0].asnumpy()
+
+
+def _roundtrip(sym, arg_params, aux_params, data, tmp_path,
+               label_names=("softmax_label",)):
+    path = str(tmp_path / "model.onnx")
+    onnx_mxnet.export_model(sym, {**arg_params, **aux_params},
+                            [data.shape], np.float32, path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    y1 = _forward(sym, (arg_params, aux_params), data,
+                  label_names=label_names)
+    y2 = _forward(sym2, (arg2, aux2), data, label_names=())
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+    return sym2
+
+
+def _init_params(sym, data_shape, seed=0):
+    mod = mx.mod.Module(sym)
+    mod.bind([("data", data_shape)], for_training=False)
+    mx.random.seed(seed)
+    mod.init_params(mx.initializer.Xavier())
+    return mod.get_params()
+
+
+def test_proto_codec_roundtrip():
+    """The hand-rolled protobuf codec must round-trip a nested model."""
+    t = P.TensorProto(name="w", dims=[2, 3], data_type=P.TensorProto.FLOAT,
+                      raw_data=np.arange(6, dtype=np.float32).tobytes())
+    node = P.NodeProto(op_type="Conv", input=["x", "w"], output=["y"],
+                       name="conv0",
+                       attribute=[P.AttributeProto(
+                           name="kernel_shape", ints=[3, 3],
+                           type=P.AttributeProto.INTS)])
+    g = P.GraphProto(node=[node], name="g", initializer=[t])
+    m = P.ModelProto(ir_version=4, producer_name="test", graph=g,
+                     opset_import=[P.OperatorSetIdProto(version=9)])
+    m2 = P.ModelProto.decode(m.encode())
+    assert m2.producer_name == "test"
+    assert m2.opset_import[0].version == 9
+    assert m2.graph.node[0].op_type == "Conv"
+    assert tuple(m2.graph.node[0].attribute[0].ints) == (3, 3)
+    assert m2.graph.initializer[0].dims == [2, 3]
+    w = np.frombuffer(m2.graph.initializer[0].raw_data, np.float32)
+    np.testing.assert_array_equal(w, np.arange(6, dtype=np.float32))
+
+
+def test_proto_negative_int_and_skip_unknown():
+    a = P.AttributeProto(name="axis", i=-1, type=P.AttributeProto.INT)
+    a2 = P.AttributeProto.decode(a.encode())
+    assert a2.i == -1
+    # unknown fields are skipped: decode NodeProto bytes as AttributeProto
+    # must not crash (field numbers overlap but kinds differ benignly)
+    raw = P.NodeProto(op_type="X", doc_string="d").encode()
+    P.AttributeProto.decode(raw)
+
+
+def test_onnx_roundtrip_mlp(tmp_path):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    data = np.random.RandomState(0).randn(8, 10).astype(np.float32)
+    arg, aux = _init_params(net, data.shape)
+    _roundtrip(net, arg, aux, data, tmp_path)
+
+
+def test_onnx_roundtrip_convnet(tmp_path):
+    net = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3, 3),
+                             num_filter=8, pad=(1, 1), name="conv1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool1")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=4, name="conv2")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1), name="gap")
+    net = mx.sym.Flatten(net, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    data = np.random.RandomState(1).randn(2, 3, 16, 16).astype(np.float32)
+    arg, aux = _init_params(net, data.shape)
+    _roundtrip(net, arg, aux, data, tmp_path)
+
+
+def test_onnx_roundtrip_elemwise_and_reduce(tmp_path):
+    d = mx.sym.Variable("data")
+    net = (d * 2.0 + 1.0)
+    net = mx.sym.exp(mx.sym.clip(net, a_min=-2.0, a_max=2.0))
+    net = mx.sym.mean(net, axis=1, keepdims=True)
+    net = mx.sym.broadcast_mul(net, mx.sym.sqrt(mx.sym.abs(d) + 1.0))
+    data = np.random.RandomState(2).randn(4, 5).astype(np.float32)
+    path = str(tmp_path / "ew.onnx")
+    onnx_mxnet.export_model(net, {}, [data.shape], np.float32, path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    y1 = _forward(net, ({}, {}), data)
+    y2 = _forward(sym2, (arg2, aux2), data)
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_metadata(tmp_path):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    data_shape = (8, 10)
+    arg, aux = _init_params(net, data_shape)
+    path = str(tmp_path / "meta.onnx")
+    onnx_mxnet.export_model(net, dict(arg), [data_shape], np.float32, path)
+    meta = onnx_mxnet.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (8, 10))]
+    assert meta["output_tensor_data"][0][1] == (8, 4)
+
+
+def test_onnx_import_unsupported_op_is_loud(tmp_path):
+    node = P.NodeProto(op_type="NonexistentOp", input=["data"],
+                       output=["y"], name="bad")
+    g = P.GraphProto(node=[node],
+                     input=[P.ValueInfoProto(name="data")],
+                     output=[P.ValueInfoProto(name="y")])
+    m = P.ModelProto(ir_version=4, graph=g,
+                     opset_import=[P.OperatorSetIdProto(version=9)])
+    path = str(tmp_path / "bad.onnx")
+    with open(path, "wb") as f:
+        f.write(m.encode())
+    with pytest.raises(Exception, match="NonexistentOp"):
+        onnx_mxnet.import_model(path)
