@@ -221,6 +221,12 @@ def _tr_pool(ex, node, p):
 def _tr_bn(ex, node, p):
     attrs = [_attr_f("epsilon", p.get("eps", 1e-3)),
              _attr_f("momentum", p.get("momentum", 0.9))]
+    if p.get("fix_gamma", True):
+        # mxnet's forward replaces gamma with ones when fix_gamma (the
+        # default, ops/nn.py) — export what the model actually computes
+        gamma_name = node.inputs[1][0].name
+        if gamma_name in ex.params:
+            ex.params[gamma_name] = _np.ones_like(ex.params[gamma_name])
     ex._emit("BatchNormalization", ex._ins(node, 5),
              [ex._vname(node, 0)], node.name, attrs)
 
@@ -342,11 +348,14 @@ _TRANSLATIONS = {
     "min": _tr_reduce("ReduceMin"),
     "expand_dims": _simple("Unsqueeze",
                            lambda p: [_attr_ints("axes", (p["axis"],))]),
+    # axis=None (squeeze all unit dims) must emit NO axes attribute —
+    # an empty-but-present axes list round-trips as a no-op
     "squeeze": _simple(
         "Squeeze",
         lambda p: [_attr_ints("axes", (p["axis"],)
-                              if isinstance(p.get("axis"), int)
-                              else tuple(p.get("axis") or ()))]),
+                              if isinstance(p["axis"], int)
+                              else tuple(p["axis"]))]
+        if p.get("axis") not in (None, ()) else []),
     "cast": lambda ex, node, p: ex._emit(
         "Cast", ex._ins(node), [ex._vname(node, 0)], node.name,
         [_attr_i("to", _NP_TO_ONNX[_np.dtype(p["dtype"])])]),

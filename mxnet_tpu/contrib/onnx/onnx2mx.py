@@ -37,7 +37,12 @@ def tensor_to_numpy(t):
     elif t.int64_data:
         arr = _np.asarray(t.int64_data, dtype=dtype)
     elif t.int32_data:
-        arr = _np.asarray(t.int32_data, dtype=dtype)
+        if t.data_type == P.TensorProto.FLOAT16:
+            # the spec stores fp16 in int32_data as raw uint16 bits
+            arr = _np.asarray(t.int32_data,
+                              _np.uint16).view(_np.float16)
+        else:
+            arr = _np.asarray(t.int32_data, dtype=dtype)
     else:
         arr = _np.zeros(int(_np.prod(shape)) if shape else 0, dtype=dtype)
     return arr.reshape(shape)
@@ -201,8 +206,10 @@ class _Importer:
             lo = float(self._const(node, 1, kind="array").reshape(()))
         if hi is None and len(node.input) > 2 and node.input[2]:
             hi = float(self._const(node, 2, kind="array").reshape(()))
-        self._simple(node, "clip",
-                     {"a_min": float(lo), "a_max": float(hi)}, n_in=1)
+        # both bounds are optional in ONNX (one-sided clips, e.g. ReLU6)
+        lo = -3.4028234663852886e38 if lo is None else float(lo)
+        hi = 3.4028234663852886e38 if hi is None else float(hi)
+        self._simple(node, "clip", {"a_min": lo, "a_max": hi}, n_in=1)
 
     def _cv_Softmax(self, node, a):
         self._simple(node, "softmax", {"axis": a.get("axis", -1)})
@@ -242,7 +249,9 @@ class _Importer:
         axes = a.get("axes")
         if axes is None and len(node.input) > 1:
             axes = self._const(node, 1)
-        self._simple(node, "squeeze", {"axis": tuple(axes)}, n_in=1)
+        # no axes at all is valid ONNX: squeeze every size-1 dim
+        params = {"axis": tuple(axes)} if axes else {}
+        self._simple(node, "squeeze", params, n_in=1)
 
     def _cv_Unsqueeze(self, node, a):
         axes = a.get("axes")

@@ -676,53 +676,76 @@ def waitall():
 
 
 # ---------------------------------------------------------------------------
-# serialization — reference binary format surface (ndarray.cc:1583-1795);
-# we use .npz-style container with the same API (save/load dict or list).
+# serialization — reference binary .params format (ndarray.cc:1583-1795),
+# see serialization.py for the wire layout. Round-1/2 npz files still load.
 # ---------------------------------------------------------------------------
 
-_MAGIC = b"MXTPU001"
+_MAGIC = b"MXTPU001"  # legacy (rounds 1-2) npz container magic, read-only
+
+
+def _to_record(a):
+    """NDArray -> serialization record (numpy or sparse tuple)."""
+    stype = getattr(a, "stype", "default")
+    if stype == "row_sparse":
+        return ("row_sparse", _np.asarray(a.data.asnumpy()),
+                _np.asarray(a.indices.asnumpy()), a.shape)
+    if stype == "csr":
+        return ("csr", _np.asarray(a.data.asnumpy()),
+                _np.asarray(a.indptr.asnumpy()),
+                _np.asarray(a.indices.asnumpy()), a.shape)
+    return a.asnumpy()
+
+
+def _from_record(rec):
+    if isinstance(rec, _np.ndarray):
+        return array(rec)
+    from .sparse import RowSparseNDArray, CSRNDArray
+    if rec[0] == "row_sparse":
+        _, data, indices, shape = rec
+        return RowSparseNDArray(jnp.asarray(data), jnp.asarray(indices),
+                                shape)
+    _, data, indptr, indices, shape = rec
+    return CSRNDArray(jnp.asarray(data), jnp.asarray(indptr),
+                      jnp.asarray(indices), shape)
 
 
 def save(fname, data):
-    """Serialize NDArrays (list or name->array dict) to a file.
-
-    ON-DISK FORMAT NOTE: this is a documented divergence from the
-    reference. The reference writes its own versioned binary (magic
-    0x112, per-array TBlob headers — src/ndarray/ndarray.cc:1583-1795);
-    we write an 8-byte magic followed by a standard numpy ``.npz``
-    archive. Rationale: identical save/load semantics through this API,
-    plus the archive opens with plain ``numpy.load`` for interop.
-    Reference-era ``.params`` binaries are NOT readable by :func:`load`;
-    convert once via the reference's python (``mx.nd.load`` ->
-    ``numpy.savez``) if migrating checkpoints.
-    """
-    import struct
+    """Serialize NDArrays (list or name->array dict) to a file in the
+    reference's versioned binary .params format (list magic 0x112,
+    per-array V2 records — src/ndarray/ndarray.cc:1583-1795), so
+    checkpoints interoperate with reference-lineage MXNet in both
+    directions. Dense, row_sparse and csr arrays round-trip."""
+    from . import serialization as _ser
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
         keys = list(data.keys())
         arrays = [data[k] for k in keys]
     else:
-        keys = None
+        keys = []
         arrays = list(data)
-    with open(fname, "wb") as f:
-        f.write(_MAGIC)
-        npz = {}
-        if keys is None:
-            for i, a in enumerate(arrays):
-                npz["arr_%d" % i] = a.asnumpy()
-            _np.savez(f, __keys__=_np.asarray([], dtype="U1"), **npz)
-        else:
-            for k, a in zip(keys, arrays):
-                npz["data_" + k] = a.asnumpy()
-            _np.savez(f, __keys__=_np.asarray(keys), **npz)
+    _ser.save_file(fname, [_to_record(a) for a in arrays], keys)
 
 
 def load(fname):
+    """Load a .params file: the reference binary format (including V1/V0
+    legacy per-array records), or the npz container earlier builds of
+    this library wrote."""
+    from . import serialization as _ser
     with open(fname, "rb") as f:
-        magic = f.read(8)
-        if magic != _MAGIC:
-            raise MXNetError("invalid NDArray file %s" % fname)
+        head = f.read(8)
+    if head == _MAGIC:
+        return _load_npz_legacy(fname)
+    arrays, names = _ser.load_file(fname)
+    arrays = [_from_record(r) for r in arrays]
+    if not names:
+        return arrays
+    return dict(zip(names, arrays))
+
+
+def _load_npz_legacy(fname):
+    with open(fname, "rb") as f:
+        f.read(8)
         z = _np.load(f, allow_pickle=False)
         keys = list(z["__keys__"])
         if not keys:

@@ -1,0 +1,126 @@
+"""Reference binary .params format tests (wire layout of
+src/ndarray/ndarray.cc:1583-1795)."""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import serialization as ser
+
+
+def test_roundtrip_dense_dict(tmp_path):
+    p = str(tmp_path / "x.params")
+    d = {"w": mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3)),
+         "b": mx.nd.array(np.array([1, 2, 3], np.int32))}
+    mx.nd.save(p, d)
+    out = mx.nd.load(p)
+    assert set(out) == {"w", "b"}
+    np.testing.assert_array_equal(out["w"].asnumpy(), d["w"].asnumpy())
+    assert out["b"].asnumpy().dtype == np.int32
+
+
+def test_roundtrip_list_and_dtypes(tmp_path):
+    p = str(tmp_path / "l.params")
+    arrays = [mx.nd.array(np.random.rand(4, 5).astype(dt))
+              for dt in (np.float32, np.float16, np.float64)]
+    mx.nd.save(p, arrays)
+    out = mx.nd.load(p)
+    assert isinstance(out, list) and len(out) == 3
+    for a, b in zip(arrays, out):
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+        assert a.asnumpy().dtype == b.asnumpy().dtype
+
+
+def test_roundtrip_sparse(tmp_path):
+    p = str(tmp_path / "s.params")
+    rsp = mx.nd.sparse.row_sparse_array(
+        (np.array([[1., 2.], [3., 4.]], np.float32),
+         np.array([1, 3], np.int64)), shape=(5, 2))
+    csr = mx.nd.sparse.csr_matrix(
+        (np.array([7., 8.], np.float32), np.array([1, 0], np.int64),
+         np.array([0, 1, 2], np.int64)), shape=(2, 3))
+    mx.nd.save(p, {"rsp": rsp, "csr": csr})
+    out = mx.nd.load(p)
+    assert out["rsp"].stype == "row_sparse"
+    assert out["csr"].stype == "csr"
+    np.testing.assert_array_equal(out["rsp"].asnumpy(), rsp.asnumpy())
+    np.testing.assert_array_equal(out["csr"].asnumpy(), csr.asnumpy())
+
+
+def test_wire_layout_golden():
+    """Byte-level check of the V2 record against the reference layout."""
+    out = bytearray()
+    ser.save_array(out, np.array([[1.0, 2.0]], np.float32))
+    expect = (struct.pack("<I", 0xF993FAC9)      # V2 magic
+              + struct.pack("<i", 1)             # kDefaultStorage
+              + struct.pack("<I", 2)             # ndim
+              + struct.pack("<qq", 1, 2)         # int64 dims
+              + struct.pack("<ii", 1, 0)         # Context cpu:0
+              + struct.pack("<i", 0)             # kFloat32
+              + struct.pack("<ff", 1.0, 2.0))    # raw data
+    assert bytes(out) == expect
+
+
+def test_list_container_golden(tmp_path):
+    p = str(tmp_path / "g.params")
+    mx.nd.save(p, {"a": mx.nd.array([1.0], dtype="float32")})
+    raw = open(p, "rb").read()
+    magic, reserved, count = struct.unpack_from("<QQQ", raw)
+    assert magic == 0x112 and reserved == 0 and count == 1
+    # names vector at the tail: count, len, bytes
+    assert raw.endswith(struct.pack("<Q", 1) + struct.pack("<Q", 1) + b"a")
+
+
+def test_legacy_v1_and_v0_records_load():
+    data = np.array([[5.0, 6.0]], np.float32)
+    # V1: magic + shape + ctx + flag + raw
+    v1 = (struct.pack("<I", 0xF993FAC8) + struct.pack("<I", 2)
+          + struct.pack("<qq", 1, 2) + struct.pack("<ii", 1, 0)
+          + struct.pack("<i", 0) + data.tobytes())
+    # V0: uint32 ndim as 'magic', uint32 dims
+    v0 = (struct.pack("<I", 2) + struct.pack("<II", 1, 2)
+          + struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+          + data.tobytes())
+    import io as _io
+    for raw in (v1, v0):
+        arr = ser.load_array(_io.BytesIO(raw))
+        np.testing.assert_array_equal(arr, data)
+
+
+def test_npz_legacy_container_still_loads(tmp_path):
+    p = str(tmp_path / "old.params")
+    with open(p, "wb") as f:
+        f.write(b"MXTPU001")
+        np.savez(f, __keys__=np.asarray(["k"]),
+                 **{"data_k": np.array([1.0, 2.0], np.float32)})
+    out = mx.nd.load(p)
+    np.testing.assert_array_equal(out["k"].asnumpy(), [1.0, 2.0])
+
+
+def test_module_checkpoint_uses_binary_format(tmp_path):
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind([("data", (4, 3))], [("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 0)
+    raw = open(prefix + "-0000.params", "rb").read()
+    assert struct.unpack_from("<Q", raw)[0] == 0x112
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_array_equal(arg["fc_weight"].asnumpy(), w0)
+
+
+def test_scalar_array_roundtrips_as_shape_1(tmp_path):
+    """0-d arrays project to (1,) — the reference wire format's ndim-0
+    record means 'none' and carries no payload (regression: scalar save
+    corrupted the stream for every following record)."""
+    p = str(tmp_path / "sc.params")
+    ser.save_file(p, [np.array(3.5, np.float32),
+                      np.array([1.0, 2.0], np.float32)], [])
+    arrays, _ = ser.load_file(p)
+    np.testing.assert_array_equal(arrays[0], [3.5])
+    np.testing.assert_array_equal(arrays[1], [1.0, 2.0])

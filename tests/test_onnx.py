@@ -142,3 +142,36 @@ def test_onnx_import_unsupported_op_is_loud(tmp_path):
         f.write(m.encode())
     with pytest.raises(Exception, match="NonexistentOp"):
         onnx_mxnet.import_model(path)
+
+
+def test_onnx_fix_gamma_exports_ones(tmp_path):
+    """fix_gamma=True (the BatchNorm default) computes with gamma=1 —
+    the export must match that, whatever the stored gamma says."""
+    net = mx.sym.BatchNorm(mx.sym.Variable("data"), name="bn",
+                           fix_gamma=True)
+    data = np.random.RandomState(3).randn(2, 4, 5, 5).astype(np.float32)
+    arg, aux = _init_params(net, data.shape)
+    arg["bn_gamma"][:] = 5.0  # would poison the export if not fixed
+    _roundtrip(net, arg, aux, data, tmp_path, label_names=())
+
+
+def test_onnx_squeeze_all_and_one_sided_clip(tmp_path):
+    d = mx.sym.Variable("data")
+    net = mx.sym.squeeze(mx.sym.clip(d, a_min=-3.4028234663852886e38,
+                                     a_max=6.0))
+    data = np.random.RandomState(4).rand(1, 3, 1, 2).astype(np.float32) * 10
+    path = str(tmp_path / "sq.onnx")
+    onnx_mxnet.export_model(net, {}, [data.shape], np.float32, path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    y1 = _forward(net, ({}, {}), data)
+    y2 = _forward(sym2, (arg2, aux2), data)
+    assert y1.shape == y2.shape == (3, 2)
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+def test_onnx_fp16_int32_data_bitcast():
+    from mxnet_tpu.contrib.onnx.onnx2mx import tensor_to_numpy
+    t = P.TensorProto(name="h", dims=[2], data_type=P.TensorProto.FLOAT16,
+                      int32_data=[15360, 16384])  # bits of 1.0, 2.0
+    np.testing.assert_array_equal(tensor_to_numpy(t),
+                                  np.array([1.0, 2.0], np.float16))
