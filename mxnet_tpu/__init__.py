@@ -65,6 +65,7 @@ def __getattr__(name):
         "operator": ".operator",
         "rnn": ".rnn",
         "model": ".model",
+        "subgraph": ".subgraph",
         "parallel": ".parallel",
         "profiler": ".profiler",
         "test_utils": ".test_utils",

@@ -127,6 +127,9 @@ register_flag("enable_x64", "MXNET_ENABLE_X64", _parse_bool, False,
               "Enable float64/int64 JAX dtypes. Off by default: the "
               "reference computes in float32 (mshadow default_real_t) and "
               "f64 is hostile to the TPU MXU.")
+register_flag("subgraph_backend", "MXNET_SUBGRAPH_BACKEND", str, "",
+              "Partition symbols with this subgraph backend's properties "
+              "at bind time. Parity: src/operator/subgraph/.")
 register_flag("engine_type", "MXNET_ENGINE_TYPE", str, "ThreadedEngine",
               "Execution engine: ThreadedEngine (async, default) or "
               "NaiveEngine (block after every op; debug). Parity: "

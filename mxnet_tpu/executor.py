@@ -437,6 +437,8 @@ def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
     """
     ctx = ctx or current_context()
     alloc_ctx = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
+    from .subgraph import maybe_partition_for_bind
+    symbol = maybe_partition_for_bind(symbol)
     shape_kwargs = {k: v for k, v in kwargs.items()
                     if isinstance(v, (tuple, list))}
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)

@@ -127,5 +127,9 @@ def get(name) -> Operator:
                        % (name, len(_REGISTRY)))
 
 
+def get_or_none(name):
+    return _REGISTRY.get(name)
+
+
 def list_ops():
     return sorted(_REGISTRY.keys())

@@ -1,0 +1,315 @@
+"""Subgraph partitioning framework (parity: src/operator/subgraph/
+subgraph_property.h:54-155, partition_graph.cc, default_subgraph_property.cc).
+
+The reference lets acceleration backends (MKLDNN, TensorRT) pattern-match
+regions of the graph and replace them with single fused operators.  On
+TPU, XLA already fuses aggressively, so the *performance* role is mostly
+covered by the compiler — what this framework provides is the reference's
+**extension point**: a registry of backends whose selectors claim chains
+of nodes, which are then collapsed into one graph node executing the
+sub-graph as a nested jax program (a natural place to drop in a pallas
+kernel for a matched pattern).
+
+Semantics mirrored from the reference:
+* ``SubgraphSelector`` — stateful matcher: ``select`` starts a match,
+  ``select_output`` extends it downstream, ``reset`` between attempts.
+* ``SubgraphProperty`` — builds selectors and names the fused node.
+* backends registered by name; ``Symbol.get_backend_symbol(name)``
+  partitions, and the ``MXNET_SUBGRAPH_BACKEND`` env/config flag applies
+  a backend inside ``simple_bind`` automatically.
+
+Correctness contract kept simple and checkable: a match is a **linear
+chain** whose interior outputs have no external consumers; auxiliary
+states of interior ops (BatchNorm moving stats) are routed through the
+fused node's aux slots, so training-time updates still land.
+"""
+from __future__ import annotations
+
+from . import config as _config
+from .ops.registry import Operator
+from .symbol.symbol import Node, Symbol, Variable
+
+
+class SubgraphSelector:
+    """Decides which nodes join a subgraph (subgraph_property.h:54)."""
+
+    def select(self, node):
+        """Start a new match at ``node``?"""
+        return False
+
+    def select_output(self, node, output_node):
+        """Extend the match from ``node`` to its consumer ``output_node``?"""
+        return False
+
+    def reset(self):
+        """Called before each new match attempt."""
+
+
+class SubgraphProperty:
+    """A backend's partitioning rule (subgraph_property.h:93)."""
+
+    #: name stamped on fused nodes
+    op_name = "_sg_subgraph"
+
+    def create_subgraph_selector(self):
+        return SubgraphSelector()
+
+    def subgraph_name(self, index):
+        return "%s_%d" % (self.op_name, index)
+
+
+_BACKENDS = {}
+
+
+def register_backend(name, properties):
+    """Register backend ``name`` with a list of SubgraphProperty."""
+    _BACKENDS[name] = list(properties)
+
+
+def get_backend(name):
+    if name not in _BACKENDS:
+        raise KeyError("unknown subgraph backend %r; registered: %s"
+                       % (name, sorted(_BACKENDS)))
+    return _BACKENDS[name]
+
+
+# ---------------------------------------------------------------- partition
+def _consumers(nodes):
+    out = {}
+    for n in nodes:
+        for (p, _oi) in n.inputs:
+            out.setdefault(id(p), []).append(n)
+    return out
+
+
+def _find_chains(sym, prop):
+    """Greedy linear-chain matching in topo order (claimed nodes are
+    skipped).  Returns list of chains (each a list of Nodes, head..tail)."""
+    nodes = sym._topo()
+    consumers = _consumers(nodes)
+    head_ids = {id(n) for n, _ in sym._entries}
+    claimed = set()
+    chains = []
+    for node in nodes:
+        if node.is_variable or id(node) in claimed:
+            continue
+        selector = prop.create_subgraph_selector()
+        selector.reset()
+        if not selector.select(node):
+            continue
+        chain = [node]
+        cur = node
+        while True:
+            # interior nodes must have exactly one consumer and must not be
+            # graph outputs — otherwise their value escapes the subgraph
+            outs = consumers.get(id(cur), [])
+            if len(outs) != 1 or id(cur) in head_ids:
+                break
+            nxt = outs[0]
+            if nxt.is_variable or id(nxt) in claimed:
+                break
+            if nxt.num_outputs() != 1:
+                break
+            if not selector.select_output(cur, nxt):
+                break
+            chain.append(nxt)
+            cur = nxt
+        if len(chain) > 1:
+            claimed.update(id(n) for n in chain)
+            chains.append(chain)
+    return chains
+
+
+def _take_key():
+    """PRNG key for the nested eval: trace-scope key under jit, the eager
+    chain otherwise — and a fixed key during abstract evaluation
+    (jax.eval_shape runs ops outside any trace scope; splitting the eager
+    global key there would leak a tracer into it)."""
+    import jax
+    from . import random as _random
+    if _random.current_trace_rng() is not None:
+        return _random.next_key()
+    try:
+        from jax._src.core import trace_state_clean
+        abstract = not trace_state_clean()
+    except ImportError:  # pragma: no cover - jax internals moved
+        abstract = False
+    if abstract:
+        return jax.random.PRNGKey(0)
+    return _random.next_key()
+
+
+def _build_fused(chain, name):
+    """Collapse ``chain`` into one Node executing the sub-graph."""
+    from .executor import _graph_eval_fn
+
+    member_ids = {id(n) for n in chain}
+    tail = chain[-1]
+
+    # external inputs in first-use order; aux vars split out
+    ext_inputs = []        # list[(producer Node, out_idx)]
+    ext_index = {}
+    var_names = []
+    for n in chain:
+        aux_slots = set(getattr(n.op, "aux_inputs", ()) or ())
+        for slot, (p, oi) in enumerate(n.inputs):
+            if id(p) in member_ids:
+                continue
+            key = (id(p), oi)
+            if key not in ext_index:
+                ext_index[key] = len(ext_inputs)
+                ext_inputs.append((p, oi, slot in aux_slots))
+                var_names.append("in%d_%s" % (len(ext_inputs) - 1,
+                                              p.name))
+
+    # clone the chain over fresh Variables so the sub-symbol is closed
+    placeholder = {}
+    for i, (p, oi, _is_aux) in enumerate(ext_inputs):
+        placeholder[(id(p), oi)] = Variable(var_names[i])._entries[0]
+    clones = {}
+    for n in chain:
+        new_inputs = []
+        for (p, oi) in n.inputs:
+            if id(p) in member_ids:
+                new_inputs.append((clones[id(p)], oi))
+            else:
+                new_inputs.append(placeholder[(id(p), oi)])
+        clones[id(n)] = Node(n.op, n.name, new_inputs, dict(n.params),
+                             dict(n.attrs))
+    sub_sym = Symbol([(clones[id(tail)], 0)])
+    sub_eval = _graph_eval_fn(sub_sym)
+
+    aux_var_names = [var_names[i] for i, (_, _, a) in enumerate(ext_inputs)
+                     if a]
+    arg_slots = [i for i, (_, _, a) in enumerate(ext_inputs) if not a]
+    aux_slots = [i for i, (_, _, a) in enumerate(ext_inputs) if a]
+
+    def fused_fn(*ins, _training=False):
+        arg_vals = {var_names[i]: ins[i] for i in arg_slots}
+        aux_vals = {var_names[i]: ins[i] for i in aux_slots}
+        outs, aux_updates = sub_eval(arg_vals, aux_vals, _take_key(),
+                                     _training)
+        if not aux_var_names:
+            return outs[0]
+        return tuple(outs) + tuple(aux_updates.get(v, aux_vals[v])
+                                   for v in aux_var_names)
+
+    def fused_shape_hook(in_shapes, params):
+        # re-run inference over the sub-graph so interior hooks (e.g.
+        # Convolution's weight-shape rule) complete the fused inputs
+        from .symbol.symbol import _infer_shapes
+        known = {var_names[i]: tuple(s)
+                 for i, s in enumerate(in_shapes) if s is not None}
+        res = _infer_shapes(sub_sym, known)
+        return [res.get(("var", var_names[i]), in_shapes[i])
+                for i in range(len(var_names))]
+
+    def fused_dtype_hook(in_dtypes, params):
+        from .symbol.symbol import _infer_types
+        known = {var_names[i]: d
+                 for i, d in enumerate(in_dtypes) if d is not None}
+        res = _infer_types(sub_sym, known)
+        in_d = [res.get(("var", var_names[i]), in_dtypes[i])
+                for i in range(len(var_names))]
+        out_d = [res.get((id(clones[id(tail)]), 0), in_d[0])]
+        out_d += [in_d[i] for i in aux_slots]
+        return in_d, out_d
+
+    n_out = 1 + len(aux_var_names)
+    op = Operator(name, fused_fn, num_outputs=n_out)
+    op.aux_inputs = tuple(aux_slots)
+    op.aux_outputs = tuple(range(1, n_out))
+    op.num_visible_outputs = 1
+    op.shape_hook = fused_shape_hook
+    op.dtype_hook = fused_dtype_hook
+    # keep the sub-symbol reachable for introspection/tests (Operator has
+    # __slots__, functions have __dict__)
+    fused_fn._subgraph_symbol = sub_sym
+
+    fused = Node(op, name, [(p, oi) for (p, oi, _a) in ext_inputs], {},
+                 {"__subgraph_op__": ",".join(n.op.name for n in chain)})
+    return fused, tail
+
+
+def partition(sym, backend_name):
+    """Return a new Symbol with ``backend_name``'s properties applied
+    (reference BuildSubgraph, partition_graph.cc)."""
+    properties = get_backend(backend_name)
+    out = sym
+    for prop in properties:
+        out = _apply_property(out, prop)
+    return out
+
+
+def _apply_property(sym, prop):
+    chains = _find_chains(sym, prop)
+    if not chains:
+        return sym
+    # (tail node id) -> fused Node
+    replacement = {}
+    for i, chain in enumerate(chains):
+        fused, tail = _build_fused(chain, prop.subgraph_name(i))
+        replacement[id(tail)] = fused
+
+    # rebuild the graph with tails swapped for fused nodes — iterative
+    # postorder (like Symbol._topo) so deep graphs don't hit the Python
+    # recursion limit
+    memo = {}
+    roots = [n for (n, _oi) in sym._entries]
+    stack = [(n, False) for n in reversed(roots)]
+    while stack:
+        node, expanded = stack.pop()
+        if id(node) in memo:
+            continue
+        src = replacement.get(id(node), node)
+        if not expanded:
+            stack.append((node, True))
+            for (p, _oi) in reversed(src.inputs):
+                if id(p) not in memo:
+                    stack.append((p, False))
+            continue
+        if node.is_variable and id(node) not in replacement:
+            memo[id(node)] = node
+        else:
+            memo[id(node)] = Node(
+                src.op, src.name,
+                [(memo[id(p)], oi) for (p, oi) in src.inputs],
+                dict(src.params), dict(src.attrs))
+
+    entries = [(memo[id(n)], oi) for (n, oi) in sym._entries]
+    return Symbol(entries)
+
+
+# ------------------------------------------------------------- default bk
+class _ConvBNActSelector(SubgraphSelector):
+    """conv -> bn -> relu (any prefix length >= 2) — the classic fusion
+    the reference's MKLDNN property targets (default_subgraph_property)."""
+
+    def select(self, node):
+        return node.op.name == "Convolution"
+
+    def select_output(self, node, output_node):
+        if node.op.name == "Convolution":
+            return output_node.op.name == "BatchNorm"
+        if node.op.name == "BatchNorm":
+            return (output_node.op.name == "Activation"
+                    and output_node.params.get("act_type") == "relu")
+        return False
+
+
+class ConvBNActProperty(SubgraphProperty):
+    op_name = "_sg_conv_bn_act"
+
+    def create_subgraph_selector(self):
+        return _ConvBNActSelector()
+
+
+register_backend("default", [ConvBNActProperty()])
+
+
+def maybe_partition_for_bind(sym):
+    """simple_bind hook: apply MXNET_SUBGRAPH_BACKEND if set."""
+    backend = _config.flags.subgraph_backend
+    if backend:
+        return partition(sym, backend)
+    return sym

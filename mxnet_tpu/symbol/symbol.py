@@ -159,6 +159,14 @@ class Symbol:
             return None
         return Symbol(list(node.inputs))
 
+    # ------------------------------------------------------------ subgraph
+    def get_backend_symbol(self, backend):
+        """Partition this symbol with a registered subgraph backend's
+        properties (reference Symbol.get_backend_symbol,
+        src/operator/subgraph/)."""
+        from ..subgraph import partition
+        return partition(self, backend)
+
     # ----------------------------------------------------------- attributes
     def attr(self, key):
         return self._entries[0][0].attrs.get(key)
@@ -302,6 +310,15 @@ class Symbol:
     # ---------------------------------------------------------------- serde
     def tojson(self):
         nodes = self._topo()
+        for n in nodes:
+            if not n.is_variable and _registry.get_or_none(n.op.name) is None:
+                # e.g. fused subgraph nodes: their Operator is a closure
+                # outside the registry, so the JSON could never load back
+                raise MXNetError(
+                    "cannot serialize symbol: op %r (node %r) is not in the "
+                    "operator registry. Serialize the original symbol and "
+                    "re-apply get_backend_symbol() after loading."
+                    % (n.op.name, n.name))
         nid = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []
         for n in nodes:

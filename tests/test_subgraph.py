@@ -1,0 +1,169 @@
+"""Subgraph partitioning framework tests (parity model:
+tests/python/unittest/test_subgraph_op.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph as sg
+
+
+def _conv_bn_relu_net():
+    net = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3, 3),
+                             num_filter=8, pad=(1, 1), name="conv1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1), name="gap")
+    net = mx.sym.Flatten(net, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _op_names(sym):
+    return [n.op.name for n in sym._topo() if not n.is_variable]
+
+
+def test_partition_reduces_nodes_and_matches_numerics():
+    net = _conv_bn_relu_net()
+    part = net.get_backend_symbol("default")
+    base_ops = _op_names(net)
+    part_ops = _op_names(part)
+    assert "_sg_conv_bn_act" in "".join(part_ops)
+    assert len(part_ops) == len(base_ops) - 2  # conv+bn+relu -> 1 node
+    # same arguments surface (weights reachable through the fused node)
+    assert set(part.list_arguments()) == set(net.list_arguments())
+    assert set(part.list_auxiliary_states()) == set(net.list_auxiliary_states())
+
+    data = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    m1 = mx.mod.Module(net)
+    m1.bind([("data", data.shape)], for_training=False)
+    mx.random.seed(5)
+    m1.init_params(mx.initializer.Xavier())
+    arg, aux = m1.get_params()
+
+    m2 = mx.mod.Module(part)
+    m2.bind([("data", data.shape)], for_training=False)
+    m2.init_params(arg_params=arg, aux_params=aux, force_init=True)
+
+    batch = mx.io.DataBatch(data=[mx.nd.array(data)])
+    m1.forward(batch, is_train=False)
+    m2.forward(batch, is_train=False)
+    np.testing.assert_allclose(m1.get_outputs()[0].asnumpy(),
+                               m2.get_outputs()[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partitioned_training_matches_eager():
+    """Training through the fused node: gradients AND BatchNorm moving
+    stats must match the unpartitioned graph."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 3, 8, 8).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+    net = _conv_bn_relu_net()
+    part = net.get_backend_symbol("default")
+
+    mods = []
+    for s in (net, part):
+        it = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(s)
+        mod.bind(it.provide_data, it.provide_label)
+        mx.random.seed(9)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for _ in range(2):
+            it.reset()
+            for b in it:
+                mod.forward_backward(b)
+                mod.update()
+        mods.append(mod)
+
+    a1, x1 = mods[0].get_params()
+    a2, x2 = mods[1].get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+    for k in x1:  # BN moving stats routed through fused aux slots
+        np.testing.assert_allclose(x1[k].asnumpy(), x2[k].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_env_flag_partitions_at_bind():
+    net = _conv_bn_relu_net()
+    with mx.config.override(subgraph_backend="default"):
+        mod = mx.mod.Module(net)
+        mod.bind([("data", (2, 3, 8, 8))], [("softmax_label", (2,))])
+        fused = [n for n in mod._exec._symbol._topo()
+                 if not n.is_variable and n.op.name.startswith("_sg_")]
+        assert fused, "bind should have partitioned via MXNET_SUBGRAPH_BACKEND"
+
+
+def test_custom_property_and_selector():
+    """User-defined backend: fuse exp -> log chains."""
+    class ExpLogSelector(sg.SubgraphSelector):
+        def select(self, node):
+            return node.op.name == "exp"
+
+        def select_output(self, node, output_node):
+            return output_node.op.name == "log"
+
+    class ExpLogProperty(sg.SubgraphProperty):
+        op_name = "_sg_exp_log"
+
+        def create_subgraph_selector(self):
+            return ExpLogSelector()
+
+    sg.register_backend("explog_test", [ExpLogProperty()])
+    net = mx.sym.log(mx.sym.exp(mx.sym.Variable("data") * 2.0))
+    part = net.get_backend_symbol("explog_test")
+    names = _op_names(part)
+    assert any(n.startswith("_sg_exp_log") for n in names), names
+
+    ex = part.bind(mx.cpu(), {"data": mx.nd.array([[1.0, 2.0]])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [[2.0, 4.0]], rtol=1e-6)
+
+
+def test_no_fuse_when_interior_output_escapes():
+    """A chain whose interior value is also consumed elsewhere must not
+    collapse (the escape would lose that output)."""
+    d = mx.sym.Variable("data")
+    e = mx.sym.exp(d)
+    net = mx.sym.log(e) + e  # e escapes the would-be exp->log chain
+    part = net.get_backend_symbol("explog_test")
+    assert not any(n.op.name.startswith("_sg_exp_log")
+                   for n in part._topo() if not n.is_variable)
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(KeyError, match="nonexistent"):
+        mx.sym.Variable("x").get_backend_symbol("nonexistent")
+
+
+def test_partition_deep_graph_no_recursion_error():
+    d = mx.sym.Variable("data")
+    net = mx.sym.log(mx.sym.exp(d))
+    for _ in range(1500):
+        net = net + 0.0
+    part = net.get_backend_symbol("explog_test")  # must not RecursionError
+    assert any(n.op.name.startswith("_sg_exp_log")
+               for n in part._topo() if not n.is_variable)
+
+
+def test_config_flag_available_without_subgraph_import():
+    import subprocess, sys
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import mxnet_tpu as mx;"
+            "cm = mx.config.override(subgraph_backend='default');"
+            "cm.__enter__(); print('flag-ok')")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=240)
+    assert "flag-ok" in r.stdout, r.stderr[-500:]
+
+
+def test_partitioned_symbol_tojson_refuses_loudly():
+    net = _conv_bn_relu_net()
+    part = net.get_backend_symbol("default")
+    with pytest.raises(Exception, match="re-apply get_backend_symbol"):
+        part.tojson()
+    net.tojson()  # the original still serializes
