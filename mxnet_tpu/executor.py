@@ -111,6 +111,11 @@ class Executor:
                 "instead (Module(context=[...]) data parallelism, or "
                 "parallel.SPMDTrainStep(tp_axis=..., tp_rule=...) for "
                 "tensor parallelism)")
+        # MXNET_SUBGRAPH_BACKEND applies here so BOTH bind paths (raw
+        # Symbol.bind and simple_bind) partition, like the reference's
+        # GraphExecutor::Init
+        from .subgraph import maybe_partition_for_bind
+        symbol = maybe_partition_for_bind(symbol)
         self._symbol = symbol
         if isinstance(ctx, (list, tuple)):
             ctxs = [Context(c) for c in ctx] or [current_context()]
@@ -437,8 +442,6 @@ def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
     """
     ctx = ctx or current_context()
     alloc_ctx = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
-    from .subgraph import maybe_partition_for_bind
-    symbol = maybe_partition_for_bind(symbol)
     shape_kwargs = {k: v for k, v in kwargs.items()
                     if isinstance(v, (tuple, list))}
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)

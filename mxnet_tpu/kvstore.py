@@ -69,11 +69,16 @@ class KVStore:
         from .parallel import dist as _dist
         return _dist.num_workers()
 
-    def get_num_dead_node(self, node_id=0):
-        """Failure-detection surface (reference kvstore.h:353 via ps-lite
-        heartbeats). Under the PJRT distributed runtime a dead host fails the
-        barrier instead; report 0 when the runtime is healthy."""
-        return 0
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Number of workers whose heartbeat went stale (reference
+        kvstore.h:353, ps-lite scheduler heartbeats). ``node_id`` selects
+        the ps-lite node group in the reference; here only workers exist,
+        so it is accepted and ignored. Liveness comes from the per-rank
+        heartbeat files the launcher provisions (parallel/fault.py); a
+        PJRT coordination-service failure additionally surfaces as a
+        failed collective."""
+        from .parallel import fault as _fault
+        return len(_fault.dead_nodes(self.num_workers, timeout=timeout))
 
     # ----------------------------------------------------------------- init
     def init(self, key, value):

@@ -114,6 +114,8 @@ def init(coordinator=None, num_workers_=None, rank_=None, strict=True):
             "before creating arrays — under tools/launch.py the import "
             "does this automatically. Original error: %s" % e) from e
     _INITIALIZED = True
+    from . import fault as _fault
+    _fault.start(rank_)  # no-op unless the launcher provisioned a hb dir
     return True
 
 

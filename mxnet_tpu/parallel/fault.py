@@ -1,0 +1,112 @@
+"""Heartbeat-based failure detection (parity: ps-lite scheduler
+heartbeats surfaced through ``KVStore::num_dead_node``,
+include/mxnet/kvstore.h:353; ps-lite van heartbeat loop).
+
+Design for the TPU runtime: PJRT's coordination service already fails
+collectives when a host dies, but that failure is an exception at an
+arbitrary collective — the reference instead exposes liveness as a
+queryable surface so training loops (and the launcher) can react before
+wedging.  Here every worker touches a per-rank heartbeat file under a
+shared directory on a background thread; ``dead_nodes`` reports ranks
+whose heartbeat is stale.  The single-host N-process launcher provisions
+the directory (``MXNET_HEARTBEAT_DIR``); multi-host deployments point it
+at a shared filesystem or rely on the coordination-service failure, which
+the same API reports via ``barrier_healthy``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["start", "stop", "dead_nodes", "heartbeat_dir", "active"]
+
+_DEFAULT_INTERVAL = 1.0
+
+_lock = threading.Lock()
+_thread = None
+_stop_evt = None
+_started_at = None
+
+
+def heartbeat_dir():
+    return os.environ.get("MXNET_HEARTBEAT_DIR") or None
+
+
+def _hb_path(dir_, rank):
+    return os.path.join(dir_, "hb_%d" % rank)
+
+
+def _interval():
+    try:
+        return float(os.environ.get("MXNET_HEARTBEAT_INTERVAL",
+                                    _DEFAULT_INTERVAL))
+    except ValueError:
+        return _DEFAULT_INTERVAL
+
+
+def active():
+    return _thread is not None and _thread.is_alive()
+
+
+def start(rank, dir_=None, interval=None):
+    """Begin heartbeating as ``rank`` (idempotent). No-op without a
+    heartbeat directory."""
+    global _thread, _stop_evt, _started_at
+    dir_ = dir_ or heartbeat_dir()
+    if dir_ is None:
+        return False
+    with _lock:
+        if active():
+            return True
+        os.makedirs(dir_, exist_ok=True)
+        interval = interval or _interval()
+        _stop_evt = threading.Event()
+        _started_at = time.time()
+        path = _hb_path(dir_, rank)
+
+        def beat(evt=_stop_evt):
+            while not evt.is_set():
+                try:
+                    with open(path, "w") as f:
+                        f.write("%d %f" % (os.getpid(), time.time()))
+                except OSError:
+                    pass  # a vanished dir must not kill the worker
+                evt.wait(interval)
+
+        _thread = threading.Thread(target=beat, daemon=True,
+                                   name="mxtpu-heartbeat")
+        _thread.start()
+    return True
+
+
+def stop():
+    global _thread, _stop_evt
+    with _lock:
+        if _stop_evt is not None:
+            _stop_evt.set()
+        _thread = None
+        _stop_evt = None
+
+
+def dead_nodes(num_workers, timeout=60.0, dir_=None):
+    """Ranks considered dead: heartbeat file stale by > ``timeout``
+    seconds, or never written although the group has been up longer than
+    ``timeout`` (startup grace period)."""
+    dir_ = dir_ or heartbeat_dir()
+    if dir_ is None or not os.path.isdir(dir_):
+        return []
+    now = time.time()
+    up_since = _started_at if _started_at is not None else now
+    dead = []
+    for r in range(num_workers):
+        path = _hb_path(dir_, r)
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            if now - up_since > timeout:
+                dead.append(r)
+            continue
+        if now - mtime > timeout:
+            dead.append(r)
+    return dead

@@ -1,0 +1,53 @@
+"""Worker for the fault-detection test: rank (n-1) stops heartbeating;
+the survivors must observe it through kv.get_num_dead_node() (reference
+kvstore.h:353 surface). The "dead" rank stays alive so the final barrier
+still completes — heartbeat staleness, not process exit, is what the
+surface reports."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.parallel import dist, fault  # noqa: E402
+
+RANK = dist.rank()
+N = dist.num_workers()
+HB = os.environ["MXNET_HEARTBEAT_DIR"]
+assert fault.active(), "dist.init should have started the heartbeat"
+
+kv = mx.kv.create("dist_sync")
+assert kv.get_num_dead_node(timeout=30) == 0
+
+dist.barrier("fault_test_start")
+
+if RANK == N - 1:
+    fault.stop()
+    os.remove(os.path.join(HB, "hb_%d" % RANK))
+    # stay alive until every survivor has flagged detection
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if all(os.path.exists(os.path.join(HB, "done_%d" % r))
+               for r in range(N - 1)):
+            break
+        time.sleep(0.2)
+    else:
+        sys.exit("survivors never detected the dead heartbeat")
+else:
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if kv.get_num_dead_node(timeout=2.0) >= 1:
+            break
+        time.sleep(0.2)
+    else:
+        sys.exit("get_num_dead_node stayed 0")
+    with open(os.path.join(HB, "done_%d" % RANK), "w") as f:
+        f.write("1")
+
+dist.barrier("fault_test_end")
+print("rank %d/%d: fault detection OK" % (RANK, N))

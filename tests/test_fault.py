@@ -1,0 +1,63 @@
+"""Failure-detection tests (reference surface: kvstore.h:353
+num_dead_node via ps-lite heartbeats; here parallel/fault.py)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mxnet_tpu.parallel import fault
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+WORKER = os.path.join(ROOT, "tests", "fault_worker.py")
+
+
+def test_heartbeat_tracker_unit(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_HEARTBEAT_DIR", d)
+    assert fault.start(0, interval=0.05)
+    try:
+        time.sleep(0.2)
+        # rank 1: stale heartbeat; rank 2: never wrote one (still in grace)
+        p1 = os.path.join(d, "hb_1")
+        with open(p1, "w") as f:
+            f.write("0 0")
+        os.utime(p1, (time.time() - 100, time.time() - 100))
+        dead = fault.dead_nodes(3, timeout=5.0)
+        assert dead == [1], dead
+        # our own heartbeat is fresh
+        assert 0 not in fault.dead_nodes(3, timeout=1.0)
+    finally:
+        fault.stop()
+
+
+def test_heartbeat_no_dir_is_noop(monkeypatch):
+    monkeypatch.delenv("MXNET_HEARTBEAT_DIR", raising=False)
+    assert not fault.start(0)
+    assert fault.dead_nodes(4, timeout=1.0) == []
+
+
+def test_dead_node_detected_across_processes():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "3",
+         "--env", "MXNET_HEARTBEAT_INTERVAL=0.2",
+         sys.executable, WORKER],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    for rank in range(3):
+        assert "rank %d/3: fault detection OK" % rank in r.stdout, \
+            r.stdout[-4000:]
+
+
+def test_launcher_reports_dead_workers():
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", sys.executable, "-c",
+         "import sys, os; sys.exit(5 if os.environ['MXNET_WORKER_RANK'] "
+         "== '0' else 0)"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 5
+    assert "worker(s) [0] died" in r.stderr, r.stderr[-1000:]

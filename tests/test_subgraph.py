@@ -98,22 +98,27 @@ def test_env_flag_partitions_at_bind():
         assert fused, "bind should have partitioned via MXNET_SUBGRAPH_BACKEND"
 
 
+class ExpLogSelector(sg.SubgraphSelector):
+    def select(self, node):
+        return node.op.name == "exp"
+
+    def select_output(self, node, output_node):
+        return output_node.op.name == "log"
+
+
+class ExpLogProperty(sg.SubgraphProperty):
+    op_name = "_sg_exp_log"
+
+    def create_subgraph_selector(self):
+        return ExpLogSelector()
+
+
+# module level: several tests below use this backend, in any order
+sg.register_backend("explog_test", [ExpLogProperty()])
+
+
 def test_custom_property_and_selector():
     """User-defined backend: fuse exp -> log chains."""
-    class ExpLogSelector(sg.SubgraphSelector):
-        def select(self, node):
-            return node.op.name == "exp"
-
-        def select_output(self, node, output_node):
-            return output_node.op.name == "log"
-
-    class ExpLogProperty(sg.SubgraphProperty):
-        op_name = "_sg_exp_log"
-
-        def create_subgraph_selector(self):
-            return ExpLogSelector()
-
-    sg.register_backend("explog_test", [ExpLogProperty()])
     net = mx.sym.log(mx.sym.exp(mx.sym.Variable("data") * 2.0))
     part = net.get_backend_symbol("explog_test")
     names = _op_names(part)
@@ -167,3 +172,15 @@ def test_partitioned_symbol_tojson_refuses_loudly():
     with pytest.raises(Exception, match="re-apply get_backend_symbol"):
         part.tojson()
     net.tojson()  # the original still serializes
+
+
+def test_raw_bind_honors_backend_flag():
+    """Symbol.bind must partition under MXNET_SUBGRAPH_BACKEND too."""
+    net = mx.sym.log(mx.sym.exp(mx.sym.Variable("data")))
+    with mx.config.override(subgraph_backend="explog_test"):
+        ex = net.bind(mx.cpu(), {"data": mx.nd.array([1.0, 2.0])})
+    fused = [n.op.name for n in ex._symbol._topo()
+             if not n.is_variable and n.op.name.startswith("_sg_")]
+    assert fused, "raw bind ignored the subgraph backend flag"
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [1.0, 2.0],
+                               rtol=1e-6)

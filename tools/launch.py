@@ -25,10 +25,12 @@ Usage::
 """
 import argparse
 import os
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 
 
@@ -59,6 +61,7 @@ def main(argv=None):
 
     port = args.coordinator_port or _free_port()
     addr = "127.0.0.1:%d" % port
+    hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
     procs = []
     threads = []
     for r in range(args.num_workers):
@@ -67,6 +70,7 @@ def main(argv=None):
             "MXNET_COORDINATOR_ADDRESS": addr,
             "MXNET_NUM_WORKERS": str(args.num_workers),
             "MXNET_WORKER_RANK": str(r),
+            "MXNET_HEARTBEAT_DIR": hb_dir,
             # reference-era names
             "DMLC_PS_ROOT_URI": "127.0.0.1",
             "DMLC_PS_ROOT_PORT": str(port),
@@ -100,6 +104,11 @@ def main(argv=None):
                 pending.discard(p)
                 if r != 0 and rc == 0:
                     rc = r
+                    dead = [i for i, q in enumerate(procs)
+                            if q.poll() not in (None, 0)]
+                    sys.stderr.write(
+                        "launch.py: worker(s) %s died (rc=%d); "
+                        "terminating the group\n" % (dead, r))
                     for q in procs:
                         if q.poll() is None:
                             q.terminate()
@@ -112,6 +121,7 @@ def main(argv=None):
         rc = 130
     for t in threads:
         t.join(timeout=5)
+    shutil.rmtree(hb_dir, ignore_errors=True)
     return rc
 
 
