@@ -1,5 +1,15 @@
 """Model zoo (parity: python/mxnet/gluon/model_zoo/vision/__init__.py —
-get_model + the full family list)."""
+get_model + the full family list).
+
+DERIVATION NOTE: the architecture definitions in this package (alexnet,
+densenet, inception, resnet, squeezenet, vgg, mobilenet) are
+transcriptions of the reference's published model specs expressed
+through the (parity) Gluon API — a model zoo is an architecture spec, so
+near-identity with the reference's layer lists is inherent and these
+files are not original TPU design work. The TPU-first engineering lives
+underneath: every Block executes through the jit-compiled CachedOp
+(gluon/block.py), convs/matmuls lower to MXU ops, and training runs the
+fused SPMD step."""
 from .alexnet import *
 from .densenet import *
 from .inception import *

@@ -5,6 +5,7 @@ from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,
 from . import register as _register
 from . import random
 from . import contrib
+from . import linalg
 from . import sparse
 from .sparse import csr_matrix, row_sparse_array
 

@@ -1,0 +1,13 @@
+"""``mx.nd.linalg`` namespace (parity: python/mxnet/ndarray/linalg.py).
+
+Re-exports the registry-generated eager wrappers (out= support, raw-numpy
+coercion) under their reference names; the op list lives once, in
+ops/linalg.py."""
+from ..ops.linalg import LINALG_NAMES
+from . import register as _register
+from ..ops import registry as _registry
+
+for _name in LINALG_NAMES:
+    globals()[_name] = _register._make_op_func(
+        _registry.get("_linalg_" + _name))
+del _name

@@ -18,3 +18,5 @@ from . import quantization  # noqa: F401
 from . import contrib_ops   # noqa: F401
 from . import custom_op     # noqa: F401
 from . import vision_ops    # noqa: F401
+from . import pallas_flash  # noqa: F401
+from . import linalg        # noqa: F401

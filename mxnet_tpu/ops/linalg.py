@@ -1,0 +1,141 @@
+"""Linear-algebra operators (parity: src/operator/tensor/la_op.cc
+NNVM_REGISTER_OP(_linalg_*) — gemm/gemm2/potrf/potri/trmm/trsm/
+sumlogdiag/syrk/gelqf/syevd).
+
+TPU-native: everything lowers through jnp.linalg / lax.linalg — batched
+over leading dims by construction, differentiated by jax (the reference
+hand-writes each backward kernel), and the triangular/Cholesky paths run
+XLA's blocked algorithms on the MXU. The reference LAPACK flag surface
+(lower, rightside, transpose, alpha) is honored.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _t(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+def _check_axis(axis):
+    # reference axis selects which axis holds matrix rows for batched
+    # operands; only the default (last-two-axes) layout is implemented —
+    # refuse loudly rather than contract the wrong axes
+    if axis != -2:
+        raise NotImplementedError(
+            "linalg gemm axis=%r unsupported: only the default axis=-2 "
+            "(matrices in the trailing two dims) is implemented" % (axis,))
+
+
+@register("_linalg_gemm")
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False,
+                alpha=1.0, beta=1.0, axis=-2):
+    """alpha * op(A) @ op(B) + beta * C (reference la_op.cc:37)."""
+    _check_axis(axis)
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_gemm2")
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    _check_axis(axis)
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf")
+def linalg_potrf(A):
+    """Cholesky: A = L L^T, returns lower-triangular L (la_op.cc:187)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri")
+def linalg_potri(A):
+    """Inverse of B from its Cholesky factor: given L (as produced by
+    potrf), returns (L L^T)^-1 (la_op.cc:239)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jnp.matmul(_t(linv), linv)
+
+
+@register("_linalg_trmm")
+def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matmul: alpha * op(tri(A)) @ B (or B @ op(tri(A))
+    with rightside) (la_op.cc:297)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _t(tri) if transpose else tri
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register("_linalg_trsm")
+def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular solve: X with op(tri(A)) @ X = alpha*B (or
+    X @ op(tri(A)) = alpha*B with rightside) (la_op.cc:360)."""
+    return lax.linalg.triangular_solve(
+        A, alpha * B, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+
+
+@register("_linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    """sum(log(diag(A))) over the last two axes (la_op.cc:423)."""
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_syrk")
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    """alpha * A @ A^T (or A^T @ A with transpose) (la_op.cc:466)."""
+    return alpha * (jnp.matmul(_t(A), A) if transpose
+                    else jnp.matmul(A, _t(A)))
+
+
+@register("_linalg_gelqf", num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (la_op.cc:523);
+    computed as the transposed QR of A^T."""
+    q, r = jnp.linalg.qr(_t(A))
+    return _t(r), _t(q)
+
+
+@register("_linalg_syevd", num_outputs=2)
+def linalg_syevd(A):
+    """Eigendecomposition of symmetric A: returns (U, L) with
+    A = U^T diag(L) U, U's ROWS the eigenvectors (la_op.cc:594)."""
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@register("_linalg_extractdiag")
+def linalg_extractdiag(A, *, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag")
+def linalg_makediag(A, *, offset=0):
+    """Batched diag(A, offset): A[..., i] lands at (i, i+offset) for
+    offset >= 0, (i-offset, i) otherwise (numpy.diag semantics)."""
+    m = A.shape[-1]
+    n = m + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    i = jnp.arange(m)
+    r = i if offset >= 0 else i - offset
+    c = i + offset if offset >= 0 else i
+    return out.at[..., r, c].set(A)
+
+
+# single source of truth for the family — the nd/sym namespace shims
+# build from this list
+LINALG_NAMES = ("gemm", "gemm2", "potrf", "potri", "trmm", "trsm",
+                "sumlogdiag", "syrk", "gelqf", "syevd", "extractdiag",
+                "makediag")
+
+for name in LINALG_NAMES:
+    alias("_linalg_" + name, "linalg_" + name)

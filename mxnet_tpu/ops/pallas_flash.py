@@ -1,0 +1,167 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of long-context training, hand-tiled for the MXU per
+/opt/skills/guides/pallas_guide.md: the Q block lives in VMEM, the kernel
+streams KV blocks with an online softmax (f32 running max / denominator /
+accumulator in VMEM scratch), and the QK^T / PV matmuls run on the MXU
+with ``preferred_element_type=f32``.  Grid = (batch*heads, q_blocks); the
+KV stream is a ``fori_loop`` inside the kernel so the accumulator never
+leaves VMEM.  Causal masking prunes the loop bound (blocks entirely in
+the future are never read).
+
+Backward: ``jax.custom_vjp`` whose bwd recomputes with the pure-jax
+blockwise (flash-pattern) attention and differentiates it — the standard
+recompute-in-backward memory profile without a second hand-written
+kernel.  (parallel/ring_attention.py holds that implementation; the
+reference has no analog — its attention ops are cuDNN calls.)
+
+On CPU the kernel runs in interpreter mode (tests); on TPU it lowers via
+Mosaic.  ``mxnet_tpu.parallel.flash_attention`` auto-selects this kernel
+on TPU when shapes allow.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, causal,
+            sm_scale, q_block, seq_q):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    bq, d = q.shape
+
+    if causal:
+        # last kv position visible to this q block (global offsets align
+        # the diagonals when seq_q != seq_k, as in blockwise_attention)
+        q_hi = (qi + 1) * q_block - 1 + (seq_k - seq_q)
+        n_blocks = jnp.minimum(q_hi // block_k + 1,
+                               pl.cdiv(seq_k, block_k))
+    else:
+        n_blocks = pl.cdiv(seq_k, block_k)
+
+    def body(j, carry):
+        m, l, o = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]  # (bk, d)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+        kv_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = kv_pos < seq_k                              # tail padding
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            mask &= kv_pos <= q_pos + (seq_k - seq_q)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        o_new = o * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, o = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, o0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, block_q, block_k, causal, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+
+    bh = b * h
+    qp = qp.reshape(bh, tq + pad_q, d)
+    kp = kp.reshape(bh, tk + pad_k, d)
+    vp = vp.reshape(bh, tk + pad_k, d)
+    n_q = (tq + pad_q) // block_q
+
+    kernel = functools.partial(
+        _kernel, block_k=block_k, seq_k=tk, causal=causal,
+        sm_scale=sm_scale, q_block=block_q, seq_q=tq)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, tk + pad_k, d), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((1, tk + pad_k, d), lambda bi, qi: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq + pad_q, d), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp)
+    out = out.reshape(b, h, tq + pad_q, d)
+    return out[:, :, :tq] if pad_q else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, block_q=128, block_k=128, causal=False,
+                    interpret=None):
+    """Flash attention on (B, H, T, D) tensors via a pallas TPU kernel.
+
+    ``interpret=None`` auto-selects: interpreter off TPU (tests), Mosaic
+    on TPU. f32 accumulation regardless of input dtype.
+
+    Fully-masked rows (causal with ``seq_q > seq_k``: queries before the
+    first key) return **zeros** — the flash/blockwise convention shared
+    with :func:`~mxnet_tpu.parallel.blockwise_attention`. The dense
+    ``attention_reference`` instead softmaxes an all-masked row into a
+    uniform distribution; that row is mathematically undefined, and the
+    zero convention is what fused kernels produce.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, block_q, block_k, causal, interpret)
+
+
+def _fwd(q, k, v, block_q, block_k, causal, interpret):
+    return flash_attention(q, k, v, block_q, block_k, causal,
+                           interpret), (q, k, v)
+
+
+def _bwd(block_q, block_k, causal, interpret, res, g):
+    from ..parallel.ring_attention import blockwise_attention
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, block_size=block_k, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+# eager/symbolic surface: mx.nd._contrib_FlashAttention(q, k, v, causal=...)
+from .registry import register as _register  # noqa: E402
+
+
+@_register("_contrib_FlashAttention")
+def _contrib_flash_attention(q, k, v, *, causal=False, block_q=128,
+                             block_k=128):
+    """(B, H, T, D) flash attention as a registered op (pallas on TPU)."""
+    return flash_attention(q, k, v, block_q, block_k, bool(causal))

@@ -10,4 +10,6 @@ from .mesh import make_mesh, data_parallel_sharding, replicated
 from .spmd import SPMDTrainStep
 from .ring_attention import (blockwise_attention, ring_attention,
                              make_ring_attention, attention_reference)
+from ..ops.pallas_flash import flash_attention
 from . import dist
+from . import fault

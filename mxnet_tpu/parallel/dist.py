@@ -85,6 +85,12 @@ def init(coordinator=None, num_workers_=None, rank_=None, strict=True):
         return True
     if _externally_initialized():
         _INITIALIZED = True
+        # the prescribed multi-host mode: user-initialized jax.distributed
+        # + MXNET_HEARTBEAT_DIR on a shared fs — liveness must beat here
+        # too or every rank eventually looks dead to get_num_dead_node
+        import jax
+        from . import fault as _fault
+        _fault.start(jax.process_index())
         return True
     role = os.environ.get("DMLC_ROLE")
     if role is not None and role != "worker":

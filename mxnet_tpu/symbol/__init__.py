@@ -2,6 +2,7 @@
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
                      invoke_sym)
 from . import register as _register
+from . import linalg
 
 _register.populate(__name__)
 

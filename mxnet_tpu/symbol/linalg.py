@@ -1,0 +1,12 @@
+"""``mx.sym.linalg`` namespace (parity: python/mxnet/symbol/linalg.py).
+
+Re-exports the registry-generated symbolic wrappers under their reference
+names; the op list lives once, in ops/linalg.py."""
+from ..ops.linalg import LINALG_NAMES
+from . import register as _register
+from ..ops import registry as _registry
+
+for _name in LINALG_NAMES:
+    globals()[_name] = _register._make_op_func(
+        _registry.get("_linalg_" + _name))
+del _name

@@ -1,0 +1,137 @@
+"""Linear-algebra op tests (parity model:
+tests/python/unittest/test_operator.py test_laop* — reference
+src/operator/tensor/la_op.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _spd(n=4, batch=(), seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*batch, n, n).astype(np.float32)
+    return a @ np.swapaxes(a, -1, -2) + n * np.eye(n, dtype=np.float32)
+
+
+def test_gemm_and_gemm2():
+    rng = np.random.RandomState(1)
+    A = rng.randn(2, 3, 4).astype(np.float32)
+    B = rng.randn(2, 4, 5).astype(np.float32)
+    C = rng.randn(2, 3, 5).astype(np.float32)
+    out = mx.nd.linalg.gemm(mx.nd.array(A), mx.nd.array(B), mx.nd.array(C),
+                            alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2.0 * A @ B + 0.5 * C,
+                               rtol=1e-5)
+    out2 = mx.nd.linalg.gemm2(mx.nd.array(A), mx.nd.array(B))
+    np.testing.assert_allclose(out2.asnumpy(), A @ B, rtol=1e-5)
+    # transposes
+    out3 = mx.nd.linalg.gemm2(mx.nd.array(np.swapaxes(A, -1, -2)),
+                              mx.nd.array(B), transpose_a=True)
+    np.testing.assert_allclose(out3.asnumpy(), A @ B, rtol=1e-5)
+
+
+def test_potrf_potri_sumlogdiag():
+    S = _spd(5, batch=(3,))
+    L = mx.nd.linalg.potrf(mx.nd.array(S))
+    np.testing.assert_allclose(
+        (L.asnumpy() @ np.swapaxes(L.asnumpy(), -1, -2)), S, rtol=1e-4,
+        atol=1e-4)
+    Sinv = mx.nd.linalg.potri(L)
+    np.testing.assert_allclose(Sinv.asnumpy() @ S,
+                               np.broadcast_to(np.eye(5), (3, 5, 5)),
+                               rtol=1e-3, atol=1e-3)
+    # log det via sumlogdiag of the Cholesky factor
+    sld = mx.nd.linalg.sumlogdiag(L).asnumpy()
+    _, logdet = np.linalg.slogdet(S)
+    np.testing.assert_allclose(2.0 * sld, logdet, rtol=1e-4)
+
+
+def test_trmm_trsm_roundtrip():
+    rng = np.random.RandomState(2)
+    A = np.tril(rng.randn(4, 4).astype(np.float32)) + 4 * np.eye(
+        4, dtype=np.float32)
+    B = rng.randn(4, 3).astype(np.float32)
+    prod = mx.nd.linalg.trmm(mx.nd.array(A), mx.nd.array(B), alpha=1.0)
+    np.testing.assert_allclose(prod.asnumpy(), np.tril(A) @ B, rtol=1e-5)
+    back = mx.nd.linalg.trsm(mx.nd.array(A), prod)
+    np.testing.assert_allclose(back.asnumpy(), B, rtol=1e-3, atol=1e-4)
+    # rightside
+    Bt = rng.randn(3, 4).astype(np.float32)
+    pr = mx.nd.linalg.trmm(mx.nd.array(A), mx.nd.array(Bt), rightside=True)
+    np.testing.assert_allclose(pr.asnumpy(), Bt @ np.tril(A), rtol=1e-5)
+
+
+def test_syrk_gelqf_syevd():
+    rng = np.random.RandomState(3)
+    A = rng.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        mx.nd.linalg.syrk(mx.nd.array(A)).asnumpy(), A @ A.T, rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.linalg.syrk(mx.nd.array(A), transpose=True).asnumpy(),
+        A.T @ A, rtol=1e-5)
+
+    L, Q = mx.nd.linalg.gelqf(mx.nd.array(A))
+    np.testing.assert_allclose(L.asnumpy() @ Q.asnumpy(), A, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, np.eye(3),
+                               rtol=1e-4, atol=1e-5)
+
+    S = _spd(4)
+    U, lam = mx.nd.linalg.syevd(mx.nd.array(S))
+    U, lam = U.asnumpy(), lam.asnumpy()
+    np.testing.assert_allclose(U.T @ np.diag(lam) @ U, S, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_extractdiag_makediag():
+    rng = np.random.RandomState(4)
+    A = rng.randn(2, 4, 4).astype(np.float32)
+    d = mx.nd.linalg.extractdiag(mx.nd.array(A))
+    np.testing.assert_allclose(d.asnumpy(),
+                               np.diagonal(A, axis1=-2, axis2=-1))
+    v = rng.randn(3).astype(np.float32)
+    m = mx.nd.linalg.makediag(mx.nd.array(v), offset=1)
+    np.testing.assert_allclose(m.asnumpy(), np.diag(v, k=1))
+    m2 = mx.nd.linalg.makediag(mx.nd.array(v), offset=-2)
+    np.testing.assert_allclose(m2.asnumpy(), np.diag(v, k=-2))
+
+
+def test_linalg_gradients_flow():
+    """potrf/sumlogdiag autodiff: d logdet(S)/dS = S^-1 (symmetrized)."""
+    S = _spd(4)
+    x = mx.nd.array(S)
+    x.attach_grad()
+    with mx.autograd.record():
+        L = mx.nd.linalg.potrf(x)
+        y = 2.0 * mx.nd.linalg.sumlogdiag(L)  # = logdet(S)
+    y.backward()
+    g = x.grad.asnumpy()
+    expect = np.linalg.inv(S)
+    np.testing.assert_allclose(g + g.T, expect + expect.T, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_linalg_symbolic():
+    A = mx.sym.Variable("A")
+    B = mx.sym.Variable("B")
+    out = mx.sym.linalg.gemm2(A, B, name="g2")
+    rng = np.random.RandomState(5)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 2).astype(np.float32)
+    ex = out.bind(mx.cpu(), {"A": mx.nd.array(a), "B": mx.nd.array(b)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_gemm_axis_rejected_loudly():
+    A = mx.nd.array(np.zeros((2, 3, 4), np.float32))
+    B = mx.nd.array(np.zeros((2, 4, 5), np.float32))
+    C = mx.nd.array(np.zeros((2, 3, 5), np.float32))
+    with pytest.raises(NotImplementedError, match="axis"):
+        mx.nd.linalg.gemm(A, B, C, axis=0)
+
+
+def test_linalg_namespace_uses_generated_wrappers():
+    # raw numpy coercion + out= support come from the shared codegen
+    a = np.eye(3, dtype=np.float32)
+    out = mx.nd.linalg.potrf(a)  # numpy accepted
+    np.testing.assert_allclose(out.asnumpy(), np.eye(3))

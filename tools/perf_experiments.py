@@ -1,0 +1,86 @@
+"""Single-chip ResNet-50 perf experiments: where does the step time go?
+
+Runs the fused train step at several configurations and prints a table:
+  fwd-only vs full step, batch scaling, optional XLA-flag variants.
+Timing = forced host fetch after N steps (same methodology as bench.py).
+
+Usage:  python tools/perf_experiments.py [--batch 128] [--steps 20]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(batch, steps, fwd_only=False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    dev = jax.devices()[0]
+    ctx = mx.tpu() if dev.platform != "cpu" else mx.cpu()
+    sym = models.resnet_symbol(num_classes=1000, num_layers=50)
+    rng = np.random.RandomState(0)
+    data_nd = mx.nd.array(rng.randn(batch, 3, 224, 224).astype(np.float32),
+                          ctx=ctx)
+    label_nd = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32),
+                           ctx=ctx)
+    batch_obj = DataBatch(data=[data_nd], label=[label_nd])
+
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind([DataDesc("data", (batch, 3, 224, 224))],
+             [DataDesc("softmax_label", (batch,))],
+             for_training=not fwd_only)
+    mod.init_params(mx.initializer.Xavier(factor_type="in", magnitude=2.0))
+    if not fwd_only:
+        mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9,
+                                             "multi_precision": True})
+
+    def one_step():
+        if fwd_only:
+            mod.forward(batch_obj, is_train=False)
+        else:
+            mod.forward_backward(batch_obj)
+            mod.update()
+
+    def force():
+        if fwd_only:
+            arr = mod.get_outputs()[0]._data
+        else:
+            arr = mod._exec.arg_dict[mod._param_names[0]]._data
+        return float(np.asarray(jax.device_get(arr)).ravel()[0])
+
+    one_step(); force()          # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_step()
+    force()
+    dt = time.perf_counter() - t0
+    return dt / steps * 1e3, batch * steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--cases", default="fwd128,step128,step256")
+    args = ap.parse_args()
+
+    for case in args.cases.split(","):
+        case = case.strip()
+        fwd = case.startswith("fwd")
+        b = int(case.replace("fwd", "").replace("step", ""))
+        ms, img_s = run(b, args.steps, fwd_only=fwd)
+        kind = "fwd-only" if fwd else "train"
+        print("CASE %-10s b=%-4d %8.2f ms/step %10.1f img/s"
+              % (kind, b, ms, img_s), flush=True)
+
+
+if __name__ == "__main__":
+    main()
