@@ -27,58 +27,67 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, causal,
-            sm_scale, q_block, seq_q):
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            block_q, block_k, seq_q, seq_k, causal, sm_scale):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
-    bq, d = q.shape
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
 
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: a KV block entirely in this Q block's future contributes
+    # nothing — skip its compute (the diagonal offset seq_k - seq_q
+    # aligns cross-length attention like blockwise_attention)
     if causal:
-        # last kv position visible to this q block (global offsets align
-        # the diagonals when seq_q != seq_k, as in blockwise_attention)
-        q_hi = (qi + 1) * q_block - 1 + (seq_k - seq_q)
-        n_blocks = jnp.minimum(q_hi // block_k + 1,
-                               pl.cdiv(seq_k, block_k))
+        visible = ki * block_k <= (qi + 1) * block_q - 1 + (seq_k - seq_q)
     else:
-        n_blocks = pl.cdiv(seq_k, block_k)
+        visible = True
 
-    def body(j, carry):
-        m, l, o = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]  # (bk, d)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # (bq, d)
+        bq = q.shape[0]
+        k_blk = k_ref[0]                                   # (bk, d)
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(
             q, k_blk.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # (bq, bk)
-        kv_pos = j * block_k + jax.lax.broadcasted_iota(
+            preferred_element_type=jnp.float32)            # (bq, bk)
+        kv_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
         mask = kv_pos < seq_k                              # tail padding
         if causal:
-            q_pos = qi * q_block + jax.lax.broadcasted_iota(
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             mask &= kv_pos <= q_pos + (seq_k - seq_q)
         s = jnp.where(mask, s, _NEG_INF)
+        m = m_scr[:]
+        l = l_scr[:]
         m_blk = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         p = jnp.where(mask, p, 0.0)
-        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
-        o_new = o * corr + jax.lax.dot_general(
+        m_scr[:] = m_new
+        l_scr[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, o_new
 
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    o0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, o = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, o0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(ki == n_k - 1)
+    def _():
+        o_ref[0] = (acc_scr[:]
+                    / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, block_q, block_k, causal, interpret):
@@ -99,20 +108,32 @@ def _flash_fwd(q, k, v, block_q, block_k, causal, interpret):
     kp = kp.reshape(bh, tk + pad_k, d)
     vp = vp.reshape(bh, tk + pad_k, d)
     n_q = (tq + pad_q) // block_q
+    n_k = (tk + pad_k) // block_k
 
+    # KV blocks are the innermost grid dim: each (block_k, d) tile is
+    # DMA'd per step while the online-softmax state (m, l, acc) persists
+    # in VMEM scratch — VMEM holds O(block) tiles, never the sequence, so
+    # long contexts fit (the review of the first version found whole-KV
+    # staging capped usable sequence length)
     kernel = functools.partial(
-        _kernel, block_k=block_k, seq_k=tk, causal=causal,
-        sm_scale=sm_scale, q_block=block_q, seq_q=tq)
+        _kernel, block_q=block_q, block_k=block_k, seq_q=tq, seq_k=tk,
+        causal=causal, sm_scale=sm_scale)
     out = pl.pallas_call(
         kernel,
-        grid=(bh, n_q),
+        grid=(bh, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-            pl.BlockSpec((1, tk + pad_k, d), lambda bi, qi: (bi, 0, 0)),
-            pl.BlockSpec((1, tk + pad_k, d), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bi, qi, ki: (bi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq + pad_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
         interpret=interpret,
     )(qp, kp, vp)
     out = out.reshape(b, h, tq + pad_q, d)
@@ -135,7 +156,10 @@ def flash_attention(q, k, v, block_q=128, block_k=128, causal=False,
     zero convention is what fused kernels produce.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # any non-cpu platform is the accelerator (this environment's TPU
+        # registers as 'axon' — equality with 'tpu' would silently run
+        # the interpreter on the real chip; see context.py's idiom)
+        interpret = jax.default_backend() == "cpu"
     return _flash_fwd(q, k, v, block_q, block_k, causal, interpret)
 
 

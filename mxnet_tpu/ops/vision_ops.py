@@ -339,3 +339,189 @@ set_op_meta("_contrib_SyncBatchNorm", shape_hook=_nn_bn_shapes,
             else 1)
 alias("_contrib_SyncBatchNorm", "SyncBatchNorm")
 alias("Correlation", "_contrib_Correlation")
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive ROI pooling (reference src/operator/contrib/
+# psroi_pooling.cc:43-112 loop nest): each output cell (ctop, ph, pw)
+# averages ONE position-specific channel c = (ctop*G + gh)*G + gw over its
+# bin. XLA-friendly form: static-shape bin masks over the full H x W
+# contracted against the gathered channel map — no dynamic slices.
+# ---------------------------------------------------------------------------
+
+@register("_contrib_PSROIPooling")
+def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    group_size = group_size or pooled_size
+    n, channels, height, width = data.shape
+    ph = pw = pooled_size
+    g = group_size
+
+    hh = jnp.arange(height, dtype=jnp.float32)
+    ww = jnp.arange(width, dtype=jnp.float32)
+    p_idx = jnp.arange(ph, dtype=jnp.float32)
+
+    # channel index per (ctop, ph, pw)
+    gh = jnp.clip((jnp.arange(ph) * g) // ph, 0, g - 1)
+    gw = jnp.clip((jnp.arange(pw) * g) // pw, 0, g - 1)
+    ctop = jnp.arange(output_dim)
+    c_idx = (ctop[:, None, None] * g + gh[None, :, None]) * g \
+        + gw[None, None, :]                                     # (D,ph,pw)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        start_w = jnp.round(roi[1]) * spatial_scale
+        start_h = jnp.round(roi[2]) * spatial_scale
+        end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        roi_w = jnp.maximum(end_w - start_w, 0.1)
+        roi_h = jnp.maximum(end_h - start_h, 0.1)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        hstart = jnp.clip(jnp.floor(p_idx * bin_h + start_h), 0, height)
+        hend = jnp.clip(jnp.ceil((p_idx + 1) * bin_h + start_h), 0, height)
+        wstart = jnp.clip(jnp.floor(p_idx * bin_w + start_w), 0, width)
+        wend = jnp.clip(jnp.ceil((p_idx + 1) * bin_w + start_w), 0, width)
+        mh = ((hh[None, :] >= hstart[:, None])
+              & (hh[None, :] < hend[:, None])).astype(jnp.float32)  # (ph,H)
+        mw = ((ww[None, :] >= wstart[:, None])
+              & (ww[None, :] < wend[:, None])).astype(jnp.float32)  # (pw,W)
+        img = jnp.take(data, b, axis=0)            # (C,H,W)
+        # contract bins on the raw image FIRST (C,p,p intermediate), then
+        # pick position-sensitive channels — gathering to (D,p,p,H,W)
+        # before the contraction would inflate peak memory by p^2
+        s_all = jnp.einsum("chw,ph,qw->cpq", img, mh, mw)
+        s = s_all[c_idx,
+                  jnp.arange(ph)[None, :, None],
+                  jnp.arange(pw)[None, None, :]]   # (D,ph,pw)
+        area = (hend - hstart)[:, None] * (wend - wstart)[None, :]
+        return jnp.where(area > 0, s / jnp.maximum(area, 1.0), 0.0)
+
+    return jax.vmap(one)(rois)                     # (R, D, ph, pw)
+
+
+# ---------------------------------------------------------------------------
+# Deformable PS-ROI pooling (reference _contrib_DeformablePSROIPooling,
+# deformable_psroi_pooling.cu kernel semantics / arXiv:1703.06211): bins
+# shift by learned normalized offsets `trans` and sample
+# sample_per_part^2 points bilinearly; out-of-image samples are dropped
+# from the average. Gradients (incl. through trans) come from autodiff.
+# ---------------------------------------------------------------------------
+
+@register("_contrib_DeformablePSROIPooling")
+def deformable_psroi_pooling(data, rois, trans=None, *, spatial_scale,
+                             output_dim, group_size, pooled_size,
+                             part_size=0, sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    n, channels, height, width = data.shape
+    p = pooled_size
+    g = group_size
+    part = part_size or p
+    sp = sample_per_part
+
+    gh = jnp.clip((jnp.arange(p) * g) // p, 0, g - 1)
+    gw = jnp.clip((jnp.arange(p) * g) // p, 0, g - 1)
+    ctop = jnp.arange(output_dim)
+    c_idx = (ctop[:, None, None] * g + gh[None, :, None]) * g \
+        + gw[None, None, :]                                    # (D,p,p)
+    part_h = jnp.clip((jnp.arange(p) * part) // p, 0, part - 1)
+    part_w = part_h
+
+    if not no_trans and trans is not None:
+        num_classes = trans.shape[1] // 2
+        cls_of_ctop = (ctop * num_classes) // output_dim       # (D,)
+
+    def one(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        start_w = jnp.round(roi[1]) * spatial_scale - 0.5
+        start_h = jnp.round(roi[2]) * spatial_scale - 0.5
+        end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        roi_w = jnp.maximum(end_w - start_w, 0.1)
+        roi_h = jnp.maximum(end_h - start_h, 0.1)
+        bin_h = roi_h / p
+        bin_w = roi_w / p
+        sub_h = bin_h / sp
+        sub_w = bin_w / sp
+
+        if no_trans or tr is None:
+            tx = jnp.zeros((output_dim, p, p))
+            ty = jnp.zeros((output_dim, p, p))
+        else:
+            # trans: (2*num_classes, part, part); offsets per class & part
+            tx_all = tr[cls_of_ctop * 2][:, part_h][:, :, part_w]
+            ty_all = tr[cls_of_ctop * 2 + 1][:, part_h][:, :, part_w]
+            tx = tx_all * trans_std
+            ty = ty_all * trans_std
+
+        # sample grid: (D, p, p, sp, sp)
+        ph_idx = jnp.arange(p, dtype=jnp.float32)
+        base_h = ph_idx[:, None] * bin_h + start_h              # (p,1)
+        base_w = ph_idx[None, :] * bin_w + start_w              # (1,p)
+        ih = jnp.arange(sp, dtype=jnp.float32)
+        hh = (base_h[None, :, :, None, None] + ty[..., None, None] * roi_h
+              + ih[None, None, None, :, None] * sub_h)
+        wwv = (base_w[None, :, :, None, None] + tx[..., None, None] * roi_w
+               + ih[None, None, None, None, :] * sub_w)
+        # boundary-equal samples stay valid (reference kernel drops only
+        # w < -0.5 || w > width-0.5): ROIs touching the image edge land
+        # exactly on -0.5 and must count in the average
+        valid = ((hh >= -0.5) & (hh <= height - 0.5)
+                 & (wwv >= -0.5) & (wwv <= width - 0.5))
+        hc = jnp.clip(hh, 0.0, height - 1.0)
+        wc = jnp.clip(wwv, 0.0, width - 1.0)
+        h0 = jnp.floor(hc).astype(jnp.int32)
+        w0 = jnp.floor(wc).astype(jnp.int32)
+        h1 = jnp.minimum(h0 + 1, height - 1)
+        w1 = jnp.minimum(w0 + 1, width - 1)
+        ah = hc - h0
+        aw = wc - w0
+
+        img = jnp.take(data, b, axis=0)                        # (C,H,W)
+        cell = img[c_idx]                                      # (D,p,p,H,W)
+
+        # bilinear gather: flatten H,W and take per-sample flat indices
+        flat = cell.reshape(output_dim, p, p, height * width)
+
+        def take(hi, wi):
+            idx = hi * width + wi                              # (D,p,p,sp,sp)
+            return jnp.take_along_axis(
+                flat, idx.reshape(output_dim, p, p, -1),
+                axis=-1).reshape(idx.shape)
+
+        v00 = take(h0, w0)
+        v01 = take(h0, w1)
+        v10 = take(h1, w0)
+        v11 = take(h1, w1)
+        sample = ((1 - ah) * (1 - aw) * v00 + (1 - ah) * aw * v01
+                  + ah * (1 - aw) * v10 + ah * aw * v11)
+        sample = jnp.where(valid, sample, 0.0)
+        cnt = jnp.sum(valid, axis=(-2, -1))
+        s = jnp.sum(sample, axis=(-2, -1))
+        return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0.0)
+
+    if trans is None or no_trans:
+        return jax.vmap(lambda r: one(r, None))(rois)
+    return jax.vmap(one)(rois, trans if trans.shape[0] == rois.shape[0]
+                         else jnp.broadcast_to(
+                             trans, (rois.shape[0],) + trans.shape[1:]))
+
+
+@register("_contrib_quadratic")
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (reference contrib/quadratic_op.cc:31 — the
+    "tutorial op"; kept for script parity)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    """data / sqrt(last_dim) (reference contrib/transformer.cc:33 — the
+    attention-score scaling helper)."""
+    return data / jnp.sqrt(jnp.float32(data.shape[-1])).astype(data.dtype)
+
+
+# MultiProposal IS the batched Proposal here: proposal() already vmaps
+# over the batch (reference multi_proposal.cc duplicates proposal.cc for
+# batch>1)
+alias("_contrib_Proposal", "_contrib_MultiProposal", "MultiProposal")

@@ -190,3 +190,115 @@ def test_proposal_pads_when_anchors_fewer_than_post_nms():
     r = rois.asnumpy()
     assert (r[:, 1] >= 0).sum() <= 144
     assert (r[-1] == -1).any()  # tail rows are -1 padding
+
+
+def _psroi_brute(data, rois, spatial_scale, output_dim, pooled_size,
+                 group_size):
+    """Direct port of the reference loop nest (psroi_pooling.cc:43-112)."""
+    import math
+    n_rois = rois.shape[0]
+    _, channels, height, width = data.shape
+    out = np.zeros((n_rois, output_dim, pooled_size, pooled_size),
+                   np.float32)
+    for n in range(n_rois):
+        b = int(rois[n, 0])
+        sw = round(rois[n, 1]) * spatial_scale
+        sh = round(rois[n, 2]) * spatial_scale
+        ew = (round(rois[n, 3]) + 1.0) * spatial_scale
+        eh = (round(rois[n, 4]) + 1.0) * spatial_scale
+        rw = max(ew - sw, 0.1)
+        rh = max(eh - sh, 0.1)
+        bh, bw = rh / pooled_size, rw / pooled_size
+        for ctop in range(output_dim):
+            for ph in range(pooled_size):
+                for pw in range(pooled_size):
+                    hstart = min(max(int(math.floor(ph * bh + sh)), 0), height)
+                    hend = min(max(int(math.ceil((ph + 1) * bh + sh)), 0), height)
+                    wstart = min(max(int(math.floor(pw * bw + sw)), 0), width)
+                    wend = min(max(int(math.ceil((pw + 1) * bw + sw)), 0), width)
+                    gh = min(max(ph * group_size // pooled_size, 0), group_size - 1)
+                    gw = min(max(pw * group_size // pooled_size, 0), group_size - 1)
+                    c = (ctop * group_size + gh) * group_size + gw
+                    patch = data[b, c, hstart:hend, wstart:wend]
+                    area = (hend - hstart) * (wend - wstart)
+                    out[n, ctop, ph, pw] = 0.0 if area <= 0 \
+                        else patch.sum() / area
+    return out
+
+
+def test_psroi_pooling_matches_brute_force():
+    rng = np.random.RandomState(0)
+    D, G = 3, 3
+    data = rng.randn(2, D * G * G, 14, 14).astype(np.float32)
+    rois = np.array([[0, 1, 1, 9, 11], [1, 0, 2, 12, 13],
+                     [0, 3, 3, 6, 6]], np.float32)
+    out = mx.nd._contrib_PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=D, pooled_size=G, group_size=G)
+    ref = _psroi_brute(data, rois, 1.0, D, G, G)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_no_trans_close_to_psroi():
+    """no_trans deformable PSROI bilinear-samples where plain PSROI
+    averages — on a linear ramp image both give the bin centroid value."""
+    D, G = 2, 2
+    h = w = 12
+    ramp = np.arange(h * w, dtype=np.float32).reshape(h, w)
+    data = np.broadcast_to(ramp, (1, D * G * G, h, w)).copy()
+    rois = np.array([[0, 2, 2, 9, 9]], np.float32)
+    out = mx.nd._contrib_DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), None, spatial_scale=1.0,
+        output_dim=D, group_size=G, pooled_size=G, sample_per_part=4,
+        no_trans=True)
+    assert out.shape == (1, D, G, G)
+    v = out.asnumpy()
+    # ramp: values increase with h and w; bins must be ordered
+    assert v[0, 0, 0, 0] < v[0, 0, 0, 1] < v[0, 0, 1, 1]
+
+
+def test_deformable_psroi_trans_shifts_sampling():
+    D, G = 1, 1
+    h = w = 16
+    ramp = np.arange(h * w, dtype=np.float32).reshape(h, w)
+    data = ramp[None, None].copy()
+    rois = np.array([[0, 4, 4, 11, 11]], np.float32)
+    base = mx.nd._contrib_DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), None, spatial_scale=1.0,
+        output_dim=D, group_size=G, pooled_size=G, sample_per_part=2,
+        no_trans=True).asnumpy()
+    # positive x-offset -> samples shift right -> larger ramp values
+    trans = np.zeros((1, 2, 1, 1), np.float32)
+    trans[0, 0] = 1.0  # x offset (normalized); trans_std scales it
+    shifted = mx.nd._contrib_DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=1.0, output_dim=D, group_size=G, pooled_size=G,
+        sample_per_part=2, trans_std=0.2, no_trans=False).asnumpy()
+    assert shifted[0, 0, 0, 0] > base[0, 0, 0, 0]
+
+
+def test_quadratic_and_div_sqrt_dim():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, -1.0]], np.float32))
+    out = mx.nd._contrib_quadratic(x, a=2.0, b=1.0, c=-1.0)
+    np.testing.assert_allclose(out.asnumpy(),
+                               2 * x.asnumpy() ** 2 + x.asnumpy() - 1)
+    d = mx.nd._contrib_div_sqrt_dim(x)
+    np.testing.assert_allclose(d.asnumpy(), x.asnumpy() / np.sqrt(2),
+                               rtol=1e-6)
+
+
+def test_multi_proposal_is_batched_proposal():
+    rng = np.random.RandomState(5)
+    n, fh, fw = 2, 6, 6
+    A = 9
+    cls = mx.nd.array(rng.rand(n, 2 * A, fh, fw).astype(np.float32))
+    bbox = mx.nd.array(0.1 * rng.randn(n, 4 * A, fh, fw).astype(np.float32))
+    im_info = mx.nd.array(np.array([[96, 96, 1.0]] * n, np.float32))
+    rois = mx.nd._contrib_MultiProposal(
+        cls, bbox, im_info, rpn_pre_nms_top_n=60, rpn_post_nms_top_n=20,
+        threshold=0.7, rpn_min_size=4, scales=(4, 8, 16),
+        ratios=(0.5, 1, 2), feature_stride=16)
+    assert rois.shape == (n * 20, 5)
+    r = rois.asnumpy()
+    valid = r[r[:, 1] >= 0]
+    assert set(np.unique(valid[:, 0])) <= {0.0, 1.0}

@@ -4,7 +4,9 @@ Runs the fused train step at several configurations and prints a table:
   fwd-only vs full step, batch scaling, optional XLA-flag variants.
 Timing = forced host fetch after N steps (same methodology as bench.py).
 
-Usage:  python tools/perf_experiments.py [--batch 128] [--steps 20]
+Usage:  python tools/perf_experiments.py [--steps 20]
+        [--cases fwd128,step128,step256]   # fwd<N> = fwd-only batch N,
+                                           # step<N> = full train step
 """
 import argparse
 import os
