@@ -17,7 +17,10 @@ from jax import lax
 
 from .ndarray import NDArray, invoke
 
-__all__ = ["foreach", "while_loop", "cond", "isinf", "isnan", "isfinite"]
+__all__ = ["foreach", "while_loop", "cond", "isinf", "isnan",
+           "isfinite", "edge_id", "dgl_adjacency", "dgl_subgraph",
+           "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample"]
 
 
 def _is_nd(x):
@@ -202,3 +205,190 @@ def isfinite(data):
     fin = invoke("abs", [data], {}) != float("inf")
     notnan = (data == data)
     return fin * notnan
+
+
+# ---------------------------------------------------------------------------
+# DGL graph ops (reference src/operator/contrib/dgl_graph.cc). These are
+# host-side graph algorithms over CSR edge structures (values = edge ids):
+# sampling/subgraphing runs on numpy — irregular, data-dependent shapes
+# have no sensible XLA lowering — and results wrap back into ndarrays.
+# Eager-only by design (the reference likewise dispatches FComputeEx on
+# CSR storage only).
+# ---------------------------------------------------------------------------
+
+def edge_id(data, u, v):
+    """Edge ids data[u[i], v[i]], -1 where no edge exists
+    (reference _contrib_edge_id, dgl_graph.cc:427)."""
+    import numpy as np
+    from .sparse import CSRNDArray
+    from . import ndarray as _nd
+    if not isinstance(data, CSRNDArray):
+        raise TypeError("edge_id expects a CSRNDArray graph")
+    indptr = np.asarray(data.indptr.asnumpy(), np.int64)
+    indices = np.asarray(data.indices.asnumpy(), np.int64)
+    vals = np.asarray(data.data.asnumpy())
+    uu = np.asarray(u.asnumpy(), np.int64).ravel()
+    vv = np.asarray(v.asnumpy(), np.int64).ravel()
+    out = np.full(uu.shape, -1.0, vals.dtype)
+    for i, (r, c) in enumerate(zip(uu, vv)):
+        lo, hi = indptr[r], indptr[r + 1]
+        hit = np.where(indices[lo:hi] == c)[0]
+        if hit.size:
+            out[i] = vals[lo + hit[0]]
+    return _nd.array(out)
+
+
+def dgl_adjacency(data):
+    """CSR of edge ids -> CSR adjacency with float 1.0 values
+    (reference _contrib_dgl_adjacency, dgl_graph.cc:499)."""
+    import numpy as np
+    from .sparse import CSRNDArray
+    import jax.numpy as jnp
+    if not isinstance(data, CSRNDArray):
+        raise TypeError("dgl_adjacency expects a CSRNDArray graph")
+    ones = jnp.ones(data.indices.shape, jnp.float32)
+    return CSRNDArray(ones, data.indptr.data, data.indices.data, data.shape)
+
+
+def dgl_subgraph(graph, *vids, return_mapping=False):
+    """Induced subgraph(s) on vertex sets ``vids`` (reference
+    _contrib_dgl_subgraph, dgl_graph.cc:247). Subgraph values renumber
+    edges 0..nnz-1; with return_mapping, parallel CSRs carrying the
+    PARENT edge ids are appended to the output list."""
+    import numpy as np
+    from .sparse import CSRNDArray
+    import jax.numpy as jnp
+    if not isinstance(graph, CSRNDArray):
+        raise TypeError("dgl_subgraph expects a CSRNDArray graph")
+    indptr = np.asarray(graph.indptr.asnumpy(), np.int64)
+    indices = np.asarray(graph.indices.asnumpy(), np.int64)
+    vals = np.asarray(graph.data.asnumpy())
+    subs, mappings = [], []
+    for vid_arr in vids:
+        vset = np.asarray(vid_arr.asnumpy(), np.int64).ravel()
+        pos = {int(v): i for i, v in enumerate(vset)}
+        n = len(vset)
+        sp_indptr = np.zeros(n + 1, np.int64)
+        sp_indices, sp_eids = [], []
+        for i, v in enumerate(vset):
+            lo, hi = indptr[v], indptr[v + 1]
+            for j in range(lo, hi):
+                dst = int(indices[j])
+                if dst in pos:
+                    sp_indices.append(pos[dst])
+                    sp_eids.append(vals[j])
+            sp_indptr[i + 1] = len(sp_indices)
+        sp_indices = np.asarray(sp_indices, np.int64)
+        new_ids = np.arange(len(sp_indices), dtype=np.float32)
+        subs.append(CSRNDArray(jnp.asarray(new_ids),
+                               jnp.asarray(sp_indptr),
+                               jnp.asarray(sp_indices), (n, n)))
+        if return_mapping:
+            mappings.append(CSRNDArray(
+                jnp.asarray(np.asarray(sp_eids, np.float32)),
+                jnp.asarray(sp_indptr), jnp.asarray(sp_indices), (n, n)))
+    return subs + mappings
+
+
+def _dgl_neighbor_sample(graph, seeds, num_hops, num_neighbor,
+                         max_num_vertices, probability=None):
+    import numpy as np
+    from .sparse import CSRNDArray
+    from . import ndarray as _nd
+    import jax.numpy as jnp
+    if not isinstance(graph, CSRNDArray):
+        raise TypeError("neighbor sampling expects a CSRNDArray graph")
+    indptr = np.asarray(graph.indptr.asnumpy(), np.int64)
+    indices = np.asarray(graph.indices.asnumpy(), np.int64)
+    vals = np.asarray(graph.data.asnumpy())
+    # one host fetch, not one per frontier vertex per hop
+    prob_np = (np.asarray(probability.asnumpy()).ravel()
+               if probability is not None else None)
+    seed_ids = np.asarray(seeds.asnumpy(), np.int64).ravel()
+    seed_ids = seed_ids[seed_ids >= 0]
+    layer_of = {int(s): 0 for s in seed_ids}
+    frontier = list(seed_ids)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            lo, hi = indptr[v], indptr[v + 1]
+            nbrs = indices[lo:hi]
+            if len(nbrs) == 0:
+                continue
+            if prob_np is not None:
+                # zero-weight neighbors are NEVER sampled (reference
+                # non-uniform semantics); a vertex whose live neighbor
+                # count is short just expands less
+                p = prob_np[nbrs]
+                nbrs = nbrs[p > 0]
+                if len(nbrs) == 0:
+                    continue
+                p = p[p > 0]
+                take = min(num_neighbor, len(nbrs))
+                chosen = np.random.choice(nbrs, size=take, replace=False,
+                                          p=p / p.sum())
+            else:
+                take = min(num_neighbor, len(nbrs))
+                chosen = np.random.choice(nbrs, size=take, replace=False)
+            for c in chosen:
+                c = int(c)
+                if c not in layer_of:
+                    layer_of[c] = hop
+                    nxt.append(c)
+            if len(layer_of) >= max_num_vertices:
+                break
+        frontier = nxt
+        if len(layer_of) >= max_num_vertices:
+            break
+    verts = sorted(layer_of)[:max_num_vertices]
+    n = len(verts)
+    out_verts = np.full(max_num_vertices + 1, -1, np.int64)
+    out_verts[:n] = verts
+    out_verts[-1] = n
+    out_layer = np.full(max_num_vertices + 1, -1, np.int64)
+    out_layer[:n] = [layer_of[v] for v in verts]
+    # induced sub-csr among sampled vertices, parent edge ids as values
+    pos = {v: i for i, v in enumerate(verts)}
+    sp_indptr = np.zeros(max_num_vertices + 1, np.int64)
+    sp_indices, sp_eids = [], []
+    for i, v in enumerate(verts):
+        lo, hi = indptr[v], indptr[v + 1]
+        for j in range(lo, hi):
+            dst = int(indices[j])
+            if dst in pos:
+                sp_indices.append(pos[dst])
+                sp_eids.append(vals[j])
+        sp_indptr[i + 1:] = len(sp_indices)
+    sub = CSRNDArray(jnp.asarray(np.asarray(sp_eids, np.float32)),
+                     jnp.asarray(sp_indptr),
+                     jnp.asarray(np.asarray(sp_indices, np.int64)),
+                     (max_num_vertices, max_num_vertices))
+    return [_nd.array(out_verts), sub, _nd.array(out_layer)]
+
+
+def dgl_csr_neighbor_uniform_sample(graph, *seeds, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100,
+                                    num_args=None):
+    """Uniform neighborhood sampling from seed vertices (reference
+    _contrib_dgl_csr_neighbor_uniform_sample). Per seed array returns
+    [vertices (max+1, last slot = count, -1 pad), sampled sub-CSR with
+    parent edge ids, per-vertex hop layer (-1 pad)]."""
+    out = []
+    for s in seeds:
+        out.extend(_dgl_neighbor_sample(graph, s, num_hops, num_neighbor,
+                                        max_num_vertices))
+    return out
+
+
+def dgl_csr_neighbor_non_uniform_sample(graph, probability, *seeds,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100,
+                                        num_args=None):
+    """Probability-weighted variant (reference
+    _contrib_dgl_csr_neighbor_non_uniform_sample)."""
+    out = []
+    for s in seeds:
+        out.extend(_dgl_neighbor_sample(graph, s, num_hops, num_neighbor,
+                                        max_num_vertices,
+                                        probability=probability))
+    return out

@@ -477,17 +477,16 @@ def deformable_psroi_pooling(data, rois, trans=None, *, spatial_scale,
         ah = hc - h0
         aw = wc - w0
 
-        img = jnp.take(data, b, axis=0)                        # (C,H,W)
-        cell = img[c_idx]                                      # (D,p,p,H,W)
-
-        # bilinear gather: flatten H,W and take per-sample flat indices
-        flat = cell.reshape(output_dim, p, p, height * width)
+        # bilinear gather straight from the flat (C*H*W) image: combined
+        # channel+spatial flat indices per sample point — never the
+        # (D,p,p,H,W) gathered intermediate (p^2 memory inflation, same
+        # reasoning as psroi_pooling above)
+        imgf = jnp.take(data, b, axis=0).reshape(-1)           # (C*H*W,)
 
         def take(hi, wi):
-            idx = hi * width + wi                              # (D,p,p,sp,sp)
-            return jnp.take_along_axis(
-                flat, idx.reshape(output_dim, p, p, -1),
-                axis=-1).reshape(idx.shape)
+            idx = (c_idx[..., None, None] * (height * width)
+                   + hi * width + wi)                          # (D,p,p,sp,sp)
+            return imgf[idx]
 
         v00 = take(h0, w0)
         v01 = take(h0, w1)

@@ -157,3 +157,110 @@ def test_foreach_lax_single_element_list_output():
         return contrib.foreach(body, d, jnp.zeros((2,)))
     lax_out, _ = run(jnp.ones((3, 2)))
     assert isinstance(lax_out, list) and len(lax_out) == 1
+
+
+# ------------------------------------------------------------------ dgl ops
+def _toy_graph():
+    """5-vertex graph; CSR values are edge ids 0..nnz-1."""
+    import numpy as np
+    dense = np.array([
+        [0, 1, 0, 1, 0],
+        [1, 0, 1, 0, 0],
+        [0, 1, 0, 1, 1],
+        [1, 0, 1, 0, 0],
+        [0, 0, 1, 0, 0]], np.float32)
+    rows, cols = np.nonzero(dense)
+    eids = np.arange(len(rows), dtype=np.float32)
+    indptr = np.zeros(6, np.int64)
+    for r in rows:
+        indptr[r + 1:] += 1
+    return mx.nd.sparse.csr_matrix(
+        (eids, cols.astype(np.int64), indptr), shape=(5, 5))
+
+
+def test_dgl_edge_id_and_adjacency():
+    import numpy as np
+    g = _toy_graph()
+    ids = mx.nd.contrib.edge_id(g, mx.nd.array([0, 0, 2]),
+                                mx.nd.array([1, 2, 4]))
+    out = ids.asnumpy()
+    assert out[0] >= 0       # edge 0->1 exists
+    assert out[1] == -1      # edge 0->2 absent
+    assert out[2] >= 0       # edge 2->4 exists
+    adj = mx.nd.contrib.dgl_adjacency(g)
+    assert adj.stype == "csr"
+    np.testing.assert_allclose(adj.data.asnumpy(),
+                               np.ones_like(adj.data.asnumpy()))
+
+
+def test_dgl_subgraph_induced():
+    import numpy as np
+    g = _toy_graph()
+    subs = mx.nd.contrib.dgl_subgraph(g, mx.nd.array([0, 1, 3]),
+                                      return_mapping=True)
+    sub, mapping = subs
+    assert sub.shape == (3, 3)
+    # edges among {0,1,3} (positions 0,1,2): 0->1, 0->3, 1->0, 3->0
+    expect = np.array([[0, 1, 1], [1, 0, 0], [1, 0, 0]], bool)
+    got = np.zeros((3, 3), bool)
+    indptr = mapping.indptr.asnumpy()
+    idx = mapping.indices.asnumpy()
+    for r in range(3):
+        got[r, idx[indptr[r]:indptr[r + 1]]] = True
+    np.testing.assert_array_equal(got, expect)
+    # mapping values are parent edge ids present in the parent graph
+    parent_ids = set(g.data.asnumpy().tolist())
+    assert set(mapping.data.asnumpy().tolist()) <= parent_ids
+
+
+def test_dgl_neighbor_sampling():
+    g = _toy_graph()
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, mx.nd.array([0]), num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    verts, sub, layer = out
+    v = verts.asnumpy()
+    n = int(v[-1])
+    assert 1 <= n <= 5
+    assert 0 in v[:n]                      # seed kept
+    lay = layer.asnumpy()
+    assert lay[list(v[:n]).index(0)] == 0  # seed at hop 0
+    assert sub.shape == (5, 5)
+    # non-uniform variant runs and keeps the seed
+    prob = mx.nd.array([0.2, 0.2, 0.2, 0.2, 0.2])
+    out2 = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, mx.nd.array([0]), num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    assert int(out2[0].asnumpy()[-1]) >= 1
+
+
+def test_dgl_non_uniform_zero_prob_neighbors():
+    import numpy as np
+    g = _toy_graph()
+    # vertex 0's neighbors are {1, 3}; zero out 3 -> only 1 ever sampled
+    prob = mx.nd.array([1.0, 1.0, 0.0, 0.0, 0.0])
+    for _ in range(5):
+        out = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            g, prob, mx.nd.array([0]), num_hops=1, num_neighbor=2,
+            max_num_vertices=5)
+        v = out[0].asnumpy()
+        n = int(v[-1])
+        sampled = set(int(x) for x in v[:n])
+        assert 3 not in sampled and 0 in sampled
+    # all-zero neighborhood: seed expands to nothing, no crash
+    prob0 = mx.nd.array([0.0, 0.0, 0.0, 0.0, 0.0])
+    out = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob0, mx.nd.array([0]), num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    assert int(out[0].asnumpy()[-1]) == 1  # just the seed
+
+
+def test_dgl_type_errors_are_loud():
+    import pytest as _pytest
+    dense = mx.nd.array(np.eye(3, dtype=np.float32))
+    with _pytest.raises(TypeError, match="CSRNDArray"):
+        mx.nd.contrib.dgl_subgraph(dense, mx.nd.array([0]))
+    with _pytest.raises(TypeError, match="CSRNDArray"):
+        mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+            dense, mx.nd.array([0]), num_hops=1, num_neighbor=1,
+            max_num_vertices=3)
