@@ -20,7 +20,7 @@ from .ndarray import NDArray, invoke
 __all__ = ["foreach", "while_loop", "cond", "isinf", "isnan",
            "isfinite", "edge_id", "dgl_adjacency", "dgl_subgraph",
            "dgl_csr_neighbor_uniform_sample",
-           "dgl_csr_neighbor_non_uniform_sample"]
+           "dgl_csr_neighbor_non_uniform_sample", "getnnz"]
 
 
 def _is_nd(x):
@@ -392,3 +392,17 @@ def dgl_csr_neighbor_non_uniform_sample(graph, probability, *seeds,
                                         max_num_vertices,
                                         probability=probability))
     return out
+
+
+def getnnz(data, axis=None):
+    """Stored-value count (reference _contrib_getnnz, contrib/nnz.cc:172):
+    for CSR inputs the number of STORED values — explicit zeros included,
+    per reference semantics; for dense inputs the nonzero count."""
+    from .sparse import CSRNDArray
+    from . import ndarray as _nd
+    if isinstance(data, CSRNDArray):
+        if axis is not None:
+            raise NotImplementedError("getnnz(axis=...) on CSR unsupported")
+        import numpy as np
+        return _nd.array(np.asarray(data.indices.shape[0], np.int64))
+    return _nd.invoke("_contrib_getnnz", [data], {"axis": axis})

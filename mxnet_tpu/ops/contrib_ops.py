@@ -386,3 +386,15 @@ def count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
     contrib = data * ss[None, :]
     out = jnp.zeros(data.shape[:-1] + (int(out_dim),), data.dtype)
     return out.at[..., hh].add(contrib)
+
+
+@register("_contrib_getnnz")
+def getnnz(data, *, axis=None):
+    """NONZERO count of a dense array. The reference op
+    (contrib/nnz.cc:172) counts a CSR's STORED values (explicit zeros
+    included) — that semantics needs storage metadata, so it lives on the
+    sparse-aware eager wrapper ``mx.nd.contrib.getnnz``; this registry op
+    is its dense fallback."""
+    if axis is None:
+        return jnp.sum(data != 0).astype(jnp.int64)
+    return jnp.sum(data != 0, axis=axis).astype(jnp.int64)

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Create image RecordIO datasets (parity: reference tools/im2rec.py —
+make-list + pack modes, multiprocess encode workers).
+
+Two modes, same CLI as the reference:
+
+  # 1) build .lst index files from an image folder
+  python tools/im2rec.py --list --recursive myprefix path/to/images
+
+  # 2) pack a .lst into prefix.rec/prefix.idx (JPEG-encoded, resized)
+  python tools/im2rec.py --resize 256 --quality 95 --num-thread 8 \
+      myprefix path/to/images
+
+The .rec produced feeds ImageRecordIter / ImageRecordDataset directly.
+"""
+import argparse
+import multiprocessing
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) with one label per leaf directory."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as f:
+        for item in image_list:
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            f.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    chunk_size = (n + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = ".%d" % i if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep + sep_test:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep + sep_test])
+
+
+def read_list(path_in):
+    """Yield (index, path, label...) tuples from a .lst file."""
+    with open(path_in) as f:
+        for line_i, line in enumerate(f):
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                print("lst should have at least 3 parts, skip line %d"
+                      % line_i)
+                continue
+            try:
+                yield (int(line[0]),) + tuple(float(x) for x in line[1:-1]) \
+                    + (line[-1],)
+            except ValueError:
+                print("parsing lst met error for line %d: %s"
+                      % (line_i, line))
+
+
+def image_encode(args, i, item, q_out):
+    import cv2
+    import numpy as np
+    from mxnet_tpu import recordio
+    fullpath = os.path.join(args.root, item[-1])
+
+    if len(item) > 3 and args.pack_label:
+        header = recordio.IRHeader(0, np.asarray(item[1:-1], np.float32),
+                                   item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[1], item[0], 0)
+
+    if args.pass_through:
+        try:
+            with open(fullpath, "rb") as fin:
+                img = fin.read()
+            q_out.put((i, recordio.pack(header, img), item))
+        except Exception as e:
+            q_out.put((i, None, item))
+            print("pack_img error:", item[-1], e)
+        return
+
+    img = cv2.imread(fullpath, args.color)
+    if img is None:
+        print("imread read blank (None) image for file:", fullpath)
+        q_out.put((i, None, item))
+        return
+    if args.center_crop:
+        if img.shape[0] > img.shape[1]:
+            margin = (img.shape[0] - img.shape[1]) // 2
+            img = img[margin:margin + img.shape[1], :]
+        else:
+            margin = (img.shape[1] - img.shape[0]) // 2
+            img = img[:, margin:margin + img.shape[0]]
+    if args.resize:
+        if img.shape[0] > img.shape[1]:
+            newsize = (args.resize,
+                       img.shape[0] * args.resize // img.shape[1])
+        else:
+            newsize = (img.shape[1] * args.resize // img.shape[0],
+                       args.resize)
+        img = cv2.resize(img, newsize)
+    try:
+        s = recordio.pack_img(header, img, quality=args.quality,
+                              img_fmt=args.encoding)
+    except Exception as e:
+        print("pack_img failed:", fullpath, e)
+        q_out.put((i, None, item))
+        return
+    q_out.put((i, s, item))
+
+
+def read_worker(args, q_in, q_out):
+    while True:
+        deq = q_in.get()
+        if deq is None:
+            break
+        i, item = deq
+        image_encode(args, i, item, q_out)
+
+
+def write_worker(q_out, fname, working_dir):
+    from mxnet_tpu import recordio
+    pre_time = time.time()
+    count = 0
+    fname = os.path.basename(fname)
+    fname_rec = os.path.splitext(fname)[0] + ".rec"
+    fname_idx = os.path.splitext(fname)[0] + ".idx"
+    record = recordio.MXIndexedRecordIO(
+        os.path.join(working_dir, fname_idx),
+        os.path.join(working_dir, fname_rec), "w")
+    buf = {}
+    more = True
+    while more:
+        deq = q_out.get()
+        if deq is not None:
+            i, s, item = deq
+            buf[i] = (s, item)
+        else:
+            more = False
+        while count in buf:
+            s, item = buf[count]
+            del buf[count]
+            if s is not None:
+                record.write_idx(item[0], s)
+            if count % 1000 == 0:
+                cur_time = time.time()
+                print("time:", cur_time - pre_time, " count:", count)
+                pre_time = cur_time
+            count += 1
+    record.close()
+
+
+def pack(args, fname):
+    q_in = [multiprocessing.Queue(1024) for _ in range(args.num_thread)]
+    q_out = multiprocessing.Queue(1024)
+    read_processes = [
+        multiprocessing.Process(target=read_worker,
+                                args=(args, q_in[i], q_out))
+        for i in range(args.num_thread)]
+    for p in read_processes:
+        p.start()
+    write_process = multiprocessing.Process(
+        target=write_worker, args=(q_out, fname, args.working_dir))
+    write_process.start()
+    for i, item in enumerate(read_list(fname)):
+        q_in[i % len(q_in)].put((i, item))
+    for q in q_in:
+        q.put(None)
+    for p in read_processes:
+        p.join()
+    q_out.put(None)
+    write_process.join()
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create an image list and/or RecordIO database")
+    parser.add_argument("prefix",
+                        help="prefix of input/output lst and rec files")
+    parser.add_argument("root", help="path to folder containing images")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true",
+                        help="make a list instead of a record database")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true",
+                        help="one label per leaf folder")
+    cgroup.add_argument("--no-shuffle", dest="shuffle",
+                        action="store_false")
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack original bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--color", type=int, default=1,
+                        choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--pack-label", action="store_true")
+    args = parser.parse_args(argv)
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+    return args
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+        return
+    args.working_dir = os.path.dirname(args.prefix)
+    files = [os.path.join(args.working_dir, f)
+             for f in os.listdir(args.working_dir)
+             if os.path.isfile(os.path.join(args.working_dir, f))]
+    count = 0
+    for fname in files:
+        if fname.startswith(args.prefix) and fname.endswith(".lst"):
+            print("Creating .rec file from", fname, "in", args.working_dir)
+            count += 1
+            pack(args, fname)
+    if not count:
+        print("Did not find and list file with prefix %s" % args.prefix)
+
+
+if __name__ == "__main__":
+    main()
