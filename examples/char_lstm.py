@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Character LSTM with the symbolic mx.rnn package + BucketingModule
+(reference example/rnn/bucketing workflow), on a built-in corpus so it
+runs anywhere."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--device" in sys.argv:
+    _dev = sys.argv[sys.argv.index("--device") + 1]
+    if _dev == "cpu":  # must run before any jax backend use
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+import logging
+logging.basicConfig(level=logging.INFO)
+
+import numpy as np
+import mxnet_tpu as mx
+
+CORPUS = ("the quick brown fox jumps over the lazy dog. "
+          "pack my box with five dozen liquor jugs. ") * 40
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "cpu"])
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    args = ap.parse_args()
+
+    vocab = {c: i + 1 for i, c in enumerate(sorted(set(CORPUS)))}
+    sentences = []
+    step = 24
+    ids = [vocab[c] for c in CORPUS]
+    for i in range(0, len(ids) - step, step):
+        sentences.append(ids[i:i + step + 1])
+    # input = chars[:-1], label = chars[1:]
+    data = [s[:-1] for s in sentences]
+    labels = [s[1:] for s in sentences]
+    buckets = [12, 24]
+    train = mx.rnn.BucketSentenceIter(data, args.batch_size, buckets=buckets,
+                                      invalid_label=0)
+    lab_iter = mx.rnn.BucketSentenceIter(labels, args.batch_size,
+                                         buckets=buckets, invalid_label=0)
+
+    n_vocab = len(vocab) + 1
+
+    def sym_gen(seq_len):
+        data_s = mx.sym.Variable("data")
+        label_s = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data_s, input_dim=n_vocab, output_dim=32,
+                                 name="embed")
+        cell = mx.rnn.LSTMCell(args.num_hidden, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=n_vocab, name="pred")
+        label = mx.sym.Reshape(label_s, shape=(-1,))
+        return (mx.sym.SoftmaxOutput(pred, label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    # pair data/label buckets manually: reuse BucketSentenceIter data with
+    # shifted labels via a tiny adapter
+    class PairIter(mx.io.DataIter):
+        def __init__(self, d_it, l_it):
+            super().__init__(d_it.batch_size)
+            self.d_it, self.l_it = d_it, l_it
+            self.provide_data = d_it.provide_data
+            self.provide_label = [("softmax_label",
+                                   d_it.provide_data[0][1])]
+            self.default_bucket_key = d_it.default_bucket_key
+
+        def reset(self):
+            self.d_it.reset(); self.l_it.reset()
+
+        def __iter__(self):
+            for db, lb in zip(self.d_it, self.l_it):
+                db.label = db.data  # fallback
+                yield mx.io.DataBatch(
+                    data=db.data, label=lb.data,
+                    bucket_key=db.bucket_key,
+                    provide_data=[("data", db.data[0].shape)],
+                    provide_label=[("softmax_label", lb.data[0].shape)])
+
+    train.reset(); lab_iter.reset()
+    it = PairIter(train, lab_iter)
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            initializer=mx.initializer.Xavier(),
+            eval_metric=mx.metric.Perplexity(ignore_label=None))
+    it.reset()
+    print("final:", mod.score(it, mx.metric.Perplexity(ignore_label=None)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Gluon imperative -> hybridized CNN training (reference
+example/gluon/mnist.py workflow), on synthetic image data so it runs
+anywhere. Shows autograd.record + Trainer, then hybridize for the
+compiled fast path."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--device" in sys.argv:
+    _dev = sys.argv[sys.argv.index("--device") + 1]
+    if _dev == "cpu":  # must run before any jax backend use
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+
+
+def net_fn():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, kernel_size=3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(32, kernel_size=3, padding=1, activation="relu"),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "cpu"])
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--no-hybridize", action="store_true")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    n = 1024
+    y = rng.randint(0, 10, n)
+    X = rng.rand(n, 1, 16, 16).astype(np.float32) * 0.1
+    for i in range(n):  # class-dependent mean intensity (GAP-friendly)
+        X[i] += (int(y[i]) + 1) * 0.25
+    ds = gluon.data.ArrayDataset(X, y.astype(np.float32))
+    loader = gluon.data.DataLoader(ds, batch_size=args.batch_size,
+                                   shuffle=True)
+
+    net = net_fn()
+    net.initialize(mx.initializer.Xavier())
+    if not args.no_hybridize:
+        net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+
+    for epoch in range(args.num_epochs):
+        total = correct = 0
+        cum_loss = 0.0
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            cum_loss += float(loss.asnumpy().sum())
+            correct += int((out.asnumpy().argmax(1)
+                            == label.asnumpy()).sum())
+            total += len(label)
+        print("epoch %d: loss %.4f acc %.3f"
+              % (epoch, cum_loss / total, correct / total))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""MNIST with the Module API (reference example/image-classification/
+train_mnist.py workflow). Uses mx.io.MNISTIter when the idx files are
+present (--data-dir), otherwise a synthetic stand-in so the script runs
+anywhere."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--device" in sys.argv:
+    _dev = sys.argv[sys.argv.index("--device") + 1]
+    if _dev == "cpu":  # must run before any jax backend use
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+import logging
+logging.basicConfig(level=logging.INFO)
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def get_iters(args):
+    img = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(img):
+        train = mx.io.MNISTIter(
+            image=img,
+            label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size)
+        return train, val
+    print("no MNIST at %s - using a synthetic stand-in" % args.data_dir)
+    rng = np.random.RandomState(0)
+    n = 2048
+    y = rng.randint(0, 10, n).astype(np.float32)
+    X = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i in range(n):  # class-dependent blob so the task is learnable
+        c = int(y[i])
+        X[i, 0, 2 * c:2 * c + 6, 4:24] += 0.8
+    cut = n - 512
+    return (mx.io.NDArrayIter(X[:cut], y[:cut], args.batch_size, shuffle=True),
+            mx.io.NDArrayIter(X[cut:], y[cut:], args.batch_size))
+
+
+def mlp_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "cpu"])
+    ap.add_argument("--data-dir", default="data/mnist")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local",
+                    help="'tpu_sync' fuses the whole step on TPU")
+    args = ap.parse_args()
+
+    train, val = get_iters(args)
+    mod = mx.mod.Module(mlp_symbol())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    val.reset()
+    print("final validation:", mod.score(val, "acc"))
+
+
+if __name__ == "__main__":
+    main()

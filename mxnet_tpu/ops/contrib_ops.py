@@ -395,6 +395,7 @@ def getnnz(data, *, axis=None):
     included) — that semantics needs storage metadata, so it lives on the
     sparse-aware eager wrapper ``mx.nd.contrib.getnnz``; this registry op
     is its dense fallback."""
+    from ..base import index_dtype
     if axis is None:
-        return jnp.sum(data != 0).astype(jnp.int64)
-    return jnp.sum(data != 0, axis=axis).astype(jnp.int64)
+        return jnp.sum(data != 0).astype(index_dtype())
+    return jnp.sum(data != 0, axis=axis).astype(index_dtype())

@@ -1,0 +1,34 @@
+"""Smoke tests: every example script must run end to end on CPU."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, args):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # examples don't need the 8-device mesh
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", script)] + args
+        + ["--device", "cpu"],
+        capture_output=True, text=True, timeout=480, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    return r
+
+
+def test_train_mnist_example():
+    r = _run("train_mnist.py", ["--num-epochs", "2"])
+    assert "final validation" in r.stdout
+
+
+def test_gluon_cnn_example():
+    r = _run("gluon_cnn.py", ["--num-epochs", "1"])
+    assert "epoch 0" in r.stdout
+
+
+def test_char_lstm_example():
+    r = _run("char_lstm.py", ["--num-epochs", "1"])
+    assert "final" in r.stdout

@@ -256,7 +256,11 @@ def main(argv=None):
     if args.list:
         make_list(args)
         return
-    args.working_dir = os.path.dirname(args.prefix)
+    # a directory prefix means "pack every .lst inside it"
+    if os.path.isdir(args.prefix):
+        args.working_dir = args.prefix
+    else:
+        args.working_dir = os.path.dirname(args.prefix)
     files = [os.path.join(args.working_dir, f)
              for f in os.listdir(args.working_dir)
              if os.path.isfile(os.path.join(args.working_dir, f))]
