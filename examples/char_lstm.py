@@ -8,11 +8,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if "--device" in sys.argv:
-    _dev = sys.argv[sys.argv.index("--device") + 1]
-    if _dev == "cpu":  # must run before any jax backend use
+def _maybe_force_cpu(argv):
+    """Honor --device cpu / --device=cpu BEFORE any jax backend use."""
+    if "--device=cpu" in argv or             ("--device" in argv
+             and argv[argv.index("--device") + 1:argv.index("--device") + 2]
+             == ["cpu"]):
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+
+_maybe_force_cpu(sys.argv)
 
 import logging
 logging.basicConfig(level=logging.INFO)
@@ -39,14 +44,10 @@ def main():
     ids = [vocab[c] for c in CORPUS]
     for i in range(0, len(ids) - step, step):
         sentences.append(ids[i:i + step + 1])
-    # input = chars[:-1], label = chars[1:]
-    data = [s[:-1] for s in sentences]
-    labels = [s[1:] for s in sentences]
-    buckets = [12, 24]
-    train = mx.rnn.BucketSentenceIter(data, args.batch_size, buckets=buckets,
-                                      invalid_label=0)
-    lab_iter = mx.rnn.BucketSentenceIter(labels, args.batch_size,
-                                         buckets=buckets, invalid_label=0)
+    buckets = [13, 25]
+    # BucketSentenceIter emits next-token-shifted labels itself
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=buckets, invalid_label=0)
 
     n_vocab = len(vocab) + 1
 
@@ -64,31 +65,7 @@ def main():
         return (mx.sym.SoftmaxOutput(pred, label, name="softmax"),
                 ("data",), ("softmax_label",))
 
-    # pair data/label buckets manually: reuse BucketSentenceIter data with
-    # shifted labels via a tiny adapter
-    class PairIter(mx.io.DataIter):
-        def __init__(self, d_it, l_it):
-            super().__init__(d_it.batch_size)
-            self.d_it, self.l_it = d_it, l_it
-            self.provide_data = d_it.provide_data
-            self.provide_label = [("softmax_label",
-                                   d_it.provide_data[0][1])]
-            self.default_bucket_key = d_it.default_bucket_key
-
-        def reset(self):
-            self.d_it.reset(); self.l_it.reset()
-
-        def __iter__(self):
-            for db, lb in zip(self.d_it, self.l_it):
-                db.label = db.data  # fallback
-                yield mx.io.DataBatch(
-                    data=db.data, label=lb.data,
-                    bucket_key=db.bucket_key,
-                    provide_data=[("data", db.data[0].shape)],
-                    provide_label=[("softmax_label", lb.data[0].shape)])
-
-    train.reset(); lab_iter.reset()
-    it = PairIter(train, lab_iter)
+    it = train
     mod = mx.mod.BucketingModule(sym_gen,
                                  default_bucket_key=it.default_bucket_key)
     mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",

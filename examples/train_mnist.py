@@ -9,11 +9,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if "--device" in sys.argv:
-    _dev = sys.argv[sys.argv.index("--device") + 1]
-    if _dev == "cpu":  # must run before any jax backend use
+def _maybe_force_cpu(argv):
+    """Honor --device cpu / --device=cpu BEFORE any jax backend use."""
+    if "--device=cpu" in argv or             ("--device" in argv
+             and argv[argv.index("--device") + 1:argv.index("--device") + 2]
+             == ["cpu"]):
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+
+_maybe_force_cpu(sys.argv)
 
 import logging
 logging.basicConfig(level=logging.INFO)

@@ -100,7 +100,11 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
     pad_ = _tuplize(pad, n)
     adj_ = _tuplize(adj, n)
     # Transposed convolution == gradient of convolution wrt its input.
-    # weight layout (reference): (in_channels, num_filter//num_group, *kernel)
+    # conv_general_dilated computes CORRELATION, so the kernel must be
+    # spatially flipped to realize the transpose (caught by torch
+    # conv_transpose2d parity); weight layout (reference):
+    # (in_channels, num_filter//num_group, *kernel)
+    weight = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
     spatial = data.shape[2:]
     out_spatial = tuple(
         (spatial[i] - 1) * stride[i] - 2 * pad_[i]
