@@ -1,0 +1,12 @@
+"""Shared example bootstrap: honor --device cpu / --device=cpu BEFORE any
+jax backend use (the env var is overridden by sitecustomize; only
+jax.config works)."""
+import sys
+
+
+def maybe_force_cpu(argv=None):
+    argv = sys.argv if argv is None else argv
+    i = argv.index("--device") if "--device" in argv else -1
+    if "--device=cpu" in argv or (i >= 0 and argv[i + 1:i + 2] == ["cpu"]):
+        import jax
+        jax.config.update("jax_platforms", "cpu")

@@ -8,16 +8,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def _maybe_force_cpu(argv):
-    """Honor --device cpu / --device=cpu BEFORE any jax backend use."""
-    if "--device=cpu" in argv or             ("--device" in argv
-             and argv[argv.index("--device") + 1:argv.index("--device") + 2]
-             == ["cpu"]):
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-
-
-_maybe_force_cpu(sys.argv)
+from _common import maybe_force_cpu  # noqa: E402
+maybe_force_cpu()
 
 import logging
 logging.basicConfig(level=logging.INFO)
@@ -40,10 +32,13 @@ def main():
 
     vocab = {c: i + 1 for i, c in enumerate(sorted(set(CORPUS)))}
     sentences = []
-    step = 24
     ids = [vocab[c] for c in CORPUS]
-    for i in range(0, len(ids) - step, step):
+    i = 0
+    for j, step in enumerate([24, 12] * (len(ids) // 36 + 1)):
+        if i + step + 1 > len(ids):
+            break
         sentences.append(ids[i:i + step + 1])
+        i += step
     buckets = [13, 25]
     # BucketSentenceIter emits next-token-shifted labels itself
     train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
@@ -62,7 +57,10 @@ def main():
         pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
         pred = mx.sym.FullyConnected(pred, num_hidden=n_vocab, name="pred")
         label = mx.sym.Reshape(label_s, shape=(-1,))
-        return (mx.sym.SoftmaxOutput(pred, label, name="softmax"),
+        # label 0 marks bucket padding (invalid_label): excluded from the
+        # loss and the metric
+        return (mx.sym.SoftmaxOutput(pred, label, name="softmax",
+                                     use_ignore=True, ignore_label=0),
                 ("data",), ("softmax_label",))
 
     it = train
@@ -71,9 +69,9 @@ def main():
     mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
             optimizer_params={"learning_rate": 3e-3},
             initializer=mx.initializer.Xavier(),
-            eval_metric=mx.metric.Perplexity(ignore_label=None))
+            eval_metric=mx.metric.Perplexity(ignore_label=0))
     it.reset()
-    print("final:", mod.score(it, mx.metric.Perplexity(ignore_label=None)))
+    print("final:", mod.score(it, mx.metric.Perplexity(ignore_label=0)))
 
 
 if __name__ == "__main__":

@@ -9,16 +9,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def _maybe_force_cpu(argv):
-    """Honor --device cpu / --device=cpu BEFORE any jax backend use."""
-    if "--device=cpu" in argv or             ("--device" in argv
-             and argv[argv.index("--device") + 1:argv.index("--device") + 2]
-             == ["cpu"]):
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-
-
-_maybe_force_cpu(sys.argv)
+from _common import maybe_force_cpu  # noqa: E402
+maybe_force_cpu()
 
 import numpy as np
 import mxnet_tpu as mx

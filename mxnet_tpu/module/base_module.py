@@ -157,14 +157,17 @@ class BaseModule:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                # metric BEFORE prefetch/prepare (reference base_module.py
+                # :528-545): prepare() may switch the bucketing module to
+                # the NEXT batch's bucket, whose executor has no outputs yet
+                if eval_metric is not None:
+                    self.update_metric(eval_metric, data_batch.label)
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch,
                                  sparse_row_id_fn=sparse_row_id_fn)
                 except StopIteration:
                     end_of_batch = True
-                if eval_metric is not None:
-                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

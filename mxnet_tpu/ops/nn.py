@@ -111,7 +111,19 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
         + dilate[i] * (kernel[i] - 1) + 1 + adj_[i]
         for i in range(n))
     if target_shape:
-        out_spatial = tuple(target_shape)
+        # reference DeconvolutionParam::InferPad: target_shape overrides
+        # pad/adj; realize it by solving the trailing pad so the dilated
+        # conv emits exactly target dims (extra rows land at the end)
+        target = tuple(int(t) for t in target_shape)
+        adj_ = tuple(
+            t - ((spatial[i] - 1) * stride[i] - 2 * pad_[i]
+                 + dilate[i] * (kernel[i] - 1) + 1)
+            for i, t in enumerate(target))
+        if any(a < 0 for a in adj_):
+            raise ValueError(
+                "Deconvolution target_shape %s smaller than the natural "
+                "output %s; increase pad" % (target, out_spatial))
+        out_spatial = target
     # lax.conv_transpose with flipped kernel reproduces gradient-of-conv.
     if n == 2:
         dn = lax.conv_dimension_numbers(

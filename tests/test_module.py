@@ -134,3 +134,58 @@ def test_bucketing_module():
         mod.backward()
         mod.update()
     assert len(mod._buckets) == 2
+
+
+def test_bucketing_fit_metric_with_multiple_live_buckets():
+    """Regression: fit must update the metric BEFORE prepare() switches
+    the bucketing module to the next batch's bucket (reference
+    base_module.py:528-545 ordering) — with two live buckets the old
+    order read a freshly-bound executor with no outputs."""
+    rng = np.random.RandomState(0)
+
+    def sym_gen(seq_len):
+        # parameters must be bucket-independent (shared across buckets)
+        data = sym.Variable("data")
+        net = sym.Embedding(data, input_dim=16, output_dim=8, name="embed")
+        net = sym.mean(net, axis=1)
+        net = sym.FullyConnected(net, num_hidden=2, name="fc")
+        return (sym.SoftmaxOutput(net, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    class TwoBucketIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(8)
+            self.default_bucket_key = 16
+            self.provide_data = [("data", (8, 16))]
+            self.provide_label = [("softmax_label", (8,))]
+            self._i = 0
+
+        def reset(self):
+            self._i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self._i >= 4:
+                raise StopIteration
+            key = 16 if self._i % 2 == 0 else 10
+            self._i += 1
+            X = rng.randint(0, 16, (8, key)).astype(np.float32)
+            y = (X[:, 0] > 8).astype(np.float32)
+            return mx.io.DataBatch(
+                data=[mx.nd.array(X)], label=[mx.nd.array(y)],
+                bucket_key=key,
+                provide_data=[("data", (8, key))],
+                provide_label=[("softmax_label", (8,))])
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16)
+    metric_values = []
+
+    def cb(param):
+        metric_values.append(param.eval_metric.get()[1])
+
+    mod.fit(TwoBucketIter(), num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, eval_metric="acc",
+            batch_end_callback=cb)
+    assert metric_values and all(0.0 <= v <= 1.0 for v in metric_values)

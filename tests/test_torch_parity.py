@@ -176,3 +176,131 @@ def test_lstm_fused_parity():
                     state_size=H, num_layers=1, mode="lstm")
     np.testing.assert_allclose(out.asnumpy(), ref_out.numpy(), rtol=1e-4,
                                atol=1e-4)
+
+
+def test_conv1d_conv3d_parity():
+    rng = np.random.RandomState(8)
+    x1 = rng.randn(2, 4, 20).astype(np.float32)
+    w1 = rng.randn(6, 4, 5).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x1), mx.nd.array(w1), kernel=(5,),
+                            num_filter=6, stride=(2,), pad=(2,),
+                            no_bias=True)
+    ref = torch.nn.functional.conv1d(_t(x1), _t(w1), stride=2, padding=2)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+    x3 = rng.randn(1, 2, 6, 6, 6).astype(np.float32)
+    w3 = rng.randn(3, 2, 3, 3, 3).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x3), mx.nd.array(w3),
+                            kernel=(3, 3, 3), num_filter=3, pad=(1, 1, 1),
+                            no_bias=True)
+    ref = torch.nn.functional.conv3d(_t(x3), _t(w3), padding=1)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_gru_fused_parity():
+    rng = np.random.RandomState(9)
+    T, N, I, H = 5, 2, 4, 3
+    x = rng.randn(T, N, I).astype(np.float32)
+    tg = torch.nn.GRU(I, H, num_layers=1)
+    with torch.no_grad():
+        ref_out, _ = tg(_t(x))
+    params = np.concatenate([
+        tg.weight_ih_l0.detach().numpy().ravel(),
+        tg.weight_hh_l0.detach().numpy().ravel(),
+        tg.bias_ih_l0.detach().numpy(),
+        tg.bias_hh_l0.detach().numpy()])
+    init_h = np.zeros((1, N, H), np.float32)
+    out = mx.nd.RNN(mx.nd.array(x), mx.nd.array(params),
+                    mx.nd.array(init_h), state_size=H, num_layers=1,
+                    mode="gru")
+    np.testing.assert_allclose(out.asnumpy(), ref_out.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_bilinear_sampler_parity_with_grid_sample():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    # normalized grid in [-1, 1], shape (N, 2, Ho, Wo) with (x, y) rows
+    gx = rng.uniform(-1, 1, (2, 6, 6)).astype(np.float32)
+    gy = rng.uniform(-1, 1, (2, 6, 6)).astype(np.float32)
+    grid = np.stack([gx, gy], axis=1)
+    out = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(grid))
+    tgrid = torch.from_numpy(np.stack([gx, gy], axis=-1))  # (N,Ho,Wo,2)
+    ref = torch.nn.functional.grid_sample(
+        _t(x), tgrid, mode="bilinear", padding_mode="zeros",
+        align_corners=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_instance_and_layer_norm_parity():
+    rng = np.random.RandomState(11)
+    x = rng.randn(3, 4, 5, 5).astype(np.float32)
+    g = rng.rand(4).astype(np.float32) + 0.5
+    b = rng.randn(4).astype(np.float32)
+    out = mx.nd.InstanceNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                             eps=1e-5)
+    ref = torch.nn.functional.instance_norm(_t(x), weight=_t(g), bias=_t(b),
+                                            eps=1e-5)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+    x2 = rng.randn(6, 10).astype(np.float32)
+    g2 = rng.rand(10).astype(np.float32) + 0.5
+    b2 = rng.randn(10).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x2), mx.nd.array(g2), mx.nd.array(b2),
+                          axis=-1, eps=1e-5)
+    ref = torch.nn.functional.layer_norm(_t(x2), (10,), _t(g2), _t(b2),
+                                         eps=1e-5)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_activations_parity():
+    rng = np.random.RandomState(12)
+    x = (rng.randn(4, 7) * 2).astype(np.float32)
+    pairs = [
+        (mx.nd.Activation(mx.nd.array(x), act_type="relu"),
+         torch.relu(_t(x))),
+        (mx.nd.Activation(mx.nd.array(x), act_type="sigmoid"),
+         torch.sigmoid(_t(x))),
+        (mx.nd.Activation(mx.nd.array(x), act_type="tanh"),
+         torch.tanh(_t(x))),
+        (mx.nd.Activation(mx.nd.array(x), act_type="softrelu"),
+         torch.nn.functional.softplus(_t(x))),
+        (mx.nd.LeakyReLU(mx.nd.array(x), act_type="leaky", slope=0.1),
+         torch.nn.functional.leaky_relu(_t(x), 0.1)),
+        (mx.nd.LeakyReLU(mx.nd.array(x), act_type="elu", slope=1.0),
+         torch.nn.functional.elu(_t(x))),
+    ]
+    for out, ref in pairs:
+        np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_embedding_and_take_parity():
+    rng = np.random.RandomState(13)
+    table = rng.randn(20, 6).astype(np.float32)
+    idx = rng.randint(0, 20, (4, 7)).astype(np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(table),
+                          input_dim=20, output_dim=6)
+    ref = torch.nn.functional.embedding(
+        torch.from_numpy(idx.astype(np.int64)), _t(table))
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-6)
+
+
+def test_deconv_target_shape():
+    rng = np.random.RandomState(14)
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                              num_filter=2, stride=(2, 2), no_bias=True,
+                              target_shape=(12, 12))
+    assert out.shape == (1, 2, 12, 12)
+    # natural output is 11x11 = conv_transpose output_padding=1
+    ref = torch.nn.functional.conv_transpose2d(_t(x), _t(w), stride=2,
+                                               output_padding=1)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
