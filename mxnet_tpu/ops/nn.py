@@ -111,18 +111,20 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
         + dilate[i] * (kernel[i] - 1) + 1 + adj_[i]
         for i in range(n))
     if target_shape:
-        # reference DeconvolutionParam::InferPad: target_shape overrides
-        # pad/adj; realize it by solving the trailing pad so the dilated
-        # conv emits exactly target dims (extra rows land at the end)
+        # reference DeconvolutionParam::InferPad (deconvolution-inl.h:121):
+        # target_shape REPLACES user pad/adj — total = stride*(in-1) +
+        # dilated_ksize - target, adj = total % 2, pad = (total+1)//2
         target = tuple(int(t) for t in target_shape)
-        adj_ = tuple(
-            t - ((spatial[i] - 1) * stride[i] - 2 * pad_[i]
-                 + dilate[i] * (kernel[i] - 1) + 1)
-            for i, t in enumerate(target))
-        if any(a < 0 for a in adj_):
-            raise ValueError(
-                "Deconvolution target_shape %s smaller than the natural "
-                "output %s; increase pad" % (target, out_spatial))
+        dksize = tuple(dilate[i] * (kernel[i] - 1) + 1 for i in range(n))
+        total = tuple(stride[i] * (spatial[i] - 1) + dksize[i] - target[i]
+                      for i in range(n))
+        if any(t < 0 for t in total):
+            raise ValueError("too big target shape %s (natural zero-pad "
+                             "output is %s)" % (target, tuple(
+                                 stride[i] * (spatial[i] - 1) + dksize[i]
+                                 for i in range(n))))
+        adj_ = tuple(t % 2 for t in total)
+        pad_ = tuple((t + 1) // 2 for t in total)
         out_spatial = target
     # lax.conv_transpose with flipped kernel reproduces gradient-of-conv.
     if n == 2:

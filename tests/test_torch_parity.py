@@ -295,12 +295,40 @@ def test_deconv_target_shape():
     rng = np.random.RandomState(14)
     x = rng.randn(1, 3, 5, 5).astype(np.float32)
     w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    # target == zero-pad natural output (total=0 -> pad=0, adj=0)
     out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
                               num_filter=2, stride=(2, 2), no_bias=True,
-                              target_shape=(12, 12))
-    assert out.shape == (1, 2, 12, 12)
-    # natural output is 11x11 = conv_transpose output_padding=1
-    ref = torch.nn.functional.conv_transpose2d(_t(x), _t(w), stride=2,
-                                               output_padding=1)
+                              target_shape=(11, 11))
+    assert out.shape == (1, 2, 11, 11)
+    ref = torch.nn.functional.conv_transpose2d(_t(x), _t(w), stride=2)
     np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
                                atol=1e-4)
+    # targets past the zero-pad natural size are rejected, like the
+    # reference InferPad CHECK ("too big target shape")
+    with pytest.raises(ValueError, match="too big target shape"):
+        mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=2, stride=(2, 2), no_bias=True,
+                            target_shape=(12, 12))
+
+
+def test_deconv_target_shape_smaller_than_natural():
+    """Reference InferPad: target_shape REPLACES user pad — pad/adj are
+    computed so targets below the zero-pad natural size work too."""
+    rng = np.random.RandomState(15)
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                              num_filter=2, stride=(2, 2), no_bias=True,
+                              target_shape=(9, 9))
+    assert out.shape == (1, 2, 9, 9)
+    # total=2 -> pad=1, adj=0: equals torch conv_transpose2d(padding=1)
+    ref = torch.nn.functional.conv_transpose2d(_t(x), _t(w), stride=2,
+                                               padding=1)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    # user pad is ignored when target_shape is given (reference semantics)
+    out2 = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w),
+                               kernel=(3, 3), num_filter=2, stride=(2, 2),
+                               pad=(2, 2), no_bias=True,
+                               target_shape=(9, 9))
+    np.testing.assert_allclose(out2.asnumpy(), out.asnumpy(), rtol=1e-6)
