@@ -106,7 +106,10 @@ class _OneBatchIter:
 
 
 def main():
-    probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
+    # generous default: the tunnel can take minutes to come up after idle
+    # (observed this round); falling back to CPU on a slow-but-alive TPU
+    # would record a misleading number
+    probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "540"))
     want_cpu = os.environ.get("BENCH_PLATFORM", "") == "cpu"
     on_tpu = (not want_cpu) and probe_tpu(probe_timeout)
 

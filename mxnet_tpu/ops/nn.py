@@ -110,7 +110,8 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
         (spatial[i] - 1) * stride[i] - 2 * pad_[i]
         + dilate[i] * (kernel[i] - 1) + 1 + adj_[i]
         for i in range(n))
-    if target_shape:
+    if target_shape and any(int(t) > 0 for t in target_shape):
+        # all-zero target_shape means UNSET (reference bCal guard)
         # reference DeconvolutionParam::InferPad (deconvolution-inl.h:121):
         # target_shape REPLACES user pad/adj — total = stride*(in-1) +
         # dilated_ksize - target, adj = total % 2, pad = (total+1)//2

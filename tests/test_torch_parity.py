@@ -332,3 +332,15 @@ def test_deconv_target_shape_smaller_than_natural():
                                pad=(2, 2), no_bias=True,
                                target_shape=(9, 9))
     np.testing.assert_allclose(out2.asnumpy(), out.asnumpy(), rtol=1e-6)
+
+
+def test_deconv_zero_target_shape_means_unset():
+    rng = np.random.RandomState(16)
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    a = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=2, stride=(2, 2), no_bias=True,
+                            target_shape=(0, 0))
+    b = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=2, stride=(2, 2), no_bias=True)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
