@@ -27,6 +27,7 @@ if _os.environ.get("MXNET_COORDINATOR_ADDRESS") \
     _dist.init(strict=False)
 
 from .base import MXNetError
+from .attribute import AttrScope
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import engine
 from . import random
@@ -63,6 +64,8 @@ def __getattr__(name):
         "kv": ".kvstore",
         "monitor": ".monitor",
         "operator": ".operator",
+        "name": ".name",
+        "attribute": ".attribute",
         "rnn": ".rnn",
         "model": ".model",
         "subgraph": ".subgraph",

@@ -1,0 +1,46 @@
+"""Attribute scoping (parity: python/mxnet/attribute.py AttrScope :27).
+
+``with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):`` attaches the
+given attributes to every symbol node created inside the scope (user
+attrs win on conflict). The symbolic layer merges the active scope in
+``invoke_sym``/``Variable``."""
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_current = threading.local()
+
+
+class AttrScope:
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("Attributes need to be string")
+        self._attr = kwargs
+        self._old = None
+
+    def get(self, attr=None):
+        """Merge scope attrs under the user-specified ``attr`` dict."""
+        if not self._attr:
+            return attr if attr else {}
+        ret = self._attr.copy()
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        self._old = current()
+        # nested scopes stack: inner scope sees outer attrs too
+        merged = AttrScope()
+        merged._attr = {**self._old._attr, **self._attr}
+        _current.value = merged
+        return self
+
+    def __exit__(self, *exc):
+        _current.value = self._old
+
+
+def current():
+    if not hasattr(_current, "value"):
+        _current.value = AttrScope()
+    return _current.value

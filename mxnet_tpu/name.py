@@ -1,0 +1,55 @@
+"""Automatic symbol naming (parity: python/mxnet/name.py — NameManager
+:25, Prefix :93).
+
+``with mx.name.Prefix("layer1_"):`` prefixes every auto-generated symbol
+name created in scope; a plain ``NameManager`` gives a fresh counter
+namespace. The symbolic layer's auto-namer consults the active manager
+(symbol/symbol.py _auto_name)."""
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_current = threading.local()
+
+
+class NameManager:
+    """Thread-scoped auto-namer: ``get(name, hint)`` returns the user
+    name unchanged, else ``hint%d`` with a per-hint counter."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        c = self._counter.get(hint, 0)
+        self._counter[hint] = c + 1
+        return "%s%d" % (hint, c)
+
+    def __enter__(self):
+        self._old = current()
+        _current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        _current.value = self._old
+
+
+class Prefix(NameManager):
+    """Auto-names get a fixed prefix inside the scope."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current():
+    """The active manager (a default one is created per thread)."""
+    if not hasattr(_current, "value"):
+        _current.value = NameManager()
+    return _current.value

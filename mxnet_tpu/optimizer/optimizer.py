@@ -90,11 +90,15 @@ class Optimizer:
         self.num_update = begin_num_update
         self._index_update_count = {}
         self.idx2name = dict(param_idx2name or {})
-        self.sym_info = None
+        # (attr_dict, arg_names): lets Variable(lr_mult=...) / AttrScope
+        # __lr_mult__/__wd_mult__ attrs reach the update rule (reference
+        # optimizer.py sym_info)
+        self.sym_info = ((sym.attr_dict(), sym.list_arguments())
+                         if sym is not None else None)
         self.param_dict = param_dict or {}
         self.multi_precision = multi_precision
-        self.lr_mult = {}
-        self.wd_mult = {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
 
     # -- state ---------------------------------------------------------------
     def create_state(self, index, weight):
@@ -113,10 +117,30 @@ class Optimizer:
         self.lr = lr
 
     def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = dict(args_lr_mult)
+        """Per-param lr multipliers; symbol ``__lr_mult__`` attrs seed the
+        defaults (reference optimizer.py set_lr_mult)."""
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = dict(args_wd_mult)
+        """Per-param wd multipliers. Reference defaults: params whose name
+        does not end in ``_weight``/``_gamma`` (biases, betas) get wd 0;
+        symbol ``__wd_mult__`` attrs override."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
         if index not in self._index_update_count:

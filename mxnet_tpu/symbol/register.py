@@ -12,8 +12,8 @@ def _make_op_func(op):
     variadic = len(op.input_names) == 0  # ops taking *data (Concat, stack)
 
     def fn(*args, name=None, **kwargs):
-        node_name = name or _symbol._auto_name(
-            op.name.lower().lstrip("_") + "_")
+        node_name = _symbol._auto_name(
+            op.name.lower().lstrip("_") + "_", name)
         if variadic:
             inputs = [a for a in args if isinstance(a, _symbol.Symbol)]
             sym_kwargs = [(k, v) for k, v in list(kwargs.items())

@@ -13,7 +13,6 @@ FInferShape backward-inference, e.g. fully_connected.cc weight shape).
 from __future__ import annotations
 
 import json
-import threading
 
 import numpy as _np
 
@@ -22,15 +21,10 @@ from ..ops import registry as _registry
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
-_name_counter = threading.local()
-
-
-def _auto_name(prefix):
-    if not hasattr(_name_counter, "counts"):
-        _name_counter.counts = {}
-    c = _name_counter.counts.get(prefix, 0)
-    _name_counter.counts[prefix] = c + 1
-    return "%s%d" % (prefix, c)
+def _auto_name(prefix, name=None):
+    """Auto-name through the active NameManager (mx.name.Prefix etc.)."""
+    from ..name import current as _name_current
+    return _name_current().get(name, prefix)
 
 
 class Node:
@@ -379,8 +373,15 @@ def invoke_sym(op_name, inputs, params, name=None):
         else:
             raise TypeError("symbol op %s expects Symbol inputs, got %r"
                             % (op_name, type(s)))
-    name = name or _auto_name(op_name.lower().lstrip("_") + "_")
-    node = Node(op, name, entries, params)
+    # explicit names are used verbatim here: the user-facing codegen
+    # (symbol/register.py) already routed them through the NameManager
+    # (Prefix prepends to explicit names too, reference name.py); direct
+    # invoke_sym callers (ONNX import, subgraph clone) need exact names
+    if name is None:
+        name = _auto_name(op_name.lower().lstrip("_") + "_")
+    from ..attribute import current as _attr_current
+    node = Node(op, name, entries, params,
+                attrs=_attr_current().get(None) or None)
     # ops with aux outputs expose only the visible prefix to the graph
     # (BatchNorm: out [+ mean/var if output_mean_var] visible; updated moving
     # stats routed to aux storage) — reference FNumVisibleOutputs
@@ -391,7 +392,8 @@ def invoke_sym(op_name, inputs, params, name=None):
 
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
-    attrs = dict(attr or {})
+    from ..attribute import current as _attr_current
+    attrs = _attr_current().get(dict(attr or {}))
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:

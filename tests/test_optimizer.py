@@ -129,3 +129,66 @@ def test_schedulers():
     w = lrs.FactorScheduler(step=100, base_lr=1.0, warmup_steps=5,
                             warmup_begin_lr=0.1)
     assert w(1) < 1.0
+
+
+def test_lr_mult_from_symbol_attrs():
+    """Variable(lr_mult=...) / AttrScope __lr_mult__ reach the update
+    rule through sym_info (reference optimizer.py set_lr_mult)."""
+    import mxnet_tpu as mx
+    w = mx.sym.Variable("w", lr_mult=0.0)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), weight=w,
+                                num_hidden=4, name="fc", no_bias=True)
+    out = mx.sym.SoftmaxOutput(net, name="softmax")
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, sym=out,
+                              param_idx2name={0: "w"})
+    assert opt._get_lr(0) == 0.0
+
+    with mx.AttrScope(**{"__lr_mult__": "0.25"}):
+        v2 = mx.sym.Variable("v2")
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data"), weight=v2,
+                                 num_hidden=4, no_bias=True)
+    opt2 = mx.optimizer.create("sgd", learning_rate=1.0, sym=net2,
+                               param_idx2name={0: "v2"})
+    assert opt2._get_lr(0) == 0.25
+
+
+def test_wd_mult_bias_default_zero():
+    """Reference default: names not ending _weight/_gamma get wd 0."""
+    import mxnet_tpu as mx
+    opt = mx.optimizer.create(
+        "sgd", learning_rate=0.1, wd=0.1,
+        param_idx2name={0: "fc_weight", 1: "fc_bias", 2: "bn_gamma",
+                        3: "bn_beta"})
+    assert opt._get_wd(0) == pytest.approx(0.1)
+    assert opt._get_wd(1) == 0.0
+    assert opt._get_wd(2) == pytest.approx(0.1)
+    assert opt._get_wd(3) == 0.0
+
+
+def test_frozen_params_through_module_fused():
+    """lr_mult=0 params stay frozen through BOTH Module paths (eager
+    updater and the fused tpu_sync step)."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    for kv in ("local", "tpu_sync"):
+        w = mx.sym.Variable("frozen_weight", lr_mult=0.0)
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), weight=w,
+                                    num_hidden=8, name="fc0", no_bias=True)
+        net = mx.sym.Activation(net, act_type="relu")
+        out = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(net, num_hidden=2, name="head"),
+            name="softmax")
+        it = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(out)
+        mod.bind(it.provide_data, it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        before = mod.get_params()[0]["frozen_weight"].asnumpy().copy()
+        mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.5})
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+        after = mod.get_params()[0]["frozen_weight"].asnumpy()
+        np.testing.assert_allclose(before, after, err_msg=kv)

@@ -170,3 +170,41 @@ def test_reshape_executor():
     ex2 = ex.reshape(data=(5, 8))
     assert ex2.arg_dict["data"].shape == (5, 8)
     assert ex2.arg_dict["fc_weight"].shape == (4, 8)
+
+
+def test_name_manager_prefix():
+    """mx.name.Prefix scopes auto-generated symbol names
+    (reference name.py:93)."""
+    with mx.name.Prefix("stage1_"):
+        a = sym.FullyConnected(sym.Variable("data"), num_hidden=4)
+    assert a.name.startswith("stage1_fullyconnected"), a.name
+    # explicit names get the prefix too (reference Prefix.get prepends
+    # after passing the user name through)
+    with mx.name.Prefix("x_"):
+        b = sym.FullyConnected(sym.Variable("data"), num_hidden=4,
+                               name="mine")
+    assert b.name == "x_mine"
+    # variables keep their explicit names (no NameManager in Variable)
+    with mx.name.Prefix("y_"):
+        v = sym.Variable("data2")
+    assert v.name == "data2"
+
+
+def test_attr_scope():
+    """mx.AttrScope attaches attrs to symbols created in scope
+    (reference attribute.py:27), nesting and user override included."""
+    with mx.AttrScope(lr_mult="0.1"):
+        v = sym.Variable("w")
+        with mx.AttrScope(wd_mult="0"):
+            n = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                                   name="fc_scoped")
+    assert v.attr("lr_mult") == "0.1"
+    attrs = n.attr_dict()["fc_scoped"]
+    assert attrs["lr_mult"] == "0.1" and attrs["wd_mult"] == "0"
+    # user attr wins over scope
+    with mx.AttrScope(lr_mult="0.5"):
+        u = sym.Variable("u", attr={"lr_mult": "2.0"})
+    assert u.attr("lr_mult") == "2.0"
+    # scope ends cleanly
+    w2 = sym.Variable("w2")
+    assert w2.attr("lr_mult") is None
