@@ -1,9 +1,13 @@
 """Attribute scoping (parity: python/mxnet/attribute.py AttrScope :27).
 
-``with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):`` attaches the
-given attributes to every symbol node created inside the scope (user
-attrs win on conflict). The symbolic layer merges the active scope in
-``invoke_sym``/``Variable``."""
+``with mx.AttrScope(ctx_group="dev1", **{"__lr_mult__": "0.1"}):``
+attaches the given attributes to every symbol node created inside the
+scope (user attrs win on conflict). The symbolic layer merges the active
+scope in ``invoke_sym``/``Variable``.
+
+NOTE: consumers read specific keys — the optimizer honors only the
+dunder forms ``__lr_mult__``/``__wd_mult__`` (reference optimizer.py
+sym_info); a bare ``lr_mult`` attr is carried but has no effect."""
 import threading
 
 __all__ = ["AttrScope", "current"]

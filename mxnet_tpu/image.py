@@ -504,3 +504,305 @@ class ImageIter:
 
     def __iter__(self):
         return self
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenters + ImageDetIter (parity: python/mxnet/image/
+# detection.py — DetAugmenter:39, DetHorizontalFlipAug:126,
+# DetRandomCropAug:152, DetRandomPadAug:323, CreateDetAugmenter:482,
+# ImageDetIter:624). Geometry runs in normalized [0,1] box coordinates on
+# the host (numpy/cv2 — data prep stays off the accelerator); labels are
+# (N, 5+) rows [cls, xmin, ymin, xmax, ymax, ...] padded with -1.
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Detection augmenter: __call__(src_hwc, label) -> (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only augmenter; boxes pass through (reference :65)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Apply one randomly chosen augmenter, or none with skip_prob
+    (reference :90)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or _pyrandom.random() < self.skip_prob:
+            return src, label
+        return _pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and boxes with probability p (reference :126)."""
+
+    def __init__(self, p):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) else src
+            src = _nd.array(arr[:, ::-1].copy())
+            label = label.copy()
+            xmax = 1.0 - label[:, 1]
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = xmax
+        return src, label
+
+
+def _box_overlap_frac(boxes, rect):
+    """Fraction of each box's area inside rect (x0, y0, x1, y1)."""
+    ix0 = _np.maximum(boxes[:, 1], rect[0])
+    iy0 = _np.maximum(boxes[:, 2], rect[1])
+    ix1 = _np.minimum(boxes[:, 3], rect[2])
+    iy1 = _np.minimum(boxes[:, 4], rect[3])
+    inter = _np.maximum(ix1 - ix0, 0) * _np.maximum(iy1 - iy0, 0)
+    area = ((boxes[:, 3] - boxes[:, 1])
+            * (boxes[:, 4] - boxes[:, 2]))
+    return _np.where(area > 0, inter / _np.maximum(area, 1e-12), 0.0)
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by object coverage (reference :152):
+    proposals must cover >= min_object_covered of at least one box; boxes
+    covered less than min_eject_coverage are dropped, the rest clipped."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _propose(self):
+        area = _pyrandom.uniform(*self.area_range)
+        ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+        w = min(_np.sqrt(area * ratio), 1.0)
+        h = min(area / max(w, 1e-12), 1.0)
+        x0 = _pyrandom.uniform(0, 1 - w)
+        y0 = _pyrandom.uniform(0, 1 - h)
+        return (x0, y0, x0 + w, y0 + h)
+
+    def __call__(self, src, label):
+        for _ in range(self.max_attempts):
+            rect = self._propose()
+            cov = _box_overlap_frac(label, rect)
+            if cov.size and cov.max() >= self.min_object_covered:
+                keep = cov >= self.min_eject_coverage
+                if not keep.any():
+                    continue
+                new = label[keep].copy()
+                w = rect[2] - rect[0]
+                h = rect[3] - rect[1]
+                new[:, 1] = _np.clip((new[:, 1] - rect[0]) / w, 0, 1)
+                new[:, 3] = _np.clip((new[:, 3] - rect[0]) / w, 0, 1)
+                new[:, 2] = _np.clip((new[:, 2] - rect[1]) / h, 0, 1)
+                new[:, 4] = _np.clip((new[:, 4] - rect[1]) / h, 0, 1)
+                arr = src.asnumpy() if isinstance(src, NDArray) else src
+                H, W = arr.shape[:2]
+                xs, ys = int(rect[0] * W), int(rect[1] * H)
+                xe = max(int(rect[2] * W), xs + 1)
+                ye = max(int(rect[3] * H), ys + 1)
+                return _nd.array(arr[ys:ye, xs:xe].copy()), new
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-and-pad: image placed inside a larger canvas, boxes
+    shrink accordingly (reference :323)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = src.asnumpy() if isinstance(src, NDArray) else src
+        H, W = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            scale = _pyrandom.uniform(*self.area_range)
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            if scale < 1.0:
+                continue
+            nw = int(W * _np.sqrt(scale * ratio))
+            nh = int(H * _np.sqrt(scale / ratio))
+            if nw < W or nh < H:
+                continue
+            x0 = _pyrandom.randint(0, nw - W)
+            y0 = _pyrandom.randint(0, nh - H)
+            canvas = _np.empty((nh, nw, arr.shape[2]), arr.dtype)
+            canvas[:] = _np.asarray(self.pad_val, arr.dtype)
+            canvas[y0:y0 + H, x0:x0 + W] = arr
+            new = label.copy()
+            new[:, 1] = (new[:, 1] * W + x0) / nw
+            new[:, 3] = (new[:, 3] * W + x0) / nw
+            new[:, 2] = (new[:, 2] * H + y0) / nh
+            new[:, 4] = (new[:, 4] * H + y0) / nh
+            return _nd.array(canvas), new
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter pipeline (reference :482): resize,
+    color jitter (borrowed), random crop/pad with given probabilities,
+    mirror, force-resize to data_shape, cast + mean/std."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        auglist.append(DetBorrowAug(LightingAug(pca_noise)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    crop_augs = []
+    if rand_crop > 0:
+        crop_augs.append(DetRandomCropAug(
+            min_object_covered, aspect_ratio_range,
+            (min(area_range[0], 1.0), min(area_range[1], 1.0)),
+            min_eject_coverage, max_attempts))
+    if crop_augs:
+        auglist.append(DetRandomSelectAug(crop_augs, 1 - rand_crop))
+    if rand_pad > 0:
+        pad_aug = DetRandomPadAug(
+            aspect_ratio_range, (max(area_range[0], 1.0), area_range[1]),
+            max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # force resize to the network input size
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if mean is not None or std is not None:
+        mean = _np.asarray(mean if mean is not None else [0, 0, 0],
+                           _np.float32)
+        std = _np.asarray(std if std is not None else [1, 1, 1], _np.float32)
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: labels are variable-length object lists packed
+    as [header_w, obj_w, (cls, xmin, ymin, xmax, ymax)...] in the .lst/
+    .rec, emitted as fixed (B, max_objects, obj_w) batches padded with -1
+    (reference image/detection.py:624)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         label_width=1, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         path_imgidx=path_imgidx, shuffle=shuffle,
+                         part_index=part_index, num_parts=num_parts,
+                         aug_list=[], imglist=imglist, data_name=data_name,
+                         label_name=label_name,
+                         last_batch_handle=last_batch_handle)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape, **kwargs)
+        self.label_shape = self._estimate_label_shape()
+
+    @property
+    def provide_label(self):
+        from .io.io import DataDesc
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self.label_shape)]
+
+    def _parse_label(self, label):
+        raw = _np.asarray(label, _np.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError("detection label too short: %d" % raw.size)
+        header_w = int(raw[0])
+        obj_w = int(raw[1])
+        if obj_w < 5 or (raw.size - header_w) % obj_w != 0:
+            raise MXNetError(
+                "label of size %d inconsistent with header %d / object "
+                "width %d" % (raw.size, header_w, obj_w))
+        out = raw[header_w:].reshape(-1, obj_w)
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        out = out[valid]
+        if out.shape[0] < 1:
+            raise MXNetError("sample with no valid boxes")
+        return out
+
+    def _estimate_label_shape(self):
+        max_count, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                try:
+                    parsed = self._parse_label(label)
+                except MXNetError:
+                    continue  # next() skips the same bad samples
+                max_count = max(max_count, parsed.shape[0])
+                width = parsed.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return (max_count, width)
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self.label_shape = tuple(label_shape)
+
+    def next(self):
+        from .io.io import DataBatch
+        batch_data = _np.zeros((self.batch_size,) + self.data_shape,
+                               dtype=_np.float32)
+        batch_label = _np.full((self.batch_size,) + self.label_shape, -1.0,
+                               dtype=_np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                try:
+                    boxes = self._parse_label(label)
+                except MXNetError:
+                    continue  # skip bad ground truth BEFORE paying imdecode
+                img = imdecode(s)
+                for aug in self.auglist:
+                    img, boxes = aug(img, boxes)
+                arr = img.asnumpy() if isinstance(img, NDArray) else img
+                batch_data[i] = arr.transpose(2, 0, 1)
+                n = min(boxes.shape[0], self.label_shape[0])
+                batch_label[i, :n, :boxes.shape[1]] = boxes[:n]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch(data=[_nd.array(batch_data)],
+                         label=[_nd.array(batch_label)],
+                         pad=self.batch_size - i)

@@ -399,3 +399,102 @@ def getnnz(data, *, axis=None):
     if axis is None:
         return jnp.sum(data != 0).astype(index_dtype())
     return jnp.sum(data != 0, axis=axis).astype(index_dtype())
+
+
+@register("_contrib_MultiBoxTarget", num_outputs=3)
+def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference multibox_target.cc): bipartite-match
+    each ground truth to its best anchor, then threshold-match the rest;
+    matched anchors get encoded box offsets + class id+1, the rest are
+    background — optionally hard-negative-mined by classification
+    confidence. Returns (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N)).
+
+    Static-shape design: the reference's per-sample greedy loops become a
+    fori_loop bipartite pass + vectorized threshold matching under vmap.
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    m = label.shape[1]
+    v0, v1, v2, v3 = (float(v) for v in variances)
+
+    a_w = anchors[:, 2] - anchors[:, 0]
+    a_h = anchors[:, 3] - anchors[:, 1]
+    a_cx = (anchors[:, 0] + anchors[:, 2]) / 2
+    a_cy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(lab, conf):
+        valid = lab[:, 0] >= 0                       # (M,)
+        gt = lab[:, 1:5]
+        iou = _box_iou_corner(anchors, gt)           # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        # bipartite: best-first, each gt claims one anchor; claimed gts
+        # leave the pool so every gt gets its guaranteed match
+        def bi_body(_, carry):
+            match, taken, gt_done = carry            # match: (N,) gt idx
+            masked = jnp.where(taken[:, None] | gt_done[None, :], -2.0,
+                               iou)
+            best_per_gt = jnp.max(masked, axis=0)    # (M,)
+            g = jnp.argmax(jnp.where(valid & ~gt_done
+                                     & (best_per_gt > -2.0),
+                                     best_per_gt, -3.0))
+            a = jnp.argmax(masked[:, g])
+            ok = valid[g] & ~gt_done[g] & (masked[a, g] >= 0.0)
+            match = jnp.where(ok & (jnp.arange(n) == a), g, match)
+            taken = taken | (ok & (jnp.arange(n) == a))
+            gt_done = gt_done | (ok & (jnp.arange(m) == g))
+            return match, taken, gt_done
+
+        match0 = jnp.full((n,), -1, jnp.int32)
+        match, taken, _ = lax.fori_loop(
+            0, m, bi_body,
+            (match0, jnp.zeros((n,), bool), jnp.zeros((m,), bool)))
+
+        # threshold matching for the rest
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        thr_ok = (~taken) & (best_iou > overlap_threshold)
+        match = jnp.where(thr_ok, best_gt, match)
+        matched = match >= 0
+        midx = jnp.clip(match, 0, m - 1)
+
+        g_box = gt[midx]                              # (N, 4)
+        g_w = jnp.maximum(g_box[:, 2] - g_box[:, 0], 1e-12)
+        g_h = jnp.maximum(g_box[:, 3] - g_box[:, 1], 1e-12)
+        g_cx = (g_box[:, 0] + g_box[:, 2]) / 2
+        g_cy = (g_box[:, 1] + g_box[:, 3]) / 2
+        tx = (g_cx - a_cx) / jnp.maximum(a_w, 1e-12) / v0
+        ty = (g_cy - a_cy) / jnp.maximum(a_h, 1e-12) / v1
+        tw = jnp.log(jnp.maximum(g_w / jnp.maximum(a_w, 1e-12), 1e-12)) / v2
+        th = jnp.log(jnp.maximum(g_h / jnp.maximum(a_h, 1e-12), 1e-12)) / v3
+        loc = jnp.stack([tx, ty, tw, th], axis=1)     # (N, 4)
+        loc_t = jnp.where(matched[:, None], loc, 0.0).reshape(-1)
+        loc_m = jnp.where(matched[:, None],
+                          jnp.ones((n, 4)), 0.0).reshape(-1)
+
+        cls_t = jnp.where(matched, lab[midx, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard-negative mining (reference multibox_target.cc): every
+            # unmatched anchor starts as IGNORED; only the hardest
+            # negatives — highest non-background confidence above thresh,
+            # up to ratio*num_pos — train as background (0)
+            neg_conf = jnp.max(conf[1:, :], axis=0)   # (N,)
+            num_pos = jnp.sum(matched)
+            quota = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                jnp.int32(minimum_negative_samples))
+            is_cand = ~matched & (neg_conf > negative_mining_thresh)
+            order = jnp.argsort(jnp.where(is_cand, -neg_conf, jnp.inf))
+            rank = jnp.empty_like(order).at[order].set(jnp.arange(n))
+            keep_neg = is_cand & (rank < quota)
+            cls_t = jnp.where(~matched,
+                              jnp.where(keep_neg, 0.0,
+                                        float(ignore_label)), cls_t)
+        return loc_t, loc_m, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t

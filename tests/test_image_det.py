@@ -1,0 +1,183 @@
+"""ImageDetIter + detection augmenters (parity model:
+tests/python/unittest/test_image.py test_det_augmenters/test_image_detiter)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _det_label(boxes):
+    """Pack [cls, xmin, ymin, xmax, ymax] rows the reference way."""
+    out = [2, 5]
+    for b in boxes:
+        out.extend(b)
+    return np.array(out, np.float32)
+
+
+def _make_det_rec(tmp_path, n=8):
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "det.rec")
+    idx = str(tmp_path / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        im = rng.randint(0, 255, (32, 40, 3), np.uint8)
+        ok, buf = cv2.imencode(".jpg", im)
+        label = _det_label([[i % 3, 0.1, 0.2, 0.6, 0.7],
+                            [1, 0.3, 0.3, 0.9, 0.8]])
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(2 + 2 * 5, label, i, 0), buf.tobytes()))
+    w.close()
+    return rec
+
+
+def test_det_iter_batches(tmp_path):
+    rec = _make_det_rec(tmp_path)
+    it = img.ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                          path_imgrec=rec)
+    assert it.provide_label[0][1] == (4, 2, 5)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 24, 24)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (4, 2, 5)
+    # boxes stay normalized and ordered, padding is -1
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+    assert (valid[:, 3] > valid[:, 1]).all()
+
+
+def test_det_hflip_flips_boxes():
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    im = mx.nd.array(np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3))
+    aug = img.DetHorizontalFlipAug(p=1.0)
+    out, new = aug(im, label)
+    np.testing.assert_allclose(new[0, 1], 0.6, rtol=1e-6)  # 1 - 0.4
+    np.testing.assert_allclose(new[0, 3], 0.9, rtol=1e-6)  # 1 - 0.1
+    np.testing.assert_array_equal(out.asnumpy(), im.asnumpy()[:, ::-1])
+
+
+def test_det_random_crop_keeps_coverage():
+    rng = np.random.RandomState(1)
+    im = mx.nd.array(rng.randint(0, 255, (64, 64, 3), np.uint8))
+    label = np.array([[0, 0.4, 0.4, 0.6, 0.6]], np.float32)
+    aug = img.DetRandomCropAug(min_object_covered=0.5,
+                               min_eject_coverage=0.5, max_attempts=200)
+    out, new = aug(im, label)
+    assert new.shape[0] >= 1
+    assert (new[:, 1:] >= 0).all() and (new[:, 1:] <= 1).all()
+    assert (new[:, 3] > new[:, 1]).all() and (new[:, 4] > new[:, 2]).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    rng = np.random.RandomState(2)
+    im = mx.nd.array(rng.randint(0, 255, (32, 32, 3), np.uint8))
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    aug = img.DetRandomPadAug(area_range=(2.0, 3.0), max_attempts=200)
+    out, new = aug(im, label)
+    arr = out.asnumpy()
+    assert arr.shape[0] >= 32 and arr.shape[1] >= 32
+    # padded canvas -> the box no longer spans the whole image
+    assert (new[0, 3] - new[0, 1]) < 1.0 or arr.shape[1] == 32
+
+
+def test_det_iter_with_augmenters_trains_shapes(tmp_path):
+    rec = _make_det_rec(tmp_path)
+    it = img.ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                          path_imgrec=rec, rand_crop=0.5, rand_pad=0.5,
+                          rand_mirror=True,
+                          mean=[123.0, 117.0, 104.0],
+                          std=[58.0, 57.0, 57.0])
+    for b in it:
+        lab = b.label[0].asnumpy()
+        valid = lab[lab[:, :, 0] >= 0]
+        assert valid.shape[0] >= 1  # every image keeps >= 1 box
+        assert (valid[:, 1:5] >= 0).all() and (valid[:, 1:5] <= 1).all()
+
+
+def test_multibox_target_matching():
+    """SSD target assignment: every gt claims its best anchor; encoded
+    offsets invert back to the gt box (reference multibox_target.cc)."""
+    anchors = mx.nd.array(np.array(
+        [[[0.2, 0.2, 0.6, 0.6],    # ~gt1
+          [0.0, 0.0, 0.3, 0.3],
+          [0.5, 0.5, 0.95, 0.95]]], np.float32))  # ~gt2
+    label = mx.nd.array(np.array(
+        [[[1, 0.25, 0.25, 0.55, 0.55],
+          [0, 0.55, 0.55, 0.9, 0.9]]], np.float32))
+    cls_pred = mx.nd.array(np.zeros((1, 3, 3), np.float32))
+    loc_t, loc_m, cls_t = mx.nd._contrib_MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0 and ct[2] == 1.0 and ct[1] == 0.0, ct
+    # decode anchor 0's offsets -> must reproduce gt1
+    t = loc_t.asnumpy()[0].reshape(3, 4)[0]
+    a = np.array([0.2, 0.2, 0.6, 0.6])
+    aw, ah = a[2] - a[0], a[3] - a[1]
+    acx, acy = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+    cx = t[0] * 0.1 * aw + acx
+    cy = t[1] * 0.1 * ah + acy
+    w = np.exp(t[2] * 0.2) * aw
+    h = np.exp(t[3] * 0.2) * ah
+    np.testing.assert_allclose(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+        [0.25, 0.25, 0.55, 0.55], atol=1e-5)
+    # mask on positives only
+    np.testing.assert_array_equal(
+        loc_m.asnumpy()[0].reshape(3, 4).sum(axis=1), [4.0, 0.0, 4.0])
+
+
+def test_multibox_target_negative_mining():
+    a = np.random.RandomState(0).rand(1, 40, 4).astype(np.float32)
+    a[..., 2:] = a[..., :2] + 0.2  # valid corners
+    anchors = mx.nd.array(a)
+    label = mx.nd.array(np.array([[[0, 0.1, 0.1, 0.35, 0.35]]], np.float32))
+    conf = np.zeros((1, 3, 40), np.float32)
+    conf[0, 1:, :] = 0.9  # every negative looks confidently wrong
+    loc_t, loc_m, cls_t = mx.nd._contrib_MultiBoxTarget(
+        anchors, label, mx.nd.array(conf), overlap_threshold=0.5,
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_neg = (ct == 0).sum()
+    n_ign = (ct == -1).sum()
+    assert n_pos >= 1
+    assert n_neg <= 3 * n_pos  # mined down to the ratio
+    assert n_ign > 0           # the rest ignored
+
+
+def test_multibox_target_bipartite_guarantees_every_gt():
+    """A dominant gt must not starve others of their bipartite match
+    (regression: claimed gts were not excluded from later iterations)."""
+    anchors = mx.nd.array(np.array(
+        [[[0.0, 0.0, 0.5, 0.5],     # IoU: g0 high, g1 low
+          [0.05, 0.05, 0.55, 0.55]]], np.float32))  # g0 second-best
+    label = mx.nd.array(np.array(
+        [[[0, 0.0, 0.0, 0.5, 0.5],        # g0: IoU 1.0 with a0
+          [1, 0.05, 0.05, 0.55, 0.55]]],  # g1: IoU 1.0 with a1
+        np.float32))
+    cls_pred = mx.nd.array(np.zeros((1, 3, 2), np.float32))
+    _, _, cls_t = mx.nd._contrib_MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.95)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0 and ct[1] == 2.0, ct  # both gts matched
+
+
+def test_multibox_target_easy_negatives_ignored():
+    """With mining on, easy negatives (below thresh) are IGNORED, not
+    trained as background (regression: the inverse held)."""
+    a = np.random.RandomState(3).rand(1, 30, 4).astype(np.float32)
+    a[..., 2:] = a[..., :2] + 0.2
+    label = mx.nd.array(np.array([[[0, 0.1, 0.1, 0.35, 0.35]]], np.float32))
+    conf = np.zeros((1, 3, 30), np.float32)
+    conf[0, 1, :5] = 0.9            # only 5 hard negatives
+    _, _, cls_t = mx.nd._contrib_MultiBoxTarget(
+        mx.nd.array(a), label, mx.nd.array(conf), overlap_threshold=0.5,
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    assert n_pos >= 1
+    assert (ct == 0).sum() <= min(3 * n_pos, 5)   # only hard ones as bg
+    assert (ct == -1).sum() >= 30 - 5 - n_pos     # easy ones ignored
