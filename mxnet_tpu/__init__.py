@@ -66,6 +66,10 @@ def __getattr__(name):
         "operator": ".operator",
         "name": ".name",
         "attribute": ".attribute",
+        "util": ".util",
+        "log": ".log",
+        "libinfo": ".libinfo",
+        "rtc": ".rtc",
         "rnn": ".rnn",
         "model": ".model",
         "subgraph": ".subgraph",

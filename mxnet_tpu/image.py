@@ -24,7 +24,10 @@ __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "CastAug", "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
            "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
-           "CreateAugmenter", "ImageIter"]
+           "CreateAugmenter", "ImageIter",
+           "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
 
 
 def _cv2():

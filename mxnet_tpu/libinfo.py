@@ -1,0 +1,25 @@
+"""Library location info (parity: python/mxnet/libinfo.py)."""
+import os
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Paths to the native runtime library (libmxtpu.so)."""
+    curr = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    candidates = [os.path.join(curr, "libmxtpu.so"),
+                  os.path.join(curr, "../src/libmxtpu.so")]
+    paths = [p for p in candidates if os.path.exists(p)]
+    if not paths:
+        raise RuntimeError("Cannot find libmxtpu.so: run `make -C src` "
+                           "(pure-python fallbacks remain available)")
+    return paths
+
+
+def find_include_path():
+    """Path to the C++ runtime sources/headers."""
+    curr = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    src = os.path.join(curr, "..", "src")
+    if os.path.isdir(src):
+        return os.path.normpath(src)
+    raise RuntimeError("Cannot find src/ include path")

@@ -1,0 +1,53 @@
+"""Colored logging helpers (parity: python/mxnet/log.py:37-113)."""
+import logging
+import sys
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+PY3 = True
+
+
+class _Formatter(logging.Formatter):
+    """Level-colored formatter when attached to a tty."""
+
+    _COLORS = {logging.WARNING: "\x1b[0;33m", logging.ERROR: "\x1b[0;31m",
+               logging.CRITICAL: "\x1b[0;35m", logging.DEBUG: "\x1b[0;32m"}
+
+    def _label(self, level):
+        return {logging.WARNING: "W", logging.ERROR: "E",
+                logging.CRITICAL: "C", logging.DEBUG: "D"}.get(level, "I")
+
+    def format(self, record):
+        color = self._COLORS.get(record.levelno, "\x1b[0m")
+        is_tty = getattr(sys.stderr, "isatty", lambda: False)()
+        fmt = (color + self._label(record.levelno)
+               + "%(asctime)s %(process)d %(pathname)s:%(funcName)s:"
+               "%(lineno)d\x1b[0m" if is_tty else
+               self._label(record.levelno)
+               + "%(asctime)s %(process)d %(pathname)s:%(funcName)s:"
+               "%(lineno)d")
+        self._style._fmt = fmt + " %(message)s"
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Logger with the colored formatter installed (reference :90)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", False):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+        else:
+            hdlr = logging.StreamHandler()
+        hdlr.setFormatter(_Formatter())
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger
+
+
+getLogger = get_logger

@@ -443,7 +443,9 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
                                      & (best_per_gt > -2.0),
                                      best_per_gt, -3.0))
             a = jnp.argmax(masked[:, g])
-            ok = valid[g] & ~gt_done[g] & (masked[a, g] >= 0.0)
+            # reference floor (multibox_target.cc:116): a gt overlapping
+            # NO anchor is left unmatched rather than grabbing anchor 0
+            ok = valid[g] & ~gt_done[g] & (masked[a, g] > 1e-6)
             match = jnp.where(ok & (jnp.arange(n) == a), g, match)
             taken = taken | (ok & (jnp.arange(n) == a))
             gt_done = gt_done | (ok & (jnp.arange(m) == g))
@@ -454,11 +456,13 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
             0, m, bi_body,
             (match0, jnp.zeros((n,), bool), jnp.zeros((m,), bool)))
 
-        # threshold matching for the rest
+        # threshold matching for the rest (skipped entirely when
+        # overlap_threshold <= 0: bipartite-only, multibox_target.cc:170)
         best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
         best_iou = jnp.max(iou, axis=1)
-        thr_ok = (~taken) & (best_iou > overlap_threshold)
-        match = jnp.where(thr_ok, best_gt, match)
+        if overlap_threshold > 0:
+            thr_ok = (~taken) & (best_iou > overlap_threshold)
+            match = jnp.where(thr_ok, best_gt, match)
         matched = match >= 0
         midx = jnp.clip(match, 0, m - 1)
 
@@ -478,17 +482,22 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
 
         cls_t = jnp.where(matched, lab[midx, 0] + 1.0, 0.0)
         if negative_mining_ratio > 0:
-            # hard-negative mining (reference multibox_target.cc): every
-            # unmatched anchor starts as IGNORED; only the hardest
-            # negatives — highest non-background confidence above thresh,
-            # up to ratio*num_pos — train as background (0)
-            neg_conf = jnp.max(conf[1:, :], axis=0)   # (N,)
+            # hard-negative mining, exact reference semantics
+            # (multibox_target.cc:180-239): candidates are unmatched
+            # anchors whose best IoU is BELOW negative_mining_thresh
+            # (moderate-IoU anchors stay don't-care); hardness is the
+            # softmax BACKGROUND probability, ascending; quota =
+            # min(ratio * num_pos, num_anchors - num_pos); the rest of
+            # the unmatched anchors are ignored.
+            bg_prob = jax.nn.softmax(conf, axis=0)[0]     # (N,)
             num_pos = jnp.sum(matched)
             quota = jnp.maximum(
                 (negative_mining_ratio * num_pos).astype(jnp.int32),
                 jnp.int32(minimum_negative_samples))
-            is_cand = ~matched & (neg_conf > negative_mining_thresh)
-            order = jnp.argsort(jnp.where(is_cand, -neg_conf, jnp.inf))
+            quota = jnp.minimum(quota, n - num_pos)
+            is_cand = ~matched & (best_iou < negative_mining_thresh)
+            quota = jnp.minimum(quota, jnp.sum(is_cand))
+            order = jnp.argsort(jnp.where(is_cand, bg_prob, jnp.inf))
             rank = jnp.empty_like(order).at[order].set(jnp.arange(n))
             keep_neg = is_cand & (rank < quota)
             cls_t = jnp.where(~matched,

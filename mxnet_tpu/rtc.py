@@ -1,0 +1,22 @@
+"""Runtime kernel compilation (parity slot: python/mxnet/rtc.py).
+
+The reference compiles CUDA C source at runtime (CudaModule/CudaKernel).
+The TPU analog of runtime kernels is pallas (see ops/pallas_flash.py for
+the pattern); arbitrary source-string compilation to TPU ISA is not a
+supported workflow, so this module exists to fail loudly with guidance
+rather than to emulate."""
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+
+class CudaModule:
+    def __init__(self, *a, **kw):
+        raise MXNetError(
+            "rtc.CudaModule is CUDA-only. On TPU write a pallas kernel "
+            "instead (jax.experimental.pallas; see "
+            "mxnet_tpu/ops/pallas_flash.py for the pattern) or a CustomOp "
+            "(mxnet_tpu/ops/custom_op.py) for host code.")
+
+
+CudaKernel = CudaModule

@@ -1,0 +1,7 @@
+"""General utilities (parity: python/mxnet/util.py)."""
+import os
+
+
+def makedirs(d):
+    """Create directories recursively if they don't exist."""
+    os.makedirs(d, exist_ok=True)

@@ -60,3 +60,19 @@ def test_enforce_determinism_blocks_autoseed():
                        text=True, env=env, timeout=120)
     assert r.returncode == 0, r.stderr
     assert "BLOCKED_THEN_OK" in r.stdout
+
+
+def test_misc_parity_modules():
+    """util/log/libinfo/rtc parity slots (reference python/mxnet/)."""
+    import mxnet_tpu as mx
+    import tempfile, os
+    d = os.path.join(tempfile.mkdtemp(), "a", "b")
+    mx.util.makedirs(d)
+    assert os.path.isdir(d)
+    lg = mx.log.get_logger("parity_test", level=mx.log.INFO)
+    assert lg.level == mx.log.INFO
+    assert mx.libinfo.find_lib_path()[0].endswith("libmxtpu.so")
+    assert mx.libinfo.find_include_path().endswith("src")
+    import pytest as _pytest
+    with _pytest.raises(mx.MXNetError, match="pallas"):
+        mx.rtc.CudaModule("foo")

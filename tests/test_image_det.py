@@ -165,19 +165,33 @@ def test_multibox_target_bipartite_guarantees_every_gt():
     assert ct[0] == 1.0 and ct[1] == 2.0, ct  # both gts matched
 
 
-def test_multibox_target_easy_negatives_ignored():
-    """With mining on, easy negatives (below thresh) are IGNORED, not
-    trained as background (regression: the inverse held)."""
+def test_multibox_target_mining_reference_semantics():
+    """Exact reference mining (multibox_target.cc:180-239): candidates
+    are unmatched anchors with best-IoU < thresh, the HARDEST (lowest
+    background softmax prob) ratio*num_pos train as background, the rest
+    are ignored — and mining works at fresh init (all-zero logits)."""
     a = np.random.RandomState(3).rand(1, 30, 4).astype(np.float32)
     a[..., 2:] = a[..., :2] + 0.2
     label = mx.nd.array(np.array([[[0, 0.1, 0.1, 0.35, 0.35]]], np.float32))
     conf = np.zeros((1, 3, 30), np.float32)
-    conf[0, 1, :5] = 0.9            # only 5 hard negatives
+    conf[0, 1, :5] = 4.0            # 5 anchors confidently non-background
     _, _, cls_t = mx.nd._contrib_MultiBoxTarget(
         mx.nd.array(a), label, mx.nd.array(conf), overlap_threshold=0.5,
         negative_mining_ratio=3.0, negative_mining_thresh=0.5)
     ct = cls_t.asnumpy()[0]
-    n_pos = (ct > 0).sum()
+    n_pos = int((ct > 0).sum())
     assert n_pos >= 1
-    assert (ct == 0).sum() <= min(3 * n_pos, 5)   # only hard ones as bg
-    assert (ct == -1).sum() >= 30 - 5 - n_pos     # easy ones ignored
+    neg_idx = np.where(ct == 0)[0]
+    assert len(neg_idx) == min(3 * n_pos, 30 - n_pos)
+    # every selected negative comes from the hard pool (lowest bg prob =
+    # the 5 boosted anchors); quota < pool means a strict subset
+    hard = set(range(5))
+    assert set(neg_idx.tolist()) <= hard
+    assert (ct == -1).sum() > 0
+
+    # fresh init: all-zero logits must still mine background gradient
+    conf0 = np.zeros((1, 3, 30), np.float32)
+    _, _, ct0 = mx.nd._contrib_MultiBoxTarget(
+        mx.nd.array(a), label, mx.nd.array(conf0), overlap_threshold=0.5,
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    assert (ct0.asnumpy()[0] == 0).sum() >= 1
