@@ -70,6 +70,7 @@ def __getattr__(name):
         "log": ".log",
         "libinfo": ".libinfo",
         "rtc": ".rtc",
+        "registry": ".registry",
         "rnn": ".rnn",
         "model": ".model",
         "subgraph": ".subgraph",

@@ -12,10 +12,15 @@ PY3 = True
 
 
 class _Formatter(logging.Formatter):
-    """Level-colored formatter when attached to a tty."""
+    """Level-labeled formatter; colored only when its own handler's
+    stream is a tty (a FileHandler must never receive ANSI escapes)."""
 
     _COLORS = {logging.WARNING: "\x1b[0;33m", logging.ERROR: "\x1b[0;31m",
                logging.CRITICAL: "\x1b[0;35m", logging.DEBUG: "\x1b[0;32m"}
+
+    def __init__(self, colored=None):
+        super().__init__()
+        self._colored = colored
 
     def _label(self, level):
         return {logging.WARNING: "W", logging.ERROR: "E",
@@ -23,13 +28,13 @@ class _Formatter(logging.Formatter):
 
     def format(self, record):
         color = self._COLORS.get(record.levelno, "\x1b[0m")
-        is_tty = getattr(sys.stderr, "isatty", lambda: False)()
-        fmt = (color + self._label(record.levelno)
-               + "%(asctime)s %(process)d %(pathname)s:%(funcName)s:"
-               "%(lineno)d\x1b[0m" if is_tty else
-               self._label(record.levelno)
-               + "%(asctime)s %(process)d %(pathname)s:%(funcName)s:"
-               "%(lineno)d")
+        colored = self._colored
+        if colored is None:
+            colored = getattr(sys.stderr, "isatty", lambda: False)()
+        base = (self._label(record.levelno)
+                + "%(asctime)s %(process)d %(pathname)s:%(funcName)s:"
+                "%(lineno)d")
+        fmt = color + base + "\x1b[0m" if colored else base
         self._style._fmt = fmt + " %(message)s"
         return super().format(record)
 
@@ -42,9 +47,12 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
         if filename:
             mode = filemode if filemode else "a"
             hdlr = logging.FileHandler(filename, mode)
+            hdlr.setFormatter(_Formatter(colored=False))
         else:
             hdlr = logging.StreamHandler()
-        hdlr.setFormatter(_Formatter())
+            stream = getattr(hdlr, "stream", None)
+            hdlr.setFormatter(_Formatter(
+                colored=getattr(stream, "isatty", lambda: False)()))
         logger.addHandler(hdlr)
         logger.setLevel(level)
     return logger

@@ -1,0 +1,89 @@
+"""Generic class registries (parity: python/mxnet/registry.py —
+get_register_func :49, get_alias_func :88, get_create_func :115).
+
+The factory trio behind the reference's optimizer/initializer/metric
+registries, exposed so user extensions can build the same pattern:
+
+    register = mx.registry.get_register_func(MyBase, "mything")
+    create = mx.registry.get_create_func(MyBase, "mything")
+
+``create`` accepts a name, an instance (returned as-is), a config dict,
+or the reference's JSON string forms ('["name", {...}]' / '{...}').
+"""
+import json
+import warnings
+
+_REGISTRY = {}
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+
+def get_register_func(base_class, nickname):
+    """Build a @register decorator for subclasses of ``base_class``."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "Can only register subclass of %s" % base_class.__name__
+        name = (name or klass.__name__).lower()
+        if name in registry:
+            warnings.warn(
+                "New %s %s.%s registered with name %s is overriding "
+                "existing %s %s.%s" % (
+                    nickname, klass.__module__, klass.__name__, name,
+                    nickname, registry[name].__module__,
+                    registry[name].__name__))
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (
+        base_class.__name__, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Build an @alias("name", ...) decorator for ``base_class``."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build a create(name_or_instance_or_config, **kwargs) factory."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            assert not args and not kwargs, \
+                "%s is already an instance; extra arguments are invalid" \
+                % nickname
+            return name
+        if isinstance(name, dict):
+            return create(**name)
+        assert isinstance(name, str), "%s must be a string" % nickname
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            assert not args and not kwargs
+            return create(**json.loads(name))
+        name = name.lower()
+        assert name in registry, \
+            "%s is not registered. Please register with %s.register first" \
+            % (name, nickname)
+        return registry[name](*args, **kwargs)
+
+    create.__doc__ = ("Create a %s instance by name, instance, config "
+                      "dict, or JSON string." % nickname)
+    return create

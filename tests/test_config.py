@@ -76,3 +76,44 @@ def test_misc_parity_modules():
     import pytest as _pytest
     with _pytest.raises(mx.MXNetError, match="pallas"):
         mx.rtc.CudaModule("foo")
+
+
+def test_generic_registry():
+    """mx.registry factory trio (reference registry.py:49-175)."""
+    import mxnet_tpu as mx
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    register = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @alias("alt")
+    @register
+    class MyThing(Base):
+        pass
+
+    assert isinstance(create("mything"), MyThing)
+    assert isinstance(create("alt", 5), MyThing)
+    inst = MyThing(2)
+    assert create(inst) is inst
+    made = create('["mything", {"x": 7}]')  # JSON form
+    assert made.x == 7
+    made2 = create({"thing": "mything", "x": 3})
+    assert made2.x == 3
+    import pytest as _pytest
+    with _pytest.raises(AssertionError, match="not registered"):
+        create("nope")
+
+
+def test_log_file_handler_has_no_ansi(tmp_path):
+    import mxnet_tpu as mx
+    path = str(tmp_path / "run.log")
+    lg = mx.log.get_logger("ansi_test", filename=path, level=mx.log.INFO)
+    lg.warning("hello")
+    for h in lg.handlers:
+        h.flush()
+    content = open(path).read()
+    assert "hello" in content and "\x1b[" not in content
