@@ -26,6 +26,15 @@ if _os.environ.get("MXNET_COORDINATOR_ADDRESS") \
     from .parallel import dist as _dist
     _dist.init(strict=False)
 
+# ps-lite launcher compatibility: server/scheduler-role processes run the
+# (no-op) server module and exit at import, exactly like the reference
+# (python/mxnet/kvstore_server.py:85) — they must not fall through and
+# execute the training script as stray singleton workers
+import os as _os_role
+if _os_role.environ.get("DMLC_ROLE", "") in ("server", "scheduler"):
+    from . import kvstore_server as _kvs
+    _kvs._init_kvstore_server_module()
+
 from .base import MXNetError
 from .attribute import AttrScope
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
@@ -71,6 +80,8 @@ def __getattr__(name):
         "libinfo": ".libinfo",
         "rtc": ".rtc",
         "registry": ".registry",
+        "kvstore_server": ".kvstore_server",
+        "executor_manager": ".executor_manager",
         "rnn": ".rnn",
         "model": ".model",
         "subgraph": ".subgraph",

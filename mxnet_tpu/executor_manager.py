@@ -1,0 +1,21 @@
+"""Legacy executor manager (parity slot: python/mxnet/executor_manager.py).
+
+The reference's DataParallelExecutorManager replicated one executor per
+device and reduced gradients host-side; here a single compiled SPMD
+program over a device mesh does both (executor.py — Module(context=[...])
+shards the batch and GSPMD inserts the all-reduce). The classes below
+exist so v0.x-era imports resolve, and point at the replacement."""
+from .base import MXNetError
+
+__all__ = ["DataParallelExecutorManager"]
+
+_MSG = ("DataParallelExecutorManager's per-device executor replication is "
+        "superseded by compiled SPMD: use mx.mod.Module(symbol, "
+        "context=[...]) (the batch is sharded and gradients all-reduced "
+        "inside one XLA program) or FeedForward(ctx=[...]) for the v0.x "
+        "surface.")
+
+
+class DataParallelExecutorManager:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

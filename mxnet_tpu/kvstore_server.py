@@ -1,0 +1,40 @@
+"""Server-role entry point (parity slot: python/mxnet/kvstore_server.py).
+
+The reference's dist kvstore runs dedicated parameter-server processes;
+this framework has NO servers — aggregation is a symmetric all-reduce
+over the jax.distributed process group (docs/distributed.md). Reference
+launch scripts that spawn server/scheduler roles keep working: those
+processes call ``_init_kvstore_server_module()``, which here logs the
+design note and exits the blocking role loop immediately instead of
+serving forever."""
+import logging
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """No-op stand-in for the ps-lite server loop."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):
+        # warning level: the root logger shows it unconfigured, so the
+        # operator sees WHY the server process exited
+        logging.warning(
+            "kvstore_server: this runtime has no parameter servers — "
+            "gradient aggregation is an all-reduce over the worker group "
+            "(see docs/distributed.md); server process exiting cleanly")
+
+
+def _init_kvstore_server_module():
+    """Reference contract (kvstore_server.py:85): invoked at package
+    import on server/scheduler-role processes, runs the (here: no-op)
+    server loop, then EXITS so the host never falls through into the
+    user training script as a stray out-of-group worker."""
+    import sys
+    role = os.environ.get("DMLC_ROLE", "")
+    if role in ("server", "scheduler"):
+        KVStoreServer().run()
+        sys.exit(0)

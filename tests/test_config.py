@@ -117,3 +117,26 @@ def test_log_file_handler_has_no_ansi(tmp_path):
         h.flush()
     content = open(path).read()
     assert "hello" in content and "\x1b[" not in content
+
+
+def test_server_role_shims():
+    """A server/scheduler-role process exits 0 AT IMPORT (reference
+    kvstore_server.py:85 contract) instead of running the training
+    script; legacy executor-manager imports point at the SPMD
+    replacement."""
+    import subprocess, sys
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import mxnet_tpu;"
+            "print('MUST NOT REACH: training script ran on a server')")
+    env = dict(os.environ, DMLC_ROLE="server")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0
+    assert "MUST NOT REACH" not in r.stdout
+    assert "no parameter servers" in r.stderr
+
+    import mxnet_tpu as mx
+    import pytest as _pytest
+    with _pytest.raises(mx.MXNetError, match="SPMD"):
+        mx.executor_manager.DataParallelExecutorManager()
