@@ -32,6 +32,14 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _fit_step(self, data_batch):
+        """One fit-loop iteration: fwd+bwd then update. Subclasses may fuse
+        the pair atomically (Module donates buffers to XLA here — in-place
+        param/opt updates — which the public forward_backward()/update()
+        contract, with its deferred commit, cannot allow)."""
+        self.forward_backward(data_batch)
+        self.update()
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
@@ -155,8 +163,7 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                self._fit_step(data_batch)
                 # metric BEFORE prefetch/prepare (reference base_module.py
                 # :528-545): prepare() may switch the bucketing module to
                 # the NEXT batch's bucket, whose executor has no outputs yet

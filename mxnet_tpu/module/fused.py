@@ -78,10 +78,9 @@ class FusedStep:
         cdt = self._compute_dtype
         dnames = self._data_names
 
-        def step(arg_vals, aux_vals, opt_state, lr_vec, wd_vec, rescale, t,
-                 key):
-            diff = {k: arg_vals[k] for k in pnames}
-            rest = {k: v for k, v in arg_vals.items() if k not in diff}
+        def step(params, rest, aux_vals, opt_state, lr_vec, wd_vec, rescale,
+                 t, key):
+            diff = params
             if cdt is not None:
                 rest = {k: (v.astype(cdt)
                             if k in dnames and v.dtype == jnp.float32 else v)
@@ -102,22 +101,36 @@ class FusedStep:
             # output (bf16 under mixed precision)
             ones = [jnp.ones(o.shape, o.dtype) for o in outs]
             grads = vjp(list(ones))[0]
-            new_args = dict(arg_vals)
+            new_params = {}
             new_opt = {}
             for i, k in enumerate(pnames):
-                nw, ns = update(arg_vals[k], grads[k], opt_state[k],
+                nw, ns = update(params[k], grads[k], opt_state[k],
                                 lr_vec[i], wd_vec[i], rescale, t)
-                new_args[k] = nw.astype(arg_vals[k].dtype)
+                new_params[k] = nw.astype(params[k].dtype)
                 new_opt[k] = ns
             new_aux = {**aux_vals, **auxu}
-            return outs, new_args, new_aux, new_opt
+            return outs, new_params, new_aux, new_opt
 
         # Shardings are not pinned here: the executor commits params/aux/
         # data to their mesh shardings (dp-sharded batch, replicated
         # weights) and init_state commits the optimizer state, so GSPMD
         # propagates from the committed inputs — including the gradient
         # psum over 'dp'.
+        #
+        # Two compiled variants of the SAME step:
+        # * `_jitted` — no donation; backs the public forward_backward()/
+        #   update() pair, whose contract allows reading the OLD params
+        #   between the two calls (and skipping update() entirely);
+        # * `_jitted_donate` — params/aux/opt-state donated, so XLA updates
+        #   them in place instead of double-buffering ~2x the model size in
+        #   HBM every step. Backs the atomic fit-loop step
+        #   (Module._fit_step), which commits results immediately. Data/
+        #   label inputs (`rest`) are never donated: callers legitimately
+        #   reuse device-resident batches across steps.
+        # jax.jit compiles lazily, so a fit()-only run pays for exactly one
+        # compilation.
         self._jitted = jax.jit(step)
+        self._jitted_donate = jax.jit(step, donate_argnums=(0, 2, 3))
 
     # ------------------------------------------------------------------- state
     def init_state(self):
@@ -187,8 +200,42 @@ class FusedStep:
         for k in self.param_names:
             self._opt._update_count(self._name2idx[k])
 
-    def run(self, arg_vals, aux_vals, opt_state, key):
+    def split_args(self, arg_vals):
+        """Split a full executor arg dict into (updated params, the rest)."""
+        params = {k: arg_vals[k] for k in self.param_names}
+        rest = {k: v for k, v in arg_vals.items() if k not in params}
+        return params, rest
+
+    def run(self, arg_vals, aux_vals, opt_state, key, donate=False):
+        """One fused step. With ``donate=True`` the param/aux/opt-state
+        buffers are DONATED to XLA (updated in place); the caller must
+        commit the returned values immediately — the inputs are dead."""
         lr_vec, wd_vec, rescale, t = self.hyper_peek()
-        return self._jitted(arg_vals, aux_vals, opt_state,
-                            jnp.asarray(lr_vec), jnp.asarray(wd_vec),
-                            rescale, t, key)
+        params, rest = self.split_args(arg_vals)
+        fn = self._jitted_donate if donate else self._jitted
+        outs, new_params, new_aux, new_opt = fn(
+            params, rest, aux_vals, opt_state,
+            jnp.asarray(lr_vec), jnp.asarray(wd_vec), rescale, t, key)
+        new_args = dict(rest)
+        new_args.update(new_params)
+        return outs, new_args, new_aux, new_opt
+
+    def cost_analysis(self, arg_vals, aux_vals, opt_state):
+        """XLA cost analysis of the compiled fused step (flops etc.), via
+        AOT lowering with the current executor values as abstract inputs.
+        Returns the cost dict or None."""
+        npar = len(self.param_names)
+        params, rest = self.split_args(arg_vals)
+        lowered = self._jitted.lower(
+            params, rest, aux_vals, opt_state,
+            jnp.zeros((npar,), jnp.float32), jnp.zeros((npar,), jnp.float32),
+            _np.float32(1.0), _np.int32(1), jax.random.PRNGKey(0))
+        try:
+            # pre-compile HLO-level analysis: avoids a second (multi-minute
+            # over the remote-compile tunnel) XLA compilation just for flops
+            cost = lowered.cost_analysis()
+        except Exception:
+            cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        return cost

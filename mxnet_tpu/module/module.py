@@ -319,6 +319,36 @@ class Module(BaseModule):
             self.forward(data_batch, is_train=True)
             self.backward()
 
+    def _fit_step(self, data_batch):
+        """Atomic fused fit step: one donating XLA program updates params/
+        aux/optimizer state IN PLACE (no HBM double-buffering), and the
+        results commit immediately. Falls back to the eager pair when the
+        fused step is not engaged."""
+        if self._fused is not None and self.optimizer_initialized:
+            from .. import random as _random
+            from ..ndarray.ndarray import NDArray
+            ex = self._exec
+            ex.set_inputs(**self._feed(data_batch))
+            key = _random.next_key()
+            outs, new_args, new_aux, new_opt = self._fused.run(
+                ex._arg_vals(), ex._aux_vals(), self._fused_opt_state, key,
+                donate=True)
+            # inputs are dead after donation: commit everything now
+            for k, v in new_aux.items():
+                ex.aux_dict[k]._rebind(v)
+            for k in self._fused.param_names:
+                ex.arg_dict[k]._rebind(new_args[k])
+            ex.outputs = [NDArray(o, ctx=ex._ctx) for o in outs]
+            ex._pending = None
+            self._fused_opt_state = new_opt
+            self._fused.commit_counts()
+            self._params_dirty = True
+            self._fused_pending = None
+            self._fused_ran = False
+        else:
+            self.forward_backward(data_batch)
+            self.update()
+
     def _forward_fused(self, feed):
         from .. import random as _random
         from ..ndarray.ndarray import NDArray

@@ -151,6 +151,51 @@ def test_fused_optimizer_states_roundtrip(tmp_path):
             np.testing.assert_allclose(np.asarray(a), b.asnumpy(), rtol=1e-6)
 
 
+def test_fit_step_donates_buffers():
+    """The atomic fit-loop step donates param/aux/opt buffers to XLA:
+    after one _fit_step, the PREVIOUS device buffers must be deleted
+    (in-place update, no HBM double-buffering) — while data/label inputs
+    survive for reuse across steps."""
+    sym = _make_net(with_bn=True)
+    X, Y = _data(16)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    batch = next(iter(it))
+    mod = mx.mod.Module(sym)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(arg_params=_fixed_params(sym), aux_params={},
+                    allow_missing=True)
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    assert mod._fused is not None
+    ex = mod._exec
+    pnames = mod._fused.param_names
+    old_params = {k: ex.arg_dict[k]._data for k in pnames}
+    old_opt = {k: mod._fused_opt_state[k] for k in pnames}
+    old_aux = {k: v._data for k, v in ex.aux_dict.items()}
+    # copy=True: on CPU np.asarray(jax_array) is a zero-copy view whose
+    # external reference would (correctly) block donation of that buffer
+    w_before = {k: np.array(v, copy=True) for k, v in old_params.items()}
+
+    mod._fit_step(batch)
+    data_val = batch.data[0]._data
+
+    for k in pnames:
+        assert old_params[k].is_deleted(), "param %s was copied, not donated" % k
+        assert not ex.arg_dict[k]._data.is_deleted()
+    for k, st in old_opt.items():
+        for s in st:
+            assert s.is_deleted(), "opt state of %s not donated" % k
+    for k, a in old_aux.items():
+        assert a.is_deleted(), "aux %s not donated" % k
+    assert not data_val.is_deleted(), "data input must NOT be donated"
+    # and the step actually trained
+    w_after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    assert any((w_before[k] != w_after[k]).any() for k in w_before)
+    # a second step with the same (surviving) batch works
+    mod._fit_step(batch)
+
+
 def test_fused_flag_disables():
     from mxnet_tpu import config
     with config.override(module_fused_step=False):
