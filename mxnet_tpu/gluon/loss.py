@@ -21,8 +21,7 @@ def _apply_weighting(F, loss, weight=None, sample_weight=None):
 
 
 def _reshape_like(F, x, y):
-    return F.reshape_like(x, y) if hasattr(F, "reshape_like") \
-        else x.reshape(y.shape)
+    return F.reshape_like(x, y)
 
 
 class Loss(HybridBlock):

@@ -1,6 +1,6 @@
 """IO package (parity: python/mxnet/io/)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter)
+                 PrefetchingIter, LibSVMIter)
 from .image_record_iter import ImageRecordIter
 
 

@@ -313,3 +313,145 @@ class PrefetchingIter(DataIter):
             return True
         except StopIteration:
             return False
+
+
+class LibSVMIter(DataIter):
+    """Iterate a zero-based-index LibSVM file as CSR batches (parity:
+    src/io/iter_libsvm.cc — data is CSR; the label comes from the leading
+    token of each line, or from a second LibSVM file when ``label_libsvm``
+    is given, in which case the label batch is CSR too).
+
+    ``num_parts``/``part_index`` shard the file by contiguous line ranges
+    (the analog of dmlc::Parser's chunk partitioning) so each dist worker
+    reads a disjoint part. The whole part is parsed up front into one host
+    CSR arena (numpy); batches are sliced views — the TPU-side consumer
+    (sparse dot, SparseEmbedding rows) receives exactly the reference's
+    CSRNDArray surface.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=128, num_parts=1, part_index=0,
+                 round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        if isinstance(data_shape, int):
+            data_shape = (data_shape,)
+        if isinstance(label_shape, int):
+            label_shape = (label_shape,)
+        if len(data_shape) != 1:
+            raise ValueError("dimension of data_shape is expected to be 1")
+        if num_parts <= 0 or not 0 <= part_index < num_parts:
+            raise ValueError("bad num_parts/part_index: %r/%r"
+                             % (num_parts, part_index))
+        if not round_batch:
+            # a short final batch would break the provide_data batch_size
+            # contract; the reference iterator only pads (iter_libsvm.cc
+            # via iter_sparse_batchloader.h)
+            raise ValueError("LibSVMIter supports round_batch=True only")
+        self._data_shape = tuple(data_shape)
+        self._label_shape = tuple(label_shape)
+        self.round_batch = round_batch
+        vals, idxs, ptr, labels = self._parse(data_libsvm, num_parts,
+                                              part_index)
+        self._vals, self._idxs, self._ptr = vals, idxs, ptr
+        self.num_data = len(ptr) - 1
+        if label_libsvm and label_libsvm != "NULL":
+            if int(_np.prod(self._label_shape)) <= 1:
+                raise ValueError("label_shape is not expected to be (1,) "
+                                 "when label_libsvm is set")
+            lv, li, lp, _ = self._parse(label_libsvm, num_parts, part_index)
+            if len(lp) - 1 != self.num_data:
+                raise ValueError("label file row count %d != data rows %d"
+                                 % (len(lp) - 1, self.num_data))
+            self._lab = (lv, li, lp)
+        else:
+            if int(_np.prod(self._label_shape)) > 1:
+                raise ValueError("label_shape is expected to be (1,) when "
+                                 "label_libsvm is NULL")
+            self._lab = _np.asarray(labels, dtype=_np.float32) \
+                .reshape(-1, 1)
+        self.reset()
+
+    @staticmethod
+    def _parse(path, num_parts, part_index):
+        with open(path, "r") as f:
+            lines = [ln.strip() for ln in f]
+        lines = [ln for ln in lines if ln and not ln.startswith("#")]
+        n = len(lines)
+        lo = part_index * n // num_parts
+        hi = (part_index + 1) * n // num_parts
+        vals, idxs, ptr, labels = [], [], [0], []
+        for ln in lines[lo:hi]:
+            toks = ln.split()
+            k = 0
+            if toks and ":" not in toks[0]:
+                labels.append(float(toks[0]))
+                k = 1
+            else:
+                labels.append(0.0)
+            for t in toks[k:]:
+                i, v = t.split(":")
+                idxs.append(int(i))
+                vals.append(float(v))
+            ptr.append(len(vals))
+        return (_np.asarray(vals, _np.float32),
+                _np.asarray(idxs, _np.int64),
+                _np.asarray(ptr, _np.int64), labels)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape,
+                         _np.float32)]
+
+    @property
+    def provide_label(self):
+        # scalar labels (leading token) deliver as (batch,); CSR label
+        # files deliver (batch,) + label_shape — match getlabel exactly
+        shp = self._label_shape if isinstance(self._lab, tuple) else ()
+        return [DataDesc("softmax_label", (self.batch_size,) + tuple(shp),
+                         _np.float32)]
+
+    def reset(self):
+        self.cursor = -1
+
+    def iter_next(self):
+        self.cursor += 1
+        return self.cursor * self.batch_size < self.num_data
+
+    def _csr_rows(self, vals, idxs, ptr, rows, width):
+        """Slice row ids out of the arena into one batch CSRNDArray."""
+        from ..ndarray import sparse as _sp
+        counts = ptr[rows + 1] - ptr[rows]
+        bptr = _np.zeros(len(rows) + 1, dtype=_np.int64)
+        _np.cumsum(counts, out=bptr[1:])
+        take = _np.concatenate(
+            [_np.arange(ptr[r], ptr[r + 1]) for r in rows]) \
+            if len(rows) else _np.zeros((0,), _np.int64)
+        return _sp.csr_matrix(
+            (vals[take], idxs[take], bptr),
+            shape=(len(rows), width))
+
+    def _rows(self):
+        start = self.cursor * self.batch_size
+        rows = _np.arange(start, min(start + self.batch_size, self.num_data))
+        if len(rows) < self.batch_size and self.round_batch:
+            # wrap modulo the dataset: stays valid even when the whole
+            # dataset is smaller than one batch
+            extra = _np.arange(self.batch_size - len(rows)) % self.num_data
+            rows = _np.concatenate([rows, extra])
+        return rows
+
+    def getdata(self):
+        return [self._csr_rows(self._vals, self._idxs, self._ptr,
+                               self._rows(), self._data_shape[0])]
+
+    def getlabel(self):
+        rows = self._rows()
+        if isinstance(self._lab, tuple):
+            lv, li, lp = self._lab
+            return [self._csr_rows(lv, li, lp, rows,
+                                   int(_np.prod(self._label_shape)))]
+        return [_nd.array(self._lab[rows, 0])]
+
+    def getpad(self):
+        end = (self.cursor + 1) * self.batch_size
+        return max(0, end - self.num_data) if self.round_batch else 0

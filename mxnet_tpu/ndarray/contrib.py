@@ -406,3 +406,20 @@ def getnnz(data, axis=None):
         import numpy as np
         return _nd.array(np.asarray(data.indices.shape[0], np.int64))
     return _nd.invoke("_contrib_getnnz", [data], {"axis": axis})
+
+
+def __getattr__(name):
+    """Resolve ``mx.nd.contrib.<name>`` to the registered
+    ``_contrib_<name>`` operator (reference python surface:
+    python/mxnet/ndarray/contrib.py is code-generated the same way) —
+    hand-written helpers above take precedence."""
+    from ..ops import registry as _registry
+    from . import register as _register
+    op = _registry.get_or_none("_contrib_" + name)
+    if op is None:
+        raise AttributeError(
+            "mxnet_tpu.ndarray.contrib has no attribute %r" % name)
+    fn = _register._make_op_func(op)
+    fn.__name__ = name
+    globals()[name] = fn   # cache for next lookup
+    return fn

@@ -204,6 +204,34 @@ class NDArray:
                     jnp.asarray(v, self.dtype), self.shape))
             return
         key = _norm_index(key)
+        # basic slicing routes through the registered _slice_assign ops
+        # (parity: src/operator/tensor/matrix_op.cc:434-459; reference
+        # __setitem__ dispatches the same way, python/mxnet/ndarray/
+        # ndarray.py _set_nd_basic_indexing)
+        basic = key if isinstance(key, tuple) else (key,)
+        if all(isinstance(k, (slice, int)) for k in basic):
+            sls = tuple(k if isinstance(k, slice) else slice(k, k + 1 or None)
+                        for k in basic)
+            begin = [s.start for s in sls]
+            end = [s.stop for s in sls]
+            step = [s.step for s in sls]
+            from .. import ops as _ops_pkg  # noqa: F401 (registry populated)
+            if isinstance(v, (int, float)):
+                new = _registry.get("_slice_assign_scalar").fn(
+                    self._data, scalar=float(v), begin=begin, end=end,
+                    step=step)
+            else:
+                # static index arithmetic: no device slice just for a shape
+                tgt = tuple(len(range(*s.indices(d)))
+                            for s, d in zip(sls, self.shape)) \
+                    + self.shape[len(sls):]
+                rhs = jnp.broadcast_to(jnp.asarray(v, self.dtype), tgt)
+                new = _registry.get("_slice_assign").fn(
+                    self._data, rhs, begin=begin, end=end, step=step)
+            # int keys collapse axes in numpy semantics; sls kept them as
+            # length-1 slices, so shapes already agree
+            self._rebind(new)
+            return
         self._rebind(self._data.at[key].set(v))
 
     def __getitem__(self, key):

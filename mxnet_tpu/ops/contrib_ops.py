@@ -507,3 +507,64 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
 
     loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
     return loc_t, loc_m, cls_t
+
+
+# ---------------------------------------------------------------------------
+# bipartite matching (SSD/rcnn target assignment)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_bipartite_matching", num_outputs=2)
+def bipartite_matching(data, *, threshold, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a score matrix [..., N, M] (parity:
+    src/operator/contrib/bounding_box.cc:154 / bounding_box-inl.h:728-760).
+
+    Returns (row_match, col_match): row_match[..., i] = matched column of
+    row i (-1 if unmatched), col_match[..., j] = matched row of column j.
+
+    TPU-native shape: one argsort of the flattened N*M scores per batch
+    element, then a lax.fori_loop greedy walk with row/column markers —
+    sequential like the reference's kernel (the walk is inherently
+    ordered), but O(NM) scalar steps on sorted data instead of host code,
+    and vmapped over the batch.
+    """
+    dshape = data.shape
+    nrow, ncol = dshape[-2], dshape[-1]
+    flat = data.reshape((-1, nrow * ncol))
+    key = flat if is_ascend else -flat
+    order = jnp.argsort(key, axis=1)
+
+    def one(scores, idx):
+        sorted_scores = scores[idx]
+        good = (sorted_scores < threshold) if is_ascend \
+            else (sorted_scores > threshold)
+        # the walk stops at the first bad score (sorted => all later ones
+        # are bad too): a prefix-AND turns the reference's `break` into a
+        # mask the loop can consume without data-dependent control flow
+        good = jnp.cumprod(good.astype(jnp.int32)) == 1
+
+        def body(j, st):
+            rmark, cmark, count = st
+            ij = idx[j]
+            r, c = ij // ncol, ij % ncol
+            free = (rmark[r] == -1) & (cmark[c] == -1)
+            # reference stops AFTER the assignment that exceeds topk
+            # (bounding_box-inl.h:748-752): emulate by refusing matches
+            # once count > topk
+            under = (count <= topk) if topk > 0 else True
+            take = free & good[j] & under
+            rmark = rmark.at[r].set(jnp.where(take, c, rmark[r]))
+            cmark = cmark.at[c].set(jnp.where(take, r, cmark[c]))
+            return rmark, cmark, count + take.astype(jnp.int32)
+
+        rmark = jnp.full((nrow,), -1, data.dtype)
+        cmark = jnp.full((ncol,), -1, data.dtype)
+        rmark, cmark, _ = lax.fori_loop(
+            0, nrow * ncol, body, (rmark, cmark, jnp.int32(0)))
+        return rmark, cmark
+
+    rm, cm = jax.vmap(one)(flat, order)
+    return (rm.reshape(dshape[:-1]),
+            cm.reshape(dshape[:-2] + (ncol,)))
+
+
+alias("_contrib_bipartite_matching", "bipartite_matching")

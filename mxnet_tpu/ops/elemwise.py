@@ -246,3 +246,18 @@ def smooth_l1(data, *, scalar=1.0):
     return jnp.where(jnp.abs(data) < 1.0 / s2,
                      0.5 * s2 * jnp.square(data),
                      jnp.abs(data) - 0.5 / s2)
+
+
+@register("add_n")
+def add_n(*args):
+    """Sum of any number of input arrays, elementwise (parity:
+    src/operator/tensor/elemwise_sum.cc add_n/ElementWiseSum). XLA folds
+    the chain into one fused reduction; no pairwise temp like the
+    reference's in-place accumulation needs."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+alias("add_n", "ElementWiseSum")

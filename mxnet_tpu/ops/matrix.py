@@ -420,3 +420,52 @@ def _param_dtype_out(in_dtypes, params):
 from .registry import set_op_meta as _set_op_meta  # noqa: E402
 _set_op_meta("argsort", dtype_hook=_param_dtype_out)
 _set_op_meta("topk", dtype_hook=_param_dtype_out)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    """Reshape lhs to the shape of rhs (parity:
+    src/operator/tensor/elemwise_unary_op_basic.cc:429 — gradient flows to
+    lhs only; rhs contributes shape, not values)."""
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (parity:
+    src/operator/tensor/indexing_op.cc:730 — deprecated alias of pick
+    along axis 1)."""
+    idx = indices.astype(_index_dtype()).reshape((-1,))
+    return jnp.take_along_axis(
+        a, idx[:, None], axis=1).reshape(idx.shape)
+
+
+def _slice_tuple(shape, begin, end, step=None):
+    """MXNet SliceParam begin/end/step (entries may be None) -> python
+    slice tuple over leading len(begin) axes."""
+    step = step if step is not None and len(step) else (None,) * len(begin)
+    out = []
+    for b, e, s in zip(begin, end, step):
+        out.append(slice(b, e, s))
+    return tuple(out)
+
+
+@register("_slice_assign")
+def slice_assign(lhs, rhs, *, begin, end, step=None):
+    """Write rhs into lhs[begin:end:step] (parity:
+    src/operator/tensor/matrix_op.cc:434 _slice_assign/_crop_assign).
+    XLA scatters in place when the buffer is donated; under jit the
+    functional update fuses."""
+    return lhs.at[_slice_tuple(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar")
+def slice_assign_scalar(data, *, scalar=0.0, begin=(), end=(), step=None):
+    """Fill data[begin:end:step] with a scalar (parity:
+    src/operator/tensor/matrix_op.cc:459)."""
+    return data.at[_slice_tuple(data.shape, begin, end, step)].set(
+        jnp.asarray(scalar, data.dtype))
+
+
+alias("_slice_assign", "_crop_assign")
+alias("_slice_assign_scalar", "_crop_assign_scalar")

@@ -548,6 +548,19 @@ def embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 
+@register("_contrib_SparseEmbedding")
+def sparse_embedding(data, weight, *, input_dim=0, output_dim=0,
+                     dtype="float32", deterministic=False):
+    """Embedding whose weight gradient is row-sparse (parity:
+    src/operator/tensor/indexing_op.cc:98-133 SparseEmbedding). The
+    forward is a plain gather; the sparse-gradient contract lives in the
+    storage layer (gluon Parameter grad_stype='row_sparse' /
+    RowSparseNDArray), which the optimizers' lazy row updates consume —
+    XLA scatters the VJP, so there is no dense-vs-rsp kernel split to
+    reproduce."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
 # ---------------------------------------------------------------------------
 # RNN (fused; reference: src/operator/rnn-inl.h, cudnn_rnn-inl.h)
 # ---------------------------------------------------------------------------
@@ -862,6 +875,7 @@ _set_op_meta("BatchNorm", shape_hook=_bn_shapes, dtype_hook=_bn_dtypes,
 _set_op_meta("LayerNorm", shape_hook=_ln_shapes)
 _set_op_meta("InstanceNorm", shape_hook=_in_shapes)
 _set_op_meta("Embedding", shape_hook=_embedding_shapes)
+_set_op_meta("_contrib_SparseEmbedding", shape_hook=_embedding_shapes)
 _set_op_meta("RNN", shape_hook=_rnn_shapes)
 _set_op_meta("LeakyReLU", shape_hook=_prelu_shapes)
 

@@ -166,3 +166,25 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
     new_mom = momentum * mom - lr * g
     new_w32 = weight32 + new_mom
     return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("group_adagrad_update", num_outputs=2)
+def group_adagrad_update(weight, grad, history, *, lr, epsilon=1e-5,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """Group-sparsity AdaGrad (parity:
+    src/operator/contrib/adgrad_update_op-inl.h:104-137): one shared
+    accumulator per ROW — history[i] += mean(g[i]^2); w -= lr * g /
+    sqrt(history + eps). The row mean keeps the accumulator scale
+    independent of embedding width."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    ssq = jnp.mean(jnp.square(g), axis=axes) if axes else jnp.square(g)
+    # history is (N,) from the op path or (N, 1) from the python
+    # optimizer's create_state (reference contrib.py:66 keepdims) —
+    # preserve whichever layout came in
+    new_hist = history + ssq.reshape(history.shape)
+    bshape = weight.shape[:1] + (1,) * len(axes)
+    new_w = weight - lr * g / jnp.sqrt(new_hist.reshape(bshape) + epsilon)
+    return new_w, new_hist

@@ -155,5 +155,60 @@ def quantized_flatten(data, min_range, max_range):
     return data.reshape(data.shape[0], -1), min_range, max_range
 
 
+@register("_contrib_quantized_pooling", num_outputs=3)
+def quantized_pooling(data, min_data, max_data, *, kernel=(), pool_type="max",
+                      global_pool=False, stride=None, pad=None,
+                      pooling_convention="valid", count_include_pad=True,
+                      cudnn_off=False, p_value=2, layout=None):
+    """Pooling over int8/uint8 feature maps (parity:
+    src/operator/quantization/quantized_pooling.cc). Pooling is monotonic
+    (max) or range-contained (avg), so min/max calibration ranges pass
+    through unchanged; the arithmetic runs in int32 on the VPU and rounds
+    back to the input dtype for avg."""
+    from .nn import pooling as _pooling
+    qdt = data.dtype
+    out = _pooling(data.astype(jnp.float32), kernel=kernel,
+                   pool_type=pool_type, global_pool=global_pool,
+                   stride=stride, pad=pad,
+                   pooling_convention=pooling_convention,
+                   count_include_pad=count_include_pad)
+    if pool_type == "max":
+        out = out.astype(qdt)
+    else:
+        out = jnp.clip(jnp.round(out),
+                       jnp.iinfo(qdt).min, jnp.iinfo(qdt).max).astype(qdt)
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_concat", num_outputs=3)
+def quantized_concat(*args, dim=1, num_args=None):
+    """Concat int8/uint8 inputs with differing calibration ranges (parity:
+    src/operator/quantization/quantized_concat.cc): every input is
+    rescaled into the widest [min, max] pair, and the output carries that
+    union range. Inputs arrive as [d0..dn-1, min0, max0, min1, max1, ...]
+    per the reference's input ordering (data first, then min/max pairs)."""
+    n = num_args if num_args is not None else len(args) // 3
+    data = args[:n]
+    mins = [jnp.reshape(a, ()) for a in args[n::2]]
+    maxs = [jnp.reshape(a, ()) for a in args[n + 1::2]]
+    out_lo = mins[0]
+    out_hi = maxs[0]
+    for lo, hi in zip(mins[1:], maxs[1:]):
+        out_lo = jnp.minimum(out_lo, lo)
+        out_hi = jnp.maximum(out_hi, hi)
+    # reference ConcatType: int8 if ANY input is int8, else uint8
+    qdt = jnp.int8 if any(d.dtype == jnp.int8 for d in data) else jnp.uint8
+    out_scale, out_zero = _q_scale(out_lo, out_hi, qdt)
+    parts = []
+    lo_q, hi_q = (0, 255) if qdt == jnp.uint8 else (-127, 127)
+    for d, lo, hi in zip(data, mins, maxs):
+        scale, zero = _q_scale(lo, hi, d.dtype)
+        real = d.astype(jnp.float32) / scale + zero   # dequantize
+        q = jnp.round((real - out_zero) * out_scale)  # requantize to union
+        parts.append(jnp.clip(q, lo_q, hi_q).astype(qdt))
+    return (jnp.concatenate(parts, axis=dim),
+            jnp.reshape(out_lo, (1,)), jnp.reshape(out_hi, (1,)))
+
+
 alias("_contrib_quantize", "quantize")
 alias("_contrib_dequantize", "dequantize")

@@ -428,6 +428,57 @@ class AdaGrad(Optimizer):
 
 
 @register
+class GroupAdaGrad(Optimizer):
+    """Row-wise AdaGrad (parity: python/mxnet/optimizer/contrib.py:31 —
+    one shared accumulator per row; weight decay unsupported). Sparse
+    gradients update lazily, touching only the gradient's rows."""
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        assert len(weight.shape) == 2, \
+            "GroupAdaGrad requires 2-d weights (rows x features)"
+        return _nd.zeros((weight.shape[0], 1), ctx=weight.ctx,
+                         dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        assert wd == 0, "Weight decay is not supported for GroupAdaGrad"
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.RowSparseNDArray):
+            import jax.numpy as jnp
+            rows, g = _sparse_grad_rows(self, grad)
+            wr = _gather_rows(weight, rows)
+            h = state._data
+            hr = h[rows] + jnp.mean(jnp.square(g), axis=1, keepdims=True)
+            state._rebind(h.at[rows].set(hr))
+            _apply_rows(weight, rows,
+                        wr - lr * g / jnp.sqrt(hr + self.float_stable_eps))
+            return
+        _nd.invoke("group_adagrad_update", [weight, grad, state],
+                   {"lr": lr, "epsilon": self.float_stable_eps,
+                    **self._clip_kw()}, out=[weight, state])
+
+    def fused_ops(self):
+        from ..ops import optimizer_ops as _O
+        import jax.numpy as jnp
+        eps, clip = self.float_stable_eps, self._clip_const()
+
+        def upd(w, g, s, lr, wd, rescale, t):
+            nw, nh = _O.group_adagrad_update(w, g, s[0], lr=lr, epsilon=eps,
+                                             rescale_grad=rescale,
+                                             clip_gradient=clip)
+            return nw, (nh,)
+        # (N, 1) matches the reference create_state layout for matrices;
+        # one accumulator per leading-dim row otherwise
+        return (lambda w: (jnp.zeros(
+            (w.shape[0], 1) if w.ndim == 2 else w.shape[:1], w.dtype),)), upd
+
+
+@register
 class AdaDelta(Optimizer):
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)

@@ -3,6 +3,7 @@ from .symbol import (Symbol, Variable, var, Group, load, load_json,
                      invoke_sym)
 from . import register as _register
 from . import linalg
+from . import contrib
 
 _register.populate(__name__)
 

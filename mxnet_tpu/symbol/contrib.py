@@ -1,0 +1,18 @@
+"""``mx.sym.contrib`` namespace: symbolic entry points for every
+registered ``_contrib_*`` operator (reference python surface:
+python/mxnet/symbol/contrib.py code-generation), resolved lazily from the
+operator registry."""
+from __future__ import annotations
+
+
+def __getattr__(name):
+    from ..ops import registry as _registry
+    from . import register as _register
+    op = _registry.get_or_none("_contrib_" + name)
+    if op is None:
+        raise AttributeError(
+            "mxnet_tpu.symbol.contrib has no attribute %r" % name)
+    fn = _register._make_op_func(op)
+    fn.__name__ = name
+    globals()[name] = fn
+    return fn

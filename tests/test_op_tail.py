@@ -1,0 +1,250 @@
+"""Operator-tail parity tests (VERDICT r3 #6): add_n/ElementWiseSum,
+reshape_like, batch_take, _slice_assign[_scalar], bipartite_matching,
+group_adagrad_update, SparseEmbedding, quantized_pooling/concat, LibSVMIter.
+
+Cases mirror the reference's unit tests
+(tests/python/unittest/test_operator.py, test_contrib_operator.py,
+test_io.py) re-expressed against this package's API.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_add_n():
+    rng = np.random.RandomState(0)
+    arrs = [mx.nd.array(rng.randn(4, 5).astype("f4")) for _ in range(5)]
+    out = mx.nd.add_n(*arrs)
+    np.testing.assert_allclose(
+        out.asnumpy(), sum(a.asnumpy() for a in arrs), rtol=1e-6)
+    out2 = mx.nd.ElementWiseSum(*arrs)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy())
+
+
+def test_add_n_symbolic_grad():
+    xs = [mx.sym.Variable("x%d" % i) for i in range(3)]
+    y = mx.sym.add_n(*xs)
+    ex = y.bind(mx.cpu(), {("x%d" % i): mx.nd.ones((2, 2)) * i
+                           for i in range(3)},
+                args_grad={("x%d" % i): mx.nd.zeros((2, 2))
+                           for i in range(3)})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               np.full((2, 2), 3.0))
+    ex.backward([mx.nd.ones((2, 2))])
+    for g in ex.grad_dict.values():
+        np.testing.assert_allclose(g.asnumpy(), np.ones((2, 2)))
+
+
+def test_reshape_like():
+    a = mx.nd.array(np.arange(6, dtype="f4"))
+    b = mx.nd.zeros((3, 2))
+    out = mx.nd.reshape_like(a, b)
+    assert out.shape == (3, 2)
+    np.testing.assert_allclose(out.asnumpy().ravel(), np.arange(6))
+
+
+def test_batch_take():
+    # reference docstring example (indexing_op.cc:748)
+    x = mx.nd.array([[1., 2.], [3., 4.], [5., 6.]])
+    out = mx.nd.batch_take(x, mx.nd.array([0, 1, 0]))
+    np.testing.assert_allclose(out.asnumpy(), [1., 4., 5.])
+
+
+def test_slice_assign_ops():
+    x = mx.nd.zeros((3, 4))
+    rhs = mx.nd.ones((2, 2))
+    out = mx.nd.invoke("_slice_assign", [x, rhs],
+                       {"begin": (0, 1), "end": (2, 3)})
+    exp = np.zeros((3, 4), "f4")
+    exp[0:2, 1:3] = 1.0
+    np.testing.assert_allclose(out.asnumpy(), exp)
+    out2 = mx.nd.invoke("_slice_assign_scalar", [x],
+                        {"scalar": 5.0, "begin": (1,), "end": (3,)})
+    exp2 = np.zeros((3, 4), "f4")
+    exp2[1:3] = 5.0
+    np.testing.assert_allclose(out2.asnumpy(), exp2)
+
+
+def test_setitem_routes_slice_assign():
+    x = mx.nd.zeros((3, 4))
+    x[0:2, 1:3] = 7.0
+    exp = np.zeros((3, 4), "f4")
+    exp[0:2, 1:3] = 7.0
+    np.testing.assert_allclose(x.asnumpy(), exp)
+    x[1] = mx.nd.array(np.arange(4, dtype="f4"))
+    exp[1] = np.arange(4)
+    np.testing.assert_allclose(x.asnumpy(), exp)
+    x[:, ::2] = -1.0
+    exp[:, ::2] = -1.0
+    np.testing.assert_allclose(x.asnumpy(), exp)
+
+
+def test_bipartite_matching():
+    # both cases from the reference test_contrib_operator.py:235-245
+    inp = mx.nd.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]])
+    a, b = mx.nd.invoke("_contrib_bipartite_matching", [inp],
+                        {"threshold": 1e-12, "is_ascend": False})
+    np.testing.assert_array_equal(a.asnumpy().astype("i8"), [1, -1, 0])
+    np.testing.assert_array_equal(b.asnumpy().astype("i8"), [2, 0])
+    a, b = mx.nd.invoke("_contrib_bipartite_matching", [inp],
+                        {"threshold": 100, "is_ascend": True})
+    np.testing.assert_array_equal(a.asnumpy().astype("i8"), [-1, 0, 1])
+    np.testing.assert_array_equal(b.asnumpy().astype("i8"), [1, 2])
+
+
+def test_bipartite_matching_batched_topk():
+    rng = np.random.RandomState(7)
+    s = rng.rand(2, 4, 5).astype("f4")
+    a, b = mx.nd.invoke("_contrib_bipartite_matching", [mx.nd.array(s)],
+                        {"threshold": 1e-12, "topk": 2})
+    a, b = a.asnumpy(), b.asnumpy()
+    assert a.shape == (2, 4) and b.shape == (2, 5)
+    for i in range(2):
+        # every match is mutual and scores decrease along the greedy order
+        for r, c in enumerate(a[i]):
+            if c >= 0:
+                assert b[i, int(c)] == r
+
+
+def test_group_adagrad_update_matches_formula():
+    rng = np.random.RandomState(1)
+    w = rng.randn(6, 3).astype("f4")
+    g = rng.randn(6, 3).astype("f4")
+    h = np.zeros((6, 1), "f4")
+    nw, nh = mx.nd.invoke("group_adagrad_update",
+                          [mx.nd.array(w), mx.nd.array(g), mx.nd.array(h)],
+                          {"lr": 0.1, "epsilon": 1e-5})
+    exp_h = h + np.mean(np.square(g), axis=1, keepdims=True)
+    exp_w = w - 0.1 * g / np.sqrt(exp_h + 1e-5)
+    np.testing.assert_allclose(nh.asnumpy(), exp_h, rtol=1e-5)
+    np.testing.assert_allclose(nw.asnumpy(), exp_w, rtol=1e-5)
+
+
+def test_group_adagrad_optimizer_dense_and_fused():
+    opt = mx.optimizer.create("groupadagrad", learning_rate=0.1, wd=0.0)
+    assert opt.fused_ops() is not None
+    w = mx.nd.array(np.ones((4, 2), "f4"))
+    g = mx.nd.array(np.full((4, 2), 0.5, "f4"))
+    st = opt.create_state(0, w)
+    assert st.shape == (4, 1)
+    opt.update(0, w, g, st)
+    exp_h = 0.25
+    exp_w = 1.0 - 0.1 * 0.5 / np.sqrt(exp_h + 1e-5)
+    np.testing.assert_allclose(w.asnumpy(), np.full((4, 2), exp_w),
+                               rtol=1e-5)
+
+
+def test_sparse_embedding_forward():
+    w = mx.nd.array(np.arange(12, dtype="f4").reshape(4, 3))
+    d = mx.nd.array([2, 0])
+    out = mx.nd.invoke("_contrib_SparseEmbedding", [d, w],
+                       {"input_dim": 4, "output_dim": 3})
+    np.testing.assert_allclose(out.asnumpy(),
+                               [[6., 7., 8.], [0., 1., 2.]])
+
+
+def test_quantized_pooling():
+    d = mx.nd.array(np.array([[[[10, 20], [30, 40]]]], "u1"), dtype="uint8")
+    out, lo, hi = mx.nd.invoke(
+        "_contrib_quantized_pooling",
+        [d, mx.nd.array([0.]), mx.nd.array([6.])],
+        {"kernel": (2, 2), "pool_type": "max"})
+    assert out.asnumpy()[0, 0, 0, 0] == 40
+    assert float(lo.asscalar()) == 0. and float(hi.asscalar()) == 6.
+    out, _, _ = mx.nd.invoke(
+        "_contrib_quantized_pooling",
+        [d, mx.nd.array([0.]), mx.nd.array([6.])],
+        {"kernel": (2, 2), "pool_type": "avg"})
+    assert out.asnumpy()[0, 0, 0, 0] == 25
+
+
+def test_quantized_concat_rescales():
+    a = mx.nd.array(np.array([[127, -127]], "i1"), dtype="int8")   # [-1, 1]
+    b = mx.nd.array(np.array([[127, 64]], "i1"), dtype="int8")     # [-2, 2]
+    out, lo, hi = mx.nd.invoke(
+        "_contrib_quantized_concat",
+        [a, b, mx.nd.array([-1.]), mx.nd.array([1.]),
+         mx.nd.array([-2.]), mx.nd.array([2.])], {"dim": 1})
+    assert float(lo.asscalar()) == -2. and float(hi.asscalar()) == 2.
+    # first input's codes are halved into the union range
+    np.testing.assert_array_equal(out.asnumpy()[0, :2], [64, -64])
+    np.testing.assert_array_equal(out.asnumpy()[0, 2:], [127, 64])
+
+
+def _write_libsvm(lines):
+    f = tempfile.NamedTemporaryFile("w", suffix=".libsvm", delete=False)
+    f.write("\n".join(lines) + "\n")
+    f.close()
+    return f.name
+
+
+def test_libsvm_iter_basic():
+    path = _write_libsvm(["1 0:0.5 3:1.2", "0 1:2.0", "1 2:-1.0 3:0.1",
+                          "0 0:4.0", "1 1:1.0"])
+    try:
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                              batch_size=2)
+        batches = list(it)
+        assert len(batches) == 3
+        d0 = batches[0].data[0]
+        assert type(d0).__name__ == "CSRNDArray"
+        np.testing.assert_allclose(
+            d0.asnumpy(), [[0.5, 0, 0, 1.2], [0, 2.0, 0, 0]])
+        np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1., 0.])
+        assert batches[-1].pad == 1  # last batch wrapped one row
+    finally:
+        os.unlink(path)
+
+
+def test_libsvm_iter_sharding_and_label_file():
+    data = _write_libsvm(["1 0:1", "2 1:1", "3 2:1", "4 0:2"])
+    lab = _write_libsvm(["0:1 1:1", "1:1", "2:1", "0:5"])
+    try:
+        parts = []
+        for pi in range(2):
+            it = mx.io.LibSVMIter(data_libsvm=data, data_shape=(3,),
+                                  label_libsvm=lab, label_shape=(3,),
+                                  batch_size=2, num_parts=2, part_index=pi)
+            for b in it:
+                parts.append((b.data[0].asnumpy(), b.label[0].asnumpy()))
+        # two parts of two rows each; labels come from the label file (CSR)
+        assert len(parts) == 2
+        np.testing.assert_allclose(parts[0][1],
+                                   [[1., 1., 0.], [0., 1., 0.]])
+        np.testing.assert_allclose(parts[1][0],
+                                   [[0., 0., 1.], [2., 0., 0.]])
+    finally:
+        os.unlink(data)
+        os.unlink(lab)
+
+
+def test_libsvm_iter_smaller_than_batch():
+    path = _write_libsvm(["1 0:1", "0 1:2", "1 2:3"])
+    try:
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(3,),
+                              batch_size=8)
+        b = next(iter(it))
+        assert b.data[0].shape == (8, 3)  # wrapped modulo the 3 rows
+        np.testing.assert_allclose(b.data[0].asnumpy()[3],
+                                   b.data[0].asnumpy()[0])
+        assert b.label[0].shape == it.provide_label[0].shape[:1]
+    finally:
+        os.unlink(path)
+
+
+def test_libsvm_iter_validates():
+    path = _write_libsvm(["1 0:1"])
+    try:
+        with pytest.raises(ValueError):
+            mx.io.LibSVMIter(data_libsvm=path, data_shape=(2, 2),
+                             batch_size=1)
+        with pytest.raises(ValueError):
+            mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                             label_shape=(3,), batch_size=1)
+    finally:
+        os.unlink(path)
