@@ -13,6 +13,7 @@ FInferShape backward-inference, e.g. fully_connected.cc weight shape).
 from __future__ import annotations
 
 import json
+import logging
 
 import numpy as _np
 
@@ -315,20 +316,31 @@ class Symbol:
                     % (n.op.name, n.name))
         nid = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []
+        row_ptr = [0]
         for n in nodes:
-            jnodes.append({
+            attrs = {k: _attr_str(k, v) for k, v in n.params.items()}
+            attrs.update({k: _attr_str(k, v) for k, v in n.attrs.items()})
+            jn = {
                 "op": "null" if n.is_variable else n.op.name,
                 "name": n.name,
-                "attrs": ({k: json.dumps(_jsonable(v)) for k, v in n.params.items()}
-                          if n.params else {}),
-                "user_attrs": dict(n.attrs),
                 "inputs": [[nid[id(src)], oi, 0] for (src, oi) in n.inputs],
-            })
+            }
+            if attrs:
+                jn["attrs"] = attrs
+            jnodes.append(jn)
+            nout = 1 if n.is_variable else n.op.resolve_num_outputs(n.params)
+            row_ptr.append(row_ptr[-1] + nout)
         arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
         heads = [[nid[id(n)], oi, 0] for (n, oi) in self._entries]
+        # the on-disk layout is the reference's
+        # (python/mxnet/symbol/symbol.py save / src/nnvm graph serialization:
+        # repr-string attr values, node_row_ptr, ["int", version] attrs) so a
+        # prefix-symbol.json written here loads in reference MXNet and vice
+        # versa (loader: load_json below, incl. legacy_json_util.cc upgrades)
         return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
-                           "heads": heads,
-                           "attrs": {"mxnet_tpu_version": "0.1.0"}}, indent=2)
+                           "node_row_ptr": row_ptr, "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10400]}},
+                          indent=2)
 
     def save(self, fname):
         with open(fname, "w") as f:
@@ -337,14 +349,6 @@ class Symbol:
     # ------------------------------------------------------------- gradient
     def gradient(self, wrt):  # kept for parity; bind-time autodiff is primary
         raise NotImplementedError("use executor.backward (jax.vjp at bind)")
-
-
-def _jsonable(v):
-    if isinstance(v, tuple):
-        return list(v)
-    if isinstance(v, _np.dtype):
-        return str(v)
-    return v
 
 
 def _sym_binary(op_name, scalar_op, lhs, rhs):
@@ -535,19 +539,159 @@ def load(fname):
         return load_json(f.read())
 
 
+# MXNet's on-disk dtype enum (reference python/mxnet/base.py _DTYPE_MX_TO_NP)
+_MX_DTYPE_CODE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                  "int32": 4, "int8": 5, "int64": 6}
+_MX_CODE_DTYPE = {v: k for k, v in _MX_DTYPE_CODE.items()}
+
+# attr keys the reference hides as __key__ (c_api_symbolic.cc:41)
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage")
+# reference-era op params with no analog in the XLA lowering: dropping them
+# changes nothing about the math (tuning/workspace knobs for cuDNN/MKLDNN)
+_IGNORABLE_PARAMS = frozenset(
+    ["workspace", "cudnn_tune", "cudnn_off", "key_var_num_args",
+     # variadic-op arg count: implied by the JSON inputs list
+     "num_args"])
+
+
+def _attr_str(key, v):
+    """Render one attr value the way reference JSON stores it (repr-string;
+    __dtype__ as the dtype enum code)."""
+    if key == "__dtype__":
+        name = str(v)
+        return str(_MX_DTYPE_CODE.get(name, name))
+    if isinstance(v, str):
+        return v
+    return str(v)
+
+
+def _attr_parse(raw):
+    """Best-effort parse of one attr value: accepts this package's legacy
+    json-encoded values AND the reference's repr-strings ("(3, 3)", "True",
+    "64", "relu")."""
+    if not isinstance(raw, str):
+        return _untuple(raw)
+    try:
+        return _untuple(json.loads(raw))
+    except (json.JSONDecodeError, ValueError):
+        pass
+    try:
+        import ast
+        return _untuple(ast.literal_eval(raw))
+    except (ValueError, SyntaxError):
+        return raw
+
+
+def _user_attr_parse(key, raw):
+    """User (dunder) attrs mostly stay strings; __shape__ and __dtype__
+    are structural and get normalized for the shape/dtype inference."""
+    if key == "__shape__":
+        v = _attr_parse(raw)
+        return tuple(v) if isinstance(v, (tuple, list)) else v
+    if key == "__dtype__":
+        v = _attr_parse(raw)
+        if isinstance(v, int):
+            return _MX_CODE_DTYPE.get(v, "float32")
+        return raw
+    if isinstance(raw, str):
+        return raw
+    return _untuple(raw)
+
+
+_warned_params = set()
+
+
+def _filter_params(opname, fn, params):
+    """Drop params the lowering does not accept (reference-era backend
+    knobs). Anything else unknown raises — silently eating a semantic
+    param would load a different model."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return params
+    if any(p.kind == inspect.Parameter.VAR_KEYWORD
+           for p in sig.parameters.values()):
+        return params
+    known = set(sig.parameters)
+    out = {}
+    for k, v in params.items():
+        if k in known:
+            out[k] = v
+        elif k in _IGNORABLE_PARAMS:
+            if (opname, k) not in _warned_params:
+                _warned_params.add((opname, k))
+                logging.getLogger("mxnet_tpu").debug(
+                    "load_json: dropping backend-tuning param %s.%s=%r",
+                    opname, k, v)
+        else:
+            raise MXNetError(
+                "load_json: op %r has no parameter %r (value %r). If this "
+                "is a backend-tuning knob of the reference, add it to "
+                "_IGNORABLE_PARAMS." % (opname, k, v))
+    return out
+
+
 def load_json(json_str):
+    """Parse a symbol JSON — this package's own files or reference MXNet
+    `prefix-symbol.json` files (format of python/mxnet/symbol save;
+    upgrades of src/nnvm/legacy_json_util.cc:49-155: repr-string attrs
+    under "attrs"/"attr"/"param", hidden keys like `weight_lr_mult`
+    re-homed onto the matching input variable, dtype enum codes)."""
     data = json.loads(json_str)
     nodes = []
     for jn in data["nodes"]:
+        raw = jn.get("attrs", jn.get("attr", jn.get("param", {}))) or {}
+        user = {k: _user_attr_parse(k, v) for k, v in raw.items()
+                if k.startswith("__") and k.endswith("__")}
+        # legacy bare hidden keys ("lr_mult") -> "__lr_mult__"
+        # (UpgradeJSON_FixParsing, legacy_json_util.cc:49)
+        for hk in _HIDDEN_KEYS:
+            if hk in raw:
+                user["__%s__" % hk] = _user_attr_parse("__%s__" % hk,
+                                                       raw[hk])
+        # own legacy format kept user attrs in a separate dict
+        for k, v in jn.get("user_attrs", {}).items():
+            user[k] = _user_attr_parse(k, v)
         if jn["op"] == "null":
-            node = Node(None, jn["name"], [], {}, jn.get("user_attrs", {}))
+            node = Node(None, jn["name"], [], {}, user)
         else:
-            params = {k: _untuple(json.loads(v)) for k, v in jn.get("attrs", {}).items()}
-            inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
-            node = Node(_registry.get(jn["op"]), jn["name"], inputs, params,
-                        jn.get("user_attrs", {}))
+            deferred = {}   # suffixed hidden keys: weight_lr_mult etc.
+            params = {}
+            for k, v in raw.items():
+                if k.startswith("__") and k.endswith("__"):
+                    continue
+                hit = [hk for hk in _HIDDEN_KEYS
+                       if k == hk or k.endswith("_" + hk)]
+                if hit:
+                    deferred[k] = (hit[0], v)
+                    continue
+                params[k] = _attr_parse(v)
+            op = _registry.get(jn["op"])
+            params = _filter_params(jn["op"], op.fn, params)
+            inputs = [(nodes[i], jin[1] if len(jin) > 1 else 0)
+                      for jin in jn["inputs"]
+                      for i in [jin[0]]]
+            node = Node(op, jn["name"], inputs, params, user)
+            # re-home "argname_lr_mult" onto the input variable whose name
+            # ends with "_argname" (legacy_json_util.cc:77-105 uses
+            # FListInputNames; variable naming follows op_name + '_' + arg)
+            for k, (hk, v) in deferred.items():
+                if k == hk:
+                    continue  # already handled as bare key above
+                argname = k[: -(len(hk) + 1)]
+                tgt = [src for src, _ in inputs
+                       if src.is_variable
+                       and src.name.endswith("_" + argname)]
+                if len(tgt) == 1:
+                    tgt[0].attrs["__%s__" % hk] = \
+                        _user_attr_parse("__%s__" % hk, v)
+                else:
+                    node.attrs[k] = v  # keep; better than dropping
         nodes.append(node)
-    entries = [(nodes[i], oi) for i, oi, _ in data["heads"]]
+    entries = [(nodes[jh[0]], jh[1] if len(jh) > 1 else 0)
+               for jh in data["heads"]]
     return Symbol(entries)
 
 
