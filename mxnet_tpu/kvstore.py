@@ -46,13 +46,50 @@ class KVStore:
         self._optimizer = None
         self._compression_params = None
         self._residuals = {}
+        self._last_wire_bytes = None   # observability: payload of last push
         # dist_*: join the launcher's process group (reference: ps-lite van
         # connects on kvstore_dist construction); cross-process reduction
         # then happens in push. Single-process dist degrades to local.
         self._dist = False
+        self._async_server = None
+        self._async_client = None
         if kv_type.startswith("dist"):
             from .parallel import dist as _dist
             self._dist = _dist.init() and _dist.num_workers() > 1
+        if self._dist and kv_type == "dist_async":
+            self._start_async()
+
+    def _start_async(self):
+        """dist_async topology: rank 0 hosts the apply-on-push server
+        thread (parallel/async_server.py), every rank connects a client.
+        One startup broadcast shares the port; after that there are NO
+        inter-worker barriers — each rank pushes/pulls at its own pace
+        (reference kvstore_dist_server.h:348-358 ApplyUpdates async arm)."""
+        import os
+        import numpy as _np2
+        from .parallel import dist as _dist
+        from .parallel import async_server as _async
+        if _dist.rank() == 0:
+            self._async_server = _async.Server()
+            port = self._async_server.port
+        else:
+            port = 0
+        port = int(_np2.asarray(
+            _dist.broadcast(_np2.array([port], _np2.int32)))[0])
+        host = os.environ.get("MXNET_ASYNC_SERVER_HOST")
+        if host is None:
+            addr = _dist.env_spec()[0]
+            if addr is None:
+                # externally-initialized jax.distributed: reuse the
+                # coordinator host it actually dialed (rank 0's machine —
+                # the same machine hosting the async server thread)
+                try:
+                    from jax._src import distributed as _jd
+                    addr = _jd.global_state.coordinator_address
+                except Exception:
+                    addr = None
+            host = addr.rsplit(":", 1)[0] if addr else "127.0.0.1"
+        self._async_client = _async.Client(host, port)
 
     # ------------------------------------------------------------- metadata
     @property
@@ -85,6 +122,12 @@ class KVStore:
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
             v0 = v[0] if isinstance(v, list) else v
+            if self._async_client is not None:
+                dense = v0.todense() if isinstance(
+                    v0, _sp.BaseSparseNDArray) else v0
+                self._async_client.call("init", k, dense.asnumpy())
+                self._store[k] = v0.copy()  # shape/dtype template for pull
+                continue
             if self._dist:
                 # reference: init lands on the server once; here rank 0's
                 # value is broadcast so every replica starts identical
@@ -106,13 +149,23 @@ class KVStore:
             if not isinstance(vs, list):
                 vs = [vs]
             agg = self._reduce(vs)
-            if self._compression_params:
-                # compress on the worker BEFORE the wire (reference
-                # gradient_compression.h: quantize worker-side, server sums
-                # quantized grads); residual error-feedback stays local
-                agg = self._compress(k, agg)
-            if self._dist:
-                agg = self._dist_reduce(agg)
+            if self._async_client is not None:
+                self._push_async(k, agg)
+                continue
+            if self._compression_params and self._dist and \
+                    not isinstance(agg, _sp.BaseSparseNDArray):
+                # wire-level path: 2-bit codes packed 4-per-uint8 cross the
+                # network (~16x smaller than f32), summed after unpacking
+                # (reference gradient_compression.h:38-132 ships quantized
+                # data the same way); residual error-feedback stays local
+                agg = self._dist_reduce_2bit(k, agg)
+            else:
+                if self._compression_params:
+                    # in-process: same quantize->dequantize roundtrip, so
+                    # convergence behavior matches the dist path
+                    agg = self._compress(k, agg)
+                if self._dist:
+                    agg = self._dist_reduce(agg)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("key %r not initialized" % k)
@@ -122,6 +175,47 @@ class KVStore:
                 # (reference kvstore_local.h PushImpl `local = merged`;
                 # python/mxnet/kvstore.py push docstring examples)
                 self._store[k] = agg
+
+    def _push_async(self, k, agg):
+        """dist_async: ship this worker's gradient to the server, which
+        applies it immediately — no cross-worker reduce, no barrier."""
+        if isinstance(agg, _sp.BaseSparseNDArray):
+            agg = agg.todense()
+        if self._compression_params:
+            packed, shape, thr = self._quantize_wire(k, agg)
+            self._last_wire_bytes = packed.nbytes
+            self._async_client.call("pushq", k, packed, shape, thr)
+        else:
+            g = agg.asnumpy()
+            self._last_wire_bytes = g.nbytes
+            self._async_client.call("push", k, g)
+
+    def _quantize_wire(self, key, grad):
+        """Worker-side 2-bit quantization producing the PACKED wire form
+        (4 codes per uint8). Residual error-feedback is kept locally."""
+        import jax.numpy as jnp
+        thr = self._compression_params["threshold"]
+        g = grad._data if isinstance(grad, NDArray) else jnp.asarray(grad)
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros_like(g)
+        packed, new_res = _pack_2bit(g, res, thr)
+        self._residuals[key] = new_res
+        return _np.asarray(packed), tuple(g.shape), thr
+
+    def _dist_reduce_2bit(self, key, agg):
+        """dist_sync with compression: allgather the packed codes (the
+        only cross-network payload), unpack+dequantize+sum locally."""
+        from .parallel import dist as _dist
+        packed, shape, thr = self._quantize_wire(key, agg)
+        self._last_wire_bytes = packed.nbytes
+        gathered = _np.asarray(_dist.allgather(packed))   # (W, nbytes)
+        total = None
+        for row in gathered:
+            d = _dequantize_2bit(row, shape, thr)
+            total = d if total is None else total + d
+        import jax.numpy as jnp
+        return NDArray(jnp.asarray(total), ctx=agg.context)
 
     def _dist_reduce(self, agg):
         """Cross-process sum (the reference's worker->server aggregation,
@@ -160,7 +254,16 @@ class KVStore:
         for k, os_ in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
-            src = self._store[k]
+            if self._async_client is not None:
+                # async: fetch whatever the server's weights are RIGHT NOW
+                import jax.numpy as jnp
+                cur = self._async_client.call("pull", k)
+                tmpl = self._store[k]
+                src = NDArray(jnp.asarray(cur), ctx=tmpl.context)
+                if isinstance(tmpl, _sp.BaseSparseNDArray):
+                    src = _sp.cast_storage(src, tmpl.stype)
+            else:
+                src = self._store[k]
             if isinstance(src, _sp.RowSparseNDArray) and ignore_sparse:
                 continue
             if not isinstance(os_, list):
@@ -208,6 +311,13 @@ class KVStore:
     # ------------------------------------------------------------ optimizer
     def set_optimizer(self, optimizer):
         self._optimizer = optimizer
+        if self._async_client is not None:
+            # the update lives on the server (reference: kvstore.py
+            # set_optimizer pickles the optimizer to the dist servers);
+            # workers keep NO local updater — push applies remotely
+            self._async_client.call("set_optimizer", pickle.dumps(optimizer))
+            self._updater = None
+            return
         self._updater = _opt.get_updater(optimizer)
 
     def _set_updater(self, updater):
@@ -256,7 +366,53 @@ class KVStore:
         _dist.barrier()
 
     def _send_command_to_servers(self, head, body):
-        pass
+        """Control message to the server group (reference
+        include/mxnet/kvstore.h:49 — kSetOptimizer/profiler commands).
+        Real for dist_async (delivered to the rank-0 server thread);
+        refused loudly elsewhere — the other modes HAVE no server, and
+        silently dropping a control message would fake success."""
+        if self._async_client is not None:
+            self._async_client.call("command", head, body)
+            return
+        raise MXNetError(
+            "kvstore type %r has no parameter server to command "
+            "(server-side control messages exist only for dist_async; "
+            "sync modes run their updates inside the compiled step)"
+            % self._type)
+
+
+def _pack_2bit(g, res, thr):
+    """Quantize g+res to {-thr, 0, +thr} and pack the 2-bit codes four per
+    uint8 (code 1 = +thr, 2 = -thr, 0 = zero). Returns (packed uint8
+    array, new residual). Pure jnp, so the whole thing is one fused XLA
+    program on the accelerator before the bytes ever hit the host/wire
+    (reference gradient_compression.cc packs on-device the same way)."""
+    import jax.numpy as jnp
+    acc = g + res
+    plus = acc >= thr
+    minus = acc <= -thr
+    q = jnp.where(plus, thr, jnp.where(minus, -thr, 0.0)).astype(g.dtype)
+    codes = (plus.astype(jnp.uint8) + 2 * minus.astype(jnp.uint8)).ravel()
+    pad = (-codes.size) % 4
+    if pad:
+        codes = jnp.concatenate([codes, jnp.zeros((pad,), jnp.uint8)])
+    c = codes.reshape(-1, 4)
+    packed = (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4) | (c[:, 3] << 6))
+    return packed.astype(jnp.uint8), acc - q
+
+
+def _dequantize_2bit(packed, shape, thr):
+    """Unpack uint8-packed 2-bit codes back to a float32 array of
+    ``shape`` (host-side numpy: runs on whichever end of the wire)."""
+    packed = _np.asarray(packed, dtype=_np.uint8)
+    n = int(_np.prod(shape)) if shape else 1
+    codes = _np.empty((packed.size, 4), _np.uint8)
+    codes[:, 0] = packed & 3
+    codes[:, 1] = (packed >> 2) & 3
+    codes[:, 2] = (packed >> 4) & 3
+    codes[:, 3] = (packed >> 6) & 3
+    lut = _np.array([0.0, thr, -thr, 0.0], _np.float32)
+    return lut[codes.ravel()[:n]].reshape(shape)
 
 
 def _key_value(key, value):

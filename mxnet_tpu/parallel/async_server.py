@@ -1,0 +1,194 @@
+"""Asynchronous parameter server for ``kvstore='dist_async'``.
+
+Reference semantics being reproduced (src/kvstore/kvstore_dist_server.h:348-358
+``ApplyUpdates``): in async mode the server applies EVERY worker push to the
+global weights immediately — no aggregation barrier, no waiting for the other
+workers — and pulls return whatever the weights are right now. Workers
+therefore progress at their own pace (Hogwild-style bounded staleness).
+
+TPU-native placement: the reference runs dedicated server *processes*
+(ps-lite); here the server is a background THREAD on rank 0 speaking a tiny
+length-prefixed-pickle TCP protocol. Rationale: the synchronous fast path
+does not need a server at all (GSPMD collectives inside the fused step), so
+the async path only has to serve the eager kvstore surface — a host thread
+next to rank 0's chip is the lightest faithful topology, and the update math
+runs through the same Optimizer/Updater code the local kvstore uses (the
+reference pickles the optimizer to the server the same way,
+python/mxnet/kvstore.py set_optimizer).
+
+Protocol messages (all pickled tuples): ("init", key, np_value),
+("push", key, np_grad), ("pull", key), ("set_optimizer", bytes),
+("command", head, body), ("stats",), ("shutdown",).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as _np
+
+__all__ = ["Server", "Client"]
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class Server:
+    """Rank-0 async parameter server thread."""
+
+    def __init__(self):
+        self._store = {}          # key -> np.ndarray (current weights)
+        self._updater = None
+        self._locks = {}          # per-key: pushes to different keys overlap
+        self._glock = threading.Lock()
+        self._push_log = []       # (monotonic_ts, key) — test observability
+        self._commands = []
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        reply = outer._dispatch(msg)
+                        _send_msg(self.request, reply)
+                        if msg[0] == "shutdown":
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class TS(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        # all interfaces: workers dial the coordinator host's address on
+        # multi-host fleets, not loopback
+        self._srv = TS(("0.0.0.0", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True,
+                                        name="mxnet_tpu-async-server")
+        self._thread.start()
+
+    # ------------------------------------------------------------- dispatch
+    def _key_lock(self, key):
+        with self._glock:
+            return self._locks.setdefault(key, threading.Lock())
+
+    def _dispatch(self, msg):
+        import time
+        op = msg[0]
+        if op == "init":
+            _, key, value = msg
+            with self._key_lock(key):
+                # first writer wins (reference server: init is idempotent)
+                self._store.setdefault(key, _np.array(value))
+            return ("ok",)
+        if op == "push":
+            _, key, grad = msg
+            return self._handle_push(key, grad, time)
+        if op == "pushq":
+            # 2-bit wire-compressed push: the worker shipped PACKED codes
+            # (~16x smaller than f32); dequantize server-side
+            from ..kvstore import _dequantize_2bit
+            _, key, packed, shape, thr = msg
+            return self._handle_push(
+                key, _dequantize_2bit(packed, shape, thr), time)
+        if op == "pull":
+            _, key = msg
+            with self._key_lock(key):
+                if key not in self._store:
+                    return ("err", "key %r not initialized" % key)
+                return ("ok", _np.array(self._store[key]))
+        if op == "set_optimizer":
+            from .. import optimizer as _opt
+            optimizer = pickle.loads(msg[1])
+            self._updater = _opt.get_updater(optimizer)
+            return ("ok",)
+        if op == "command":
+            # reference kSetOptimizer-style control messages
+            # (include/mxnet/kvstore.h:49); recorded and ack'd
+            self._commands.append((msg[1], msg[2]))
+            return ("ok",)
+        if op == "stats":
+            return ("ok", {"pushes": list(self._push_log),
+                           "commands": list(self._commands)})
+        if op == "shutdown":
+            threading.Thread(target=self._srv.shutdown,
+                             daemon=True).start()
+            return ("ok",)
+        return ("err", "unknown op %r" % (op,))
+
+    def _handle_push(self, key, grad, time):
+        with self._key_lock(key):
+            if key not in self._store:
+                return ("err", "key %r not initialized" % key)
+            if self._updater is None:
+                self._store[key] = _np.array(grad)
+            else:
+                self._apply(key, grad)
+        self._push_log.append((time.monotonic(), key))
+        return ("ok",)
+
+    def _apply(self, key, grad):
+        """Apply one push through the real Updater — identical math to the
+        local kvstore path (reference server reuses the optimizer op too)."""
+        from ..ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+        w = NDArray(jnp.asarray(self._store[key]))
+        g = NDArray(jnp.asarray(grad))
+        self._updater(_key_int(key), g, w)
+        self._store[key] = _np.asarray(w._data)
+
+
+def _key_int(key):
+    """Updaters index per-key optimizer state by int when possible."""
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return key
+
+
+class Client:
+    """One worker's connection to the async server."""
+
+    def __init__(self, host, port, timeout=60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._lock = threading.Lock()
+
+    def call(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] != "ok":
+            from ..base import MXNetError
+            raise MXNetError("async server: %s" % (reply[1],))
+        return reply[1] if len(reply) > 1 else None
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

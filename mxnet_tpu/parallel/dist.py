@@ -37,7 +37,7 @@ import os
 import numpy as _np
 
 __all__ = ["init", "initialized", "rank", "num_workers", "barrier",
-           "allreduce_sum", "broadcast", "env_spec"]
+           "allreduce_sum", "allgather", "broadcast", "env_spec"]
 
 _INITIALIZED = False
 
@@ -155,16 +155,64 @@ def barrier(tag="mxnet_tpu_barrier"):
 def allreduce_sum(value):
     """Sum an array over all processes; every rank gets the result.
 
-    value: numpy/jax array (host or device). Returns a jax array. The
-    collective is an all-gather + on-host-group sum — the kvstore
-    compatibility path; fused SPMD programs get their reductions from
-    GSPMD instead.
+    A REAL compiled collective: one device per process forms a global
+    ("p",) mesh, the per-process value becomes that process's shard of a
+    global array, and a jitted sum over the sharded axis lowers to an XLA
+    AllReduce riding DCN (Gloo on CPU fleets) — O(1) memory per rank and
+    no host round-trip, unlike an allgather+host-sum (the reference's
+    analog is the ps-lite server aggregation; kvstore_dist.h:44).
     """
     if not initialized():
         return value
+    import jax
+    import jax.numpy as jnp
+    try:
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+        mesh, fn = _reducer()
+        v = jnp.asarray(value)
+        garr = multihost_utils.host_local_array_to_global_array(
+            v[None], mesh, P("p"))
+        return fn(garr).addressable_data(0)
+    except Exception:
+        # defensive fallback (odd dtypes/backends): the gather path is
+        # always correct, just not bandwidth-optimal
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(value)
+        return jnp.asarray(gathered.sum(axis=0, dtype=gathered.dtype))
+
+
+_REDUCER = None
+
+
+def _reducer():
+    """(mesh, jitted sum-over-'p') — built ONCE per process: jax.jit's
+    cache is keyed on function identity, so a fresh lambda per call would
+    retrace and recompile on every gradient push."""
+    global _REDUCER
+    if _REDUCER is None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = _np.array([per_proc[p] for p in sorted(per_proc)])
+        mesh = Mesh(devs, ("p",))
+        fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                     in_shardings=NamedSharding(mesh, P("p")),
+                     out_shardings=NamedSharding(mesh, P()))
+        _REDUCER = (mesh, fn)
+    return _REDUCER
+
+
+def allgather(value):
+    """Gather per-process arrays to every rank: returns (world, ...)."""
+    if not initialized():
+        import jax.numpy as jnp
+        return jnp.asarray(value)[None]
     from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(value)
-    return gathered.sum(axis=0, dtype=gathered.dtype)
+    return multihost_utils.process_allgather(value)
 
 
 def broadcast(value, root=0):

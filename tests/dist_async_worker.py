@@ -1,0 +1,82 @@
+"""Worker for the N-process dist_async kvstore test.
+
+Demonstrates what the reference's async server arm guarantees
+(src/kvstore/kvstore_dist_server.h:348-358): every push applies to the
+global weights IMMEDIATELY, with no cross-worker barrier — so a fast
+worker completes all its pushes while a slow worker is still sleeping,
+which is impossible under dist_sync (where push is collective).
+
+Run: python tools/launch.py -n 2 python tests/dist_async_worker.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+SHAPE = (4, 3)
+FAST_PUSHES = 5
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker >= 2
+
+    kv.init("w", mx.nd.zeros(SHAPE))
+    # server-side optimizer: plain SGD lr=1 => weight -= grad per push
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0))
+    assert kv._updater is None, "async worker must not update locally"
+    kv._barrier()  # line up the start, then NO further barriers
+
+    t0 = time.monotonic()
+    if rank == 0:
+        # fast worker: burst of pushes, each applied on arrival
+        for _ in range(FAST_PUSHES):
+            kv.push("w", mx.nd.ones(SHAPE))
+        t_done = time.monotonic()
+        # server already reflects OUR pushes even though rank 1 is asleep
+        out = mx.nd.zeros(SHAPE)
+        kv.pull("w", out=out)
+        seen = -out.asnumpy()[0, 0]
+        assert FAST_PUSHES <= seen < FAST_PUSHES + 1, seen
+        assert t_done - t0 < 2.0, (
+            "fast worker stalled %.1fs: pushes are barriered, not async"
+            % (t_done - t0))
+        print("rank 0: %d async pushes applied in %.2fs without waiting"
+              % (FAST_PUSHES, t_done - t0))
+    else:
+        time.sleep(3.0)
+        kv.push("w", mx.nd.ones(SHAPE))
+
+    kv._barrier()  # drain: everyone finished pushing
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    total = -out.asnumpy()[0, 0]
+    expected = FAST_PUSHES + (nworker - 1)
+    assert total == expected, (total, expected)
+
+    # server-side push log proves ordering: all of rank 0's pushes landed
+    # before the slow worker's single one
+    if rank == 0:
+        stats = kv._async_client.call("stats")
+        times = [t for t, _ in stats["pushes"]]
+        assert len(times) == expected
+        assert times[FAST_PUSHES - 1] < times[-1] - 2.0, (
+            "slow worker's push should arrive seconds after the burst")
+        kv._send_command_to_servers(0, "profile_on")
+        stats = kv._async_client.call("stats")
+        assert stats["commands"] == [(0, "profile_on")]
+    print("rank %d/%d: all dist_async invariants OK" % (rank, nworker))
+
+
+if __name__ == "__main__":
+    main()
