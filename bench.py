@@ -46,17 +46,53 @@ def _peak_flops(device_kind: str) -> float:
     return 197e12  # assume v5e
 
 
-def probe_tpu(timeout: float) -> bool:
-    """Check TPU liveness in a subprocess (a hung PJRT init can't be
-    interrupted in-process)."""
+def probe_tpu(deadline_s: float, attempt_timeout: float) -> bool:
+    """Retry TPU liveness probes (each in a subprocess — a hung PJRT init
+    can't be interrupted in-process) until a hard wall-clock deadline.
+
+    One timed-out attempt must NOT condemn the round to a CPU number: the
+    tunnel has been observed to need several minutes after idle, and a
+    killed probe process releases the relay so the next attempt can win.
+    """
     code = ("import jax; d = jax.devices(); "
             "assert d[0].platform != 'cpu'; print(d[0].device_kind)")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True, text=True)
-        return r.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    t_end = time.monotonic() + deadline_s
+    attempt = 0
+    while time.monotonic() < t_end:
+        attempt += 1
+        budget = min(attempt_timeout, max(30.0, t_end - time.monotonic()))
+        try:
+            r = subprocess.run([sys.executable, "-c", code], timeout=budget,
+                               capture_output=True, text=True)
+            if r.returncode == 0:
+                return True
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+        print("bench: TPU probe attempt %d failed; %.0fs to deadline"
+              % (attempt, max(0.0, t_end - time.monotonic())),
+              file=sys.stderr)
+        time.sleep(min(20.0, max(0.0, t_end - time.monotonic())))
+    return False
+
+
+_LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_LAST_TPU.json")
+
+
+def _emit_stale_or_smoke():
+    """The TPU never appeared. A CPU number must NEVER be the round's
+    headline (round-3 lesson: a 0.39 img/s CPU line replaced the metric).
+    Re-emit the last valid TPU result flagged stale; only if none has ever
+    been recorded, emit an explicitly-labelled CPU smoke line."""
+    if os.path.exists(_LAST_TPU_PATH):
+        with open(_LAST_TPU_PATH) as f:
+            last = json.load(f)
+        last["stale"] = True
+        last["stale_reason"] = ("TPU unreachable this run; value is the "
+                                "last real-chip measurement")
+        print(json.dumps(last))
+        return True
+    return False
 
 
 def _make_rec(n_images, side, path="/tmp/mxtpu_bench_%d_%d.rec"):
@@ -106,12 +142,16 @@ class _OneBatchIter:
 
 
 def main():
-    # generous default: the tunnel can take minutes to come up after idle
-    # (observed this round); falling back to CPU on a slow-but-alive TPU
-    # would record a misleading number
+    # generous defaults: the tunnel can take minutes to come up after idle;
+    # falling back to CPU on a slow-but-alive TPU would record a misleading
+    # number, so we retry probes until a hard deadline
     probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "540"))
+    probe_deadline = float(os.environ.get("BENCH_TPU_DEADLINE", "1500"))
     want_cpu = os.environ.get("BENCH_PLATFORM", "") == "cpu"
-    on_tpu = (not want_cpu) and probe_tpu(probe_timeout)
+    on_tpu = (not want_cpu) and probe_tpu(probe_deadline, probe_timeout)
+
+    if not on_tpu and not want_cpu and _emit_stale_or_smoke():
+        return
 
     import jax
     if not on_tpu:
@@ -173,25 +213,19 @@ def main():
     batch_obj = it._batch
     t1 = time.perf_counter()
     for _ in range(n_sync):
-        mod.forward_backward(batch_obj)
-        mod.update()
+        # same donating program fit() used (a bare forward_backward would
+        # trigger a second multi-minute XLA compile of the non-donating
+        # variant for no measurement benefit)
+        mod._fit_step(batch_obj)
         force()
     sync_step_ms = (time.perf_counter() - t1) / n_sync * 1e3
 
     # FLOPs/step from XLA cost analysis of the compiled fused program
     flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
     try:
-        import jax.numpy as jnp
         ex = mod._exec
-        fused = mod._fused
-        npar = len(fused.param_names)
-        lowered = fused._jitted.lower(
-            ex._arg_vals(), ex._aux_vals(), mod._fused_opt_state,
-            jnp.zeros((npar,), jnp.float32), jnp.zeros((npar,), jnp.float32),
-            np.float32(1.0), np.int32(1), jax.random.PRNGKey(0))
-        cost = lowered.compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
+        cost = mod._fused.cost_analysis(ex._arg_vals(), ex._aux_vals(),
+                                        mod._fused_opt_state)
         if cost and cost.get("flops", 0) > 0:
             flops_per_step = float(cost["flops"])
     except Exception:
@@ -270,6 +304,30 @@ def main():
         out["recordio_img_s"] = round(recordio_img_s, 2)
         out["recordio_input_only_img_s"] = round(input_only_img_s, 2)
         out["recordio_overlap"] = round(recordio_overlap, 3)
+    # the other two BASELINE.json metrics (kvstore push/pull µs, Gluon
+    # LSTM tokens/sec) ride along as extra fields; BENCH_EXTRA=0 skips
+    if os.environ.get("BENCH_EXTRA", "1") == "1":
+        try:
+            from tools.bandwidth import measure as _kv_us
+            out["kvstore_push_pull_us"] = _kv_us(
+                "local", size_mb=1.0, reps=10 if on_tpu else 3)["value"]
+        except Exception as e:
+            out["kvstore_push_pull_us"] = "failed: %s" % e
+        try:
+            from tools.bench_lstm import measure as _lstm
+            out["lstm_tokens_per_sec"] = _lstm(
+                steps=10 if on_tpu else 2)["value"]
+        except Exception as e:
+            out["lstm_tokens_per_sec"] = "failed: %s" % e
+
+    if on_tpu:
+        # persist: future runs where the TPU is unreachable re-emit this
+        # (flagged stale) instead of poisoning the record with a CPU line
+        try:
+            with open(_LAST_TPU_PATH, "w") as f:
+                json.dump(out, f)
+        except OSError:
+            pass
     print(json.dumps(out))
 
 

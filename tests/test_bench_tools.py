@@ -1,0 +1,36 @@
+"""The two measurement tools behind BASELINE.json's secondary metrics
+(VERDICT r3 #7): kvstore push/pull µs and Gluon LSTM tokens/sec.
+
+Smoke-sized here (tiny shapes, 2 reps); bench.py attaches the real-shape
+numbers to the round's JSON line.
+"""
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_bandwidth_probe():
+    from tools.bandwidth import measure
+    r = measure("local", size_mb=0.1, reps=2)
+    assert r["metric"] == "kvstore_push_pull_us"
+    assert r["value"] > 0 and r["gbps"] > 0
+
+
+def test_bandwidth_probe_compressed():
+    from tools.bandwidth import measure
+    r = measure("local", size_mb=0.1, reps=2, compression="2bit")
+    assert r["compression"] == "2bit" and r["value"] > 0
+
+
+def test_bandwidth_probe_multi_device_reduce():
+    from tools.bandwidth import measure
+    r = measure("local", size_mb=0.05, reps=2, ndev=4)
+    assert r["ndev"] == 4 and r["value"] > 0
+
+
+def test_lstm_tokens_per_sec():
+    from tools.bench_lstm import measure
+    r = measure(batch=4, seq_len=8, hidden=16, vocab=50, layers=1, steps=2)
+    assert r["metric"] == "gluon_lstm_tokens_per_sec"
+    assert r["value"] > 0 and r["step_ms"] > 0

@@ -1,0 +1,84 @@
+"""KVStore push/pull latency probe — the BASELINE.json "kvstore push/pull
+µs" metric (reference analog: tools/bandwidth/measure.py, which times
+push/pull of network-sized buffers through the kvstore).
+
+Times the full product path: per-device gradient reduce, optional wire
+compression, store update, and pull copy-out, for ResNet-50-ish key sizes.
+Runs on CPU or TPU (whatever backend jax resolves; pass --platform cpu to
+pin). Under a tools/launch.py group the push crosses processes
+(dist_sync allreduce / dist_async server), so the number covers the real
+network leg too.
+
+One JSON line:
+{"metric": "kvstore_push_pull_us", "value": <us per push+pull>, ...}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(kv_type="local", size_mb=1.0, reps=20, compression=None,
+            ndev=1):
+    import numpy as np
+    import mxnet_tpu as mx
+    import jax
+
+    n = max(1, int(size_mb * (1 << 20) / 4))
+    kv = mx.kv.create(kv_type)
+    if compression:
+        kv.set_gradient_compression({"type": compression, "threshold": 0.5})
+    rng = np.random.RandomState(0)
+    val = mx.nd.array(rng.randn(n).astype("f4"))
+    kv.init("k", val)
+    grads = [mx.nd.array(rng.randn(n).astype("f4")) for _ in range(ndev)]
+    out = mx.nd.zeros((n,))
+
+    def once():
+        kv.push("k", grads if ndev > 1 else grads[0])
+        kv.pull("k", out=out, ignore_sparse=False)
+        jax.block_until_ready(out._data)
+
+    once()   # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        once()
+    dt = time.perf_counter() - t0
+    us = dt / reps * 1e6
+    return {
+        "metric": "kvstore_push_pull_us",
+        "value": round(us, 1),
+        "unit": "us",
+        "vs_baseline": None,   # reference publishes no single-host number
+        "kv_type": kv_type,
+        "size_mb": size_mb,
+        "ndev": ndev,
+        "compression": compression or "none",
+        "wire_bytes": kv._last_wire_bytes,
+        "gbps": round(size_mb * (1 << 20) * 2 / dt * reps / 1e9, 3),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--kv-type", default="local")
+    p.add_argument("--size-mb", type=float, default=1.0)
+    p.add_argument("--reps", type=int, default=20)
+    p.add_argument("--ndev", type=int, default=1)
+    p.add_argument("--compression", default=None, choices=[None, "2bit"])
+    p.add_argument("--platform", default=None, choices=[None, "cpu"])
+    args = p.parse_args()
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(measure(args.kv_type, args.size_mb, args.reps,
+                             args.compression, args.ndev)))
+
+
+if __name__ == "__main__":
+    main()
