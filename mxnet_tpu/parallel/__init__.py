@@ -7,7 +7,7 @@ XLA-native SPMD: pick a `jax.sharding.Mesh`, annotate shardings, let GSPMD
 insert collectives over ICI/DCN.
 """
 from .mesh import make_mesh, data_parallel_sharding, replicated
-from .spmd import SPMDTrainStep
+from .spmd import SPMDTrainStep, megatron_tp_rule
 from .ring_attention import (blockwise_attention, ring_attention,
                              make_ring_attention, attention_reference)
 from ..ops.pallas_flash import flash_attention

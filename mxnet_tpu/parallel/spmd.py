@@ -29,6 +29,51 @@ from .. import random as _random
 __all__ = ["SPMDTrainStep"]
 
 
+def megatron_tp_rule(column_parallel=(), row_parallel=(), tp_axis="tp"):
+    """Build a ``tp_rule`` implementing the Megatron-LM sharding pattern
+    for FullyConnected weights (layout (out_features, in_features), the
+    reference's FC layout — src/operator/nn/fully_connected-inl.h):
+
+    * column-parallel layers (the FIRST matmul of an MLP pair, or the QKV
+      projection of attention) split the OUTPUT dim: weight P(tp, None),
+      bias P(tp). The activation comes out tp-sharded on features — no
+      collective needed. NOTE for fused QKV: lay the output features out
+      HEAD-MAJOR (reshape to (..., heads, 3, head_dim), not
+      (..., 3, heads, head_dim)) so a contiguous row split is a whole-head
+      partition; a 3-major interleave forces GSPMD to reshard at the
+      downstream q/k/v split and costs extra all-gathers (numerics stay
+      right, the one-psum-per-pair property doesn't).
+    * row-parallel layers (the SECOND matmul / attention output proj)
+      split the INPUT dim: weight P(None, tp), bias replicated. Consuming
+      the tp-sharded activation needs one psum, which GSPMD inserts
+      automatically at the sharding boundary.
+
+    One collective per MLP/attention pair — the Megatron recipe — falls
+    out of the two specs; nothing is hand-scheduled.
+
+    ``column_parallel`` / ``row_parallel``: iterables of layer-name
+    prefixes (e.g. ``["ffn1", "attn_qkv"]``; matches ``<prefix>_weight`` /
+    ``<prefix>_bias``).
+    """
+    col = tuple(column_parallel)
+    row = tuple(row_parallel)
+
+    def rule(name, shape):
+        for p in col:
+            if name == p + "_weight" and len(shape) >= 2:
+                return P(tp_axis, None)
+            if name == p + "_bias":
+                return P(tp_axis)
+        for p in row:
+            if name == p + "_weight" and len(shape) >= 2:
+                return P(None, tp_axis)
+            if name == p + "_bias":
+                return P()   # replicated; added after the psum
+        return None
+
+    return rule
+
+
 class SPMDTrainStep:
     """Compile a Symbol's training step over a mesh.
 

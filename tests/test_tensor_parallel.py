@@ -1,0 +1,145 @@
+"""Tensor parallelism beyond one matmul (VERDICT r3 #8): the Megatron
+sharding pattern (parallel/spmd.py megatron_tp_rule) on a 2-layer MLP and
+a full attention block, with tp=2 numerics checked against tp=1 on the
+8-virtual-device CPU mesh.
+
+What the pattern claims (Megatron-LM; reference has no TP — group2ctx
+model parallelism is refused loudly and replaced by this): column-split
+the first matmul / QKV projection, row-split the second / output
+projection, one psum per pair inserted by GSPMD.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import SPMDTrainStep, make_mesh, megatron_tp_rule
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs the 8-virtual-device mesh")
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="ffn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=24, name="ffn2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="head")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _attn_sym(embed=16, heads=4, seq=8):
+    """QKV (column-parallel over heads) -> FlashAttention -> out proj
+    (row-parallel) -> pooled classifier."""
+    data = mx.sym.Variable("data")               # (B, T, C)
+    qkv = mx.sym.FullyConnected(data, num_hidden=3 * embed, flatten=False,
+                                name="attn_qkv")     # (B, T, 3C)
+    # HEAD-major feature layout: a contiguous tp row-split of the fused
+    # weight is then a whole-head partition (see megatron_tp_rule note)
+    qkv = mx.sym.reshape(qkv, shape=(0, 0, heads, 3, embed // heads))
+    qkv = mx.sym.transpose(qkv, axes=(3, 0, 2, 1, 4))  # (3, B, H, T, D)
+    q = mx.sym.squeeze(mx.sym.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
+    k = mx.sym.squeeze(mx.sym.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
+    v = mx.sym.squeeze(mx.sym.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
+    o = mx.sym.contrib.FlashAttention(q, k, v, causal=True)  # (B, H, T, D)
+    o = mx.sym.transpose(o, axes=(0, 2, 1, 3))               # (B, T, H, D)
+    o = mx.sym.reshape(o, shape=(0, 0, -3))                  # (B, T, C)
+    o = mx.sym.FullyConnected(o, num_hidden=embed, flatten=False,
+                              name="attn_out")
+    o = mx.sym.mean(o, axis=1)                               # (B, C)
+    o = mx.sym.FullyConnected(o, num_hidden=4, name="head")
+    return mx.sym.SoftmaxOutput(o, name="softmax")
+
+
+def _train(sym, data_shape, tp, steps=3, seed=0, rule=None, batch=8):
+    """Run `steps` SPMD train steps on a dp x tp mesh; return params."""
+    n_tp = tp
+    n_dp = 1
+    devices = jax.devices()[: n_dp * n_tp]
+    mesh = make_mesh({"dp": n_dp, "tp": n_tp}, devices=devices)
+    shapes = dict(data=(batch,) + data_shape)
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    names = sym.list_arguments()
+    param_shapes = {n: tuple(s) for n, s in zip(names, arg_shapes)
+                    if n not in ("data", "softmax_label")}
+    aux_d = {n: tuple(s) for n, s in
+             zip(sym.list_auxiliary_states(), aux_shapes)}
+    step = SPMDTrainStep(sym, mesh, dp_axis="dp", tp_axis="tp",
+                         tp_rule=rule, lr=0.1, momentum=0.9)
+    step.compile(param_shapes, aux_d, {"data": shapes["data"]},
+                 {"softmax_label": (batch,)})
+    params, aux, opt = step.init(param_shapes, aux_d, seed=seed)
+
+    rng = np.random.RandomState(42)
+    key = jax.random.PRNGKey(0)
+    for i in range(steps):
+        data = {"data": jax.device_put(
+            rng.randn(*shapes["data"]).astype(np.float32),
+            NamedSharding(mesh, P("dp")))}
+        label = {"softmax_label": jax.device_put(
+            rng.randint(0, 4, (batch,)).astype(np.float32),
+            NamedSharding(mesh, P("dp")))}
+        params, aux, opt, outs = step(params, aux, opt, data, label, key)
+    return {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+
+
+def test_mlp_tp2_matches_tp1():
+    rule = megatron_tp_rule(column_parallel=["ffn1"], row_parallel=["ffn2"])
+    p1 = _train(_mlp_sym(), (16,), tp=1, rule=rule)
+    p2 = _train(_mlp_sym(), (16,), tp=2, rule=rule)
+    assert set(p1) == set(p2)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
+    # and training actually moved the sharded weights
+    p0 = _train(_mlp_sym(), (16,), tp=2, rule=rule, steps=0)
+    assert any(not np.allclose(p2[k], p0[k]) for k in p2)
+
+
+def test_mlp_tp4_matches_tp1():
+    rule = megatron_tp_rule(column_parallel=["ffn1"], row_parallel=["ffn2"])
+    p1 = _train(_mlp_sym(), (16,), tp=1, rule=rule)
+    p4 = _train(_mlp_sym(), (16,), tp=4, rule=rule)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p4[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
+
+
+def test_attention_block_tp2_matches_tp1():
+    rule = megatron_tp_rule(column_parallel=["attn_qkv"],
+                            row_parallel=["attn_out"])
+    p1 = _train(_attn_sym(), (8, 16), tp=1, rule=rule)
+    p2 = _train(_attn_sym(), (8, 16), tp=2, rule=rule)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=5e-4, atol=5e-5,
+                                   err_msg=k)
+
+
+def test_sharding_actually_splits_weights():
+    """Not just numerics: the tp=2 run must PLACE ffn1_weight split across
+    the tp axis (no silent replication)."""
+    rule = megatron_tp_rule(column_parallel=["ffn1"], row_parallel=["ffn2"])
+    devices = jax.devices()[:2]
+    mesh = make_mesh({"dp": 1, "tp": 2}, devices=devices)
+    sym = _mlp_sym()
+    batch = 8
+    arg_shapes, _, _ = sym.infer_shape(data=(batch, 16))
+    names = sym.list_arguments()
+    param_shapes = {n: tuple(s) for n, s in zip(names, arg_shapes)
+                    if n not in ("data", "softmax_label")}
+    step = SPMDTrainStep(sym, mesh, dp_axis="dp", tp_axis="tp",
+                         tp_rule=rule)
+    step.compile(param_shapes, {}, {"data": (batch, 16)},
+                 {"softmax_label": (batch,)})
+    params, aux, opt = step.init(param_shapes, {})
+    w = params["ffn1_weight"]
+    shard_shapes = {s.data.shape for s in w.addressable_shards}
+    full = param_shapes["ffn1_weight"]
+    assert shard_shapes == {(full[0] // 2, full[1])}, shard_shapes
+    w2 = params["ffn2_weight"]
+    shard_shapes2 = {s.data.shape for s in w2.addressable_shards}
+    full2 = param_shapes["ffn2_weight"]
+    assert shard_shapes2 == {(full2[0], full2[1] // 2)}, shard_shapes2
