@@ -2,6 +2,8 @@
 example/image-classification/symbols/ in the reference)."""
 from . import resnet
 from .resnet import get_symbol as resnet_symbol
+from .inception_v3 import get_symbol as inception_v3_symbol
+from .alexnet import get_symbol as alexnet_symbol
 
 
 def lenet(num_classes=10):

@@ -935,6 +935,47 @@ def logistic_regression_output(data, label=None, *, grad_scale=1.0):
     return _logreg_core(data, label, grad_scale)
 
 
+@_partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _make_loss_core(data, grad_scale, normalization, valid_thresh):
+    return data * 1.0
+
+
+def _make_loss_fwd(data, grad_scale, normalization, valid_thresh):
+    return data * 1.0, data
+
+
+def _make_loss_bwd(grad_scale, normalization, valid_thresh, data, g):
+    """MakeLoss backward (reference src/operator/make_loss-inl.h:92-118):
+    the input IS the loss, so its gradient is the constant grad_scale —
+    divided by batch ('batch') or by the count of elements above
+    valid_thresh ('valid'). The incoming cotangent is ignored (head op
+    seeded with all-ones, like SoftmaxOutput)."""
+    scale = jnp.asarray(grad_scale, data.dtype)
+    if normalization == "batch":
+        scale = scale / data.shape[0]
+    elif normalization == "valid":
+        valid = jnp.maximum(
+            jnp.sum((data > valid_thresh).astype(data.dtype)), 1.0)
+        scale = scale / valid
+    return (jnp.full(data.shape, scale, data.dtype),)
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss")
+def make_loss(data, *, grad_scale=1.0, normalization="null",
+              valid_thresh=0.0):
+    """Turn any symbol into a loss head (reference make_loss.cc): forward
+    is identity; backward injects grad_scale (grad_scale=0 makes a
+    monitoring output that contributes no gradient, the SSD pattern)."""
+    return _make_loss_core(data, float(grad_scale), normalization,
+                           float(valid_thresh))
+
+
+alias("MakeLoss", "make_loss")
+
+
 def _softmax_out_shapes(ins, p):
     out = list(ins)
     data = ins[0]

@@ -29,7 +29,9 @@ def _chain():
                 "MXNET_ENFORCE_DETERMINISM is set but mx.random.seed() was "
                 "never called on this thread — refusing to auto-seed from "
                 "entropy (parity: env_var.md:226 restricts nondeterminism).")
-        _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+        with jax.ensure_compile_time_eval():
+            _state.key = jax.random.PRNGKey(
+                _np.random.randint(0, 2**31 - 1))
     return _state.key
 
 
@@ -88,5 +90,13 @@ def next_key():
     if tr is not None:
         return tr.next_key()
     key = _chain()
-    _state.key, sub = jax.random.split(key)
+    # concrete even under an EXTERNAL trace with no TraceRng installed
+    # (shape inference eval_shape'ing a Dropout, a user jit over eager
+    # ops): splitting inside the trace would store a TRACER into the
+    # global chain and poison every later eager draw
+    # (UnexpectedTracerError); compile-time eval keeps the chain eager
+    # and hands the trace a constant subkey.
+    with jax.ensure_compile_time_eval():
+        new_key, sub = jax.random.split(key)
+    _state.key = new_key
     return sub

@@ -1,0 +1,51 @@
+"""Symbolic model builders (reference example/image-classification/symbols):
+resnet (covered elsewhere), inception-v3, alexnet — shape-inferred and
+executed forward through the bound executor."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def test_inception_v3_shapes():
+    s = models.inception_v3_symbol(num_classes=1000)
+    _, outs, _ = s.infer_shape(data=(4, 3, 299, 299))
+    assert outs == [(4, 1000)]
+    # the documented minimum input also resolves
+    _, outs, _ = s.infer_shape(data=(1, 3, 139, 139))
+    assert outs == [(1, 1000)]
+
+
+def test_inception_v3_forward():
+    s = models.inception_v3_symbol(num_classes=7, dropout=0.0)
+    ex = s.simple_bind(mx.cpu(), data=(1, 3, 139, 139), grad_req="null")
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v[:] = mx.nd.array(
+                np.random.RandomState(0).uniform(-0.05, 0.05, v.shape)
+                .astype("f4"))
+    for k, v in ex.aux_dict.items():
+        v[:] = mx.nd.ones(v.shape) if k.endswith("var") \
+            else mx.nd.zeros(v.shape)
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.random.rand(1, 3, 139, 139)
+                                .astype("f4")))
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (1, 7)
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-4)
+
+
+def test_alexnet_forward():
+    s = models.alexnet_symbol(num_classes=5)
+    ex = s.simple_bind(mx.cpu(), data=(2, 3, 224, 224), grad_req="null")
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v[:] = mx.nd.array(
+                np.random.RandomState(1).uniform(-0.02, 0.02, v.shape)
+                .astype("f4"))
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.random.rand(2, 3, 224, 224)
+                                .astype("f4")))
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 5)
+    np.testing.assert_allclose(out.sum(axis=1), [1.0, 1.0], rtol=1e-4)
