@@ -53,12 +53,26 @@ def on_complete(array):
 def waitall():
     """Block until all async device work completes (parity: MXNDArrayWaitAll).
 
-    ``jax.effects_barrier()`` only orders effectful computations; blocking on
-    every live array is what actually drains outstanding async executions,
-    matching the reference's WaitForAll (threaded_engine.cc)."""
+    ``jax.effects_barrier()`` only orders effectful computations. On TPU,
+    each device executes enqueued programs IN ORDER, so one sentinel
+    computation per device drains its queue in O(#devices) — a per-epoch
+    waitall stays cheap no matter how many arrays are live. XLA:CPU runs
+    executions on a thread pool with only data dependencies ordering
+    them, so there the (O(live arrays)) walk remains the only correct
+    drain, matching the reference's WaitForAll (threaded_engine.cc)."""
     try:
         jax.effects_barrier()
+        # Every outstanding async execution *and* transfer surfaces as a
+        # not-yet-ready live array; is_ready() is a non-blocking poll, so
+        # the walk costs O(live arrays) python but issues a device sync
+        # only for the (few) actually-pending ones. A per-device sentinel
+        # program would miss in-flight H2D/D2H transfers, which are not
+        # enqueued on the compute queue.
         for a in jax.live_arrays():
-            a.block_until_ready()
+            try:
+                if not a.is_ready():
+                    a.block_until_ready()
+            except AttributeError:
+                a.block_until_ready()
     except Exception as e:
         raise MXNetError(str(e)) from e

@@ -85,6 +85,85 @@ def _build_augmenter(data_shape, resize=-1, rand_crop=False,
     return aug
 
 
+# One process-wide native pool (the reference's singleton storage manager,
+# src/storage.cc): NEVER destroyed mid-run — per-iterator pools freed at GC
+# while numpy views of their slots are still reachable corrupt the heap.
+# Slot arrays are cached per shape and recycled across iterators.
+_POOL_LOCK = threading.Lock()
+_GLOBAL_POOL = None
+_SLOT_CACHE = {}     # shape -> [np.float32 arrays backed by the pool]
+
+
+def _global_pool():
+    global _GLOBAL_POOL
+    if _GLOBAL_POOL is None:
+        from .. import runtime
+        _GLOBAL_POOL = runtime.NativeStoragePool()
+    return _GLOBAL_POOL
+
+
+class _HostArena:
+    """Round-robin batch staging buffers on the process-wide native pool."""
+
+    def __init__(self, shape, nslots):
+        import ctypes
+        self._shape = tuple(shape)
+        nbytes = int(_np.prod(self._shape)) * 4
+        self._slots = []
+        with _POOL_LOCK:
+            cached = _SLOT_CACHE.setdefault(self._shape, [])
+            while cached and len(self._slots) < nslots:
+                self._slots.append(cached.pop())
+            pool = _global_pool()
+            while len(self._slots) < nslots:
+                ptr = pool.alloc(nbytes)
+                if not ptr:
+                    raise MemoryError("native pool alloc failed")
+                buf = (ctypes.c_float * (nbytes // 4)).from_address(ptr)
+                self._slots.append(
+                    _np.frombuffer(buf, _np.float32).reshape(self._shape))
+        self._i = 0
+        self._pending = {}   # id(slot) -> device array reading it
+
+    def next(self):
+        arr = self._slots[self._i]
+        self._i = (self._i + 1) % len(self._slots)
+        # queued != transferred: PJRT H2D is async, so the device array
+        # staged from this slot may still be READING it. Block on that
+        # transfer before handing the slot back to a decoder. (No-op once
+        # the pipeline is in steady state and transfers finish ahead of
+        # the wrap-around.)
+        pending = self._pending.pop(id(arr), None)
+        if pending is not None:
+            try:
+                pending.block_until_ready()
+            except Exception:
+                pass  # a failed transfer can't be reading the slot
+        return arr
+
+    def note_transfer(self, host_arr, device_arr):
+        """Record the device array whose H2D transfer reads host_arr."""
+        self._pending[id(host_arr)] = device_arr
+
+    def release(self):
+        """Return slots for reuse by the next same-shape iterator. Only
+        call after the pipeline is fully drained (no writer can touch
+        them afterwards)."""
+        for dev in self._pending.values():
+            try:
+                dev.block_until_ready()
+            except Exception:
+                pass
+        self._pending.clear()
+        with _POOL_LOCK:
+            _SLOT_CACHE.setdefault(self._shape, []).extend(self._slots)
+        self._slots = []
+
+    @property
+    def pooled_bytes(self):
+        return _global_pool().pooled_bytes
+
+
 class _RecordSource:
     """Indexed access to a .rec file: native mmap scanner when available,
     python MXIndexedRecordIO otherwise. Thread-safe for reads."""
@@ -171,10 +250,31 @@ class ImageRecordIter:
         else:
             from concurrent.futures import ThreadPoolExecutor
             self._pool = ThreadPoolExecutor(self._nthreads)
+        # host staging arena: batch buffers come from the native storage
+        # pool (src/storage.cc, the reference pooled_storage_manager.h
+        # analog) and cycle round-robin instead of a fresh large malloc
+        # per batch. Recycling is transfer-safe: _HostArena.next() blocks
+        # on the H2D transfer last staged from a slot before handing it
+        # back to a decoder (note_transfer/_pending).
+        self._arena = None
+        self._arena_aliases = False
+        if runtime.available():
+            try:
+                self._arena = _HostArena((batch_size,) + self.data_shape,
+                                         nslots=self._depth + 4)
+                import jax as _jax
+                dev = (ctx.jax_device if ctx is not None
+                       and hasattr(ctx, "jax_device")
+                       else _jax.devices()[0])
+                self._arena_aliases = dev.platform == "cpu"
+            except Exception:
+                self._arena = None
         self._queue = None
         self._feeder = None
         self._err = None
         self._stop = threading.Event()
+        self._scheduled = 0          # commits pushed, _stage not finished
+        self._sched_lock = threading.Lock()
         self.reset()
 
     # ------------------------------------------------------------- schedule
@@ -221,8 +321,20 @@ class ImageRecordIter:
             if self._err is not None:
                 return  # a part of this batch failed: don't stage garbage
             from ..ndarray import ndarray as _nd
+            slot = None
+            if self._arena is not None and self._arena_aliases:
+                # XLA:CPU ZERO-COPIES 64-byte-aligned host buffers — the
+                # device array would alias the pool slot and recycling
+                # would corrupt staged batches (and the heap). A real TPU
+                # H2D transfer copies, so only the CPU backend pays this.
+                data = _np.array(data, copy=True)
+            elif self._arena is not None:
+                slot = data
             d = _nd.array(data.astype(self._dtype, copy=False),
                           ctx=self._ctx)
+            if slot is not None:
+                # the async H2D reads the slot until the array is ready
+                self._arena.note_transfer(slot, d._data)
             l = _nd.array(label, ctx=self._ctx)
             batch = DataBatch(data=[d], label=[l], pad=0)
             while not self._stop.is_set():
@@ -233,6 +345,9 @@ class ImageRecordIter:
                     continue  # consumer will pop, or reset() will stop us
         except BaseException as e:
             self._record_err(e)
+        finally:
+            with self._sched_lock:
+                self._scheduled -= 1
 
     def _feed_epoch(self):
         """Producer: schedules every batch of the epoch through the engine
@@ -260,7 +375,8 @@ class ImageRecordIter:
             if self._stop.is_set() or self._err is not None:
                 return
             idxs = order[b * B:(b + 1) * B]
-            data = _np.empty((B,) + self.data_shape, _np.float32)
+            data = self._arena.next() if self._arena is not None \
+                else _np.empty((B,) + self.data_shape, _np.float32)
             label = _np.empty((B,) + shape, _np.float32)
             bounds = [(p * B // P, (p + 1) * B // P) for p in range(P)]
             rngs = [_np.random.RandomState(
@@ -279,13 +395,20 @@ class ImageRecordIter:
                         mutable_vars=(self._part_vars[p],))
                 # commit: reads all part vars, stages the batch (the
                 # bounded queue.put inside _stage is the backpressure)
+                with self._sched_lock:
+                    self._scheduled += 1
                 self._engine.push(
                     (lambda d=data, l=label: self._stage(d, l)),
                     const_vars=tuple(self._part_vars),
                     mutable_vars=(self._batch_var,))
-                # cap the batches *allocated ahead* too, or this loop
-                # outruns the queue bound with np.empty buffers
-                while (self._queue.qsize() >= self._depth
+                # cap the batches *allocated ahead*: queued + scheduled-
+                # but-not-yet-staged. Without the _scheduled term the
+                # loop can outrun staging arbitrarily (qsize stays 0
+                # while commits lag) and an arena slot would be handed
+                # back to a decoder before its previous batch was even
+                # staged, let alone transferred.
+                while (self._queue.qsize() + self._scheduled
+                       >= self._depth + 2
                        and not self._stop.is_set()):
                     self._stop.wait(0.002)
             else:
@@ -294,6 +417,8 @@ class ImageRecordIter:
                         for p, (lo, hi) in enumerate(bounds) if lo != hi]
                 for f in futs:
                     f.result()
+                with self._sched_lock:
+                    self._scheduled += 1   # balanced by _stage's finally
                 self._stage(data, label)
         if self._engine is not None:
             # commits are in flight on engine threads; the epoch sentinel
@@ -320,6 +445,7 @@ class ImageRecordIter:
         self._stop.clear()
         self._done = False
         self._err = None
+        self._scheduled = 0   # drained: no commit can be outstanding
         self._feeder = threading.Thread(target=self._feed_epoch, daemon=True)
         self._feeder.start()
 
@@ -363,6 +489,14 @@ class ImageRecordIter:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if self._arena is not None:
+            if self._feeder is None or not self._feeder.is_alive():
+                # drained: no writer can touch the slots anymore
+                self._arena.release()
+            # else: a wedged feeder may still write — keep the slots out
+            # of the shared cache (leak them) rather than hand a zombie
+            # writer the next iterator's live buffers
+            self._arena = None
 
     def __del__(self):
         try:

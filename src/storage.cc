@@ -50,7 +50,11 @@ class StoragePool {
         return p;
       }
     }
-    void* p = std::malloc(cls);
+    // 64-byte alignment: XLA's CPU client CHECK-fails handing it a host
+    // buffer below its minimum alignment, and TPU infeed DMA wants
+    // cacheline-aligned staging anyway (RoundSize keeps cls a multiple
+    // of the alignment)
+    void* p = std::aligned_alloc(64, cls < 64 ? 64 : cls);
     if (p == nullptr) return nullptr;
     std::lock_guard<std::mutex> lk(mu_);
     sizes_[p] = cls;

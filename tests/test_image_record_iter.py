@@ -149,3 +149,46 @@ def test_round_batch_wraps_small_dataset(rec_file):
     batches = list(it)
     assert len(batches) == 1 and batches[0].data[0].shape[0] == 100
     it.close()
+
+
+def test_host_arena_batches_match_plain_alloc(rec_file):
+    """The pooled staging arena (src/storage.cc buffers, recycled
+    round-robin) must be invisible to correctness: identical batches to
+    the per-batch-malloc path across multiple epochs."""
+    from mxnet_tpu.io import ImageRecordIter
+
+    from mxnet_tpu.io import image_record_iter as iri
+
+    def collect(force_plain):
+        if force_plain:
+            # disable the arena BEFORE the feeder starts (releasing it
+            # after construction would race the running pipeline)
+            import unittest.mock as mock
+            with mock.patch.object(iri, "_HostArena",
+                                   side_effect=MemoryError):
+                it = ImageRecordIter(rec_file, data_shape=(3, 16, 16),
+                                     batch_size=4, preprocess_threads=2,
+                                     prefetch_buffer=2, seed=7)
+            assert it._arena is None
+        else:
+            it = ImageRecordIter(rec_file, data_shape=(3, 16, 16),
+                                 batch_size=4, preprocess_threads=2,
+                                 prefetch_buffer=2, seed=7)
+        out = []
+        for _ in range(2):
+            for b in it:
+                out.append(b.data[0].asnumpy().copy())
+            it.reset()
+        arena = it._arena
+        it.close()
+        return out, arena
+
+    pooled, arena = collect(force_plain=False)
+    if arena is not None:   # native runtime present: pool really backed it
+        from mxnet_tpu.io import image_record_iter as iri
+        # close() returned the slots to the per-shape cache for reuse
+        assert len(iri._SLOT_CACHE.get((4, 3, 16, 16), [])) >= 6
+    plain, _ = collect(force_plain=True)
+    assert len(pooled) == len(plain) and len(pooled) > 0
+    for a, b in zip(pooled, plain):
+        np.testing.assert_array_equal(a, b)
