@@ -91,6 +91,7 @@ def __getattr__(name):
         "executor": ".executor",
         "visualization": ".visualization",
         "viz": ".visualization",
+        "serving": ".serving",
     }
     if name in lazy:
         m = importlib.import_module(lazy[name], __name__)

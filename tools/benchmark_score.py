@@ -1,0 +1,126 @@
+"""Inference throughput across the model zoo — the reference's
+example/image-classification/benchmark_score.py (source of the inference
+rows in docs/faq/perf.md:169-194 / BASELINE.md).
+
+Symbolic models run through the bound Executor (one fused XLA inference
+program, bf16 optional); gluon zoo models run hybridized. One JSON line
+per (model, batch):
+
+    {"metric": "inference_img_per_sec", "model": "resnet-50", ...}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _symbolic(name, num_layers):
+    from mxnet_tpu import models
+    if name == "resnet":
+        return models.resnet_symbol(num_classes=1000, num_layers=num_layers)
+    if name == "inception-v3":
+        return models.inception_v3_symbol(num_classes=1000)
+    if name == "alexnet":
+        return models.alexnet_symbol(num_classes=1000)
+    raise ValueError(name)
+
+
+def score(model="resnet-50", batch=32, steps=20, dtype="float32"):
+    """dtype: float32 or bfloat16 (symbolic models; gluon zoo casts the
+    whole block)."""
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+    shape = (3, 299, 299) if model == "inception-v3" else (3, 224, 224)
+
+    name, _, layers = model.partition("-")
+    if name == "inception":
+        sym = _symbolic("inception-v3", 0)
+    elif name in ("resnet", "alexnet"):
+        sym = _symbolic(name, int(layers) if layers else 50)
+    else:
+        # gluon zoo path (vgg16, mobilenet..., densenet..., squeezenet...)
+        from mxnet_tpu.gluon.model_zoo import vision
+        net = vision.get_model(model, pretrained=False)
+        net.initialize(mx.initializer.Xavier(), ctx=ctx)
+        if dtype != "float32":
+            net.cast(dtype)
+        net.hybridize(static_alloc=True)
+        x = mx.nd.array(np.random.rand(batch, *shape).astype("f4"),
+                        ctx=ctx, dtype=dtype)
+        net(x).wait_to_read()   # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = net(x)
+        float(np.asarray(jax.device_get(out._data)).ravel()[0])
+        dt = time.perf_counter() - t0
+        return _line(model, batch, steps, dt, dtype)
+
+    # bf16: params and data in the MXU's native dtype (the reference's
+    # fp16 inference rows, perf.md:181-194); BN stats stay f32
+    ex = sym.simple_bind(ctx, data=(batch,) + shape, grad_req="null",
+                         type_dict={"data": dtype})
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v[:] = mx.nd.array(rng.uniform(-0.05, 0.05, v.shape)
+                               .astype("f4"), ctx=ctx, dtype=v.dtype)
+    for k, v in ex.aux_dict.items():
+        v[:] = mx.nd.ones(v.shape, ctx=ctx) if k.endswith("var") \
+            else mx.nd.zeros(v.shape, ctx=ctx)
+    x = mx.nd.array(rng.rand(batch, *shape).astype("f4"), ctx=ctx,
+                    dtype=dtype)
+    ex.forward(is_train=False, data=x)   # compile
+    ex.outputs[0].wait_to_read()
+    import jax as _j
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ex.forward(is_train=False, data=x)
+    float(np.asarray(_j.device_get(ex.outputs[0]._data)).ravel()[0])
+    dt = time.perf_counter() - t0
+    return _line(model, batch, steps, dt, dtype)
+
+
+def _line(model, batch, steps, dt, dtype):
+    import jax
+    return {
+        "metric": "inference_img_per_sec",
+        "model": model,
+        "value": round(batch * steps / dt, 2),
+        "unit": "img/s",
+        "batch": batch,
+        "dtype": dtype,
+        "step_ms": round(dt / steps * 1e3, 3),
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--models", default="resnet-50",
+                   help="comma list: resnet-50, resnet-152, inception-v3, "
+                        "alexnet, or any gluon zoo name (mobilenet1.0...)")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--platform", default=None, choices=[None, "cpu"])
+    args = p.parse_args()
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    for m in args.models.split(","):
+        print(json.dumps(score(m.strip(), args.batch, args.steps,
+                               args.dtype)))
+
+
+if __name__ == "__main__":
+    main()

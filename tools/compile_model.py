@@ -1,0 +1,50 @@
+"""Freeze a checkpoint pair into a deployable AOT inference artifact
+(the reference's TensorRT build step, mx.contrib.tensorrt /
+trt_graph_executor.cc — here jax.export StableHLO, cross-targetable to
+TPU from a CPU host).
+
+    python tools/compile_model.py --prefix model --epoch 10 \
+        --data-shape 1,3,224,224 --out model.mxtpu [--platforms tpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--prefix", required=True)
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--data-shape", required=True,
+                   help="comma dims incl. batch, e.g. 1,3,224,224")
+    p.add_argument("--data-name", default="data")
+    p.add_argument("--out", required=True)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--platforms", default=None,
+                   help="comma list, e.g. tpu (default: current backend)")
+    p.add_argument("--platform", default=None, choices=[None, "cpu"],
+                   help="backend to run the EXPORT on")
+    args = p.parse_args()
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    sym, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                           args.epoch)
+    shape = tuple(int(x) for x in args.data_shape.split(","))
+    plats = args.platforms.split(",") if args.platforms else None
+    meta = mx.serving.export_compiled(
+        sym, arg_params, aux_params, {args.data_name: shape}, args.out,
+        dtype=args.dtype, platforms=plats)
+    print(json.dumps({"artifact": args.out,
+                      "bytes": os.path.getsize(args.out), **meta}))
+
+
+if __name__ == "__main__":
+    main()
