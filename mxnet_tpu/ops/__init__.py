@@ -20,3 +20,4 @@ from . import custom_op     # noqa: F401
 from . import vision_ops    # noqa: F401
 from . import pallas_flash  # noqa: F401
 from . import linalg        # noqa: F401
+from . import legacy_aliases  # noqa: F401  (must come after the bases)

@@ -1,0 +1,264 @@
+"""Legacy operator names + the remaining small op tail.
+
+Parity: every name here is registered in the reference's operator table
+and reachable from old scripts/JSON (legacy capitalized elemwise names
+from the pre-0.9 era — src/operator/tensor/elemwise_binary_op_basic.cc
+add_alias chains; random-sampling surface names — random/sample_op.cc;
+deprecated layer names — batch_norm_v1.cc, convolution_v1.cc,
+pooling_v1.cc, softmax.cc).
+
+Deliberately NOT registered (documented refusals):
+* ``_Native`` / ``_NDArray`` — C-callback op bridges of the 0.x C API;
+  the Python CustomOp path (ops/custom_op.py) is the supported analog.
+* ``_CrossDeviceCopy`` — explicit D2D copy node; XLA/GSPMD moves data.
+* ``_sg_mkldnn_conv`` / ``_trt_op`` — backend-fused subgraph nodes of
+  MKLDNN/TensorRT; the subgraph framework + AOT serving fill the role.
+* ``_cond``/``_while_loop``/``_foreach`` — subgraph-attribute control
+  flow nodes; the functional API (ndarray/contrib.py foreach/while_loop/
+  cond over lax) is the TPU-native form.
+* ``IdentityAttachKLSparseReg`` — sparse-activation KL regularizer tied
+  to the v0.x executor's aux-state update hooks; no modern consumer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+from .. import random as _random
+
+# ---------------------------------------------------------------- aliases
+# legacy capitalized elemwise family
+for _legacy, _new in {
+    "_Plus": "elemwise_add", "_Minus": "elemwise_sub",
+    "_Mul": "elemwise_mul", "_Div": "elemwise_div",
+    "_Mod": "_mod", "_Power": "_power",
+    "_Maximum": "_maximum", "_Minimum": "_minimum",
+    "_Hypot": "_hypot",
+    "_Equal": "_equal", "_Not_Equal": "_not_equal",
+    "_Greater": "_greater", "_Greater_Equal": "_greater_equal",
+    "_Lesser": "_lesser", "_Lesser_Equal": "_lesser_equal",
+    "_Logical_And": "_logical_and", "_Logical_Or": "_logical_or",
+    "_Logical_Xor": "_logical_xor",
+    "_PlusScalar": "_plus_scalar", "_MinusScalar": "_minus_scalar",
+    "_RMinusScalar": "_rminus_scalar", "_MulScalar": "_mul_scalar",
+    "_DivScalar": "_div_scalar", "_RDivScalar": "_rdiv_scalar",
+    "_ModScalar": "_mod_scalar", "_RModScalar": "_rmod_scalar",
+    "_PowerScalar": "_power_scalar", "_RPowerScalar": "_rpower_scalar",
+    "_MaximumScalar": "_maximum_scalar",
+    "_MinimumScalar": "_minimum_scalar",
+    "_EqualScalar": "_equal_scalar",
+    "_NotEqualScalar": "_not_equal_scalar",
+    "_GreaterScalar": "_greater_scalar",
+    "_GreaterEqualScalar": "_greater_equal_scalar",
+    "_LesserScalar": "_lesser_scalar",
+    "_LesserEqualScalar": "_lesser_equal_scalar",
+    # grad accumulation node (elemwise_sum.cc _grad_add)
+    "_grad_add": "elemwise_add",
+    # deprecated layer names
+    "BatchNorm_v1": "BatchNorm", "CuDNNBatchNorm": "BatchNorm",
+    "Convolution_v1": "Convolution", "Pooling_v1": "Pooling",
+    "Softmax": "SoftmaxOutput",   # softmax.cc: deprecated SoftmaxOutput
+    "crop": "Crop",
+    # random-surface names (random/sample_op.cc aliases)
+    "uniform": "_random_uniform", "random_uniform": "_random_uniform",
+    "normal": "_random_normal", "random_normal": "_random_normal",
+    "random_gamma": "_random_gamma",
+    "random_exponential": "_random_exponential",
+    "random_poisson": "_random_poisson",
+    "random_negative_binomial": "_random_negative_binomial",
+    "random_generalized_negative_binomial":
+        "_random_generalized_negative_binomial",
+    "random_randint": "_random_randint",
+    "sample_multinomial": "_sample_multinomial",
+    "shuffle": "_shuffle",
+    # contrib spellings
+    "_contrib_CTCLoss": "CTCLoss", "_contrib_ctc_loss": "CTCLoss",
+    "_contrib_box_non_maximum_suppression": "_contrib_box_nms",
+    "_contrib_group_adagrad_update": "group_adagrad_update",
+    "_zeros_without_dtype": "_zeros",
+}.items():
+    alias(_new, _legacy)
+
+
+# ------------------------------------------------- missing scalar logicals
+@register("_logical_and_scalar")
+def logical_and_scalar(data, *, scalar):
+    return ((data != 0) & (scalar != 0)).astype(data.dtype)
+
+
+@register("_logical_or_scalar")
+def logical_or_scalar(data, *, scalar):
+    return ((data != 0) | (scalar != 0)).astype(data.dtype)
+
+
+@register("_logical_xor_scalar")
+def logical_xor_scalar(data, *, scalar):
+    return ((data != 0) ^ (scalar != 0)).astype(data.dtype)
+
+
+@register("_hypot_scalar")
+def hypot_scalar(data, *, scalar):
+    return jnp.hypot(data, jnp.asarray(scalar, data.dtype))
+
+
+# --------------------------------------------------------- small real ops
+@register("hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    """clip(alpha*x + beta, 0, 1) (elemwise_unary_op_basic.cc)."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("_histogram", num_outputs=2)
+def histogram(data, bins=None, *, bin_cnt=None, range=None):
+    """(counts, edges) (src/operator/tensor/histogram.cc): either an
+    explicit edges array input or (bin_cnt, range)."""
+    if bin_cnt is not None:
+        if range is None or len(tuple(range)) != 2:
+            from ..base import MXNetError
+            raise MXNetError(
+                "_histogram: bin_cnt requires range=(min, max) "
+                "(reference histogram.cc HistogramParam)")
+        cnt, edges = jnp.histogram(data.ravel(), bins=int(bin_cnt),
+                                   range=tuple(range))
+    else:
+        cnt, edges = jnp.histogram(data.ravel(), bins=bins)
+    return cnt, edges
+
+
+@register("_ravel_multi_index")
+def ravel_multi_index(data, *, shape):
+    """(ndim, N) coords -> flat indices (tensor/ravel.cc)."""
+    coords = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
+    out = jnp.ravel_multi_index(coords, tuple(shape), mode="clip")
+    return out.astype(data.dtype)
+
+
+@register("_unravel_index")
+def unravel_index(data, *, shape):
+    """flat indices (N,) -> (ndim, N) coords (tensor/ravel.cc)."""
+    coords = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(coords).astype(data.dtype)
+
+
+@register("_identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs carrying rhs's storage attr (used by the sparse
+    optimizer passes); values are lhs verbatim."""
+    return lhs * 1.0
+
+
+@register("_rnn_param_concat")
+def rnn_param_concat(*data, dim=0):
+    """Concat specialized for RNN parameter flattening (rnn.cc)."""
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("_square_sum")
+def square_sum(data, *, axis=None, keepdims=False, exclude=False):
+    """sum(x^2) (square_sum.cc — the rsp-optimized fused form; one XLA
+    fusion here)."""
+    ax = None if axis is None else tuple(axis) if isinstance(
+        axis, (tuple, list)) else (axis,)
+    return jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+
+
+@register("cast_storage")
+def cast_storage_op(data, *, stype):
+    """Dense graph node: storage casting is an NDArray-layer concept
+    (ndarray/sparse.py cast_storage does the real conversion); inside a
+    compiled graph every tensor is dense, so this is identity."""
+    return data * 1.0
+
+
+@register("_sparse_retain")
+def sparse_retain(data, indices):
+    """Keep only the requested rows (sparse_retain.cc). Dense lowering:
+    zero every row NOT selected — the rsp path lives on
+    RowSparseNDArray.retain."""
+    idx = indices.astype(jnp.int32)
+    mask = jnp.zeros((data.shape[0],), jnp.bool_).at[idx].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros_like(data))
+
+
+@register("_scatter_plus_scalar")
+def scatter_plus_scalar(data, *, scalar):
+    """Sparse-aware scalar add (elemwise_scatter_op.cc: touches only
+    stored values of an rsp/csr input; dense math is identical)."""
+    return data + scalar
+
+
+@register("_scatter_minus_scalar")
+def scatter_minus_scalar(data, *, scalar):
+    return data - scalar
+
+
+@register("_scatter_elemwise_div")
+def scatter_elemwise_div(lhs, rhs):
+    return lhs / rhs
+
+
+@register("_scatter_set_nd")
+def scatter_set_nd(lhs, rhs, indices, *, shape=None):
+    """Write rhs into lhs at gather_nd-style indices
+    (tensor/indexing_op.cc scatter_set_nd)."""
+    idx = tuple(indices[i].astype(jnp.int32)
+                for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+# ------------------------------------------------ missing sample_* family
+def _sample_shape(params0, shape):
+    shape = tuple(shape) if shape else ()
+    return params0.shape + shape
+
+
+@register("_sample_exponential", is_random=True)
+def sample_exponential(lam, *, shape=None, dtype="float32"):
+    out = _sample_shape(lam, shape)
+    k = _random.next_key()
+    e = jax.random.exponential(k, out).astype(dtype)
+    return e / lam.reshape(lam.shape + (1,) * (len(out) - lam.ndim))
+
+
+@register("_sample_poisson", is_random=True)
+def sample_poisson(lam, *, shape=None, dtype="float32"):
+    out = _sample_shape(lam, shape)
+    k = _random.next_key()
+    lam_b = lam.reshape(lam.shape + (1,) * (len(out) - lam.ndim))
+    return jax.random.poisson(k, lam_b, out).astype(dtype)
+
+
+@register("_sample_negative_binomial", is_random=True)
+def sample_negative_binomial(k, p, *, shape=None, dtype="float32"):
+    """NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (same mixture the reference
+    sampler uses)."""
+    out = _sample_shape(k, shape)
+    kk = _random.next_key()
+    k_b = k.reshape(k.shape + (1,) * (len(out) - k.ndim))
+    p_b = p.reshape(p.shape + (1,) * (len(out) - p.ndim))
+    g = jax.random.gamma(kk, k_b, out) * (1.0 - p_b) / p_b
+    return jax.random.poisson(_random.next_key(), g).astype(dtype)
+
+
+@register("_sample_generalized_negative_binomial", is_random=True)
+def sample_gnb(mu, alpha, *, shape=None, dtype="float32"):
+    """GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) rate."""
+    out = _sample_shape(mu, shape)
+    kk = _random.next_key()
+    mu_b = mu.reshape(mu.shape + (1,) * (len(out) - mu.ndim))
+    a_b = alpha.reshape(alpha.shape + (1,) * (len(out) - alpha.ndim))
+    g = jax.random.gamma(kk, 1.0 / a_b, out) * mu_b * a_b
+    return jax.random.poisson(_random.next_key(), g).astype(dtype)
+
+
+@register("_sparse_adagrad_update", num_outputs=2)
+def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Dense lowering of the rsp adagrad kernel (optimizer_op.cc); the
+    truly-lazy row path lives in optimizer.AdaGrad's rsp branch."""
+    from .optimizer_ops import adagrad_update
+    return adagrad_update(weight, grad, history, lr=lr, epsilon=epsilon,
+                          wd=wd, rescale_grad=rescale_grad,
+                          clip_gradient=clip_gradient)

@@ -100,6 +100,9 @@ def main():
     kvc.push("c0", mx.nd.zeros(SHAPE))  # 0 + residual 0.5 -> +0.5 again
     kvc.pull("c0", out=out)
     check_diff(out, 0.5 * nworker, my_rank)
+    # and the WIRE carried packed 2-bit codes, not f32 (~16x smaller)
+    n = int(np.prod(SHAPE))
+    assert kvc._last_wire_bytes == (n + 3) // 4, kvc._last_wire_bytes
 
     # --- barrier ----------------------------------------------------------
     kv._barrier()

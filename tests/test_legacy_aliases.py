@@ -1,0 +1,133 @@
+"""Legacy op-name surface + remaining tail (ops/legacy_aliases.py):
+every name is a reference-registered operator; numerics checked against
+the obvious ground truth."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry
+
+
+def _inv(name, inputs, params=None):
+    return mx.nd.invoke(name, inputs, params or {})
+
+
+def test_legacy_capitalized_elemwise():
+    a = mx.nd.array(np.array([1., 5., 3.], "f4"))
+    b = mx.nd.array(np.array([4., 2., 3.], "f4"))
+    np.testing.assert_allclose(_inv("_Plus", [a, b]).asnumpy(), [5, 7, 6])
+    np.testing.assert_allclose(_inv("_Maximum", [a, b]).asnumpy(),
+                               [4, 5, 3])
+    np.testing.assert_allclose(_inv("_Greater", [a, b]).asnumpy(),
+                               [0, 1, 0])
+    np.testing.assert_allclose(
+        _inv("_RMinusScalar", [a], {"scalar": 10.0}).asnumpy(), [9, 5, 7])
+    np.testing.assert_allclose(
+        _inv("_logical_xor_scalar", [a], {"scalar": 1.0}).asnumpy(),
+        [0, 0, 0])
+    np.testing.assert_allclose(
+        _inv("_hypot_scalar", [mx.nd.array([3.0])],
+             {"scalar": 4.0}).asnumpy(), [5.0])
+
+
+def test_deprecated_layer_names_resolve():
+    for legacy, modern in [("BatchNorm_v1", "BatchNorm"),
+                           ("Convolution_v1", "Convolution"),
+                           ("Pooling_v1", "Pooling"),
+                           ("Softmax", "SoftmaxOutput"),
+                           ("crop", "Crop"),
+                           ("_contrib_ctc_loss", "CTCLoss")]:
+        assert registry.get(legacy) is registry.get(modern), legacy
+
+
+def test_random_surface_names():
+    out = _inv("random_uniform", [], {"low": 0.0, "high": 1.0,
+                                      "shape": (100,)})
+    x = out.asnumpy()
+    assert x.shape == (100,) and (x >= 0).all() and (x <= 1).all()
+    s = _inv("shuffle", [mx.nd.array(np.arange(16.))], {}).asnumpy()
+    assert sorted(s) == list(range(16))
+
+
+def test_hard_sigmoid_and_grad():
+    x = mx.nd.array(np.array([-5., 0., 1., 5.], "f4"))
+    x.attach_grad()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        y = mx.nd.hard_sigmoid(x)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), [0, 0.5, 0.7, 1], rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 0.2, 0.2, 0],
+                               rtol=1e-6)
+
+
+def test_histogram():
+    cnt, edges = _inv("_histogram", [mx.nd.array([0.1, 0.5, 0.9, 0.5])],
+                      {"bin_cnt": 2, "range": (0.0, 1.0)})
+    # half-open bins [a, b) except the last (numpy == reference
+    # histogram.cc): both 0.5s land in the second bin
+    np.testing.assert_array_equal(cnt.asnumpy(), [1, 3])
+    np.testing.assert_allclose(edges.asnumpy(), [0, 0.5, 1.0])
+
+
+def test_ravel_unravel_roundtrip():
+    flat = mx.nd.array([0., 4., 5.])
+    coords = _inv("_unravel_index", [flat], {"shape": (2, 3)})
+    back = _inv("_ravel_multi_index", [coords], {"shape": (2, 3)})
+    np.testing.assert_array_equal(back.asnumpy(), flat.asnumpy())
+
+
+def test_sparse_retain_dense_lowering():
+    d = mx.nd.array(np.arange(12.).reshape(4, 3))
+    out = _inv("_sparse_retain", [d, mx.nd.array([0, 2])])
+    exp = np.zeros((4, 3))
+    exp[[0, 2]] = d.asnumpy()[[0, 2]]
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+
+
+def test_scatter_set_nd():
+    lhs = mx.nd.zeros((2, 3))
+    idx = mx.nd.array([[0, 1], [1, 2]])   # rows: dim0 coords, dim1 coords
+    out = _inv("_scatter_set_nd", [lhs, mx.nd.array([7., 8.]), idx],
+               {"shape": (2, 3)})
+    exp = np.zeros((2, 3))
+    exp[0, 1] = 7.0
+    exp[1, 2] = 8.0
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+
+
+def test_square_sum_matches_dense():
+    d = np.random.RandomState(0).randn(4, 5).astype("f4")
+    out = _inv("_square_sum", [mx.nd.array(d)], {"axis": 1})
+    np.testing.assert_allclose(out.asnumpy(), (d * d).sum(1), rtol=1e-6)
+
+
+def test_sample_family_moments():
+    mx.random.seed(7)
+    lam = mx.nd.array([4.0, 100.0])
+    p = _inv("_sample_poisson", [lam], {"shape": (4000,)}).asnumpy()
+    np.testing.assert_allclose(p.mean(axis=1), [4.0, 100.0], rtol=0.1)
+    e = _inv("_sample_exponential", [lam], {"shape": (4000,)}).asnumpy()
+    np.testing.assert_allclose(e.mean(axis=1), [0.25, 0.01], rtol=0.15)
+    k = mx.nd.array([8.0])
+    pr = mx.nd.array([0.5])
+    nb = _inv("_sample_negative_binomial", [k, pr],
+              {"shape": (4000,)}).asnumpy()
+    np.testing.assert_allclose(nb.mean(), 8.0, rtol=0.15)  # k(1-p)/p
+    mu = mx.nd.array([6.0])
+    al = mx.nd.array([0.3])
+    g = _inv("_sample_generalized_negative_binomial", [mu, al],
+             {"shape": (4000,)}).asnumpy()
+    np.testing.assert_allclose(g.mean(), 6.0, rtol=0.15)
+
+
+def test_rnn_param_concat_and_identity_attr():
+    a, b = mx.nd.ones((2, 2)), mx.nd.zeros((1, 2))
+    out = _inv("_rnn_param_concat", [a, b], {"dim": 0})
+    assert out.shape == (3, 2)
+    same = _inv("_identity_with_attr_like_rhs", [a, b])
+    np.testing.assert_array_equal(same.asnumpy(), a.asnumpy())
+
+
+def test_registry_count_meets_target():
+    """VERDICT r3 #6: >= 380 reference-registered names."""
+    assert len(registry.list_ops()) >= 380
