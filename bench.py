@@ -66,6 +66,13 @@ def probe_tpu(deadline_s: float, attempt_timeout: float) -> bool:
                                capture_output=True, text=True)
             if r.returncode == 0:
                 return True
+            if "AssertionError" in (r.stderr or ""):
+                # jax initialized fine and resolved to CPU: there IS no
+                # TPU on this host — deterministic, don't burn the
+                # deadline retrying it
+                print("bench: no TPU backend on this host (resolved to "
+                      "CPU); not retrying", file=sys.stderr)
+                return False
         except (subprocess.TimeoutExpired, OSError):
             pass
         print("bench: TPU probe attempt %d failed; %.0fs to deadline"

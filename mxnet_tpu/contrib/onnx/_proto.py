@@ -150,8 +150,12 @@ class Message:
                     while pos < stop:
                         v, pos = _dec_varint(buf, pos)
                         vals.append(_signed64(v))
-                    getattr(self, name).extend(vals) if repeated \
-                        else setattr(self, name, vals[-1])
+                    if repeated:
+                        getattr(self, name).extend(vals)
+                    elif vals:
+                        # empty packed payload on a scalar field: keep the
+                        # default rather than crash on a truncated file
+                        setattr(self, name, vals[-1])
                 else:
                     v, pos = _dec_varint(buf, pos)
                     v = _signed64(v)

@@ -255,8 +255,17 @@ def _tr_leaky(ex, node, p):
 
 
 def _tr_reshape(ex, node, p):
+    shape = tuple(p["shape"])
+    # ONNX Reshape only defines 0 (copy) and -1 (infer); mxnet's -2/-3/-4
+    # special codes have no ONNX encoding — exporting them verbatim would
+    # produce a silently invalid graph
+    if any(s < -1 for s in shape):
+        raise MXNetError(
+            "Reshape node %r uses mxnet special shape codes %r; ONNX "
+            "Reshape supports only 0 and -1 — rewrite the model with an "
+            "explicit shape before export" % (node.name, shape))
     shape_name = node.name + "_shape"
-    ex._shape_init(shape_name, p["shape"])
+    ex._shape_init(shape_name, shape)
     ex._emit("Reshape", ex._ins(node) + [shape_name],
              [ex._vname(node, 0)], node.name)
 

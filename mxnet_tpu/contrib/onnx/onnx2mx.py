@@ -73,12 +73,13 @@ def _attrs(node):
 
 
 class _Importer:
-    def __init__(self, graph):
+    def __init__(self, graph, for_training=False):
         self.graph = graph
         self.params = {n.name: tensor_to_numpy(n) for n in graph.initializer}
         self.syms = {}        # onnx value name -> Symbol
         self.aux_names = set()
         self.used_params = set()
+        self._for_training = for_training
 
     def run(self):
         for vi in self.graph.input:
@@ -377,19 +378,27 @@ class _Importer:
             "eps": a.get("epsilon", 1e-5),
             "momentum": a.get("momentum", 0.9),
             "fix_gamma": False,
-            # inference graphs (the ONNX norm) use the running stats
-            "use_global_stats": True}, n_in=5)
+            # use_global_stats pins inference to the imported running
+            # stats (the ONNX norm). For fine-tuning, import with
+            # import_model(..., for_training=True): batch stats are used
+            # in training mode and the running stats keep updating — the
+            # reference importer's semantics.
+            "use_global_stats": not self._for_training}, n_in=5)
 
 
-def import_model(model_file):
+def import_model(model_file, for_training=False):
     """Read a .onnx file -> (sym, arg_params, aux_params) (reference
-    contrib/onnx/onnx2mx/import_model.py:21)."""
+    contrib/onnx/onnx2mx/import_model.py:21).
+
+    for_training=False (default) builds an inference graph: BatchNorm is
+    pinned to the imported running stats. for_training=True leaves
+    training semantics intact so the imported model can be fine-tuned."""
     with open(model_file, "rb") as f:
         data = f.read()
     model = P.ModelProto.decode(data)
     if model.graph is None:
         raise MXNetError("%s contains no graph" % model_file)
-    return _Importer(model.graph).run()
+    return _Importer(model.graph, for_training=for_training).run()
 
 
 def get_model_metadata(model_file):

@@ -734,6 +734,7 @@ class ImageDetIter(ImageIter):
         self.auglist = aug_list if aug_list is not None else \
             CreateDetAugmenter(data_shape, **kwargs)
         self.label_shape = self._estimate_label_shape()
+        self._estimated_label_shape = self.label_shape
 
     @property
     def provide_label(self):
@@ -779,7 +780,22 @@ class ImageDetIter(ImageIter):
         if data_shape is not None:
             self.data_shape = tuple(data_shape)
         if label_shape is not None:
-            self.label_shape = tuple(label_shape)
+            label_shape = tuple(label_shape)
+            # reference check_label_shape: shrinking below the dataset's
+            # max object count would silently TRUNCATE ground-truth boxes
+            # in next()
+            max_count, width = getattr(self, "_estimated_label_shape",
+                                       (0, 0))
+            if label_shape[0] < max_count:
+                raise MXNetError(
+                    "label_shape rows %d < dataset max object count %d: "
+                    "boxes would be truncated" % (label_shape[0],
+                                                  max_count))
+            if width and len(label_shape) > 1 and label_shape[1] != width:
+                raise MXNetError(
+                    "label_shape object width %d != dataset width %d"
+                    % (label_shape[1], width))
+            self.label_shape = label_shape
 
     def next(self):
         from .io.io import DataBatch

@@ -14,7 +14,7 @@ def test_bandwidth_probe():
     from tools.bandwidth import measure
     r = measure("local", size_mb=0.1, reps=2)
     assert r["metric"] == "kvstore_push_pull_us"
-    assert r["value"] > 0 and r["gbps"] > 0
+    assert r["value"] > 0 and r["gbit_per_s"] > 0
 
 
 def test_bandwidth_probe_compressed():

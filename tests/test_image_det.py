@@ -195,3 +195,13 @@ def test_multibox_target_mining_reference_semantics():
         mx.nd.array(a), label, mx.nd.array(conf0), overlap_threshold=0.5,
         negative_mining_ratio=3.0, negative_mining_thresh=0.5)
     assert (ct0.asnumpy()[0] == 0).sum() >= 1
+
+
+def test_det_iter_reshape_validates_label_rows(tmp_path):
+    rec = _make_det_rec(tmp_path)
+    it = img.ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                          path_imgrec=rec)
+    with pytest.raises(mx.base.MXNetError):
+        it.reshape(label_shape=(1, 5))  # dataset has 2 objects per image
+    it.reshape(label_shape=(4, 5))      # growing is fine
+    assert it.label_shape == (4, 5)

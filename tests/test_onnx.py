@@ -175,3 +175,34 @@ def test_onnx_fp16_int32_data_bitcast():
                       int32_data=[15360, 16384])  # bits of 1.0, 2.0
     np.testing.assert_array_equal(tensor_to_numpy(t),
                                   np.array([1.0, 2.0], np.float16))
+
+
+def test_reshape_special_codes_refuse_export(tmp_path):
+    x = mx.sym.Variable("data")
+    net = mx.sym.reshape(x, shape=(0, -3))   # -3: merge dims, no ONNX form
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    with pytest.raises(mx.base.MXNetError):
+        onnx_mxnet.export_model(net, {}, [(2, 3, 4)],
+                                onnx_file_path=str(tmp_path / "bad.onnx"))
+
+
+def test_import_model_for_training_keeps_bn_batch_stats(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(mx.sym.FullyConnected(data, num_hidden=4,
+                                                 name="fc"), name="bn")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fc_weight": mx.nd.array(rng.randn(4, 3).astype("f4")),
+            "fc_bias": mx.nd.zeros((4,)),
+            "bn_gamma": mx.nd.ones((4,)), "bn_beta": mx.nd.zeros((4,))}
+    aux = {"bn_moving_mean": mx.nd.zeros((4,)),
+           "bn_moving_var": mx.nd.ones((4,))}
+    f = str(tmp_path / "m.onnx")
+    onnx_mxnet.export_model(net, {**args, **aux}, [(2, 3)],
+                            onnx_file_path=f)
+    sym_inf, _, _ = onnx_mxnet.import_model(f)
+    sym_tr, _, _ = onnx_mxnet.import_model(f, for_training=True)
+    bn_inf = [n for n in sym_inf._topo() if n.op and n.op.name == "BatchNorm"][0]
+    bn_tr = [n for n in sym_tr._topo() if n.op and n.op.name == "BatchNorm"][0]
+    assert bn_inf.params["use_global_stats"] is True
+    assert bn_tr.params["use_global_stats"] is False

@@ -60,7 +60,11 @@ def measure(kv_type="local", size_mb=1.0, reps=20, compression=None,
         "ndev": ndev,
         "compression": compression or "none",
         "wire_bytes": kv._last_wire_bytes,
-        "gbps": round(size_mb * (1 << 20) * 2 / dt * reps / 1e9, 3),
+        # actual bytes moved per rep (compressed pushes move the packed
+        # codes, not f32) in gigaBITs/s, comparable with link line rates
+        "gbit_per_s": round(
+            ((kv._last_wire_bytes or size_mb * (1 << 20)) * ndev
+             + size_mb * (1 << 20)) * 8 / dt * reps / 1e9, 3),
     }
 
 
