@@ -10,3 +10,20 @@ def maybe_force_cpu(argv=None):
     if "--device=cpu" in argv or (i >= 0 and argv[i + 1:i + 2] == ["cpu"]):
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+
+def pick_ctx():
+    """mx.tpu() when a real accelerator backend resolved, else mx.cpu()."""
+    import jax
+    import mxnet_tpu as mx
+    return mx.tpu() if jax.devices()[0].platform != "cpu" else mx.cpu()
+
+
+def check_improved(metric_name, values, lower_is_better=True):
+    """Exit nonzero when a multi-epoch run did not improve; a single
+    epoch can't self-compare and just reports the value."""
+    if len(values) < 2:
+        return
+    ok = values[-1] < values[0] if lower_is_better else         values[-1] > values[0]
+    if not ok:
+        raise SystemExit("%s did not improve: %s" % (metric_name, values))

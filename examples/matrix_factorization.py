@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Matrix factorization for recommendation (reference example/sparse/
+matrix_factorization/train.py workflow): two SparseEmbedding tables
+(users, items) with row-sparse gradients, dot-product scoring, L2 loss —
+only the rows a batch touches are ever updated (the sparse-embedding
+regime the reference runs over ps-lite; here the lazy-row optimizer
+path).
+
+--data takes a MovieLens-format 'user item rating' file; without it a
+synthetic low-rank rating matrix is sampled.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import maybe_force_cpu, pick_ctx, check_improved  # noqa: E402
+maybe_force_cpu()
+
+import logging
+logging.basicConfig(level=logging.INFO)
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+
+
+class MFBlock(gluon.HybridBlock):
+    def __init__(self, num_users, num_items, factor, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.user = gluon.nn.Embedding(num_users, factor,
+                                           sparse_grad=True)
+            self.item = gluon.nn.Embedding(num_items, factor,
+                                           sparse_grad=True)
+
+    def hybrid_forward(self, F, users, items):
+        return (self.user(users) * self.item(items)).sum(axis=1)
+
+
+def synthetic_ratings(num_users=200, num_items=150, rank=6, n=20000,
+                      seed=0):
+    rng = np.random.RandomState(seed)
+    U = rng.randn(num_users, rank) / np.sqrt(rank)
+    V = rng.randn(num_items, rank) / np.sqrt(rank)
+    u = rng.randint(0, num_users, n)
+    i = rng.randint(0, num_items, n)
+    r = (U[u] * V[i]).sum(1) + 0.05 * rng.randn(n)
+    return u.astype("f4"), i.astype("f4"), r.astype("f4")
+
+
+def load_ratings(path):
+    raw = np.loadtxt(path)
+    return (raw[:, 0].astype("f4"), raw[:, 1].astype("f4"),
+            raw[:, 2].astype("f4"))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None,
+                   help="'user item rating' text file")
+    p.add_argument("--factor", type=int, default=16)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--optimizer", default="groupadagrad",
+                   help="sgd | adagrad | groupadagrad (all lazy-row)")
+    p.add_argument("--device", default=None)
+    args = p.parse_args()
+
+    ctx = pick_ctx()
+    u, i, r = load_ratings(args.data) if args.data else synthetic_ratings()
+    nu, ni = int(u.max()) + 1, int(i.max()) + 1
+    it = mx.io.NDArrayIter({"user": u, "item": i}, r,
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="score")
+
+    net = MFBlock(nu, ni, args.factor)
+    net.initialize(mx.initializer.Normal(0.1), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), args.optimizer,
+                            {"learning_rate": args.lr})
+
+    rmses = []
+    for epoch in range(args.epochs):
+        it.reset()
+        se = count = 0.0
+        for batch in it:
+            users = batch.data[0].as_in_context(ctx)
+            items = batch.data[1].as_in_context(ctx)
+            score = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                pred = net(users, items)
+                loss = loss_fn(pred, score)
+            loss.backward()
+            # sparse_grad=True: these are RowSparseNDArrays — the
+            # optimizer's lazy path touches only the batch's rows
+            trainer.step(users.shape[0])
+            se += float(((pred - score) ** 2).sum().asscalar())
+            count += users.shape[0]
+        rmses.append(float(np.sqrt(se / count)))
+        logging.info("epoch %d: rmse %.4f", epoch, rmses[-1])
+    check_improved("rmse", rmses)
+    print("matrix factorization OK: rmse %.4f -> %.4f (%d users, "
+          "%d items)" % (rmses[0], rmses[-1], nu, ni))
+
+
+if __name__ == "__main__":
+    main()

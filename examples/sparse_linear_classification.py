@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Sparse linear classification (reference example/sparse/
+linear_classification/train.py workflow): LibSVMIter streams CSR
+batches, the weight's gradient is row-sparse, and the optimizer updates
+only the touched rows lazily — the ps-lite workflow re-homed onto the
+kvstore surface (dist_sync/dist_async both work under tools/launch.py).
+
+--data takes a libsvm file (the reference uses criteo/avazu); without it
+a synthetic sparse classification problem is generated.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import maybe_force_cpu, check_improved  # noqa: E402
+maybe_force_cpu()
+
+import logging
+logging.basicConfig(level=logging.INFO)
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def make_synthetic_libsvm(path, n=2000, dim=1000, nnz=12, seed=0):
+    """Linearly separable sparse data: y = sign(w_true . x)."""
+    nnz = min(nnz, dim)
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(dim)
+    with open(path, "w") as f:
+        for _ in range(n):
+            idx = rng.choice(dim, nnz, replace=False)
+            val = rng.randn(nnz)
+            y = 1 if np.dot(w_true[idx], val) > 0 else 0
+            f.write("%d %s\n" % (y, " ".join(
+                "%d:%.4f" % (i, v) for i, v in sorted(zip(idx, val)))))
+    return path
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None, help="libsvm training file")
+    p.add_argument("--num-features", type=int, default=1000)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--kv-store", default="local",
+                   help="local | dist_sync | dist_async (under launch.py)")
+    p.add_argument("--optimizer", default="adagrad")
+    p.add_argument("--device", default=None)
+    args = p.parse_args()
+
+    data_path = args.data or make_synthetic_libsvm(
+        "/tmp/mxtpu_sparse_lc.libsvm", dim=args.num_features)
+    kv = mx.kv.create(args.kv_store)
+    it = mx.io.LibSVMIter(data_libsvm=data_path,
+                          data_shape=(args.num_features,),
+                          batch_size=args.batch_size,
+                          num_parts=kv.num_workers, part_index=kv.rank)
+
+    # row-sparse weight: only rows touched by a batch ever update
+    weight = mx.nd.zeros((args.num_features, 1))
+    bias = mx.nd.zeros((1,))
+    opt = mx.optimizer.create(args.optimizer, learning_rate=args.lr)
+    w_state = opt.create_state(0, weight)
+    b_state = opt.create_state(1, bias)
+
+    from mxnet_tpu.ndarray import sparse as sp
+    accs = []
+    for epoch in range(args.epochs):
+        it.reset()
+        correct = total = 0
+        for batch in it:
+            x = batch.data[0]                       # CSRNDArray (B, D)
+            y = batch.label[0].asnumpy()
+            logits = sp.dot(x, weight).asnumpy().ravel() + \
+                float(bias.asscalar())
+            prob = 1.0 / (1.0 + np.exp(-logits))
+            # logistic grad wrt logits
+            g = (prob - y)[:, None].astype("f4") / len(y)
+            # dL/dW = X^T g — row-sparse: only features present in the
+            # batch get nonzero rows
+            gw_dense = sp.dot(x, mx.nd.array(g), transpose_a=True)
+            gw = sp.cast_storage(gw_dense, "row_sparse")
+            opt.update(0, weight, gw, w_state)
+            opt.update(1, bias, mx.nd.array([float(g.sum())]), b_state)
+            correct += int(((prob > 0.5) == (y > 0.5)).sum())
+            total += len(y)
+        accs.append(correct / total)
+        logging.info("epoch %d: accuracy %.3f", epoch, accs[-1])
+    check_improved("accuracy", accs, lower_is_better=False)
+    print("sparse linear classification OK: acc %.3f -> %.3f "
+          "(%d workers, %s)" % (accs[0], accs[-1], kv.num_workers,
+                                args.kv_store))
+
+
+if __name__ == "__main__":
+    main()

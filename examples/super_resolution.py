@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Single-image super-resolution (reference example/gluon/
+super_resolution.py workflow): the ESPCN sub-pixel CNN — conv stack +
+depth_to_space (PixelShuffle) upscaling — trained with L2 loss on the
+hybridize() imperative path, PSNR reported per epoch.
+
+--data points at a directory of images (the reference uses BSDS300);
+without it, synthetic smooth images are generated (band-limited noise)
+so the script trains anywhere and PSNR measurably rises.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import maybe_force_cpu, pick_ctx, check_improved  # noqa: E402
+maybe_force_cpu()
+
+import logging
+logging.basicConfig(level=logging.INFO)
+
+import math
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+
+
+class SuperResolutionNet(gluon.HybridBlock):
+    def __init__(self, upscale_factor, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.conv1 = gluon.nn.Conv2D(64, (5, 5), padding=(2, 2),
+                                         activation="relu")
+            self.conv2 = gluon.nn.Conv2D(64, (3, 3), padding=(1, 1),
+                                         activation="relu")
+            self.conv3 = gluon.nn.Conv2D(32, (3, 3), padding=(1, 1),
+                                         activation="relu")
+            self.conv4 = gluon.nn.Conv2D(upscale_factor ** 2, (3, 3),
+                                         padding=(1, 1))
+        self.upscale_factor = upscale_factor
+
+    def hybrid_forward(self, F, x):
+        x = self.conv4(self.conv3(self.conv2(self.conv1(x))))
+        # PixelShuffle: (B, r^2, H, W) -> (B, 1, H*r, W*r)
+        return F.depth_to_space(x, block_size=self.upscale_factor)
+
+
+def synthetic_pairs(n=128, size=32, factor=2, seed=0):
+    """Band-limited random images: downsample is information-lossy but
+    learnable."""
+    rng = np.random.RandomState(seed)
+    hi = []
+    for _ in range(n):
+        freq = rng.randn(6, 6)
+        img = np.zeros((size * factor, size * factor), np.float32)
+        xs = np.linspace(0, 2 * np.pi, size * factor)
+        for i in range(6):
+            for j in range(6):
+                img += freq[i, j] * np.outer(np.sin((i + 1) * xs / 2),
+                                             np.cos((j + 1) * xs / 2))
+        img = (img - img.min()) / (np.ptp(img) + 1e-6)
+        hi.append(img.astype(np.float32))
+    hi = np.stack(hi)[:, None]                      # (N, 1, H*r, W*r)
+    lo = hi[:, :, ::factor, ::factor]               # nearest downsample
+    return lo, hi
+
+
+def load_dir(path, size=64, factor=2):
+    import cv2
+    his = []
+    for f in sorted(os.listdir(path)):
+        img = cv2.imread(os.path.join(path, f))
+        if img is None:
+            continue
+        y = cv2.cvtColor(img, cv2.COLOR_BGR2YCrCb)[:, :, 0]
+        y = cv2.resize(y, (size * factor, size * factor))
+        his.append((y / 255.0).astype(np.float32))
+    hi = np.stack(his)[:, None]
+    return hi[:, :, ::factor, ::factor], hi
+
+
+def psnr(pred, target):
+    mse = float(np.mean((pred - target) ** 2))
+    return 10 * math.log10(1.0 / max(mse, 1e-10))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None, help="directory of images")
+    p.add_argument("--upscale-factor", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--device", default=None)
+    args = p.parse_args()
+
+    ctx = pick_ctx()
+    lo, hi = (load_dir(args.data, factor=args.upscale_factor)
+              if args.data else synthetic_pairs(factor=args.upscale_factor))
+    it = mx.io.NDArrayIter(lo, hi, batch_size=args.batch_size,
+                           shuffle=True, label_name="label")
+
+    net = SuperResolutionNet(args.upscale_factor)
+    net.initialize(mx.initializer.Orthogonal(), ctx=ctx)
+    net.hybridize(static_alloc=True)
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    psnrs = []
+    for epoch in range(args.epochs):
+        it.reset()
+        for batch in it:
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+        pred = net(mx.nd.array(lo[:16], ctx=ctx)).asnumpy()
+        v = psnr(pred, hi[:16])
+        psnrs.append(v)
+        logging.info("epoch %d: psnr %.2f dB", epoch, v)
+    check_improved("psnr", psnrs, lower_is_better=False)
+    print("super-resolution OK: psnr %.2f -> %.2f dB"
+          % (psnrs[0], psnrs[-1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -16,7 +16,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import maybe_force_cpu  # noqa: E402
+from _common import maybe_force_cpu, pick_ctx  # noqa: E402
 maybe_force_cpu()
 
 import logging
@@ -120,8 +120,7 @@ def main():
     p.add_argument("--device", default=None)
     args = p.parse_args()
 
-    import jax
-    ctx = mx.tpu() if jax.devices()[0].platform != "cpu" else mx.cpu()
+    ctx = pick_ctx()
     train, val = get_data(args, ctx)
     sym = build_symbol(args)
 
@@ -166,6 +165,7 @@ def main():
             epoch_end_callback=ep_cbs)
 
     if args.benchmark and len(times) >= 2:
+        import jax
         dt = times[-1] - times[0]
         n = args.benchmark_steps * (len(times) - 1)
         print("benchmark: %.2f img/s (batch %d, %s)"

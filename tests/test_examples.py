@@ -32,3 +32,33 @@ def test_gluon_cnn_example():
 def test_char_lstm_example():
     r = _run("char_lstm.py", ["--num-epochs", "1"])
     assert "final" in r.stdout
+
+
+def test_word_language_model_example():
+    r = _run("word_language_model.py",
+             ["--epochs", "2", "--synthetic-tokens", "16000"])
+    assert "LM training OK" in r.stdout
+
+
+def test_super_resolution_example():
+    r = _run("super_resolution.py", ["--epochs", "4"])
+    assert "super-resolution OK" in r.stdout
+
+
+def test_sparse_linear_classification_example():
+    r = _run("sparse_linear_classification.py", ["--epochs", "5"])
+    assert "sparse linear classification OK" in r.stdout
+
+
+def test_matrix_factorization_example():
+    r = _run("matrix_factorization.py", ["--epochs", "6"])
+    assert "matrix factorization OK" in r.stdout
+
+
+def test_train_imagenet_benchmark_mode():
+    r = _run("train_imagenet.py",
+             ["--benchmark", "1", "--benchmark-steps", "2",
+              "--network", "resnet", "--num-layers", "18",
+              "--image-shape", "3,32,32", "--num-classes", "10",
+              "--batch-size", "8"])
+    assert "benchmark:" in r.stdout and "img/s" in r.stdout
