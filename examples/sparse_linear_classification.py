@@ -60,12 +60,16 @@ def main():
                           batch_size=args.batch_size,
                           num_parts=kv.num_workers, part_index=kv.rank)
 
-    # row-sparse weight: only rows touched by a batch ever update
+    # row-sparse weight synchronized THROUGH the kvstore (the reference
+    # workflow): the optimizer runs kvstore-side, push aggregates each
+    # worker's sparse gradient (dist_sync) or applies it immediately
+    # (dist_async), and pull fetches the fresh weights
     weight = mx.nd.zeros((args.num_features, 1))
     bias = mx.nd.zeros((1,))
-    opt = mx.optimizer.create(args.optimizer, learning_rate=args.lr)
-    w_state = opt.create_state(0, weight)
-    b_state = opt.create_state(1, bias)
+    kv.set_optimizer(mx.optimizer.create(args.optimizer,
+                                         learning_rate=args.lr))
+    kv.init(0, weight)
+    kv.init(1, bias)
 
     from mxnet_tpu.ndarray import sparse as sp
     accs = []
@@ -84,8 +88,10 @@ def main():
             # batch get nonzero rows
             gw_dense = sp.dot(x, mx.nd.array(g), transpose_a=True)
             gw = sp.cast_storage(gw_dense, "row_sparse")
-            opt.update(0, weight, gw, w_state)
-            opt.update(1, bias, mx.nd.array([float(g.sum())]), b_state)
+            kv.push(0, gw)
+            kv.push(1, mx.nd.array([float(g.sum())]))
+            kv.pull(0, out=weight, ignore_sparse=False)
+            kv.pull(1, out=bias)
             correct += int(((prob > 0.5) == (y > 0.5)).sum())
             total += len(y)
         accs.append(correct / total)

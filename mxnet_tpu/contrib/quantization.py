@@ -46,24 +46,37 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
             bias_e = mapped_entry(node.inputs[2]) \
                 if not no_bias and len(node.inputs) > 2 else None
             qv2 = _registry.get("_contrib_quantize_v2")
-            q_params = {"out_type": quantized_dtype}
-            if node.name in th_dict:
-                lo, hi = th_dict[node.name]
-                q_params["min_calib_range"] = float(lo)
-                q_params["max_calib_range"] = float(hi)
-            qd = Node(qv2, node.name + "_quantize", [data_e], dict(q_params))
+            if not data_e[0].is_variable and \
+                    data_e[0].op.name == "_contrib_dequantize":
+                # upstream already lives in the int8 domain (a requantized
+                # conv/FC or quantized pooling/concat): consume its
+                # (q, min, max) triple directly — the dequantize/quantize
+                # round-trip between consecutive quantized layers is
+                # elided, exactly what reference quantize_graph_pass.cc
+                # achieves with its requantize chaining
+                t = data_e[0].inputs
+                d_trip = [t[0], t[1], t[2]]
+            else:
+                q_params = {"out_type": quantized_dtype}
+                if node.name in th_dict:
+                    lo, hi = th_dict[node.name]
+                    q_params["min_calib_range"] = float(lo)
+                    q_params["max_calib_range"] = float(hi)
+                qd = Node(qv2, node.name + "_quantize", [data_e],
+                          dict(q_params))
+                d_trip = [(qd, 0), (qd, 1), (qd, 2)]
             qw = Node(qv2, node.name + "_quantize_weight", [weight_e],
                       {"out_type": "int8"})
-            ins = [(qd, 0), (qw, 0)]
+            ins = [d_trip[0], (qw, 0)]
             if bias_e is not None:
                 qb = Node(qv2, node.name + "_quantize_bias", [bias_e],
                           {"out_type": "int8"})
                 ins.append((qb, 0))
-                ranges = [(qd, 1), (qd, 2), (qw, 1), (qw, 2), (qb, 1),
+                ranges = [d_trip[1], d_trip[2], (qw, 1), (qw, 2), (qb, 1),
                           (qb, 2)]
             else:
                 qb = None
-                ranges = [(qd, 1), (qd, 2), (qw, 1), (qw, 2)]
+                ranges = [d_trip[1], d_trip[2], (qw, 1), (qw, 2)]
             qparams = dict(node.params)
             if qb is None:
                 qparams["no_bias"] = True
@@ -76,6 +89,42 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
                 ranges += [(qw, 1), (qw, 2)]
             qnode = Node(qop, node.name + "_quantized", ins + ranges,
                          qparams)
+            # int32 accumulator -> int8 via requantize (reference inserts
+            # one after every int32-output op; calibrated when the node's
+            # OUTPUT stats were collected)
+            rq_params = {}
+            if node.name + "::out" in th_dict:
+                lo, hi = th_dict[node.name + "::out"]
+                rq_params = {"min_calib_range": float(lo),
+                             "max_calib_range": float(hi)}
+            rq = Node(_registry.get("_contrib_requantize"),
+                      node.name + "_requantize",
+                      [(qnode, 0), (qnode, 1), (qnode, 2)], rq_params)
+            deq = Node(_registry.get("_contrib_dequantize"),
+                       node.name + "_dequantize",
+                       [(rq, 0), (rq, 1), (rq, 2)], {})
+            mapping[id(node)] = deq
+        elif op_name in ("Pooling", "Flatten", "Concat") \
+                and node.name not in excluded \
+                and _all_dequantized(node, mapping):
+            # stay in the int8 domain across shape/pool/concat layers
+            # between quantized matmul islands (reference
+            # quantize_graph_pass.cc keeps these quantized so consecutive
+            # conv/FC layers skip the dequantize->requantize round-trip):
+            # consume the (q, min, max) feeding the dequantize directly
+            triples = [mapping[id(e[0])].inputs for e in node.inputs]
+            if op_name == "Concat":
+                qop = _registry.get("_contrib_quantized_concat")
+                ins = [t[0] for t in triples] + \
+                    [r for t in triples for r in (t[1], t[2])]
+            elif op_name == "Pooling":
+                qop = _registry.get("_contrib_quantized_pooling")
+                ins = [triples[0][0], triples[0][1], triples[0][2]]
+            else:
+                qop = _registry.get("_contrib_quantized_flatten")
+                ins = [triples[0][0], triples[0][1], triples[0][2]]
+            qnode = Node(qop, node.name + "_quantized", ins,
+                         dict(node.params))
             deq = Node(_registry.get("_contrib_dequantize"),
                        node.name + "_dequantize",
                        [(qnode, 0), (qnode, 1), (qnode, 2)], {})
@@ -87,6 +136,16 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
     return Symbol([(mapping[id(n)], i) for n, i in sym._entries])
 
 
+def _all_dequantized(node, mapping):
+    """Every input of ``node`` maps to a _contrib_dequantize island."""
+    for (src, _idx) in node.inputs:
+        m = mapping.get(id(src))
+        if m is None or m.is_variable or \
+                m.op.name != "_contrib_dequantize":
+            return False
+    return True
+
+
 def _collect_layer_stats(sym, arg_params, aux_params, calib_data,
                          data_names, num_calib_examples, collect):
     """Run forward passes over calibration batches, feeding `collect` with
@@ -94,11 +153,14 @@ def _collect_layer_stats(sym, arg_params, aux_params, calib_data,
     from .. import ndarray as nd
     from ..executor import _graph_eval_fn
 
-    # internals symbol exposing each quantizable node's data input
+    # internals symbol exposing each quantizable node's data input AND
+    # its output (the output ranges calibrate the post-accumulator
+    # requantize, reference quantization.py collects both)
     targets = {}
     for node in sym._topo():
         if not node.is_variable and node.op.name in _QUANTIZABLE:
             targets[node.name] = node.inputs[0]
+            targets[node.name + "::out"] = (node, 0)
     if not targets:
         return
     probe = Symbol(list(targets.values()))

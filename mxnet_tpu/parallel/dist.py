@@ -224,6 +224,7 @@ def broadcast(value, root=0):
     v = jnp.asarray(value)
     if root == 0:
         # broadcast_one_to_all ignores non-root inputs (they only fix
-        # shape/dtype)
-        return multihost_utils.broadcast_one_to_all(v)
-    return multihost_utils.process_allgather(v)[root]
+        # shape/dtype); it hands back HOST numpy — convert, or the jax
+        # NDArray methods (.at etc.) break downstream
+        return jnp.asarray(multihost_utils.broadcast_one_to_all(v))
+    return jnp.asarray(multihost_utils.process_allgather(v)[root])

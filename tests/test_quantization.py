@@ -1,0 +1,107 @@
+"""int8 quantization graph pass (contrib/quantization.py — reference
+quantize_graph_pass.cc + calibration from quantization.py): quantize
+islands around FC/conv, int8-domain fusion through pooling/flatten/
+concat, naive and entropy calibration, numeric closeness to the float
+model."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as Q
+
+
+def _convnet():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="p1")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=8, name="c2")
+    net = mx.sym.Flatten(net, name="fl")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _setup(seed=0, n=64):
+    rng = np.random.RandomState(seed)
+    sym = _convnet()
+    shapes, _, _ = sym.infer_shape(data=(2, 3, 16, 16))
+    args = {nm: mx.nd.array(rng.uniform(-0.2, 0.2, s).astype("f4"))
+            for nm, s in zip(sym.list_arguments(), shapes)
+            if nm not in ("data", "softmax_label")}
+    X = rng.rand(n, 3, 16, 16).astype("f4")
+    return sym, args, X
+
+
+def _forward(sym, args, X):
+    ex = sym.bind(mx.cpu(), {**args, "data": mx.nd.array(X),
+                             "softmax_label": mx.nd.zeros((len(X),))})
+    ex.forward()
+    return ex.outputs[0].asnumpy()
+
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantize_model_close_to_float(calib_mode):
+    sym, args, X = _setup()
+    it = mx.io.NDArrayIter(X, np.zeros(len(X), "f4"), batch_size=16,
+                           label_name="softmax_label")
+    qsym, qargs, qaux = Q.quantize_model(
+        sym, args, {}, calib_data=it, calib_mode=calib_mode,
+        num_calib_examples=32)
+    ref = _forward(sym, args, X[:4])
+    out = _forward(qsym, qargs, X[:4])
+    assert np.abs(out - ref).max() < 0.1
+
+
+def test_pooling_flatten_stay_int8():
+    """The whole conv->pool->conv->flatten->fc chain runs in the int8
+    domain: no dequantize between quantized islands (reference
+    quantize_graph_pass keeps pooling/flatten/concat quantized)."""
+    sym, args, X = _setup()
+    it = mx.io.NDArrayIter(X, np.zeros(len(X), "f4"), batch_size=16,
+                           label_name="softmax_label")
+    qsym, _, _ = Q.quantize_model(sym, args, {}, calib_data=it,
+                                  calib_mode="naive",
+                                  num_calib_examples=32)
+    ops = [n.op.name for n in qsym._topo() if not n.is_variable]
+    assert "_contrib_quantized_pooling" in ops
+    assert "_contrib_quantized_flatten" in ops
+    # exactly ONE dequantize: at the island's exit before softmax
+    assert ops.count("_contrib_dequantize") == 1
+
+
+def test_concat_stays_int8():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(1, 1), num_filter=4, name="c1")
+    c2 = mx.sym.Convolution(data, kernel=(1, 1), num_filter=4, name="c2")
+    net = mx.sym.Concat(c1, c2, dim=1, name="cat")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(1)
+    shapes, _, _ = sym.infer_shape(data=(2, 3, 8, 8))
+    args = {nm: mx.nd.array(rng.uniform(-0.2, 0.2, s).astype("f4"))
+            for nm, s in zip(sym.list_arguments(), shapes)
+            if nm not in ("data", "softmax_label")}
+    X = rng.rand(32, 3, 8, 8).astype("f4")
+    it = mx.io.NDArrayIter(X, np.zeros(32, "f4"), batch_size=16,
+                           label_name="softmax_label")
+    qsym, qargs, _ = Q.quantize_model(sym, args, {}, calib_data=it,
+                                      calib_mode="naive",
+                                      num_calib_examples=32)
+    ops = [n.op.name for n in qsym._topo() if not n.is_variable]
+    assert "_contrib_quantized_concat" in ops
+    ref = _forward(sym, args, X[:4])
+    out = _forward(qsym, qargs, X[:4])
+    assert np.abs(out - ref).max() < 0.1
+
+
+def test_excluded_layer_stays_float():
+    sym, args, X = _setup()
+    it = mx.io.NDArrayIter(X, np.zeros(len(X), "f4"), batch_size=16,
+                           label_name="softmax_label")
+    qsym, _, _ = Q.quantize_model(sym, args, {}, calib_data=it,
+                                  calib_mode="naive",
+                                  excluded_sym_names=["fc"],
+                                  num_calib_examples=16)
+    names = [n.name for n in qsym._topo() if not n.is_variable]
+    assert "fc" in names and "fc_quantized" not in names
