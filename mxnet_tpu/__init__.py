@@ -92,6 +92,7 @@ def __getattr__(name):
         "visualization": ".visualization",
         "viz": ".visualization",
         "serving": ".serving",
+        "contrib": ".contrib",
     }
     if name in lazy:
         m = importlib.import_module(lazy[name], __name__)

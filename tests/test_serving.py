@@ -112,3 +112,41 @@ def test_cross_platform_tpu_export_from_cpu_host(tmp_path):
     assert meta["platforms"] == ["tpu"]
     cm = mx.serving.CompiledModel.load(art)   # loads anywhere
     assert cm.meta["platforms"] == ["tpu"]    # runs only on a tpu backend
+
+
+def test_int8_model_exports_and_serves(tmp_path):
+    """Quantized graphs are ordinary structure: the whole int8 pipeline
+    stages out to one AOT artifact (docs/serving.md workflow)."""
+    from mxnet_tpu.contrib import quantization as Q
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="c1")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(2, 3, 8, 8))
+    args = {n: mx.nd.array(rng.uniform(-0.2, 0.2, s).astype("f4"))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    X = rng.rand(32, 3, 8, 8).astype("f4")
+    it = mx.io.NDArrayIter(X, np.zeros(32, "f4"), batch_size=16,
+                           label_name="softmax_label")
+    qsym, qargs, qaux = Q.quantize_model(sym, args, {}, calib_data=it,
+                                         calib_mode="naive",
+                                         num_calib_examples=16)
+    art = str(tmp_path / "q.mxtpu")
+    mx.serving.export_compiled(qsym, qargs, qaux, {"data": (2, 3, 8, 8)},
+                               art)
+    out = np.asarray(mx.serving.CompiledModel.load(art)(X[:2])[0])
+    assert out.shape == (2, 3)
+    # the artifact must match the LIVE quantized executor bit-for-bit-ish
+    ex = qsym.bind(mx.cpu(), {**qargs, "data": mx.nd.array(X[:2]),
+                              "softmax_label": mx.nd.zeros((2,))})
+    ex.forward()
+    np.testing.assert_allclose(out, ex.outputs[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    # and stay in the fp32 model's neighborhood (quantization error only)
+    fex = sym.bind(mx.cpu(), {**args, "data": mx.nd.array(X[:2]),
+                              "softmax_label": mx.nd.zeros((2,))})
+    fex.forward()
+    assert np.abs(out - fex.outputs[0].asnumpy()).max() < 0.1
