@@ -1,0 +1,23 @@
+"""JAX API compatibility shims shared by the parallel package."""
+from __future__ import annotations
+
+import inspect
+
+
+def get_shard_map():
+    """shard_map moved from jax.experimental to jax proper in 0.8."""
+    try:
+        from jax import shard_map  # JAX >= 0.8
+    except ImportError:  # pragma: no cover - older JAX
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def shard_map_no_check(**kwargs):
+    """shard_map partial with the replication checker disabled — the
+    kwarg was renamed check_rep -> check_vma across JAX versions."""
+    import functools
+    shard_map = get_shard_map()
+    checker = "check_vma" if "check_vma" in \
+        inspect.signature(shard_map).parameters else "check_rep"
+    return functools.partial(shard_map, **{checker: False}, **kwargs)
