@@ -1,0 +1,349 @@
+#!/usr/bin/env python
+"""Two-stage Faster R-CNN training in miniature (reference example/rcnn
+workflow): RPN over a conv backbone with host-side anchor targets (the
+reference's AnchorLoader), the Proposal contrib op, a ProposalTarget
+**CustomOp** (python operator, exactly how the reference implements it),
+ROIPooling, and the two-head loss — cls SoftmaxOutput with ignore labels
++ smooth_l1/MakeLoss bbox regression — trained end to end with
+Module.fit on synthetic box images until both losses fall.
+
+This is BASELINE config 4's rcnn half: custom ops + static-shape
+handling of a dynamically-sized problem (fixed ROI quota per image).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import maybe_force_cpu, check_improved  # noqa: E402
+maybe_force_cpu()
+
+import logging
+logging.basicConfig(level=logging.INFO)
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import operator as mxop
+
+IMG = 128
+STRIDE = 16
+FEAT = IMG // STRIDE
+SCALES = (2, 4, 6)        # anchor sizes 32/64/96 px at stride 16
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+NUM_CLASSES = 3           # background + 2 object classes
+ROIS_PER_IMG = 16
+
+
+def make_anchors():
+    """EXACTLY the Proposal op's anchors (vision_ops._make_anchors:
+    base box (0,0,bs-1,bs-1), +1 width convention, shift grid k*stride) —
+    targets must use the same parameterization the op decodes with."""
+    from mxnet_tpu.ops.vision_ops import _make_anchors
+    base = _make_anchors(STRIDE, SCALES, RATIOS)    # (A, 4)
+    shifts = np.arange(FEAT) * STRIDE
+    sx, sy = np.meshgrid(shifts, shifts)
+    grid = np.stack([sx, sy, sx, sy], -1).reshape(-1, 1, 4)
+    return (grid + base[None]).reshape(-1, 4)       # (FEAT*FEAT*A, 4)
+
+
+ANCHORS = make_anchors()
+
+
+def iou(boxes, gt):
+    x1 = np.maximum(boxes[:, 0], gt[0])
+    y1 = np.maximum(boxes[:, 1], gt[1])
+    x2 = np.minimum(boxes[:, 2], gt[2])
+    y2 = np.minimum(boxes[:, 3], gt[3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    area_b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    area_g = (gt[2] - gt[0]) * (gt[3] - gt[1])
+    return inter / np.maximum(area_b + area_g - inter, 1e-6)
+
+
+def bbox_transform(anchors, gt):
+    """Box -> regression deltas with the reference's +1 width convention
+    (rcnn bbox_transform == the Proposal op's decode inverse)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + (aw - 1) / 2
+    ay = anchors[:, 1] + (ah - 1) / 2
+    gw, gh = gt[2] - gt[0] + 1.0, gt[3] - gt[1] + 1.0
+    gx, gy = gt[0] + (gw - 1) / 2, gt[1] + (gh - 1) / 2
+    return np.stack([(gx - ax) / aw, (gy - ay) / ah,
+                     np.log(gw / aw), np.log(gh / ah)], -1)
+
+
+def anchor_targets(gt_box):
+    """Host-side RPN targets (the reference AnchorLoader's job)."""
+    overlaps = iou(ANCHORS, gt_box)
+    label = np.full(len(ANCHORS), -1.0, np.float32)
+    label[overlaps < 0.3] = 0.0
+    label[overlaps >= 0.5] = 1.0
+    label[overlaps.argmax()] = 1.0
+    # cap negatives to keep the loss balanced
+    neg = np.where(label == 0)[0]
+    if len(neg) > 3 * max((label == 1).sum(), 1) + 8:
+        drop = np.random.RandomState(0).choice(
+            neg, len(neg) - (3 * int((label == 1).sum()) + 8),
+            replace=False)
+        label[drop] = -1.0
+    targets = bbox_transform(ANCHORS, gt_box).astype(np.float32)
+    weight = (label == 1).astype(np.float32)[:, None] * np.ones(
+        (1, 4), np.float32)
+    # layouts the RPN heads emit: label (A*FEAT*FEAT,), bbox (4A, F, F)
+    lab = label.reshape(FEAT * FEAT, A).T.reshape(-1)
+    tgt = targets.reshape(FEAT, FEAT, A * 4).transpose(2, 0, 1)
+    wgt = weight.reshape(FEAT, FEAT, A * 4).transpose(2, 0, 1)
+    return lab, tgt, wgt
+
+
+@mxop.register("proposal_target")
+class ProposalTargetProp(mxop.CustomOpProp):
+    """Sample a fixed ROI quota per image and label it against the gt box
+    (reference example/rcnn proposal_target.py — a python CustomOp)."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_out", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        n_img = in_shape[1][0]
+        n = n_img * ROIS_PER_IMG
+        return in_shape, [(n, 5), (n,), (n, 4 * NUM_CLASSES),
+                          (n, 4 * NUM_CLASSES)], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalTarget()
+
+
+class ProposalTarget(mxop.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()          # (R, 5) [batch, x1..y2]
+        gts = in_data[1].asnumpy()           # (N, 5) [x1..y2, cls]
+        out_r, out_l, out_t, out_w = [], [], [], []
+        for b in range(len(gts)):
+            gt = gts[b]
+            mine = rois[rois[:, 0] == b][:, 1:]
+            # drop the Proposal op's [-1,-1,-1,-1] NMS padding rows — they
+            # would otherwise fill the background quota with zero-feature
+            # samples (reference pads with repeated VALID proposals)
+            mine = mine[mine[:, 2] > mine[:, 0]]
+            if len(mine) == 0:
+                mine = ANCHORS[:1]
+            # gt box always joins the pool (reference does the same)
+            pool = np.vstack([mine, gt[None, :4]])
+            ov = iou(pool, gt[:4])
+            order = np.argsort(-ov)
+            fg = order[ov[order] >= 0.5][: ROIS_PER_IMG // 4]
+            bg = order[ov[order] < 0.5][: ROIS_PER_IMG - len(fg)]
+            keep = np.concatenate([fg, bg])
+            if len(keep) < ROIS_PER_IMG:    # pad by repeating
+                keep = np.resize(keep, ROIS_PER_IMG)
+            sel = pool[keep]
+            lab = np.zeros(ROIS_PER_IMG, np.float32)
+            lab[: len(fg)] = gt[4] + 1      # class id (0 = background)
+            tgt = np.zeros((ROIS_PER_IMG, 4 * NUM_CLASSES), np.float32)
+            wgt = np.zeros_like(tgt)
+            deltas = bbox_transform(sel[: len(fg)], gt[:4]) \
+                if len(fg) else np.zeros((0, 4))
+            for j in range(len(fg)):
+                c = int(lab[j])
+                tgt[j, 4 * c:4 * c + 4] = deltas[j]
+                wgt[j, 4 * c:4 * c + 4] = 1.0
+            out_r.append(np.hstack([np.full((ROIS_PER_IMG, 1), b,
+                                            np.float32), sel]))
+            out_l.append(lab)
+            out_t.append(tgt)
+            out_w.append(wgt)
+        self.assign(out_data[0], req[0], np.vstack(out_r))
+        self.assign(out_data[1], req[1], np.concatenate(out_l))
+        self.assign(out_data[2], req[2], np.vstack(out_t))
+        self.assign(out_data[3], req[3], np.vstack(out_w))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g in in_grad:                     # sampling has no gradient
+            self.assign(g, "write", 0 * g)
+
+
+def rcnn_symbol():
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    gt_boxes = mx.sym.Variable("gt_boxes")
+    rpn_label = mx.sym.Variable("rpn_label")
+    rpn_bbox_target = mx.sym.Variable("rpn_bbox_target")
+    rpn_bbox_weight = mx.sym.Variable("rpn_bbox_weight")
+
+    def conv_block(x, nf, name, stride=1):
+        x = mx.sym.Convolution(x, kernel=(3, 3), stride=(stride, stride),
+                               pad=(1, 1), num_filter=nf, name=name)
+        return mx.sym.Activation(x, act_type="relu")
+
+    x = conv_block(data, 16, "c1", 2)
+    x = conv_block(x, 32, "c2", 2)
+    x = conv_block(x, 32, "c3", 2)
+    feat = conv_block(x, 64, "c4", 2)          # stride 16
+
+    rpn = conv_block(feat, 64, "rpn_conv")
+    rpn_cls = mx.sym.Convolution(rpn, kernel=(1, 1), num_filter=2 * A,
+                                 name="rpn_cls_score")
+    rpn_bbox = mx.sym.Convolution(rpn, kernel=(1, 1), num_filter=4 * A,
+                                  name="rpn_bbox_pred")
+
+    # RPN losses (reference symbol_vgg.py get_vgg_rpn semantics)
+    rpn_cls_r = mx.sym.reshape(rpn_cls, shape=(0, 2, -1))
+    rpn_cls_prob = mx.sym.SoftmaxOutput(
+        rpn_cls_r, rpn_label, multi_output=True, use_ignore=True,
+        ignore_label=-1, normalization="valid", name="rpn_cls_prob")
+    rpn_bbox_loss = mx.sym.MakeLoss(
+        mx.sym.smooth_l1(rpn_bbox_weight * (rpn_bbox - rpn_bbox_target),
+                         scalar=3.0),
+        grad_scale=1.0 / (FEAT * FEAT), name="rpn_bbox_loss")
+
+    # proposals (no grad through the sampling) -> fixed ROI quota
+    score_shape = mx.sym.reshape(rpn_cls, shape=(0, 2, A, FEAT, FEAT))
+    probs = mx.sym.softmax(score_shape, axis=1)
+    probs = mx.sym.reshape(probs, shape=(0, 2 * A, FEAT, FEAT))
+    rois = mx.sym.contrib.Proposal(
+        mx.sym.BlockGrad(probs), mx.sym.BlockGrad(rpn_bbox), im_info,
+        feature_stride=STRIDE, scales=SCALES, ratios=RATIOS,
+        rpn_pre_nms_top_n=64, rpn_post_nms_top_n=ROIS_PER_IMG,
+        threshold=0.7, rpn_min_size=8, name="rois")
+    target = mx.sym.Custom(rois=rois, gt_boxes=gt_boxes,
+                           op_type="proposal_target", name="pt")
+    rois_s, label, bbox_target, bbox_weight = (
+        target[0], target[1], target[2], target[3])
+
+    pooled = mx.sym.ROIPooling(feat, rois_s, pooled_size=(4, 4),
+                               spatial_scale=1.0 / STRIDE, name="roi_pool")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(pooled, num_hidden=128, name="fc6"),
+        act_type="relu")
+    cls_prob = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=NUM_CLASSES, name="cls_score"),
+        mx.sym.BlockGrad(label), normalization="valid", name="cls_prob")
+    bbox_loss = mx.sym.MakeLoss(
+        mx.sym.smooth_l1(
+            bbox_weight * (mx.sym.FullyConnected(
+                h, num_hidden=4 * NUM_CLASSES, name="bbox_pred")
+                - bbox_target), scalar=1.0),
+        grad_scale=1.0 / ROIS_PER_IMG, name="bbox_loss")
+    return mx.sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss,
+                         mx.sym.BlockGrad(label)])
+
+
+class RCNNIter(mx.io.DataIter):
+    """Synthetic detection batches + host-side RPN anchor targets."""
+
+    def __init__(self, n=64, batch_size=2, seed=0):
+        super().__init__(batch_size)
+        rng = np.random.RandomState(seed)
+        self.data, self.gt = [], []
+        for _ in range(n):
+            img = rng.rand(3, IMG, IMG).astype(np.float32) * 0.1
+            cls = rng.randint(0, NUM_CLASSES - 1)
+            size = rng.randint(36, 80)
+            x1 = rng.randint(0, IMG - size)
+            y1 = rng.randint(0, IMG - size)
+            img[cls, y1:y1 + size, x1:x1 + size] += 0.8
+            self.data.append(img)
+            self.gt.append(np.array([x1, y1, x1 + size, y1 + size, cls],
+                                    np.float32))
+        self.n = n
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size, 3, IMG, IMG)),
+                ("im_info", (self.batch_size, 3)),
+                ("gt_boxes", (self.batch_size, 5))]
+
+    @property
+    def provide_label(self):
+        return [("rpn_label", (self.batch_size, A * FEAT * FEAT)),
+                ("rpn_bbox_target", (self.batch_size, 4 * A, FEAT, FEAT)),
+                ("rpn_bbox_weight", (self.batch_size, 4 * A, FEAT, FEAT))]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def next(self):
+        from mxnet_tpu.io import DataBatch
+        self.cursor += self.batch_size
+        if self.cursor + self.batch_size > self.n:
+            raise StopIteration
+        sl = slice(self.cursor, self.cursor + self.batch_size)
+        imgs = np.stack(self.data[sl])
+        gts = np.stack(self.gt[sl])
+        labs, tgts, wgts = zip(*(anchor_targets(g[:4]) for g in self.gt[sl]))
+        info = np.tile([IMG, IMG, 1.0], (self.batch_size, 1)) \
+            .astype(np.float32)
+        return DataBatch(
+            data=[mx.nd.array(imgs), mx.nd.array(info), mx.nd.array(gts)],
+            label=[mx.nd.array(np.stack(labs)), mx.nd.array(np.stack(tgts)),
+                   mx.nd.array(np.stack(wgts))], pad=0)
+
+
+class RCNNMetric(mx.metric.EvalMetric):
+    """rpn_cls NLL + head cls NLL (reference rcnn metric set)."""
+
+    def __init__(self):
+        super().__init__("rcnn_loss")
+
+    def update(self, labels, preds):
+        rpn_prob = preds[0].asnumpy()          # (B, 2, A*F*F)
+        rpn_lab = labels[0].asnumpy()
+        m = rpn_lab >= 0
+        idx = rpn_lab.clip(0).astype(int)
+        p = np.take_along_axis(rpn_prob, idx[:, None, :], 1)[:, 0][m]
+        rpn_nll = -np.log(np.maximum(p, 1e-9)).sum()
+        cls_prob = preds[2].asnumpy()          # (B*R, C)
+        lab = preds[4].asnumpy().astype(int).ravel()
+        pc = cls_prob[np.arange(len(lab)), lab]
+        cls_nll = -np.log(np.maximum(pc, 1e-9)).sum()
+        self.sum_metric += rpn_nll + cls_nll
+        self.num_inst += m.sum() + len(lab)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--kv-store", default="local")
+    p.add_argument("--device", default=None)
+    args = p.parse_args()
+
+    it = RCNNIter(batch_size=args.batch_size)
+    sym = rcnn_symbol()
+    mod = mx.mod.Module(sym,
+                        data_names=("data", "im_info", "gt_boxes"),
+                        label_names=("rpn_label", "rpn_bbox_target",
+                                     "rpn_bbox_weight"))
+    metric = RCNNMetric()
+    losses = []
+
+    def epoch_cb(epoch, s, a, b):
+        losses.append(metric.get()[1])
+
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 5e-4},
+            initializer=mx.initializer.Xavier(),
+            kvstore=args.kv_store, eval_metric=metric,
+            epoch_end_callback=epoch_cb)
+    for e, v in enumerate(losses):
+        logging.info("epoch %d: loss %.3f", e, v)
+    check_improved("rcnn loss", losses)
+    print("Faster R-CNN training OK: loss %.3f -> %.3f"
+          % (losses[0], losses[-1]))
+
+
+if __name__ == "__main__":
+    main()

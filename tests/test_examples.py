@@ -62,3 +62,8 @@ def test_train_imagenet_benchmark_mode():
               "--image-shape", "3,32,32", "--num-classes", "10",
               "--batch-size", "8"])
     assert "benchmark:" in r.stdout and "img/s" in r.stdout
+
+
+def test_train_rcnn_example():
+    r = _run("train_rcnn.py", ["--epochs", "3"])
+    assert "Faster R-CNN training OK" in r.stdout
