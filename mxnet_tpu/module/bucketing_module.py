@@ -125,9 +125,19 @@ class BucketingModule(BaseModule):
                 module._kvstore = cur._kvstore
                 module._update_on_kvstore = cur._update_on_kvstore
                 module.optimizer_initialized = True
+                # fused one-program step per bucket (each bucket compiles
+                # once — the bucketing contract); optimizer STATE is
+                # mirrored across buckets below, so momentum stays one
+                # accumulator per weight like the reference's shared
+                # Updater
+                module._init_fused_step(cur._kvstore)
             self._buckets[bucket_key] = module
+        prev = self._curr_module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
+        if prev is not None and prev is not self._curr_module \
+                and self.optimizer_initialized:
+            self._sync_fused_opt_state(prev, self._curr_module)
         if self.params_initialized:
             # share the canonical parameter arrays across buckets
             default = self._buckets[self._default_bucket_key]
@@ -152,7 +162,42 @@ class BucketingModule(BaseModule):
                 mod._kvstore = self._curr_module._kvstore
                 mod._update_on_kvstore = self._curr_module._update_on_kvstore
                 mod.optimizer_initialized = True
+                mod._init_fused_step(self._curr_module._kvstore)
         self.optimizer_initialized = True
+
+    @staticmethod
+    def _sync_fused_opt_state(prev, cur):
+        """One optimizer accumulator per weight across buckets: the
+        shared eager Updater is the interchange format — a fused module
+        mirrors its state out on switch-away and the next fused module
+        adopts it on switch-in (no recompile; state-only)."""
+        if prev._fused is not None and prev._fused_opt_state is not None \
+                and prev._updater is not None:
+            prev._updater.states = prev._fused.state_to_updater(
+                prev._fused_opt_state)
+        if cur._fused is not None and cur._updater is not None \
+                and cur._updater.states:
+            cur._fused_opt_state = cur._fused.state_from_updater(
+                cur._updater.states)
+
+    def _sync_params_to_default(self):
+        """The default bucket carries the canonical parameters other
+        buckets re-sync from on switch."""
+        default = self._buckets[self._default_bucket_key]
+        if self._curr_module is not default:
+            arg_params, aux_params = self._curr_module.get_params()
+            default.init_params(arg_params=arg_params,
+                                aux_params=aux_params, force_init=True)
+
+    def _fit_step(self, data_batch):
+        """Fit-loop iteration through the CURRENT bucket's fused step
+        (falls back to eager inside Module._fit_step), preserving the
+        default-bucket parameter sync that update() performs."""
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._params_dirty = True
+        self._curr_module._fit_step(data_batch)
+        self._sync_params_to_default()
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         assert self.binded and self.params_initialized
@@ -174,12 +219,7 @@ class BucketingModule(BaseModule):
     def update(self):
         self._params_dirty = True
         self._curr_module.update()
-        # propagate updated params to default bucket storage is implicit:
-        # all buckets re-sync on switch via init_params
-        if self._curr_module is not self._buckets[self._default_bucket_key]:
-            arg_params, aux_params = self._curr_module.get_params()
-            self._buckets[self._default_bucket_key].init_params(
-                arg_params=arg_params, aux_params=aux_params, force_init=True)
+        self._sync_params_to_default()
 
     def get_outputs(self, merge_multi_context=True):
         return self._curr_module.get_outputs(merge_multi_context)
@@ -201,5 +241,15 @@ class BucketingModule(BaseModule):
             mod.install_monitor(mon)
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        if save_optimizer_states:
+            # the ACTIVE bucket holds the freshest fused optimizer state;
+            # mirror it into the shared Updater BEFORE the default bucket
+            # snapshots (its own fused state is stale since the last
+            # switch), or resumed momentum silently restarts from the
+            # switch point
+            cur = self._curr_module
+            default = self._buckets[self._default_bucket_key]
+            if cur is not default:
+                self._sync_fused_opt_state(cur, default)
         self._buckets[self._default_bucket_key].save_checkpoint(
             prefix, epoch, save_optimizer_states)

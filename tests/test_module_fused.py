@@ -324,3 +324,117 @@ def test_eval_metric_none_with_eval_data_raises():
     mod = mx.mod.Module(sym)
     with pytest.raises(ValueError):
         mod.fit(it, eval_data=it2, eval_metric=None, num_epoch=1)
+
+
+def test_bucketing_fused_matches_eager_across_buckets():
+    """BucketingModule engages the fused step per bucket with ONE
+    optimizer accumulator per weight across buckets (mirrored through
+    the shared Updater on switches) — numerics must match the all-eager
+    path over an alternating-bucket schedule."""
+    from mxnet_tpu import config
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    def sym_gen(L):
+        data = mx.sym.Variable("data")
+        net = mx.sym.mean(data, axis=1)             # (B, 4) for any L
+        net = mx.sym.FullyConnected(net, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        return (mx.sym.SoftmaxOutput(net, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    rng = np.random.RandomState(0)
+    buckets = [3, 5]
+    batches = []
+    for i in range(8):
+        L = buckets[i % 2]
+        b = DataBatch(
+            data=[mx.nd.array(rng.randn(4, L, 4).astype("f4"))],
+            label=[mx.nd.array(rng.randint(0, 4, (4,)).astype("f4"))],
+            provide_data=[DataDesc("data", (4, L, 4))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+        b.bucket_key = L
+        batches.append(b)
+
+    def train(fused):
+        with config.override(module_fused_step=fused):
+            mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=5)
+            mod.bind([DataDesc("data", (4, 5, 4))],
+                     [DataDesc("softmax_label", (4,))])
+            prng = np.random.RandomState(3)
+            sym5 = sym_gen(5)[0]
+            shapes, _, _ = sym5.infer_shape(data=(4, 5, 4))
+            fixed = {n: mx.nd.array(
+                prng.uniform(-0.1, 0.1, s).astype("f4"))
+                for n, s in zip(sym5.list_arguments(), shapes)
+                if n not in ("data", "softmax_label")}
+            mod.init_params(arg_params=fixed, aux_params={},
+                            allow_missing=True)
+            mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.1,
+                                                 "momentum": 0.9})
+            if fused:
+                assert mod._curr_module._fused is not None
+            for b in batches:
+                mod._fit_step(b)
+            return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    p_f = train(True)
+    p_e = train(False)
+    for k in p_e:
+        np.testing.assert_allclose(p_f[k], p_e[k], rtol=2e-5, atol=2e-6,
+                                    err_msg=k)
+
+
+def test_bucketing_checkpoint_saves_active_bucket_momentum(tmp_path):
+    """save_checkpoint(save_optimizer_states=True) while a NON-default
+    bucket is active must capture that bucket's fused momentum (not the
+    default bucket's stale snapshot)."""
+    from mxnet_tpu import config
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    def sym_gen(L):
+        data = mx.sym.Variable("data")
+        net = mx.sym.mean(data, axis=1)
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+        return (mx.sym.SoftmaxOutput(net, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    rng = np.random.RandomState(0)
+
+    def batch(L):
+        b = DataBatch(
+            data=[mx.nd.array(rng.randn(4, L, 4).astype("f4"))],
+            label=[mx.nd.array(rng.randint(0, 4, (4,)).astype("f4"))],
+            provide_data=[DataDesc("data", (4, L, 4))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+        b.bucket_key = L
+        return b
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=5)
+    mod.bind([DataDesc("data", (4, 5, 4))], [DataDesc("softmax_label", (4,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    assert mod._curr_module._fused is not None
+    # switch to bucket 3 and train ONLY there: all momentum lives in
+    # bucket 3's fused state
+    for _ in range(4):
+        mod._fit_step(batch(3))
+    prefix = str(tmp_path / "bk")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+
+    states = open(prefix + "-0001.states", "rb").read()
+    eager = mx.mod.Module(sym_gen(5)[0])
+    eager.bind([DataDesc("data", (4, 5, 4))],
+               [DataDesc("softmax_label", (4,))])
+    eager.init_params(initializer=mx.initializer.Xavier())
+    eager.init_optimizer(kvstore="local", optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+    eager._updater.set_states(states)
+    moms = [s.asnumpy() if hasattr(s, "asnumpy") else np.asarray(s)
+            for s in eager._updater.states.values() if s is not None]
+    assert any(np.abs(m).max() > 0 for m in moms), \
+        "saved momentum is all-zero: active bucket's state was lost"
