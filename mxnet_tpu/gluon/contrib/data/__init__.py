@@ -1,0 +1,32 @@
+"""gluon.contrib.data (reference python/mxnet/gluon/contrib/data/
+sampler.py): IntervalSampler."""
+from __future__ import annotations
+
+from ...data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Walk the dataset with a stride: 0, k, 2k, ..., 1, k+1, ...
+    (reference sampler.py IntervalSampler). rollover=False stops after
+    the first pass."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise ValueError(
+                "interval %d must not exceed length %d" % (interval,
+                                                           length))
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval) if self._rollover else [0]
+        for start in starts:
+            for i in range(start, self._length, self._interval):
+                yield i
+
+    def __len__(self):
+        return self._length if self._rollover \
+            else len(range(0, self._length, self._interval))

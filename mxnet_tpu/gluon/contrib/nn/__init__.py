@@ -1,0 +1,79 @@
+"""gluon.contrib.nn (reference python/mxnet/gluon/contrib/nn/
+basic_layers.py): Concurrent/HybridConcurrent tower containers,
+Identity, SparseEmbedding, SyncBatchNorm."""
+from __future__ import annotations
+
+from ...nn.basic_layers import (Sequential, HybridSequential, HybridBlock,
+                                Block, BatchNorm, Embedding)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
+
+
+class Concurrent(Sequential):
+    """Feed ONE input to every child, concat the outputs along ``axis``
+    (reference basic_layers.py:29 — the Inception-tower container)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference basic_layers.py:62)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference basic_layers.py:95): the skip branch
+    of a Concurrent tower."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding whose weight gradient is row-sparse (reference
+    basic_layers.py:116): only the rows a batch touches are updated, so
+    huge vocabularies train through the lazy-row optimizer path. A thin
+    alias of ``gluon.nn.Embedding(sparse_grad=True)`` — one gather
+    implementation, still hybridizable."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+    def __repr__(self):
+        return "SparseEmbedding(%s -> %s)" % (self._kwargs["input_dim"],
+                                              self._kwargs["output_dim"])
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference basic_layers.py:163 /
+    contrib/sync_batch_norm.cc).
+
+    TPU-first note: under GSPMD the batch axis is ONE logical tensor —
+    BatchNorm's reduction over a dp-sharded batch already spans every
+    device (XLA inserts the cross-replica sum), so synchronized statistics
+    are the default here and this class only keeps the reference's
+    surface (``num_devices`` accepted for API parity, unused)."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices

@@ -1,0 +1,304 @@
+"""gluon.contrib.rnn (reference python/mxnet/gluon/contrib/rnn/):
+VariationalDropoutCell, LSTMPCell, and the Conv{1,2,3}D{RNN,LSTM,GRU}Cell
+family.
+
+TPU-first shape: every cell is ordinary jit-traceable gluon; the conv
+cells express i2h/h2h as `F.Convolution` so XLA fuses the gate math into
+the convolutions, and variational dropout draws its masks ONCE per
+unroll (all timesteps of one compiled scan share the same mask
+constants)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import (HybridRecurrentCell, ModifierCell, LSTMCell,
+                             GRUCell, RNNCell)
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (same-mask-across-time) dropout around a cell
+    (reference contrib/rnn/rnn_cell.py:26, Gal & Ghahramani 2016): masks
+    for inputs/states/outputs are drawn once per sequence and reused at
+    every step. reset() discards them; under a compiled unroll the masks
+    become constants of the scan."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    @staticmethod
+    def _mask(F, p, like):
+        return F.Dropout(F.ones_like(like), p=p)
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(F, self.drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if self.drop_states:
+            if self._state_mask is None:
+                self._state_mask = self._mask(F, self.drop_states,
+                                              states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
+        out, states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(F, self.drop_outputs, out)
+            out = out * self._output_mask
+        return out, states
+
+    def __repr__(self):
+        return "VariationalDropoutCell(%s)" % self.base_cell.name
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a hidden-state projection (reference
+    contrib/rnn/rnn_cell.py:197, Sak et al. 2014): the recurrent state is
+    ``r = h @ h2r`` of size ``projection_size`` — the h2h matmul shrinks
+    from h*4h to r*4h, the LSTMP trick for large hidden sizes."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def _layer_infer_shape(self, x_shape, *rest):
+        h, r = self._hidden_size, self._projection_size
+        self.i2h_weight._finish_deferred_init((4 * h, int(x_shape[-1])))
+        self.h2h_weight._finish_deferred_init((4 * h, r))
+        self.h2r_weight._finish_deferred_init((r, h))
+        self.i2h_bias._finish_deferred_init((4 * h,))
+        self.h2h_bias._finish_deferred_init((4 * h,))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        h = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * h)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * h)
+        parts = F.split(i2h + h2h, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(parts[0])
+        forget_gate = F.sigmoid(parts[1])
+        in_transform = F.tanh(parts[2])
+        out_gate = F.sigmoid(parts[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        hidden = out_gate * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared machinery of the conv recurrent cells (reference
+    contrib/rnn/conv_rnn_cell.py:37): i2h and h2h are convolutions over
+    (C, spatial...) states; gate count differs per family."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 n_gates, i2h_pad=None, activation="tanh", prefix=None,
+                 params=None, conv_ndim=2):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)   # (C, spatial...)
+        self._hidden_channels = hidden_channels
+        self._ndim = conv_ndim
+        self._n_gates = n_gates
+        self._activation = activation
+        k = i2h_kernel if isinstance(i2h_kernel, tuple) \
+            else (i2h_kernel,) * conv_ndim
+        hk = h2h_kernel if isinstance(h2h_kernel, tuple) \
+            else (h2h_kernel,) * conv_ndim
+        if any(x % 2 == 0 for x in hk):
+            raise ValueError(
+                "h2h_kernel must be odd in every dimension (state shape "
+                "must be preserved), got %s" % (hk,))
+        self._i2h_kernel = k
+        self._h2h_kernel = hk
+        self._i2h_pad = tuple(i2h_pad) if i2h_pad is not None \
+            else tuple(x // 2 for x in k)
+        self._h2h_pad = tuple(x // 2 for x in hk)
+        nc = n_gates * hidden_channels
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(nc, self._input_shape[0]) + k,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(nc, hidden_channels) + hk,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(nc,), init="zeros",
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(nc,), init="zeros",
+            allow_deferred_init=True)
+
+    @property
+    def _state_shape(self):
+        # i2h uses stride 1 + explicit padding: spatial dims follow conv
+        spatial = tuple(
+            s + 2 * p - k + 1 for s, k, p in
+            zip(self._input_shape[1:], self._i2h_kernel, self._i2h_pad))
+        return (self._hidden_channels,) + spatial
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape
+        n_states = 2 if self._n_gates == 4 else 1
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._ndim:]}
+                for _ in range(n_states)]
+
+    def _layer_infer_shape(self, x_shape, *rest):
+        nc = self._n_gates * self._hidden_channels
+        self.i2h_weight._finish_deferred_init(
+            (nc, int(x_shape[1])) + self._i2h_kernel)
+        self.h2h_weight._finish_deferred_init(
+            (nc, self._hidden_channels) + self._h2h_kernel)
+        self.i2h_bias._finish_deferred_init((nc,))
+        self.h2h_bias._finish_deferred_init((nc,))
+
+    def _convs(self, F, inputs, state, i2h_weight, h2h_weight, i2h_bias,
+               h2h_bias):
+        nc = self._n_gates * self._hidden_channels
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, num_filter=nc,
+                            pad=self._i2h_pad)
+        h2h = F.Convolution(state, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, num_filter=nc,
+                            pad=self._h2h_pad)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 activation="tanh", conv_ndim=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, n_gates=1, activation=activation,
+                         conv_ndim=conv_ndim, **kwargs)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 activation="tanh", conv_ndim=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, n_gates=4, activation=activation,
+                         conv_ndim=conv_ndim, **kwargs)
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        parts = F.split(i2h + h2h, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(parts[0])
+        forget_gate = F.sigmoid(parts[1])
+        in_transform = self._act(F, parts[2])
+        out_gate = F.sigmoid(parts[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 activation="tanh", conv_ndim=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, n_gates=3, activation=activation,
+                         conv_ndim=conv_ndim, **kwargs)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        i2h_p = F.split(i2h, num_outputs=3, axis=1)
+        h2h_p = F.split(h2h, num_outputs=3, axis=1)
+        reset_gate = F.sigmoid(i2h_p[0] + h2h_p[0])
+        update_gate = F.sigmoid(i2h_p[1] + h2h_p[1])
+        new_mem = self._act(F, i2h_p[2] + reset_gate * h2h_p[2])
+        out = update_gate * states[0] + (1.0 - update_gate) * new_mem
+        return out, [out]
+
+
+def _make_conv_cell(base, ndim, name):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, activation="tanh", **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, activation=activation,
+                             conv_ndim=ndim, **kwargs)
+    Cell.__name__ = Cell.__qualname__ = name
+    Cell.__doc__ = ("%s (reference contrib/rnn/conv_rnn_cell.py): "
+                    "input_shape is (C, spatial...)." % name)
+    return Cell
+
+
+Conv1DRNNCell = _make_conv_cell(_ConvRNNCell, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _make_conv_cell(_ConvRNNCell, 2, "Conv2DRNNCell")
+Conv3DRNNCell = _make_conv_cell(_ConvRNNCell, 3, "Conv3DRNNCell")
+Conv1DLSTMCell = _make_conv_cell(_ConvLSTMCell, 1, "Conv1DLSTMCell")
+Conv2DLSTMCell = _make_conv_cell(_ConvLSTMCell, 2, "Conv2DLSTMCell")
+Conv3DLSTMCell = _make_conv_cell(_ConvLSTMCell, 3, "Conv3DLSTMCell")
+Conv1DGRUCell = _make_conv_cell(_ConvGRUCell, 1, "Conv1DGRUCell")
+Conv2DGRUCell = _make_conv_cell(_ConvGRUCell, 2, "Conv2DGRUCell")
+Conv3DGRUCell = _make_conv_cell(_ConvGRUCell, 3, "Conv3DGRUCell")

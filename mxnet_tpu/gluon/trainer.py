@@ -154,12 +154,35 @@ class Trainer:
                         % param.name)
                 continue  # skip stale grads (reference trainer.py :340)
             if self._update_on_kvstore:
-                self._kvstore.push(i, param.grad())
+                g = param.grad()
+                if param.grad_stype == "row_sparse":
+                    # the kvstore's updater must also hit the lazy
+                    # row_sparse branch, or dist training would dense-
+                    # decay every row while local training doesn't
+                    from ..ndarray import sparse as _sp
+                    g = _sp.cast_storage(g, "row_sparse")
+                self._kvstore.push(i, g)
                 # weights must always come back, even from a sparse store
                 self._kvstore.pull(i, param.data(), ignore_sparse=False)
             else:
                 work.append((i, param))
             info.fresh = False
+        # sparse_grad parameters route through the optimizers' lazy
+        # row_sparse branch (touched rows = nonzero gradient rows; a
+        # batch index whose accumulated gradient is EXACTLY zero skips
+        # its wd/momentum tick — the one observable difference from the
+        # reference kernels, which key off the gathered indices); they
+        # are excluded from the fused dense program
+        sparse_work = [(i, p) for i, p in work
+                       if p.grad_stype == "row_sparse"]
+        if sparse_work:
+            from ..ndarray import sparse as _sp
+            work = [(i, p) for i, p in work
+                    if p.grad_stype != "row_sparse"]
+            upd = self._updaters[0]
+            for i, param in sparse_work:
+                upd(i, _sp.cast_storage(param.grad(), "row_sparse"),
+                    param.data())
         if work:
             if not self._fused_update(work):
                 upd = self._updaters[0]

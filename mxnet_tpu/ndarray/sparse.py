@@ -302,6 +302,21 @@ def cast_storage(arr, stype):
         return arr
     if stype == "default":
         return arr.todense()
+    if stype == "row_sparse" and isinstance(arr, NDArray) \
+            and not isinstance(arr, BaseSparseNDArray):
+        # device fast path: the row-occupancy reduction runs ON DEVICE
+        # and only an (N,) bool vector crosses to the host (picking the
+        # row ids is inherently data-dependent); the kept rows are then
+        # a device gather. The naive path copies the WHOLE dense matrix
+        # to the host — for a large embedding gradient that is the
+        # entire point of being sparse, gone.
+        import jax.numpy as jnp
+        g = arr._data
+        occ = _np.asarray(jnp.any(g != 0, axis=tuple(range(1, g.ndim))))
+        rows = _np.nonzero(occ)[0].astype(_np.int64)
+        return RowSparseNDArray(g[jnp.asarray(rows)],
+                                jnp.asarray(rows), arr.shape,
+                                ctx=arr._ctx)
     dense_np = arr.asnumpy()
     if stype == "row_sparse":
         return _rsp_from_dense(dense_np, ctx=arr._ctx)

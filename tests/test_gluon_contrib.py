@@ -1,0 +1,176 @@
+"""gluon.contrib (reference python/mxnet/gluon/contrib/): Concurrent
+containers, SparseEmbedding, SyncBatchNorm, variational dropout, LSTMP,
+and the conv recurrent cell family."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+
+cnn = gluon.contrib.nn
+crnn = gluon.contrib.rnn
+
+
+def test_concurrent_and_identity():
+    for cls, hybrid in [(cnn.Concurrent, False),
+                        (cnn.HybridConcurrent, True)]:
+        net = cls(axis=1)
+        net.add(gluon.nn.Dense(4), gluon.nn.Dense(3), cnn.Identity())
+        net.initialize(mx.initializer.Xavier())
+        if hybrid:
+            net.hybridize()
+        x = mx.nd.array(np.random.RandomState(0).rand(2, 5).astype("f4"))
+        out = net(x)
+        assert out.shape == (2, 12)
+        np.testing.assert_allclose(out.asnumpy()[:, 7:], x.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_sparse_embedding_trains_lazy_rows():
+    """The Trainer routes sparse_grad params through the optimizers'
+    LAZY row_sparse branch: with weight decay, untouched rows must NOT
+    decay (a dense update would shrink every row)."""
+    emb = cnn.SparseEmbedding(30, 6)
+    emb.initialize(mx.initializer.Normal(0.1))
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "wd": 0.1})
+    ids = mx.nd.array([1, 5, 5, 9])
+    w0 = emb.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (emb(ids) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    w1 = emb.weight.data().asnumpy()
+    touched = [1, 5, 9]
+    untouched = [i for i in range(30) if i not in touched]
+    assert not np.allclose(w1[touched], w0[touched])
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+
+
+def test_sync_batchnorm_matches_batchnorm():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 3, 5, 5).astype("f4"))
+    a = cnn.SyncBatchNorm(in_channels=3, num_devices=8)
+    b = gluon.nn.BatchNorm(axis=1, in_channels=3)
+    a.initialize()
+    b.initialize()
+    with autograd.record():
+        ya = a(x)
+    with autograd.record():
+        yb = b(x)
+    np.testing.assert_allclose(ya.asnumpy(), yb.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_variational_dropout_mask_constant_across_time():
+    vd = crnn.VariationalDropoutCell(gluon.rnn.RNNCell(8),
+                                     drop_inputs=0.4, drop_outputs=0.5)
+    vd.base_cell.initialize()
+    mx.random.seed(11)
+    outs, _ = vd.unroll(4, mx.nd.ones((2, 4, 8)), merge_outputs=False)
+    masks = [(o.asnumpy() == 0) for o in outs]
+    for m in masks[1:]:
+        np.testing.assert_array_equal(masks[0], m)
+    # reset() draws fresh masks
+    vd.reset()
+    outs2, _ = vd.unroll(4, mx.nd.ones((2, 4, 8)), merge_outputs=False)
+    assert not (outs2[0].asnumpy() == 0).all()
+
+
+def test_lstmp_cell_shapes_and_grads():
+    cell = crnn.LSTMPCell(16, projection_size=8)
+    cell.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(1).rand(2, 4, 5).astype("f4"))
+    with autograd.record():
+        out, states = cell.unroll(4, x, merge_outputs=True)
+        loss = (out ** 2).sum()
+    loss.backward()
+    assert out.shape == (2, 4, 8)          # projected size
+    assert states[0].shape == (2, 8) and states[1].shape == (2, 16)
+    g = cell.params.get("h2r_weight").grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_lstmp_reduces_to_manual_math():
+    """One step vs hand-computed LSTMP equations."""
+    cell = crnn.LSTMPCell(4, projection_size=3, input_size=2)
+    cell.initialize(mx.initializer.Uniform(0.5))
+    x = mx.nd.array(np.random.RandomState(2).rand(1, 2).astype("f4"))
+    states = cell.begin_state(1)
+    out, _ = cell(x, states)
+    names = {k.split("_", 1)[1]: v.data().asnumpy()
+             for k, v in cell.params._params.items()}
+    i2h = x.asnumpy() @ names["i2h_weight"].T + names["i2h_bias"]
+    h2h = np.zeros_like(i2h) + names["h2h_bias"]
+    gates = (i2h + h2h).reshape(4, 4)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    i, f, g, o = sig(gates[0]), sig(gates[1]), np.tanh(gates[2]), \
+        sig(gates[3])
+    c = f * 0 + i * g
+    h = o * np.tanh(c)
+    r = h @ names["h2r_weight"].T
+    np.testing.assert_allclose(out.asnumpy()[0], r, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("cls,ndim,n_states", [
+    (crnn.Conv1DRNNCell, 1, 1), (crnn.Conv2DRNNCell, 2, 1),
+    (crnn.Conv3DRNNCell, 3, 1), (crnn.Conv1DLSTMCell, 1, 2),
+    (crnn.Conv2DLSTMCell, 2, 2), (crnn.Conv3DLSTMCell, 3, 2),
+    (crnn.Conv1DGRUCell, 1, 1), (crnn.Conv2DGRUCell, 2, 1),
+    (crnn.Conv3DGRUCell, 3, 1),
+])
+def test_conv_cells_unroll_and_grads(cls, ndim, n_states):
+    spatial = (6,) * ndim
+    cell = cls(input_shape=(3,) + spatial, hidden_channels=4,
+               i2h_kernel=3, h2h_kernel=3)
+    cell.initialize(mx.initializer.Xavier())
+    rng = np.random.RandomState(0)
+    seq = mx.nd.array(rng.rand(2, 3, 3, *spatial).astype("f4"))
+    with autograd.record():
+        out, states = cell.unroll(3, seq, merge_outputs=True)
+        loss = (out ** 2).sum()
+    loss.backward()
+    assert out.shape == (2, 3, 4) + spatial
+    assert len(states) == n_states
+    for s in states:
+        assert s.shape == (2, 4) + spatial
+    g = cell.params.get("h2h_weight").grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_conv_cell_rejects_even_h2h_kernel():
+    with pytest.raises(ValueError):
+        crnn.Conv2DLSTMCell(input_shape=(3, 6, 6), hidden_channels=4,
+                            i2h_kernel=3, h2h_kernel=2)
+
+
+def test_interval_sampler():
+    assert list(gluon.contrib.data.IntervalSampler(10, 3)) == \
+        [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    s = gluon.contrib.data.IntervalSampler(10, 3, rollover=False)
+    assert list(s) == [0, 3, 6, 9] and len(s) == 4
+    with pytest.raises(ValueError):
+        gluon.contrib.data.IntervalSampler(3, 5)
+
+
+def test_sparse_embedding_lazy_rows_update_on_kvstore():
+    """Same lazy contract when the update runs ON the kvstore (the dist
+    path): the pushed gradient must be row_sparse so the store's updater
+    hits the lazy branch too."""
+    emb = cnn.SparseEmbedding(30, 6)
+    emb.initialize(mx.initializer.Normal(0.1))
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "wd": 0.1},
+                       kvstore="local", update_on_kvstore=True)
+    ids = mx.nd.array([2, 7])
+    w0 = emb.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (emb(ids) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+    w1 = emb.weight.data().asnumpy()
+    untouched = [i for i in range(30) if i not in (2, 7)]
+    assert not np.allclose(w1[[2, 7]], w0[[2, 7]])
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
