@@ -317,10 +317,13 @@ def main():
     p.add_argument("--batch-size", type=int, default=2)
     p.add_argument("--lr", type=float, default=0.02)
     p.add_argument("--kv-store", default="local")
+    p.add_argument("--seed", type=int, default=7)
     p.add_argument("--device", default=None)
     args = p.parse_args()
 
-    it = RCNNIter(batch_size=args.batch_size)
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
+    it = RCNNIter(batch_size=args.batch_size, seed=args.seed)
     sym = rcnn_symbol()
     mod = mx.mod.Module(sym,
                         data_names=("data", "im_info", "gt_boxes"),

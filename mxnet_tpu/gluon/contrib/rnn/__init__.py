@@ -31,38 +31,42 @@ class VariationalDropoutCell(ModifierCell):
         self.drop_inputs = drop_inputs
         self.drop_states = drop_states
         self.drop_outputs = drop_outputs
-        self._input_mask = None
-        self._state_mask = None
-        self._output_mask = None
+        self._masks = {}
+        self._mask_trace = None
 
     def _alias(self):
         return "vardrop"
 
     def reset(self):
         super().reset()
-        self._input_mask = None
-        self._state_mask = None
-        self._output_mask = None
+        self._masks = {}
+        self._mask_trace = None
 
-    @staticmethod
-    def _mask(F, p, like):
-        return F.Dropout(F.ones_like(like), p=p)
+    def _get_mask(self, F, name, p, like):
+        """Per-sequence mask cache, valid only within one trace (or in
+        eager mode) — the ZoneoutCell trace-id guard: a tracer cached
+        from a finished jit trace must never leak into the next one."""
+        from ...block import _current_trace
+        tctx = _current_trace()
+        trace_id = tctx.seq if tctx is not None else None
+        if self._mask_trace != trace_id:
+            self._masks = {}
+            self._mask_trace = trace_id
+        if name not in self._masks:
+            self._masks[name] = F.Dropout(F.ones_like(like), p=p)
+        return self._masks[name]
 
     def hybrid_forward(self, F, inputs, states):
         if self.drop_inputs:
-            if self._input_mask is None:
-                self._input_mask = self._mask(F, self.drop_inputs, inputs)
-            inputs = inputs * self._input_mask
+            inputs = inputs * self._get_mask(F, "i", self.drop_inputs,
+                                             inputs)
         if self.drop_states:
-            if self._state_mask is None:
-                self._state_mask = self._mask(F, self.drop_states,
-                                              states[0])
-            states = [states[0] * self._state_mask] + list(states[1:])
+            states = [states[0] * self._get_mask(F, "s", self.drop_states,
+                                                 states[0])] \
+                + list(states[1:])
         out, states = self.base_cell(inputs, states)
         if self.drop_outputs:
-            if self._output_mask is None:
-                self._output_mask = self._mask(F, self.drop_outputs, out)
-            out = out * self._output_mask
+            out = out * self._get_mask(F, "o", self.drop_outputs, out)
         return out, states
 
     def __repr__(self):

@@ -408,18 +408,11 @@ def getnnz(data, axis=None):
     return _nd.invoke("_contrib_getnnz", [data], {"axis": axis})
 
 
-def __getattr__(name):
-    """Resolve ``mx.nd.contrib.<name>`` to the registered
-    ``_contrib_<name>`` operator (reference python surface:
-    python/mxnet/ndarray/contrib.py is code-generated the same way) —
-    hand-written helpers above take precedence."""
-    from ..ops import registry as _registry
+def _make_contrib_fn(op):
     from . import register as _register
-    op = _registry.get_or_none("_contrib_" + name)
-    if op is None:
-        raise AttributeError(
-            "mxnet_tpu.ndarray.contrib has no attribute %r" % name)
-    fn = _register._make_op_func(op)
-    fn.__name__ = name
-    globals()[name] = fn   # cache for next lookup
-    return fn
+    return _register._make_op_func(op)
+
+
+from ..ops.registry import contrib_surface as _contrib_surface  # noqa: E402
+__getattr__, __dir__ = _contrib_surface(globals(), _make_contrib_fn)
+

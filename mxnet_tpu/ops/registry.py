@@ -133,3 +133,27 @@ def get_or_none(name):
 
 def list_ops():
     return sorted(_REGISTRY.keys())
+
+
+def contrib_surface(module_globals, make_fn):
+    """Shared machinery for the generated mx.nd.contrib / mx.sym.contrib
+    namespaces (reference: code-generated contrib modules): returns
+    (__getattr__, __dir__) resolving ``name`` -> the registered
+    ``_contrib_<name>`` operator through ``make_fn(op)``."""
+    def __getattr__(name):
+        op = get_or_none("_contrib_" + name)
+        if op is None:
+            raise AttributeError(
+                "%s has no attribute %r" % (module_globals.get(
+                    "__name__", "contrib"), name))
+        fn = make_fn(op)
+        fn.__name__ = name
+        module_globals[name] = fn   # cache for the next lookup
+        return fn
+
+    def __dir__():
+        return sorted(set(list(module_globals) + [
+            n[len("_contrib_"):] for n in list_ops()
+            if n.startswith("_contrib_")]))
+
+    return __getattr__, __dir__

@@ -4,15 +4,12 @@ python/mxnet/symbol/contrib.py code-generation), resolved lazily from the
 operator registry."""
 from __future__ import annotations
 
+from ..ops.registry import contrib_surface as _contrib_surface
 
-def __getattr__(name):
-    from ..ops import registry as _registry
+
+def _make_contrib_fn(op):
     from . import register as _register
-    op = _registry.get_or_none("_contrib_" + name)
-    if op is None:
-        raise AttributeError(
-            "mxnet_tpu.symbol.contrib has no attribute %r" % name)
-    fn = _register._make_op_func(op)
-    fn.__name__ = name
-    globals()[name] = fn
-    return fn
+    return _register._make_op_func(op)
+
+
+__getattr__, __dir__ = _contrib_surface(globals(), _make_contrib_fn)

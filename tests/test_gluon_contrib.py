@@ -64,17 +64,38 @@ def test_sync_batchnorm_matches_batchnorm():
 
 def test_variational_dropout_mask_constant_across_time():
     vd = crnn.VariationalDropoutCell(gluon.rnn.RNNCell(8),
-                                     drop_inputs=0.4, drop_outputs=0.5)
-    vd.base_cell.initialize()
+                                     drop_outputs=0.5)
+    vd.base_cell.initialize(mx.initializer.Uniform(1.0))
     mx.random.seed(11)
-    outs, _ = vd.unroll(4, mx.nd.ones((2, 4, 8)), merge_outputs=False)
+    # dropout only fires in training mode: record() like a real step
+    with autograd.record():
+        outs, _ = vd.unroll(4, mx.nd.ones((2, 4, 8)),
+                            merge_outputs=False)
     masks = [(o.asnumpy() == 0) for o in outs]
+    assert masks[0].any(), "no dropout applied - test would be vacuous"
     for m in masks[1:]:
         np.testing.assert_array_equal(masks[0], m)
-    # reset() draws fresh masks
+    # reset() draws fresh masks (statistically certain to differ)
     vd.reset()
-    outs2, _ = vd.unroll(4, mx.nd.ones((2, 4, 8)), merge_outputs=False)
-    assert not (outs2[0].asnumpy() == 0).all()
+    with autograd.record():
+        outs2, _ = vd.unroll(4, mx.nd.ones((2, 4, 8)),
+                             merge_outputs=False)
+    assert ((outs2[0].asnumpy() == 0) != masks[0]).any()
+
+
+def test_variational_dropout_hybridized():
+    """Masks cached across steps must not leak tracers across jit
+    traces (the ZoneoutCell trace-id guard)."""
+    vd = crnn.VariationalDropoutCell(gluon.rnn.RNNCell(8),
+                                     drop_inputs=0.4)
+    vd.base_cell.initialize()
+    vd.hybridize()
+    for _ in range(2):   # two separate traces
+        with autograd.record():
+            outs, _ = vd.unroll(3, mx.nd.ones((2, 3, 8)),
+                                merge_outputs=True)
+        outs.backward()
+        vd.reset()
 
 
 def test_lstmp_cell_shapes_and_grads():
