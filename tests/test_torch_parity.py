@@ -344,3 +344,38 @@ def test_deconv_zero_target_shape_means_unset():
     b = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
                             num_filter=2, stride=(2, 2), no_bias=True)
     np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+
+
+def test_lstmp_cell_vs_torch_proj_lstm():
+    """gluon.contrib LSTMPCell vs torch.nn.LSTM(proj_size=): identical
+    weights -> identical per-step outputs and states."""
+    from mxnet_tpu import gluon
+    rng = np.random.RandomState(9)
+    T, N, I, H, R = 4, 2, 5, 6, 3
+    x = rng.randn(N, T, I).astype(np.float32)
+
+    tl = torch.nn.LSTM(I, H, num_layers=1, proj_size=R, batch_first=True)
+    with torch.no_grad():
+        ref_out, (ref_h, ref_c) = tl(_t(x))
+
+    cell = gluon.contrib.rnn.LSTMPCell(H, projection_size=R, input_size=I)
+    cell.initialize()
+    p = {k.split("_", 1)[1]: v for k, v in cell.params._params.items()}
+    p["i2h_weight"].set_data(mx.nd.array(
+        tl.weight_ih_l0.detach().numpy()))
+    p["h2h_weight"].set_data(mx.nd.array(
+        tl.weight_hh_l0.detach().numpy()))
+    p["h2r_weight"].set_data(mx.nd.array(
+        tl.weight_hr_l0.detach().numpy()))
+    p["i2h_bias"].set_data(mx.nd.array(tl.bias_ih_l0.detach().numpy()))
+    p["h2h_bias"].set_data(mx.nd.array(tl.bias_hh_l0.detach().numpy()))
+
+    out, states = cell.unroll(T, mx.nd.array(x), merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), ref_out.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(states[0].asnumpy(),
+                               ref_h.detach().numpy()[0], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(states[1].asnumpy(),
+                               ref_c.detach().numpy()[0], rtol=1e-5,
+                               atol=1e-5)
