@@ -1,0 +1,84 @@
+"""bench.py's probe/stale machinery (VERDICT r3 weak #1): a TPU-less
+round must re-emit the last real-chip result flagged stale — never
+headline a CPU number when a TPU measurement exists — and a
+deterministic no-TPU host must fail fast instead of burning the
+deadline."""
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "_LAST_TPU_PATH",
+                        str(tmp_path / "BENCH_LAST_TPU.json"))
+    return mod
+
+
+def test_stale_reemit_when_last_tpu_exists(tmp_path, monkeypatch, capsys):
+    bench = _load_bench(tmp_path, monkeypatch)
+    last = {"metric": "resnet50_module_fit_img_per_sec_b128_bf16",
+            "value": 7000.0, "mfu": 0.72, "device": "TPU v5 lite"}
+    with open(bench._LAST_TPU_PATH, "w") as f:
+        json.dump(last, f)
+    assert bench._emit_stale_or_smoke() is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 7000.0
+    assert out["stale"] is True and "stale_reason" in out
+    assert out["device"] == "TPU v5 lite"   # NOT a CPU line
+
+
+def test_no_stale_without_history(tmp_path, monkeypatch):
+    bench = _load_bench(tmp_path, monkeypatch)
+    assert bench._emit_stale_or_smoke() is False
+
+
+def test_probe_fails_fast_on_deterministic_cpu(tmp_path, monkeypatch):
+    """A host where jax resolves straight to CPU (AssertionError, not a
+    tunnel timeout) must return after ONE attempt, not retry for the
+    whole deadline."""
+    import subprocess
+    import time as _time
+    bench = _load_bench(tmp_path, monkeypatch)
+    calls = []
+
+    class R:
+        returncode = 1
+        stderr = "AssertionError\n"
+
+    def fake_run(*a, **k):
+        calls.append(_time.monotonic())
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    t0 = _time.monotonic()
+    assert bench.probe_tpu(deadline_s=300, attempt_timeout=60) is False
+    assert len(calls) == 1
+    assert _time.monotonic() - t0 < 5
+
+
+def test_probe_retries_on_timeout(tmp_path, monkeypatch):
+    import subprocess
+    bench = _load_bench(tmp_path, monkeypatch)
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(1)
+        if len(calls) < 3:
+            raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+        class R:
+            returncode = 0
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.probe_tpu(deadline_s=600, attempt_timeout=60) is True
+    assert len(calls) == 3
