@@ -325,29 +325,38 @@ class Module(BaseModule):
         results commit immediately. Falls back to the eager pair when the
         fused step is not engaged."""
         if self._fused is not None and self.optimizer_initialized:
-            from .. import random as _random
-            from ..ndarray.ndarray import NDArray
-            ex = self._exec
-            ex.set_inputs(**self._feed(data_batch))
-            key = _random.next_key()
-            outs, new_args, new_aux, new_opt = self._fused.run(
-                ex._arg_vals(), ex._aux_vals(), self._fused_opt_state, key,
-                donate=True)
-            # inputs are dead after donation: commit everything now
-            for k, v in new_aux.items():
-                ex.aux_dict[k]._rebind(v)
-            for k in self._fused.param_names:
-                ex.arg_dict[k]._rebind(new_args[k])
-            ex.outputs = [NDArray(o, ctx=ex._ctx) for o in outs]
-            ex._pending = None
-            self._fused_opt_state = new_opt
-            self._fused.commit_counts()
-            self._params_dirty = True
-            self._fused_pending = None
-            self._fused_ran = False
+            from .. import profiler as _profiler
+            if _profiler.is_active("symbolic"):
+                with _profiler.op_timer(
+                        "Module::fused_fit_step", "symbolic",
+                        lambda: [o._data for o in self._exec.outputs]):
+                    return self._fit_step_fused_impl(data_batch)
+            return self._fit_step_fused_impl(data_batch)
         else:
             self.forward_backward(data_batch)
             self.update()
+
+    def _fit_step_fused_impl(self, data_batch):
+        from .. import random as _random
+        from ..ndarray.ndarray import NDArray
+        ex = self._exec
+        ex.set_inputs(**self._feed(data_batch))
+        key = _random.next_key()
+        outs, new_args, new_aux, new_opt = self._fused.run(
+            ex._arg_vals(), ex._aux_vals(), self._fused_opt_state, key,
+            donate=True)
+        # inputs are dead after donation: commit everything now
+        for k, v in new_aux.items():
+            ex.aux_dict[k]._rebind(v)
+        for k in self._fused.param_names:
+            ex.arg_dict[k]._rebind(new_args[k])
+        ex.outputs = [NDArray(o, ctx=ex._ctx) for o in outs]
+        ex._pending = None
+        self._fused_opt_state = new_opt
+        self._fused.commit_counts()
+        self._params_dirty = True
+        self._fused_pending = None
+        self._fused_ran = False
 
     def _forward_fused(self, feed):
         from .. import random as _random

@@ -162,3 +162,26 @@ def test_group2ctx_raises_loudly():
                         group2ctx={"dev1": mx.cpu(1)})
     with _pytest.raises(mx.MXNetError):
         mx.mod.Module(net, group2ctxs={"dev1": mx.cpu(1)})
+
+
+def test_fused_fit_step_is_profiled():
+    """The atomic donating fit step must appear in the profile like the
+    eager Executor::forward does (observability parity for the path the
+    bench measures)."""
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4),
+        name="softmax")
+    X = np.random.rand(32, 8).astype("f4")
+    it = mx.io.NDArrayIter(X, np.zeros(32, "f4"), batch_size=16,
+                           label_name="softmax_label")
+    profiler.set_config(profile_all=True, aggregate_stats=True)
+    profiler.set_state("run")
+    try:
+        mod = mx.mod.Module(sym)
+        mod.fit(it, num_epoch=1, kvstore="tpu_sync",
+                initializer=mx.initializer.Xavier())
+        assert mod._fused is not None
+    finally:
+        profiler.set_state("stop")
+    d = profiler.dumps(reset=True)
+    assert "Module::fused_fit_step" in d
