@@ -283,19 +283,23 @@ class Executor:
         return {k: self._placed(v, self._rep_sharding)
                 for k, v in self.aux_dict.items()}
 
+    def prepare_input(self, name, v, place=True):
+        """Feed value (NDArray / numpy / nested list) cast to the bound
+        arg's dtype; with ``place`` (default), also committed where the
+        executor computes — feeds may come from a host iterator
+        (NDArrayIter on cpu()) and jit must not see mixed platforms."""
+        if isinstance(v, NDArray):
+            val = v._data.astype(self.arg_dict[name].dtype)
+        else:
+            val = jnp.asarray(_np.asarray(v), self.arg_dict[name].dtype)
+        return self._place_input(val, name) if place else val
+
     def set_inputs(self, **kwargs):
         """Feed input arrays (by arg name) into the bound buffers, placing
         them where the executor computes."""
         for k, v in kwargs.items():
             if k in self.arg_dict:
-                if isinstance(v, NDArray):
-                    val = v._data.astype(self.arg_dict[k].dtype)
-                else:
-                    val = jnp.asarray(_np.asarray(v), self.arg_dict[k].dtype)
-                # feed may come from a host iterator (NDArrayIter on cpu()):
-                # place it where the executor computes or jit sees mixed
-                # platforms
-                self.arg_dict[k]._rebind(self._place_input(val, k))
+                self.arg_dict[k]._rebind(self.prepare_input(k, v))
 
     def forward(self, is_train=False, **kwargs):
         from . import profiler as _profiler

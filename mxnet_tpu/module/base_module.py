@@ -120,12 +120,36 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """Epoch loop (reference base_module.py:410-560)."""
+            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=1):
+        """Epoch loop (reference base_module.py:410-560).
+
+        ``steps_per_dispatch=K > 1`` groups K batches into ONE compiled
+        XLA dispatch (`lax.scan` over the stacked feeds — see
+        ``FusedStep.run_k``), amortising per-step host/PJRT latency.
+        Metric updates stay per-batch; ``batch_end_callback`` fires per
+        batch but only after its group completes; lr/wd schedules advance
+        in steps of K. Requires a module with a fused grouped step
+        (plain :class:`Module`) and no monitor."""
         from .. import initializer as _init
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             initializer = _init.Uniform(0.01)
+
+        # validate steps_per_dispatch BEFORE any side effect (bind/
+        # install_monitor/init_optimizer are not undone by the raise)
+        if steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1, got %r"
+                             % (steps_per_dispatch,))
+        grouped = steps_per_dispatch > 1
+        if grouped:
+            if not hasattr(self, "_fit_group"):
+                raise ValueError(
+                    "steps_per_dispatch > 1 needs a module with a grouped "
+                    "fused step (plain Module); %s has none"
+                    % type(self).__name__)
+            if monitor is not None or sparse_row_id_fn is not None:
+                raise ValueError("steps_per_dispatch > 1 is incompatible "
+                                 "with monitor / sparse_row_id_fn")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -157,32 +181,63 @@ class BaseModule:
                 eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self._fit_step(data_batch)
-                # metric BEFORE prefetch/prepare (reference base_module.py
-                # :528-545): prepare() may switch the bucketing module to
-                # the NEXT batch's bucket, whose executor has no outputs yet
-                if eval_metric is not None:
-                    self.update_metric(eval_metric, data_batch.label)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    for cb in _as_list(batch_end_callback):
-                        cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                         eval_metric=eval_metric,
-                                         locals=locals()))
-                nbatch += 1
+            if grouped:
+                # one dispatch per K batches; callbacks fire per batch
+                # (from THIS frame, so BatchEndParam.locals matches the
+                # per-step path) but only after the group's dispatch
+                group, end_of_batch = [], False
+                while not end_of_batch:
+                    try:
+                        group.append(next(data_iter))
+                    except StopIteration:
+                        end_of_batch = True
+                    if len(group) == steps_per_dispatch or \
+                            (end_of_batch and group):
+                        if len(group) == steps_per_dispatch:
+                            self._fit_group(group, eval_metric)
+                        else:
+                            # tail: per-step path — reuses/compiles the
+                            # single-step program instead of tracing a
+                            # second scan variant for the odd group size
+                            for b in group:
+                                self._fit_group([b], eval_metric)
+                        for data_batch in group:
+                            if batch_end_callback is not None:
+                                for cb in _as_list(batch_end_callback):
+                                    cb(BatchEndParam(
+                                        epoch=epoch, nbatch=nbatch,
+                                        eval_metric=eval_metric,
+                                        locals=locals()))
+                            nbatch += 1
+                        group = []
+            else:
+                end_of_batch = False
+                next_data_batch = next(data_iter)
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    self._fit_step(data_batch)
+                    # metric BEFORE prefetch/prepare (reference
+                    # base_module.py:528-545): prepare() may switch the
+                    # bucketing module to the NEXT batch's bucket, whose
+                    # executor has no outputs yet
+                    if eval_metric is not None:
+                        self.update_metric(eval_metric, data_batch.label)
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    except StopIteration:
+                        end_of_batch = True
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        for cb in _as_list(batch_end_callback):
+                            cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                             eval_metric=eval_metric,
+                                             locals=locals()))
+                    nbatch += 1
             for name, val in (eval_metric.get_name_value()
                               if eval_metric is not None else []):
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

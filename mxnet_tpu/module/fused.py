@@ -132,6 +132,30 @@ class FusedStep:
         self._jitted = jax.jit(step)
         self._jitted_donate = jax.jit(step, donate_argnums=(0, 2, 3))
 
+        # K steps per dispatch: the classic TPU train-loop-under-scan.
+        # One host->device dispatch executes K full steps over K stacked
+        # batches, amortising the per-dispatch host/PJRT latency (dominant
+        # behind a remote/tunneled chip, still measurable on a local one).
+        # lr/wd enter once per dispatch; the update count t advances in the
+        # scan carry so t-dependent optimizers (adam bias correction,
+        # schedules consumed via t) stay exact. Retraces automatically when
+        # K (the stacked leading dim) changes.
+        def k_step(params, static_rest, aux_vals, opt_state, feeds,
+                   lr_vec, wd_vec, rescale, t0, keys):
+            def body(carry, xs):
+                p, a, o, t = carry
+                feed, key = xs
+                outs, p2, a2, o2 = step(p, {**static_rest, **feed}, a, o,
+                                        lr_vec, wd_vec, rescale, t, key)
+                return (p2, a2, o2, t + jnp.int32(1)), outs
+
+            (p, a, o, _), outs = jax.lax.scan(
+                body, (params, aux_vals, opt_state, jnp.int32(t0)),
+                (feeds, keys))
+            return outs, p, a, o
+
+        self._jitted_k = jax.jit(k_step, donate_argnums=(0, 2, 3))
+
     # ------------------------------------------------------------------- state
     def init_state(self):
         """Fused optimizer state from the executor's current params, placed
@@ -219,6 +243,46 @@ class FusedStep:
         new_args = dict(rest)
         new_args.update(new_params)
         return outs, new_args, new_aux, new_opt
+
+    def run_k(self, arg_vals, aux_vals, opt_state, feeds, keys):
+        """K fused steps in ONE XLA program (`lax.scan` over stacked
+        batches) — see ``k_step`` in :meth:`_build`.
+
+        ``feeds`` is a list of K ``{input_name: jax value}`` dicts (the
+        per-step data/label feeds); ``keys`` a list of K PRNG keys. The
+        param/aux/opt-state buffers are DONATED; the caller must commit the
+        returned values immediately. Returns ``(outs, new_params, new_aux,
+        new_opt)`` where each element of ``outs`` is stacked ``(K, ...)``
+        so callers can still update metrics per sub-batch.
+
+        lr/wd are evaluated once per dispatch (a schedule moves in steps of
+        K); the optimizer update count still advances per inner step.
+        """
+        lr_vec, wd_vec, rescale, t = self.hyper_peek()
+        params, rest = self.split_args(arg_vals)
+        feed_names = frozenset(feeds[0])
+        static_rest = {k: v for k, v in rest.items() if k not in feed_names}
+        ex = self._exec
+        cdt = self._compute_dtype
+        stacked = {}
+        for name in feeds[0]:
+            vals = [f[name] for f in feeds]
+            if cdt is not None and name in self._data_names \
+                    and vals[0].dtype == jnp.float32:
+                # the step would cast each slice anyway; casting before the
+                # stack halves the stacked buffer
+                vals = [v.astype(cdt) for v in vals]
+            arr = jnp.stack(vals)
+            if ex._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                spec = P(None, "dp") if name in ex._batch_args else P()
+                arr = jax.device_put(arr, NamedSharding(ex._mesh, spec))
+            stacked[name] = arr
+        outs, new_params, new_aux, new_opt = self._jitted_k(
+            params, static_rest, aux_vals, opt_state, stacked,
+            jnp.asarray(lr_vec), jnp.asarray(wd_vec), rescale, t,
+            jnp.stack(list(keys)))
+        return outs, new_params, new_aux, new_opt
 
     def cost_analysis(self, arg_vals, aux_vals, opt_state):
         """XLA cost analysis of the compiled fused step (flops etc.), via

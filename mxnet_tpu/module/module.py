@@ -336,27 +336,104 @@ class Module(BaseModule):
             self.forward_backward(data_batch)
             self.update()
 
+    def _commit_fused(self, last_outs, new_params, new_aux, new_opt,
+                      n_steps=1):
+        """Commit a donating fused dispatch: the input buffers are dead, so
+        params/aux/opt-state/outputs must all be adopted now. Shared by the
+        per-step and grouped (run_k) paths — the commit protocol must stay
+        identical."""
+        from ..ndarray.ndarray import NDArray
+        ex = self._exec
+        for k, v in new_aux.items():
+            ex.aux_dict[k]._rebind(v)
+        for k in self._fused.param_names:
+            ex.arg_dict[k]._rebind(new_params[k])
+        ex.outputs = [NDArray(o, ctx=ex._ctx) for o in last_outs]
+        ex._pending = None
+        self._fused_opt_state = new_opt
+        for _ in range(n_steps):
+            self._fused.commit_counts()
+        self._params_dirty = True
+        self._fused_pending = None
+        self._fused_ran = False
+
     def _fit_step_fused_impl(self, data_batch):
         from .. import random as _random
-        from ..ndarray.ndarray import NDArray
         ex = self._exec
         ex.set_inputs(**self._feed(data_batch))
         key = _random.next_key()
         outs, new_args, new_aux, new_opt = self._fused.run(
             ex._arg_vals(), ex._aux_vals(), self._fused_opt_state, key,
             donate=True)
-        # inputs are dead after donation: commit everything now
-        for k, v in new_aux.items():
-            ex.aux_dict[k]._rebind(v)
-        for k in self._fused.param_names:
-            ex.arg_dict[k]._rebind(new_args[k])
-        ex.outputs = [NDArray(o, ctx=ex._ctx) for o in outs]
-        ex._pending = None
-        self._fused_opt_state = new_opt
-        self._fused.commit_counts()
-        self._params_dirty = True
-        self._fused_pending = None
-        self._fused_ran = False
+        self._commit_fused(outs, new_args, new_aux, new_opt)
+
+    def _fit_group(self, data_batches, eval_metric=None):
+        """fit's grouped entry (``steps_per_dispatch``): run the batches
+        through :meth:`_fit_step_k`, then update ``eval_metric`` once per
+        sub-batch from the stacked per-step outputs — metric semantics
+        identical to the per-step loop."""
+        if self._fused is None or not self.optimizer_initialized \
+                or len(data_batches) == 1:
+            if len(data_batches) > 1 and \
+                    not getattr(self, "_warned_group_fallback", False):
+                self._warned_group_fallback = True
+                self.logger.warning(
+                    "steps_per_dispatch: fused step not engaged "
+                    "(optimizer/kvstore/grad_req unfusable?) — falling "
+                    "back to one dispatch per batch")
+            for b in data_batches:
+                self._fit_step(b)
+                if eval_metric is not None:
+                    self.update_metric(eval_metric, b.label)
+            return
+        from ..ndarray.ndarray import NDArray
+        outs = self._fit_step_k(data_batches)
+        if eval_metric is not None:
+            ex = self._exec
+            last = ex.outputs
+            for i, b in enumerate(data_batches):
+                ex.outputs = [NDArray(o[i], ctx=ex._ctx) for o in outs]
+                self.update_metric(eval_metric, b.label)
+            ex.outputs = last
+
+    def _fit_step_k(self, data_batches):
+        """K fit steps in ONE donating XLA dispatch (`FusedStep.run_k` —
+        the train-loop-under-scan TPU idiom). Caller (:meth:`_fit_group`)
+        guarantees the fused step is engaged and K > 1. Returns the
+        stacked per-step output values (list of ``(K, ...)`` jax arrays)
+        so the fit loop can update metrics per sub-batch."""
+        assert self._fused is not None and self.optimizer_initialized \
+            and len(data_batches) > 1
+        from .. import profiler as _profiler
+        if _profiler.is_active("symbolic"):
+            with _profiler.op_timer(
+                    "Module::fused_fit_step_k", "symbolic",
+                    lambda: [o._data for o in self._exec.outputs]):
+                return self._fit_step_k_impl(data_batches)
+        return self._fit_step_k_impl(data_batches)
+
+    def _fit_step_k_impl(self, data_batches):
+        from .. import random as _random
+        ex = self._exec
+        # keep the executor's input bindings current (shape checks, later
+        # forward() calls); run_k reads the per-step values from `feeds`
+        ex.set_inputs(**self._feed(data_batches[-1]))
+        # each feed value gets the SAME cast (+ placement) set_inputs
+        # applies (host iterator batches are cpu-committed; stacking them
+        # raw would hand the donating jit cpu feeds next to device params).
+        # Under a mesh, run_k re-commits the STACKED array to P(None, 'dp')
+        # anyway, so per-slice placement would be paid twice — skip it.
+        place_each = ex._mesh is None
+        feeds = [{name: ex.prepare_input(name, arr, place=place_each)
+                  for name, arr in self._feed(b).items()}
+                 for b in data_batches]
+        keys = [_random.next_key() for _ in data_batches]
+        outs, new_params, new_aux, new_opt = self._fused.run_k(
+            ex._arg_vals(), ex._aux_vals(), self._fused_opt_state,
+            feeds, keys)
+        self._commit_fused([o[-1] for o in outs], new_params, new_aux,
+                           new_opt, n_steps=len(data_batches))
+        return outs
 
     def _forward_fused(self, feed):
         from .. import random as _random

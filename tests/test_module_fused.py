@@ -218,6 +218,134 @@ def test_unfusable_optimizer_falls_back():
     assert mod._fused is None  # Nadam updates via NDArray math on host
 
 
+# ------------------------------------------- steps_per_dispatch (run_k/scan)
+def _fit_grouped(k, opt="sgd", opt_params=None, n=64, num_epoch=3,
+                 eval_metric="acc", record_cb=False):
+    sym = _make_net()
+    X, Y = _data(n)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(sym)
+    calls = []
+    cb = (lambda p: calls.append(p.nbatch)) if record_cb else None
+    mod.fit(it, num_epoch=num_epoch, kvstore="tpu_sync", optimizer=opt,
+            optimizer_params=opt_params or {"learning_rate": 0.1,
+                                            "momentum": 0.9},
+            arg_params={k_: v.copy() for k_, v in _fixed_params(sym).items()},
+            initializer=None, eval_metric=eval_metric,
+            steps_per_dispatch=k, batch_end_callback=cb)
+    return mod, calls
+
+
+def test_grouped_dispatch_matches_per_step():
+    """K=4 divides the 4 batches/epoch exactly: the whole epoch is one
+    scan dispatch. Params+aux (BN stats ride the scan carry) must match
+    the per-step fused path."""
+    per = _fit("tpu_sync", "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    grp, _ = _fit_grouped(4)
+    assert grp._fused is not None
+    _assert_params_close(per, grp)
+
+
+def test_grouped_dispatch_tail_metric_callbacks():
+    """n=80 -> 5 batches/epoch, K=2 -> two groups + a 1-batch tail (which
+    takes the per-step program rather than tracing a second scan variant
+    for the odd size). Callbacks fire once per batch; the metric
+    accumulates per sub-batch, equal to per-step."""
+    m_grp = mx.metric.create("acc")
+    grp, calls = _fit_grouped(2, n=80, num_epoch=2, eval_metric=m_grp,
+                              record_cb=True)
+    assert calls == list(range(5)) * 2
+    m_per = mx.metric.create("acc")
+    sym = _make_net()
+    X, Y = _data(80)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    per = mx.mod.Module(sym)
+    per.fit(it, num_epoch=2, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            arg_params={k: v.copy() for k, v in _fixed_params(sym).items()},
+            initializer=None, eval_metric=m_per)
+    _assert_params_close(per, grp)
+    np.testing.assert_allclose(m_per.get()[1], m_grp.get()[1], atol=1e-6)
+
+
+def test_grouped_adam_update_count_advances_in_scan():
+    """Adam's bias correction depends on t: if the in-scan update count
+    failed to advance, step 2..K would reuse t=1 and diverge fast."""
+    per = _fit("tpu_sync", "adam", {"learning_rate": 0.01}, num_epoch=1)
+    grp, _ = _fit_grouped(4, opt="adam",
+                          opt_params={"learning_rate": 0.01}, num_epoch=1)
+    _assert_params_close(per, grp, rtol=2e-4, atol=2e-6)
+
+
+def test_grouped_dispatch_spmd_matches_single_device():
+    """run_k's mesh branch: stacked feeds re-committed to P(None, 'dp'),
+    params/opt replicated — numerics equal to the single-device run."""
+    sym = _make_net()
+    X, Y = _data(64)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=[mx.Context("cpu", i) for i in range(4)])
+    mod.fit(it, num_epoch=3, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            arg_params={k: v.copy() for k, v in _fixed_params(sym).items()},
+            initializer=None, steps_per_dispatch=4)
+    assert mod._fused is not None
+    single = _fit("tpu_sync", "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    _assert_params_close(single, mod)
+
+
+def test_grouped_accepts_numpy_feeds():
+    """set_inputs accepts raw numpy feeds; the grouped path must too
+    (it routes every value through Executor.prepare_input)."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+    sym = _make_net()
+    X, Y = _data(64)
+    batches = [DataBatch(data=[X[i * 16:(i + 1) * 16]],
+                         label=[Y[i * 16:(i + 1) * 16]]) for i in range(4)]
+
+    class It:
+        provide_data = [DataDesc("data", (16, 8))]
+        provide_label = [DataDesc("softmax_label", (16,))]
+        batch_size = 16
+
+        def __iter__(self):
+            return iter(batches)
+
+        def reset(self):
+            pass
+
+    mod = mx.mod.Module(sym)
+    mod.fit(It(), num_epoch=1, eval_metric=None, kvstore="tpu_sync",
+            optimizer="sgd", arg_params=_fixed_params(sym),
+            initializer=None, steps_per_dispatch=2)
+    assert mod._fused is not None
+
+
+def test_grouped_rejects_bad_k():
+    sym = _make_net()
+    X, Y = _data(16)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(sym)
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        mod.fit(it, num_epoch=1, steps_per_dispatch=0)
+
+
+def test_grouped_rejects_monitor():
+    sym = _make_net()
+    X, Y = _data(16)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(sym)
+    mon = mx.monitor.Monitor(1)
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        mod.fit(it, num_epoch=1, kvstore="tpu_sync",
+                steps_per_dispatch=2, monitor=mon)
+    # the raise fired before bind/install_monitor/init_optimizer: a retry
+    # without the monitor must still engage the fused path
+    it.reset()
+    mod.fit(it, num_epoch=1, kvstore="tpu_sync", steps_per_dispatch=2,
+            arg_params=_fixed_params(_make_net()), initializer=None)
+    assert mod._fused is not None
+
+
 # --------------------------------------------------------------- gluon side
 def _gluon_train(fused, opt="sgd", opt_params=None, steps=6):
     from mxnet_tpu import gluon, autograd, config
