@@ -192,11 +192,16 @@ def main():
         arr = mod._exec.arg_dict[mod._param_names[0]]._data
         return float(np.asarray(jax.device_get(arr)).ravel()[0])
 
-    times = []
+    def timing_cb(lst):
+        # epoch-end probe shared by every measured fit(): force a host
+        # fetch (the only reliable sync on proxy backends), then stamp
+        def cb(epoch, symbol, arg_p, aux_p):
+            force()
+            lst.append(time.perf_counter())
+        return cb
 
-    def epoch_cb(epoch, symbol, arg_p, aux_p):
-        force()
-        times.append(time.perf_counter())
+    times = []
+    epoch_cb = timing_cb(times)
 
     # epoch 0 = warmup/compile; epochs 1..2 timed (through Module.fit)
     mod.fit(it, num_epoch=3, eval_metric=None, kvstore="tpu_sync",
@@ -226,6 +231,30 @@ def main():
         mod._fit_step(batch_obj)
         force()
     sync_step_ms = (time.perf_counter() - t1) / n_sync * 1e3
+
+    # grouped dispatch (fit(steps_per_dispatch=K)): K fused steps ride ONE
+    # XLA program (lax.scan over stacked batches), amortising per-dispatch
+    # host/PJRT latency — which behind this environment's tunneled chip is
+    # a large, hardware-irrelevant cost. Reported as extra fields; the
+    # headline stays the per-step-dispatch fit, matching the reference's
+    # --benchmark 1 semantics.
+    k_disp = int(os.environ.get("BENCH_K", "10" if on_tpu else "0"))
+    grouped_img_s = grouped_step_ms = grouped_mfu = None
+    if k_disp > 1:
+        t_k = []
+        it.reset()
+        # continues on the already-initialized module; epoch 0 compiles
+        # the scan program, epochs 1..2 are timed
+        mod.fit(it, num_epoch=3, eval_metric=None, kvstore="tpu_sync",
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                                  "multi_precision": True},
+                steps_per_dispatch=k_disp,
+                epoch_end_callback=timing_cb(t_k))
+        dt_k = t_k[-1] - t_k[0]
+        n_timed_k = steps * (len(t_k) - 1)
+        grouped_img_s = batch * n_timed_k / dt_k
+        grouped_step_ms = dt_k / n_timed_k * 1e3
 
     # FLOPs/step from XLA cost analysis of the compiled fused program
     flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
@@ -268,16 +297,11 @@ def main():
         rit.reset()
         # overlapped: same module, fused step, real batches
         t_rec = []
-
-        def rec_cb(epoch, symbol, arg_p, aux_p):
-            force()
-            t_rec.append(time.perf_counter())
-
         mod.fit(rit, num_epoch=3, eval_metric=None, kvstore="tpu_sync",
                 optimizer="sgd",
                 optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
                                   "multi_precision": True},
-                epoch_end_callback=rec_cb)
+                epoch_end_callback=timing_cb(t_rec))
         steps_per_epoch = 768 // batch
         dt_rec = t_rec[-1] - t_rec[0]
         recordio_img_s = batch * steps_per_epoch * (len(t_rec) - 1) / dt_rec
@@ -307,6 +331,14 @@ def main():
         "device": dev.device_kind,
         "flops_per_step": flops_per_step,
     }
+    if grouped_img_s is not None:
+        out["steps_per_dispatch"] = k_disp
+        out["grouped_img_s"] = round(grouped_img_s, 2)
+        out["grouped_step_ms"] = round(grouped_step_ms, 3)
+        if on_tpu:
+            grouped_mfu = (grouped_img_s / batch) * flops_per_step \
+                / _peak_flops(dev.device_kind)
+            out["grouped_mfu"] = round(grouped_mfu, 4)
     if recordio_img_s is not None:
         out["recordio_img_s"] = round(recordio_img_s, 2)
         out["recordio_input_only_img_s"] = round(input_only_img_s, 2)

@@ -16,6 +16,16 @@ if config.flags.enable_x64:
     import jax as _jax
     _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache (the operator_tune replacement — see
+# the flag's docstring). Pure config: no device/backend work happens here,
+# so import hygiene is preserved.
+if config.flags.compile_cache_dir:
+    import jax as _jax_cc
+    _jax_cc.config.update("jax_compilation_cache_dir",
+                          config.flags.compile_cache_dir)
+    _jax_cc.config.update("jax_persistent_cache_min_compile_time_secs",
+                          config.flags.compile_cache_min_compile_secs)
+
 # Under a launcher (tools/launch.py sets MXNET_COORDINATOR_ADDRESS /
 # DMLC_PS_ROOT_URI), join the process group NOW — jax.distributed must
 # initialize before any JAX call touches a backend, and user scripts touch

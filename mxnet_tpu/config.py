@@ -150,6 +150,36 @@ register_flag("enforce_determinism", "MXNET_ENFORCE_DETERMINISM",
               "kernels are deterministic by default; this additionally "
               "refuses to auto-seed the global RNG from entropy "
               "(mxnet_tpu.random._chain).")
+register_flag("backward_do_mirror", "MXNET_BACKWARD_DO_MIRROR",
+              _parse_bool, False,
+              "Gradient mirroring (parity: reference "
+              "graph_executor.cc:260-283, docs/faq/env_var.md): trade "
+              "FLOPs for activation memory. TPU-native mechanism: the "
+              "differentiated graph is wrapped in jax.checkpoint, so the "
+              "backward pass recomputes activations instead of keeping "
+              "them resident in HBM (~2x batch headroom for ~1.3x "
+              "forward FLOPs at the default policy).")
+register_flag("mirror_policy", "MXNET_MIRROR_POLICY", str,
+              "nothing_saveable",
+              "jax.checkpoint_policies policy name used when "
+              "MXNET_BACKWARD_DO_MIRROR=1: nothing_saveable (recompute "
+              "everything — max memory savings), dots_saveable (keep "
+              "matmul outputs), dots_with_no_batch_dims_saveable "
+              "(transformer-style).")
+register_flag("compile_cache_dir", "MXNET_COMPILE_CACHE_DIR", str, "",
+              "Persistent XLA compilation-cache directory; empty "
+              "disables. The XLA-era replacement for the reference's "
+              "operator_tune startup autotuning "
+              "(src/operator/operator_tune.h:67-225): instead of "
+              "re-measuring ops every process, compiled programs are "
+              "reused across processes, so a big fused train step's "
+              "multi-minute first compile is paid once per program, not "
+              "once per run.")
+register_flag("compile_cache_min_compile_secs",
+              "MXNET_COMPILE_CACHE_MIN_COMPILE_SECS", float, 1.0,
+              "Only persist programs whose compile took at least this "
+              "many seconds (tiny eager ops are cheap to recompile and "
+              "would bloat the cache).")
 register_flag("profiler_autostart", "MXNET_PROFILER_AUTOSTART",
               _parse_bool, False,
               "Start the profiler when mxnet_tpu.profiler is first "

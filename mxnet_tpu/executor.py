@@ -35,6 +35,24 @@ from .ndarray.ndarray import NDArray
 __all__ = ["Executor", "simple_bind"]
 
 
+def mirror_wrap(f):
+    """Gradient mirroring (the MXNET_BACKWARD_DO_MIRROR analog —
+    reference graph_executor.cc:260-283 recomputes cheap segments in the
+    backward): when the flag is on, wrap the differentiated function in
+    ``jax.checkpoint`` so the backward recomputes activations per the
+    configured rematerialization policy instead of keeping them in HBM.
+    Evaluated at trace time — a no-op passthrough when the flag is off."""
+    from .config import flags as _flags
+    if not _flags.backward_do_mirror:
+        return f
+    policy = getattr(jax.checkpoint_policies, _flags.mirror_policy, None)
+    if policy is None:
+        raise ValueError(
+            "MXNET_MIRROR_POLICY=%r is not a jax.checkpoint_policies "
+            "name" % _flags.mirror_policy)
+    return jax.checkpoint(f, policy=policy)
+
+
 def _graph_eval_fn(symbol):
     """Build eval(arg_vals, aux_vals, key, training) -> (outputs, aux_updates).
 
@@ -230,7 +248,7 @@ class Executor:
                 outs, auxu = eval_fn({**rest, **d}, aux_vals, key, True)
                 return outs, auxu
 
-            outs, vjp, auxu = jax.vjp(f, diff, has_aux=True)
+            outs, vjp, auxu = jax.vjp(mirror_wrap(f), diff, has_aux=True)
             grads = vjp(list(ograds))[0]
             return outs, auxu, grads
 

@@ -92,7 +92,8 @@ class FusedStep:
                          for k, v in d.items()}
                 return eval_fn({**rest, **d}, aux_vals, key, True)
 
-            outs, vjp, auxu = jax.vjp(f, diff, has_aux=True)
+            from ..executor import mirror_wrap
+            outs, vjp, auxu = jax.vjp(mirror_wrap(f), diff, has_aux=True)
             # keep aux dtypes stable across steps (bf16 activations must
             # not flip the f32 BN accumulators and trigger a recompile)
             auxu = {k: v.astype(aux_vals[k].dtype) for k, v in auxu.items()}

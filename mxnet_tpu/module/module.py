@@ -415,9 +415,6 @@ class Module(BaseModule):
     def _fit_step_k_impl(self, data_batches):
         from .. import random as _random
         ex = self._exec
-        # keep the executor's input bindings current (shape checks, later
-        # forward() calls); run_k reads the per-step values from `feeds`
-        ex.set_inputs(**self._feed(data_batches[-1]))
         # each feed value gets the SAME cast (+ placement) set_inputs
         # applies (host iterator batches are cpu-committed; stacking them
         # raw would hand the donating jit cpu feeds next to device params).
@@ -427,6 +424,11 @@ class Module(BaseModule):
         feeds = [{name: ex.prepare_input(name, arr, place=place_each)
                   for name, arr in self._feed(b).items()}
                  for b in data_batches]
+        # keep the executor's input bindings current (shape checks, later
+        # forward() calls) without re-casting/re-transferring the batch
+        for name, val in feeds[-1].items():
+            ex.arg_dict[name]._rebind(
+                val if place_each else ex._place_input(val, name))
         keys = [_random.next_key() for _ in data_batches]
         outs, new_params, new_aux, new_opt = self._fused.run_k(
             ex._arg_vals(), ex._aux_vals(), self._fused_opt_state,

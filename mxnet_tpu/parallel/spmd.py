@@ -129,7 +129,9 @@ class SPMDTrainStep:
                     total = total + jnp.sum(o)
                 return total, (outs, auxu)
 
-            grads, (outs, auxu) = jax.grad(loss_fn, has_aux=True)(params)
+            from ..executor import mirror_wrap
+            grads, (outs, auxu) = jax.grad(mirror_wrap(loss_fn),
+                                           has_aux=True)(params)
             new_params = {}
             new_opt = {}
             for k, w in params.items():

@@ -320,6 +320,19 @@ def test_grouped_accepts_numpy_feeds():
     assert mod._fused is not None
 
 
+def test_fused_with_backward_mirror_matches():
+    """Gradient mirroring under the fused step: jax.checkpoint recompute
+    must not change the numerics (same program, residuals recomputed)."""
+    from mxnet_tpu import config
+    base = _fit("tpu_sync", "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                num_epoch=1, n=16)
+    with config.override(backward_do_mirror=True):
+        mirrored = _fit("tpu_sync", "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        num_epoch=1, n=16)
+    _assert_params_close(base, mirrored, rtol=1e-5, atol=1e-7)
+
+
 def test_grouped_rejects_bad_k():
     sym = _make_net()
     X, Y = _data(16)

@@ -1,12 +1,15 @@
 """Single-chip ResNet-50 perf experiments: where does the step time go?
 
 Runs the fused train step at several configurations and prints a table:
-  fwd-only vs full step, batch scaling, optional XLA-flag variants.
-Timing = forced host fetch after N steps (same methodology as bench.py).
+  fwd-only vs full step, batch scaling, grouped scan dispatch, optional
+  XLA-flag variants (set XLA_FLAGS in the shell — it must precede jax
+  init). Timing = forced host fetch after N steps (same methodology as
+  bench.py).
 
 Usage:  python tools/perf_experiments.py [--steps 20]
-        [--cases fwd128,step128,step256]   # fwd<N> = fwd-only batch N,
-                                           # step<N> = full train step
+        [--cases fwd128,step128,step256,scan128x10]
+        # fwd<N> = fwd-only batch N; step<N> = full train step;
+        # scan<N>x<K> = fit(steps_per_dispatch=K): K steps per dispatch
 """
 import argparse
 import os
@@ -16,7 +19,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run(batch, steps, fwd_only=False):
+def run(batch, steps, fwd_only=False, scan_k=0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -35,6 +38,36 @@ def run(batch, steps, fwd_only=False):
     batch_obj = DataBatch(data=[data_nd], label=[label_nd])
 
     mod = mx.mod.Module(sym, context=ctx)
+
+    if scan_k:
+        # grouped dispatch through the product API, bench.py-style timing
+        class _It:
+            provide_data = [DataDesc("data", (batch, 3, 224, 224))]
+            provide_label = [DataDesc("softmax_label", (batch,))]
+            batch_size = batch
+
+            def __iter__(self):
+                return iter([batch_obj] * steps)
+
+            def reset(self):
+                pass
+
+        t_k = []
+
+        def cb(epoch, symbol, a, b):
+            jax.device_get(mod._exec.arg_dict[mod._param_names[0]]._data)
+            t_k.append(time.perf_counter())
+
+        mod.fit(_It(), num_epoch=3, eval_metric=None, kvstore="tpu_sync",
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                                  "multi_precision": True},
+                initializer=mx.initializer.Xavier(factor_type="in",
+                                                  magnitude=2.0),
+                steps_per_dispatch=scan_k, epoch_end_callback=cb)
+        dt = t_k[-1] - t_k[0]
+        n = steps * (len(t_k) - 1)
+        return dt / n * 1e3, batch * n / dt
     mod.bind([DataDesc("data", (batch, 3, 224, 224))],
              [DataDesc("softmax_label", (batch,))],
              for_training=not fwd_only)
@@ -76,6 +109,12 @@ def main():
 
     for case in args.cases.split(","):
         case = case.strip()
+        if case.startswith("scan"):
+            b, k = (int(x) for x in case[4:].split("x"))
+            ms, img_s = run(b, args.steps, scan_k=k)
+            print("CASE scan(K=%-3d) b=%-4d %8.2f ms/step %10.1f img/s"
+                  % (k, b, ms, img_s), flush=True)
+            continue
         fwd = case.startswith("fwd")
         b = int(case.replace("fwd", "").replace("step", ""))
         ms, img_s = run(b, args.steps, fwd_only=fwd)
