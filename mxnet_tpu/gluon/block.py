@@ -597,7 +597,9 @@ class HybridBlock(Block):
                 return jitted(pa, diff_ins, key)
 
             diff_params = [param_arrays[i] for i in diff_idx]
-            (out_pytree, aux), vjp = jax.vjp(fwd, diff_params, in_arrays)
+            from ..executor import mirror_wrap
+            (out_pytree, aux), vjp = jax.vjp(mirror_wrap(fwd), diff_params,
+                                             in_arrays)
             _apply_aux(params, param_names, aux)
             flat, out_td = tree.tree_flatten(out_pytree)
             out_nds = [_nd.NDArray(o) for o in flat]

@@ -399,6 +399,17 @@ def test_trainer_fused_matches_eager():
         np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
 
 
+def test_gluon_hybridize_mirror_matches():
+    """Mirroring on the CachedOp backward (hybridize path): identical
+    training trajectory with remat on."""
+    from mxnet_tpu import config
+    base, _ = _gluon_train(True)
+    with config.override(backward_do_mirror=True):
+        mirrored, _ = _gluon_train(True)
+    for a, b in zip(base, mirrored):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+
 def test_trainer_fused_adam_matches_eager():
     eager, _ = _gluon_train(False, "adam", {"learning_rate": 0.01}, steps=1)
     fused, tr = _gluon_train(True, "adam", {"learning_rate": 0.01}, steps=1)
