@@ -26,11 +26,24 @@ if config.flags.compile_cache_dir:
     _jax_cc.config.update("jax_persistent_cache_min_compile_time_secs",
                           config.flags.compile_cache_min_compile_secs)
 
+import os as _os
+
+# Re-assert a user-pinned CPU platform into jax config. A site-installed
+# PJRT plugin (e.g. a TPU-proxy sitecustomize) may call
+# jax.config.update("jax_platforms", ...) during registration, silently
+# overriding the env var — and a forced remote platform HANGS every
+# jax.devices() call when its link is down, hermetic CPU runs included.
+# Only cpu-leading values are re-asserted: for accelerator values the
+# plugin's own selection (typically "<plat>,cpu") is already right.
+# Pure config, no backend init, so import hygiene holds.
+if _os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+    import jax as _jax_plat
+    _jax_plat.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 # Under a launcher (tools/launch.py sets MXNET_COORDINATOR_ADDRESS /
 # DMLC_PS_ROOT_URI), join the process group NOW — jax.distributed must
 # initialize before any JAX call touches a backend, and user scripts touch
 # arrays long before they create a kvstore. No-op outside a launcher.
-import os as _os
 if _os.environ.get("MXNET_COORDINATOR_ADDRESS") \
         or _os.environ.get("DMLC_PS_ROOT_URI"):
     from .parallel import dist as _dist

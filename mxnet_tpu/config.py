@@ -166,9 +166,12 @@ register_flag("mirror_policy", "MXNET_MIRROR_POLICY", str,
               "everything — max memory savings), dots_saveable (keep "
               "matmul outputs), dots_with_no_batch_dims_saveable "
               "(transformer-style).")
-register_flag("compile_cache_dir", "MXNET_COMPILE_CACHE_DIR", str, "",
+register_flag("compile_cache_dir", "MXNET_COMPILE_CACHE_DIR", str,
+              (os.path.expanduser("~/.cache/mxnet_tpu/xla")
+               if not os.path.expanduser("~").startswith("~") else ""),
               "Persistent XLA compilation-cache directory; empty "
-              "disables. The XLA-era replacement for the reference's "
+              "disables. On by default: set MXNET_COMPILE_CACHE_DIR= "
+              "(empty) to turn off. The XLA-era replacement for the reference's "
               "operator_tune startup autotuning "
               "(src/operator/operator_tune.h:67-225): instead of "
               "re-measuring ops every process, compiled programs are "

@@ -108,14 +108,30 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=1e-5, atol=1e-7):
 
 
 def with_seed(seed=None):
-    """Decorator: reproducible RNG per test (reference tests common.py:113)."""
+    """Decorator: reproducible RNG per test (reference tests common.py:113).
+
+    Seed priority matches the reference: explicit ``seed=`` argument, else
+    the MXNET_TEST_SEED env var (how a logged failure seed is replayed —
+    also what tools/flakiness_checker.py -s sets), else random.
+    """
     import functools
 
     def deco(f):
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
             from . import random as _random
-            s = seed if seed is not None else np.random.randint(0, 2**31)
+            env_seed = os.environ.get("MXNET_TEST_SEED", "")
+            if seed is not None:
+                s = seed
+            elif env_seed:
+                try:
+                    s = int(env_seed)
+                except ValueError:
+                    raise ValueError(
+                        "MXNET_TEST_SEED must be an integer, got %r"
+                        % env_seed) from None
+            else:
+                s = np.random.randint(0, 2**31)
             _random.seed(s)
             try:
                 return f(*args, **kwargs)

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Check a given test for flakiness by running it many times.
+
+Parity: /root/reference/tools/flakiness_checker.py (same job: take a test
+spec + trial count, re-run with varying seeds, report). Differences: our
+suite is pytest (the reference was nosetests), so the spec is any pytest
+node id (``tests/test_ops.py::test_conv``) or the reference-style
+``test_module.test_name`` form, and the seed rides MXNET_TEST_SEED, which
+``mxnet_tpu.test_utils.with_seed`` honors.
+
+Usage: python tools/flakiness_checker.py tests/test_metric_io.py::test_acc
+       [-n 100] [-s SEED] [-v]
+"""
+import argparse
+import os
+import re
+import subprocess
+import sys
+import random
+
+DEFAULT_NUM_TRIALS = 100
+
+
+def find_test_path(test_file):
+    """Map a bare module name (reference style: ``test_operator``) to a
+    path under tests/."""
+    if os.path.exists(test_file):
+        return test_file
+    base = os.path.basename(test_file)
+    if not base.endswith(".py"):
+        base += ".py"
+    top = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for root, _, files in os.walk(os.path.join(top, "tests")):
+        if base in files:
+            return os.path.join(root, base)
+    raise FileNotFoundError("could not find %s under tests/" % test_file)
+
+
+def parse_spec(spec):
+    if "::" in spec:                       # pytest node id
+        path, name = spec.split("::", 1)
+        return find_test_path(path), name
+    m = re.match(r"(.+)\.(test_\w+)$", spec)  # reference dotted form
+    if m:
+        return find_test_path(m.group(1)), m.group(2)
+    return find_test_path(spec), None
+
+
+def run_test_trials(args):
+    path, name = parse_spec(args.test)
+    node = path if name is None else "%s::%s" % (path, name)
+    verbosity = [] if args.verbose else ["-q", "--no-header"]
+    failures = 0
+    for i in range(args.trials):
+        seed = args.seed if args.seed is not None \
+            else random.randint(0, 2**31 - 1)
+        env = dict(os.environ, MXNET_TEST_SEED=str(seed))
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", node, "-x"] + verbosity,
+            env=env, capture_output=not args.verbose, text=True)
+        if res.returncode != 0:
+            failures += 1
+            print("FAILED trial %d/%d (seed %d)" % (i + 1, args.trials, seed))
+            if not args.verbose and res.stdout:
+                print(res.stdout.strip().splitlines()[-1])
+        elif args.verbose:
+            print("passed trial %d/%d (seed %d)" % (i + 1, args.trials, seed))
+    return failures
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("test", help="pytest node id (tests/test_x.py::test_y), "
+                                "file path, or reference-style module.name")
+    p.add_argument("-n", "--trials", type=int, default=DEFAULT_NUM_TRIALS)
+    p.add_argument("-s", "--seed", type=int, default=None,
+                   help="fixed seed for every trial (default: random per "
+                        "trial)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args()
+
+    failures = run_test_trials(args)
+    print("%d/%d trials failed" % (failures, args.trials))
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

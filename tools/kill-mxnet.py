@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Kill stray training processes on every host in a hostfile.
+
+Parity: /root/reference/tools/kill-mxnet.py — cluster cleanup after a
+crashed/hung distributed run. Same CLI: hostfile (one host per line,
+``host:port`` accepted), the unix user whose processes to kill, and a
+program-name pattern. Hosts are reached over ssh exactly like
+tools/launch.py's ssh mode launches them; the local machine is swept last.
+
+Usage: python tools/kill-mxnet.py <hostfile> <user> <prog>
+"""
+import os
+import subprocess
+import sys
+
+
+def kill_command(user, prog_name):
+    import shlex
+    return "pkill -9 -u %s -f %s || true" % (shlex.quote(user),
+                                             shlex.quote(prog_name))
+
+
+def main():
+    if len(sys.argv) != 4:
+        print("usage: %s <hostfile> <user> <prog>" % sys.argv[0])
+        sys.exit(1)
+    host_file, user, prog_name = sys.argv[1:4]
+    cmd = kill_command(user, prog_name)
+    print(cmd)
+
+    procs = []
+    with open(host_file) as f:
+        for host in f:
+            host = host.strip()
+            if not host:
+                continue
+            if ":" in host:
+                host = host[:host.index(":")]
+            print(host)
+            procs.append(subprocess.Popen(
+                ["ssh", "-oStrictHostKeyChecking=no", host, cmd],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        p.wait()
+    os.system(cmd)
+    print("Done killing")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown (or TSV) table.
+
+Parity: /root/reference/tools/parse_log.py — same log grammar (the
+``Epoch[N] Train-metric=V`` / ``Validation-metric=V`` / ``Time cost=V``
+lines our fit loops and Speedometer emit match the reference's) and the
+same output formats.
+
+Usage: python tools/parse_log.py train.log [--format markdown|none]
+       [--metric-names accuracy ce]
+"""
+import argparse
+import re
+
+
+def parse(lines, metric_names):
+    pats = ([re.compile(r".*Epoch\[(\d+)\] Train-%s.*=([.\d]+)" % s)
+             for s in metric_names]
+            + [re.compile(r".*Epoch\[(\d+)\] Validation-%s.*=([.\d]+)" % s)
+               for s in metric_names]
+            + [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")])
+    # data[epoch] = [sum, count] per column (train metrics, val metrics, time)
+    data = {}
+    for line in lines:
+        for i, pat in enumerate(pats):
+            m = pat.match(line)
+            if m is not None:
+                epoch, val = int(m.group(1)), float(m.group(2))
+                cols = data.setdefault(epoch, [[0.0, 0] for _ in pats])
+                cols[i][0] += val
+                cols[i][1] += 1
+                break
+    return data
+
+
+def mean(col):
+    return col[0] / col[1] if col[1] else float("nan")
+
+
+def main():
+    p = argparse.ArgumentParser(description="Parse training output log")
+    p.add_argument("logfile", help="the log file to parse")
+    p.add_argument("--format", default="markdown",
+                   choices=["markdown", "none"])
+    p.add_argument("--metric-names", nargs="+", default=["accuracy"],
+                   help="metric names to look for in the log")
+    args = p.parse_args()
+
+    with open(args.logfile) as f:
+        data = parse(f.readlines(), args.metric_names)
+
+    heads = (["train-" + s for s in args.metric_names]
+             + ["val-" + s for s in args.metric_names] + ["time"])
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(heads) + " |")
+        print("| --- " * (len(heads) + 1) + "|")
+        for epoch in sorted(data):
+            cols = data[epoch]
+            cells = ["%f" % mean(c) for c in cols[:-1]]
+            print("| %2d | %s | %.1f |"
+                  % (epoch + 1, " | ".join(cells), mean(cols[-1])))
+    else:
+        print("\t".join(["epoch"] + heads))
+        for epoch in sorted(data):
+            cols = data[epoch]
+            print("\t".join(["%2d" % (epoch + 1)]
+                            + ["%f" % mean(c) for c in cols[:-1]]
+                            + ["%.1f" % mean(cols[-1])]))
+
+
+if __name__ == "__main__":
+    main()

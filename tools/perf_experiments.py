@@ -39,7 +39,19 @@ def run(batch, steps, fwd_only=False, scan_k=0):
 
     mod = mx.mod.Module(sym, context=ctx)
 
-    if scan_k:
+    if not fwd_only:
+        # Route EVERY train case through fit() so each case reuses the ONE
+        # donating jitted program bench.py measures (forward_backward would
+        # compile a second, non-donating variant: minutes of wasted tunnel
+        # compile and not the benched path). scan_k<=1 -> per-step dispatch.
+        scan_k = max(scan_k, 1)
+        if steps % scan_k:
+            # fit's grouped path only engages for FULL groups of K; an
+            # undersized tail falls back to per-step and the printed number
+            # would silently mix the two dispatch modes
+            raise ValueError("--steps %d not divisible by scan K=%d: the "
+                             "tail batches would run per-step" % (steps,
+                                                                  scan_k))
         # grouped dispatch through the product API, bench.py-style timing
         class _It:
             provide_data = [DataDesc("data", (batch, 3, 224, 224))]
@@ -70,26 +82,14 @@ def run(batch, steps, fwd_only=False, scan_k=0):
         return dt / n * 1e3, batch * n / dt
     mod.bind([DataDesc("data", (batch, 3, 224, 224))],
              [DataDesc("softmax_label", (batch,))],
-             for_training=not fwd_only)
+             for_training=False)
     mod.init_params(mx.initializer.Xavier(factor_type="in", magnitude=2.0))
-    if not fwd_only:
-        mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
-                           optimizer_params={"learning_rate": 0.05,
-                                             "momentum": 0.9,
-                                             "multi_precision": True})
 
     def one_step():
-        if fwd_only:
-            mod.forward(batch_obj, is_train=False)
-        else:
-            mod.forward_backward(batch_obj)
-            mod.update()
+        mod.forward(batch_obj, is_train=False)
 
     def force():
-        if fwd_only:
-            arr = mod.get_outputs()[0]._data
-        else:
-            arr = mod._exec.arg_dict[mod._param_names[0]]._data
+        arr = mod.get_outputs()[0]._data
         return float(np.asarray(jax.device_get(arr)).ravel()[0])
 
     one_step(); force()          # compile

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Create a .idx index file for an existing .rec RecordIO file.
+
+Parity: /root/reference/tools/rec2idx.py (IndexCreator over the C
+MXRecordIOReaderTell API). Ours walks the record with
+:class:`mxnet_tpu.recordio.MXRecordIO` — `tell()` is native to the reader —
+and writes the same ``key\\tbyte-offset`` text format MXIndexedRecordIO
+consumes.
+
+Usage: python tools/rec2idx.py data/test.rec data/test.idx
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+class IndexCreator(recordio.MXRecordIO):
+    """Reads a ``.rec`` file and writes the random-access index."""
+
+    def __init__(self, uri, idx_path, key_type=int):
+        self.key_type = key_type
+        self.fidx = None
+        self.idx_path = idx_path
+        super().__init__(uri, "r")
+
+    def open(self):
+        super().open()
+        self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+        super().close()
+
+    def create_index(self, log_every=1000):
+        self.reset()
+        counter = 0
+        t0 = time.time()
+        while True:
+            if counter and counter % log_every == 0:
+                print("time: %.2fs  count: %d" % (time.time() - t0, counter))
+            pos = self.tell()
+            if self.read() is None:
+                break
+            self.fidx.write("%s\t%d\n" % (self.key_type(counter), pos))
+            counter += 1
+        return counter
+
+
+def main():
+    p = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+        description="Create an index file from a .rec file")
+    p.add_argument("record", help="path to .rec file")
+    p.add_argument("index", help="path to index file (created/overwritten)")
+    args = p.parse_args()
+
+    creator = IndexCreator(os.path.abspath(args.record),
+                           os.path.abspath(args.index))
+    n = creator.create_index()
+    creator.close()
+    print("indexed %d records" % n)
+
+
+if __name__ == "__main__":
+    main()
