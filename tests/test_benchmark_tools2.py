@@ -1,0 +1,44 @@
+"""Smoke tests for the round-4 measurement tools: the gluon
+imperative-vs-hybrid benchmark (reference benchmark/python/gluon/
+benchmark_gluon.py) and the sparse end-to-end benchmark (reference
+benchmark/python/sparse/sparse_end2end.py). Tiny shapes; the tools'
+real-shape numbers run on the chip."""
+import json
+import os
+import subprocess
+import sys
+
+TOP = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, TOP)
+
+
+def test_benchmark_gluon_inference_both_variants():
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOP, "tools", "benchmark_gluon.py"),
+         "--model", "squeezenet1.0", "--batch-size", "1",
+         "--num-batches", "2", "--type", "inference"],
+        capture_output=True, text=True, timeout=560,
+        env=dict(os.environ, JAX_PLATFORMS="cpu")).stdout
+    lines = [json.loads(ln) for ln in out.splitlines()
+             if ln.startswith("{")]
+    metrics = {(l["metric"], l.get("hybrid")) for l in lines}
+    assert ("gluon_img_per_sec", True) in metrics
+    assert ("gluon_img_per_sec", False) in metrics
+    assert ("gluon_hybridize_speedup", None) in metrics
+    for l in lines:
+        assert l["value"] > 0
+
+
+def test_sparse_end2end_phases():
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOP, "tools", "sparse_end2end.py"),
+         "--num-features", "500", "--nnz", "5", "--batch-size", "32",
+         "--num-batch", "3"],
+        capture_output=True, text=True, timeout=560,
+        env=dict(os.environ, JAX_PLATFORMS="cpu")).stdout
+    line = json.loads([ln for ln in out.splitlines()
+                       if ln.startswith("{")][-1])
+    assert line["metric"] == "sparse_linear_samples_per_sec"
+    assert line["value"] > 0
+    for phase in ("io_ms", "comm_ms", "compute_ms"):
+        assert line[phase] >= 0

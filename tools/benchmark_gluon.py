@@ -1,0 +1,139 @@
+"""Gluon imperative-vs-hybridized throughput across the model zoo.
+
+Parity: /root/reference/benchmark/python/gluon/benchmark_gluon.py (the
+BASELINE.md measurement-tools row "gluon imperative vs hybrid
+throughput"). Same sweep axes — model, batch size, inference/training —
+plus the comparison that tool exists for: eager dispatch vs the compiled
+CachedOp. On TPU the gap is the whole story (eager pays a PJRT dispatch
+per op; hybridized runs ONE XLA program), so the ratio is printed too.
+
+One JSON line per (model, mode, batch, variant):
+
+    {"metric": "gluon_img_per_sec", "model": "resnet18_v1",
+     "mode": "inference", "hybrid": true, ...}
+
+Usage: python tools/benchmark_gluon.py [--model resnet18_v1]
+       [--batch-size 32] [--num-batches 10] [--type inference]
+       [--no-imperative] [--platform cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _input_shape(model):
+    return (3, 299, 299) if model.startswith("inception") else (3, 224, 224)
+
+
+def run_inference(model, batch, steps, hybrid, ctx):
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(model, pretrained=False)
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    if hybrid:
+        net.hybridize(static_alloc=True)
+    x = mx.nd.random.uniform(shape=(batch,) + _input_shape(model), ctx=ctx)
+    net(x).wait_to_read()                        # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = net(x)
+    float(np.asarray(jax.device_get(out._data)).ravel()[0])
+    return time.perf_counter() - t0
+
+
+def run_training(model, batch, steps, hybrid, ctx):
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(model, pretrained=False)
+    net.initialize(mx.initializer.Xavier(), ctx=ctx)
+    if hybrid:
+        net.hybridize(static_alloc=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    x = mx.nd.random.uniform(shape=(batch,) + _input_shape(model), ctx=ctx)
+    y = mx.nd.array(np.random.randint(0, 1000, (batch,)), ctx=ctx)
+
+    def step():
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    step().wait_to_read()                        # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    float(np.asarray(jax.device_get(loss._data)).ravel()[0])
+    return time.perf_counter() - t0
+
+
+def bench(model, batch, steps, mode, variants):
+    import jax
+    import mxnet_tpu as mx
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+    fn = run_inference if mode == "inference" else run_training
+    results = {}
+    for hybrid in variants:
+        dt = fn(model, batch, steps, hybrid, ctx)
+        results[hybrid] = batch * steps / dt
+        print(json.dumps({
+            "metric": "gluon_img_per_sec",
+            "model": model, "mode": mode, "hybrid": hybrid,
+            "value": round(results[hybrid], 2), "unit": "img/s",
+            "batch": batch, "step_ms": round(dt / steps * 1e3, 3),
+            "device": jax.devices()[0].device_kind,
+        }), flush=True)
+    if True in results and False in results:
+        print(json.dumps({
+            "metric": "gluon_hybridize_speedup", "model": model,
+            "mode": mode,
+            "value": round(results[True] / results[False], 2), "unit": "x",
+        }), flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Gluon model-zoo CNN benchmark (imperative vs hybrid)")
+    p.add_argument("--model", default="resnet18_v1",
+                   help="any gluon model-zoo name, comma list accepted")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-batches", type=int, default=10)
+    p.add_argument("--type", default="inference", dest="mode",
+                   choices=["all", "training", "inference"])
+    p.add_argument("--no-imperative", action="store_true",
+                   help="hybridized only (eager sweeps are slow on big "
+                        "zoo models)")
+    p.add_argument("--platform", default=None, choices=[None, "cpu"])
+    args = p.parse_args()
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    variants = [True] if args.no_imperative else [True, False]
+    modes = ["inference", "training"] if args.mode == "all" else [args.mode]
+    for model in args.model.split(","):
+        for mode in modes:
+            bench(model.strip(), args.batch_size, args.num_batches, mode,
+                  variants)
+
+
+if __name__ == "__main__":
+    main()

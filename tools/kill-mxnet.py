@@ -16,8 +16,14 @@ import sys
 
 def kill_command(user, prog_name):
     import shlex
-    return "pkill -9 -u %s -f %s || true" % (shlex.quote(user),
-                                             shlex.quote(prog_name))
+    # pgrep then filter out our own pid ($$ is the shell running the
+    # sweep): a plain pkill -f would match this script's own command
+    # line (which contains the prog pattern) and SIGKILL it mid-run
+    return ("for p in $(pgrep -u %s -f %s); do "
+            "[ \"$p\" != \"$$\" ] && [ \"$p\" != \"%d\" ] && "
+            "[ \"$p\" != \"%d\" ] && kill -9 $p; "
+            "done; true" % (shlex.quote(user), shlex.quote(prog_name),
+                            os.getpid(), os.getppid()))
 
 
 def main():

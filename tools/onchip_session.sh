@@ -1,0 +1,44 @@
+#!/bin/bash
+# One-shot on-chip perf session: run the moment the TPU tunnel comes back.
+# Orders the work so the most valuable numbers land first (each phase
+# logs incrementally; a mid-session tunnel loss still leaves results).
+#
+#   bash tools/onchip_session.sh [logdir]
+#
+# Phase 1  microbench_convs  — are the conv kernels themselves at MXU
+#                              efficiency? (small programs, fast compiles)
+# Phase 2  perf_experiments  — step128 vs scan128xK: how much of the
+#                              57.5ms step is per-dispatch tunnel latency?
+# Phase 3  bench.py BENCH_K  — refresh BENCH_LAST_TPU.json with the
+#                              grouped-dispatch fields for the round record.
+# All phases share the persistent compile cache (on by default), so a
+# retry after a tunnel drop skips straight past finished compiles.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/onchip}
+mkdir -p "$LOG"
+
+probe() {
+  timeout 90 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+echo "[onchip] probing tunnel..."
+if ! probe; then
+  echo "[onchip] tunnel down — aborting (rerun when it returns)"
+  exit 2
+fi
+
+echo "[onchip] phase 1: conv microbench"
+timeout 1800 python -u tools/microbench_convs.py --iters 50 \
+  2>&1 | tee "$LOG/microbench.log" | grep -v -E "WARN|axon_"
+
+echo "[onchip] phase 2: dispatch experiments"
+timeout 3000 python -u tools/perf_experiments.py --steps 30 \
+  --cases step128,scan128x10,scan128x30 \
+  2>&1 | tee "$LOG/experiments.log" | grep -v -E "WARN|axon_"
+
+echo "[onchip] phase 3: bench refresh (grouped dispatch K=30)"
+BENCH_K=30 timeout 3600 python -u bench.py \
+  2>&1 | tee "$LOG/bench.log" | tail -5
+
+echo "[onchip] done — logs in $LOG"
