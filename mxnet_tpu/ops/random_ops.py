@@ -123,9 +123,67 @@ def shuffle(data):
     return jax.random.permutation(_random.next_key(), data, axis=0)
 
 
-@register("_sample_unique_zipfian", is_random=True)
-def sample_unique_zipfian(*, range_max, shape=(1,)):
-    # approximate: log-uniform proposals (used by sampled softmax)
-    u = jax.random.uniform(_random.next_key(), tuple(shape))
-    out = jnp.exp(u * jnp.log(float(range_max))).astype(_index_dtype()) - 1
-    return jnp.clip(out, 0, range_max - 1)
+@register("_sample_unique_zipfian", is_random=True, num_outputs=2)
+def sample_unique_zipfian(*, range_max, shape=(1, 1)):
+    """Sampling WITHOUT replacement from the log-uniform (zipfian)
+    proposal distribution, plus the number of tries it took — the
+    sampled-softmax helper (reference
+    src/operator/random/unique_sample_op.h:109-136 rejection loop).
+    TPU form: a vmapped ``lax.while_loop`` drawing one proposal per
+    iteration against a hit-mask — identical semantics (exact uniques,
+    exact try counts per row), no host-side set.
+    """
+    shape = tuple(shape)
+    if len(shape) == 1:
+        shape = (1,) + shape
+    batch, n = shape
+    if n > range_max:
+        raise ValueError(
+            "Number of samples (%d) cannot exceed the number of possible "
+            "classes (%d)" % (n, range_max))
+    log_rm = jnp.float32(jnp.log(float(range_max)))
+    idt = _index_dtype()
+    # proposals per while_loop iteration: enough that the loop usually
+    # finishes in a handful of vectorized rounds instead of one device
+    # round-trip per draw
+    blk = min(max(64, 2 * n), 8192)
+
+    def one_row(key):
+        def cond(st):
+            return st[0] < n
+
+        def body(st):
+            count, tries, mask, buf, key = st
+            key, sub = jax.random.split(key)
+            x = jax.random.uniform(sub, (blk,))
+            vals = jnp.clip(
+                jnp.round(jnp.exp(x * log_rm)).astype(idt) - 1,
+                0, range_max - 1)
+            # first occurrence within the block (earlier duplicate kills
+            # later ones), then not already in the hit-mask
+            dup_earlier = jnp.tril(vals[None, :] == vals[:, None], -1)
+            is_new = ~jnp.any(dup_earlier, axis=1) & ~mask[vals]
+            # set size after each draw if applied in order; the loop
+            # "stops" at the draw that fills the set — later proposals
+            # were never drawn in the reference's sequential semantics
+            pos = count + jnp.cumsum(is_new.astype(jnp.int32))
+            apply = is_new & (pos <= n)
+            slot = jnp.where(apply, pos - 1, n)     # n = OOB -> dropped
+            buf = buf.at[slot].set(vals, mode="drop")
+            mask = mask.at[jnp.where(apply, vals, range_max)].set(
+                True, mode="drop")
+            filled = pos[-1] >= n
+            # index of the filling draw (argmax finds the first True)
+            t_fill = jnp.argmax(pos >= n)
+            tries = tries + jnp.where(filled, t_fill + 1, blk)
+            return (jnp.minimum(pos[-1], n), tries, mask, buf, key)
+
+        init = (jnp.int32(0), jnp.int32(0),
+                jnp.zeros((range_max,), jnp.bool_),
+                jnp.zeros((n,), idt), key)
+        count, tries, _, buf, _ = jax.lax.while_loop(cond, body, init)
+        return buf, tries.astype(idt)
+
+    keys = jax.random.split(_random.next_key(), batch)
+    samples, tries = jax.vmap(one_row)(keys)
+    return samples, tries

@@ -1,0 +1,288 @@
+"""Table-driven numeric sweep over the elemwise/broadcast/scalar/reduction
+operator families vs numpy — the reference test_operator.py's per-op
+checks (tests/python/unittest/test_operator.py) compressed into tables.
+Every op is invoked through the public generic `mx.nd.invoke` path (the
+registry name a symbol/NNVM-JSON would carry), so this also guards the
+registered-name surface itself."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RNG = np.random.RandomState(7)
+
+
+def _inv(name, arrs, **kw):
+    out = mx.nd.invoke(name, [mx.nd.array(a) for a in arrs], kw)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    return out.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# unary math
+# ---------------------------------------------------------------------------
+
+UNARY = [
+    # (registry name, numpy fn, domain_lo, domain_hi)
+    ("sin", np.sin, -3, 3), ("cos", np.cos, -3, 3), ("tan", np.tan, -1, 1),
+    ("sinh", np.sinh, -2, 2), ("cosh", np.cosh, -2, 2),
+    ("tanh", np.tanh, -2, 2),
+    ("arcsin", np.arcsin, -0.9, 0.9), ("arccos", np.arccos, -0.9, 0.9),
+    ("arctan", np.arctan, -3, 3),
+    ("arcsinh", np.arcsinh, -3, 3), ("arccosh", np.arccosh, 1.1, 4),
+    ("arctanh", np.arctanh, -0.9, 0.9),
+    ("exp", np.exp, -2, 2), ("expm1", np.expm1, -2, 2),
+    ("log", np.log, 0.1, 5), ("log1p", np.log1p, -0.5, 5),
+    ("log2", np.log2, 0.1, 5), ("log10", np.log10, 0.1, 5),
+    ("sqrt", np.sqrt, 0.0, 9), ("rsqrt", lambda x: 1 / np.sqrt(x), 0.1, 9),
+    ("cbrt", np.cbrt, -8, 8),
+    ("rcbrt", lambda x: 1 / np.cbrt(x), 0.1, 8),
+    ("reciprocal", lambda x: 1 / x, 0.2, 4),
+    ("square", np.square, -4, 4), ("abs", np.abs, -4, 4),
+    ("sign", np.sign, -4, 4), ("negative", np.negative, -4, 4),
+    ("_np_negative", np.negative, -4, 4),
+    ("floor", np.floor, -4, 4), ("ceil", np.ceil, -4, 4),
+    ("trunc", np.trunc, -4, 4), ("rint", np.rint, -4, 4),
+    ("fix", np.fix, -4, 4),
+    ("degrees", np.degrees, -3, 3), ("radians", np.radians, -180, 180),
+    ("erf", np.vectorize(math.erf), -2, 2),
+    ("gammaln", np.vectorize(math.lgamma), 0.2, 5),
+    ("gamma", np.vectorize(math.gamma), 0.2, 5),
+    ("softsign", lambda x: x / (1 + np.abs(x)), -4, 4),
+    ("logical_not", lambda x: (x == 0).astype("f4"), -1, 1),
+]
+
+
+@pytest.mark.parametrize("name,ref,lo,hi", UNARY,
+                         ids=[u[0] for u in UNARY])
+def test_unary_vs_numpy(name, ref, lo, hi):
+    x = RNG.uniform(lo, hi, (3, 4)).astype("f4")
+    np.testing.assert_allclose(_inv(name, [x]), ref(x).astype("f4"),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_erfinv_roundtrip():
+    x = RNG.uniform(-0.9, 0.9, (8,)).astype("f4")
+    y = _inv("erfinv", [x])
+    np.testing.assert_allclose(np.vectorize(math.erf)(y), x, rtol=1e-4,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# binary: elemwise_*, broadcast_*, legacy _-names, CamelCase legacy
+# ---------------------------------------------------------------------------
+
+BINARY = [
+    ("add", np.add), ("sub", np.subtract), ("mul", np.multiply),
+    ("div", np.divide), ("mod", np.mod), ("power", np.power),
+    ("maximum", np.maximum), ("minimum", np.minimum), ("hypot", np.hypot),
+    ("equal", lambda a, b: (a == b).astype("f4")),
+    ("not_equal", lambda a, b: (a != b).astype("f4")),
+    ("greater", lambda a, b: (a > b).astype("f4")),
+    ("greater_equal", lambda a, b: (a >= b).astype("f4")),
+    ("lesser", lambda a, b: (a < b).astype("f4")),
+    ("lesser_equal", lambda a, b: (a <= b).astype("f4")),
+    ("logical_and", lambda a, b: ((a != 0) & (b != 0)).astype("f4")),
+    ("logical_or", lambda a, b: ((a != 0) | (b != 0)).astype("f4")),
+    ("logical_xor", lambda a, b: ((a != 0) ^ (b != 0)).astype("f4")),
+]
+
+_BCAST_NAME = {"add": "broadcast_plus", "sub": "broadcast_minus"}
+
+
+@pytest.mark.parametrize("stem,ref", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_families_vs_numpy(stem, ref):
+    # positive operands keep power/mod/div well-conditioned
+    a = RNG.uniform(0.5, 3, (2, 4)).astype("f4")
+    b = RNG.uniform(0.5, 3, (2, 4)).astype("f4")
+    b[0, 0] = a[0, 0]  # give the comparison ops one equal element
+    want = ref(a, b).astype("f4")
+
+    names = ["elemwise_" + stem]
+    if stem in ("add", "sub", "mul", "div", "mod", "power", "hypot",
+                "equal", "not_equal", "greater", "lesser"):
+        legacy = {"add": "_plus", "sub": "_minus"}.get(stem, "_" + stem)
+        names.append(legacy)
+    for name in names:
+        np.testing.assert_allclose(_inv(name, [a, b]), want, rtol=1e-5,
+                                   err_msg=name)
+
+    # broadcast variant over (2,1,3) x (1,4,3)
+    a3 = RNG.uniform(0.5, 3, (2, 1, 3)).astype("f4")
+    b3 = RNG.uniform(0.5, 3, (1, 4, 3)).astype("f4")
+    bname = _BCAST_NAME.get(stem, "broadcast_" + stem)
+    np.testing.assert_allclose(_inv(bname, [a3, b3]),
+                               ref(a3, b3).astype("f4"), rtol=1e-5,
+                               err_msg=bname)
+
+
+def test_broadcast_aliases():
+    a = RNG.uniform(0.5, 3, (2, 3)).astype("f4")
+    b = RNG.uniform(0.5, 3, (2, 3)).astype("f4")
+    np.testing.assert_allclose(_inv("broadcast_add", [a, b]),
+                               _inv("broadcast_plus", [a, b]))
+    np.testing.assert_allclose(_inv("broadcast_sub", [a, b]),
+                               _inv("broadcast_minus", [a, b]))
+    np.testing.assert_allclose(_inv("broadcast_div", [a, b]), a / b,
+                               rtol=1e-6)
+
+
+SCALAR = [
+    ("_plus_scalar", lambda x, s: x + s),
+    ("_minus_scalar", lambda x, s: x - s),
+    ("_rminus_scalar", lambda x, s: s - x),
+    ("_mul_scalar", lambda x, s: x * s),
+    ("_div_scalar", lambda x, s: x / s),
+    ("_rdiv_scalar", lambda x, s: s / x),
+    ("_mod_scalar", lambda x, s: np.mod(x, s)),
+    ("_rmod_scalar", lambda x, s: np.mod(s, x)),
+    ("_power_scalar", lambda x, s: np.power(x, s)),
+    ("_rpower_scalar", lambda x, s: np.power(s, x)),
+    ("_maximum_scalar", np.maximum), ("_minimum_scalar", np.minimum),
+    ("_equal_scalar", lambda x, s: (x == s).astype("f4")),
+    ("_not_equal_scalar", lambda x, s: (x != s).astype("f4")),
+    ("_greater_scalar", lambda x, s: (x > s).astype("f4")),
+    ("_greater_equal_scalar", lambda x, s: (x >= s).astype("f4")),
+    ("_lesser_scalar", lambda x, s: (x < s).astype("f4")),
+    ("_lesser_equal_scalar", lambda x, s: (x <= s).astype("f4")),
+    ("_logical_and_scalar", lambda x, s: ((x != 0) & (s != 0)).astype("f4")),
+    ("_logical_or_scalar", lambda x, s: ((x != 0) | (s != 0)).astype("f4")),
+]
+
+
+@pytest.mark.parametrize("name,ref", SCALAR, ids=[s[0] for s in SCALAR])
+def test_scalar_ops_vs_numpy(name, ref):
+    x = RNG.uniform(0.5, 3, (2, 3)).astype("f4")
+    x[0, 0] = 1.5  # equality hit
+    np.testing.assert_allclose(_inv(name, [x], scalar=1.5),
+                               ref(x, np.float32(1.5)).astype("f4"),
+                               rtol=1e-5)
+
+
+def test_camelcase_legacy_binary_names():
+    a = RNG.uniform(0.5, 2, (2, 2)).astype("f4")
+    b = RNG.uniform(0.5, 2, (2, 2)).astype("f4")
+    np.testing.assert_allclose(_inv("_Mul", [a, b]), a * b, rtol=1e-6)
+    np.testing.assert_allclose(_inv("_Div", [a, b]), a / b, rtol=1e-6)
+    np.testing.assert_allclose(_inv("_Minus", [a, b]), a - b, rtol=1e-6)
+    np.testing.assert_allclose(_inv("_Power", [a, b]), np.power(a, b),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_inv("_Hypot", [a, b]), np.hypot(a, b),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_inv("_MulScalar", [a], scalar=2.0), a * 2)
+    np.testing.assert_allclose(_inv("_RDivScalar", [a], scalar=2.0), 2 / a,
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def test_reductions_vs_numpy():
+    x = RNG.randn(3, 4, 5).astype("f4")
+    xn = x.copy()
+    xn[0, 0, 0] = np.nan
+    np.testing.assert_allclose(_inv("nansum", [xn], axis=1),
+                               np.nansum(xn, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(_inv("nanprod", [xn], axis=2),
+                               np.nanprod(xn, axis=2), rtol=1e-5)
+    np.testing.assert_allclose(_inv("max_axis", [x], axis=1),
+                               x.max(axis=1))
+    np.testing.assert_allclose(_inv("min_axis", [x], axis=0),
+                               x.min(axis=0))
+    np.testing.assert_allclose(_inv("sum_axis", [x], axis=2),
+                               x.sum(axis=2), rtol=1e-5)
+    np.testing.assert_allclose(_inv("square_sum", [x], axis=1),
+                               (x ** 2).sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(_inv("argmin", [x], axis=1),
+                               x.argmin(axis=1).astype("f4"))
+    # argmax_channel: argmax over the trailing axis of a 2-D input
+    x2 = RNG.randn(4, 6).astype("f4")
+    np.testing.assert_allclose(_inv("argmax_channel", [x2]),
+                               x2.argmax(axis=-1).astype("f4"))
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing
+# ---------------------------------------------------------------------------
+
+def test_shape_index_ops_vs_numpy():
+    x = RNG.randn(2, 3, 4).astype("f4")
+    np.testing.assert_allclose(_inv("repeat", [x], repeats=2, axis=1),
+                               np.repeat(x, 2, axis=1))
+    np.testing.assert_allclose(_inv("reverse", [x], axis=1),
+                               x[:, ::-1, :])
+    np.testing.assert_allclose(_inv("shape_array", [x]),
+                               np.array([2, 3, 4]))
+    assert _inv("size_array", [x]).item() == 24
+    np.testing.assert_allclose(
+        _inv("broadcast_like", [x[:, :1, :], x]),
+        np.broadcast_to(x[:, :1, :], x.shape))
+    np.testing.assert_allclose(
+        _inv("slice_like", [RNG.randn(4, 6).astype("f4")[:2, :3],
+                            np.zeros((2, 3), "f4")]).shape, (2, 3))
+    # gather_nd / scatter_nd round trip
+    data = RNG.randn(4, 5).astype("f4")
+    idx = np.array([[0, 2, 3], [1, 4, 0]], dtype="f4")  # (2, n)
+    picked = _inv("gather_nd", [data, idx])
+    np.testing.assert_allclose(picked, data[[0, 2, 3], [1, 4, 0]])
+    scat = _inv("scatter_nd", [mx.nd.array(picked).asnumpy(), idx],
+                shape=(4, 5))
+    np.testing.assert_allclose(scat[[0, 2, 3], [1, 4, 0]], picked)
+    # ravel/unravel
+    mi = np.array([[1, 2], [3, 1]], dtype="f4")  # (ndim, n)
+    flat = _inv("ravel_multi_index", [mi], shape=(5, 4))
+    np.testing.assert_allclose(flat, np.ravel_multi_index(
+        mi.astype("i8"), (5, 4)).astype("f4"))
+    back = _inv("unravel_index", [flat], shape=(5, 4))
+    np.testing.assert_allclose(back, mi)
+    # space_to_depth
+    sd = RNG.randn(1, 2, 4, 6).astype("f4")
+    out = _inv("space_to_depth", [sd], block_size=2)
+    assert out.shape == (1, 8, 2, 3)
+    rt = _inv("depth_to_space", [out], block_size=2)
+    np.testing.assert_allclose(rt, sd)
+
+
+def test_stop_gradient_blocks_grad():
+    x = mx.nd.array(np.ones((2, 2), "f4"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (mx.nd.invoke("stop_gradient", [x], {}) * x).sum()
+    y.backward()
+    # d/dx [sg(x) * x] = sg(x) = 1 (not 2x = 2)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones((2, 2)))
+
+
+def test_grad_add_matches_add():
+    a = RNG.randn(3, 3).astype("f4")
+    b = RNG.randn(3, 3).astype("f4")
+    np.testing.assert_allclose(_inv("_grad_add", [a, b]), a + b, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops invoked directly by registry name
+# ---------------------------------------------------------------------------
+
+def test_sgd_update_op_direct():
+    w = RNG.randn(4).astype("f4")
+    g = RNG.randn(4).astype("f4")
+    out = _inv("sgd_update", [w, g], lr=0.1, wd=0.0, rescale_grad=1.0)
+    np.testing.assert_allclose(out, w - 0.1 * g, rtol=1e-6)
+
+
+def test_mp_sgd_update_keeps_master_precision():
+    w16 = np.array([1.0, 2.0], dtype=np.float16)
+    g16 = np.array([0.5, 0.5], dtype=np.float16)
+    w32 = w16.astype("f4")
+    outs = mx.nd.invoke("mp_sgd_update",
+                        [mx.nd.array(w16, dtype="float16"),
+                         mx.nd.array(g16, dtype="float16"),
+                         mx.nd.array(w32)],
+                        {"lr": 0.1, "wd": 0.0, "rescale_grad": 1.0})
+    out = outs[0] if isinstance(outs, (list, tuple)) else outs
+    np.testing.assert_allclose(out.asnumpy().astype("f4"),
+                               w32 - 0.1 * g16.astype("f4"), atol=1e-3)

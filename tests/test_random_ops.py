@@ -1,0 +1,161 @@
+"""Statistical tests for the random/sample operator family (reference
+src/operator/random/sample_op.cc — tested upstream in test_operator.py's
+test_*_generator cases via moment checks). Moments at n=20k with loose
+tolerances; determinism via mx.random.seed."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N = (200, 100)          # 20k draws
+
+
+def _draw(name, **params):
+    return mx.nd.invoke(name, [], dict(params, shape=N)).asnumpy()
+
+
+def test_uniform_moments_and_range():
+    x = _draw("_random_uniform", low=-2.0, high=3.0)
+    assert x.min() >= -2.0 and x.max() < 3.0
+    assert abs(x.mean() - 0.5) < 0.1           # (low+high)/2
+    assert abs(x.var() - 25 / 12.0) < 0.15     # (high-low)^2/12
+
+
+def test_normal_moments():
+    x = _draw("_random_normal", loc=1.5, scale=2.0)
+    assert abs(x.mean() - 1.5) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_gaussian_alias_matches_normal_api():
+    mx.random.seed(3)
+    a = _draw("_random_gaussian", loc=0.0, scale=1.0)
+    assert abs(a.mean()) < 0.05
+
+
+def test_gamma_moments():
+    x = _draw("_random_gamma", alpha=3.0, beta=2.0)
+    # mxnet convention: mean = alpha*beta, var = alpha*beta^2
+    assert abs(x.mean() - 6.0) < 0.3
+    assert abs(x.var() - 12.0) < 1.5
+    assert x.min() > 0
+
+
+def test_exponential_moments():
+    x = _draw("_random_exponential", lam=2.0)
+    assert abs(x.mean() - 0.5) < 0.05          # 1/lam
+    assert x.min() >= 0
+
+
+def test_poisson_moments():
+    x = _draw("_random_poisson", lam=4.0)
+    assert abs(x.mean() - 4.0) < 0.2
+    assert abs(x.var() - 4.0) < 0.5
+    np.testing.assert_allclose(x, np.round(x))  # integral support
+
+
+def test_negative_binomial_moments():
+    k, p = 5, 0.4
+    x = _draw("_random_negative_binomial", k=k, p=p)
+    mean = k * (1 - p) / p
+    var = mean / p
+    assert abs(x.mean() - mean) < 0.4
+    assert abs(x.var() - var) < 2.5
+    assert x.min() >= 0
+
+
+def test_generalized_negative_binomial_moments():
+    mu, alpha = 3.0, 0.5
+    x = _draw("_random_generalized_negative_binomial", mu=mu, alpha=alpha)
+    assert abs(x.mean() - mu) < 0.3
+    assert abs(x.var() - (mu + alpha * mu * mu)) < 1.5
+
+
+def test_randint_bounds_and_coverage():
+    x = _draw("_random_randint", low=2, high=7, dtype="int32")
+    assert x.min() >= 2 and x.max() < 7
+    assert set(np.unique(x)) == {2, 3, 4, 5, 6}
+
+
+def test_seed_determinism_across_ops():
+    mx.random.seed(42)
+    a = _draw("_random_normal", loc=0.0, scale=1.0)
+    b = _draw("_random_gamma", alpha=2.0, beta=1.0)
+    mx.random.seed(42)
+    a2 = _draw("_random_normal", loc=0.0, scale=1.0)
+    b2 = _draw("_random_gamma", alpha=2.0, beta=1.0)
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(b, b2)
+    # and a different seed gives a different stream
+    mx.random.seed(43)
+    a3 = _draw("_random_normal", loc=0.0, scale=1.0)
+    assert not np.array_equal(a, a3)
+
+
+# ---------------------------------------------------------------------------
+# _sample_*: per-row distribution parameters
+# ---------------------------------------------------------------------------
+
+def test_sample_uniform_per_row_params():
+    low = mx.nd.array([0.0, 10.0])
+    high = mx.nd.array([1.0, 20.0])
+    x = mx.nd.invoke("_sample_uniform", [low, high],
+                     {"shape": (5000,)}).asnumpy()
+    assert x.shape == (2, 5000)
+    assert 0 <= x[0].min() and x[0].max() < 1
+    assert 10 <= x[1].min() and x[1].max() < 20
+
+
+def test_sample_normal_per_row_params():
+    mu = mx.nd.array([0.0, 50.0])
+    sigma = mx.nd.array([1.0, 5.0])
+    x = mx.nd.invoke("_sample_normal", [mu, sigma],
+                     {"shape": (8000,)}).asnumpy()
+    assert abs(x[0].mean()) < 0.1 and abs(x[0].std() - 1) < 0.1
+    assert abs(x[1].mean() - 50) < 0.5 and abs(x[1].std() - 5) < 0.4
+
+
+def test_sample_gamma_per_row_params():
+    alpha = mx.nd.array([2.0, 9.0])
+    beta = mx.nd.array([1.0, 0.5])
+    x = mx.nd.invoke("_sample_gamma", [alpha, beta],
+                     {"shape": (8000,)}).asnumpy()
+    assert abs(x[0].mean() - 2.0) < 0.25
+    assert abs(x[1].mean() - 4.5) < 0.4
+
+
+def test_sample_multinomial_frequencies_and_probs():
+    p = mx.nd.array([[0.1, 0.6, 0.3]])
+    draws = mx.nd.invoke("_sample_multinomial", [p],
+                         {"shape": (8000,)}).asnumpy()[0]
+    freq = np.bincount(draws.astype("i8"), minlength=3) / draws.size
+    np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.03)
+    out = mx.nd.invoke("_sample_multinomial", [p],
+                       {"shape": (10,), "get_prob": True})
+    sample, logp = out[0].asnumpy()[0], out[1].asnumpy()[0]
+    np.testing.assert_allclose(
+        np.exp(logp), np.array([0.1, 0.6, 0.3])[sample.astype("i8")],
+        rtol=1e-4)
+
+
+def test_shuffle_is_permutation():
+    x = np.arange(512, dtype="f4")
+    y = mx.nd.invoke("_shuffle", [mx.nd.array(x)], {}).asnumpy()
+    assert not np.array_equal(y, x)
+    np.testing.assert_array_equal(np.sort(y), x)
+
+
+def test_sample_unique_zipfian_properties():
+    out = mx.nd.invoke("_sample_unique_zipfian", [],
+                       {"range_max": 1000, "shape": (1, 64)})
+    samples, num_tries = out[0].asnumpy(), out[1].asnumpy()
+    # rejection sampling needs >= num_sampled draws
+    assert num_tries.shape == (1,) and num_tries[0] >= 64
+    row = samples[0]
+    assert row.shape == (64,)
+    assert len(np.unique(row)) == 64            # unique within a row
+    assert row.min() >= 0 and row.max() < 1000
+    # zipfian skew: small ids must dominate a large-id band of equal width
+    lo = (row < 100).sum()
+    hi = ((row >= 800) & (row < 900)).sum()
+    assert lo > hi
