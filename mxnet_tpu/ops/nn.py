@@ -714,12 +714,50 @@ def upsampling(*data, scale=2, sample_type="nearest", num_args=1,
     raise NotImplementedError("bilinear UpSampling via Deconvolution")
 
 
+def _interp_axis_align_corners(x, out_len, axis):
+    """1-D linear interpolation along `axis` with the reference's
+    align-corners ratio (bilinear_resize.cc:69: rwidth = (in-1)/(out-1);
+    jax.image.resize uses half-pixel centers, which the reference kernel
+    does NOT)."""
+    in_len = x.shape[axis]
+    if out_len == in_len:
+        return x
+    if out_len > 1 and in_len > 1:
+        pos = jnp.arange(out_len, dtype=jnp.float32) \
+            * ((in_len - 1) / (out_len - 1))
+    else:
+        pos = jnp.zeros((out_len,), jnp.float32)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_len - 1)
+    t = pos - lo
+    shape = [1] * x.ndim
+    shape[axis] = out_len
+    t = t.reshape(shape).astype(x.dtype)
+    return jnp.take(x, lo, axis=axis) * (1 - t) \
+        + jnp.take(x, hi, axis=axis) * t
+
+
 @register("_contrib_BilinearResize2D")
 def bilinear_resize(data, *, height=0, width=0, scale_height=None, scale_width=None):
     n, c, h, w = data.shape
     oh = height or int(h * scale_height)
     ow = width or int(w * scale_width)
-    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+    out = _interp_axis_align_corners(data, oh, 2)
+    return _interp_axis_align_corners(out, ow, 3)
+
+
+def _adaptive_pool_matrix(in_len, out_len, dtype):
+    """Averaging matrix A (out,in): A[i,j] = 1/len(win_i) for j in the
+    reference's variable window [floor(i*in/out), ceil((i+1)*in/out))
+    (contrib/adaptive_avg_pooling.cc). Dense matmul form: exact for any
+    size ratio and XLA/MXU-friendly."""
+    import numpy as _np
+    a = _np.zeros((out_len, in_len), _np.float32)
+    for i in range(out_len):
+        s = (i * in_len) // out_len
+        e = -(-((i + 1) * in_len) // out_len)   # ceil
+        a[i, s:e] = 1.0 / (e - s)
+    return jnp.asarray(a, dtype)
 
 
 @register("_contrib_AdaptiveAvgPooling2D")
@@ -732,7 +770,9 @@ def adaptive_avg_pool(data, *, output_size=1):
     if h % oh == 0 and w % ow == 0:
         x = data.reshape(n, c, oh, h // oh, ow, w // ow)
         return x.mean(axis=(3, 5))
-    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+    ah = _adaptive_pool_matrix(h, oh, data.dtype)     # (oh, h)
+    aw = _adaptive_pool_matrix(w, ow, data.dtype)     # (ow, w)
+    return jnp.einsum("oh,nchw,pw->ncop", ah, data, aw)
 
 
 # ---------------------------------------------------------------------------

@@ -148,21 +148,34 @@ def sample_unique_zipfian(*, range_max, shape=(1, 1)):
     # round-trip per draw
     blk = min(max(64, 2 * n), 8192)
 
+    sentinel = jnp.asarray(range_max, idt)   # > every valid sample
+
     def one_row(key):
+        # carry: (count, tries, buf insertion-ordered, sset sorted+padded,
+        # key) — O(n) state per row; membership is a searchsorted against
+        # sset, in-block dedup a stable sort, so nothing scales with
+        # range_max or blk^2
         def cond(st):
             return st[0] < n
 
         def body(st):
-            count, tries, mask, buf, key = st
+            count, tries, buf, sset, key = st
             key, sub = jax.random.split(key)
             x = jax.random.uniform(sub, (blk,))
             vals = jnp.clip(
                 jnp.round(jnp.exp(x * log_rm)).astype(idt) - 1,
                 0, range_max - 1)
-            # first occurrence within the block (earlier duplicate kills
-            # later ones), then not already in the hit-mask
-            dup_earlier = jnp.tril(vals[None, :] == vals[:, None], -1)
-            is_new = ~jnp.any(dup_earlier, axis=1) & ~mask[vals]
+            # first DRAWN occurrence within the block: stable sort groups
+            # equal values with original draw order preserved, the head of
+            # each run is the first occurrence
+            order = jnp.argsort(vals, stable=True)
+            svals = vals[order]
+            head = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                    svals[1:] != svals[:-1]])
+            first_occ = jnp.zeros((blk,), jnp.bool_).at[order].set(head)
+            in_prior = sset[
+                jnp.clip(jnp.searchsorted(sset, vals), 0, n - 1)] == vals
+            is_new = first_occ & ~in_prior
             # set size after each draw if applied in order; the loop
             # "stops" at the draw that fills the set — later proposals
             # were never drawn in the reference's sequential semantics
@@ -170,18 +183,18 @@ def sample_unique_zipfian(*, range_max, shape=(1, 1)):
             apply = is_new & (pos <= n)
             slot = jnp.where(apply, pos - 1, n)     # n = OOB -> dropped
             buf = buf.at[slot].set(vals, mode="drop")
-            mask = mask.at[jnp.where(apply, vals, range_max)].set(
-                True, mode="drop")
+            merged = jnp.concatenate(
+                [sset, jnp.where(apply, vals, sentinel)])
+            sset = jnp.sort(merged)[:n]
             filled = pos[-1] >= n
             # index of the filling draw (argmax finds the first True)
             t_fill = jnp.argmax(pos >= n)
             tries = tries + jnp.where(filled, t_fill + 1, blk)
-            return (jnp.minimum(pos[-1], n), tries, mask, buf, key)
+            return (jnp.minimum(pos[-1], n), tries, buf, sset, key)
 
-        init = (jnp.int32(0), jnp.int32(0),
-                jnp.zeros((range_max,), jnp.bool_),
-                jnp.zeros((n,), idt), key)
-        count, tries, _, buf, _ = jax.lax.while_loop(cond, body, init)
+        init = (jnp.int32(0), jnp.int32(0), jnp.zeros((n,), idt),
+                jnp.full((n,), sentinel, idt), key)
+        count, tries, buf, _, _ = jax.lax.while_loop(cond, body, init)
         return buf, tries.astype(idt)
 
     keys = jax.random.split(_random.next_key(), batch)

@@ -1,0 +1,149 @@
+"""Numeric tests for contrib ops with no prior direct coverage: fft/ifft,
+count_sketch, index_copy, quadratic, boolean_mask, getnnz, box_iou,
+box_nms, div_sqrt_dim, AdaptiveAvgPooling2D, BilinearResize2D (reference
+tests/python/unittest/test_contrib_operator.py / test_operator.py cases
+re-expressed)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RNG = np.random.RandomState(11)
+
+
+def _inv(name, arrs, **kw):
+    out = mx.nd.invoke(name, [mx.nd.array(a) for a in arrs], kw)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    return out.asnumpy()
+
+
+def test_fft_ifft_roundtrip_and_values():
+    x = RNG.randn(3, 8).astype("f4")
+    packed = _inv("_contrib_fft", [x])
+    assert packed.shape == (3, 16)
+    want = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(packed[:, 0::2], want.real, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(packed[:, 1::2], want.imag, rtol=1e-4,
+                               atol=1e-4)
+    # reference ifft scales by n (contrib/fft-inl.h backward convention)
+    back = _inv("_contrib_ifft", [packed])
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch_unbiased_dot_product():
+    """Count-sketch preserves dot products in expectation; with a single
+    (h, s) draw we check the defining identity: sketch(x) . sketch(y)
+    computed with the same hashes equals sum_j s_j^2 x_j y_j grouped by
+    buckets — verified against a direct numpy sketch."""
+    in_dim, out_dim = 32, 16
+    x = RNG.randn(2, in_dim).astype("f4")
+    h = RNG.randint(0, out_dim, (1, in_dim)).astype("f4")
+    s = np.sign(RNG.randn(1, in_dim)).astype("f4")
+    got = mx.nd.invoke("_contrib_count_sketch",
+                       [mx.nd.array(x), mx.nd.array(h), mx.nd.array(s)],
+                       {"out_dim": out_dim}).asnumpy()
+    want = np.zeros((2, out_dim), "f4")
+    for j in range(in_dim):
+        want[:, int(h[0, j])] += s[0, j] * x[:, j]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_index_copy():
+    old = np.zeros((5, 3), "f4")
+    new = RNG.randn(2, 3).astype("f4")
+    idx = np.array([3, 0], "f4")
+    got = _inv("_contrib_index_copy", [old, idx, new])
+    want = old.copy()
+    want[3] = new[0]
+    want[0] = new[1]
+    np.testing.assert_allclose(got, want)
+
+
+def test_quadratic_and_grad():
+    x = mx.nd.array(RNG.randn(4).astype("f4"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.invoke("_contrib_quadratic", [x],
+                         {"a": 2.0, "b": -1.0, "c": 0.5}).sum()
+    y.backward()
+    xn = x.asnumpy()
+    np.testing.assert_allclose(x.grad.asnumpy(), 4 * xn - 1, rtol=1e-5)
+
+
+def test_div_sqrt_dim():
+    x = RNG.randn(2, 9).astype("f4")
+    np.testing.assert_allclose(_inv("_contrib_div_sqrt_dim", [x]),
+                               x / 3.0, rtol=1e-6)
+
+
+def test_boolean_mask_compacts_kept_rows():
+    data = np.arange(12, dtype="f4").reshape(4, 3)
+    mask = np.array([1, 0, 1, 0], "f4")
+    got = _inv("_contrib_boolean_mask", [data, mask])
+    # static-shape contract: kept rows first, zero padding after
+    np.testing.assert_allclose(got[:2], data[[0, 2]])
+    np.testing.assert_allclose(got[2:], 0)
+
+
+def test_getnnz_dense():
+    x = np.array([[0, 1, 2], [0, 0, 3]], "f4")
+    assert _inv("_contrib_getnnz", [x]).item() == 3
+
+
+def test_box_iou_matches_manual():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "f4")
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], "f4")
+    got = _inv("_contrib_box_iou", [a, b])
+    assert got.shape == (2, 2)
+    np.testing.assert_allclose(got[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1], 0.0, atol=1e-7)
+    # boxes [1,1,3,3] vs [2,2,4,4]: inter 1, union 7
+    np.testing.assert_allclose(got[1, 1], 1 / 7, rtol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [class_id, score, x1, y1, x2, y2]
+    boxes = np.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],   # heavy overlap with row 0
+        [0, 0.7, 5, 5, 7, 7],           # far away
+    ], "f4")
+    out = _inv("_contrib_box_nms", [boxes],
+               overlap_thresh=0.5, coord_start=2, score_index=1,
+               id_index=0)
+    scores = out[:, 1]
+    assert (scores == 0.9).any() and (scores == 0.7).any()
+    assert not (scores == 0.8).any()      # suppressed -> -1 row
+    assert (out == -1).any()
+
+
+def test_adaptive_avg_pooling():
+    x = RNG.randn(1, 2, 4, 4).astype("f4")
+    got = _inv("_contrib_AdaptiveAvgPooling2D", [x], output_size=(2, 2))
+    want = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # global: output_size 1 == full mean
+    got1 = _inv("_contrib_AdaptiveAvgPooling2D", [x], output_size=(1, 1))
+    np.testing.assert_allclose(got1[..., 0, 0], x.mean(axis=(2, 3)),
+                               rtol=1e-5)
+
+
+def test_adaptive_avg_pooling_non_divisible_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(2, 3, 7, 5).astype("f4")
+    got = _inv("_contrib_AdaptiveAvgPooling2D", [x], output_size=(3, 2))
+    want = torch.nn.functional.adaptive_avg_pool2d(
+        torch.from_numpy(x), (3, 2)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_resize_corners_and_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(1, 1, 5, 5).astype("f4")
+    got = _inv("_contrib_BilinearResize2D", [x], height=9, width=9)
+    want = torch.nn.functional.interpolate(
+        torch.from_numpy(x), size=(9, 9), mode="bilinear",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
