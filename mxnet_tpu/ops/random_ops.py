@@ -129,9 +129,11 @@ def sample_unique_zipfian(*, range_max, shape=(1, 1)):
     proposal distribution, plus the number of tries it took — the
     sampled-softmax helper (reference
     src/operator/random/unique_sample_op.h:109-136 rejection loop).
-    TPU form: a vmapped ``lax.while_loop`` drawing one proposal per
-    iteration against a hit-mask — identical semantics (exact uniques,
-    exact try counts per row), no host-side set.
+    TPU form: a vmapped ``lax.while_loop`` drawing a vectorized block of
+    proposals per iteration, deduped by stable sort and checked against
+    an O(n) sorted-set carry — identical semantics (exact uniques, exact
+    try counts per row: draws past the filling one "never happened"),
+    nothing scaling with range_max, no host-side set.
     """
     shape = tuple(shape)
     if len(shape) == 1:

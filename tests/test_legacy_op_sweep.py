@@ -1,0 +1,222 @@
+"""Numeric tests for legacy v1 ops with no prior direct coverage:
+Sequence{Mask,Last,Reverse}, UpSampling, LRN, L2Normalization,
+SoftmaxActivation, SliceChannel, SwapAxis, BlockGrad, Cast, the
+regression output heads, SVMOutput, and the STN trio
+GridGenerator/BilinearSampler/SpatialTransformer (reference
+tests/python/unittest/test_operator.py cases re-expressed)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RNG = np.random.RandomState(5)
+
+
+def _inv(name, arrs, **kw):
+    out = mx.nd.invoke(name, [mx.nd.array(a) for a in arrs], kw)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    return out.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (time-major (T, B, ...), per-batch lengths)
+# ---------------------------------------------------------------------------
+
+def test_sequence_mask_lengths_and_value():
+    x = RNG.randn(4, 3, 2).astype("f4")
+    lens = np.array([2, 4, 1], "f4")
+    got = _inv("SequenceMask", [x, lens], use_sequence_length=True,
+               value=-7.0)
+    want = x.copy()
+    for b, L in enumerate(lens.astype(int)):
+        want[L:, b] = -7.0
+    np.testing.assert_allclose(got, want)
+    # without lengths: identity
+    np.testing.assert_allclose(_inv("SequenceMask", [x]), x)
+
+
+def test_sequence_last_lengths():
+    x = RNG.randn(5, 3, 2).astype("f4")
+    lens = np.array([1, 5, 3], "f4")
+    got = _inv("SequenceLast", [x, lens], use_sequence_length=True)
+    want = np.stack([x[0, 0], x[4, 1], x[2, 2]])
+    np.testing.assert_allclose(got, want)
+    np.testing.assert_allclose(_inv("SequenceLast", [x]), x[-1])
+
+
+def test_sequence_reverse_lengths():
+    x = RNG.randn(4, 2, 3).astype("f4")
+    lens = np.array([3, 4], "f4")
+    got = _inv("SequenceReverse", [x, lens], use_sequence_length=True)
+    want = x.copy()
+    for b, L in enumerate(lens.astype(int)):
+        want[:L, b] = x[:L, b][::-1]
+    np.testing.assert_allclose(got, want)
+    np.testing.assert_allclose(_inv("SequenceReverse", [x]), x[::-1])
+
+
+# ---------------------------------------------------------------------------
+# spatial/shape ops
+# ---------------------------------------------------------------------------
+
+def test_upsampling_nearest():
+    x = RNG.randn(2, 3, 4, 4).astype("f4")
+    got = _inv("UpSampling", [x], scale=2, sample_type="nearest")
+    want = np.repeat(np.repeat(x, 2, axis=2), 2, axis=3)
+    np.testing.assert_allclose(got, want)
+
+
+def test_lrn_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(2, 8, 5, 5).astype("f4")
+    nsize, alpha, beta, k = 5, 1e-3, 0.75, 2.0
+    got = _inv("LRN", [x], nsize=nsize, alpha=alpha, beta=beta, knorm=k)
+    want = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), size=nsize, alpha=alpha, beta=beta,
+        k=k).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_l2_normalization_modes():
+    x = RNG.randn(2, 3, 4).astype("f4")
+    got = _inv("L2Normalization", [x], mode="instance")
+    want = x / np.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got = _inv("L2Normalization", [x], mode="channel")
+    want = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got = _inv("L2Normalization", [x], mode="spatial")
+    want = x / np.sqrt((x ** 2).sum(axis=2, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_softmax_activation_channel_mode():
+    x = RNG.randn(2, 4, 3, 3).astype("f4")
+    got = _inv("SoftmaxActivation", [x], mode="channel")
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(axis=1, keepdims=True),
+                               rtol=1e-5)
+    # instance mode flattens trailing dims
+    x2 = RNG.randn(3, 6).astype("f4")
+    got2 = _inv("SoftmaxActivation", [x2])
+    e2 = np.exp(x2 - x2.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(got2, e2 / e2.sum(axis=1, keepdims=True),
+                               rtol=1e-5)
+
+
+def test_slice_channel_and_squeeze():
+    x = RNG.randn(2, 6, 3).astype("f4")
+    outs = mx.nd.invoke("SliceChannel", [mx.nd.array(x)],
+                        {"num_outputs": 3, "axis": 1})
+    assert len(outs) == 3
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.asnumpy(), x[:, 2 * i:2 * i + 2, :])
+    outs = mx.nd.invoke("SliceChannel", [mx.nd.array(x)],
+                        {"num_outputs": 6, "axis": 1,
+                         "squeeze_axis": True})
+    assert outs[0].shape == (2, 3)
+    np.testing.assert_allclose(outs[4].asnumpy(), x[:, 4, :])
+
+
+def test_swapaxis_and_cast():
+    x = RNG.randn(2, 3, 4).astype("f4")
+    np.testing.assert_allclose(_inv("SwapAxis", [x], dim1=0, dim2=2),
+                               np.swapaxes(x, 0, 2))
+    got = mx.nd.invoke("Cast", [mx.nd.array(x)], {"dtype": "int32"})
+    assert got.dtype == np.int32
+    np.testing.assert_allclose(got.asnumpy(), x.astype("i4"))
+
+
+def test_block_grad_stops_gradient():
+    x = mx.nd.array(np.full((3,), 2.0, "f4"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (mx.nd.invoke("BlockGrad", [x], {}) * x * x).sum()
+    y.backward()
+    # d/dx [bg(x) * x^2] = 2 * bg(x) * x = 8 (the bg(x)=x factor is held)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((3,), 8.0))
+
+
+# ---------------------------------------------------------------------------
+# output heads: forward + injected gradients
+# ---------------------------------------------------------------------------
+
+def _head_grad(name, data, label, **kw):
+    d = mx.nd.array(data)
+    d.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.invoke(name, [d, mx.nd.array(label)], kw)
+    out.backward()
+    return out.asnumpy(), d.grad.asnumpy()
+
+
+def test_linear_regression_output_grad():
+    data = RNG.randn(4, 3).astype("f4")
+    label = RNG.randn(4, 3).astype("f4")
+    out, grad = _head_grad("LinearRegressionOutput", data, label)
+    np.testing.assert_allclose(out, data)
+    np.testing.assert_allclose(grad, (data - label) / 3, rtol=1e-5)
+
+
+def test_mae_regression_output_grad():
+    data = RNG.randn(4, 3).astype("f4")
+    label = RNG.randn(4, 3).astype("f4")
+    out, grad = _head_grad("MAERegressionOutput", data, label)
+    np.testing.assert_allclose(out, data)
+    np.testing.assert_allclose(grad, np.sign(data - label) / 3)
+
+
+def test_logistic_regression_output_grad():
+    data = RNG.randn(4, 1).astype("f4")
+    label = RNG.randint(0, 2, (4, 1)).astype("f4")
+    out, grad = _head_grad("LogisticRegressionOutput", data, label)
+    sig = 1 / (1 + np.exp(-data))
+    np.testing.assert_allclose(out, sig, rtol=1e-5)
+    np.testing.assert_allclose(grad, sig - label, rtol=1e-5, atol=1e-6)
+
+
+def test_svm_output_hinge_grad():
+    data = np.array([[2.0, -2.0], [0.2, -0.2]], "f4")  # row0 satisfied
+    label = np.array([0, 0], "f4")
+    out, grad = _head_grad("SVMOutput", data, label, margin=1.0,
+                           use_linear=True)
+    np.testing.assert_allclose(out, data)
+    np.testing.assert_allclose(grad[0], [0, 0])          # margin met
+    np.testing.assert_allclose(grad[1], [-1.0, 1.0])     # violations
+
+
+# ---------------------------------------------------------------------------
+# STN trio
+# ---------------------------------------------------------------------------
+
+def test_grid_generator_affine_identity():
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], "f4"), (2, 1))
+    grid = _inv("GridGenerator", [theta], transform_type="affine",
+                target_shape=(3, 5))
+    assert grid.shape == (2, 2, 3, 5)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5),
+                               rtol=1e-5)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               rtol=1e-5)
+
+
+def test_spatial_transformer_identity_and_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.randn(2, 3, 6, 6).astype("f4")
+    ident = np.tile(np.array([1, 0, 0, 0, 1, 0], "f4"), (2, 1))
+    got = _inv("SpatialTransformer", [x, ident], target_shape=(6, 6),
+               transform_type="affine", sampler_type="bilinear")
+    np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-5)
+    # a real affine vs torch grid_sample(align_corners=True)
+    theta = np.tile(np.array([0.8, 0.1, 0.05, -0.1, 0.9, -0.05], "f4"),
+                    (2, 1))
+    got = _inv("SpatialTransformer", [x, theta], target_shape=(5, 4),
+               transform_type="affine", sampler_type="bilinear")
+    tg = torch.nn.functional.affine_grid(
+        torch.from_numpy(theta.reshape(2, 2, 3)), (2, 3, 5, 4),
+        align_corners=True)
+    want = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), tg, mode="bilinear", padding_mode="zeros",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
