@@ -41,13 +41,14 @@ __all__ = ["ImageRecordIter"]
 def _build_augmenter(data_shape, resize=-1, rand_crop=False,
                      rand_mirror=False, mirror=False, mean_r=0.0, mean_g=0.0,
                      mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
-                     inter_method=1):
+                     pad=0, fill_value=255, inter_method=1):
     """numpy/cv2 sample transform: HWC BGR uint8 -> CHW float32.
 
     Mirrors the reference DefaultImageAugmenter's core parameters
-    (src/io/image_aug_default.cc): short-side resize, random/center crop,
-    horizontal mirror, per-channel mean/std, scale. Output is RGB (the
-    reference decodes to RGB by default).
+    (src/io/image_aug_default.cc): short-side resize, border pad (the
+    CIFAR pad-4 recipe), random/center crop, horizontal mirror,
+    per-channel mean/std, scale. Output is RGB (the reference decodes to
+    RGB by default).
     """
     import cv2
     _, th, tw = data_shape
@@ -65,6 +66,14 @@ def _build_augmenter(data_shape, resize=-1, rand_crop=False,
             if (nh, nw) != (h, w):
                 img = cv2.resize(img, (nw, nh), interpolation=inter_method)
                 h, w = nh, nw
+        if pad > 0:
+            # AFTER resize, matching the reference augmenter order
+            # (image_aug_default.cc: resize happens before the pad/crop
+            # stage, so the border stays a crisp `pad`-pixel ring)
+            img = cv2.copyMakeBorder(img, pad, pad, pad, pad,
+                                     cv2.BORDER_CONSTANT,
+                                     value=[fill_value] * 3)
+            h, w = img.shape[:2]
         if h < th or w < tw:  # upscale tiny inputs so the crop fits
             img = cv2.resize(img, (max(tw, w), max(th, h)),
                              interpolation=inter_method)
@@ -236,6 +245,10 @@ class ImageRecordIter:
         lo = part_index * n // num_parts
         hi = (part_index + 1) * n // num_parts
         self._indices = _np.arange(lo, hi)
+        # batches per epoch (shuffle reorders but never changes the count)
+        shard = hi - lo
+        self.num_batches = (-(-shard // batch_size) if round_batch
+                            else shard // batch_size)
         self._aug = _build_augmenter(self.data_shape, **aug_params)
         self._nthreads = max(1, preprocess_threads)
         self._depth = max(2, prefetch_buffer)

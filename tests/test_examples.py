@@ -24,6 +24,12 @@ def test_train_mnist_example():
     assert "final validation" in r.stdout
 
 
+def test_train_cifar10_example():
+    r = _run("train_cifar10.py", ["--num-epochs", "1", "--batch-size", "64",
+                                  "--num-layers", "20"])
+    assert "final accuracy" in r.stdout
+
+
 def test_gluon_cnn_example():
     r = _run("gluon_cnn.py", ["--num-epochs", "1"])
     assert "epoch 0" in r.stdout

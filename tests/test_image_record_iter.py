@@ -192,3 +192,44 @@ def test_host_arena_batches_match_plain_alloc(rec_file):
     assert len(pooled) == len(plain) and len(pooled) > 0
     for a, b in zip(pooled, plain):
         np.testing.assert_array_equal(a, b)
+
+
+def test_num_batches_attribute(rec_file):
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         round_batch=True)
+    assert it.num_batches == 5          # ceil(37/8)
+    assert sum(1 for _ in it) == 5
+    it.close()
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         round_batch=False)
+    assert it.num_batches == 4          # floor(37/8)
+    assert sum(1 for _ in it) == 4
+    it.close()
+
+
+def test_pad_then_crop_augmentation(rec_file):
+    # pad=4 then CENTER crop back to 32 recovers the original exactly
+    # (the reference pad/crop recipe is identity without rand_crop)
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         pad=4, fill_value=7, rand_crop=False)
+    batch = next(iter(it))
+    img = batch.data[0].asnumpy()[0]
+    lab = batch.label[0].asnumpy()[0]
+    color = (lab * 5) % 250
+    assert np.all(img == color)
+    it.close()
+    # RANDOM crop inside the padded canvas: pixels are only ever the
+    # color or the fill, and across a batch some crops hit the border
+    it = ImageRecordIter(rec_file, data_shape=(3, 32, 32), batch_size=8,
+                         pad=4, fill_value=7, rand_crop=True, seed=3)
+    batch = next(iter(it))
+    data = batch.data[0].asnumpy()
+    labels = batch.label[0].asnumpy()
+    fill_seen = False
+    for j in range(8):
+        c = float((labels[j] * 5) % 250)
+        vals = set(np.unique(data[j]))
+        assert vals.issubset({7.0, c})
+        fill_seen = fill_seen or 7.0 in vals
+    assert fill_seen        # at least one off-center crop hit the border
+    it.close()
