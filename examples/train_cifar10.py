@@ -60,7 +60,9 @@ def main():
     p = argparse.ArgumentParser(
         description="train cifar10",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
-    p.add_argument("--network", default="resnet")
+    p.add_argument("--network", default="resnet", choices=["resnet"],
+                   help="cifar script trains resnet only (reference "
+                        "default); train_imagenet.py has the other nets")
     p.add_argument("--num-layers", type=int, default=20,
                    help="cifar resnet depth: 20, 56 or 110")
     p.add_argument("--data-train", default=None,
@@ -113,9 +115,18 @@ def main():
             eval_end_callback=eval_cb,
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
 
-    if not accs:          # no val data at all: score the train set once
-        accs.append(dict(mod.score(train, mx.metric.Accuracy()))
+    if not accs:
+        # no val data: score the TRAIN .rec once through a clean
+        # (augmentation-free, deterministic) iterator
+        from mxnet_tpu.io import ImageRecordIter
+        clean = ImageRecordIter(
+            args.data_train, data_shape=(3, 28, 28),
+            batch_size=args.batch_size,
+            mean_r=RGB_MEAN[0], mean_g=RGB_MEAN[1], mean_b=RGB_MEAN[2],
+            std_r=RGB_STD[0], std_g=RGB_STD[1], std_b=RGB_STD[2])
+        accs.append(dict(mod.score(clean, mx.metric.Accuracy()))
                     ["accuracy"])
+        clean.close()
     print("final accuracy: %.4f" % accs[-1])
     if accs[-1] < 0.9:    # saturated runs can't self-compare
         check_improved("accuracy", accs, lower_is_better=False)

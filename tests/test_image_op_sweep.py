@@ -1,0 +1,140 @@
+"""Numeric tests for the _image_* operator family (reference
+src/operator/image/image_random-inl.h; upstream tested in
+test_gluon_data_vision.py). HWC uint8/float conventions, flips,
+normalize, crop/resize, and statistical behavior of the random jitters."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RNG = np.random.RandomState(3)
+
+
+def _inv(name, arrs, **kw):
+    out = mx.nd.invoke(name, [mx.nd.array(a) for a in arrs], kw)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    return out.asnumpy()
+
+
+def _img(h=6, w=5):
+    return RNG.randint(0, 255, (h, w, 3)).astype("uint8")
+
+
+def test_to_tensor_scales_and_transposes():
+    x = _img()
+    got = _inv("_image_to_tensor", [x])
+    assert got.shape == (3, 6, 5)
+    np.testing.assert_allclose(got, x.transpose(2, 0, 1) / 255.0,
+                               rtol=1e-6)
+
+
+def test_normalize_per_channel():
+    x = RNG.rand(3, 4, 4).astype("f4")
+    got = _inv("_image_normalize", [x], mean=(0.5, 0.4, 0.3),
+               std=(0.2, 0.25, 0.3))
+    want = (x - np.array([0.5, 0.4, 0.3]).reshape(3, 1, 1)) \
+        / np.array([0.2, 0.25, 0.3]).reshape(3, 1, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_flips_hwc():
+    x = _img()
+    np.testing.assert_allclose(_inv("_image_flip_left_right", [x]),
+                               x[:, ::-1])
+    np.testing.assert_allclose(_inv("_image_flip_top_bottom", [x]),
+                               x[::-1])
+
+
+def test_random_flip_is_identity_or_flip():
+    x = _img()
+    seen = set()
+    for _ in range(12):
+        got = _inv("_image_random_flip_left_right", [x])
+        if np.array_equal(got, x):
+            seen.add("id")
+        elif np.array_equal(got, x[:, ::-1]):
+            seen.add("flip")
+        else:
+            raise AssertionError("output is neither identity nor flip")
+    assert seen == {"id", "flip"}      # both outcomes occur
+
+
+def test_crop_and_resize():
+    x = _img(8, 8)
+    got = _inv("_image_crop", [x], x=2, y=1, width=4, height=5)
+    np.testing.assert_allclose(got, x[1:6, 2:6])
+    got = _inv("_image_resize", [x.astype("f4")], size=(4, 4))
+    assert got.shape == (4, 4, 3)
+    # constant image stays constant under any interpolation
+    const = np.full((8, 8, 3), 77.0, "f4")
+    np.testing.assert_allclose(_inv("_image_resize", [const],
+                                    size=(5, 3)), 77.0, rtol=1e-5)
+
+
+def test_random_brightness_bounds():
+    x = np.full((4, 4, 3), 100.0, "f4")
+    mx.random.seed(0)
+    for _ in range(8):
+        got = _inv("_image_random_brightness", [x], min_factor=0.5,
+                   max_factor=1.5)
+        f = got.mean() / 100.0
+        assert 0.5 - 1e-5 <= f <= 1.5 + 1e-5
+        # brightness is a pure scale: image stays constant
+        assert np.allclose(got, got.flat[0])
+
+
+def test_random_contrast_preserves_constant_gray():
+    # contrast blends toward the gray mean; a constant gray image is a
+    # fixed point for any factor
+    x = np.full((4, 4, 3), 90.0, "f4")
+    mx.random.seed(1)
+    got = _inv("_image_random_contrast", [x], min_factor=0.3,
+               max_factor=1.7)
+    np.testing.assert_allclose(got, x, rtol=1e-4)
+
+
+def test_random_saturation_preserves_gray():
+    # saturation blends toward per-pixel gray; already-gray pixels are
+    # fixed points
+    x = np.repeat(RNG.rand(4, 4, 1).astype("f4") * 200, 3, axis=2)
+    mx.random.seed(2)
+    got = _inv("_image_random_saturation", [x], min_factor=0.2,
+               max_factor=1.8)
+    np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-3)
+
+
+def test_random_hue_preserves_gray():
+    x = np.repeat(RNG.rand(4, 4, 1).astype("f4"), 3, axis=2)
+    mx.random.seed(3)
+    got = _inv("_image_random_hue", [x], min_factor=0.7, max_factor=1.3)
+    np.testing.assert_allclose(got, x, rtol=1e-3, atol=1e-3)
+
+
+def test_random_lighting_zero_std_is_identity():
+    x = RNG.rand(5, 5, 3).astype("f4")
+    got = _inv("_image_random_lighting", [x], alpha_std=0.0)
+    np.testing.assert_allclose(got, x, rtol=1e-6)
+
+
+def test_random_color_jitter_zero_is_identity():
+    x = RNG.rand(5, 5, 3).astype("f4") * 255
+    got = _inv("_image_random_color_jitter", [x], brightness=0.0,
+               contrast=0.0, saturation=0.0, hue=0.0)
+    np.testing.assert_allclose(got, x, rtol=1e-5)
+
+
+def test_gluon_vision_transforms_compose():
+    # the user-facing composition: ToTensor + Normalize through gluon
+    from mxnet_tpu.gluon.data.vision import transforms
+    t = transforms.Compose([transforms.ToTensor(),
+                            transforms.Normalize(0.5, 0.25)])
+    x = mx.nd.array(_img())
+    out = t(x).asnumpy()
+    assert out.shape == (3, 6, 5)
+    want = (_to_chw_float(x.asnumpy()) - 0.5) / 0.25
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def _to_chw_float(img):
+    return img.transpose(2, 0, 1).astype("f4") / 255.0
