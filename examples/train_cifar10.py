@@ -26,6 +26,15 @@ RGB_MEAN = (125.307, 122.961, 113.8575)
 RGB_STD = (51.5865, 50.847, 51.255)
 
 
+def eval_iter(path, args):
+    """Deterministic (augmentation-free) scoring iterator."""
+    from mxnet_tpu.io import ImageRecordIter
+    return ImageRecordIter(
+        path, data_shape=(3, 28, 28), batch_size=args.batch_size,
+        mean_r=RGB_MEAN[0], mean_g=RGB_MEAN[1], mean_b=RGB_MEAN[2],
+        std_r=RGB_STD[0], std_g=RGB_STD[1], std_b=RGB_STD[2])
+
+
 def rec_iters(args):
     from mxnet_tpu.io import ImageRecordIter
     train = ImageRecordIter(
@@ -34,11 +43,7 @@ def rec_iters(args):
         mean_r=RGB_MEAN[0], mean_g=RGB_MEAN[1], mean_b=RGB_MEAN[2],
         std_r=RGB_STD[0], std_g=RGB_STD[1], std_b=RGB_STD[2],
         preprocess_threads=max(os.cpu_count() or 2, 2), shuffle=True)
-    val = ImageRecordIter(
-        args.data_val, data_shape=(3, 28, 28), batch_size=args.batch_size,
-        mean_r=RGB_MEAN[0], mean_g=RGB_MEAN[1], mean_b=RGB_MEAN[2],
-        std_r=RGB_STD[0], std_g=RGB_STD[1], std_b=RGB_STD[2]) \
-        if args.data_val else None
+    val = eval_iter(args.data_val, args) if args.data_val else None
     return train, val
 
 
@@ -116,14 +121,8 @@ def main():
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
 
     if not accs:
-        # no val data: score the TRAIN .rec once through a clean
-        # (augmentation-free, deterministic) iterator
-        from mxnet_tpu.io import ImageRecordIter
-        clean = ImageRecordIter(
-            args.data_train, data_shape=(3, 28, 28),
-            batch_size=args.batch_size,
-            mean_r=RGB_MEAN[0], mean_g=RGB_MEAN[1], mean_b=RGB_MEAN[2],
-            std_r=RGB_STD[0], std_g=RGB_STD[1], std_b=RGB_STD[2])
+        # no val data: score the TRAIN .rec once, augmentation-free
+        clean = eval_iter(args.data_train, args)
         accs.append(dict(mod.score(clean, mx.metric.Accuracy()))
                     ["accuracy"])
         clean.close()

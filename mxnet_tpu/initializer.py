@@ -11,7 +11,7 @@ from .ndarray import ndarray as _nd
 
 __all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "Mixed", "InitDesc", "register", "create"]
+           "FusedRNN", "Mixed", "InitDesc", "register", "create"]
 
 _INIT_REGISTRY = {}
 
@@ -58,6 +58,8 @@ class Initializer:
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):
             desc = InitDesc(desc)
+        if desc.global_init is None:
+            desc.global_init = self
         init = desc.attrs.get("__init__", "")
         if init:
             create(init)._init_weight(desc, arr)
@@ -238,6 +240,43 @@ class LSTMBias(Initializer):
 
 
 @register
+class FusedRNN(Initializer):
+    """Initialize a FusedRNNCell's packed parameter vector (reference
+    initializer.py:689): unpack into per-gate i2h/h2h weights and biases,
+    initialize each piece with ``init`` (or the run's global initializer
+    when None), apply the LSTM forget-gate bias, and repack."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = create(init)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights({"parameters": arr})
+        inner = self._init or desc.global_init or Uniform()
+        for name, piece in args.items():
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                piece[:] = self._forget_bias
+            else:
+                inner(InitDesc(name, global_init=desc.global_init), piece)
+        packed = cell.pack_weights(args)["parameters"]
+        self._set(arr, packed.asnumpy())
+
+
 class Mixed(Initializer):
     def __init__(self, patterns, initializers):
         super().__init__()

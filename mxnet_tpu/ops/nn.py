@@ -565,8 +565,9 @@ def sparse_embedding(data, weight, *, input_dim=0, output_dim=0,
 # RNN (fused; reference: src/operator/rnn-inl.h, cudnn_rnn-inl.h)
 # ---------------------------------------------------------------------------
 
-def _lstm_cell(x, h, c, wx, wh, bx, bh):
-    gates = x @ wx.T + h @ wh.T + bx + bh
+def _lstm_cell(xproj, h, c, wh, bh):
+    # xproj = x @ wx.T + bx, hoisted out of the scan (see rnn())
+    gates = xproj + h @ wh.T + bh
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
     g = jnp.tanh(g)
@@ -575,8 +576,8 @@ def _lstm_cell(x, h, c, wx, wh, bx, bh):
     return h2, c2
 
 
-def _gru_cell(x, h, wx, wh, bx, bh):
-    xr, xz, xn = jnp.split(x @ wx.T + bx, 3, axis=-1)
+def _gru_cell(xproj, h, wh, bh):
+    xr, xz, xn = jnp.split(xproj, 3, axis=-1)
     hr, hz, hn = jnp.split(h @ wh.T + bh, 3, axis=-1)
     r = jax.nn.sigmoid(xr + hr)
     z = jax.nn.sigmoid(xz + hz)
@@ -584,8 +585,8 @@ def _gru_cell(x, h, wx, wh, bx, bh):
     return (1 - z) * n + z * h
 
 
-def _rnn_cell(x, h, wx, wh, bx, bh, act):
-    return act(x @ wx.T + h @ wh.T + bx + bh)
+def _rnn_cell(xproj, h, wh, bh, act):
+    return act(xproj + h @ wh.T + bh)
 
 
 def _rnn_param_shapes(mode, input_size, state_size, num_layers, bidirectional):
@@ -629,9 +630,12 @@ def rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
         lstm_state_clip_max=None, lstm_state_clip_nan=False):
     """Fused multi-layer RNN over ``lax.scan`` (time major: (T, N, I)).
 
-    The scan body is a dense cell -> XLA fuses gates into MXU matmuls; this is
-    the TPU analog of the reference's miopenRNN fused kernels
-    (src/operator/cudnn_rnn-inl.h:43).
+    The TPU analog of the reference's miopenRNN fused kernels
+    (src/operator/cudnn_rnn-inl.h:43), with the cuDNN scheduling trick
+    done at the XLA level: the input projection ``x @ wx.T + bx`` for ALL
+    timesteps is hoisted out of the scan into one (T*N, I)x(I, G*H)
+    matmul — a large, MXU-efficient contraction — so the sequential scan
+    body carries only the (N, H)x(H, G*H) recurrence.
     """
     T, N, I = data.shape
     dirs = 2 if bidirectional else 1
@@ -656,23 +660,25 @@ def rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
             li = layer * dirs + d
             wx, wh, bx, bh = wxs[li], whs[li], bxs[li], bhs[li]
             xs = x if d == 0 else jnp.flip(x, axis=0)
+            # whole-sequence input projection: one big MXU matmul
+            xp = jnp.einsum("tni,gi->tng", xs, wx) + bx
             if mode == "lstm":
                 def step(carry, xt):
                     h, c = carry
-                    h2, c2 = _lstm_cell(xt, h, c, wx, wh, bx, bh)
+                    h2, c2 = _lstm_cell(xt, h, c, wh, bh)
                     return (h2, c2), h2
-                (hT, cT), ys = lax.scan(step, (h0[li], c0[li]), xs)
+                (hT, cT), ys = lax.scan(step, (h0[li], c0[li]), xp)
                 c_finals.append(cT)
             elif mode == "gru":
                 def step(h, xt):
-                    h2 = _gru_cell(xt, h, wx, wh, bx, bh)
+                    h2 = _gru_cell(xt, h, wh, bh)
                     return h2, h2
-                hT, ys = lax.scan(step, h0[li], xs)
+                hT, ys = lax.scan(step, h0[li], xp)
             else:
                 def step(h, xt):
-                    h2 = _rnn_cell(xt, h, wx, wh, bx, bh, act)
+                    h2 = _rnn_cell(xt, h, wh, bh, act)
                     return h2, h2
-                hT, ys = lax.scan(step, h0[li], xs)
+                hT, ys = lax.scan(step, h0[li], xp)
             h_finals.append(hT)
             if d == 1:
                 ys = jnp.flip(ys, axis=0)

@@ -272,7 +272,104 @@ class FusedRNNCell(BaseRNNCell):
         self._bidirectional = bidirectional
         self._dropout = dropout
         self._get_next_state = get_next_state
-        self._param = self.params.get("parameters")
+        self._forget_bias = forget_bias
+        # the packed vector carries a FusedRNN default initializer attr so
+        # Module.init_params with ANY global initializer unpacks, inits
+        # per-gate, and repacks (reference rnn_cell.py:578-580)
+        from .. import initializer as _init
+        self._param = self.params.get(
+            "parameters",
+            init=_init.FusedRNN(None, num_hidden, num_layers, mode,
+                                bidirectional, forget_bias))
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": [""], "rnn_tanh": [""],
+                "lstm": ["_i", "_f", "_c", "_o"],
+                "gru": ["_r", "_z", "_o"]}[self._mode]
+
+    @property
+    def _directions(self):
+        return ["l", "r"] if self._bidirectional else ["l"]
+
+    def _slice_weights(self, flat, num_input, lh):
+        """name -> (offset, shape) map over the packed vector, derived by
+        walking ops/nn.py ``_rnn_param_shapes`` — the SAME layout the RNN
+        operator unpacks at execution time, so the naming layer can never
+        desync from the compute layer. Each gate-stacked block is split
+        into per-gate reference names (``{prefix}{dir}{layer}_i2h{gate}_
+        weight`` etc., reference rnn_cell.py:600)."""
+        from ..ops.nn import _rnn_param_shapes
+        gate_names = self._gate_names
+        dirs = self._directions
+        m = len(gate_names)
+        shapes = _rnn_param_shapes(self._mode, num_input, lh,
+                                   self._num_layers, self._bidirectional)
+        group = {"wx": ("i2h", "weight"), "wh": ("h2h", "weight"),
+                 "bx": ("i2h", "bias"), "bh": ("h2h", "bias")}
+        spans = {}
+        p = 0
+        pair = 0    # (layer, direction) index; advances after each h-block
+        for kind, shape in shapes:
+            layer, d = divmod(pair % (self._num_layers * len(dirs)),
+                              len(dirs))
+            grp, suffix = group[kind]
+            gshape = (lh,) if suffix == "bias" else (lh, shape[-1])
+            per = 1
+            for s in gshape:
+                per *= s
+            for gate in gate_names:
+                name = "%s%s%d_%s%s_%s" % (self._prefix, dirs[d], layer,
+                                           grp, gate, suffix)
+                spans[name] = (p, gshape)
+                p += per
+            if kind in ("wh", "bh"):
+                pair += 1
+        assert p == flat.size, \
+            "Invalid parameters size for FusedRNNCell: %d != %d" % (
+                flat.size, p)
+        return spans
+
+    def unpack_weights(self, args):
+        """Split the packed vector into named per-gate i2h/h2h weights
+        and biases (reference rnn_cell.py:639)."""
+        from .. import ndarray as _ndm
+        import numpy as _np
+        args = dict(args)
+        arr = args.pop(self._param.name)
+        flat = arr.asnumpy().reshape(-1)
+        b = len(self._directions)
+        m = len(self._gate_names)
+        h = self._num_hidden
+        num_input = flat.size // b // h // m \
+            - (self._num_layers - 1) * (h + b * h + 2) - h - 2
+        for name, (p, shape) in self._slice_weights(flat, num_input,
+                                                    h).items():
+            n = int(_np.prod(shape))
+            args[name] = _ndm.array(flat[p:p + n].reshape(shape),
+                                    ctx=arr.context)
+        return args
+
+    def pack_weights(self, args):
+        """Inverse of unpack_weights (reference rnn_cell.py:652)."""
+        from .. import ndarray as _ndm
+        import numpy as _np
+        args = dict(args)
+        c0 = "%sl0_i2h%s_weight" % (self._prefix, self._gate_names[0])
+        w0 = args[c0]
+        num_input = w0.shape[1]
+        b = len(self._directions)
+        m = len(self._gate_names)
+        h = self._num_hidden
+        total = (num_input + h + 2) * h * m * b \
+            + (self._num_layers - 1) * m * h * (h + b * h + 2) * b
+        flat = _np.zeros((total,), dtype=_np.dtype(w0.dtype))
+        for name, (p, shape) in self._slice_weights(flat, num_input,
+                                                    h).items():
+            n = int(_np.prod(shape))
+            flat[p:p + n] = args.pop(name).asnumpy().reshape(-1)
+        args[self._param.name] = _ndm.array(flat, ctx=w0.context)
+        return args
 
     @property
     def state_info(self):

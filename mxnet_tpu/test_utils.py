@@ -93,6 +93,67 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
                                    err_msg="analytic vs numeric grad for input %d" % xi)
 
 
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=1e-8,
+                           ctx=None, aux_states=None):
+    """Bind `sym` at `location` (list or name->array dict) and compare each
+    output against `expected` (reference test_utils.check_symbolic_forward
+    :932)."""
+    ctx = ctx or default_context()
+    args = _location_dict(sym, location)
+    args = {k: nd.array(v, ctx=ctx) for k, v in args.items()}
+    aux = {k: nd.array(v, ctx=ctx) for k, v in (aux_states or {}).items()}
+    ex = sym.bind(ctx, args, aux_states=aux or None)
+    ex.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    assert len(ex.outputs) == len(expected)
+    for i, (out, want) in enumerate(zip(ex.outputs, expected)):
+        np.testing.assert_allclose(
+            out.asnumpy(), np.asarray(want), rtol=rtol, atol=atol,
+            err_msg="output %d of %s" % (i, sym.name))
+    return [o.asnumpy() for o in ex.outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=1e-8, ctx=None, grad_req="write",
+                            aux_states=None):
+    """Bind `sym`, run fwd+bwd with `out_grads`, and compare input grads
+    against `expected` (name->array dict or list in argument order)
+    (reference test_utils.check_symbolic_backward :976)."""
+    ctx = ctx or default_context()
+    args = _location_dict(sym, location)
+    args = {k: nd.array(v, ctx=ctx) for k, v in args.items()}
+    aux = {k: nd.array(v, ctx=ctx) for k, v in (aux_states or {}).items()}
+    grads = {k: nd.zeros(v.shape, ctx=ctx) for k, v in args.items()}
+    ex = sym.bind(ctx, args, args_grad=grads, grad_req=grad_req,
+                  aux_states=aux or None)
+    ex.forward(is_train=True)
+    if not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    ex.backward([nd.array(g, ctx=ctx) for g in out_grads])
+    if isinstance(expected, dict):
+        items = expected.items()
+    else:
+        names = sym.list_arguments()
+        assert len(names) == len(expected), (names, len(expected))
+        items = zip(names, expected)
+    for name, want in items:
+        if want is None:
+            continue
+        np.testing.assert_allclose(
+            ex.grad_dict[name].asnumpy(), np.asarray(want), rtol=rtol,
+            atol=atol, err_msg="grad of %s" % name)
+    return {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+
+
+def _location_dict(sym, location):
+    if isinstance(location, dict):
+        return location
+    names = sym.list_arguments()
+    assert len(names) == len(location), (names, len(location))
+    return dict(zip(names, location))
+
+
 def check_consistency(fn, inputs, ctx_list=None, rtol=1e-5, atol=1e-7):
     """Run fn on each context and cross-compare outputs
     (reference test_utils.check_consistency:1213)."""

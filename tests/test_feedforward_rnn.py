@@ -247,3 +247,46 @@ def test_fused_unroll_default_placeholders():
     outs, _ = mx.rnn.BidirectionalCell(l, r).unroll(3)
     args = outs[0].list_arguments()
     assert "t0_data" in args, args
+
+
+def test_fused_cell_default_init_and_weight_packing():
+    """Module.init_params on a FusedRNNCell model works with ANY global
+    initializer (the packed vector carries a FusedRNN __init__ attr,
+    reference rnn_cell.py:578-580 / initializer.py:689), the forget-gate
+    bias initializes to forget_bias, and unpack/pack round-trips."""
+    import numpy as np
+    T, H, V = 5, 8, 12
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=V, output_dim=H, name="emb")
+    emb_t = mx.sym.swapaxes(emb, dim1=0, dim2=1)
+    cell = mx.rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="l_",
+                               forget_bias=2.0)
+    out, _ = cell.unroll(T, emb_t, layout="TNC", merge_outputs=True)
+    logits = mx.sym.FullyConnected(
+        mx.sym.Reshape(mx.sym.swapaxes(out, dim1=0, dim2=1),
+                       shape=(-1, H)), num_hidden=V, name="fc")
+    loss = mx.sym.SoftmaxOutput(
+        logits, mx.sym.Reshape(mx.sym.Variable("softmax_label"),
+                               shape=(-1,)), name="softmax")
+    mod = mx.mod.Module(loss, context=mx.cpu())
+    mod.bind([mx.io.DataDesc("data", (4, T))],
+             [mx.io.DataDesc("softmax_label", (4, T))])
+    # a PLAIN global initializer: routed through the FusedRNN attr
+    mod.init_params(mx.initializer.Xavier())
+    params = mod.get_params()[0]
+
+    unpacked = cell.unpack_weights({"l_parameters": params["l_parameters"]})
+    # naming contract: direction 'l', per-layer per-gate i2h/h2h pieces
+    assert "l_l0_i2h_i_weight" in unpacked
+    assert "l_l1_h2h_o_bias" in unpacked
+    assert unpacked["l_l0_i2h_c_weight"].shape == (H, H)   # layer0: in=H
+    assert unpacked["l_l1_i2h_c_weight"].shape == (H, H)
+    np.testing.assert_allclose(unpacked["l_l0_i2h_f_bias"].asnumpy(), 2.0)
+    np.testing.assert_allclose(unpacked["l_l1_i2h_f_bias"].asnumpy(), 2.0)
+    # Xavier actually ran on the weight pieces (nonzero, bounded)
+    w = unpacked["l_l0_i2h_i_weight"].asnumpy()
+    assert np.abs(w).max() > 0 and np.abs(w).max() < 2.0
+
+    repacked = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["l_parameters"].asnumpy(),
+                               params["l_parameters"].asnumpy(), rtol=1e-6)

@@ -47,9 +47,10 @@ def test_flips_hwc():
 
 
 def test_random_flip_is_identity_or_flip():
+    mx.random.seed(7)
     x = _img()
     seen = set()
-    for _ in range(12):
+    for _ in range(32):
         got = _inv("_image_random_flip_left_right", [x])
         if np.array_equal(got, x):
             seen.add("id")

@@ -90,3 +90,25 @@ def test_with_seed_env_replay():
         return mx.nd.random.uniform(shape=(4,)).asnumpy()
     c, d = draw7(), draw7()
     np.testing.assert_array_equal(c, d)
+
+
+def test_check_symbolic_forward_backward_harness():
+    # the reference-parity symbolic checkers drive bind/forward/backward
+    import mxnet_tpu as mx
+    from mxnet_tpu import test_utils
+
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = a * b + a
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], "f4")
+    y = np.array([[5.0, 6.0], [7.0, 8.0]], "f4")
+    test_utils.check_symbolic_forward(out, [x, y], [x * y + x])
+    og = np.ones((2, 2), "f4")
+    test_utils.check_symbolic_backward(out, [x, y], [og],
+                                       {"a": y + 1, "b": x})
+    # list-form expected and None skips
+    test_utils.check_symbolic_backward(out, [x, y], [og], [y + 1, None])
+    # mismatched grads must raise
+    import pytest as _pytest
+    with _pytest.raises(AssertionError):
+        test_utils.check_symbolic_backward(out, [x, y], [og], {"a": y})
