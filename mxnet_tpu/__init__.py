@@ -16,16 +16,6 @@ if config.flags.enable_x64:
     import jax as _jax
     _jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache (the operator_tune replacement — see
-# the flag's docstring). Pure config: no device/backend work happens here,
-# so import hygiene is preserved.
-if config.flags.compile_cache_dir:
-    import jax as _jax_cc
-    _jax_cc.config.update("jax_compilation_cache_dir",
-                          config.flags.compile_cache_dir)
-    _jax_cc.config.update("jax_persistent_cache_min_compile_time_secs",
-                          config.flags.compile_cache_min_compile_secs)
-
 import os as _os
 
 # Re-assert a user-pinned CPU platform into jax config. A site-installed
@@ -35,10 +25,31 @@ import os as _os
 # jax.devices() call when its link is down, hermetic CPU runs included.
 # Only cpu-leading values are re-asserted: for accelerator values the
 # plugin's own selection (typically "<plat>,cpu") is already right.
-# Pure config, no backend init, so import hygiene holds.
+# Pure config, no backend init, so import hygiene holds. Runs BEFORE the
+# cache block below, which keys off the resolved platform.
 if _os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
     import jax as _jax_plat
     _jax_plat.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+# Persistent XLA compilation cache (the operator_tune replacement — see
+# the flag's docstring). Pure config: no device/backend work happens here,
+# so import hygiene is preserved. CPU-pinned processes skip the default
+# cache: XLA:CPU persists AOT machine code whose feature stamps
+# (+prefer-no-scatter etc.) fail host verification on reload and can
+# SIGILL/segfault — and CPU compiles are cheap anyway; the cache's job is
+# the TPU's multi-minute fused-step compiles. An explicit
+# MXNET_COMPILE_CACHE_DIR is always honored.
+if config.flags.compile_cache_dir:
+    import jax as _jax_cc
+    # default-on only when an accelerator platform is explicitly selected
+    # (unset/auto and cpu-pinned processes both resolve to XLA:CPU)
+    _lead = (_jax_cc.config.jax_platforms or "").split(",")[0]
+    _accel = _lead not in ("", "cpu")
+    if _os.environ.get("MXNET_COMPILE_CACHE_DIR") or _accel:
+        _jax_cc.config.update("jax_compilation_cache_dir",
+                              config.flags.compile_cache_dir)
+        _jax_cc.config.update("jax_persistent_cache_min_compile_time_secs",
+                              config.flags.compile_cache_min_compile_secs)
 
 # Under a launcher (tools/launch.py sets MXNET_COORDINATOR_ADDRESS /
 # DMLC_PS_ROOT_URI), join the process group NOW — jax.distributed must

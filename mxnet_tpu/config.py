@@ -170,8 +170,13 @@ register_flag("compile_cache_dir", "MXNET_COMPILE_CACHE_DIR", str,
               (os.path.expanduser("~/.cache/mxnet_tpu/xla")
                if not os.path.expanduser("~").startswith("~") else ""),
               "Persistent XLA compilation-cache directory; empty "
-              "disables. On by default: set MXNET_COMPILE_CACHE_DIR= "
-              "(empty) to turn off. The XLA-era replacement for the reference's "
+              "disables. The default engages only when an accelerator "
+              "platform is explicitly selected (jax_platforms leads with "
+              "a non-cpu entry): XLA:CPU AOT artifacts can fail feature "
+              "verification on reload (SIGILL), and CPU compiles are "
+              "cheap. Setting MXNET_COMPILE_CACHE_DIR explicitly forces "
+              "the cache on for any backend; empty turns it off. "
+              "The XLA-era replacement for the reference's "
               "operator_tune startup autotuning "
               "(src/operator/operator_tune.h:67-225): instead of "
               "re-measuring ops every process, compiled programs are "

@@ -24,6 +24,15 @@ def test_train_mnist_example():
     assert "final validation" in r.stdout
 
 
+def test_nce_word2vec_example():
+    # short run: assert the mechanics (zipfian negatives, NCE head,
+    # manual SGD on a shared embedding) improve the loss; the full
+    # embedding-geometry check runs at the script's own defaults
+    r = _run("nce_word2vec.py", ["--steps", "60", "--vocab", "128",
+                                 "--num-neg", "7", "--batch-size", "128"])
+    assert "partner-nearest-neighbour" in r.stdout
+
+
 def test_train_cifar10_example():
     r = _run("train_cifar10.py", ["--num-epochs", "1", "--batch-size", "64",
                                   "--num-layers", "20"])
