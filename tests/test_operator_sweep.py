@@ -286,3 +286,53 @@ def test_mp_sgd_update_keeps_master_precision():
     out = outs[0] if isinstance(outs, (list, tuple)) else outs
     np.testing.assert_allclose(out.asnumpy().astype("f4"),
                                w32 - 0.1 * g16.astype("f4"), atol=1e-3)
+
+
+def test_histogram_and_diag():
+    x = np.array([0.5, 1.5, 2.5, 0.1, 1.1, 2.9], "f4")
+    cnt, edges = mx.nd.invoke("histogram", [mx.nd.array(x)],
+                              {"bin_cnt": 3, "range": (0.0, 3.0)})
+    np.testing.assert_allclose(cnt.asnumpy(), [2, 2, 2])
+    np.testing.assert_allclose(edges.asnumpy(), [0, 1, 2, 3])
+    m = RNG.randn(4, 4).astype("f4")
+    np.testing.assert_allclose(_inv("diag", [m]), np.diag(m))
+    np.testing.assert_allclose(_inv("diag", [m], k=1), np.diag(m, 1))
+    v = np.array([1.0, 2.0, 3.0], "f4")
+    np.testing.assert_allclose(_inv("diag", [v]), np.diag(v))
+
+
+def test_one_hot_pick_take():
+    idx = np.array([0, 2, 1], "f4")
+    got = _inv("one_hot", [idx], depth=4, on_value=2.0, off_value=-1.0)
+    want = np.full((3, 4), -1.0, "f4")
+    for i, j in enumerate(idx.astype(int)):
+        want[i, j] = 2.0
+    np.testing.assert_allclose(got, want)
+
+    data = RNG.randn(3, 5).astype("f4")
+    picked = _inv("pick", [data, idx], axis=1)
+    np.testing.assert_allclose(picked,
+                               data[np.arange(3), idx.astype(int)])
+
+    t = _inv("take", [data, np.array([2, 0], "f4")], axis=1)
+    np.testing.assert_allclose(t, data[:, [2, 0]])
+
+
+def test_sort_argsort_topk():
+    x = RNG.randn(3, 6).astype("f4")
+    np.testing.assert_allclose(_inv("sort", [x], axis=1),
+                               np.sort(x, axis=1))
+    np.testing.assert_allclose(_inv("argsort", [x], axis=1),
+                               np.argsort(x, axis=1).astype("f4"))
+    top = _inv("topk", [x], axis=1, k=2, ret_typ="value")
+    want = np.sort(x, axis=1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(top, want)
+
+
+def test_khatri_rao():
+    a = RNG.randn(2, 3).astype("f4")
+    b = RNG.randn(4, 3).astype("f4")
+    got = _inv("khatri_rao", [a, b])
+    want = np.vstack([np.kron(a[:, j], b[:, j]) for j in range(3)]).T
+    assert got.shape == (8, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
