@@ -155,6 +155,13 @@ class _JaxF:
     """
 
     def __getattr__(self, name):
+        if name in ("contrib", "linalg"):
+            # sub-namespaces mirror the eager nd.contrib/nd.linalg
+            # surfaces (reference F.contrib.* works under hybridize)
+            return _JaxFSub(self, "_%s_" % name)
+        return self._op_fn(name)
+
+    def _op_fn(self, name):
         try:
             op = _registry.get(name)
         except KeyError:
@@ -188,6 +195,20 @@ class _JaxF:
 
     def __repr__(self):
         return "<traced-F (jax)>"
+
+
+class _JaxFSub:
+    """F.contrib / F.linalg under traced execution: attribute X resolves
+    to the registry op ``<prefix>X`` (e.g. _contrib_ROIAlign) — exact
+    match only, mirroring the eager contrib_surface resolver so a name
+    behaves identically eager and hybridized."""
+
+    def __init__(self, parent, prefix):
+        self._parent = parent
+        self._prefix = prefix
+
+    def __getattr__(self, name):
+        return self._parent._op_fn(self._prefix + name)
 
 
 _F_JAX = _JaxF()

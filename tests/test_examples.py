@@ -24,6 +24,16 @@ def test_train_mnist_example():
     assert "final validation" in r.stdout
 
 
+def test_transformer_lm_example():
+    # a 1-layer model must SOLVE the lag-9 copy task — only possible by
+    # attending 9 steps back through the causal flash kernel
+    r = _run("train_transformer_lm.py",
+             ["--steps", "300", "--seq-len", "32", "--lag", "9",
+              "--dim", "32", "--num-layers", "1", "--batch-size", "32",
+              "--lr", "5e-3"])
+    assert "loss first->last" in r.stdout
+
+
 def test_nce_word2vec_example():
     # short run: assert the mechanics (zipfian negatives, NCE head,
     # manual SGD on a shared embedding) improve the loss; the full

@@ -462,3 +462,25 @@ def test_export_roundtrip_via_load_parameters(tmp_path):
     net3(x)
     net3.load_parameters(fname)
     assert_almost_equal(net3(x).asnumpy(), y0)
+
+
+def test_hybrid_forward_contrib_namespace():
+    """F.contrib.* must resolve inside hybrid_forward under BOTH eager and
+    hybridized execution (reference hybrid blocks use F.contrib ops)."""
+    import numpy as np
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            # CamelCase contrib op and a snake_case one
+            y = F.contrib.div_sqrt_dim(x)
+            q = F.expand_dims(x, axis=1)            # (N, 1, T, D)
+            att = F.contrib.FlashAttention(q, q, q, causal=True)
+            return y + F.reshape(att, shape=(-3, 0, 0))
+
+    net = Net()
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 4, 9))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
