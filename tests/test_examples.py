@@ -24,6 +24,12 @@ def test_train_mnist_example():
     assert "final validation" in r.stdout
 
 
+def test_long_context_attention_example():
+    r = _run("long_context_attention.py",
+             ["--devices", "4", "--seq-len", "512"])
+    assert "LONG-CONTEXT OK" in r.stdout
+
+
 def test_transformer_lm_example():
     # a 1-layer model must SOLVE the lag-9 copy task — only possible by
     # attending 9 steps back through the causal flash kernel
