@@ -207,3 +207,36 @@ def test_unhashable_kwargs():
     y = mx.nd.Custom(mx.nd.ones((3,)), op_type="kw_shape", shape=[2, 2])
     assert y.shape == (2, 2)
     np.testing.assert_allclose(y.asnumpy(), 3 * np.ones((2, 2)))
+
+
+def test_custom_op_exception_propagates_to_sync_point():
+    """A Python error inside a custom op must reach the CALLER as an
+    exception, not hang or corrupt state (reference test_exc_handling.py
+    semantics: async worker errors rethrow at sync points). Also: the
+    session stays usable afterwards."""
+
+    class Exploding(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            raise RuntimeError("boom from custom op")
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            pass
+
+    @mx.operator.register("_test_exploding")
+    class ExplodingProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Exploding()
+
+    x = mx.nd.ones((2, 2))
+    with pytest.raises(Exception) as ei:
+        out = mx.nd.Custom(x, op_type="_test_exploding")
+        out.asnumpy()          # sync point at the latest
+    assert "boom" in str(ei.value)
+    # engine/session still healthy after the failure
+    np.testing.assert_allclose((x + 1).asnumpy(), 2.0)

@@ -336,3 +336,51 @@ def test_khatri_rao():
     want = np.vstack([np.kron(a[:, j], b[:, j]) for j in range(3)]).T
     assert got.shape == (8, 3)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_symbolic_broadcast_backward_reduces_over_broadcast_axes():
+    """Gradient of a broadcast op must SUM over the broadcast axes
+    (reference test_operator.py test_broadcast_binary_op backward)."""
+    from mxnet_tpu import test_utils
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.broadcast_mul(a, b)
+    av = RNG.randn(2, 1, 3).astype("f4")
+    bv = RNG.randn(1, 4, 3).astype("f4")
+    og = RNG.randn(2, 4, 3).astype("f4")
+    test_utils.check_symbolic_forward(out, [av, bv], [av * bv], rtol=1e-5)
+    test_utils.check_symbolic_backward(
+        out, [av, bv], [og],
+        {"a": (og * bv).sum(axis=1, keepdims=True),
+         "b": (og * av).sum(axis=0, keepdims=True)}, rtol=1e-5)
+
+    out = mx.sym.broadcast_add(a, b)
+    test_utils.check_symbolic_backward(
+        out, [av, bv], [og],
+        {"a": og.sum(axis=1, keepdims=True),
+         "b": og.sum(axis=0, keepdims=True)}, rtol=1e-5)
+
+    # scalar-ish broadcast: (1,1,1) against full shape
+    sv = RNG.randn(1, 1, 1).astype("f4")
+    out = mx.sym.broadcast_div(a, b)
+    test_utils.check_symbolic_backward(
+        out, [og, sv], [og],
+        {"a": og / sv, "b": (-og * og / (sv * sv)).sum(keepdims=True)
+         .reshape(1, 1, 1)}, rtol=1e-4)
+
+
+def test_symbolic_grad_req_add_accumulates():
+    """grad_req='add' must accumulate into the provided grad buffer
+    instead of overwriting (reference executor semantics)."""
+    a = mx.sym.Variable("a")
+    out = 2.0 * a
+    av = np.ones((2, 2), "f4")
+    seed = np.full((2, 2), 5.0, "f4")
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array(av)},
+                  args_grad={"a": mx.nd.array(seed)}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones((2, 2))])
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), seed + 2.0)
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones((2, 2))])
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), seed + 4.0)
