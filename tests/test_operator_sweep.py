@@ -384,3 +384,44 @@ def test_symbolic_grad_req_add_accumulates():
     ex.forward(is_train=True)
     ex.backward([mx.nd.ones((2, 2))])
     np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), seed + 4.0)
+
+
+RESHAPE_CASES = [
+    # (src_shape, spec, reverse, want) — the reference test_reshape table
+    # (tests/python/unittest/test_operator.py:2128-2148) verbatim
+    ((2, 3, 5, 5), (0, -1), False, (2, 75)),
+    ((2, 3, 5, 5), (0, 0, -1), False, (2, 3, 25)),
+    ((5, 3, 4, 5), (0, -1, 0), False, (5, 15, 4)),
+    ((2, 3, 5, 4), (-1, 0, 0), False, (8, 3, 5)),
+    ((2, 3, 5, 5), (0, 0, 0, 0), False, (2, 3, 5, 5)),
+    ((2, 4, 5, 3), (-1, 2, 2, 1), False, (30, 2, 2, 1)),
+    ((2, 3, 5, 6), (-2,), False, (2, 3, 5, 6)),
+    ((2, 3, 5, 6), (6, 1, -2), False, (6, 1, 5, 6)),
+    ((2, 3, 5, 6), (-3, -3), False, (6, 30)),
+    ((2, 3, 5, 6), (-3, -1), False, (6, 30)),
+    ((64,), (-4, 16, 4), False, (16, 4)),
+    ((64,), (-4, 16, -1), False, (16, 4)),
+    ((64, 1, 2, 3), (-4, 16, -1, -2), False, (16, 4, 1, 2, 3)),
+    ((2, 3, 5, 5), (0, -1), True, (5, 30)),
+    ((2, 3, 5, 5), (0, 0, -1), True, (3, 5, 10)),
+    ((5, 3, 4, 5), (0, -1, 0), True, (3, 20, 5)),
+    ((2, 3, 5, 4), (-1, 0, 0), True, (6, 5, 4)),
+    ((2, 3, 4, 5), (3, -1, 0), True, (3, 8, 5)),
+    ((2, 3, 5, 5), (5, 3, 0, -1), True, (5, 3, 5, 2)),
+    ((2, 3, 5, 5), (0, 0, 0, 0), True, (2, 3, 5, 5)),
+]
+
+
+@pytest.mark.parametrize("src,spec,rev,want", RESHAPE_CASES,
+                         ids=["%s%s%s" % (s, p, "R" if r else "")
+                              for s, p, r, _ in RESHAPE_CASES])
+def test_reshape_special_codes(src, spec, rev, want):
+    x = np.arange(int(np.prod(src)), dtype="f4").reshape(src)
+    out = mx.nd.reshape(mx.nd.array(x), shape=spec, reverse=rev)
+    assert out.shape == want
+    np.testing.assert_allclose(out.asnumpy(), x.reshape(want))
+    # values survive (same memory order contract as numpy reshape) and
+    # the symbolic path infers the identical shape
+    sym = mx.sym.Reshape(mx.sym.Variable("data"), shape=spec, reverse=rev)
+    _, out_shapes, _ = sym.infer_shape(data=src)
+    assert out_shapes[0] == want
