@@ -355,8 +355,8 @@ class NDArray:
             shape = tuple(shape[0])
         return invoke("Reshape", [self], {"shape": shape, **kwargs})
 
-    def reshape_like(self, other):
-        return invoke("Reshape", [self], {"shape": other.shape})
+    def reshape_like(self, other, **kwargs):
+        return invoke("reshape_like", [self, other], kwargs)
 
     def flatten(self):
         return invoke("Flatten", [self], {})

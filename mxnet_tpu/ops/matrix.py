@@ -423,11 +423,28 @@ _set_op_meta("topk", dtype_hook=_param_dtype_out)
 
 
 @register("reshape_like")
-def reshape_like(lhs, rhs):
+def reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
     """Reshape lhs to the shape of rhs (parity:
     src/operator/tensor/elemwise_unary_op_basic.cc:429 — gradient flows to
-    lhs only; rhs contributes shape, not values)."""
-    return jnp.reshape(lhs, rhs.shape)
+    lhs only; rhs contributes shape, not values). The begin/end ranges
+    replace ONLY lhs dims [lhs_begin, lhs_end) with rhs dims
+    [rhs_begin, rhs_end), keeping the rest of lhs's shape (reference
+    ReshapeLikeParam)."""
+
+    def _rng(b, e, ndim, what):
+        b = 0 if b is None else (b + ndim if b < 0 else b)
+        e = ndim if e is None else (e + ndim if e < 0 else e)
+        if not (0 <= b <= e <= ndim):   # reference GetReshapeLikeParams
+            raise ValueError(
+                "reshape_like: invalid %s range [%s, %s) for %d dims"
+                % (what, b, e, ndim))
+        return b, e
+
+    lb, le = _rng(lhs_begin, lhs_end, lhs.ndim, "lhs")
+    rb, re = _rng(rhs_begin, rhs_end, rhs.ndim, "rhs")
+    shape = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+    return jnp.reshape(lhs, shape)
 
 
 @register("batch_take")

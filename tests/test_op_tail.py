@@ -248,3 +248,40 @@ def test_libsvm_iter_validates():
                              label_shape=(3,), batch_size=1)
     finally:
         os.unlink(path)
+
+
+def test_reshape_like_ranges():
+    """Range-limited reshape_like (reference test_operator.py:2206 table:
+    replace lhs dims [lhs_begin, lhs_end) with rhs dims
+    [rhs_begin, rhs_end))."""
+    cases = [
+        ((30,), (15, 2, 4), 0, None, 0, 2, (15, 2)),
+        ((30,), (15, 2, 4), None, 1, None, 2, (15, 2)),
+        ((30, 7), (15, 2, 4), 0, 1, 0, 2, (15, 2, 7)),
+        ((3, 5), (1, 15, 4), 0, 2, 1, 2, (15,)),
+        ((3, 5), (1, 15, 4), 0, None, 1, -1, (15,)),
+        ((30, 12), (4, 2, 2, 3), -1, None, 1, None, (30, 2, 2, 3)),
+        ((1, 1, 7, 3, 1, 1), (81, 1, 1, 21), 1, -1, 1, None,
+         (1, 1, 1, 21, 1)),
+    ]
+    for lshape, rshape, lb, le, rb, re, want in cases:
+        lhs = np.arange(int(np.prod(lshape)), dtype="f4").reshape(lshape)
+        out = mx.nd.reshape_like(
+            mx.nd.array(lhs), mx.nd.zeros(rshape), lhs_begin=lb,
+            lhs_end=le, rhs_begin=rb, rhs_end=re)
+        assert out.shape == want, (lshape, rshape, out.shape, want)
+        np.testing.assert_allclose(out.asnumpy(), lhs.reshape(want))
+    # old api unchanged
+    out = mx.nd.reshape_like(mx.nd.zeros((40, 30)), mx.nd.zeros((30, 20, 2)))
+    assert out.shape == (30, 20, 2)
+
+
+def test_reshape_like_invalid_range_raises():
+    with pytest.raises(Exception, match="invalid lhs range"):
+        mx.nd.reshape_like(mx.nd.zeros((1, 6)), mx.nd.ones((1, 3)),
+                           lhs_begin=1, lhs_end=0, rhs_begin=0, rhs_end=1)
+    # fluent method routes through the operator, ranges included
+    out = mx.nd.zeros((30, 7)).reshape_like(
+        mx.nd.zeros((15, 2, 4)), lhs_begin=0, lhs_end=1, rhs_begin=0,
+        rhs_end=2)
+    assert out.shape == (15, 2, 7)
