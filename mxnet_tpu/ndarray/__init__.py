@@ -14,3 +14,5 @@ _register.populate(__name__)
 # `out=` capable aliases used across the reference codebase
 zeros_like = globals().get("zeros_like")
 ones_like = globals().get("ones_like")
+
+

@@ -16,6 +16,7 @@ from . import ndarray as _nd
 
 def _make_op_func(op):
     def fn(*args, out=None, name=None, **kwargs):
+        args, kwargs = op.bind_positional(args, kwargs)
         inputs = []
         for a in args:
             if isinstance(a, _nd.NDArray):

@@ -207,7 +207,19 @@ def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
 
 @register("where")
 def where(condition, x, y):
-    return jnp.where(condition.astype(bool), x, y)
+    """Elementwise select; a 1-D condition over N-D operands selects whole
+    ROWS along axis 0 (reference control_flow_op.h WhereOpShape: csr/1-D
+    condition of length x.shape[0])."""
+    cond = condition.astype(bool)
+    xshape = jnp.shape(x)
+    if cond.ndim == 1 and len(xshape) > 1:
+        if cond.shape[0] != xshape[0]:
+            raise ValueError(
+                "where: 1-D condition length %d must equal x.shape[0]=%d "
+                "(reference control_flow_op.h WhereOpShape)"
+                % (cond.shape[0], xshape[0]))
+        cond = cond.reshape((-1,) + (1,) * (len(xshape) - 1))
+    return jnp.where(cond, x, y)
 
 
 @register("Cast")

@@ -289,17 +289,21 @@ def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
 def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     from ..base import normalize_dtype
     d = jnp.moveaxis(data, axis, -1)
-    vals, idx = jax.lax.top_k(-d if is_ascend else d, k)
+    vals, raw_idx = jax.lax.top_k(-d if is_ascend else d, k)
     if is_ascend:
         vals = -vals
+    if ret_typ == "mask":
+        # 1 at every top-k position, 0 elsewhere, in the DATA's layout
+        # (reference ordering_op ReturnType::kReturnMask); built from the
+        # raw integer indices before any float cast
+        onehot = jax.nn.one_hot(raw_idx, d.shape[-1], dtype=data.dtype)
+        return jnp.moveaxis(onehot.sum(axis=-2), -1, axis)
     vals = jnp.moveaxis(vals, -1, axis)
-    idx = jnp.moveaxis(idx, -1, axis).astype(normalize_dtype(dtype))
+    idx = jnp.moveaxis(raw_idx, -1, axis).astype(normalize_dtype(dtype))
     if ret_typ == "value":
         return vals
     if ret_typ == "both":
         return vals, idx
-    if ret_typ == "mask":
-        raise NotImplementedError("topk ret_typ=mask")
     return idx
 
 

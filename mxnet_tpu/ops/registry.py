@@ -32,7 +32,7 @@ class Operator:
     __slots__ = ("name", "fn", "num_outputs", "param_names", "is_random",
                  "doc", "shape_hook", "dtype_hook", "aux_inputs",
                  "aux_outputs", "num_visible_outputs", "input_names",
-                 "input_optional")
+                 "input_optional", "has_var_inputs")
 
     def __init__(self, name, fn, num_outputs=1, is_random=False):
         self.name = name
@@ -54,11 +54,32 @@ class Operator:
         # positional (array) inputs: name -> has_default
         self.input_names = []
         self.input_optional = []
+        self.has_var_inputs = False
         for p in sig.parameters.values():
             if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
                           inspect.Parameter.POSITIONAL_ONLY):
                 self.input_names.append(p.name)
                 self.input_optional.append(p.default is not inspect.Parameter.empty)
+            elif p.kind == inspect.Parameter.VAR_POSITIONAL:
+                self.has_var_inputs = True
+
+    def bind_positional(self, args, kwargs):
+        """Split positional call args into (input_args, kwargs): anything
+        past the declared tensor-input slots binds to param_names in
+        declaration order — the reference's generated-signature contract
+        (mx.nd.reshape(x, (3, 2)), mx.nd.sum(x, 1)). Variadic-input ops
+        treat every positional as an input."""
+        if self.has_var_inputs or len(args) <= len(self.input_names):
+            return args, kwargs
+        extra = args[len(self.input_names):]
+        if len(extra) > len(self.param_names):
+            raise TypeError("%s: too many positional arguments" % self.name)
+        for pname, val in zip(self.param_names, extra):
+            if pname in kwargs:
+                raise TypeError("%s: parameter %r given positionally and "
+                                "by keyword" % (self.name, pname))
+            kwargs[pname] = val
+        return args[:len(self.input_names)], kwargs
 
     def resolve_num_outputs(self, params):
         if callable(self.num_outputs):

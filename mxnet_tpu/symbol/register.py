@@ -24,6 +24,8 @@ def _make_op_func(op):
             kwargs.pop("ctx", None)
             return _symbol.invoke_sym(op.name, inputs, kwargs, name=node_name)
 
+        args, kwargs = op.bind_positional(args, kwargs)
+
         # named input slots: fill from positionals, then keywords, then
         # auto-create parameter variables the reference way
         # (e.g. Convolution(data) -> conv0_weight / conv0_bias variables;

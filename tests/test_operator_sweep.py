@@ -425,3 +425,49 @@ def test_reshape_special_codes(src, spec, rev, want):
     sym = mx.sym.Reshape(mx.sym.Variable("data"), shape=spec, reverse=rev)
     _, out_shapes, _ = sym.infer_shape(data=src)
     assert out_shapes[0] == want
+
+
+def test_topk_mask_and_where_rows_and_positional_clip():
+    a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], "f4")
+    mask = mx.nd.topk(mx.nd.array(a), k=1, ret_typ="mask").asnumpy()
+    np.testing.assert_allclose(mask, [[1, 0, 0], [0, 1, 0]])
+    mask2 = mx.nd.topk(mx.nd.array(a), k=2, is_ascend=True,
+                       ret_typ="mask").asnumpy()
+    np.testing.assert_allclose(mask2, [[0, 1, 1], [1, 0, 1]])
+    # row-selecting 1-D condition (reference where with csr/1-D cond)
+    got = mx.nd.where(mx.nd.array([1.0, 0.0]),
+                      mx.nd.array([[1.0, 2.0], [3.0, 4.0]]),
+                      mx.nd.array([[9.0, 9.0], [8.0, 8.0]])).asnumpy()
+    np.testing.assert_allclose(got, [[1, 2], [8, 8]])
+    # elementwise condition unchanged
+    got = mx.nd.where(mx.nd.array([[1.0, 0.0], [0.0, 1.0]]),
+                      mx.nd.array([[1.0, 2.0], [3.0, 4.0]]),
+                      mx.nd.array([[9.0, 9.0], [8.0, 8.0]])).asnumpy()
+    np.testing.assert_allclose(got, [[1, 9], [8, 4]])
+    # positional clip (reference generated signature)
+    np.testing.assert_allclose(
+        mx.nd.clip(mx.nd.array(a), 1.0, 3.0).asnumpy(),
+        np.clip(a, 1, 3))
+
+
+def test_positional_parameter_binding():
+    """Generated op functions accept params positionally after the tensor
+    inputs (the reference codegen contract: mx.nd.reshape(x, (3,2)),
+    mx.nd.sum(x, 1), Convolution(..., kernel) etc.)."""
+    x = mx.nd.array(np.arange(6, dtype="f4").reshape(2, 3))
+    assert mx.nd.reshape(x, (3, 2)).shape == (3, 2)
+    assert mx.nd.expand_dims(x, 1).shape == (2, 1, 3)
+    assert mx.nd.transpose(x, (1, 0)).shape == (3, 2)
+    np.testing.assert_allclose(mx.nd.sum(x, 1).asnumpy(),
+                               x.asnumpy().sum(1))
+    assert len(mx.nd.split(x, 3, axis=1)) == 3
+    out = mx.nd.FullyConnected(x, mx.nd.zeros((4, 3)), mx.nd.zeros((4,)), 4)
+    assert out.shape == (2, 4)
+    # symbol surface follows the same contract
+    s = mx.sym.reshape(mx.sym.Variable("data"), (3, 2))
+    assert s.infer_shape(data=(2, 3))[1][0] == (3, 2)
+    # duplicate positional+keyword must raise
+    with pytest.raises(TypeError, match="positionally and by keyword"):
+        mx.nd.reshape(x, (3, 2), shape=(6,))
+    with pytest.raises(TypeError, match="too many positional"):
+        mx.nd.zeros_like(x, 1, 2, 3, 4, 5, 6, 7)
