@@ -26,7 +26,6 @@ def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, 
                                              "shape": _shape(shape), "dtype": dtype, "ctx": ctx}, out=out)
 
 
-randn = normal
 
 
 def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
@@ -70,3 +69,11 @@ def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
 
 def shuffle(data, **kw):
     return _nd.invoke("_shuffle", [data], {})
+
+
+def randn(*shape, **kwargs):
+    """Standard-normal draws with the shape given positionally
+    (reference ndarray/random.py:155: randn(2, 3) == normal(0, 1, (2, 3)))."""
+    loc = kwargs.pop("loc", 0.0)
+    scale = kwargs.pop("scale", 1.0)
+    return normal(loc, scale, shape or (1,), **kwargs)

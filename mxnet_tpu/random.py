@@ -16,7 +16,22 @@ import threading
 import jax
 import numpy as _np
 
-__all__ = ["seed", "next_key", "TraceRng", "current_trace_rng"]
+# the reference re-exports the nd.random samplers at mx.random level
+# (python/mxnet/random.py:26 `from .ndarray.random import *`); resolved
+# lazily (PEP 562) because this module loads before the ndarray package
+_SAMPLERS = ("uniform", "normal", "randn", "poisson", "exponential",
+             "gamma", "negative_binomial", "generalized_negative_binomial",
+             "multinomial", "shuffle", "randint")
+
+__all__ = ["seed", "next_key", "TraceRng", "current_trace_rng",
+           *_SAMPLERS]
+
+
+def __getattr__(name):
+    if name in _SAMPLERS:
+        from .ndarray import random as _ndrandom
+        return getattr(_ndrandom, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
 
 _state = threading.local()
 

@@ -159,3 +159,22 @@ def test_sample_unique_zipfian_properties():
     lo = (row < 100).sum()
     hi = ((row >= 800) & (row < 900)).sum()
     assert lo > hi
+
+
+def test_mx_random_module_reexports_samplers():
+    """mx.random.* exposes the sampler surface positionally (reference
+    random.py:26 star-import of ndarray.random; randn at :155)."""
+    mx.random.seed(11)
+    u = mx.random.uniform(-1, 1, (500,)).asnumpy()
+    assert u.min() >= -1 and u.max() < 1
+    n = mx.random.normal(5, 0.5, (2000,)).asnumpy()
+    assert abs(n.mean() - 5) < 0.1
+    r = mx.random.randn(3, 4)
+    assert r.shape == (3, 4)
+    s = mx.random.shuffle(mx.nd.array(np.arange(16, dtype="f4"))).asnumpy()
+    np.testing.assert_array_equal(np.sort(s), np.arange(16))
+    # seed reproducibility through the re-exported surface
+    mx.random.seed(7)
+    a = mx.random.uniform(0, 1, (8,)).asnumpy()
+    mx.random.seed(7)
+    np.testing.assert_array_equal(a, mx.random.uniform(0, 1, (8,)).asnumpy())
