@@ -2,8 +2,10 @@
 onnx2mx/import_model.py + import_onnx.py GraphProto._convert_operator).
 
 Builds a Symbol + arg/aux params from a serialized ModelProto.  Covers
-the operator subset the reference's importer exercises for CNN/MLP
-models; unsupported ops raise with the op name so gaps are loud.
+the reference importer's full 92-entry op table (onnx2mx/
+_import_helper.py:28-117) — enough to import the ONNX files the
+reference model zoo exports; unsupported ops raise with the op name so
+gaps stay loud.
 """
 import numpy as _np
 
@@ -73,8 +75,9 @@ def _attrs(node):
 
 
 class _Importer:
-    def __init__(self, graph, for_training=False):
+    def __init__(self, graph, for_training=False, opset=9):
         self.graph = graph
+        self.opset = opset
         self.params = {n.name: tensor_to_numpy(n) for n in graph.initializer}
         self.syms = {}        # onnx value name -> Symbol
         self.aux_names = set()
@@ -212,8 +215,14 @@ class _Importer:
         hi = 3.4028234663852886e38 if hi is None else float(hi)
         self._simple(node, "clip", {"a_min": lo, "a_max": hi}, n_in=1)
 
+    def _softmax_axis(self, a):
+        # opset < 13: default axis=1 with flatten-to-2D semantics (the
+        # common case — a 2D classifier head — is exact; reference
+        # importer also passes axis=1). opset >= 13: per-axis, default -1.
+        return a.get("axis", 1 if self.opset < 13 else -1)
+
     def _cv_Softmax(self, node, a):
-        self._simple(node, "softmax", {"axis": a.get("axis", -1)})
+        self._simple(node, "softmax", {"axis": self._softmax_axis(a)})
 
     def _cv_Constant(self, node, a):
         value = a.get("value")
@@ -385,6 +394,325 @@ class _Importer:
             # reference importer's semantics.
             "use_global_stats": not self._for_training}, n_in=5)
 
+    _cv_SpatialBN = _cv_BatchNormalization  # legacy caffe2 name (reference
+    # _import_helper.py maps both to batch_norm)
+
+    # -- remainder of the reference's 92-entry import table ----------------
+    # (reference onnx2mx/_import_helper.py:28-117; each converter mirrors
+    # the matching _op_translations.py translation, re-targeted at our op
+    # registry)
+
+    def _cv_Ceil(self, node, a):
+        self._simple(node, "ceil")
+
+    def _cv_Floor(self, node, a):
+        self._simple(node, "floor")
+
+    def _cv_Reciprocal(self, node, a):
+        self._simple(node, "reciprocal")
+
+    def _cv_Softsign(self, node, a):
+        self._simple(node, "softsign")
+
+    def _cv_LogSoftmax(self, node, a):
+        self._simple(node, "log_softmax", {"axis": self._softmax_axis(a)})
+
+    def _cv_Selu(self, node, a):
+        self._simple(node, "LeakyReLU", {"act_type": "selu"})
+
+    def _cv_HardSigmoid(self, node, a):
+        self._simple(node, "hard_sigmoid",
+                     {"alpha": a.get("alpha", 0.2),
+                      "beta": a.get("beta", 0.5)})
+
+    def _cv_Cos(self, node, a):
+        self._simple(node, "cos")
+
+    def _cv_Sin(self, node, a):
+        self._simple(node, "sin")
+
+    def _cv_Tan(self, node, a):
+        self._simple(node, "tan")
+
+    def _cv_Acos(self, node, a):
+        self._simple(node, "arccos")
+
+    def _cv_Asin(self, node, a):
+        self._simple(node, "arcsin")
+
+    def _cv_Atan(self, node, a):
+        self._simple(node, "arctan")
+
+    # comparison / logical (ONNX outputs bool; our broadcast_* comparisons
+    # return the input dtype — downstream Cast/Where handle both)
+    def _cv_Less(self, node, a):
+        self._simple(node, "broadcast_lesser")
+
+    def _cv_Greater(self, node, a):
+        self._simple(node, "broadcast_greater")
+
+    def _cv_Equal(self, node, a):
+        self._simple(node, "broadcast_equal")
+
+    def _cv_And(self, node, a):
+        self._simple(node, "broadcast_logical_and")
+
+    def _cv_Or(self, node, a):
+        self._simple(node, "broadcast_logical_or")
+
+    def _cv_Xor(self, node, a):
+        self._simple(node, "broadcast_logical_xor")
+
+    def _cv_Not(self, node, a):
+        self._simple(node, "logical_not")
+
+    # variadic elementwise
+    def _cv_Sum(self, node, a):
+        self._simple(node, "add_n")
+
+    def _cv_Mean(self, node, a):
+        n = len(node.input)
+        s = invoke_sym("add_n", [self._in(node, i) for i in range(n)], {})
+        self.syms[node.output[0]] = invoke_sym(
+            "_div_scalar", [s], {"scalar": float(n)})
+
+    def _fold_binary(self, node, mx_op):
+        acc = self._in(node, 0)
+        for i in range(1, len(node.input)):
+            acc = invoke_sym(mx_op, [acc, self._in(node, i)], {})
+        self.syms[node.output[0]] = acc
+
+    def _cv_Max(self, node, a):
+        self._fold_binary(node, "broadcast_maximum")
+
+    def _cv_Min(self, node, a):
+        self._fold_binary(node, "broadcast_minimum")
+
+    # reductions (beyond Mean/Sum/Max/Min)
+    def _cv_ReduceProd(self, node, a):
+        self._reduce(node, a, "prod")
+
+    def _composed_reduce(self, node, a, inner, outer):
+        """outer(reduce_sum(inner(x))) — the ONNX composite reductions."""
+        axes = a.get("axes")
+        x = self._in(node, 0)
+        if inner:
+            x = invoke_sym(inner, [x], {})
+        x = invoke_sym("sum", [x],
+                       {"axis": tuple(axes) if axes else None,
+                        "keepdims": bool(a.get("keepdims", 1))})
+        if outer:
+            x = invoke_sym(outer, [x], {})
+        self.syms[node.output[0]] = x
+
+    def _cv_ReduceSumSquare(self, node, a):
+        self._composed_reduce(node, a, "square", None)
+
+    def _cv_ReduceLogSum(self, node, a):
+        self._composed_reduce(node, a, None, "log")
+
+    def _cv_ReduceL1(self, node, a):
+        self._composed_reduce(node, a, "abs", None)
+
+    def _cv_ReduceL2(self, node, a):
+        self._composed_reduce(node, a, "square", "sqrt")
+
+    def _cv_ReduceLogSumExp(self, node, a):
+        self._composed_reduce(node, a, "exp", "log")
+
+    def _cv_ArgMax(self, node, a):
+        self._simple(node, "argmax",
+                     {"axis": a.get("axis", 0),
+                      "keepdims": bool(a.get("keepdims", 1))})
+
+    def _cv_ArgMin(self, node, a):
+        self._simple(node, "argmin",
+                     {"axis": a.get("axis", 0),
+                      "keepdims": bool(a.get("keepdims", 1))})
+
+    # structure / indexing
+    def _cv_Shape(self, node, a):
+        self._simple(node, "shape_array")
+
+    def _cv_Gather(self, node, a):
+        # mode="wrap": ONNX negative indices count from the end
+        self._simple(node, "take", {"axis": a.get("axis", 0),
+                                    "mode": "wrap"})
+
+    def _cv_DepthToSpace(self, node, a):
+        self._simple(node, "depth_to_space",
+                     {"block_size": a["blocksize"]})
+
+    def _cv_SpaceToDepth(self, node, a):
+        self._simple(node, "space_to_depth",
+                     {"block_size": a["blocksize"]})
+
+    def _cv_Split(self, node, a):
+        axis = a.get("axis", 0)
+        sizes = a.get("split")
+        if sizes is None and len(node.input) > 1:  # opset 13 moved to input
+            sizes = self._const(node, 1)
+        x = self._in(node, 0)
+        if sizes is None or len(set(sizes)) == 1:
+            out = invoke_sym("split", [x],
+                             {"num_outputs": len(node.output), "axis": axis},
+                             name=node.name or None)
+            self._out(node, out)
+            return
+        start = 0
+        for i, sz in enumerate(sizes):  # unequal split -> slice_axis chain
+            self.syms[node.output[i]] = invoke_sym(
+                "slice_axis", [x],
+                {"axis": axis, "begin": start, "end": start + int(sz)})
+            start += int(sz)
+
+    _INT_HUGE = 2 ** 31 - 1
+
+    def _cv_Slice(self, node, a):
+        starts = a.get("starts")
+        if starts is not None:  # opset < 10: attributes
+            ends = a["ends"]
+            axes = a.get("axes", tuple(range(len(starts))))
+            steps = (1,) * len(starts)
+        else:  # opset >= 10: constant inputs
+            starts = self._const(node, 1)
+            ends = self._const(node, 2)
+            axes = (self._const(node, 3) if len(node.input) > 3
+                    and node.input[3] else tuple(range(len(starts))))
+            steps = (self._const(node, 4) if len(node.input) > 4
+                     and node.input[4] else (1,) * len(starts))
+        x = self._in(node, 0)
+        for ax, b, e, st in zip(axes, starts, ends, steps):
+            if st != 1:
+                raise MXNetError("Slice with step != 1 unsupported")
+            # INT64_MAX / INT32_MAX end means "to the end of the axis"
+            end = None if e >= self._INT_HUGE else int(e)
+            x = invoke_sym("slice_axis", [x],
+                           {"axis": int(ax), "begin": int(b), "end": end})
+        self.syms[node.output[0]] = x
+
+    def _cv_Pad(self, node, a):
+        pads = a.get("pads")
+        if pads is None and len(node.input) > 1:  # opset >= 11: input
+            pads = self._const(node, 1)
+        value = a.get("value", 0.0)
+        if len(node.input) > 2 and node.input[2]:
+            value = float(self._const(node, 2, kind="array").reshape(()))
+        mode = a.get("mode", "constant")
+        n = len(pads) // 2
+        # ONNX: [x1_begin..xn_begin, x1_end..xn_end] -> flat (b,a) per axis
+        pw = []
+        for i in range(n):
+            pw += [int(pads[i]), int(pads[i + n])]
+        self._simple(node, "pad",
+                     {"mode": mode, "pad_width": tuple(pw),
+                      "constant_value": value}, n_in=1)
+
+    # NN layers
+    def _cv_ConvTranspose(self, node, a):
+        kernel = tuple(a.get("kernel_shape", ()))
+        n = len(kernel)
+        out_shape = a.get("output_shape")
+        pads = tuple(a.get("pads", (0,) * (2 * n)))
+        if pads[:n] != pads[n:] and out_shape is None:
+            raise MXNetError("asymmetric ConvTranspose pads unsupported")
+        w_name = node.input[1]
+        if w_name not in self.params:
+            raise MXNetError("ConvTranspose weight must be an initializer")
+        group = a.get("group", 1)
+        # ONNX weight layout (C_in, C_out/group, *kernel) == our
+        # Deconvolution convention (ops/nn.py deconvolution)
+        num_filter = self.params[w_name].shape[1] * group
+        params = {
+            "kernel": kernel,
+            "stride": tuple(a.get("strides", (1,) * n)),
+            "dilate": tuple(a.get("dilations", (1,) * n)),
+            "num_filter": num_filter, "num_group": group,
+            "no_bias": len(node.input) < 3 or node.input[2] == ""}
+        if out_shape is not None:
+            # output_shape overrides pads: Deconvolution's target_shape
+            # runs the reference InferPad (pad/adj derived, possibly
+            # asymmetric-equivalent), matching ONNX auto-pad semantics
+            params["target_shape"] = tuple(out_shape[-n:])
+        else:
+            params["pad"] = pads[:n]
+            params["adj"] = tuple(a.get("output_padding", (0,) * n))
+        self._simple(node, "Deconvolution", params)
+
+    def _cv_FC(self, node, a):
+        """Legacy caffe2 FC (reference maps it to fully_connected)."""
+        w_name = node.input[1]
+        if w_name not in self.params:
+            raise MXNetError("FC weight must be an initializer")
+        self._simple(node, "FullyConnected",
+                     {"num_hidden": self.params[w_name].shape[0],
+                      "no_bias": len(node.input) < 3})
+
+    def _cv_LRN(self, node, a):
+        self._simple(node, "LRN", {
+            "nsize": a["size"], "alpha": a.get("alpha", 1e-4),
+            "beta": a.get("beta", 0.75), "knorm": a.get("bias", 1.0)})
+
+    def _cv_InstanceNormalization(self, node, a):
+        self._simple(node, "InstanceNorm",
+                     {"eps": a.get("epsilon", 1e-5)}, n_in=3)
+
+    def _cv_MaxRoiPool(self, node, a):
+        self._simple(node, "ROIPooling", {
+            "pooled_size": tuple(a["pooled_shape"]),
+            "spatial_scale": a.get("spatial_scale", 1.0)})
+
+    def _cv_LpPool(self, node, a):
+        kernel = tuple(a.get("kernel_shape", ()))
+        n = len(kernel)
+        pads = tuple(a.get("pads", (0,) * (2 * n)))
+        if pads[:n] != pads[n:]:
+            raise MXNetError("asymmetric LpPool pads unsupported")
+        self._simple(node, "Pooling", {
+            "kernel": kernel, "pool_type": "lp",
+            "p_value": a.get("p", 2),
+            "stride": tuple(a.get("strides", (1,) * n)),
+            "pad": pads[:n]}, n_in=1)
+
+    def _cv_GlobalLpPool(self, node, a):
+        self._simple(node, "Pooling",
+                     {"pool_type": "lp", "p_value": a.get("p", 2),
+                      "global_pool": True, "kernel": ()})
+
+    # random
+    def _cv_RandomUniform(self, node, a):
+        dt = _DTYPES.get(a.get("dtype", P.TensorProto.FLOAT), _np.float32)
+        self.syms[node.output[0]] = invoke_sym(
+            "_random_uniform", [],
+            {"low": a.get("low", 0.0), "high": a.get("high", 1.0),
+             "shape": tuple(a["shape"]), "dtype": _np.dtype(dt).name})
+
+    def _cv_RandomNormal(self, node, a):
+        dt = _DTYPES.get(a.get("dtype", P.TensorProto.FLOAT), _np.float32)
+        self.syms[node.output[0]] = invoke_sym(
+            "_random_normal", [],
+            {"loc": a.get("mean", 0.0), "scale": a.get("scale", 1.0),
+             "shape": tuple(a["shape"]), "dtype": _np.dtype(dt).name})
+
+    def _like_dtype(self, a):
+        if "dtype" not in a:
+            return None
+        dt = _DTYPES.get(a["dtype"])
+        if dt is None:
+            raise MXNetError("Random*Like dtype %r unsupported" % a["dtype"])
+        return _np.dtype(dt).name
+
+    def _cv_RandomUniformLike(self, node, a):
+        self._simple(node, "_random_uniform_like",
+                     {"low": a.get("low", 0.0), "high": a.get("high", 1.0),
+                      "dtype": self._like_dtype(a)})
+
+    def _cv_RandomNormalLike(self, node, a):
+        self._simple(node, "_random_normal_like",
+                     {"loc": a.get("mean", 0.0),
+                      "scale": a.get("scale", 1.0),
+                      "dtype": self._like_dtype(a)})
+
 
 def import_model(model_file, for_training=False):
     """Read a .onnx file -> (sym, arg_params, aux_params) (reference
@@ -398,7 +726,12 @@ def import_model(model_file, for_training=False):
     model = P.ModelProto.decode(data)
     if model.graph is None:
         raise MXNetError("%s contains no graph" % model_file)
-    return _Importer(model.graph, for_training=for_training).run()
+    opset = 9
+    for osi in model.opset_import:
+        if not osi.domain:  # default ONNX domain
+            opset = osi.version
+    return _Importer(model.graph, for_training=for_training,
+                     opset=opset).run()
 
 
 def get_model_metadata(model_file):

@@ -94,7 +94,9 @@ def slice_op(data, *, begin, end, step=None):
 
 
 @register("slice_axis")
-def slice_axis(data, *, axis, begin, end):
+def slice_axis(data, *, axis, begin=0, end=None):
+    # end=None slices to the end of the axis (reference slice_axis accepts
+    # None for both bounds)
     idx = [slice(None)] * data.ndim
     idx[axis] = slice(begin, end)
     return data[tuple(idx)]

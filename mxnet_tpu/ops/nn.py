@@ -172,7 +172,7 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter,
 @register("Pooling")
 def pooling(data, *, kernel=(), pool_type="max", global_pool=False,
             stride=None, pad=None, pooling_convention="valid",
-            count_include_pad=True, cudnn_off=False):
+            count_include_pad=True, cudnn_off=False, p_value=2):
     n = data.ndim - 2
     if global_pool:
         kernel = data.shape[2:]
@@ -208,8 +208,18 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False,
         cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return s / cnt
     if pool_type == "lp":
-        p2 = lax.reduce_window(jnp.square(data), 0.0, lax.add, window, strides, padding)
-        return jnp.sqrt(p2)
+        # reference pooling-inl.h: Lp pooling with integer p (1/2/3 common)
+        p = int(p_value)
+        if p == 1:
+            return lax.reduce_window(jnp.abs(data), 0.0, lax.add, window,
+                                     strides, padding)
+        if p == 2:
+            p2 = lax.reduce_window(jnp.square(data), 0.0, lax.add, window,
+                                   strides, padding)
+            return jnp.sqrt(p2)
+        pp = lax.reduce_window(jnp.abs(data) ** p, 0.0, lax.add, window,
+                               strides, padding)
+        return pp ** (1.0 / p)
     raise ValueError("unknown pool_type %r" % pool_type)
 
 

@@ -34,6 +34,23 @@ def random_normal(*, loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
 alias("_random_normal", "_random_gaussian")
 
 
+@register("_random_uniform_like", is_random=True)
+def random_uniform_like(data, *, low=0.0, high=1.0, dtype=None, ctx=None):
+    """Draw uniform samples shaped like ``data`` (reference
+    sample_op.cc `_random_uniform_like`)."""
+    return jax.random.uniform(_random.next_key(), data.shape,
+                              _dt(dtype) if dtype else data.dtype, low, high)
+
+
+@register("_random_normal_like", is_random=True)
+def random_normal_like(data, *, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    """Draw normal samples shaped like ``data`` (reference
+    sample_op.cc `_random_normal_like`)."""
+    return loc + scale * jax.random.normal(
+        _random.next_key(), data.shape,
+        _dt(dtype) if dtype else data.dtype)
+
+
 @register("_random_gamma", is_random=True)
 def random_gamma(*, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None):
     return beta * jax.random.gamma(_random.next_key(), alpha, tuple(shape),

@@ -1,0 +1,176 @@
+"""int8-vs-bf16 MXU throughput microbenchmark (VERDICT r4 item 5).
+
+The reference's int8 deployment story rests on int8 inference being
+faster than the float path (reference contrib/quantization.py:84-205,
+src/operator/quantization/quantize_graph_pass.cc). Our quantized ops
+lower to `lax.dot_general`/`conv_general_dilated` with int8 inputs and
+`preferred_element_type=int32` (ops/quantization.py) — this benchmark
+proves on hardware that the integer path actually engages the MXU
+rather than silently upcasting: it times the SAME shapes in bf16 and
+int8 and reports achieved TOP/s for both.
+
+Shapes: the ResNet-50 hot convs plus square FC matmuls. Each case
+prints one line; the summary prints int8/bf16 throughput ratios.
+
+    python tools/microbench_int8.py --iters 50
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+MATMUL_SHAPES = [  # (M, K, N)
+    (1024, 1024, 1024),
+    (4096, 4096, 4096),
+    (8192, 8192, 8192),
+    (128, 2048, 1000),     # ResNet-50 classifier at batch 128
+]
+
+# (N, C, H, W, O, kh, kw, stride) — ResNet-50 hot convs at batch 128
+CONV_SHAPES = [
+    (128, 256, 56, 56, 64, 1, 1, 1),
+    (128, 128, 28, 28, 128, 3, 3, 1),
+    (128, 256, 14, 14, 256, 3, 3, 1),
+    (128, 512, 7, 7, 512, 3, 3, 1),
+]
+
+
+def _time_fn(fn, *args, iters=50):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_matmuls(iters):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows = []
+    for m, k, n in MATMUL_SHAPES:
+        rng = np.random.RandomState(0)
+        a_f = jnp.asarray(rng.randn(m, k), jnp.bfloat16)
+        b_f = jnp.asarray(rng.randn(k, n), jnp.bfloat16)
+        a_i = jnp.asarray(rng.randint(-127, 128, (m, k)), jnp.int8)
+        b_i = jnp.asarray(rng.randint(-127, 128, (k, n)), jnp.int8)
+
+        f_bf16 = jax.jit(lambda a, b: lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))
+        f_int8 = jax.jit(lambda a, b: lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32))
+
+        ops = 2.0 * m * k * n
+        t_f = _time_fn(f_bf16, a_f, b_f, iters=iters)
+        t_i = _time_fn(f_int8, a_i, b_i, iters=iters)
+        rows.append(("matmul %dx%dx%d" % (m, k, n),
+                     ops / t_f / 1e12, ops / t_i / 1e12))
+        print("matmul %5dx%5dx%5d  bf16 %7.1f TOP/s  int8 %7.1f TOP/s  "
+              "ratio %.2fx" % (m, k, n, ops / t_f / 1e12, ops / t_i / 1e12,
+                               t_f / t_i), flush=True)
+    return rows
+
+
+def bench_convs(iters):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows = []
+    for (n, c, h, w, o, kh, kw, s) in CONV_SHAPES:
+        rng = np.random.RandomState(0)
+        pad = kh // 2
+        x_f = jnp.asarray(rng.randn(n, c, h, w), jnp.bfloat16)
+        k_f = jnp.asarray(rng.randn(o, c, kh, kw), jnp.bfloat16)
+        x_i = jnp.asarray(rng.randint(-127, 128, (n, c, h, w)), jnp.int8)
+        k_i = jnp.asarray(rng.randint(-127, 128, (o, c, kh, kw)), jnp.int8)
+        dn = lax.conv_dimension_numbers(x_f.shape, k_f.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+
+        def conv(x, k, ptype):
+            return lax.conv_general_dilated(
+                x, k, window_strides=(s, s), padding=[(pad, pad)] * 2,
+                dimension_numbers=dn, preferred_element_type=ptype)
+
+        f_bf16 = jax.jit(lambda x, k: conv(x, k, jnp.float32))
+        f_int8 = jax.jit(lambda x, k: conv(x, k, jnp.int32))
+
+        oh, ow = h // s, w // s
+        ops = 2.0 * n * o * oh * ow * c * kh * kw
+        t_f = _time_fn(f_bf16, x_f, k_f, iters=iters)
+        t_i = _time_fn(f_int8, x_i, k_i, iters=iters)
+        rows.append(("conv %dx%dx%dx%d k%d" % (n, c, h, w, kh),
+                     ops / t_f / 1e12, ops / t_i / 1e12))
+        print("conv  n%d c%4d %3dx%3d o%4d k%d  bf16 %7.1f TOP/s  int8 "
+              "%7.1f TOP/s  ratio %.2fx" % (n, c, h, w, o, kh,
+                                            ops / t_f / 1e12,
+                                            ops / t_i / 1e12, t_f / t_i),
+              flush=True)
+    return rows
+
+
+def bench_quantized_fc(iters):
+    """End-to-end registered op: quantize -> quantized FC -> dequantize,
+    vs the bf16 Dense it replaces — the serving-path comparison."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx  # noqa: F401  (registers ops)
+    from mxnet_tpu.ops import quantization as q
+
+    m, k, n = 128, 2048, 1000
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    wgt = jnp.asarray(rng.randn(n, k), jnp.float32)
+
+    qw, w_lo, w_hi = q.quantize_v2(wgt, out_type="int8")
+
+    @jax.jit
+    def int8_path(x, qw, w_lo, w_hi):
+        qx, x_lo, x_hi = q.quantize_v2(x, out_type="int8")
+        acc, o_lo, o_hi = q.quantized_fully_connected(
+            qx, qw, None, x_lo, x_hi, w_lo, w_hi, None, None,
+            num_hidden=n, no_bias=True)
+        return q.dequantize(acc, o_lo, o_hi)
+
+    @jax.jit
+    def bf16_path(x, w):
+        return (x.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16).T
+                ).astype(jnp.float32)
+
+    t_i = _time_fn(int8_path, x, qw, w_lo, w_hi, iters=iters)
+    t_f = _time_fn(bf16_path, x, wgt, iters=iters)
+    print("quantized FC end-to-end %dx%dx%d  bf16 %.3f ms  int8(+q/dq) "
+          "%.3f ms  ratio %.2fx" % (m, k, n, t_f * 1e3, t_i * 1e3,
+                                    t_f / t_i), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=50)
+    args = ap.parse_args()
+    import jax
+    dev = jax.devices()[0]
+    print("device: %s (%s)" % (dev.device_kind, dev.platform), flush=True)
+    m = bench_matmuls(args.iters)
+    c = bench_convs(args.iters)
+    bench_quantized_fc(args.iters)
+    ratios = [i / f for (_, f, i) in m + c if f > 0]
+    print("int8/bf16 throughput ratio: min %.2f median %.2f max %.2f"
+          % (min(ratios), sorted(ratios)[len(ratios) // 2], max(ratios)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Extra on-chip phases beyond tools/onchip_session.sh — run by
+# tools/chip_watcher.sh right after the main session. Each phase guards
+# its own tunnel probe and logs incrementally, so a mid-session tunnel
+# loss still leaves earlier results.
+#
+#   bash tools/onchip_extra.sh [logdir]
+#
+# Phase A  int8 microbench   — is int8 actually faster than bf16 on the
+#                              MXU? (VERDICT r4 item 5)
+# Phase B  LSTM re-capture   — post-projection-hoist tokens/s (item 4)
+# Phase C  RecordIO bench    — decode->staging->H2D overlap vs synthetic
+#                              (item 3; BENCH_RECORDIO=1)
+# Phase D  memory/donation   — compiled memory_analysis + donation alias
+#                              check on the real PJRT plugin (item 2c)
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/onchip}
+mkdir -p "$LOG"
+
+probe() {
+  timeout 90 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+phase() {  # phase <name> <timeout> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  if ! probe; then
+    echo "[extra] tunnel down before $name — stopping"; exit 2
+  fi
+  echo "[extra] phase $name"
+  timeout "$tmo" "$@" 2>&1 | tee "$LOG/$name.log" | grep -v -E "WARN|axon_"
+}
+
+phase int8 1800 python -u tools/microbench_int8.py --iters 50
+phase lstm 1800 python -u tools/bench_lstm.py --steps 30
+phase recordio 3600 env BENCH_RECORDIO=1 BENCH_K=30 python -u bench.py
+phase memdonation 1800 python -u tools/diagnose_step_hlo.py --on-chip
+
+echo "[extra] done — logs in $LOG"
