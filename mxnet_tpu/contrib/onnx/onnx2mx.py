@@ -728,8 +728,8 @@ def import_model(model_file, for_training=False):
         raise MXNetError("%s contains no graph" % model_file)
     opset = 9
     for osi in model.opset_import:
-        if not osi.domain:  # default ONNX domain
-            opset = osi.version
+        if osi.domain in ("", "ai.onnx"):  # both spellings of the
+            opset = osi.version            # default ONNX domain
     return _Importer(model.graph, for_training=for_training,
                      opset=opset).run()
 

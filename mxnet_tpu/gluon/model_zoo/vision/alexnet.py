@@ -42,7 +42,9 @@ class AlexNet(HybridBlock):
         return self.output(x)
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
+def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     if pretrained:
-        raise RuntimeError("no pretrained weights in this environment")
+        from ..model_store import load_pretrained
+        net = AlexNet(**kwargs)
+        return load_pretrained(net, "alexnet", root=root, ctx=ctx)
     return AlexNet(**kwargs)

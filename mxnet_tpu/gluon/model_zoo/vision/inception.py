@@ -168,7 +168,9 @@ class Inception3(HybridBlock):
         return self.output(x)
 
 
-def inception_v3(pretrained=False, ctx=None, **kwargs):
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     if pretrained:
-        raise RuntimeError("no pretrained weights in this environment")
+        from ..model_store import load_pretrained
+        net = Inception3(**kwargs)
+        return load_pretrained(net, "inceptionv3", root=root, ctx=ctx)
     return Inception3(**kwargs)

@@ -117,16 +117,32 @@ class MobileNetV2(HybridBlock):
         return self.output(x)
 
 
-def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise RuntimeError("no pretrained weights in this environment")
-    return MobileNet(multiplier, **kwargs)
+def _multiplier_suffix(multiplier):
+    # zoo naming: 1.0 / 0.75 / 0.5 / 0.25 (reference get_mobilenet
+    # version_suffix trimming)
+    s = "%.2f" % multiplier
+    return s[:-1] if s.endswith("0") else s
 
 
-def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, **kwargs):
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
+    net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise RuntimeError("no pretrained weights in this environment")
-    return MobileNetV2(multiplier, **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenet%s" % _multiplier_suffix(multiplier),
+                        root=root, ctx=ctx)
+    return net
+
+
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net,
+                        "mobilenetv2_%s" % _multiplier_suffix(multiplier),
+                        root=root, ctx=ctx)
+    return net
 
 
 def mobilenet1_0(**kwargs):

@@ -241,7 +241,8 @@ resnet_block_versions = [{"basic_block": BasicBlockV1,
                           "bottle_neck": BottleneckV2}]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
+               **kwargs):
     assert num_layers in resnet_spec, \
         "Invalid number of layers: %d. Options are %s" % (
             num_layers, str(resnet_spec.keys()))
@@ -251,8 +252,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise RuntimeError("no pretrained weights available in this "
-                           "environment (zero egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "resnet%d_v%d" % (num_layers, version),
+                        root=root, ctx=ctx)
     return net
 
 

@@ -84,10 +84,13 @@ class SqueezeNet(HybridBlock):
         return self.output(x)
 
 
-def get_squeezenet(version, pretrained=False, ctx=None, **kwargs):
+def get_squeezenet(version, pretrained=False, ctx=None, root=None,
+                   **kwargs):
+    net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise RuntimeError("no pretrained weights in this environment")
-    return SqueezeNet(version, **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "squeezenet%s" % version, root=root, ctx=ctx)
+    return net
 
 
 def squeezenet1_0(**kwargs):

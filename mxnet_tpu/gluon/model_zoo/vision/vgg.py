@@ -51,11 +51,15 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 
-def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise RuntimeError("no pretrained weights in this environment")
+def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        name = "vgg%d%s" % (num_layers,
+                            "_bn" if kwargs.get("batch_norm") else "")
+        load_pretrained(net, name, root=root, ctx=ctx)
+    return net
 
 
 def vgg11(**kwargs):

@@ -1,0 +1,119 @@
+"""Pretrained model-zoo store (parity: python/mxnet/gluon/model_zoo/
+model_store.py get_model_file/purge + the zoo factories' pretrained=
+path, reference vision/resnet.py:388-390).
+
+No network exists here, so fixtures are generated: a zoo net's params
+are saved in reference ``.params`` format and resolved back through the
+public ``pretrained=True`` surface.
+"""
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+
+def _save_fixture(name, root, fname=None, ctor=None):
+    """Initialize zoo model `name` and save its params as a fixture."""
+    net = (ctor or (lambda: vision.get_model(name)))()
+    net.initialize(mx.initializer.Xavier())
+    # materialize params (deferred init) with one tiny forward
+    net(mx.nd.zeros((1, 3, 224, 224)))
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, fname or ("%s.params" % name))
+    net.save_parameters(path)
+    return net, path
+
+
+def test_get_model_file_resolves_plain_params(tmp_path):
+    root = str(tmp_path / "models")
+    _save_fixture("squeezenet1.0", root)
+    path = model_store.get_model_file("squeezenet1.0", root=root)
+    assert path.endswith("squeezenet1.0.params")
+
+
+def test_pretrained_true_loads_weights(tmp_path):
+    root = str(tmp_path / "models")
+    src, _ = _save_fixture("squeezenet1.0", root)
+    net = vision.get_model("squeezenet1.0", pretrained=True, root=root)
+    x = mx.nd.array(np.random.RandomState(0).randn(1, 3, 224, 224)
+                    .astype(np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pretrained_via_local_repo_dir(tmp_path, monkeypatch):
+    repo = str(tmp_path / "repo")
+    root = str(tmp_path / "cache")
+    _save_fixture("mobilenet0.25", repo)
+    monkeypatch.setenv("MXNET_GLUON_REPO", repo)
+    net = vision.get_model("mobilenet0.25", pretrained=True, root=root)
+    assert os.path.exists(os.path.join(root, "mobilenet0.25.params"))
+    assert any(p.shape for p in net.collect_params().values())
+
+
+def test_pretrained_via_repo_zip(tmp_path, monkeypatch):
+    repo = str(tmp_path / "repo")
+    root = str(tmp_path / "cache")
+    _, params_path = _save_fixture("squeezenet1.1", str(tmp_path / "stage"))
+    os.makedirs(repo, exist_ok=True)
+    short = model_store.short_hash("squeezenet1.1")
+    with zipfile.ZipFile(os.path.join(
+            repo, "squeezenet1.1-%s.zip" % short), "w") as zf:
+        zf.write(params_path, "squeezenet1.1.params")
+    monkeypatch.setenv("MXNET_GLUON_REPO", repo)
+    path = model_store.get_model_file("squeezenet1.1", root=root)
+    assert path.endswith("squeezenet1.1.params")
+
+
+def test_hash_named_file_with_wrong_content_is_rejected(tmp_path):
+    """A reference-hash-named file must byte-verify; junk is refused
+    loudly rather than loaded."""
+    root = str(tmp_path / "models")
+    os.makedirs(root)
+    short = model_store.short_hash("resnet18_v1")
+    with open(os.path.join(root, "resnet18_v1-%s.params" % short),
+              "wb") as f:
+        f.write(b"junk")
+    with pytest.raises(RuntimeError, match="resnet18_v1"):
+        model_store.get_model_file("resnet18_v1", root=root)
+
+
+def test_missing_model_error_names_locations(tmp_path):
+    with pytest.raises(RuntimeError) as e:
+        model_store.get_model_file("resnet50_v2", root=str(tmp_path))
+    assert "resnet50_v2" in str(e.value)
+    assert str(tmp_path) in str(e.value)
+
+
+def test_unknown_model_short_hash_raises():
+    with pytest.raises(ValueError, match="not available"):
+        model_store.short_hash("not_a_model")
+
+
+def test_purge(tmp_path):
+    root = str(tmp_path / "models")
+    os.makedirs(root)
+    for n in ("a.params", "b.params"):
+        open(os.path.join(root, n), "wb").close()
+    open(os.path.join(root, "keep.txt"), "wb").close()
+    model_store.purge(root=root)
+    assert os.listdir(root) == ["keep.txt"]
+
+
+def test_factory_name_mapping():
+    """Every get_model zoo name maps to a known store entry, so
+    pretrained= resolution agrees with the reference's table."""
+    from mxnet_tpu.gluon.model_zoo.vision.mobilenet import \
+        _multiplier_suffix
+    assert _multiplier_suffix(1.0) == "1.0"
+    assert _multiplier_suffix(0.75) == "0.75"
+    assert _multiplier_suffix(0.5) == "0.5"
+    assert _multiplier_suffix(0.25) == "0.25"
+    for name in ("resnet18_v1", "resnet152_v2", "vgg16", "vgg19_bn",
+                 "alexnet", "densenet201", "squeezenet1.0", "inceptionv3",
+                 "mobilenet0.5", "mobilenetv2_1.0"):
+        assert name in model_store._model_sha1

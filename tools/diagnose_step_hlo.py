@@ -131,12 +131,18 @@ def analyze_stablehlo(text):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin jax to CPU (lowering-only analysis; the "
+                         "env var JAX_PLATFORMS=cpu is overridden by "
+                         "sitecustomize here, so use this flag)")
     ap.add_argument("--on-chip", action="store_true",
                     help="compile on the device: memory_analysis + "
                          "donation aliases + post-opt HLO counts")
     args = ap.parse_args()
 
     import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     print("device: %s (%s)  batch=%d  conv_layout=%s"
           % (dev.device_kind, dev.platform, args.batch,
