@@ -69,26 +69,36 @@ class KVStore:
         import numpy as _np2
         from .parallel import dist as _dist
         from .parallel import async_server as _async
-        if _dist.rank() == 0:
-            self._async_server = _async.Server()
-            port = self._async_server.port
-        else:
-            port = 0
-        port = int(_np2.asarray(
-            _dist.broadcast(_np2.array([port], _np2.int32)))[0])
-        host = os.environ.get("MXNET_ASYNC_SERVER_HOST")
-        if host is None:
+        def coordinator_host():
+            """Host of the job coordinator: launcher env, else the address
+            an externally-initialized jax.distributed actually dialed
+            (rank 0's machine — the same machine hosting the server
+            thread)."""
             addr = _dist.env_spec()[0]
             if addr is None:
-                # externally-initialized jax.distributed: reuse the
-                # coordinator host it actually dialed (rank 0's machine —
-                # the same machine hosting the async server thread)
                 try:
                     from jax._src import distributed as _jd
                     addr = _jd.global_state.coordinator_address
                 except Exception:
                     addr = None
-            host = addr.rsplit(":", 1)[0] if addr else "127.0.0.1"
+            return _async._host_of(addr) if addr else None
+
+        if _dist.rank() == 0:
+            # with a job secret the server binds the coordinator interface
+            # (reachable by remote workers, frames authenticated); without
+            # one it stays loopback-only — see async_server.py trust model
+            bind = None
+            if os.environ.get("MXNET_KVSTORE_SECRET") and \
+                    not os.environ.get("MXNET_KVSTORE_BIND"):
+                bind = coordinator_host()
+            self._async_server = _async.Server(bind=bind)
+            port = self._async_server.port
+        else:
+            port = 0
+        port = int(_np2.asarray(
+            _dist.broadcast(_np2.array([port], _np2.int32)))[0])
+        host = os.environ.get("MXNET_ASYNC_SERVER_HOST") \
+            or coordinator_host() or "127.0.0.1"
         self._async_client = _async.Client(host, port)
 
     # ------------------------------------------------------------- metadata

@@ -7,85 +7,184 @@ workers — and pulls return whatever the weights are right now. Workers
 therefore progress at their own pace (Hogwild-style bounded staleness).
 
 TPU-native placement: the reference runs dedicated server *processes*
-(ps-lite); here the server is a background THREAD on rank 0 speaking a tiny
-length-prefixed-pickle TCP protocol. Rationale: the synchronous fast path
-does not need a server at all (GSPMD collectives inside the fused step), so
-the async path only has to serve the eager kvstore surface — a host thread
-next to rank 0's chip is the lightest faithful topology, and the update math
-runs through the same Optimizer/Updater code the local kvstore uses (the
-reference pickles the optimizer to the server the same way,
-python/mxnet/kvstore.py set_optimizer).
+(ps-lite); here the server is a background THREAD on rank 0. Rationale: the
+synchronous fast path does not need a server at all (GSPMD collectives inside
+the fused step), so the async path only has to serve the eager kvstore
+surface — a host thread next to rank 0's chip is the lightest faithful
+topology, and the update math runs through the same Optimizer/Updater code
+the local kvstore uses.
 
-Protocol messages (all pickled tuples): ("init", key, np_value),
-("push", key, np_grad), ("pull", key), ("set_optimizer", bytes),
-("command", head, body), ("stats",), ("shutdown",).
+Wire protocol + trust model (ps-lite message framing analog,
+reference src/kvstore/kvstore_dist.h:44-58; see docs/distributed.md):
+
+* Frame: ``<Q total_len> <32B HMAC-SHA256 tag> <payload>``; payload is
+  ``<I header_len> <JSON header> <raw tensor bytes>``. Tensors travel as
+  raw little-endian buffers described by header dtype/shape — NO pickle
+  on the tensor path.
+* Every frame is HMAC-authenticated with a shared secret
+  (``MXNET_KVSTORE_SECRET``) and VERIFIED BEFORE ANY PARSING; a bad tag
+  drops the connection. Without an explicit secret the server generates
+  a process-local one and binds LOOPBACK ONLY, so it is unreachable
+  remotely. Binding a non-loopback interface (``MXNET_KVSTORE_BIND`` or
+  the coordinator interface on multi-host fleets) requires an explicit
+  shared secret — refused loudly otherwise.
+* ``set_optimizer`` is the one opaque payload (the reference ships the
+  pickled optimizer the same way, python/mxnet/kvstore.py
+  set_optimizer); it deserializes only after HMAC verification, so only
+  holders of the secret can reach that code path.
+* Each client THREAD gets its own connection (thread-local socket), so
+  one worker's push and pull overlap instead of serializing through a
+  single socket, and a large push does not head-of-line-block control
+  messages on another thread.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
+import json
+import os
 import pickle
+import secrets as _secrets
 import socket
 import socketserver
 import struct
 import threading
+import weakref
 
 import numpy as _np
 
 __all__ = ["Server", "Client"]
 
+_TAG_LEN = 32
 
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+# refuse absurd frame-length claims BEFORE buffering the payload — an
+# unauthenticated peer controls the length field (tag checks come after
+# the read). Tunable for jobs shipping truly huge single tensors.
+_MAX_FRAME = int(os.environ.get("MXNET_KVSTORE_MAX_FRAME", str(1 << 32)))
+
+# process-local default secret: single-process topologies (server thread +
+# in-process clients) share it implicitly; separate processes must export
+# MXNET_KVSTORE_SECRET (tools/launch.py generates one per job)
+_process_secret = _secrets.token_bytes(32)
 
 
-def _recv_msg(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        hdr += chunk
-    (n,) = struct.unpack("<Q", hdr)
+def _secret():
+    """Derived HMAC key. Called once per Server/Client construction —
+    not per frame — so env lookup + sha256 stay off the hot path."""
+    s = os.environ.get("MXNET_KVSTORE_SECRET")
+    if s:
+        return hashlib.sha256(s.encode()).digest()
+    return _process_secret
+
+
+def _is_loopback(bind):
+    return bind in ("127.0.0.1", "localhost", "::1")
+
+
+def _send_frame(sock, header, blob=b"", key=None):
+    hdr = json.dumps(header).encode()
+    payload = struct.pack("<I", len(hdr)) + hdr + blob
+    tag = hmac.new(key or _secret(), payload, hashlib.sha256).digest()
+    sock.sendall(struct.pack("<Q", _TAG_LEN + len(payload)) + tag + payload)
+
+
+def _host_of(addr):
+    """Host part of a ``host:port`` coordinator address; tolerates
+    bracketed IPv6 (``[::1]:9091`` -> ``::1``)."""
+    host = addr.rsplit(":", 1)[0]
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    return host
+
+
+def _recv_exact(sock, n):
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
-            raise ConnectionError("peer closed mid-message")
+            raise ConnectionError("peer closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return bytes(buf)
+
+
+def _recv_frame(sock, key=None):
+    (total,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if total < _TAG_LEN + 4 or total > _MAX_FRAME:
+        raise ConnectionError("malformed frame (claimed %d bytes)" % total)
+    tag = _recv_exact(sock, _TAG_LEN)
+    payload = _recv_exact(sock, total - _TAG_LEN)
+    # authenticate BEFORE parsing anything
+    want = hmac.new(key or _secret(), payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, want):
+        raise ConnectionError("frame failed authentication")
+    (hlen,) = struct.unpack("<I", payload[:4])
+    header = json.loads(payload[4:4 + hlen].decode())
+    return header, payload[4 + hlen:]
+
+
+def _pack_array(arr):
+    arr = _np.ascontiguousarray(arr)
+    return ({"dtype": arr.dtype.str, "shape": list(arr.shape)},
+            arr.tobytes())
+
+
+def _unpack_array(meta, blob):
+    return _np.frombuffer(blob, dtype=_np.dtype(meta["dtype"])) \
+        .reshape(meta["shape"]).copy()
 
 
 class Server:
-    """Rank-0 async parameter server thread."""
+    """Rank-0 async parameter server thread.
 
-    def __init__(self):
+    ``bind``: interface to listen on. Defaults to ``MXNET_KVSTORE_BIND``,
+    else loopback. Non-loopback binds require MXNET_KVSTORE_SECRET."""
+
+    def __init__(self, bind=None):
+        bind = bind or os.environ.get("MXNET_KVSTORE_BIND") or "127.0.0.1"
+        if not _is_loopback(bind) and \
+                not os.environ.get("MXNET_KVSTORE_SECRET"):
+            raise RuntimeError(
+                "async kvstore server: refusing to bind non-loopback "
+                "interface %r without MXNET_KVSTORE_SECRET set — remote "
+                "peers must authenticate (see docs/distributed.md)" % bind)
         self._store = {}          # key -> np.ndarray (current weights)
         self._updater = None
         self._locks = {}          # per-key: pushes to different keys overlap
         self._glock = threading.Lock()
         self._push_log = []       # (monotonic_ts, key) — test observability
         self._commands = []
+        self._hmac_key = _secret()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
                     while True:
-                        msg = _recv_msg(self.request)
-                        reply = outer._dispatch(msg)
-                        _send_msg(self.request, reply)
-                        if msg[0] == "shutdown":
+                        header, blob = _recv_frame(self.request,
+                                                   key=outer._hmac_key)
+                        try:
+                            reply_hdr, reply_blob = outer._dispatch(header,
+                                                                    blob)
+                        except Exception as e:  # authenticated-but-bad
+                            # frame (e.g. version skew): protocol error
+                            # reply, not a handler traceback + disconnect
+                            reply_hdr, reply_blob = {
+                                "status": "err",
+                                "error": "%s: %s" % (type(e).__name__,
+                                                     e)}, b""
+                        _send_frame(self.request, reply_hdr, reply_blob,
+                                    key=outer._hmac_key)
+                        if header.get("op") == "shutdown":
                             return
-                except (ConnectionError, OSError):
-                    return
+                except (ConnectionError, OSError, ValueError):
+                    return  # incl. failed authentication: drop the peer
 
         class TS(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
-        # all interfaces: workers dial the coordinator host's address on
-        # multi-host fleets, not loopback
-        self._srv = TS(("0.0.0.0", 0), Handler)
+        self._srv = TS((bind, 0), Handler)
+        self.bind = bind
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True,
@@ -97,60 +196,66 @@ class Server:
         with self._glock:
             return self._locks.setdefault(key, threading.Lock())
 
-    def _dispatch(self, msg):
+    def _dispatch(self, header, blob):
         import time
-        op = msg[0]
+        op = header.get("op")
+        key = header.get("key")
         if op == "init":
-            _, key, value = msg
             with self._key_lock(key):
                 # first writer wins (reference server: init is idempotent)
-                self._store.setdefault(key, _np.array(value))
-            return ("ok",)
+                self._store.setdefault(key, _unpack_array(header, blob))
+            return {"status": "ok"}, b""
         if op == "push":
-            _, key, grad = msg
-            return self._handle_push(key, grad, time)
+            return self._handle_push(key, _unpack_array(header, blob), time)
         if op == "pushq":
             # 2-bit wire-compressed push: the worker shipped PACKED codes
             # (~16x smaller than f32); dequantize server-side
             from ..kvstore import _dequantize_2bit
-            _, key, packed, shape, thr = msg
+            packed = _np.frombuffer(blob, _np.uint8)
             return self._handle_push(
-                key, _dequantize_2bit(packed, shape, thr), time)
+                key, _dequantize_2bit(packed, tuple(header["shape"]),
+                                      header["thr"]), time)
         if op == "pull":
-            _, key = msg
             with self._key_lock(key):
                 if key not in self._store:
-                    return ("err", "key %r not initialized" % key)
-                return ("ok", _np.array(self._store[key]))
+                    return {"status": "err",
+                            "error": "key %r not initialized" % key}, b""
+                meta, raw = _pack_array(self._store[key])
+                meta["status"] = "ok"
+                return meta, raw
         if op == "set_optimizer":
             from .. import optimizer as _opt
-            optimizer = pickle.loads(msg[1])
+            # opaque payload — reached only through an authenticated frame
+            optimizer = pickle.loads(blob)
             self._updater = _opt.get_updater(optimizer)
-            return ("ok",)
+            return {"status": "ok"}, b""
         if op == "command":
             # reference kSetOptimizer-style control messages
             # (include/mxnet/kvstore.h:49); recorded and ack'd
-            self._commands.append((msg[1], msg[2]))
-            return ("ok",)
+            self._commands.append((header["head"], header["body"]))
+            return {"status": "ok"}, b""
         if op == "stats":
-            return ("ok", {"pushes": list(self._push_log),
-                           "commands": list(self._commands)})
+            return {"status": "ok",
+                    "stats": {"pushes": list(self._push_log),
+                              "commands": [list(c) for c in
+                                           self._commands]}}, b""
         if op == "shutdown":
             threading.Thread(target=self._srv.shutdown,
                              daemon=True).start()
-            return ("ok",)
-        return ("err", "unknown op %r" % (op,))
+            return {"status": "ok"}, b""
+        return {"status": "err", "error": "unknown op %r" % (op,)}, b""
 
     def _handle_push(self, key, grad, time):
         with self._key_lock(key):
             if key not in self._store:
-                return ("err", "key %r not initialized" % key)
+                return {"status": "err",
+                        "error": "key %r not initialized" % key}, b""
             if self._updater is None:
                 self._store[key] = _np.array(grad)
             else:
                 self._apply(key, grad)
         self._push_log.append((time.monotonic(), key))
-        return ("ok",)
+        return {"status": "ok"}, b""
 
     def _apply(self, key, grad):
         """Apply one push through the real Updater — identical math to the
@@ -172,23 +277,87 @@ def _key_int(key):
 
 
 class Client:
-    """One worker's connection to the async server."""
+    """One worker's connection pool to the async server.
+
+    Connections are per-thread (thread-local), so calls from different
+    threads — e.g. a trainer pushing while a prefetcher pulls — overlap
+    on independent sockets instead of serializing behind one lock."""
 
     def __init__(self, host, port, timeout=60.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._lock = threading.Lock()
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._hmac_key = _secret()
+        self._tls = threading.local()
+        self._conns = []          # weakrefs: threads own their sockets
+        self._conns_lock = threading.Lock()
+        self._connect()  # fail fast on a bad address
 
-    def call(self, *msg):
-        with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
-        if reply[0] != "ok":
+    def _connect(self):
+        sock = getattr(self._tls, "sock", None)
+        if sock is None:
+            sock = socket.create_connection(self._addr,
+                                            timeout=self._timeout)
+            self._tls.sock = sock
+            with self._conns_lock:
+                self._conns = [r for r in self._conns if r() is not None]
+                self._conns.append(weakref.ref(sock))
+            # close promptly when the owning thread dies (its Thread
+            # object is collected), not at interpreter exit — otherwise
+            # short-lived kvstore-touching threads leak fds + matching
+            # server handler threads
+            weakref.finalize(threading.current_thread(), _close_quietly,
+                             sock)
+        return sock
+
+    def call(self, op, *args):
+        header = {"op": op}
+        blob = b""
+        if op in ("init", "push"):
+            key, value = args
+            meta, blob = _pack_array(value)
+            header.update(meta, key=key)
+        elif op == "pushq":
+            key, packed, shape, thr = args
+            header.update(key=key, shape=list(shape), thr=float(thr))
+            blob = _np.ascontiguousarray(packed, _np.uint8).tobytes()
+        elif op == "pull":
+            header["key"] = args[0]
+        elif op == "set_optimizer":
+            blob = args[0]
+        elif op == "command":
+            header.update(head=args[0], body=args[1])
+        elif op in ("stats", "shutdown"):
+            pass
+        else:
+            raise ValueError("unknown kvstore op %r" % op)
+
+        sock = self._connect()
+        _send_frame(sock, header, blob, key=self._hmac_key)
+        reply, rblob = _recv_frame(sock, key=self._hmac_key)
+        if reply.get("status") != "ok":
             from ..base import MXNetError
-            raise MXNetError("async server: %s" % (reply[1],))
-        return reply[1] if len(reply) > 1 else None
+            raise MXNetError("async server: %s" % reply.get("error"))
+        if "dtype" in reply:
+            return _unpack_array(reply, rblob)
+        if "stats" in reply:
+            # JSON carries tuples as lists; restore the documented shape
+            st = reply["stats"]
+            st["pushes"] = [tuple(p) for p in st.get("pushes", [])]
+            st["commands"] = [tuple(c) for c in st.get("commands", [])]
+            return st
+        return None
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._conns_lock:
+            refs, self._conns = self._conns, []
+        for ref in refs:
+            sock = ref()
+            if sock is not None:
+                _close_quietly(sock)
+
+
+def _close_quietly(sock):
+    try:
+        sock.close()
+    except OSError:
+        pass

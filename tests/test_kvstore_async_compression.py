@@ -89,6 +89,62 @@ def test_async_server_uninitialized_key_errors():
         cli.close()
 
 
+def test_async_server_rejects_unauthenticated_frames():
+    """A peer without the shared secret cannot get anything parsed —
+    frames are HMAC-verified before any deserialization."""
+    import socket
+    import struct
+    srv = Server()
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        # well-formed frame, wrong tag: header {"op": "stats"}
+        payload = struct.pack("<I", 15) + b'{"op": "stats"}'
+        sock.sendall(struct.pack("<Q", 32 + len(payload)) + b"\x00" * 32
+                     + payload)
+        # server must drop the connection without replying
+        sock.settimeout(5)
+        assert sock.recv(1) == b""  # EOF
+        sock.close()
+        # an authenticated client still works afterwards
+        cli = Client("127.0.0.1", srv.port)
+        cli.call("init", "k", np.ones((2,), "f4"))
+        np.testing.assert_array_equal(cli.call("pull", "k"), [1, 1])
+    finally:
+        Client("127.0.0.1", srv.port).call("shutdown")
+
+
+def test_async_server_refuses_public_bind_without_secret(monkeypatch):
+    monkeypatch.delenv("MXNET_KVSTORE_SECRET", raising=False)
+    with pytest.raises(RuntimeError, match="MXNET_KVSTORE_SECRET"):
+        Server(bind="0.0.0.0")
+
+
+def test_async_client_threads_use_independent_sockets():
+    """Push and pull from different threads ride separate connections, so
+    they can overlap (single-socket head-of-line block fixed)."""
+    import threading
+    srv = Server()
+    cli = Client("127.0.0.1", srv.port)
+    try:
+        cli.call("init", "w", np.zeros((4,), "f4"))
+        socks = {}
+
+        def worker(name):
+            cli.call("pull", "w")
+            socks[name] = id(cli._tls.sock)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(socks.values())) == 3  # one socket per thread
+    finally:
+        cli.call("shutdown")
+        cli.close()
+
+
 def test_send_command_refuses_without_server():
     kv = mx.kv.create("local")
     with pytest.raises(mx.base.MXNetError):

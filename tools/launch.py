@@ -62,6 +62,11 @@ def main(argv=None):
     port = args.coordinator_port or _free_port()
     addr = "127.0.0.1:%d" % port
     hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
+    # per-job kvstore auth secret: separate worker processes must share it
+    # to talk to the rank-0 async server (async_server.py trust model)
+    if "MXNET_KVSTORE_SECRET" not in os.environ:
+        import secrets as _secrets
+        os.environ["MXNET_KVSTORE_SECRET"] = _secrets.token_hex(16)
     procs = []
     threads = []
     for r in range(args.num_workers):
