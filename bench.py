@@ -148,6 +148,29 @@ class _OneBatchIter:
         self._i = 0
 
 
+def _dist_kv_us(n=2, size_mb=1.0):
+    """kvstore push/pull µs with a REAL network leg: a 2-process
+    tools/launch.py group on host CPUs (label: kv_type=dist_sync)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    here = os.path.dirname(os.path.abspath(__file__))
+    r = subprocess.run(
+        [sys.executable, os.path.join(here, "tools", "launch.py"),
+         "-n", str(n), sys.executable,
+         os.path.join(here, "tools", "bandwidth.py"),
+         "--kv-type", "dist_sync", "--platform", "cpu",
+         "--size-mb", str(size_mb)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=here)
+    vals = []
+    for line in r.stdout.splitlines():
+        _, _, payload = line.partition("{")
+        if '"kvstore_push_pull_us"' in line:
+            vals.append(json.loads("{" + payload)["value"])
+    if not vals:
+        raise RuntimeError("no worker reported: %s" % r.stdout[-500:])
+    return round(sum(vals) / len(vals), 1)
+
+
 def main():
     # generous defaults: the tunnel can take minutes to come up after idle;
     # falling back to CPU on a slow-but-alive TPU would record a misleading
@@ -358,6 +381,13 @@ def main():
                 steps=10 if on_tpu else 2)["value"]
         except Exception as e:
             out["lstm_tokens_per_sec"] = "failed: %s" % e
+        # dist leg: 2-process launch group on the host CPUs, so the µs
+        # includes real cross-process serialization + TCP (the reference
+        # measures tools/bandwidth/measure.py under a dmlc launch group)
+        try:
+            out["kvstore_dist_push_pull_us"] = _dist_kv_us()
+        except Exception as e:
+            out["kvstore_dist_push_pull_us"] = "failed: %s" % e
 
     if on_tpu:
         # persist: future runs where the TPU is unreachable re-emit this

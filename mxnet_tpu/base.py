@@ -10,7 +10,16 @@ from __future__ import annotations
 import numpy as _np
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
-           "_NP_DTYPES", "mx_real_t", "normalize_dtype", "index_dtype"]
+           "_NP_DTYPES", "mx_real_t", "normalize_dtype", "index_dtype",
+           "data_dir"]
+
+
+def data_dir():
+    """Data/cache directory, ``MXNET_HOME`` or ``~/.mxnet`` (reference
+    base.py data_dir) — model-zoo weights and datasets live under it."""
+    import os
+    return os.path.expanduser(os.environ.get(
+        "MXNET_HOME", os.path.join("~", ".mxnet")))
 
 
 def index_dtype():

@@ -1,10 +1,12 @@
-"""gluon.contrib.data (reference python/mxnet/gluon/contrib/data/
-sampler.py): IntervalSampler."""
+"""gluon.contrib.data (reference python/mxnet/gluon/contrib/data/):
+IntervalSampler + WikiText language-model datasets."""
 from __future__ import annotations
 
 from ...data.sampler import Sampler
+from . import text
+from .text import WikiText2, WikiText103
 
-__all__ = ["IntervalSampler"]
+__all__ = ["IntervalSampler", "text", "WikiText2", "WikiText103"]
 
 
 class IntervalSampler(Sampler):

@@ -1,0 +1,134 @@
+"""WikiText language-model datasets (parity: python/mxnet/gluon/contrib/
+data/text.py WikiText2/WikiText103).
+
+Same reading semantics as the reference — lines tokenized on whitespace,
+``<eos>`` appended per line, the whole corpus flattened to one stream,
+``data = stream[:-1]`` / ``label = stream[1:]`` reshaped to
+``(N, seq_len)`` — but cache-first instead of download-first: this
+environment has no network egress, so the corpus file must already be at
+``root`` (``wiki.<segment>.tokens``, the reference archive layout; a
+reference-downloaded dataset dir works as-is, and any same-named
+synthetic corpus is accepted). A ``wikitext-*-v1.zip`` placed in
+``root`` is extracted like the reference's download step."""
+from __future__ import annotations
+
+import io
+import os
+import shutil
+import zipfile
+
+import numpy as _np
+
+from ...data import dataset as _dataset
+from .... import ndarray as nd
+from ....base import data_dir as _data_dir
+from ....contrib import text as _text
+
+__all__ = ["WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+
+class _WikiText(_dataset.Dataset):
+    """Shared reader (reference text.py _WikiText/_LanguageModelDataset)."""
+
+    def __init__(self, root, segment, vocab, seq_len):
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        self._vocab = vocab
+        self._counter = None
+        self._get_data()
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _build_vocab(self, content):
+        if not self._counter:
+            self._counter = _text.utils.count_tokens_from_str(content)
+        if not self._vocab:
+            self._vocab = _text.vocab.Vocabulary(
+                counter=self._counter, reserved_tokens=[EOS_TOKEN])
+
+    def _locate(self):
+        fname = self._data_file[self._segment]
+        path = os.path.join(self._root, fname)
+        if os.path.exists(path):
+            return path
+        # reference download step analog: extract a locally-provided
+        # archive (flattened, like the reference's member walk)
+        zpath = os.path.join(self._root, self._archive_file)
+        if os.path.exists(zpath):
+            with zipfile.ZipFile(zpath) as zf:
+                for member in zf.namelist():
+                    base = os.path.basename(member)
+                    if base:
+                        with zf.open(member) as src, \
+                                open(os.path.join(self._root, base),
+                                     "wb") as dst:
+                            shutil.copyfileobj(src, dst)
+            if os.path.exists(path):
+                return path
+        raise RuntimeError(
+            "WikiText corpus %r not found (no network egress in this "
+            "environment). Place the tokens file at %s, or the archive "
+            "%s in %s." % (self._segment, path, self._archive_file,
+                           self._root))
+
+    def _get_data(self):
+        path = self._locate()
+        with io.open(path, "r", encoding="utf8") as fin:
+            content = fin.read()
+        self._build_vocab(content)
+        raw_lines = [ln.strip().split() for ln in content.splitlines()]
+        tokens = []
+        for line in raw_lines:
+            if line:
+                tokens.extend(line)
+                tokens.append(EOS_TOKEN)
+        idx = self._vocab.to_indices(tokens)
+        data = _np.array(idx[0:-1], dtype=_np.int32)
+        label = _np.array(idx[1:], dtype=_np.int32)
+        n = (len(data) // self._seq_len) * self._seq_len
+        self._data = nd.array(data[:n].reshape(-1, self._seq_len),
+                              dtype="int32")
+        self._label = nd.array(label[:n].reshape(-1, self._seq_len),
+                               dtype="int32")
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 word-level LM dataset (reference text.py:107-141).
+
+    Each sample is a ``(data, label)`` pair of length ``seq_len``; lines
+    end with ``<eos>``; labels are the data shifted by one token."""
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        self._archive_file = "wikitext-2-v1.zip"
+        self._data_file = {"train": "wiki.train.tokens",
+                           "validation": "wiki.valid.tokens",
+                           "test": "wiki.test.tokens"}
+        root = root or os.path.join(_data_dir(), "datasets", "wikitext-2")
+        super().__init__(root, segment, vocab, seq_len)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 word-level LM dataset (reference text.py:144-179)."""
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        self._archive_file = "wikitext-103-v1.zip"
+        self._data_file = {"train": "wiki.train.tokens",
+                           "validation": "wiki.valid.tokens",
+                           "test": "wiki.test.tokens"}
+        root = root or os.path.join(_data_dir(), "datasets", "wikitext-103")
+        super().__init__(root, segment, vocab, seq_len)

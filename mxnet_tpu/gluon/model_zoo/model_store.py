@@ -67,8 +67,8 @@ _model_sha1 = {name: checksum for checksum, name in [
 
 
 def _default_root():
-    return os.path.join(
-        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")), "models")
+    from ...base import data_dir
+    return os.path.join(data_dir(), "models")
 
 
 def short_hash(name):

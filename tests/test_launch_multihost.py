@@ -1,0 +1,88 @@
+"""Multi-host launch recipe (reference tools/launch.py ssh mode).
+
+No ssh daemon exists in CI, so the recipe is proven through --dry-run:
+the launcher must emit one correct, complete command per host — exactly
+what an operator (or a k8s/slurm wrapper) runs on each machine.
+"""
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+
+
+def _run(args):
+    env = dict(os.environ)
+    env.pop("MXNET_KVSTORE_SECRET", None)
+    r = subprocess.run([sys.executable, LAUNCH] + args,
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout.strip().splitlines()
+
+
+def test_multihost_dry_run_emits_one_ssh_command_per_host():
+    lines = _run(["-H", "hostA,hostB", "--heartbeat-dir", "/shared/hb",
+                  "--dry-run", "python", "train.py", "--kv-store",
+                  "dist_sync"])
+    assert len(lines) == 2
+    assert lines[0].startswith("[rank 0 @ hostA] ssh ")
+    assert lines[1].startswith("[rank 1 @ hostB] ssh ")
+    for rank_, line in enumerate(lines):
+        # every worker points at host 0's coordinator
+        assert "MXNET_COORDINATOR_ADDRESS=hostA:9091" in line
+        assert "MXNET_WORKER_RANK=%d" % rank_ in line
+        assert "MXNET_NUM_WORKERS=2" in line
+        assert "MXNET_HEARTBEAT_DIR=/shared/hb" in line
+        # reference-era aliases for v1.x scripts
+        assert "DMLC_PS_ROOT_URI=hostA" in line
+        assert "DMLC_PS_ROOT_PORT=9091" in line
+        assert "DMLC_ROLE=worker" in line
+        assert "python train.py --kv-store dist_sync" in line
+        # the job secret must NOT travel in argv (world-readable via
+        # /proc/<pid>/cmdline) — it ships on ssh stdin
+        assert "MXNET_KVSTORE_SECRET=" not in line
+        assert "MXNET_KVSTORE_SECRET on stdin" in line
+        assert "IFS= read -r MXNET_KVSTORE_SECRET" in line
+
+
+def test_multihost_user_at_host_coordinator_is_dialable():
+    lines = _run(["-H", "ubuntu@10.0.0.1,ubuntu@10.0.0.2",
+                  "--heartbeat-dir", "/hb", "--dry-run", "cmd"])
+    for line in lines:
+        # ssh keeps the user@ prefix; the coordinator address must not
+        assert "MXNET_COORDINATOR_ADDRESS=10.0.0.1:9091" in line
+        assert "DMLC_PS_ROOT_URI=10.0.0.1" in line
+        assert "ssh" in line and "ubuntu@10.0.0." in line
+
+
+def test_multihost_round_robin_when_n_exceeds_hosts():
+    lines = _run(["-H", "h0,h1", "-n", "4", "--heartbeat-dir", "/hb",
+                  "--dry-run", "cmd"])
+    hosts = [li.split("@ ")[1].split("]")[0] for li in lines]
+    assert hosts == ["h0", "h1", "h0", "h1"]
+
+
+def test_multihost_custom_port():
+    (line,) = _run(["-H", "tpu-vm-0", "--coordinator-port", "7777",
+                    "--heartbeat-dir", "/hb", "--dry-run", "cmd"])
+    assert "MXNET_COORDINATOR_ADDRESS=tpu-vm-0:7777" in line
+
+
+def test_singlehost_dry_run_contract():
+    lines = _run(["-n", "2", "--dry-run", "python", "train.py"])
+    assert len(lines) == 2
+    for rank_, line in enumerate(lines):
+        assert "MXNET_WORKER_RANK=%d" % rank_ in line
+        assert re.search(r"MXNET_COORDINATOR_ADDRESS=127\.0\.0\.1:\d+",
+                         line)
+
+
+def test_missing_heartbeat_dir_warns():
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-H", "a,b", "--dry-run", "cmd"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert r.returncode == 0
+    assert "failure detection" in r.stderr

@@ -14,14 +14,23 @@ coordination service — so this launcher:
 * on any worker failing, kills the rest and exits non-zero.
 
 Multi-host launches (one process per host over DCN) use the same
-environment contract — point MXNET_COORDINATOR_ADDRESS at host 0 and run
-one process per host with distinct ranks; this script is the single-host
-convenience wrapper the reference's ``-n N`` local mode provided.
+environment contract: ``-H host0,host1,...`` starts one worker per host
+over ssh (the reference launcher's ssh mode, tools/launch.py -H), with
+MXNET_COORDINATOR_ADDRESS pointed at host 0, a shared per-job
+MXNET_KVSTORE_SECRET, and reference-era DMLC_* aliases. ``--dry-run``
+prints the exact per-host command instead of executing — the documented
+recipe for schedulers that own placement (k8s/slurm: run those commands
+yourself, one per host).
 
 Usage::
 
+    # single host, N processes
     python tools/launch.py -n 4 [--env K=V ...] python train.py \
         --kv-store dist_sync
+
+    # two hosts over DCN (one process per host, ssh)
+    python tools/launch.py -H host0,host1 \
+        --heartbeat-dir /shared/hb python train.py --kv-store dist_sync
 """
 import argparse
 import os
@@ -48,44 +57,140 @@ def _stream(proc, rank_, out):
         out.flush()
 
 
+def _worker_env(addr, num_workers, rank_, hb_dir, extra):
+    """The environment contract every worker sees (single- and
+    multi-host modes share it)."""
+    host0, _, port = addr.rpartition(":")
+    env = {
+        "MXNET_COORDINATOR_ADDRESS": addr,
+        "MXNET_NUM_WORKERS": str(num_workers),
+        "MXNET_WORKER_RANK": str(rank_),
+        "MXNET_HEARTBEAT_DIR": hb_dir,
+        "MXNET_KVSTORE_SECRET": os.environ["MXNET_KVSTORE_SECRET"],
+        # reference-era names
+        "DMLC_PS_ROOT_URI": host0,
+        "DMLC_PS_ROOT_PORT": port,
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_WORKER_ID": str(rank_),
+        "DMLC_ROLE": "worker",
+    }
+    for kv in extra:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def _ssh_command(host, env, command, cwd):
+    """One remote worker: ssh <host> '<read secret from stdin> && cd
+    <cwd> && env K=V... cmd'. The job secret travels on stdin, NOT in
+    argv — /proc/<pid>/cmdline is world-readable on shared hosts."""
+    import shlex
+    exports = " ".join("%s=%s" % (k, shlex.quote(v))
+                       for k, v in sorted(env.items()))
+    remote = ("IFS= read -r MXNET_KVSTORE_SECRET && "
+              "export MXNET_KVSTORE_SECRET && cd %s && env %s %s"
+              % (shlex.quote(cwd), exports,
+                 " ".join(shlex.quote(c) for c in command)))
+    return ["ssh", "-o", "BatchMode=yes", "-o",
+            "StrictHostKeyChecking=accept-new", host, remote]
+
+
+def _multihost(args):
+    """One worker per host entry over ssh (reference launch.py ssh
+    launcher). --dry-run prints the per-host commands for scheduler-
+    owned placement instead of executing."""
+    hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    n = args.num_workers or len(hosts)
+    port = args.coordinator_port or 9091   # must be pre-agreed: remote
+    # ssh accepts user@host; the coordinator address must not carry the
+    # user part (workers dial it as a plain network address)
+    host0 = hosts[0].rpartition("@")[2]
+    addr = "%s:%d" % (host0, port)         # hosts can't ask us for a port
+    if "MXNET_KVSTORE_SECRET" not in os.environ:
+        import secrets as _secrets
+        os.environ["MXNET_KVSTORE_SECRET"] = _secrets.token_hex(16)
+    hb_dir = args.heartbeat_dir
+    if hb_dir is None:
+        hb_dir = tempfile.gettempdir() + "/mxtpu_hb"
+        sys.stderr.write(
+            "launch.py: no --heartbeat-dir given; per-host %s is NOT "
+            "shared, so cross-host failure detection via "
+            "get_num_dead_node is off\n" % hb_dir)
+    secret = os.environ["MXNET_KVSTORE_SECRET"]
+    cmds = []
+    for r in range(n):
+        host = hosts[r % len(hosts)]
+        env = _worker_env(addr, n, r, hb_dir, args.env)
+        env.pop("MXNET_KVSTORE_SECRET")  # shipped on stdin, not argv
+        cmds.append((r, host, _ssh_command(host, env, args.command,
+                                           os.getcwd())))
+    if args.dry_run:
+        for r, host, cmd in cmds:
+            print("[rank %d @ %s] %s  # MXNET_KVSTORE_SECRET on stdin"
+                  % (r, host, " ".join(cmd)))
+        return 0
+    procs = []
+    threads = []
+    for r, host, cmd in cmds:
+        p = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        p.stdin.write(secret + "\n")
+        p.stdin.close()
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(p, r, sys.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    return _wait_group(procs, threads)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-n", "--num-workers", type=int, default=None)
+    ap.add_argument("-H", "--hosts", default=None,
+                    help="comma-separated host list: one worker per "
+                         "entry over ssh (multi-host DCN mode)")
     ap.add_argument("--coordinator-port", type=int, default=None)
+    ap.add_argument("--heartbeat-dir", default=None,
+                    help="shared-filesystem dir for cross-host failure "
+                         "detection (multi-host mode)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print per-host commands instead of executing")
     ap.add_argument("--env", action="append", default=[],
                     help="extra K=V for the workers")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
+    if args.hosts:
+        return _multihost(args)
+    if not args.num_workers:
+        ap.error("-n is required in single-host mode")
 
+    import shlex
     port = args.coordinator_port or _free_port()
     addr = "127.0.0.1:%d" % port
-    hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
     # per-job kvstore auth secret: separate worker processes must share it
     # to talk to the rank-0 async server (async_server.py trust model)
     if "MXNET_KVSTORE_SECRET" not in os.environ:
         import secrets as _secrets
         os.environ["MXNET_KVSTORE_SECRET"] = _secrets.token_hex(16)
+    if args.dry_run:
+        for r in range(args.num_workers):
+            env = _worker_env(addr, args.num_workers, r, "<heartbeat-dir>",
+                              args.env)
+            print("[rank %d @ localhost] env %s %s"
+                  % (r, " ".join("%s=%s" % (k, shlex.quote(v))
+                                 for k, v in sorted(env.items())),
+                     " ".join(args.command)))
+        return 0
+    hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
     procs = []
     threads = []
     for r in range(args.num_workers):
         env = dict(os.environ)
-        env.update({
-            "MXNET_COORDINATOR_ADDRESS": addr,
-            "MXNET_NUM_WORKERS": str(args.num_workers),
-            "MXNET_WORKER_RANK": str(r),
-            "MXNET_HEARTBEAT_DIR": hb_dir,
-            # reference-era names
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(port),
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_WORKER_ID": str(r),
-            "DMLC_ROLE": "worker",
-        })
-        for kv in args.env:
-            k, _, v = kv.partition("=")
-            env[k] = v
+        env.update(_worker_env(addr, args.num_workers, r, hb_dir, args.env))
         p = subprocess.Popen(args.command, env=env,
                              stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT, text=True)
@@ -94,7 +199,12 @@ def main(argv=None):
                              daemon=True)
         t.start()
         threads.append(t)
+    rc = _wait_group(procs, threads)
+    shutil.rmtree(hb_dir, ignore_errors=True)
+    return rc
 
+
+def _wait_group(procs, threads):
     rc = 0
     try:
         # poll ALL workers: a failed one wedges the rest at their next
@@ -126,7 +236,6 @@ def main(argv=None):
         rc = 130
     for t in threads:
         t.join(timeout=5)
-    shutil.rmtree(hb_dir, ignore_errors=True)
     return rc
 
 
