@@ -302,6 +302,77 @@ def _tr_identity_head(ex, node, p):
     ex._emit("Identity", ex._ins(node, 1), [ex._vname(node, 0)], node.name)
 
 
+def _tr_square(ex, node, p):
+    # reference convert_square: Pow against a constant-2 initializer
+    cname = node.name + "_pow2"
+    ex._scalar_init(cname, 2.0)
+    ex._emit("Pow", ex._ins(node, 1) + [cname], [ex._vname(node, 0)],
+             node.name)
+
+
+def _tr_slice_axis(ex, node, p):
+    end = p.get("end")
+    ex._emit("Slice", ex._ins(node, 1), [ex._vname(node, 0)], node.name,
+             [_attr_ints("axes", (p["axis"],)),
+              _attr_ints("starts", (p.get("begin", 0),)),
+              _attr_ints("ends", (2 ** 31 - 1 if end is None else end,))])
+
+
+def _tr_split(ex, node, p):
+    n_out = int(p.get("num_outputs", 1))
+    outs = [ex._vname(node, i) for i in range(n_out)]
+    axis = p.get("axis", 1)
+    if p.get("squeeze_axis"):
+        # ONNX Split keeps the axis; add a Squeeze per output (reference
+        # convert_slice_channel squeeze_axis=1 form)
+        raw = [o + "_presqueeze" for o in outs]
+        ex._emit("Split", ex._ins(node, 1), raw, node.name,
+                 [_attr_i("axis", axis)])
+        for r, o in zip(raw, outs):
+            ex._emit("Squeeze", [r], [o], o + "_squeeze",
+                     [_attr_ints("axes", (axis,))])
+    else:
+        ex._emit("Split", ex._ins(node, 1), outs, node.name,
+                 [_attr_i("axis", axis)])
+
+
+def _tr_pad(ex, node, p):
+    pw = tuple(p["pad_width"])
+    n = len(pw) // 2
+    # MXNet flat (before,after) per axis -> ONNX (begins..., ends...)
+    pads = [int(pw[2 * i]) for i in range(n)] \
+        + [int(pw[2 * i + 1]) for i in range(n)]
+    attrs = [_attr_s("mode", p.get("mode", "constant")),
+             _attr_ints("pads", pads)]
+    if p.get("mode", "constant") == "constant":
+        attrs.append(_attr_f("value", p.get("constant_value", 0.0)))
+    ex._emit("Pad", ex._ins(node, 1), [ex._vname(node, 0)], node.name,
+             attrs)
+
+
+def _tr_l2norm(ex, node, p):
+    # LpNormalization normalizes along ONE axis; only channel mode maps
+    # (the reference exporter likewise refuses non-channel modes)
+    if p.get("mode", "instance") != "channel":
+        raise MXNetError(
+            "L2Normalization mode %r has no ONNX form (only 'channel' "
+            "maps to LpNormalization)" % p.get("mode", "instance"))
+    ex._emit("LpNormalization", ex._ins(node, 1), [ex._vname(node, 0)],
+             node.name, [_attr_i("p", 2), _attr_i("axis", 1)])
+
+
+def _tr_arg_reduce(onnx_op):
+    def tr(ex, node, p):
+        axis = p.get("axis")
+        if axis is None:
+            raise MXNetError("%s without axis has no ONNX form" % onnx_op)
+        ex._emit(onnx_op, ex._ins(node, 1), [ex._vname(node, 0)],
+                 node.name,
+                 [_attr_i("axis", int(axis)),
+                  _attr_i("keepdims", 1 if p.get("keepdims") else 0)])
+    return tr
+
+
 _TRANSLATIONS = {
     "FullyConnected": _tr_fc,
     "Convolution": _tr_conv,
@@ -368,7 +439,52 @@ _TRANSLATIONS = {
     "cast": lambda ex, node, p: ex._emit(
         "Cast", ex._ins(node), [ex._vname(node, 0)], node.name,
         [_attr_i("to", _NP_TO_ONNX[_np.dtype(p["dtype"])])]),
+    # --- remainder of the reference's export table (mx2onnx/
+    # _op_translations.py @mx_op.register set) ---
+    "_copy": _simple("Identity"),
+    "_linalg_gemm2": _simple("MatMul"),
+    "_maximum": _simple("Max"),
+    "_minimum": _simple("Min"),
+    "broadcast_maximum": _simple("Max"),   # ONNX Max/Min broadcast
+    "broadcast_minimum": _simple("Min"),
+    "_power": _simple("Pow"),
+    "add_n": _simple("Sum"),
+    "ceil": _simple("Ceil"),
+    "floor": _simple("Floor"),
+    "reciprocal": _simple("Reciprocal"),
+    "square": _tr_square,
+    "cos": _simple("Cos"),
+    "sin": _simple("Sin"),
+    "tan": _simple("Tan"),
+    "arccos": _simple("Acos"),
+    "arcsin": _simple("Asin"),
+    "arctan": _simple("Atan"),
+    "broadcast_equal": _simple("Equal"),
+    "broadcast_greater": _simple("Greater"),
+    "broadcast_lesser": _simple("Less"),
+    "prod": _tr_reduce("ReduceProd"),
+    "argmax": _tr_arg_reduce("ArgMax"),
+    "argmin": _tr_arg_reduce("ArgMin"),
+    "hard_sigmoid": _simple(
+        "HardSigmoid", lambda p: [_attr_f("alpha", p.get("alpha", 0.2)),
+                                  _attr_f("beta", p.get("beta", 0.5))]),
+    "depth_to_space": _simple(
+        "DepthToSpace", lambda p: [_attr_i("blocksize", p["block_size"])]),
+    "space_to_depth": _simple(
+        "SpaceToDepth", lambda p: [_attr_i("blocksize", p["block_size"])]),
+    "slice_axis": _tr_slice_axis,
+    "SliceChannel": _tr_split,
+    "split": _tr_split,
+    "Pad": _tr_pad,
+    "pad": _tr_pad,
+    "LRN": _simple(
+        "LRN", lambda p: [_attr_i("size", p["nsize"]),
+                          _attr_f("alpha", p.get("alpha", 1e-4)),
+                          _attr_f("beta", p.get("beta", 0.75)),
+                          _attr_f("bias", p.get("knorm", 2.0))]),
+    "L2Normalization": _tr_l2norm,
 }
+_TRANSLATIONS["Cast"] = _TRANSLATIONS["cast"]
 
 
 def export_model(sym, params, input_shape, input_type=_np.float32,

@@ -648,6 +648,14 @@ class _Importer:
                      {"num_hidden": self.params[w_name].shape[0],
                       "no_bias": len(node.input) < 3})
 
+    def _cv_LpNormalization(self, node, a):
+        # beyond the reference's 92-entry table: round-trips our own
+        # exporter's L2Normalization channel-mode output
+        if a.get("p", 2) != 2 or a.get("axis", -1) != 1:
+            raise MXNetError("LpNormalization only imports as p=2 axis=1 "
+                             "(channel-mode L2Normalization)")
+        self._simple(node, "L2Normalization", {"mode": "channel"})
+
     def _cv_LRN(self, node, a):
         self._simple(node, "LRN", {
             "nsize": a["size"], "alpha": a.get("alpha", 1e-4),

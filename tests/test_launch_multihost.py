@@ -27,8 +27,11 @@ def test_multihost_dry_run_emits_one_ssh_command_per_host():
                   "--dry-run", "python", "train.py", "--kv-store",
                   "dist_sync"])
     assert len(lines) == 2
-    assert lines[0].startswith("[rank 0 @ hostA] ssh ")
-    assert lines[1].startswith("[rank 1 @ hostB] ssh ")
+    # runnable as printed: operator env supplies the secret via stdin
+    assert lines[0].startswith(
+        "[rank 0 @ hostA] printf '%s\\n' \"$MXNET_KVSTORE_SECRET\" | ssh ")
+    assert lines[1].startswith(
+        "[rank 1 @ hostB] printf '%s\\n' \"$MXNET_KVSTORE_SECRET\" | ssh ")
     for rank_, line in enumerate(lines):
         # every worker points at host 0's coordinator
         assert "MXNET_COORDINATOR_ADDRESS=hostA:9091" in line
@@ -40,10 +43,10 @@ def test_multihost_dry_run_emits_one_ssh_command_per_host():
         assert "DMLC_PS_ROOT_PORT=9091" in line
         assert "DMLC_ROLE=worker" in line
         assert "python train.py --kv-store dist_sync" in line
-        # the job secret must NOT travel in argv (world-readable via
-        # /proc/<pid>/cmdline) — it ships on ssh stdin
-        assert "MXNET_KVSTORE_SECRET=" not in line
-        assert "MXNET_KVSTORE_SECRET on stdin" in line
+        # the job secret value must NOT travel in argv (world-readable
+        # via /proc/<pid>/cmdline) — it ships on ssh stdin
+        assert 'MXNET_KVSTORE_SECRET="' not in line
+        assert re.search(r"MXNET_KVSTORE_SECRET=\w", line) is None
         assert "IFS= read -r MXNET_KVSTORE_SECRET" in line
 
 
@@ -77,6 +80,7 @@ def test_singlehost_dry_run_contract():
         assert "MXNET_WORKER_RANK=%d" % rank_ in line
         assert re.search(r"MXNET_COORDINATOR_ADDRESS=127\.0\.0\.1:\d+",
                          line)
+        assert "MXNET_KVSTORE_SECRET" not in line  # never in argv
 
 
 def test_missing_heartbeat_dir_warns():

@@ -186,6 +186,106 @@ def test_reshape_special_codes_refuse_export(tmp_path):
                                 onnx_file_path=str(tmp_path / "bad.onnx"))
 
 
+def _roundtrip_expr(net, data, tmp_path, data_name="data"):
+    path = str(tmp_path / "expr.onnx")
+    onnx_mxnet.export_model(net, {}, [data.shape], np.float32, path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    y1 = _forward(net, ({}, {}), data)
+    y2 = _forward(sym2, (arg2, aux2), data)
+    np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-5)
+    return y1
+
+
+def test_onnx_roundtrip_math_tail(tmp_path):
+    """The exporter ops added for full reference-table parity round-trip
+    through the wire format (export -> import -> identical numerics)."""
+    d = mx.sym.Variable("data")
+    net = mx.sym.square(mx.sym.cos(d)) + mx.sym.ceil(d) - mx.sym.floor(d)
+    net = net + mx.sym.reciprocal(d + 3.0) + mx.sym.arctan(d)
+    net = mx.sym.maximum(net, mx.sym.minimum(d, net))
+    data = np.random.RandomState(5).rand(3, 4).astype(np.float32) + 0.5
+    _roundtrip_expr(net, data, tmp_path)
+
+
+def test_onnx_roundtrip_reduce_and_index_tail(tmp_path):
+    d = mx.sym.Variable("data")
+    net = mx.sym.broadcast_add(
+        mx.sym.prod(d, axis=1, keepdims=True),
+        mx.sym.argmax(d, axis=1, keepdims=True))
+    data = np.random.RandomState(6).rand(3, 4).astype(np.float32) + 0.5
+    _roundtrip_expr(net, data, tmp_path)
+
+
+def test_onnx_roundtrip_structure_tail(tmp_path):
+    d = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(d, num_outputs=2, axis=1)
+    net = mx.sym.add_n(parts[0], parts[1])
+    net = mx.sym.pad(net, mode="constant", pad_width=(0, 0, 0, 0, 1, 1,
+                                                      1, 1),
+                     constant_value=0.5)
+    net = mx.sym.slice_axis(net, axis=2, begin=1, end=None)
+    data = np.random.RandomState(7).randn(2, 4, 5, 5).astype(np.float32)
+    _roundtrip_expr(net, data, tmp_path)
+
+
+def test_onnx_roundtrip_nn_tail(tmp_path):
+    d = mx.sym.Variable("data")
+    net = mx.sym.LRN(d, nsize=3, alpha=1e-3, beta=0.7, knorm=1.5)
+    net = mx.sym.hard_sigmoid(net, alpha=0.3, beta=0.4)
+    net = mx.sym.space_to_depth(mx.sym.depth_to_space(net, block_size=2),
+                                block_size=2)
+    data = np.random.RandomState(8).rand(1, 4, 6, 6).astype(np.float32)
+    _roundtrip_expr(net, data, tmp_path)
+
+
+def test_onnx_export_table_covers_reference(tmp_path):
+    """Name-by-name diff against the reference exporter's @mx_op.register
+    table (minus 'null', which is the variable passthrough)."""
+    from mxnet_tpu.contrib.onnx.mx2onnx import _TRANSLATIONS
+    reference_table = [
+        "Activation", "BatchNorm", "Cast", "Concat", "Convolution",
+        "Dropout", "Flatten", "FullyConnected", "L2Normalization", "LRN",
+        "LeakyReLU", "Pad", "Pooling", "Reshape", "SliceChannel",
+        "SoftmaxOutput", "_copy", "_div_scalar", "_linalg_gemm2",
+        "_maximum", "_minimum", "_minus_scalar", "_mul_scalar",
+        "_plus_scalar", "_power", "abs", "add_n", "arccos", "arcsin",
+        "arctan", "argmax", "argmin", "broadcast_add", "broadcast_div",
+        "broadcast_equal", "broadcast_greater", "broadcast_lesser",
+        "broadcast_mul", "broadcast_power", "broadcast_sub", "cast",
+        "ceil", "clip", "cos", "depth_to_space", "dot", "elemwise_add",
+        "elemwise_div", "elemwise_mul", "elemwise_sub", "exp", "floor",
+        "log", "max", "mean", "min", "negative", "prod", "reciprocal",
+        "relu", "sigmoid", "sin", "slice_axis", "softmax",
+        "space_to_depth", "sqrt", "square", "squeeze", "sum", "tan",
+        "tanh", "transpose",
+    ]
+    missing = [op for op in reference_table if op not in _TRANSLATIONS]
+    assert not missing, "exporter lacks reference table ops: %r" % missing
+
+
+def test_onnx_export_l2normalization_roundtrips(tmp_path):
+    net = mx.sym.L2Normalization(mx.sym.Variable("data"), mode="channel")
+    data = np.random.RandomState(9).rand(2, 3, 4).astype(np.float32)
+    path = str(tmp_path / "l2.onnx")
+    onnx_mxnet.export_model(net, {}, [data.shape], np.float32, path)
+    model = P.ModelProto.decode(open(path, "rb").read())
+    assert [n.op_type for n in model.graph.node] == ["LpNormalization"]
+    sym2, a2, x2 = onnx_mxnet.import_model(path)
+    y1 = _forward(net, ({}, {}), data)
+    y2 = _forward(sym2, (a2, x2), data)
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_export_l2normalization_instance_mode_refuses(tmp_path):
+    """mode='instance' (the MXNet default) normalizes over ALL non-batch
+    axes — LpNormalization axis=1 would silently change numerics, so the
+    export must refuse (reference exporter behavior)."""
+    net = mx.sym.L2Normalization(mx.sym.Variable("data"))
+    with pytest.raises(mx.base.MXNetError, match="channel"):
+        onnx_mxnet.export_model(net, {}, [(2, 3, 4)],
+                                onnx_file_path=str(tmp_path / "bad.onnx"))
+
+
 def test_import_model_for_training_keeps_bn_batch_stats(tmp_path):
     data = mx.sym.Variable("data")
     net = mx.sym.BatchNorm(mx.sym.FullyConnected(data, num_hidden=4,

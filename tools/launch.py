@@ -125,9 +125,15 @@ def _multihost(args):
         cmds.append((r, host, _ssh_command(host, env, args.command,
                                            os.getcwd())))
     if args.dry_run:
+        sys.stderr.write(
+            "launch.py: export MXNET_KVSTORE_SECRET (same value "
+            "everywhere) before running these; each command reads it "
+            "from stdin\n")
         for r, host, cmd in cmds:
-            print("[rank %d @ %s] %s  # MXNET_KVSTORE_SECRET on stdin"
-                  % (r, host, " ".join(cmd)))
+            # runnable as printed: the operator's env supplies the secret
+            print("[rank %d @ %s] printf '%%s\\n' "
+                  "\"$MXNET_KVSTORE_SECRET\" | %s" % (r, host,
+                                                      " ".join(cmd)))
         return 0
     procs = []
     threads = []
@@ -135,8 +141,12 @@ def _multihost(args):
         p = subprocess.Popen(cmd, stdin=subprocess.PIPE,
                              stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT, text=True)
-        p.stdin.write(secret + "\n")
-        p.stdin.close()
+        try:
+            p.stdin.write(secret + "\n")
+            p.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass  # ssh died instantly; _wait_group reaps it and
+            # terminates the rest of the group
         procs.append(p)
         t = threading.Thread(target=_stream, args=(p, r, sys.stdout),
                              daemon=True)
@@ -177,9 +187,13 @@ def main(argv=None):
         import secrets as _secrets
         os.environ["MXNET_KVSTORE_SECRET"] = _secrets.token_hex(16)
     if args.dry_run:
+        sys.stderr.write(
+            "launch.py: export MXNET_KVSTORE_SECRET (same value for "
+            "every worker) before running these\n")
         for r in range(args.num_workers):
             env = _worker_env(addr, args.num_workers, r, "<heartbeat-dir>",
                               args.env)
+            env.pop("MXNET_KVSTORE_SECRET")  # never print secrets in argv
             print("[rank %d @ localhost] env %s %s"
                   % (r, " ".join("%s=%s" % (k, shlex.quote(v))
                                  for k, v in sorted(env.items())),

@@ -1,0 +1,106 @@
+"""Serve an int8 .mxtpu artifact on the live backend and time it against
+the f32 artifact of the same model (VERDICT r4 item 5, serving half:
+the reference's int8 deployment story is that calibrated int8 inference
+beats the float path — contrib/quantization.py:84-205).
+
+Builds a conv tower + classifier head at batch 64, calibrates with the
+naive min/max scheme, AOT-exports BOTH precisions via jax.export, then
+loads + times each artifact through the serving surface. On TPU the
+int8 matmuls/convs hit the MXU integer path; the printed ratio is the
+deployment-relevant number.
+
+    python tools/serve_int8_onchip.py [--batch 64] [--iters 30] [--cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_model():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = data
+    for i, (f, s) in enumerate([(32, 2), (64, 2), (128, 2)]):
+        net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=f,
+                                 stride=(s, s), pad=(1, 1),
+                                 name="conv%d" % i)
+        net = mx.sym.Activation(net, act_type="relu", name="relu%d" % i)
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1), name="gap")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=1000, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--side", type=int, default=64)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import quantization as Q
+
+    dev = jax.devices()[0]
+    print("device: %s (%s)" % (dev.device_kind, dev.platform), flush=True)
+
+    sym = build_model()
+    shape = (args.batch, 3, args.side, args.side)
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=shape)
+    params = {n: mx.nd.array(rng.uniform(-0.15, 0.15, s).astype("f4"))
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    X = rng.rand(*shape).astype("f4")
+
+    it = mx.io.NDArrayIter(X, np.zeros(args.batch, "f4"),
+                           batch_size=args.batch,
+                           label_name="softmax_label")
+    qsym, qargs, qaux = Q.quantize_model(
+        sym, params, {}, calib_data=it, calib_mode="naive",
+        num_calib_examples=args.batch)
+
+    tmp = tempfile.mkdtemp()
+    f32_art = os.path.join(tmp, "f32.mxtpu")
+    int8_art = os.path.join(tmp, "int8.mxtpu")
+    mx.serving.export_compiled(sym, params, {}, {"data": shape}, f32_art)
+    mx.serving.export_compiled(qsym, qargs, qaux, {"data": shape},
+                               int8_art)
+
+    def bench(path):
+        cm = mx.serving.CompiledModel.load(path)
+        out = cm(X)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = cm(X)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        return dt, np.asarray(out[0])
+
+    t_f32, y_f32 = bench(f32_art)
+    t_int8, y_int8 = bench(int8_art)
+    err = float(np.abs(y_f32 - y_int8).max())
+    print("f32  artifact: %.3f ms/batch  (%.1f img/s)"
+          % (t_f32 * 1e3, args.batch / t_f32), flush=True)
+    print("int8 artifact: %.3f ms/batch  (%.1f img/s)"
+          % (t_int8 * 1e3, args.batch / t_int8), flush=True)
+    print("int8/f32 serving speedup: %.2fx   max |err| %.4f"
+          % (t_f32 / t_int8, err))
+
+
+if __name__ == "__main__":
+    main()
