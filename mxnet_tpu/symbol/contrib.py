@@ -1,10 +1,324 @@
-"""``mx.sym.contrib`` namespace: symbolic entry points for every
-registered ``_contrib_*`` operator (reference python surface:
-python/mxnet/symbol/contrib.py code-generation), resolved lazily from the
-operator registry."""
+"""``mx.sym.contrib`` namespace (reference python surface:
+python/mxnet/symbol/contrib.py): symbolic entry points for every
+registered ``_contrib_*`` operator, resolved lazily from the operator
+registry, plus the symbolic control-flow trio ``foreach`` /
+``while_loop`` / ``cond`` (reference contrib.py:95-740 building
+`_foreach`/`_while_loop`/`_cond` subgraph nodes,
+src/operator/control_flow.cc:1255/1316/1378).
+
+TPU-native control-flow design: the reference cuts the body into an
+nnvm subgraph executed by a dedicated C++ op with hand-written
+gradients. Here the body is traced into a sub-Symbol, evaluated by the
+same pure interpreter the executor jits (`executor._graph_eval_fn`),
+and the step node's fn lowers to ``lax.scan`` / ``lax.while_loop`` /
+``lax.cond`` — so the compiled graph gets real XLA control flow and the
+gradient falls out of ``jax.vjp`` through scan, no custom backward.
+
+Caveats (documented, loud): control-flow nodes hold Python closures, so
+symbols containing them don't serialize to JSON (`tojson` refuses);
+auxiliary states (e.g. BatchNorm moving stats) used inside a body are
+read-only within the loop.
+"""
 from __future__ import annotations
 
-from ..ops.registry import contrib_surface as _contrib_surface
+import itertools
+
+from ..base import MXNetError
+from ..ops.registry import contrib_surface as _contrib_surface, Operator
+from .symbol import Symbol, Variable, Group, Node, _auto_name
+
+_uid = itertools.count()
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def _unwrap(lst, single):
+    return lst[0] if single else lst
+
+
+def _one_entry(sym, what):
+    if not isinstance(sym, Symbol):
+        raise TypeError("%s must be a Symbol, got %r" % (what, type(sym)))
+    if len(sym._entries) != 1:
+        raise MXNetError("%s must be a single-output Symbol" % what)
+    return sym._entries[0]
+
+
+def _trace_subgraph(out_syms, placeholder_names):
+    """Group outputs into a sub-Symbol; split its variables into
+    (free arg nodes, aux names) excluding the placeholders."""
+    sub = Group(out_syms)
+    aux_names = set(sub.list_auxiliary_states())
+    free_nodes = [n for n in sub._topo()
+                  if n.is_variable and n.name not in placeholder_names]
+    arg_nodes = [n for n in free_nodes if n.name not in aux_names]
+    aux_nodes = [n for n in free_nodes if n.name in aux_names]
+    from ..executor import _graph_eval_fn
+    return sub, arg_nodes, aux_nodes, _graph_eval_fn(sub)
+
+
+def _has_random(sub):
+    return any(n.op.is_random for n in sub._topo() if not n.is_variable)
+
+
+def _flow_node(op_name, fn, n_outputs, input_entries, name, is_random,
+               shape_hook=None, aux_slots=()):
+    op = Operator(op_name, fn, num_outputs=n_outputs, is_random=is_random)
+    op.shape_hook = shape_hook
+    # aux slots keep BatchNorm-style moving stats classified as auxiliary
+    # states in the OUTER graph too (read-only inside the body), instead
+    # of silently becoming trainable arguments — same wiring as fused
+    # subgraph nodes (subgraph.py)
+    op.aux_inputs = tuple(aux_slots)
+    node = Node(op, _auto_name(op_name.strip("_") + "_", name),
+                list(input_entries), {})
+    return Symbol([(node, i) for i in range(n_outputs)])
+
+
+def _check_single(syms, what):
+    for s in syms:
+        _one_entry(s, what)
+    return syms
+
+
+def _subgraph_shape_hook(sub, slot_names, slot_slice_axis0):
+    """Back-infer unknown loop-node input shapes by running the body
+    sub-Symbol's own partial shape inference (the reference's subgraph
+    FInferShape pass, control_flow.cc ForeachShape).
+
+    ``slot_names``: sub-graph variable name per node input slot;
+    ``slot_slice_axis0``: slots whose node-level shape carries a leading
+    scan axis the per-step subgraph doesn't see."""
+
+    def hook(in_shapes, params):
+        known = {}
+        for i, (nm, s) in enumerate(zip(slot_names, in_shapes)):
+            if s is None:
+                continue
+            known[nm] = tuple(s[1:]) if i in slot_slice_axis0 else tuple(s)
+        try:
+            arg_shapes, _, aux_shapes = sub.infer_shape_partial(**known)
+        except Exception:
+            return in_shapes
+        inferred = dict(zip(sub.list_arguments(), arg_shapes))
+        inferred.update(zip(sub.list_auxiliary_states(), aux_shapes))
+        out = []
+        for i, (nm, s) in enumerate(zip(slot_names, in_shapes)):
+            if s is not None:
+                out.append(s)
+                continue
+            got = inferred.get(nm)
+            if got is not None and i in slot_slice_axis0:
+                got = None  # can't recover the scan length from a slice
+            out.append(tuple(got) if got is not None else None)
+        return out
+
+    return hook
+
+
+def foreach(body, data, init_states, name=None):
+    """Symbolic scan: run ``body(data_slice, states)`` over axis 0 of
+    ``data``, threading states (reference sym.contrib.foreach).
+    Returns (outputs, final_states) with the body's structure."""
+    import jax
+    from jax import lax
+    from .. import random as _random
+
+    data_list, single_data = _as_list(data)
+    states, single_state = _as_list(init_states)
+    uid = next(_uid)
+    ph_data = [Variable("_foreach%d_data%d" % (uid, i))
+               for i in range(len(data_list))]
+    ph_states = [Variable("_foreach%d_state%d" % (uid, i))
+                 for i in range(len(states))]
+    outs, fin = body(_unwrap(ph_data, single_data),
+                     _unwrap(ph_states, single_state))
+    out_list, single_out = _as_list(outs)
+    fin_list, _ = _as_list(fin)
+    if len(fin_list) != len(states):
+        raise MXNetError(
+            "foreach body returned %d states, expected %d"
+            % (len(fin_list), len(states)))
+    _check_single(out_list, "foreach body output")
+    _check_single(fin_list, "foreach body state")
+    d_names = [s.name for s in ph_data]
+    s_names = [s.name for s in ph_states]
+    sub, arg_nodes, aux_nodes, eval_fn = _trace_subgraph(
+        out_list + fin_list, set(d_names + s_names))
+    rand = _has_random(sub)
+    n_data, n_st, n_out = len(data_list), len(states), len(out_list)
+    f_names = [n.name for n in arg_nodes]
+    a_names = [n.name for n in aux_nodes]
+
+    def fn(*args, _training=True):
+        datas = args[:n_data]
+        st0 = args[n_data:n_data + n_st]
+        free = dict(zip(f_names, args[n_data + n_st:
+                                      n_data + n_st + len(f_names)]))
+        aux = dict(zip(a_names, args[n_data + n_st + len(f_names):]))
+        key0 = _random.next_key()
+
+        def step(carry, xs):
+            key, sts = carry[0], carry[1:]
+            key, sub_key = jax.random.split(key)
+            vals = dict(free)
+            vals.update(zip(d_names, xs))
+            vals.update(zip(s_names, sts))
+            outputs, _ = eval_fn(vals, aux, sub_key, _training)
+            return ((key,) + tuple(outputs[n_out:]),
+                    tuple(outputs[:n_out]))
+
+        final, ys = lax.scan(step, (key0,) + tuple(st0),
+                             tuple(datas))
+        return tuple(ys) + tuple(final[1:])
+
+    entries = [_one_entry(s, "foreach data") for s in data_list] \
+        + [_one_entry(s, "foreach state") for s in states] \
+        + [(n, 0) for n in arg_nodes] + [(n, 0) for n in aux_nodes]
+    hook = _subgraph_shape_hook(sub, d_names + s_names + f_names + a_names,
+                                set(range(n_data)))
+    aux0 = n_data + n_st + len(f_names)
+    res = _flow_node("_foreach", fn, n_out + n_st, entries, name, rand,
+                     shape_hook=hook,
+                     aux_slots=range(aux0, aux0 + len(a_names)))
+    out = _unwrap([res[i] for i in range(n_out)], single_out)
+    fin_states = _unwrap([res[n_out + i] for i in range(n_st)],
+                         single_state)
+    return out, fin_states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
+    """Symbolic while: run ``func`` while ``cond`` holds, up to
+    ``max_iterations``; step outputs are stacked and zero-padded to
+    max_iterations (reference sym.contrib.while_loop)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from .. import random as _random
+
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    max_iterations = int(max_iterations)
+    lvars, single = _as_list(loop_vars)
+    uid = next(_uid)
+    ph = [Variable("_while%d_var%d" % (uid, i)) for i in range(len(lvars))]
+    cond_sym = cond(*ph)
+    step_out, new_vars = func(*ph)
+    out_list, single_out = _as_list(step_out)
+    nv_list, _ = _as_list(new_vars)
+    if len(nv_list) != len(lvars):
+        raise MXNetError("while_loop func returned %d loop_vars, "
+                         "expected %d" % (len(nv_list), len(lvars)))
+    _check_single([cond_sym], "while_loop cond output")
+    _check_single(out_list, "while_loop step output")
+    _check_single(nv_list, "while_loop loop_var")
+    ph_names = {s.name for s in ph}
+    v_names = [s.name for s in ph]
+    sub, arg_nodes, aux_nodes, eval_fn = _trace_subgraph(
+        [cond_sym] + out_list + nv_list, ph_names)
+    rand = _has_random(sub)
+    n_v, n_out = len(lvars), len(out_list)
+    f_names = [n.name for n in arg_nodes]
+    a_names = [n.name for n in aux_nodes]
+
+    def fn(*args, _training=True):
+        # fixed-trip lax.scan with an active mask, NOT lax.while_loop:
+        # reverse-mode jax.vjp cannot differentiate through while_loop,
+        # and max_iterations is mandatory anyway. Iterations past the
+        # predicate's first False keep the carry frozen and record zeros
+        # (the reference's zero-padded step outputs). cond and body come
+        # from ONE subgraph evaluation per step, so a random predicate
+        # decides on exactly the values the carry commits.
+        v0 = args[:n_v]
+        free = dict(zip(f_names, args[n_v:n_v + len(f_names)]))
+        aux = dict(zip(a_names, args[n_v + len(f_names):]))
+        key0 = _random.next_key()
+
+        def step(carry, _):
+            key, active, vars_ = carry
+            key, sub_key = jax.random.split(key)
+            vals = dict(free)
+            vals.update(zip(v_names, vars_))
+            outputs, _ = eval_fn(vals, aux, sub_key, _training)
+            c = jnp.squeeze(outputs[0]).astype(bool)
+            step_outs = tuple(outputs[1:1 + n_out])
+            nxt = tuple(outputs[1 + n_out:])
+            cont = jnp.logical_and(active, c)
+            new_vars = tuple(
+                jnp.where(cont, n_, v_) for n_, v_ in zip(nxt, vars_))
+            recorded = tuple(
+                jnp.where(cont, o, jnp.zeros_like(o)) for o in step_outs)
+            return (key, cont, new_vars), recorded
+
+        (_, _, fin), ys = lax.scan(
+            step, (key0, jnp.bool_(True), tuple(v0)), None,
+            length=max_iterations)
+        return tuple(ys) + tuple(fin)
+
+    entries = [_one_entry(s, "while_loop var") for s in lvars] \
+        + [(n, 0) for n in arg_nodes] + [(n, 0) for n in aux_nodes]
+    hook = _subgraph_shape_hook(sub, v_names + f_names + a_names, set())
+    aux0 = n_v + len(f_names)
+    res = _flow_node("_while_loop", fn, n_out + n_v, entries, name, rand,
+                     shape_hook=hook,
+                     aux_slots=range(aux0, aux0 + len(a_names)))
+    out = _unwrap([res[i] for i in range(n_out)], single_out)
+    fin = _unwrap([res[n_out + i] for i in range(n_v)], single)
+    return out, fin
+
+
+def cond(pred, then_func, else_func, name=None):
+    """Symbolic branch: then_func() or else_func() by scalar ``pred``
+    (reference sym.contrib.cond). Both branches must produce the same
+    output structure."""
+    import jax.numpy as jnp
+    from jax import lax
+    from .. import random as _random
+
+    then_out, single_then = _as_list(then_func())
+    else_out, single_else = _as_list(else_func())
+    if len(then_out) != len(else_out) or single_then != single_else:
+        raise MXNetError("cond branches must return the same structure")
+    _check_single(then_out, "cond then output")
+    _check_single(else_out, "cond else output")
+    sub_t, arg_t, aux_t, eval_t = _trace_subgraph(then_out, set())
+    sub_e, arg_e, aux_e, eval_e = _trace_subgraph(else_out, set())
+    rand = _has_random(sub_t) or _has_random(sub_e)
+    n_out = len(then_out)
+    ft, at = [n.name for n in arg_t], [n.name for n in aux_t]
+    fe, ae = [n.name for n in arg_e], [n.name for n in aux_e]
+    nt, nat = len(ft), len(at)
+    ne, nae = len(fe), len(ae)
+
+    def fn(pred_v, *args, _training=True):
+        vt = dict(zip(ft, args[:nt]))
+        xt = dict(zip(at, args[nt:nt + nat]))
+        ve = dict(zip(fe, args[nt + nat:nt + nat + ne]))
+        xe = dict(zip(ae, args[nt + nat + ne:]))
+        key = _random.next_key()
+
+        def t(_):
+            outs, _aux = eval_t(vt, xt, key, _training)
+            return tuple(outs)
+
+        def e(_):
+            outs, _aux = eval_e(ve, xe, key, _training)
+            return tuple(outs)
+
+        return lax.cond(jnp.squeeze(pred_v).astype(bool), t, e, None)
+
+    entries = [_one_entry(pred, "cond pred")] \
+        + [(n, 0) for n in arg_t] + [(n, 0) for n in aux_t] \
+        + [(n, 0) for n in arg_e] + [(n, 0) for n in aux_e]
+    aux_slots = list(range(1 + nt, 1 + nt + nat)) \
+        + list(range(1 + nt + nat + ne, 1 + nt + nat + ne + nae))
+    res = _flow_node("_cond", fn, n_out, entries, name, rand,
+                     aux_slots=aux_slots)
+    return _unwrap([res[i] for i in range(n_out)], single_then)
 
 
 def _make_contrib_fn(op):

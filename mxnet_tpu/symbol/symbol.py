@@ -217,6 +217,28 @@ class Symbol:
             return False
         return _sym_binary("broadcast_equal", "_equal_scalar", self, other)
 
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return _sym_binary("broadcast_not_equal", "_not_equal_scalar",
+                           self, other)
+
+    def __gt__(self, other):
+        return _sym_binary("broadcast_greater", "_greater_scalar",
+                           self, other)
+
+    def __ge__(self, other):
+        return _sym_binary("broadcast_greater_equal",
+                           "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _sym_binary("broadcast_lesser", "_lesser_scalar",
+                           self, other)
+
+    def __le__(self, other):
+        return _sym_binary("broadcast_lesser_equal",
+                           "_lesser_equal_scalar", self, other)
+
     def __hash__(self):
         return id(self)
 

@@ -1,0 +1,216 @@
+"""Symbolic control flow — mx.sym.contrib.foreach / while_loop / cond
+(reference python/mxnet/symbol/contrib.py:95-740 over
+src/operator/control_flow.cc subgraph ops; here the body subgraph is
+interpreted by the executor's evaluator inside lax.scan/while/cond, so
+gradients come from jax.vjp through native XLA control flow)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _bind_run(sym, feeds, grad=None):
+    args = {k: mx.nd.array(v) for k, v in feeds.items()}
+    ex = sym.bind(mx.cpu(), args,
+                  args_grad={k: mx.nd.zeros(v.shape)
+                             for k, v in feeds.items()} if grad else None)
+    ex.forward(is_train=bool(grad))
+    outs = [o.asnumpy() for o in ex.outputs]
+    if grad:
+        ex.backward([mx.nd.array(g) for g in grad])
+        return outs, {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+    return outs
+
+
+def test_sym_foreach_cumsum():
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+
+    def body(x, s):
+        new_s = s + x
+        return new_s, new_s
+
+    outs, fin = mx.sym.contrib.foreach(body, data, init)
+    net = mx.sym.Group([outs, fin])
+    x = np.arange(1, 7, dtype=np.float32).reshape(6, 1)
+    (o, f) = _bind_run(net, {"data": x, "init": np.zeros((1,), "f4")})
+    np.testing.assert_allclose(o.ravel(), np.cumsum(x.ravel()), rtol=1e-6)
+    np.testing.assert_allclose(f, [21.0], rtol=1e-6)
+
+
+def test_sym_foreach_closes_over_outer_weight():
+    """Free variables of the body become inputs of the loop node —
+    an outer weight used inside the body is trained through the scan."""
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    w = mx.sym.Variable("w")
+
+    def body(x, s):
+        new_s = mx.sym.broadcast_add(mx.sym.broadcast_mul(x, w), s)
+        return new_s, new_s
+
+    outs, fin = mx.sym.contrib.foreach(body, data, init)
+    loss = mx.sym.sum(fin)
+    assert "w" in loss.list_arguments()
+    T = 4
+    x = np.ones((T, 3), np.float32) * 2.0
+    feeds = {"data": x, "init": np.zeros((3,), "f4"),
+             "w": np.ones((3,), "f4")}
+    (out,), grads = _bind_run(loss, feeds, grad=[np.ones((), "f4")])
+    # fin = sum_t x_t * w  -> d/dw = sum_t x_t = 8 per element
+    np.testing.assert_allclose(out, 24.0, rtol=1e-6)
+    np.testing.assert_allclose(grads["w"], np.full(3, 8.0), rtol=1e-6)
+
+
+def test_sym_foreach_multi_data_multi_state():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    s1 = mx.sym.Variable("s1")
+    s2 = mx.sym.Variable("s2")
+
+    def body(xs, states):
+        xa, xb = xs
+        p, q = states
+        return [xa + p, xb * q], [p + xa, q * xb]
+
+    outs, fins = mx.sym.contrib.foreach(body, [a, b], [s1, s2])
+    net = mx.sym.Group(list(outs) + list(fins))
+    A = np.ones((3, 2), np.float32)
+    B = np.full((3, 2), 2.0, np.float32)
+    res = _bind_run(net, {"a": A, "b": B,
+                          "s1": np.zeros(2, "f4"), "s2": np.ones(2, "f4")})
+    np.testing.assert_allclose(res[0][:, 0], [1, 2, 3])       # cumsum-ish
+    np.testing.assert_allclose(res[1][:, 0], [2, 4, 8])       # geometric
+    np.testing.assert_allclose(res[2], [3, 3])                # final s1
+    np.testing.assert_allclose(res[3], [8, 8])                # final s2
+
+
+def test_sym_while_loop_counts_and_pads():
+    def cond_fn(i, s):
+        return i < 3
+
+    def func(i, s):
+        return s + i, (i + 1, s + i)
+
+    outs, fin = mx.sym.contrib.while_loop(
+        cond_fn, func, [mx.sym.Variable("i"), mx.sym.Variable("s")],
+        max_iterations=5)
+    net = mx.sym.Group([outs, fin[0], fin[1]])
+    res = _bind_run(net, {"i": np.zeros((1,), "f4"),
+                          "s": np.zeros((1,), "f4")})
+    # steps: s+i = 0, 1, 3; padded with zeros to 5
+    np.testing.assert_allclose(res[0].ravel(), [0, 1, 3, 0, 0])
+    np.testing.assert_allclose(res[1], [3.0])
+    np.testing.assert_allclose(res[2], [3.0])
+
+
+def test_sym_cond_branches():
+    x = mx.sym.Variable("x")
+    pred = mx.sym.sum(x) > 0
+
+    out = mx.sym.contrib.cond(pred, lambda: x * 2.0, lambda: x - 10.0)
+    for sign, expect in [(1.0, 2.0), (-1.0, -11.0)]:
+        (res,) = _bind_run(out, {"x": np.full((2,), sign, "f4")})
+        np.testing.assert_allclose(res, np.full(2, expect), rtol=1e-6)
+
+
+def test_sym_foreach_rnn_cell_shapes_back_infer():
+    """An RNN-style cell inside the body: the loop node's shape hook runs
+    the subgraph's own inference, so the cell's FC weights back-infer
+    from the data slice shape — no explicit weight shapes needed (the
+    reference subgraph FInferShape behavior)."""
+    data = mx.sym.Variable("data")     # (N, T, F) from the iterator
+    init = mx.sym.Variable("init")     # (N, H)
+    data_t = mx.sym.transpose(data, axes=(1, 0, 2))  # scan over T
+
+    def body(x, s):
+        h = mx.sym.FullyConnected(x, num_hidden=4, name="i2h") \
+            + mx.sym.FullyConnected(s, num_hidden=4, no_bias=True,
+                                    name="h2h")
+        h = mx.sym.Activation(h, act_type="tanh")
+        return h, h
+
+    outs, fin = mx.sym.contrib.foreach(body, data_t, init)
+    net = mx.sym.FullyConnected(fin, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = net.list_arguments()
+    assert "i2h_weight" in args and "h2h_weight" in args
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(4, 5, 3),
+                                                init=(4, 4))
+    shp = dict(zip(args, arg_shapes))
+    assert shp["i2h_weight"] == (4, 3)     # back-inferred through the scan
+    assert shp["h2h_weight"] == (4, 4)
+    assert shp["fc_weight"] == (2, 4)
+    assert out_shapes[0] == (4, 2)
+
+    # and it trains through the standard Module path
+    rng = np.random.RandomState(0)
+    X = rng.randn(4, 5, 3).astype("f4")   # iter feeds (N, T, F)
+    Y = (rng.rand(4) > 0.5).astype("f4")
+    mod = mx.mod.Module(net, data_names=["data", "init"],
+                        label_names=["softmax_label"])
+    it = mx.io.NDArrayIter({"data": X, "init": np.zeros((4, 4), "f4")},
+                           Y, batch_size=4, label_name="softmax_label")
+    mod.fit(it, num_epoch=2, eval_metric="acc",
+            optimizer_params={"learning_rate": 0.1})
+    assert mod.get_params()[0]["i2h_weight"].shape == (4, 3)
+
+
+def test_sym_while_loop_is_differentiable():
+    """The loop lowers to a masked lax.scan, not lax.while_loop, so
+    jax.vjp (the executor backward) differentiates through it."""
+    w = mx.sym.Variable("w")
+
+    def cond_fn(i, s):
+        return i < 3
+
+    def func(i, s):
+        return s, (i + 1, s * w)
+
+    outs, fin = mx.sym.contrib.while_loop(
+        cond_fn, func, [mx.sym.Variable("i"), mx.sym.Variable("s")],
+        max_iterations=4)
+    loss = mx.sym.sum(fin[1])
+    feeds = {"i": np.zeros((1,), "f4"), "s": np.full((1,), 2.0, "f4"),
+             "w": np.full((1,), 3.0, "f4")}
+    (out,), grads = _bind_run(loss, feeds, grad=[np.ones((), "f4")])
+    # 3 iterations: s_final = 2 * w^3 = 54;  d/dw = 6 w^2 = 54
+    np.testing.assert_allclose(out, 54.0, rtol=1e-6)
+    np.testing.assert_allclose(grads["w"], [54.0], rtol=1e-6)
+
+
+def test_sym_foreach_batchnorm_aux_stays_aux():
+    """Moving stats used inside a body remain AUXILIARY states in the
+    outer graph (read-only in the loop) — not trainable arguments."""
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+
+    def body(x, s):
+        h = mx.sym.BatchNorm(x, name="bn", use_global_stats=True)
+        return h + s, s
+
+    outs, fin = mx.sym.contrib.foreach(body, data, init)
+    net = mx.sym.Group([outs, fin])
+    assert "bn_moving_mean" in net.list_auxiliary_states()
+    assert "bn_moving_var" in net.list_auxiliary_states()
+    assert "bn_moving_mean" not in net.list_arguments()
+
+
+def test_sym_foreach_multi_output_body_refused():
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+
+    def body(x, s):
+        return mx.sym.SliceChannel(x, num_outputs=2, axis=0), s
+
+    with pytest.raises(mx.base.MXNetError, match="single-output"):
+        mx.sym.contrib.foreach(body, data, init)
+
+
+def test_sym_control_flow_refuses_tojson():
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    outs, fin = mx.sym.contrib.foreach(lambda x, s: (x + s, s + x),
+                                       data, init)
+    with pytest.raises(mx.base.MXNetError, match="registry"):
+        mx.sym.Group([outs, fin]).tojson()
