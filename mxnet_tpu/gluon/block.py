@@ -203,11 +203,21 @@ class _JaxFSub:
     match only, mirroring the eager contrib_surface resolver so a name
     behaves identically eager and hybridized."""
 
+    # functional contrib helpers with no registry op: control flow +
+    # float predicates dispatch to the ndarray.contrib implementations,
+    # which lower to lax.scan/while/cond on raw jax values — so
+    # F.contrib.foreach works identically eager and hybridized
+    _FUNCTIONAL = ("foreach", "while_loop", "cond", "isinf", "isnan",
+                   "isfinite")
+
     def __init__(self, parent, prefix):
         self._parent = parent
         self._prefix = prefix
 
     def __getattr__(self, name):
+        if self._prefix == "_contrib_" and name in self._FUNCTIONAL:
+            from ..ndarray import contrib as _nd_contrib
+            return getattr(_nd_contrib, name)
         return self._parent._op_fn(self._prefix + name)
 
 

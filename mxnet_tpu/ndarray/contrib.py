@@ -31,6 +31,35 @@ def _is_nd(x):
     return False
 
 
+def _is_jax_val(x):
+    return isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer)
+
+
+def _has_tracer(x):
+    if isinstance(x, jax.core.Tracer):
+        return True
+    if isinstance(x, (list, tuple)):
+        return any(_has_tracer(v) for v in x)
+    return False
+
+
+def _check_not_mixed(*groups):
+    """Inside a hybridized trace, NDArray constants can't cross into the
+    jit program — fail with a clear message instead of a deep
+    TracerBoolConversionError / leaked-tracer crash."""
+    flat = []
+    for g in groups:
+        flat.extend(g if isinstance(g, (list, tuple)) else [g])
+    if any(_has_tracer(v) for v in flat) and any(
+            isinstance(v, NDArray) for v in flat):
+        from ..base import MXNetError
+        raise MXNetError(
+            "control flow inside a hybridized forward mixes traced "
+            "values with NDArray constants; create constants with F "
+            "ops (or pass them as block parameters/inputs) so the whole "
+            "loop stays inside the compiled program")
+
+
 def _as_list(x):
     if isinstance(x, (list, tuple)):
         return list(x), False
@@ -41,6 +70,7 @@ def foreach(body, data, init_states):
     """Run body over data slices along axis 0, threading states
     (reference contrib.foreach; symbolic analog `_foreach`
     control_flow.cc:1255)."""
+    _check_not_mixed(data, init_states)
     if _is_nd(data) or _is_nd(init_states):
         return _foreach_eager(body, data, init_states)
     return _foreach_lax(body, data, init_states)
@@ -95,6 +125,7 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     contrib.while_loop / `_while_loop` control_flow.cc:1316)."""
     if max_iterations is None:
         raise ValueError("max_iterations is required")
+    _check_not_mixed(loop_vars)
     if _is_nd(loop_vars):
         return _while_eager(cond, func, loop_vars, max_iterations)
     return _while_lax(cond, func, loop_vars, max_iterations)
@@ -193,15 +224,20 @@ def cond(pred, then_func, else_func):
 
 
 def isinf(data):
+    if _is_jax_val(data):  # raw jax value inside a hybridized trace
+        return jnp.isinf(data).astype(data.dtype)
     return invoke("abs", [data], {}) == float("inf")
 
 
 def isnan(data):
+    if _is_jax_val(data):
+        return jnp.isnan(data).astype(data.dtype)
     return data != data
 
 
 def isfinite(data):
-    import numpy as _np
+    if _is_jax_val(data):
+        return jnp.isfinite(data).astype(data.dtype)
     fin = invoke("abs", [data], {}) != float("inf")
     notnan = (data == data)
     return fin * notnan

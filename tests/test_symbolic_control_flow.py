@@ -207,6 +207,83 @@ def test_sym_foreach_multi_output_body_refused():
         mx.sym.contrib.foreach(body, data, init)
 
 
+def test_hybridized_f_contrib_foreach_matches_eager():
+    """F.contrib.foreach inside a HybridBlock: same numerics eager and
+    under the jit trace (the functional control flow dispatches to the
+    lax lowering on raw jax values)."""
+    from mxnet_tpu import gluon, autograd
+
+    class ScanCell(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.dense = gluon.nn.Dense(8, flatten=False)
+
+        def hybrid_forward(self, F, x, init):
+            xt = F.transpose(x, axes=(1, 0, 2))
+
+            def body(xs, s):
+                h = F.tanh(self.dense(xs) + s)
+                return h, h
+
+            outs, fin = F.contrib.foreach(body, xt, init)
+            return fin
+
+    net = ScanCell()
+    net.initialize()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 5, 3).astype("f4"))
+    z = mx.nd.zeros((4, 8))
+    y_eager = net(x, z).asnumpy()
+    net.hybridize()
+    with autograd.record():
+        y_hyb = net(x, z)
+        loss = y_hyb.sum()
+    loss.backward()  # gradient flows through the scan
+    np.testing.assert_allclose(y_eager, y_hyb.asnumpy(), atol=1e-5)
+    g = net.dense.weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_hybridized_f_contrib_float_predicates():
+    from mxnet_tpu import gluon
+
+    class Pred(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.contrib.isfinite(x) + F.contrib.isnan(x) * 2 \
+                + F.contrib.isinf(x) * 4
+
+    net = Pred()
+    x = mx.nd.array(np.array([1.0, float("inf"), float("nan")], "f4"))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(y_eager, [1.0, 4.0, 2.0])
+    np.testing.assert_allclose(y_hyb, y_eager)
+
+
+def test_hybridized_control_flow_refuses_nd_constants():
+    """Mixing an NDArray constant into control flow inside a hybridized
+    forward fails with a clear message, not a leaked-tracer crash."""
+    from mxnet_tpu import gluon
+
+    const = mx.nd.zeros((4, 8))
+
+    class Bad(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            xt = F.transpose(x, axes=(1, 0, 2))
+            outs, fin = F.contrib.foreach(
+                lambda xs, s: (xs + s, s), xt, const)  # captured NDArray
+            return fin
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.zeros((4, 5, 8))
+    with pytest.raises(mx.base.MXNetError, match="hybridized"):
+        net(x)
+
+
 def test_sym_control_flow_refuses_tojson():
     data = mx.sym.Variable("data")
     init = mx.sym.Variable("init")
