@@ -219,8 +219,22 @@ def cond(pred, then_func, else_func):
     contrib.cond / `_cond` control_flow.cc:1378)."""
     if isinstance(pred, NDArray):
         return then_func() if _bool_of(pred) else else_func()
+
+    def checked(f):
+        def g(_):
+            out = f()
+            if _is_nd(out):  # NDArray const can't cross the jit trace
+                from ..base import MXNetError
+                raise MXNetError(
+                    "cond branch inside a hybridized forward returned an "
+                    "NDArray constant; create it with F ops (or pass it "
+                    "as a block parameter/input) so the branch stays "
+                    "inside the compiled program")
+            return out
+        return g
+
     return lax.cond(jnp.squeeze(pred).astype(bool),
-                    lambda _: then_func(), lambda _: else_func(), None)
+                    checked(then_func), checked(else_func), None)
 
 
 def isinf(data):

@@ -10,18 +10,30 @@ TPU-native control-flow design: the reference cuts the body into an
 nnvm subgraph executed by a dedicated C++ op with hand-written
 gradients. Here the body is traced into a sub-Symbol, evaluated by the
 same pure interpreter the executor jits (`executor._graph_eval_fn`),
-and the step node's fn lowers to ``lax.scan`` / ``lax.while_loop`` /
-``lax.cond`` — so the compiled graph gets real XLA control flow and the
-gradient falls out of ``jax.vjp`` through scan, no custom backward.
+and the step node's fn lowers to ``lax.scan`` / a masked fixed-trip
+scan / ``lax.cond`` — so the compiled graph gets real XLA control flow
+and the gradient falls out of ``jax.vjp``, no custom backward.
+(while_loop uses a masked scan rather than ``lax.while_loop`` because
+reverse-mode autodiff cannot cross while_loop and ``max_iterations`` is
+mandatory anyway.)
 
-Caveats (documented, loud): control-flow nodes hold Python closures, so
-symbols containing them don't serialize to JSON (`tojson` refuses);
-auxiliary states (e.g. BatchNorm moving stats) used inside a body are
-read-only within the loop.
+Construction is split trace/build: the public functions trace the body
+into a sub-Symbol plus a metadata dict, and ``_build_*`` turns
+(subgraphs, meta, inputs) into the node. JSON serde round-trips through
+the same split — ``tojson`` embeds the sub-Symbol graphs in the node's
+``subgraphs`` field (the reference's subgraph wire layout) with the
+metadata as a node attr, and ``load_json`` rebuilds via ``_build_*`` —
+so control-flow models checkpoint like any other (reference
+nnvm::Symbol subgraph serialization).
+
+Aux states (e.g. BatchNorm moving stats) used inside a body stay
+classified auxiliary in the outer graph and are read-only within the
+loop.
 """
 from __future__ import annotations
 
 import itertools
+import json as _json
 
 from ..base import MXNetError
 from ..ops.registry import contrib_surface as _contrib_surface, Operator
@@ -50,15 +62,14 @@ def _one_entry(sym, what):
 
 def _trace_subgraph(out_syms, placeholder_names):
     """Group outputs into a sub-Symbol; split its variables into
-    (free arg nodes, aux names) excluding the placeholders."""
+    (free arg nodes, aux nodes) excluding the placeholders."""
     sub = Group(out_syms)
     aux_names = set(sub.list_auxiliary_states())
     free_nodes = [n for n in sub._topo()
                   if n.is_variable and n.name not in placeholder_names]
     arg_nodes = [n for n in free_nodes if n.name not in aux_names]
     aux_nodes = [n for n in free_nodes if n.name in aux_names]
-    from ..executor import _graph_eval_fn
-    return sub, arg_nodes, aux_nodes, _graph_eval_fn(sub)
+    return sub, arg_nodes, aux_nodes
 
 
 def _has_random(sub):
@@ -66,7 +77,7 @@ def _has_random(sub):
 
 
 def _flow_node(op_name, fn, n_outputs, input_entries, name, is_random,
-               shape_hook=None, aux_slots=()):
+               shape_hook=None, aux_slots=(), flow_payload=None):
     op = Operator(op_name, fn, num_outputs=n_outputs, is_random=is_random)
     op.shape_hook = shape_hook
     # aux slots keep BatchNorm-style moving stats classified as auxiliary
@@ -76,6 +87,10 @@ def _flow_node(op_name, fn, n_outputs, input_entries, name, is_random,
     op.aux_inputs = tuple(aux_slots)
     node = Node(op, _auto_name(op_name.strip("_") + "_", name),
                 list(input_entries), {})
+    if flow_payload is not None:
+        # consumed by tojson (serialized as node "subgraphs" + meta attr)
+        # and skipped by attr_dict; see _FLOW_REBUILD for the load side
+        node.attrs["__flow__"] = flow_payload
     return Symbol([(node, i) for i in range(n_outputs)])
 
 
@@ -93,6 +108,7 @@ def _subgraph_shape_hook(sub, slot_names, slot_slice_axis0):
     ``slot_names``: sub-graph variable name per node input slot;
     ``slot_slice_axis0``: slots whose node-level shape carries a leading
     scan axis the per-step subgraph doesn't see."""
+    slot_slice_axis0 = set(slot_slice_axis0)
 
     def hook(in_shapes, params):
         known = {}
@@ -120,39 +136,22 @@ def _subgraph_shape_hook(sub, slot_names, slot_slice_axis0):
     return hook
 
 
-def foreach(body, data, init_states, name=None):
-    """Symbolic scan: run ``body(data_slice, states)`` over axis 0 of
-    ``data``, threading states (reference sym.contrib.foreach).
-    Returns (outputs, final_states) with the body's structure."""
+# ---------------------------------------------------------------------------
+# builders: (subgraphs, meta, input entries) -> flow-node Symbol.
+# The public trace functions call these directly; load_json rebuilds
+# through the same path (_FLOW_REBUILD).
+# ---------------------------------------------------------------------------
+
+def _build_foreach(sub, meta, entries, name):
     import jax
     from jax import lax
+    from ..executor import _graph_eval_fn
     from .. import random as _random
 
-    data_list, single_data = _as_list(data)
-    states, single_state = _as_list(init_states)
-    uid = next(_uid)
-    ph_data = [Variable("_foreach%d_data%d" % (uid, i))
-               for i in range(len(data_list))]
-    ph_states = [Variable("_foreach%d_state%d" % (uid, i))
-                 for i in range(len(states))]
-    outs, fin = body(_unwrap(ph_data, single_data),
-                     _unwrap(ph_states, single_state))
-    out_list, single_out = _as_list(outs)
-    fin_list, _ = _as_list(fin)
-    if len(fin_list) != len(states):
-        raise MXNetError(
-            "foreach body returned %d states, expected %d"
-            % (len(fin_list), len(states)))
-    _check_single(out_list, "foreach body output")
-    _check_single(fin_list, "foreach body state")
-    d_names = [s.name for s in ph_data]
-    s_names = [s.name for s in ph_states]
-    sub, arg_nodes, aux_nodes, eval_fn = _trace_subgraph(
-        out_list + fin_list, set(d_names + s_names))
-    rand = _has_random(sub)
-    n_data, n_st, n_out = len(data_list), len(states), len(out_list)
-    f_names = [n.name for n in arg_nodes]
-    a_names = [n.name for n in aux_nodes]
+    n_data, n_st, n_out = meta["n_data"], meta["n_st"], meta["n_out"]
+    d_names, s_names = meta["d_names"], meta["s_names"]
+    f_names, a_names = meta["f_names"], meta["a_names"]
+    eval_fn = _graph_eval_fn(sub)
 
     def fn(*args, _training=True):
         datas = args[:n_data]
@@ -172,58 +171,30 @@ def foreach(body, data, init_states, name=None):
             return ((key,) + tuple(outputs[n_out:]),
                     tuple(outputs[:n_out]))
 
-        final, ys = lax.scan(step, (key0,) + tuple(st0),
-                             tuple(datas))
+        final, ys = lax.scan(step, (key0,) + tuple(st0), tuple(datas))
         return tuple(ys) + tuple(final[1:])
 
-    entries = [_one_entry(s, "foreach data") for s in data_list] \
-        + [_one_entry(s, "foreach state") for s in states] \
-        + [(n, 0) for n in arg_nodes] + [(n, 0) for n in aux_nodes]
     hook = _subgraph_shape_hook(sub, d_names + s_names + f_names + a_names,
-                                set(range(n_data)))
+                                range(n_data))
     aux0 = n_data + n_st + len(f_names)
-    res = _flow_node("_foreach", fn, n_out + n_st, entries, name, rand,
-                     shape_hook=hook,
-                     aux_slots=range(aux0, aux0 + len(a_names)))
-    out = _unwrap([res[i] for i in range(n_out)], single_out)
-    fin_states = _unwrap([res[n_out + i] for i in range(n_st)],
-                         single_state)
-    return out, fin_states
+    return _flow_node("_foreach", fn, n_out + n_st, entries, name,
+                      _has_random(sub), shape_hook=hook,
+                      aux_slots=range(aux0, aux0 + len(a_names)),
+                      flow_payload=([sub], meta))
 
 
-def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
-    """Symbolic while: run ``func`` while ``cond`` holds, up to
-    ``max_iterations``; step outputs are stacked and zero-padded to
-    max_iterations (reference sym.contrib.while_loop)."""
+def _build_while(sub, meta, entries, name):
     import jax
     import jax.numpy as jnp
     from jax import lax
+    from ..executor import _graph_eval_fn
     from .. import random as _random
 
-    if max_iterations is None:
-        raise ValueError("max_iterations is required")
-    max_iterations = int(max_iterations)
-    lvars, single = _as_list(loop_vars)
-    uid = next(_uid)
-    ph = [Variable("_while%d_var%d" % (uid, i)) for i in range(len(lvars))]
-    cond_sym = cond(*ph)
-    step_out, new_vars = func(*ph)
-    out_list, single_out = _as_list(step_out)
-    nv_list, _ = _as_list(new_vars)
-    if len(nv_list) != len(lvars):
-        raise MXNetError("while_loop func returned %d loop_vars, "
-                         "expected %d" % (len(nv_list), len(lvars)))
-    _check_single([cond_sym], "while_loop cond output")
-    _check_single(out_list, "while_loop step output")
-    _check_single(nv_list, "while_loop loop_var")
-    ph_names = {s.name for s in ph}
-    v_names = [s.name for s in ph]
-    sub, arg_nodes, aux_nodes, eval_fn = _trace_subgraph(
-        [cond_sym] + out_list + nv_list, ph_names)
-    rand = _has_random(sub)
-    n_v, n_out = len(lvars), len(out_list)
-    f_names = [n.name for n in arg_nodes]
-    a_names = [n.name for n in aux_nodes]
+    n_v, n_out = meta["n_v"], meta["n_out"]
+    max_iterations = meta["max_iterations"]
+    v_names, f_names, a_names = (meta["v_names"], meta["f_names"],
+                                 meta["a_names"])
+    eval_fn = _graph_eval_fn(sub)
 
     def fn(*args, _training=True):
         # fixed-trip lax.scan with an active mask, NOT lax.while_loop:
@@ -259,40 +230,25 @@ def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
             length=max_iterations)
         return tuple(ys) + tuple(fin)
 
-    entries = [_one_entry(s, "while_loop var") for s in lvars] \
-        + [(n, 0) for n in arg_nodes] + [(n, 0) for n in aux_nodes]
-    hook = _subgraph_shape_hook(sub, v_names + f_names + a_names, set())
+    hook = _subgraph_shape_hook(sub, v_names + f_names + a_names, ())
     aux0 = n_v + len(f_names)
-    res = _flow_node("_while_loop", fn, n_out + n_v, entries, name, rand,
-                     shape_hook=hook,
-                     aux_slots=range(aux0, aux0 + len(a_names)))
-    out = _unwrap([res[i] for i in range(n_out)], single_out)
-    fin = _unwrap([res[n_out + i] for i in range(n_v)], single)
-    return out, fin
+    return _flow_node("_while_loop", fn, n_out + n_v, entries, name,
+                      _has_random(sub), shape_hook=hook,
+                      aux_slots=range(aux0, aux0 + len(a_names)),
+                      flow_payload=([sub], meta))
 
 
-def cond(pred, then_func, else_func, name=None):
-    """Symbolic branch: then_func() or else_func() by scalar ``pred``
-    (reference sym.contrib.cond). Both branches must produce the same
-    output structure."""
+def _build_cond(sub_t, sub_e, meta, entries, name):
     import jax.numpy as jnp
     from jax import lax
+    from ..executor import _graph_eval_fn
     from .. import random as _random
 
-    then_out, single_then = _as_list(then_func())
-    else_out, single_else = _as_list(else_func())
-    if len(then_out) != len(else_out) or single_then != single_else:
-        raise MXNetError("cond branches must return the same structure")
-    _check_single(then_out, "cond then output")
-    _check_single(else_out, "cond else output")
-    sub_t, arg_t, aux_t, eval_t = _trace_subgraph(then_out, set())
-    sub_e, arg_e, aux_e, eval_e = _trace_subgraph(else_out, set())
-    rand = _has_random(sub_t) or _has_random(sub_e)
-    n_out = len(then_out)
-    ft, at = [n.name for n in arg_t], [n.name for n in aux_t]
-    fe, ae = [n.name for n in arg_e], [n.name for n in aux_e]
-    nt, nat = len(ft), len(at)
-    ne, nae = len(fe), len(ae)
+    n_out = meta["n_out"]
+    ft, at, fe, ae = meta["ft"], meta["at"], meta["fe"], meta["ae"]
+    nt, nat, ne, nae = len(ft), len(at), len(fe), len(ae)
+    eval_t = _graph_eval_fn(sub_t)
+    eval_e = _graph_eval_fn(sub_e)
 
     def fn(pred_v, *args, _training=True):
         vt = dict(zip(ft, args[:nt]))
@@ -311,14 +267,147 @@ def cond(pred, then_func, else_func, name=None):
 
         return lax.cond(jnp.squeeze(pred_v).astype(bool), t, e, None)
 
+    aux_slots = list(range(1 + nt, 1 + nt + nat)) \
+        + list(range(1 + nt + nat + ne, 1 + nt + nat + ne + nae))
+    return _flow_node("_cond", fn, n_out, entries, name,
+                      _has_random(sub_t) or _has_random(sub_e),
+                      aux_slots=aux_slots,
+                      flow_payload=([sub_t, sub_e], meta))
+
+
+_FLOW_REBUILD = {
+    "_foreach": lambda subs, meta, entries, name:
+        _build_foreach(subs[0], meta, entries, name),
+    "_while_loop": lambda subs, meta, entries, name:
+        _build_while(subs[0], meta, entries, name),
+    "_cond": lambda subs, meta, entries, name:
+        _build_cond(subs[0], subs[1], meta, entries, name),
+}
+
+
+def rebuild_flow_node(op_name, sub_jsons, meta_raw, input_entries, name):
+    """load_json hook: reconstruct a control-flow node from its embedded
+    subgraph JSONs + metadata attr."""
+    from .symbol import load_json
+    if op_name not in _FLOW_REBUILD:
+        raise MXNetError(
+            "node %r carries subgraphs but op %r has no rebuild rule "
+            "here (reference nnvm subgraph ops beyond "
+            "_foreach/_while_loop/_cond are unsupported)"
+            % (name, op_name))
+    if meta_raw is None:
+        raise MXNetError(
+            "control-flow node %r (%s) has no __flow_meta__ attr: this "
+            "JSON was serialized by reference MXNet's nnvm subgraph "
+            "format, whose C++ slot layout we don't reconstruct — "
+            "re-export the model through this package's tojson()"
+            % (name, op_name))
+    subs = [load_json(_json.dumps(sj)) for sj in sub_jsons]
+    meta = _json.loads(meta_raw) if isinstance(meta_raw, str) else meta_raw
+    sym = _FLOW_REBUILD[op_name](subs, meta, input_entries, name)
+    return sym._entries[0][0]  # the Node; caller re-wraps entries
+
+
+# ---------------------------------------------------------------------------
+# public trace functions
+# ---------------------------------------------------------------------------
+
+def foreach(body, data, init_states, name=None):
+    """Symbolic scan: run ``body(data_slice, states)`` over axis 0 of
+    ``data``, threading states (reference sym.contrib.foreach).
+    Returns (outputs, final_states) with the body's structure."""
+    data_list, single_data = _as_list(data)
+    states, single_state = _as_list(init_states)
+    uid = next(_uid)
+    ph_data = [Variable("_foreach%d_data%d" % (uid, i))
+               for i in range(len(data_list))]
+    ph_states = [Variable("_foreach%d_state%d" % (uid, i))
+                 for i in range(len(states))]
+    outs, fin = body(_unwrap(ph_data, single_data),
+                     _unwrap(ph_states, single_state))
+    out_list, single_out = _as_list(outs)
+    fin_list, _ = _as_list(fin)
+    if len(fin_list) != len(states):
+        raise MXNetError(
+            "foreach body returned %d states, expected %d"
+            % (len(fin_list), len(states)))
+    _check_single(out_list, "foreach body output")
+    _check_single(fin_list, "foreach body state")
+    d_names = [s.name for s in ph_data]
+    s_names = [s.name for s in ph_states]
+    sub, arg_nodes, aux_nodes = _trace_subgraph(
+        out_list + fin_list, set(d_names + s_names))
+    meta = {"n_data": len(data_list), "n_st": len(states),
+            "n_out": len(out_list), "d_names": d_names,
+            "s_names": s_names,
+            "f_names": [n.name for n in arg_nodes],
+            "a_names": [n.name for n in aux_nodes]}
+    entries = [_one_entry(s, "foreach data") for s in data_list] \
+        + [_one_entry(s, "foreach state") for s in states] \
+        + [(n, 0) for n in arg_nodes] + [(n, 0) for n in aux_nodes]
+    res = _build_foreach(sub, meta, entries, name)
+    n_out, n_st = meta["n_out"], meta["n_st"]
+    out = _unwrap([res[i] for i in range(n_out)], single_out)
+    fin_states = _unwrap([res[n_out + i] for i in range(n_st)],
+                         single_state)
+    return out, fin_states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
+    """Symbolic while: run ``func`` while ``cond`` holds, up to
+    ``max_iterations``; step outputs are stacked and zero-padded to
+    max_iterations (reference sym.contrib.while_loop)."""
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    lvars, single = _as_list(loop_vars)
+    uid = next(_uid)
+    ph = [Variable("_while%d_var%d" % (uid, i)) for i in range(len(lvars))]
+    cond_sym = cond(*ph)
+    step_out, new_vars = func(*ph)
+    out_list, single_out = _as_list(step_out)
+    nv_list, _ = _as_list(new_vars)
+    if len(nv_list) != len(lvars):
+        raise MXNetError("while_loop func returned %d loop_vars, "
+                         "expected %d" % (len(nv_list), len(lvars)))
+    _check_single([cond_sym], "while_loop cond output")
+    _check_single(out_list, "while_loop step output")
+    _check_single(nv_list, "while_loop loop_var")
+    v_names = [s.name for s in ph]
+    sub, arg_nodes, aux_nodes = _trace_subgraph(
+        [cond_sym] + out_list + nv_list, set(v_names))
+    meta = {"n_v": len(lvars), "n_out": len(out_list),
+            "max_iterations": int(max_iterations), "v_names": v_names,
+            "f_names": [n.name for n in arg_nodes],
+            "a_names": [n.name for n in aux_nodes]}
+    entries = [_one_entry(s, "while_loop var") for s in lvars] \
+        + [(n, 0) for n in arg_nodes] + [(n, 0) for n in aux_nodes]
+    res = _build_while(sub, meta, entries, name)
+    n_out, n_v = meta["n_out"], meta["n_v"]
+    out = _unwrap([res[i] for i in range(n_out)], single_out)
+    fin = _unwrap([res[n_out + i] for i in range(n_v)], single)
+    return out, fin
+
+
+def cond(pred, then_func, else_func, name=None):
+    """Symbolic branch: then_func() or else_func() by scalar ``pred``
+    (reference sym.contrib.cond). Both branches must produce the same
+    output structure."""
+    then_out, single_then = _as_list(then_func())
+    else_out, single_else = _as_list(else_func())
+    if len(then_out) != len(else_out) or single_then != single_else:
+        raise MXNetError("cond branches must return the same structure")
+    _check_single(then_out, "cond then output")
+    _check_single(else_out, "cond else output")
+    sub_t, arg_t, aux_t = _trace_subgraph(then_out, set())
+    sub_e, arg_e, aux_e = _trace_subgraph(else_out, set())
+    meta = {"n_out": len(then_out),
+            "ft": [n.name for n in arg_t], "at": [n.name for n in aux_t],
+            "fe": [n.name for n in arg_e], "ae": [n.name for n in aux_e]}
     entries = [_one_entry(pred, "cond pred")] \
         + [(n, 0) for n in arg_t] + [(n, 0) for n in aux_t] \
         + [(n, 0) for n in arg_e] + [(n, 0) for n in aux_e]
-    aux_slots = list(range(1 + nt, 1 + nt + nat)) \
-        + list(range(1 + nt + nat + ne, 1 + nt + nat + ne + nae))
-    res = _flow_node("_cond", fn, n_out, entries, name, rand,
-                     aux_slots=aux_slots)
-    return _unwrap([res[i] for i in range(n_out)], single_then)
+    res = _build_cond(sub_t, sub_e, meta, entries, name)
+    return _unwrap([res[i] for i in range(meta["n_out"])], single_then)
 
 
 def _make_contrib_fn(op):

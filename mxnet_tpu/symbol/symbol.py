@@ -173,7 +173,9 @@ class Symbol:
         out = {}
         for n in self._topo():
             if n.attrs:
-                out[n.name] = dict(n.attrs)
+                d = {k: v for k, v in n.attrs.items() if k != "__flow__"}
+                if d:
+                    out[n.name] = d
         return out
 
     # ------------------------------------------------------------ operators
@@ -328,7 +330,8 @@ class Symbol:
     def tojson(self):
         nodes = self._topo()
         for n in nodes:
-            if not n.is_variable and _registry.get_or_none(n.op.name) is None:
+            if not n.is_variable and "__flow__" not in n.attrs \
+                    and _registry.get_or_none(n.op.name) is None:
                 # e.g. fused subgraph nodes: their Operator is a closure
                 # outside the registry, so the JSON could never load back
                 raise MXNetError(
@@ -341,12 +344,20 @@ class Symbol:
         row_ptr = [0]
         for n in nodes:
             attrs = {k: _attr_str(k, v) for k, v in n.params.items()}
-            attrs.update({k: _attr_str(k, v) for k, v in n.attrs.items()})
+            attrs.update({k: _attr_str(k, v) for k, v in n.attrs.items()
+                          if k != "__flow__"})
             jn = {
                 "op": "null" if n.is_variable else n.op.name,
                 "name": n.name,
                 "inputs": [[nid[id(src)], oi, 0] for (src, oi) in n.inputs],
             }
+            if "__flow__" in n.attrs:
+                # control-flow node: embed the body sub-Symbol graph(s)
+                # (reference nnvm subgraph serialization layout) plus the
+                # slot metadata needed to rebuild the lax lowering
+                subs, meta = n.attrs["__flow__"]
+                jn["subgraphs"] = [json.loads(s.tojson()) for s in subs]
+                attrs["__flow_meta__"] = json.dumps(meta)
             if attrs:
                 jn["attrs"] = attrs
             jnodes.append(jn)
@@ -678,6 +689,18 @@ def load_json(json_str):
             user[k] = _user_attr_parse(k, v)
         if jn["op"] == "null":
             node = Node(None, jn["name"], [], {}, user)
+        elif "subgraphs" in jn:
+            # control-flow node: rebuild the lax lowering from the
+            # embedded body graph(s) + metadata (contrib._build_*)
+            from .contrib import rebuild_flow_node
+            inputs = [(nodes[i], jin[1] if len(jin) > 1 else 0)
+                      for jin in jn["inputs"]
+                      for i in [jin[0]]]
+            node = rebuild_flow_node(jn["op"], jn["subgraphs"],
+                                     raw.get("__flow_meta__"),
+                                     inputs, jn["name"])
+            user.pop("__flow_meta__", None)
+            node.attrs.update(user)  # user attrs survive the round-trip
         else:
             deferred = {}   # suffixed hidden keys: weight_lr_mult etc.
             params = {}

@@ -284,10 +284,50 @@ def test_hybridized_control_flow_refuses_nd_constants():
         net(x)
 
 
-def test_sym_control_flow_refuses_tojson():
+def test_sym_foreach_json_roundtrip():
+    """Control-flow nodes serialize with embedded subgraphs (the
+    reference's nnvm subgraph wire layout) and rebuild on load — a
+    checkpointed control-flow model round-trips like any other."""
     data = mx.sym.Variable("data")
     init = mx.sym.Variable("init")
-    outs, fin = mx.sym.contrib.foreach(lambda x, s: (x + s, s + x),
-                                       data, init)
-    with pytest.raises(mx.base.MXNetError, match="registry"):
-        mx.sym.Group([outs, fin]).tojson()
+
+    def body(x, s):
+        h = mx.sym.Activation(mx.sym.FullyConnected(x, num_hidden=4,
+                                                    name="i2h") + s,
+                              act_type="tanh")
+        return h, h
+
+    outs, fin = mx.sym.contrib.foreach(body, data, init)
+    net = mx.sym.Group([outs, fin])
+    js = net.tojson()
+    assert "subgraphs" in js and "_foreach" in js
+    net2 = mx.sym.load_json(js)
+    assert sorted(net2.list_arguments()) == sorted(net.list_arguments())
+    x = np.random.RandomState(0).randn(5, 2, 3).astype("f4")
+    feeds = {"data": x, "init": np.zeros((2, 4), "f4"),
+             "i2h_weight": np.ones((4, 3), "f4") * 0.1,
+             "i2h_bias": np.zeros((4,), "f4")}
+    y1 = _bind_run(net, feeds)
+    y2 = _bind_run(net2, feeds)
+    for a, b in zip(y1, y2):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_sym_while_and_cond_json_roundtrip():
+    w = mx.sym.Variable("w")
+    outs, fin = mx.sym.contrib.while_loop(
+        lambda i, s: i < 3, lambda i, s: (s, (i + 1, s * w)),
+        [mx.sym.Variable("i"), mx.sym.Variable("s")], max_iterations=4)
+    x = mx.sym.Variable("x")
+    branch = mx.sym.contrib.cond(mx.sym.sum(x) > 0,
+                                 lambda: x * 2.0, lambda: x - 1.0)
+    net = mx.sym.Group([outs, fin[1], branch])
+    net2 = mx.sym.load_json(net.tojson())
+    feeds = {"i": np.zeros((1,), "f4"), "s": np.full((1,), 2.0, "f4"),
+             "w": np.full((1,), 3.0, "f4"),
+             "x": np.full((2,), 1.5, "f4")}
+    y1 = _bind_run(net, feeds)
+    y2 = _bind_run(net2, feeds)
+    for a, b in zip(y1, y2):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    np.testing.assert_allclose(y2[-1], [3.0, 3.0])  # then-branch taken
