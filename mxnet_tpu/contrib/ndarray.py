@@ -1,0 +1,5 @@
+"""``mx.contrib.ndarray`` namespace (reference contrib/ndarray.py —
+the registration target for contrib ndarray functions). Re-exports the
+real surface from :mod:`mxnet_tpu.ndarray.contrib`."""
+from ..ndarray.contrib import *  # noqa: F401,F403
+from ..ndarray.contrib import foreach, while_loop, cond  # noqa: F401

@@ -1,0 +1,3 @@
+"""``mx.contrib.symbol`` namespace (reference contrib/symbol.py).
+Re-exports the real surface from :mod:`mxnet_tpu.symbol.contrib`."""
+from ..symbol.contrib import foreach, while_loop, cond  # noqa: F401

@@ -1,0 +1,34 @@
+"""TensorRT contrib surface (reference contrib/tensorrt.py:30-106).
+
+TensorRT is a CUDA-platform engine; the TPU-native replacement for its
+role — ahead-of-time compiled, weights-baked inference artifacts — is
+:mod:`mxnet_tpu.serving` (`export_compiled` / `CompiledModel`, the
+`.mxtpu` StableHLO format; docs/serving.md). These functions fail
+loudly with that pointer instead of pretending a TRT engine exists
+(same policy as rtc.py for CUDA runtime compilation).
+"""
+from ..base import MXNetError
+
+__all__ = ["set_use_tensorrt", "get_use_tensorrt", "get_optimized_symbol",
+           "tensorrt_bind"]
+
+_MSG = ("TensorRT is a CUDA-only engine with no TPU analog; use "
+        "mxnet_tpu.serving.export_compiled / CompiledModel for "
+        "AOT-compiled inference artifacts (docs/serving.md)")
+
+
+def set_use_tensorrt(status):
+    if status:
+        raise MXNetError(_MSG)
+
+
+def get_use_tensorrt():
+    return False
+
+
+def get_optimized_symbol(executor):
+    raise MXNetError(_MSG)
+
+
+def tensorrt_bind(symbol, ctx, all_params, **kwargs):
+    raise MXNetError(_MSG)

@@ -305,7 +305,13 @@ def rebuild_flow_node(op_name, sub_jsons, meta_raw, input_entries, name):
     subs = [load_json(_json.dumps(sj)) for sj in sub_jsons]
     meta = _json.loads(meta_raw) if isinstance(meta_raw, str) else meta_raw
     sym = _FLOW_REBUILD[op_name](subs, meta, input_entries, name)
-    return sym._entries[0][0]  # the Node; caller re-wraps entries
+    node = sym._entries[0][0]
+    # serialized names load VERBATIM (like every other node kind) — the
+    # builder routed `name` through the NameManager, which would prefix
+    # it inside an active mx.name.Prefix scope and desync name-keyed
+    # consumers from the checkpoint
+    node.name = name
+    return node  # caller re-wraps entries
 
 
 # ---------------------------------------------------------------------------

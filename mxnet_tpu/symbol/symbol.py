@@ -687,15 +687,15 @@ def load_json(json_str):
         # own legacy format kept user attrs in a separate dict
         for k, v in jn.get("user_attrs", {}).items():
             user[k] = _user_attr_parse(k, v)
+        inputs = [(nodes[i], jin[1] if len(jin) > 1 else 0)
+                  for jin in jn["inputs"]
+                  for i in [jin[0]]]
         if jn["op"] == "null":
             node = Node(None, jn["name"], [], {}, user)
         elif "subgraphs" in jn:
             # control-flow node: rebuild the lax lowering from the
             # embedded body graph(s) + metadata (contrib._build_*)
             from .contrib import rebuild_flow_node
-            inputs = [(nodes[i], jin[1] if len(jin) > 1 else 0)
-                      for jin in jn["inputs"]
-                      for i in [jin[0]]]
             node = rebuild_flow_node(jn["op"], jn["subgraphs"],
                                      raw.get("__flow_meta__"),
                                      inputs, jn["name"])
@@ -715,9 +715,6 @@ def load_json(json_str):
                 params[k] = _attr_parse(v)
             op = _registry.get(jn["op"])
             params = _filter_params(jn["op"], op.fn, params)
-            inputs = [(nodes[i], jin[1] if len(jin) > 1 else 0)
-                      for jin in jn["inputs"]
-                      for i in [jin[0]]]
             node = Node(op, jn["name"], inputs, params, user)
             # re-home "argname_lr_mult" onto the input variable whose name
             # ends with "_argname" (legacy_json_util.cc:77-105 uses

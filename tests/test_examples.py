@@ -76,6 +76,12 @@ def test_super_resolution_example():
     assert "super-resolution OK" in r.stdout
 
 
+def test_dcgan_example():
+    r = _run("train_dcgan.py", ["--epochs", "3", "--num-samples", "64",
+                                "--batch-size", "16"])
+    assert "dcgan OK" in r.stdout
+
+
 def test_sparse_linear_classification_example():
     r = _run("sparse_linear_classification.py", ["--epochs", "5"])
     assert "sparse linear classification OK" in r.stdout
