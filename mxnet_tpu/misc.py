@@ -1,0 +1,46 @@
+"""Legacy learning-rate scheduler interface (reference misc.py — the
+pre-`lr_scheduler` API some v0.x scripts still import). Kept for source
+compatibility; new code uses :mod:`mxnet_tpu.lr_scheduler`."""
+import logging
+import math
+
+__all__ = ["LearningRateScheduler", "FactorScheduler"]
+
+
+class LearningRateScheduler:
+    """Base class of the legacy LR scheduler (reference misc.py:24)."""
+
+    def __init__(self):
+        self.base_lr = 0.01
+
+    def __call__(self, iteration):
+        raise NotImplementedError("must override this")
+
+
+class FactorScheduler(LearningRateScheduler):
+    """Reduce learning rate by ``factor`` every ``step`` iterations
+    (reference misc.py:44; modern analog lr_scheduler.FactorScheduler)."""
+
+    def __init__(self, step, factor=0.1):
+        super().__init__()
+        if step < 1:
+            raise ValueError(
+                "Schedule step must be greater or equal than 1 round")
+        if factor >= 1.0:
+            raise ValueError("Factor must be less than 1 to make lr reduce")
+        self.step = step
+        self.factor = factor
+        self.old_lr = self.base_lr
+        self.init = False
+
+    def __call__(self, iteration):
+        if not self.init:
+            self.init = True
+            self.old_lr = self.base_lr
+        lr = self.base_lr * math.pow(self.factor,
+                                     int(iteration / self.step))
+        if lr != self.old_lr:
+            self.old_lr = lr
+            logging.info("Update[%d]: Change learning rate to %0.5e",
+                         iteration, lr)
+        return lr

@@ -7,6 +7,9 @@ from . import random
 from . import contrib
 from . import linalg
 from . import sparse
+from . import image
+from . import op
+from . import _internal
 from .sparse import csr_matrix, row_sparse_array
 
 _register.populate(__name__)

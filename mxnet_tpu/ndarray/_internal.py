@@ -1,0 +1,9 @@
+"""``mx.nd._internal`` namespace (reference ndarray/_internal.py — the
+underscore-prefixed generated operators, e.g. ``_plus_scalar``)."""
+from ..ops.registry import namespaced_surface as _ns, list_ops as _list
+from .register import _make_op_func as _mk
+
+__getattr__, __dir__ = _ns(
+    globals(), _mk,
+    resolve=lambda n: n if n.startswith("_") else None,
+    listing=lambda: [n for n in _list() if n.startswith("_")])

@@ -1,0 +1,10 @@
+"""``mx.nd.op`` namespace (reference ndarray/op.py — the module the
+code generator populates with every public operator). Resolves any
+non-underscore registry op lazily."""
+from ..ops.registry import namespaced_surface as _ns, list_ops as _list
+from .register import _make_op_func as _mk
+
+__getattr__, __dir__ = _ns(
+    globals(), _mk,
+    resolve=lambda n: None if n.startswith("_") else n,
+    listing=lambda: [n for n in _list() if not n.startswith("_")])

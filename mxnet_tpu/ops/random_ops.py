@@ -116,7 +116,8 @@ def sample_gamma(alpha, beta, *, shape=(), dtype="float32"):
     return g * beta[ext]
 
 
-@register("_sample_multinomial", is_random=True)
+@register("_sample_multinomial", is_random=True,
+          num_outputs=lambda p: 2 if p.get("get_prob") else 1)
 def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32"):
     # data: (..., K) probabilities
     n = 1

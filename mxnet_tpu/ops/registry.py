@@ -156,25 +156,36 @@ def list_ops():
     return sorted(_REGISTRY.keys())
 
 
-def contrib_surface(module_globals, make_fn):
-    """Shared machinery for the generated mx.nd.contrib / mx.sym.contrib
-    namespaces (reference: code-generated contrib modules): returns
-    (__getattr__, __dir__) resolving ``name`` -> the registered
-    ``_contrib_<name>`` operator through ``make_fn(op)``."""
+def namespaced_surface(module_globals, make_fn, resolve, listing=None):
+    """Generic generated-namespace machinery (mx.nd.op / mx.nd.image /
+    mx.sym.random ... — reference code-generated namespace modules):
+    returns (__getattr__, __dir__) where ``resolve(attr)`` maps the
+    attribute to a registry op name (or None -> AttributeError) and
+    ``listing()`` yields the dir() names."""
     def __getattr__(name):
-        op = get_or_none("_contrib_" + name)
+        opname = resolve(name)
+        op = get_or_none(opname) if opname else None
         if op is None:
             raise AttributeError(
                 "%s has no attribute %r" % (module_globals.get(
-                    "__name__", "contrib"), name))
+                    "__name__", "<namespace>"), name))
         fn = make_fn(op)
         fn.__name__ = name
         module_globals[name] = fn   # cache for the next lookup
         return fn
 
     def __dir__():
-        return sorted(set(list(module_globals) + [
-            n[len("_contrib_"):] for n in list_ops()
-            if n.startswith("_contrib_")]))
+        extra = list(listing()) if listing else []
+        return sorted(set(list(module_globals) + extra))
 
     return __getattr__, __dir__
+
+
+def contrib_surface(module_globals, make_fn):
+    """mx.nd.contrib / mx.sym.contrib namespaces: ``name`` resolves to
+    the registered ``_contrib_<name>`` operator."""
+    return namespaced_surface(
+        module_globals, make_fn,
+        resolve=lambda n: "_contrib_" + n,
+        listing=lambda: [n[len("_contrib_"):] for n in list_ops()
+                         if n.startswith("_contrib_")])

@@ -4,6 +4,11 @@ from .symbol import (Symbol, Variable, var, Group, load, load_json,
 from . import register as _register
 from . import linalg
 from . import contrib
+from . import random
+from . import sparse
+from . import image
+from . import op
+from . import _internal
 
 _register.populate(__name__)
 

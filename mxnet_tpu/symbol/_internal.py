@@ -1,0 +1,8 @@
+"""``mx.sym._internal`` namespace (reference symbol/_internal.py)."""
+from ..ops.registry import namespaced_surface as _ns, list_ops as _list
+from .register import _make_op_func as _mk
+
+__getattr__, __dir__ = _ns(
+    globals(), _mk,
+    resolve=lambda n: n if n.startswith("_") else None,
+    listing=lambda: [n for n in _list() if n.startswith("_")])

@@ -1,0 +1,10 @@
+"""``mx.sym.image`` namespace (reference symbol/image.py): attribute X
+resolves to the registered ``_image_X`` operator."""
+from ..ops.registry import namespaced_surface as _ns, list_ops as _list
+from .register import _make_op_func as _mk
+
+__getattr__, __dir__ = _ns(
+    globals(), _mk,
+    resolve=lambda n: "_image_" + n,
+    listing=lambda: [n[len("_image_"):] for n in _list()
+                     if n.startswith("_image_")])

@@ -1,0 +1,84 @@
+"""``mx.sym.random`` namespace (reference symbol/random.py): symbolic
+sampling ops mirroring the ``mx.nd.random`` surface — shape-explicit
+draws that become nodes in the graph and thread the trace key."""
+from __future__ import annotations
+
+from .symbol import Symbol, invoke_sym
+
+
+def _shape(shape):
+    if shape is None:
+        return (1,)
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None,
+            **kw):
+    if isinstance(low, Symbol):
+        return invoke_sym("_sample_uniform", [low, high],
+                          {"shape": shape or (), "dtype": dtype})
+    return invoke_sym("_random_uniform", [],
+                      {"low": low, "high": high, "shape": _shape(shape),
+                       "dtype": dtype})
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+           **kw):
+    if isinstance(loc, Symbol):
+        return invoke_sym("_sample_normal", [loc, scale],
+                          {"shape": shape or (), "dtype": dtype})
+    return invoke_sym("_random_normal", [],
+                      {"loc": loc, "scale": scale, "shape": _shape(shape),
+                       "dtype": dtype})
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None,
+          **kw):
+    if isinstance(alpha, Symbol):
+        return invoke_sym("_sample_gamma", [alpha, beta],
+                          {"shape": shape or (), "dtype": dtype})
+    return invoke_sym("_random_gamma", [],
+                      {"alpha": alpha, "beta": beta,
+                       "shape": _shape(shape), "dtype": dtype})
+
+
+def exponential(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return invoke_sym("_random_exponential", [],
+                      {"lam": lam, "shape": _shape(shape), "dtype": dtype})
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return invoke_sym("_random_poisson", [],
+                      {"lam": lam, "shape": _shape(shape), "dtype": dtype})
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                      **kw):
+    return invoke_sym("_random_negative_binomial", [],
+                      {"k": k, "p": p, "shape": _shape(shape),
+                       "dtype": dtype})
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, **kw):
+    return invoke_sym("_random_generalized_negative_binomial", [],
+                      {"mu": mu, "alpha": alpha, "shape": _shape(shape),
+                       "dtype": dtype})
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, **kw):
+    return invoke_sym("_random_randint", [],
+                      {"low": low, "high": high, "shape": _shape(shape),
+                       "dtype": dtype})
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    return invoke_sym("_sample_multinomial", [data],
+                      {"shape": shape or (), "get_prob": get_prob,
+                       "dtype": dtype})
+
+
+def shuffle(data, **kw):
+    return invoke_sym("_shuffle", [data], {})

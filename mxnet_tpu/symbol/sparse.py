@@ -1,0 +1,21 @@
+"""``mx.sym.sparse`` namespace (reference symbol/sparse.py — generated
+sparse operators). Resolves attribute X to the registered ``_sparse_X``
+op, falling back to the plain name for ops shared with the dense
+surface (dot, retain-style helpers)."""
+from ..ops.registry import namespaced_surface as _ns, list_ops as _list, \
+    get_or_none as _get
+from .register import _make_op_func as _mk
+
+
+def _resolve(n):
+    if n.startswith("_"):
+        return None
+    if _get("_sparse_" + n) is not None:
+        return "_sparse_" + n
+    return n
+
+
+__getattr__, __dir__ = _ns(
+    globals(), _mk, resolve=_resolve,
+    listing=lambda: [n[len("_sparse_"):] for n in _list()
+                     if n.startswith("_sparse_")])

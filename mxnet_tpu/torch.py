@@ -1,0 +1,24 @@
+"""Torch7/LuaJIT bridge surface (reference torch.py — ndarray functions
+executed by a Torch backend compiled with USE_TORCH=1).
+
+That bridge is CUDA-era Lua tech with no TPU analog; anything it could
+compute is a native XLA op here. The module exists so v0.x imports
+resolve, and fails loudly on use (same policy as rtc.py)."""
+from .base import MXNetError
+
+__all__ = []
+
+_MSG = ("the Torch7/LuaJIT bridge has no TPU analog; every mx.th.* "
+        "function maps to a native mx.nd op in this framework")
+
+
+def __getattr__(name):
+    if name.startswith("_"):
+        raise AttributeError(name)
+
+    # attribute access stays AttributeError-clean (hasattr/inspect work);
+    # only USING a torch function fails
+    def stub(*args, **kwargs):
+        raise MXNetError("mxnet.torch.%s: %s" % (name, _MSG))
+    stub.__name__ = name
+    return stub

@@ -1,0 +1,68 @@
+"""Generated namespace modules (reference ndarray/{op,_internal,image}
+.py, symbol/{op,_internal,image,random,sparse}.py, misc.py, torch.py):
+every name a reference script can import resolves here too."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_nd_op_and_internal():
+    y = mx.nd.op.relu(mx.nd.array([-1.0, 2.0]))
+    np.testing.assert_allclose(y.asnumpy(), [0.0, 2.0])
+    z = mx.nd._internal._plus_scalar(mx.nd.array([1.0]), scalar=2.0)
+    np.testing.assert_allclose(z.asnumpy(), [3.0])
+    with pytest.raises(AttributeError):
+        mx.nd.op._plus_scalar  # underscore ops live in _internal only
+    with pytest.raises(AttributeError):
+        mx.nd._internal.relu
+
+
+def test_nd_image_namespace():
+    x = mx.nd.array(np.random.RandomState(0).rand(8, 8, 3)
+                    .astype("f4"))
+    t = mx.nd.image.to_tensor(x)
+    assert t.shape == (3, 8, 8)
+    r = mx.nd.image.resize(x, size=(4, 4))
+    assert r.shape == (4, 4, 3)
+    assert "resize" in dir(mx.nd.image)
+
+
+def test_sym_random_namespace():
+    s = mx.sym.random.normal(loc=2.0, scale=0.1, shape=(64,))
+    ex = s.bind(mx.cpu(), {})
+    ex.forward()
+    v = ex.outputs[0].asnumpy()
+    assert v.shape == (64,) and 1.5 < v.mean() < 2.5
+    # symbolic sample op with Symbol params
+    mu = mx.sym.Variable("mu")
+    s2 = mx.sym.random.uniform(mu, mu + 1.0, shape=())
+    assert "mu" in s2.list_arguments()
+
+
+def test_sym_image_op_internal_sparse():
+    img = mx.sym.Variable("img")
+    t = mx.sym.image.to_tensor(img)
+    ex = t.bind(mx.cpu(), {"img": mx.nd.ones((4, 4, 3))})
+    ex.forward()
+    assert ex.outputs[0].shape == (3, 4, 4)
+    assert callable(mx.sym.op.softmax)
+    assert callable(mx.sym._internal._mul_scalar)
+    d = mx.sym.sparse.retain(mx.sym.Variable("a"), mx.sym.Variable("i")) \
+        if hasattr(mx.sym.sparse, "retain") else None
+    assert callable(mx.sym.sparse.dot)
+
+
+def test_misc_legacy_scheduler():
+    from mxnet_tpu.misc import FactorScheduler
+    sch = FactorScheduler(step=2, factor=0.1)
+    assert sch(0) == pytest.approx(0.01)
+    assert sch(4) == pytest.approx(0.01 * 0.01)
+    with pytest.raises(ValueError):
+        FactorScheduler(step=0)
+
+
+def test_torch_shim_fails_loudly():
+    from mxnet_tpu import torch as mxth
+    with pytest.raises(mx.base.MXNetError, match="TPU analog"):
+        mxth.zeros((2, 2))
