@@ -82,6 +82,11 @@ def test_dcgan_example():
     assert "dcgan OK" in r.stdout
 
 
+def test_vae_example():
+    r = _run("train_vae.py", ["--epochs", "3", "--num-samples", "128"])
+    assert "vae OK" in r.stdout
+
+
 def test_sparse_linear_classification_example():
     r = _run("sparse_linear_classification.py", ["--epochs", "5"])
     assert "sparse linear classification OK" in r.stdout
