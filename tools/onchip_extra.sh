@@ -34,6 +34,7 @@ phase() {  # phase <name> <timeout> <cmd...>
 phase int8 1800 python -u tools/microbench_int8.py --iters 50
 phase int8serve 1800 python -u tools/serve_int8_onchip.py --iters 30
 phase lstm 1800 python -u tools/bench_lstm.py --steps 30
+phase transformer 1800 python -u tools/bench_transformer.py --steps 20
 phase recordio 3600 env BENCH_RECORDIO=1 BENCH_K=30 python -u bench.py
 phase memdonation 1800 python -u tools/diagnose_step_hlo.py --on-chip
 
