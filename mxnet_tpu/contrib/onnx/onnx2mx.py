@@ -334,12 +334,38 @@ class _Importer:
         self.syms[node.output[0]] = y
 
     # NN layers
+    def _resolve_pads(self, a, kernel, op_name):
+        """ONNX pads/auto_pad -> symmetric per-axis pads. auto_pad=SAME
+        needs runtime spatial dims for stride>1 or even kernels, which a
+        shape-less import can't provide — those fail loudly instead of
+        silently zero-padding (the bug this replaces)."""
+        n = len(kernel)
+        auto = a.get("auto_pad", "NOTSET")
+        if auto in ("NOTSET", "", "VALID"):
+            pads = tuple(a.get("pads", (0,) * (2 * n))) \
+                if auto in ("NOTSET", "") else (0,) * (2 * n)
+            if pads[:n] != pads[n:]:
+                raise MXNetError("asymmetric %s pads unsupported"
+                                 % op_name)
+            return pads[:n]
+        if auto in ("SAME_UPPER", "SAME_LOWER"):
+            strides = tuple(a.get("strides", (1,) * n))
+            dilations = tuple(a.get("dilations", (1,) * n))
+            # effective (dilated) kernel decides SAME padding
+            eff = tuple(d * (k - 1) + 1 for k, d in zip(kernel, dilations))
+            if any(s != 1 for s in strides) or any(e % 2 == 0
+                                                   for e in eff):
+                raise MXNetError(
+                    "%s auto_pad=%s with stride>1 or even effective "
+                    "kernel needs runtime shapes; re-export with "
+                    "explicit pads" % (op_name, auto))
+            return tuple((e - 1) // 2 for e in eff)
+        raise MXNetError("%s auto_pad=%r unsupported" % (op_name, auto))
+
     def _cv_Conv(self, node, a):
         kernel = tuple(a.get("kernel_shape", ()))
-        pads = tuple(a.get("pads", (0,) * (2 * len(kernel))))
         n = len(kernel)
-        if pads[:n] != pads[n:]:
-            raise MXNetError("asymmetric Conv pads unsupported")
+        pads = self._resolve_pads(a, kernel, "Conv")
         w_name = node.input[1]
         if w_name not in self.params:
             raise MXNetError("Conv weight must be an initializer")
@@ -348,7 +374,7 @@ class _Importer:
             "kernel": kernel,
             "stride": tuple(a.get("strides", (1,) * n)),
             "dilate": tuple(a.get("dilations", (1,) * n)),
-            "pad": pads[:n],
+            "pad": pads,
             "num_filter": num_filter,
             "num_group": a.get("group", 1),
             "no_bias": len(node.input) < 3 or node.input[2] == "",
@@ -363,15 +389,14 @@ class _Importer:
 
     def _pool(self, node, a, pool_type):
         kernel = tuple(a.get("kernel_shape", ()))
-        n = len(kernel)
-        pads = tuple(a.get("pads", (0,) * (2 * n)))
-        if pads[:n] != pads[n:]:
-            raise MXNetError("asymmetric pool pads unsupported")
+        pads = self._resolve_pads(a, kernel, node.op_type)
         count_include_pad = a.get("count_include_pad", 0)
         self._simple(node, "Pooling", {
             "kernel": kernel, "pool_type": pool_type,
-            "stride": tuple(a.get("strides", (1,) * n)),
-            "pad": pads[:n],
+            "stride": tuple(a.get("strides", (1,) * len(kernel))),
+            "pad": pads,
+            # opset>=10 ceil_mode == the reference's "full" convention
+            "pooling_convention": "full" if a.get("ceil_mode") else "valid",
             "count_include_pad": bool(count_include_pad)}, n_in=1)
 
     def _cv_GlobalAveragePool(self, node, a):
@@ -613,6 +638,12 @@ class _Importer:
         kernel = tuple(a.get("kernel_shape", ()))
         n = len(kernel)
         out_shape = a.get("output_shape")
+        if a.get("auto_pad", "NOTSET") not in ("NOTSET", "") \
+                and out_shape is None:
+            # SAME/VALID deconvolution padding depends on runtime shapes
+            raise MXNetError(
+                "ConvTranspose auto_pad=%r unsupported; re-export with "
+                "explicit pads or output_shape" % a["auto_pad"])
         pads = tuple(a.get("pads", (0,) * (2 * n)))
         if pads[:n] != pads[n:] and out_shape is None:
             raise MXNetError("asymmetric ConvTranspose pads unsupported")

@@ -491,6 +491,44 @@ def _resnet_block_onnx():
     return nodes, inits
 
 
+def test_conv_auto_pad_same(tmp_path):
+    """auto_pad=SAME_UPPER (stride 1, odd kernel) pads to same-size
+    output instead of being silently ignored as zero padding."""
+    x = RNG.randn(1, 2, 6, 6).astype(np.float32)
+    w = (RNG.randn(3, 2, 3, 3) * 0.2).astype(np.float32)
+    nodes = [P.NodeProto(op_type="Conv", input=["x", "w"], output=["y0"],
+                         attribute=[_attr("kernel_shape", (3, 3)),
+                                    _attr("auto_pad", "SAME_UPPER")])]
+    (y,) = _import(nodes, {"x": x}, initializers=[_tensor("w", w)],
+                   tmp_path=tmp_path)
+    assert y.shape == (1, 3, 6, 6)
+
+
+def test_conv_auto_pad_same_with_stride_refuses(tmp_path):
+    x = RNG.randn(1, 2, 6, 6).astype(np.float32)
+    w = (RNG.randn(3, 2, 3, 3) * 0.2).astype(np.float32)
+    nodes = [P.NodeProto(op_type="Conv", input=["x", "w"], output=["y0"],
+                         attribute=[_attr("kernel_shape", (3, 3)),
+                                    _attr("strides", (2, 2)),
+                                    _attr("auto_pad", "SAME_UPPER")])]
+    with pytest.raises(Exception, match="auto_pad"):
+        _import(nodes, {"x": x}, initializers=[_tensor("w", w)],
+                tmp_path=tmp_path)
+
+
+def test_pool_ceil_mode(tmp_path):
+    """ceil_mode=1 maps to the reference 'full' pooling convention."""
+    x = RNG.randn(1, 1, 5, 5).astype(np.float32)
+    (y,) = _import([_node("MaxPool", ["x"], ["y0"], kernel_shape=(2, 2),
+                          strides=(2, 2), ceil_mode=1)],
+                   {"x": x}, tmp_path=tmp_path)
+    assert y.shape == (1, 1, 3, 3)   # ceil(5/2) = 3
+    (y,) = _import([_node("MaxPool", ["x"], ["y0"], kernel_shape=(2, 2),
+                          strides=(2, 2))],
+                   {"x": x}, tmp_path=tmp_path)
+    assert y.shape == (1, 1, 2, 2)   # floor
+
+
 def test_gather_negative_indices_wrap(tmp_path):
     x = RNG.randn(5, 4).astype(np.float32)
     idx = np.array([-1, 0], np.float32)  # ONNX: -1 == last element
