@@ -13,9 +13,10 @@ Deliberately NOT registered (documented refusals):
 * ``_CrossDeviceCopy`` — explicit D2D copy node; XLA/GSPMD moves data.
 * ``_sg_mkldnn_conv`` / ``_trt_op`` — backend-fused subgraph nodes of
   MKLDNN/TensorRT; the subgraph framework + AOT serving fill the role.
-* ``_cond``/``_while_loop``/``_foreach`` — subgraph-attribute control
-  flow nodes; the functional API (ndarray/contrib.py foreach/while_loop/
-  cond over lax) is the TPU-native form.
+* ``_cond``/``_while_loop``/``_foreach`` — not registry entries, but
+  fully supported: symbol/contrib.py builds them as per-instance
+  subgraph nodes (lax lowering, JSON serde with embedded subgraphs),
+  and ndarray/contrib.py provides the functional eager/hybrid forms.
 * ``IdentityAttachKLSparseReg`` — sparse-activation KL regularizer tied
   to the v0.x executor's aux-state update hooks; no modern consumer.
 """
