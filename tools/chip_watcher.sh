@@ -16,7 +16,9 @@ mkdir -p "$WATCH"
 PROBE_INTERVAL=${PROBE_INTERVAL:-600}
 
 probe() {
-  timeout 90 python -c "import jax; assert jax.devices()[0].platform=='tpu'" \
+  # match bench.py's probe: anything that is NOT cpu counts (the axon
+  # PJRT plugin may report its own platform name rather than 'tpu')
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform!='cpu'" \
     >/dev/null 2>&1
 }
 
