@@ -84,6 +84,13 @@ class KVStore:
             return _async._host_of(addr) if addr else None
 
         if _dist.rank() == 0:
+            # materialize the jax backend on the MAIN thread first: the
+            # server thread applies pushes through jax, and letting it
+            # trigger the (distributed, topology-exchanging) backend init
+            # races the other ranks' init ("global_topology already
+            # exists" gRPC failures)
+            import jax
+            jax.devices()
             # with a job secret the server binds the coordinator interface
             # (reachable by remote workers, frames authenticated); without
             # one it stays loopback-only — see async_server.py trust model

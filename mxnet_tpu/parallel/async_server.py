@@ -168,6 +168,9 @@ class Server:
                         except Exception as e:  # authenticated-but-bad
                             # frame (e.g. version skew): protocol error
                             # reply, not a handler traceback + disconnect
+                            if os.environ.get("MXNET_ASYNC_DEBUG"):
+                                import traceback
+                                traceback.print_exc()
                             reply_hdr, reply_blob = {
                                 "status": "err",
                                 "error": "%s: %s" % (type(e).__name__,

@@ -152,6 +152,23 @@ def test_send_command_refuses_without_server():
 
 
 @pytest.mark.parametrize("n", [2])
+def test_gluon_trainer_dist_async(n):
+    """Gluon Trainer end to end over the async server: optimizer runs
+    server-side (update_on_kvstore), every rank converges."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(n), sys.executable,
+         os.path.join(ROOT, "tests", "dist_gluon_async_worker.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+    for rank in range(n):
+        assert "rank %d/%d: gluon dist_async invariants OK" % (rank, n) \
+            in r.stdout, r.stdout[-4000:]
+
+
+@pytest.mark.parametrize("n", [2])
 def test_dist_async_multiprocess(n):
     """Full N-process dist_async: apply-on-push, no barrier, slow worker
     does not stall the fast one — observably different from dist_sync."""
