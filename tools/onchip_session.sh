@@ -28,6 +28,33 @@ if ! probe; then
   exit 2
 fi
 
+echo "[onchip] phase 0: 2-minute quick numbers (survives a tiny window)"
+timeout 240 python -u - <<'EOF' 2>&1 | tee "$LOG/quick.log" | grep -v -E "WARN|axon_"
+import time, json
+import numpy as np
+import jax, jax.numpy as jnp
+dev = jax.devices()[0]
+print("device:", dev.device_kind, flush=True)
+# one big bf16 matmul: MXU sanity + per-dispatch latency estimate
+a = jnp.asarray(np.random.rand(4096, 4096), jnp.bfloat16)
+f = jax.jit(lambda a: a @ a)
+out = f(a); jax.block_until_ready(out)
+t0 = time.perf_counter()
+for _ in range(20):
+    out = f(out)
+float(np.asarray(jax.device_get(out))[0, 0])  # forced fetch
+dt = (time.perf_counter() - t0) / 20
+tflops = 2 * 4096**3 / dt / 1e12
+t1 = time.perf_counter()
+for _ in range(10):
+    float(np.asarray(jax.device_get(f(a)))[0, 0])  # sync every step
+sync = (time.perf_counter() - t1) / 10
+print(json.dumps({"quick_matmul_tflops": round(tflops, 1),
+                  "pipelined_ms": round(dt * 1e3, 3),
+                  "sync_roundtrip_ms": round(sync * 1e3, 3),
+                  "device": dev.device_kind}), flush=True)
+EOF
+
 echo "[onchip] phase 1: conv microbench"
 timeout 1800 python -u tools/microbench_convs.py --iters 50 \
   2>&1 | tee "$LOG/microbench.log" | grep -v -E "WARN|axon_"
