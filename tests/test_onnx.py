@@ -286,6 +286,30 @@ def test_onnx_export_l2normalization_instance_mode_refuses(tmp_path):
                                 onnx_file_path=str(tmp_path / "bad.onnx"))
 
 
+def test_onnx_full_resnet18_roundtrip(tmp_path):
+    """Flagship interop: the zoo's symbolic ResNet-18 exports to ONNX and
+    reimports with byte-identical inference — the reference's model-zoo
+    export workflow end to end."""
+    from mxnet_tpu import models
+    sym = models.resnet_symbol(num_classes=10, num_layers=18)
+    rng = np.random.RandomState(0)
+    shapes, _, aux_shapes = sym.infer_shape(data=(2, 3, 32, 32))
+    args = {n: mx.nd.array(rng.uniform(-0.1, 0.1, s).astype("f4"))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    aux = {n: mx.nd.array(np.abs(rng.uniform(0.5, 1.0, s)).astype("f4"))
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    path = str(tmp_path / "resnet18.onnx")
+    onnx_mxnet.export_model(sym, {**args, **aux}, [(2, 3, 32, 32)],
+                            np.float32, path)
+    sym2, a2, x2 = onnx_mxnet.import_model(path)
+    data = rng.randn(2, 3, 32, 32).astype(np.float32)
+    y1 = _forward(sym, (args, aux), data,
+                  label_names=("softmax_label",))
+    y2 = _forward(sym2, (a2, x2), data, label_names=())
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-5)
+
+
 def test_import_model_for_training_keeps_bn_batch_stats(tmp_path):
     data = mx.sym.Variable("data")
     net = mx.sym.BatchNorm(mx.sym.FullyConnected(data, num_hidden=4,
