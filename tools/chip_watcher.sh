@@ -37,6 +37,10 @@ while true; do
       [ -x "$extra" ] && bash "$extra" "$WATCH" >> "$WATCH/extra.log" 2>&1
     done
     touch "$WATCH/SESSION_DONE"
+    # results must land INSIDE the repo: if the window opened after the
+    # builder session ended, the round driver commits the working tree —
+    # logs left in /tmp would be lost with the container
+    mkdir -p bench_logs && cp -r "$WATCH"/. bench_logs/ 2>/dev/null
     sleep 7200
   else
     echo "$(date -u +%FT%TZ) probe $n: tunnel down" >> "$WATCH/probes.log"
