@@ -21,7 +21,8 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
-__all__ = ["Flag", "flags", "register_flag", "describe", "override"]
+__all__ = ["Flag", "flags", "register_flag", "describe", "override",
+           "compute_dtype"]
 
 
 def _parse_bool(s: str) -> bool:
@@ -121,6 +122,44 @@ def override(**kwargs):
 
 
 # ---------------------------------------------------------------------------
+# Dtype policy
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "f16": "float16", "fp16": "float16", "half": "float16",
+    "float16": "float16",
+}
+_DTYPE_OFF = ("float32", "fp32", "f32", "off", "none", "no")
+
+
+def compute_dtype(default=None):
+    """Resolve the session dtype policy to a jax compute dtype or None.
+
+    ``default`` is what the calling path would use under the ``auto``
+    policy — e.g. the fused Module step passes ``jnp.bfloat16`` when the
+    optimizer requested ``multi_precision``, the Gluon CachedOp path
+    passes ``None`` (run in parameter dtype). An explicit policy
+    (``MXNET_COMPUTE_DTYPE=bfloat16`` / ``float16``) wins over the
+    default in every path; ``float32``/``off`` forcibly disables the
+    downcast. Returns a jnp dtype (cast f32 compute to it) or None (no
+    cast).
+    """
+    val = str(flags.compute_dtype).strip().lower()
+    if val in ("", "auto"):
+        return default
+    if val in _DTYPE_OFF:
+        return None
+    name = _DTYPE_ALIASES.get(val)
+    if name is None:
+        raise ValueError(
+            "MXNET_COMPUTE_DTYPE=%r not understood (expected auto, "
+            "bfloat16, float16, or float32/off)" % val)
+    import jax.numpy as jnp  # deferred: keep config importable without jax
+    return {"bfloat16": jnp.bfloat16, "float16": jnp.float16}[name]
+
+
+# ---------------------------------------------------------------------------
 # Core flags (reference env vars they correspond to are noted in the doc).
 # ---------------------------------------------------------------------------
 register_flag("enable_x64", "MXNET_ENABLE_X64", _parse_bool, False,
@@ -204,6 +243,19 @@ register_flag("trainer_fused_update", "MXNET_TRAINER_FUSED_UPDATE",
               "Gluon Trainer.step applies all parameter updates in one "
               "jitted program (one dispatch/step) instead of one eager op "
               "per parameter. Numerically identical to the eager path.")
+register_flag("compute_dtype", "MXNET_COMPUTE_DTYPE", str, "auto",
+              "Session-wide mixed-precision compute dtype policy, "
+              "consulted by the fused Module step, the Gluon "
+              "hybridize/CachedOp path, and the fused Trainer update. "
+              "'auto' (default): each path keeps its contextual default "
+              "(the fused Module step casts to bfloat16 when the "
+              "optimizer asked for multi_precision; Gluon blocks run in "
+              "the parameter dtype). 'bfloat16'/'float16' (aliases bf16/"
+              "fp16/f16/half): cast f32 activations and non-exempt f32 "
+              "params to that dtype inside jitted programs — master "
+              "weights, optimizer state, and normalization statistics "
+              "stay f32. 'float32'/'off'/'none': never downcast, even "
+              "where the contextual default would.")
 register_flag("test_device", "MXNET_TEST_DEVICE", str, "cpu",
               "Device type test_utils.default_context() returns (cpu|tpu) "
               "— the reference's env-switchable default_context (:53).")

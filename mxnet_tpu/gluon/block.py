@@ -569,9 +569,11 @@ class HybridBlock(Block):
         # recurrent cell stepped with a state list)
         leaves, treedef = jax.tree_util.tree_flatten(list(args))
         training = _autograd.is_training()
+        from .. import config as _config
         sig = (treedef,
                tuple((a.shape, str(a.dtype)) if isinstance(a, _nd.NDArray)
-                     else ("static", repr(a)) for a in leaves), training)
+                     else ("static", repr(a)) for a in leaves), training,
+               str(_config.compute_dtype(default=None)))
         runner = self._cached_graph.get(sig)
         if runner is None:
             runner = self._build_cache(treedef, leaves, training)
@@ -586,8 +588,31 @@ class HybridBlock(Block):
         param_names = [p.name for p in params]
         static_leaves = [None if isinstance(a, _nd.NDArray) else a
                          for a in ex_leaves]
+        # session dtype policy (config.compute_dtype): cast f32 params and
+        # inputs to the compute dtype INSIDE the traced program, so the
+        # hybridized path gets the same mixed-precision semantics as the
+        # fused Module step. Params flagged _keep_f32 (BN affine/stats) are
+        # exempt; the grouped downcast keeps the lowered program at one
+        # convert for all params instead of one per param.
+        from .. import config as _config
+        cdt = _config.compute_dtype(default=None)
+        keep_idx = frozenset(i for i, p in enumerate(params)
+                             if getattr(p, "_keep_f32", False))
 
         def traced(param_arrays, in_arrays, key):
+            if cdt is not None:
+                from ..module.fused import _downcast_group
+                cast_i = [i for i, a in enumerate(param_arrays)
+                          if a.dtype == jnp.float32 and i not in keep_idx
+                          and a.size > 0]
+                if cast_i:
+                    low = _downcast_group(
+                        [param_arrays[i] for i in cast_i], cdt)
+                    param_arrays = list(param_arrays)
+                    for i, v in zip(cast_i, low):
+                        param_arrays[i] = v
+                in_arrays = [a.astype(cdt) if a.dtype == jnp.float32 else a
+                             for a in in_arrays]
             tctx = _TraceCtx(dict(zip(param_names, param_arrays)), training)
             with _trace_scope(tctx):
                 with _random.trace_scope(key):

@@ -174,6 +174,20 @@ class BatchNorm(HybridBlock):
                 "running_var", grad_req="null", shape=(in_channels,),
                 init=running_variance_initializer, allow_deferred_init=True,
                 differentiable=False)
+        # mixed-precision contract (reference cuDNN BN): affine params and
+        # moving stats stay f32 whatever the activation dtype; the
+        # bf16-native kernel widens inside its reductions and consumes f32
+        # gamma/beta directly, so the dtype policy must not downcast them
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            p._keep_f32 = True
+
+    def cast(self, dtype):
+        name = dtype if isinstance(dtype, str) else _np.dtype(dtype).name
+        if name in ("float16", "bfloat16"):
+            self._cached_graph = {}
+            return  # params/stats stay f32; the op runs bf16 natively
+        super().cast(dtype)
 
     def _layer_infer_shape(self, x_shape, *rest):
         c = int(x_shape[self._axis])

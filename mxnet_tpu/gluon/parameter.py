@@ -54,6 +54,11 @@ class Parameter:
     the first forward (reference parameter.py `_finish_deferred_init`).
     """
 
+    # exempt from the session compute-dtype policy's f32 downcast (set by
+    # layers whose kernels consume f32 natively, e.g. BatchNorm affine
+    # params and moving stats; see config.compute_dtype)
+    _keep_f32 = False
+
     def __init__(self, name, grad_req="write", shape=None, dtype="float32",
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
                  differentiable=True, stype="default", grad_stype="default"):

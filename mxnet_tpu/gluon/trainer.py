@@ -210,19 +210,32 @@ class Trainer:
         import jax
         import jax.numpy as jnp
         from ..module.fused import _flatten_state
+        from ..optimizer.optimizer import _is_lowp_float
         upd0 = self._updaters[0]
         opt = self._optimizer
-        ws, gs, states = [], [], []
+        # Low-precision weights ride the same fused program when the
+        # optimizer keeps f32 masters (multi_precision, or the session
+        # dtype policy implies it): the master is the update target, the
+        # grad upcasts and the weight downcast happen inside the jit.
+        ws, gs, states, low = [], [], [], []
         for i, param in work:
             w = param.data()
-            if w.dtype != _np.float32:
-                return False  # fp16/bf16 weights: eager multi-precision path
+            mp = w.dtype != _np.float32
+            if mp and not (opt.multi_precision and _is_lowp_float(w.dtype)):
+                return False  # no master copy: eager path handles it
             if i not in upd0.states:
                 upd0.states[i] = opt.create_state_multi_precision(i, w)
-            ws.append(w._data)
+            if mp:
+                inner, w32 = upd0.states[i]
+                ws.append(w32._data)
+                low.append(_np.dtype(w.dtype))
+                states.append(tuple(s._data for s in _flatten_state(inner)))
+            else:
+                ws.append(w._data)
+                low.append(None)
+                states.append(tuple(s._data
+                                    for s in _flatten_state(upd0.states[i])))
             gs.append(param.grad()._data)
-            states.append(tuple(s._data
-                                for s in _flatten_state(upd0.states[i])))
         # eager-identical bookkeeping: bump counts, then read lr/wd; t is
         # PER PARAM (ignore_stale_grad can make counts diverge, and eager
         # Adam/FTML bias-correct with the per-index count)
@@ -234,25 +247,46 @@ class Trainer:
                             jnp.int32)
         rescale = _np.float32(opt.rescale_grad)
 
-        jitted = getattr(self, "_fused_jit", None)
+        # the master-weight layout is static per program: key the jit cache
+        # by which slots are low-precision (and their dtypes)
+        cache = getattr(self, "_fused_jit_cache", None)
+        if cache is None:
+            cache = self._fused_jit_cache = {}
+        jitted = cache.get(tuple(low))
         if jitted is None:
             update = fused[1]
+            low_key = tuple(low)
 
             def f(ws, gs, states, lr_vec, wd_vec, rescale, t_vec):
-                out_w, out_s = [], []
+                out_w, out_low, out_s = [], [], []
                 for j in range(len(ws)):
-                    nw, ns = update(ws[j], gs[j], states[j],
+                    g = gs[j]
+                    if low_key[j] is not None \
+                            and g.dtype != jnp.float32:
+                        g = g.astype(jnp.float32)
+                    nw, ns = update(ws[j], g, states[j],
                                     lr_vec[j], wd_vec[j], rescale, t_vec[j])
-                    out_w.append(nw.astype(ws[j].dtype))
+                    nw = nw.astype(ws[j].dtype)
+                    out_w.append(nw)
+                    out_low.append(nw.astype(low_key[j])
+                                   if low_key[j] is not None else None)
                     out_s.append(ns)
-                return out_w, out_s
-            jitted = self._fused_jit = jax.jit(f)
-        new_ws, new_states = jitted(ws, gs, states, lr_vec, wd_vec,
-                                    rescale, t_vec)
-        for (i, param), nw, ns in zip(work, new_ws, new_states):
-            param.data()._rebind(nw)
-            for old, new in zip(_flatten_state(upd0.states[i]), ns):
-                old._rebind(new)
+                return out_w, out_low, out_s
+            jitted = cache[tuple(low)] = jax.jit(f)
+        self._fused_jit = jitted  # most-recent program (introspection)
+        new_ws, new_low, new_states = jitted(ws, gs, states, lr_vec, wd_vec,
+                                             rescale, t_vec)
+        for (i, param), nw, nl, ns in zip(work, new_ws, new_low, new_states):
+            if nl is not None:
+                param.data()._rebind(nl)
+                inner, w32 = upd0.states[i]
+                w32._rebind(nw)
+                for old, new in zip(_flatten_state(inner), ns):
+                    old._rebind(new)
+            else:
+                param.data()._rebind(nw)
+                for old, new in zip(_flatten_state(upd0.states[i]), ns):
+                    old._rebind(new)
         return True
 
     def save_states(self, fname):
@@ -280,3 +314,4 @@ class Trainer:
         # optimizer's hyperparameters
         self._fused_ops_cache = False
         self._fused_jit = None
+        self._fused_jit_cache = {}

@@ -1,0 +1,104 @@
+"""Static analysis of lowered StableHLO text: layout/precision op counts.
+
+Extracted from tools/diagnose_step_hlo.py so the same counters serve both
+the diagnosis CLI and chip-free regression tests: the pre-optimization
+StableHLO of a jitted program is a deterministic function of the traced
+graph, so counting `convert` / `transpose` / `convolution` / `dot_general`
+ops (and the nominal element traffic through them) on CPU bounds what the
+TPU backend will see — a perf guardrail that needs no chip.
+
+    import jax, mxnet_tpu.hlo_stats as hs
+    stats = hs.analyze_stablehlo(jax.jit(f).lower(*args).as_text())
+    assert hs.convert_count_between(stats, "f32", "bf16") <= BUDGET
+"""
+from __future__ import annotations
+
+import collections
+import re
+
+_SHAPE_RE = re.compile(r"tensor<([0-9x]*)x?([a-z0-9]+)>")
+_OP_RE = re.compile(r"stablehlo\.(\w+)")
+
+
+def _elems(shape_str):
+    """Element count of a StableHLO shape prefix like '128x3x224x224'."""
+    n = 1
+    for d in shape_str.split("x"):
+        if d.isdigit():
+            n *= int(d)
+    return n
+
+
+def analyze_stablehlo(text):
+    """Count the layout/precision ops in StableHLO text.
+
+    Returns an OrderedDict of human-readable counters:
+
+    * ``transpose_count`` / ``transpose_gelems`` — layout shuffles and the
+      billions of elements they move;
+    * ``convert_count`` / ``convert_pairs`` / ``convert_gelems`` — dtype
+      converts broken down by ``src->dst`` pair with nominal element
+      traffic per pair;
+    * ``convolution`` / ``dot_general`` — MXU-op counts keyed by result
+      element type;
+    * ``total_ops`` / ``top_ops`` — overall op census.
+    """
+    out = collections.OrderedDict()
+    op_counts = collections.Counter()
+    transpose_elems = 0
+    convert_pairs = collections.Counter()
+    convert_elems = collections.Counter()
+    conv_types = collections.Counter()
+    dot_types = collections.Counter()
+
+    for line in text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        op_counts[op] += 1
+        if op == "transpose":
+            shapes = _SHAPE_RE.findall(line)
+            if shapes:
+                transpose_elems += _elems(shapes[0][0])
+        elif op == "convert":
+            shapes = _SHAPE_RE.findall(line)
+            if len(shapes) >= 2:
+                pair = "%s->%s" % (shapes[0][1], shapes[-1][1])
+                convert_pairs[pair] += 1
+                convert_elems[pair] += _elems(shapes[0][0])
+        elif op == "convolution":
+            shapes = _SHAPE_RE.findall(line)
+            if shapes:
+                conv_types[shapes[-1][1]] += 1
+        elif op == "dot_general":
+            shapes = _SHAPE_RE.findall(line)
+            if shapes:
+                dot_types[shapes[-1][1]] += 1
+
+    out["transpose_count"] = op_counts["transpose"]
+    out["transpose_gelems"] = transpose_elems / 1e9
+    out["convert_count"] = op_counts["convert"]
+    out["convert_pairs"] = dict(convert_pairs.most_common())
+    out["convert_gelems"] = {k: v / 1e9
+                             for k, v in convert_elems.most_common()}
+    out["convolution"] = dict(conv_types)
+    out["dot_general"] = dict(dot_types)
+    out["total_ops"] = sum(op_counts.values())
+    out["top_ops"] = dict(op_counts.most_common(12))
+    return out
+
+
+def convert_count_between(stats, a, b):
+    """Total converts in either direction between element types ``a`` and
+    ``b`` (e.g. ``("f32", "bf16")``) from an :func:`analyze_stablehlo`
+    result."""
+    pairs = stats.get("convert_pairs", {})
+    return pairs.get("%s->%s" % (a, b), 0) + pairs.get("%s->%s" % (b, a), 0)
+
+
+def convert_gelems_between(stats, a, b):
+    """Nominal element traffic (Gelem) through converts between ``a`` and
+    ``b`` in either direction."""
+    g = stats.get("convert_gelems", {})
+    return g.get("%s->%s" % (a, b), 0.0) + g.get("%s->%s" % (b, a), 0.0)

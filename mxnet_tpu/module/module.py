@@ -280,16 +280,39 @@ class Module(BaseModule):
         from .fused import FusedStep
         # multi_precision on a TPU module = bf16 compute over f32 master
         # weights (the reference's fp16 multi-precision SGD, optimizer.py
-        # :452, mapped to the MXU's native dtype)
-        compute_dtype = None
+        # :452, mapped to the MXU's native dtype); the session dtype policy
+        # (MXNET_COMPUTE_DTYPE, config.compute_dtype) can force or veto it
+        default_cdt = None
         if getattr(self._optimizer, "multi_precision", False):
             import jax.numpy as _jnp
-            compute_dtype = _jnp.bfloat16
+            default_cdt = _jnp.bfloat16
+        from .. import config as _config
+        compute_dtype = _config.compute_dtype(default=default_cdt)
         self._fused = FusedStep(self._exec, self._optimizer,
                                 self._param_names,
                                 compute_dtype=compute_dtype,
-                                data_names=self._data_names)
+                                data_names=self._data_names,
+                                keep_f32=self._norm_stat_params())
         self._fused_opt_state = self._fused.init_state()
+
+    def _norm_stat_params(self):
+        """Names of params that must stay f32 under a low-precision compute
+        policy: BatchNorm gamma/beta. The bf16-native BN kernel keeps its
+        statistics/scale math in f32 and consumes f32 affine params
+        directly (ops/nn.py), so downcasting them would only add converts
+        back at every BN boundary."""
+        keep = set()
+        try:
+            for node in self._symbol._topo():
+                if node.op is not None and node.op.name == "BatchNorm":
+                    for slot in (1, 2):  # gamma, beta inputs
+                        if slot < len(node.inputs):
+                            src = node.inputs[slot][0]
+                            if src.is_variable:
+                                keep.add(src.name)
+        except Exception:
+            pass
+        return frozenset(keep)
 
     # --------------------------------------------------------------- running
     def _feed(self, data_batch):

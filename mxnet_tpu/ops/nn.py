@@ -18,6 +18,8 @@ design notes:
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -230,6 +232,90 @@ alias("Pooling", "pooling")
 # Normalization
 # ---------------------------------------------------------------------------
 
+def _bn_widened_sums(x, red):
+    """Per-channel sum and sum-of-squares of a low-precision tensor,
+    accumulated in f32 *inside* the reduction via dot_general's
+    preferred_element_type — no convert of the activation tensor.
+
+    bf16·bf16 products are exact in f32 (8-bit mantissas), so the results
+    equal an f32 upcast-then-reduce bit-for-bit up to summation order.
+    """
+    axis = [i for i in range(x.ndim) if i not in red][0]
+    ones = jnp.ones(tuple(x.shape[i] for i in red), x.dtype)
+    s1 = lax.dot_general(x, ones,
+                         ((red, tuple(range(len(red)))), ((), ())),
+                         preferred_element_type=jnp.float32)
+    s2 = lax.dot_general(x, x, ((red, red), ((axis,), (axis,))),
+                         preferred_element_type=jnp.float32)
+    n = 1
+    for i in red:
+        n *= x.shape[i]
+    return s1, s2, n
+
+
+def _bn_coef_apply(x, axis, *cols32):
+    """Concatenate per-channel f32 coefficient vectors, downcast with a
+    single convert, and return them reshaped for broadcasting against x.
+    One convert per BN per pass instead of one per full activation
+    tensor."""
+    C = x.shape[axis]
+    coef = jnp.concatenate(cols32).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = C
+    return [jnp.reshape(coef[i * C:(i + 1) * C], shape)
+            for i in range(len(cols32))]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_lowp_train(x, g32, b32, eps, axis):
+    out, mean, var, _ = _bn_lowp_fwd_impl(x, g32, b32, eps, axis)
+    return out, mean, var
+
+
+def _bn_lowp_fwd_impl(x, g32, b32, eps, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    s1, s2, n = _bn_widened_sums(x, red)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    scale = inv * g32
+    shift = b32 - mean * scale
+    sc, sh = _bn_coef_apply(x, axis, scale, shift)
+    return x * sc + sh, mean, var, inv
+
+
+def _bn_lowp_train_fwd(x, g32, b32, eps, axis):
+    out, mean, var, inv = _bn_lowp_fwd_impl(x, g32, b32, eps, axis)
+    return (out, mean, var), (x, g32, mean, inv)
+
+
+def _bn_lowp_train_bwd(eps, axis, res, cots):
+    dy, _dmean, _dvar = cots  # stat outputs carry no gradient
+    x, g32, mean, inv = res
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    ones = jnp.ones(tuple(x.shape[i] for i in red), x.dtype)
+    s_dy = lax.dot_general(dy, ones,
+                           ((red, tuple(range(len(red)))), ((), ())),
+                           preferred_element_type=jnp.float32)
+    s_dyx = lax.dot_general(dy, x, ((red, red), ((axis,), (axis,))),
+                            preferred_element_type=jnp.float32)
+    n = 1
+    for i in red:
+        n *= x.shape[i]
+    dgamma = inv * (s_dyx - mean * s_dy)
+    dbeta = s_dy
+    # dx = A*dy + B*x + C with per-channel f32 coefficients, applied bf16
+    A = g32 * inv
+    B = -A * inv * dgamma / n
+    Cc = -A * s_dy / n - B * mean
+    a, b, c = _bn_coef_apply(x, axis, A, B, Cc)
+    dx = dy * a + x * b + c
+    return dx, dgamma, dbeta
+
+
+_bn_lowp_train.defvjp(_bn_lowp_train_fwd, _bn_lowp_train_bwd)
+
+
 @register("BatchNorm", num_outputs=5)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
@@ -242,26 +328,42 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     the reference mutates moving stats in place (src/operator/nn/batch_norm.cc),
     our pure-functional form returns them and the invoke layer/executor
     commits them. Same observable semantics, XLA-friendly.
+
+    Mixed precision: stats/scale math stays f32 regardless of data dtype
+    (reference cuDNN BN semantics), but for bf16/f16 activations the f32
+    widening happens *inside* the reductions (dot_general with
+    preferred_element_type=f32) and the normalize/scale/shift runs in the
+    data dtype off a single per-channel downcast — the activation tensor
+    is never round-tripped through f32 in fwd or bwd.
     """
-    red_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    axis = axis % data.ndim
+    red_axes = tuple(i for i in range(data.ndim) if i != axis)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    # mixed precision: stats/scale math in f32 regardless of data dtype
-    # (reference cuDNN BN computes fp16 inputs with f32 stats and f32
-    # gamma/beta/aux); output returns in the data dtype
-    x32 = data.astype(jnp.float32) if data.dtype != jnp.float32 else data
+    g32 = g.astype(jnp.float32) if g.dtype != jnp.float32 else g
+    b32 = beta.astype(jnp.float32) if beta.dtype != jnp.float32 else beta
+    lowp = data.dtype in (jnp.bfloat16, jnp.float16)
     if _training and not use_global_stats:
-        mean = jnp.mean(x32, axis=red_axes)
-        var = jnp.var(x32, axis=red_axes)
+        if lowp:
+            out, mean, var = _bn_lowp_train(data, g32, b32, float(eps), axis)
+        else:
+            mean = jnp.mean(data, axis=red_axes)
+            var = jnp.var(data, axis=red_axes)
         new_mean = moving_mean * momentum + mean * (1.0 - momentum)
         new_var = moving_var * momentum + var * (1.0 - momentum)
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
-    shape = [1] * data.ndim
-    shape[axis % data.ndim] = data.shape[axis % data.ndim]
-    inv = lax.rsqrt(var + eps)
-    out = (x32 - jnp.reshape(mean, shape)) * jnp.reshape(inv * g, shape) \
-        + jnp.reshape(beta, shape)
+    if not (_training and not use_global_stats and lowp):
+        inv = lax.rsqrt(var + eps)
+        if lowp:
+            sc, sh = _bn_coef_apply(data, axis, inv * g32,
+                                    b32 - mean * (inv * g32))
+            out = data * sc + sh
+        else:
+            shape = [1] * data.ndim
+            shape[axis] = data.shape[axis]
+            out = (data - jnp.reshape(mean, shape)) \
+                * jnp.reshape(inv * g32, shape) + jnp.reshape(b32, shape)
     return (out.astype(data.dtype), lax.stop_gradient(mean),
             lax.stop_gradient(var),
             lax.stop_gradient(new_mean), lax.stop_gradient(new_var))

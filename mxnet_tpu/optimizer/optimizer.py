@@ -25,6 +25,17 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
 _OPT_REGISTRY = {}
 
 
+def _is_lowp_float(dtype):
+    """True for the low-precision float dtypes that take an f32 master
+    copy under multi_precision (reference handled float16 only; bfloat16
+    is the TPU-native equivalent)."""
+    try:
+        name = _np.dtype(dtype).name
+    except TypeError:
+        name = str(dtype)
+    return name in ("float16", "bfloat16")
+
+
 def _sparse_grad_rows(opt, grad):
     """(rows, prepped_values) for a row-sparse gradient: rescale + clip on
     the stored values only. Lazy-update semantics (reference
@@ -105,7 +116,7 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and _is_lowp_float(weight.dtype):
             w32 = weight.astype("float32")
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)
@@ -179,7 +190,7 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and _is_lowp_float(weight.dtype):
             from ..ndarray import sparse as _sp
             inner, w32 = state
             if isinstance(grad, _sp.RowSparseNDArray):

@@ -59,8 +59,10 @@ _TAG_LEN = 32
 
 # refuse absurd frame-length claims BEFORE buffering the payload — an
 # unauthenticated peer controls the length field (tag checks come after
-# the read). Tunable for jobs shipping truly huge single tensors.
-_MAX_FRAME = int(os.environ.get("MXNET_KVSTORE_MAX_FRAME", str(1 << 32)))
+# the read), so the default bounds what such a peer can make us buffer to
+# 256 MiB per connection. Tunable for jobs shipping truly huge single
+# tensors (a 4 GiB-era default let one pre-auth connection pin ~4 GiB).
+_MAX_FRAME = int(os.environ.get("MXNET_KVSTORE_MAX_FRAME", str(256 << 20)))
 
 # process-local default secret: single-process topologies (server thread +
 # in-process clients) share it implicitly; separate processes must export
@@ -81,10 +83,34 @@ def _is_loopback(bind):
     return bind in ("127.0.0.1", "localhost", "::1")
 
 
-def _send_frame(sock, header, blob=b"", key=None):
+class _Channel:
+    """Per-connection anti-replay state: a server-issued random challenge
+    plus a monotonic frame counter, both mixed into every frame's HMAC
+    input (frame #n MACs ``challenge || n || payload``). The request/reply
+    protocol is lock-step, so both ends advance the same counter sequence;
+    a frame captured earlier (same connection or any previous one) MACs
+    over the wrong (challenge, counter) pair and is rejected exactly like
+    a forgery — replays and reordering are dropped, not applied."""
+
+    __slots__ = ("challenge", "n")
+
+    def __init__(self, challenge):
+        self.challenge = challenge
+        self.n = 0
+
+    def _mac_prefix(self):
+        # consumed exactly once per frame, in wire order
+        prefix = self.challenge + struct.pack("<Q", self.n)
+        self.n += 1
+        return prefix
+
+
+def _send_frame(sock, header, blob=b"", key=None, chan=None):
     hdr = json.dumps(header).encode()
     payload = struct.pack("<I", len(hdr)) + hdr + blob
-    tag = hmac.new(key or _secret(), payload, hashlib.sha256).digest()
+    prefix = chan._mac_prefix() if chan is not None else b""
+    tag = hmac.new(key or _secret(), prefix + payload,
+                   hashlib.sha256).digest()
     sock.sendall(struct.pack("<Q", _TAG_LEN + len(payload)) + tag + payload)
 
 
@@ -107,16 +133,21 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_frame(sock, key=None):
+def _recv_frame(sock, key=None, chan=None):
     (total,) = struct.unpack("<Q", _recv_exact(sock, 8))
     if total < _TAG_LEN + 4 or total > _MAX_FRAME:
         raise ConnectionError("malformed frame (claimed %d bytes)" % total)
     tag = _recv_exact(sock, _TAG_LEN)
     payload = _recv_exact(sock, total - _TAG_LEN)
-    # authenticate BEFORE parsing anything
-    want = hmac.new(key or _secret(), payload, hashlib.sha256).digest()
+    # authenticate BEFORE parsing anything; the channel prefix makes a
+    # replayed/reordered frame fail exactly like a forgery
+    prefix = chan._mac_prefix() if chan is not None else b""
+    want = hmac.new(key or _secret(), prefix + payload,
+                    hashlib.sha256).digest()
     if not hmac.compare_digest(tag, want):
-        raise ConnectionError("frame failed authentication")
+        raise ConnectionError("frame failed authentication"
+                              + (" (stale counter/replay?)"
+                                 if chan is not None else ""))
     (hlen,) = struct.unpack("<I", payload[:4])
     header = json.loads(payload[4:4 + hlen].decode())
     return header, payload[4 + hlen:]
@@ -159,9 +190,21 @@ class Server:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
+                    # per-connection anti-replay channel: issue a fresh
+                    # random challenge in a hello frame (MAC'd with the
+                    # shared key alone — the peer can't know the challenge
+                    # yet), then every subsequent frame in either direction
+                    # MACs over challenge || counter || payload
+                    challenge = _secrets.token_bytes(16)
+                    _send_frame(self.request,
+                                {"op": "hello",
+                                 "challenge": challenge.hex()},
+                                key=outer._hmac_key)
+                    chan = _Channel(challenge)
                     while True:
                         header, blob = _recv_frame(self.request,
-                                                   key=outer._hmac_key)
+                                                   key=outer._hmac_key,
+                                                   chan=chan)
                         try:
                             reply_hdr, reply_blob = outer._dispatch(header,
                                                                     blob)
@@ -176,7 +219,7 @@ class Server:
                                 "error": "%s: %s" % (type(e).__name__,
                                                      e)}, b""
                         _send_frame(self.request, reply_hdr, reply_blob,
-                                    key=outer._hmac_key)
+                                    key=outer._hmac_key, chan=chan)
                         if header.get("op") == "shutdown":
                             return
                 except (ConnectionError, OSError, ValueError):
@@ -300,6 +343,16 @@ class Client:
         if sock is None:
             sock = socket.create_connection(self._addr,
                                             timeout=self._timeout)
+            # the server opens every connection with a hello frame carrying
+            # the anti-replay challenge; all later frames MAC over it plus
+            # the lock-step frame counter
+            hello, _ = _recv_frame(sock, key=self._hmac_key)
+            if hello.get("op") != "hello" or "challenge" not in hello:
+                _close_quietly(sock)
+                raise ConnectionError(
+                    "async server handshake: expected hello frame, got %r"
+                    % (hello.get("op"),))
+            self._tls.chan = _Channel(bytes.fromhex(hello["challenge"]))
             self._tls.sock = sock
             with self._conns_lock:
                 self._conns = [r for r in self._conns if r() is not None]
@@ -310,7 +363,7 @@ class Client:
             # server handler threads
             weakref.finalize(threading.current_thread(), _close_quietly,
                              sock)
-        return sock
+        return sock, self._tls.chan
 
     def call(self, op, *args):
         header = {"op": op}
@@ -334,9 +387,18 @@ class Client:
         else:
             raise ValueError("unknown kvstore op %r" % op)
 
-        sock = self._connect()
-        _send_frame(sock, header, blob, key=self._hmac_key)
-        reply, rblob = _recv_frame(sock, key=self._hmac_key)
+        sock, chan = self._connect()
+        try:
+            _send_frame(sock, header, blob, key=self._hmac_key, chan=chan)
+            reply, rblob = _recv_frame(sock, key=self._hmac_key, chan=chan)
+        except OSError:
+            # timeout / ConnectionError: the request-reply stream (and the
+            # channel counter) is desynced — drop the thread-local socket
+            # so the NEXT call reconnects cleanly instead of reusing it
+            self._tls.sock = None
+            self._tls.chan = None
+            _close_quietly(sock)
+            raise
         if reply.get("status") != "ok":
             from ..base import MXNetError
             raise MXNetError("async server: %s" % reply.get("error"))

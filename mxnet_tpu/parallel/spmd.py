@@ -107,8 +107,11 @@ class SPMDTrainStep:
         # Mixed precision (reference: multi-precision SGD,
         # python/mxnet/optimizer/optimizer.py:452): master weights stay
         # float32; compute runs in `dtype` (bf16 on the MXU). The cast sits
-        # inside the differentiated function so grads come back f32.
-        compute_dtype = dtype
+        # inside the differentiated function so grads come back f32. The
+        # session dtype policy (config.compute_dtype) supplies/overrides
+        # the default, same as the fused Module and Gluon paths.
+        from .. import config as _config
+        compute_dtype = _config.compute_dtype(default=dtype)
 
         def step(params, aux, opt_state, data, label, key):
             n_batch = data[dn[0]].shape[0]

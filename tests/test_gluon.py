@@ -484,3 +484,38 @@ def test_hybrid_forward_contrib_namespace():
     net.hybridize()
     hybrid = net(x).asnumpy()
     np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_compute_dtype_policy_bf16():
+    """Session dtype policy (MXNET_COMPUTE_DTYPE=bfloat16) on the CachedOp
+    path: compute runs bf16 off a single grouped downcast, BatchNorm
+    params/stats are excluded (stay f32), and outputs track the f32 run."""
+    from mxnet_tpu import config
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.BatchNorm(), nn.Activation("relu"),
+                nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 5).astype("f4"))
+    y32 = net(x).asnumpy()
+    with config.override(compute_dtype="bfloat16"):
+        ybf = net(x)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+    assert ybf.dtype == np.dtype("bfloat16").type or \
+        str(ybf.asnumpy().dtype) == "bfloat16"
+    assert_almost_equal(ybf.asnumpy().astype("f4"), y32, rtol=0.05,
+                        atol=0.05)
+    for name, p in net.collect_params().items():
+        assert p.data().dtype == np.float32, name  # masters untouched
+        if p.grad_req != "null":
+            assert np.isfinite(p.grad().asnumpy().astype("f4")).all(), name
+    # BatchNorm keeps f32 params/stats even under an explicit low-p cast
+    bn = [b for b in net._children.values()
+          if isinstance(b, nn.BatchNorm)][0]
+    bn.cast("bfloat16")
+    assert bn.gamma.dtype == np.float32
+    assert bn.running_mean.dtype == np.float32

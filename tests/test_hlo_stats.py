@@ -1,0 +1,71 @@
+"""Unit tests for mxnet_tpu.hlo_stats (the chip-free HLO counters shared by
+tools/diagnose_step_hlo.py and the convert-budget regression test)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu import hlo_stats as hs
+
+_SYNTHETIC = """
+module @jit_f {
+  func.func public @main(%arg0: tensor<4x8xf32>) -> tensor<4x8xf32> {
+    %0 = stablehlo.convert %arg0 : (tensor<4x8xf32>) -> tensor<4x8xbf16>
+    %1 = stablehlo.transpose %0, dims = [1, 0] : (tensor<4x8xbf16>) -> tensor<8x4xbf16>
+    %2 = stablehlo.dot_general %0, %1, contracting_dims = [1] x [0] : (tensor<4x8xbf16>, tensor<8x4xbf16>) -> tensor<4x4xbf16>
+    %3 = stablehlo.convert %2 : (tensor<4x4xbf16>) -> tensor<4x4xf32>
+    %4 = stablehlo.convert %arg0 : (tensor<4x8xf32>) -> tensor<4x8xbf16>
+    %5 = stablehlo.add %3, %3 : tensor<4x4xf32>
+    return %5 : tensor<4x4xf32>
+  }
+}
+"""
+
+
+def test_analyze_synthetic_counts():
+    st = hs.analyze_stablehlo(_SYNTHETIC)
+    assert st["convert_count"] == 3
+    assert st["convert_pairs"] == {"f32->bf16": 2, "bf16->f32": 1}
+    assert st["transpose_count"] == 1
+    assert st["dot_general"] == {"bf16": 1}
+    assert st["top_ops"]["add"] == 1
+    # element traffic: 2 * 32 f32->bf16, 16 bf16->f32 (in Gelem)
+    assert abs(st["convert_gelems"]["f32->bf16"] - 64 / 1e9) < 1e-12
+
+
+def test_convert_between_helpers():
+    st = hs.analyze_stablehlo(_SYNTHETIC)
+    assert hs.convert_count_between(st, "f32", "bf16") == 3
+    assert hs.convert_count_between(st, "bf16", "f32") == 3  # symmetric
+    assert hs.convert_count_between(st, "f32", "f16") == 0
+    assert hs.convert_gelems_between(st, "f32", "bf16") > 0
+
+
+def test_analyze_real_lowering():
+    """The counters agree with an actual jax lowering, not just the
+    synthetic grammar."""
+
+    def f(x, w):
+        return jnp.dot(x.astype(jnp.bfloat16),
+                       w.astype(jnp.bfloat16)).astype(jnp.float32)
+
+    text = jax.jit(f).lower(jnp.zeros((4, 8), jnp.float32),
+                            jnp.zeros((8, 2), jnp.float32)).as_text()
+    st = hs.analyze_stablehlo(text)
+    assert hs.convert_count_between(st, "f32", "bf16") == 3
+    assert st["dot_general"] == {"bf16": 1}
+    assert st["total_ops"] >= 4
+
+
+def test_tool_reexports_shared_impl():
+    """tools/diagnose_step_hlo.py must consume the same counters the
+    regression test does."""
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        tool = importlib.import_module("diagnose_step_hlo")
+    finally:
+        sys.path.pop(0)
+    assert tool.analyze_stablehlo is hs.analyze_stablehlo

@@ -94,9 +94,14 @@ def test_async_server_rejects_unauthenticated_frames():
     frames are HMAC-verified before any deserialization."""
     import socket
     import struct
+    from mxnet_tpu.parallel.async_server import _recv_frame
     srv = Server()
     try:
         sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        # the server greets every connection with a hello frame carrying
+        # the anti-replay challenge; drain it first
+        hello, _ = _recv_frame(sock)
+        assert hello["op"] == "hello"
         # well-formed frame, wrong tag: header {"op": "stats"}
         payload = struct.pack("<I", 15) + b'{"op": "stats"}'
         sock.sendall(struct.pack("<Q", 32 + len(payload)) + b"\x00" * 32
@@ -109,6 +114,38 @@ def test_async_server_rejects_unauthenticated_frames():
         cli = Client("127.0.0.1", srv.port)
         cli.call("init", "k", np.ones((2,), "f4"))
         np.testing.assert_array_equal(cli.call("pull", "k"), [1, 1])
+    finally:
+        Client("127.0.0.1", srv.port).call("shutdown")
+
+
+def test_async_server_rejects_replayed_frames():
+    """A frame captured off the wire fails authentication when resent:
+    every frame MACs over the per-connection challenge plus its position
+    in the lock-step stream, so replays land on a stale counter."""
+    import hashlib
+    import hmac
+    import json
+    import socket
+    import struct
+    from mxnet_tpu.parallel.async_server import (_Channel, _recv_frame,
+                                                 _secret)
+    srv = Server()
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        hello, _ = _recv_frame(sock)
+        chan = _Channel(bytes.fromhex(hello["challenge"]))
+        hdr = json.dumps({"op": "stats"}).encode()
+        payload = struct.pack("<I", len(hdr)) + hdr
+        tag = hmac.new(_secret(), chan._mac_prefix() + payload,
+                       hashlib.sha256).digest()
+        frame = struct.pack("<Q", 32 + len(payload)) + tag + payload
+        sock.sendall(frame)
+        reply, _ = _recv_frame(sock, chan=chan)
+        assert reply["status"] == "ok"  # the frame was valid the 1st time
+        sock.sendall(frame)  # verbatim replay: counter is now stale
+        sock.settimeout(5)
+        assert sock.recv(1) == b""  # EOF — dropped like a forgery
+        sock.close()
     finally:
         Client("127.0.0.1", srv.port).call("shutdown")
 

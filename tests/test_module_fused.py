@@ -590,3 +590,134 @@ def test_bucketing_checkpoint_saves_active_bucket_momentum(tmp_path):
             for s in eager._updater.states.values() if s is not None]
     assert any(np.abs(m).max() > 0 for m in moms), \
         "saved momentum is all-zero: active bucket's state was lost"
+
+
+# ---- bf16-native BatchNorm: parity with the f32 reference ------------------
+# The bf16 path computes stats as f32-widened dot_general reductions over
+# the bf16 activations and normalizes in bf16 (ops/nn.py batch_norm); these
+# tests pin it against the unchanged f32 path on bit-identical input values.
+
+def _bn_run(x, gamma, beta, rmean, rvar, training=True, fix_gamma=False):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import batch_norm
+
+    kw = dict(eps=1e-3, momentum=0.9, fix_gamma=fix_gamma, axis=1,
+              _training=training)
+    # random target: with a plain sum, dgamma = sum(xhat) ~ 0, and with a
+    # pure sum-of-squares, dx cancels analytically (dy lies in the span BN's
+    # backward projects out) — either would make the comparison vacuous
+    tgt = jnp.asarray(np.random.RandomState(7).randn(*x.shape)
+                      .astype("f4"))
+
+    def loss(xx, g, b):
+        out = batch_norm(xx, g, b, rmean, rvar, **kw)[0]
+        return jnp.sum((out.astype(jnp.float32) - tgt) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(x, gamma, beta)
+    outs = batch_norm(x, gamma, beta, rmean, rvar, **kw)
+    return outs, grads
+
+
+def _rel_err(a, b):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    return np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-6)
+
+
+def test_batchnorm_bf16_training_parity():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    xbf = jnp.asarray(rng.randn(8, 5, 6, 7).astype("f4") * 2 + 1,
+                      jnp.bfloat16)
+    x32 = xbf.astype(jnp.float32)  # identical values, f32 reference path
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, (5,)).astype("f4"))
+    beta = jnp.asarray(rng.randn(5).astype("f4"))
+    rmean = jnp.zeros((5,), jnp.float32)
+    rvar = jnp.ones((5,), jnp.float32)
+
+    (o_bf, m_bf, v_bf, nm_bf, nv_bf), g_bf = _bn_run(xbf, gamma, beta,
+                                                     rmean, rvar)
+    (o_32, m_32, v_32, nm_32, nv_32), g_32 = _bn_run(x32, gamma, beta,
+                                                     rmean, rvar)
+    # output stays in the activation dtype — no hidden upcast
+    assert o_bf.dtype == jnp.bfloat16 and o_32.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(o_bf, np.float32),
+                               np.asarray(o_32), rtol=0.05, atol=0.05)
+    # batch stats and running-stat updates are f32 on both paths and the
+    # widened reductions are exact f32 sums of the same values: tight
+    for a, b, tol in ((m_bf, m_32, 1e-5), (v_bf, v_32, 1e-4),
+                      (nm_bf, nm_32, 1e-5), (nv_bf, nv_32, 1e-4)):
+        assert a.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=tol, atol=tol)
+    dx_bf, dg_bf, db_bf = g_bf
+    dx_32, dg_32, db_32 = g_32
+    assert dx_bf.dtype == jnp.bfloat16  # cotangent stays bf16 (no convert)
+    assert _rel_err(dx_bf, dx_32) < 0.03
+    assert _rel_err(dg_bf, dg_32) < 0.03
+    assert _rel_err(db_bf, db_32) < 0.03
+
+
+def test_batchnorm_bf16_inference_parity():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    xbf = jnp.asarray(rng.randn(4, 3, 5, 5).astype("f4"), jnp.bfloat16)
+    x32 = xbf.astype(jnp.float32)
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, (3,)).astype("f4"))
+    beta = jnp.asarray(rng.randn(3).astype("f4"))
+    rmean = jnp.asarray(rng.randn(3).astype("f4"))
+    rvar = jnp.asarray(rng.uniform(0.5, 2.0, (3,)).astype("f4"))
+
+    (o_bf, _, _, nm_bf, nv_bf), _ = _bn_run(xbf, gamma, beta, rmean, rvar,
+                                            training=False)
+    (o_32, _, _, _, _), _ = _bn_run(x32, gamma, beta, rmean, rvar,
+                                    training=False)
+    assert o_bf.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(o_bf, np.float32),
+                               np.asarray(o_32), rtol=0.05, atol=0.05)
+    # inference must not touch the running stats
+    np.testing.assert_array_equal(np.asarray(nm_bf), np.asarray(rmean))
+    np.testing.assert_array_equal(np.asarray(nv_bf), np.asarray(rvar))
+
+
+def test_batchnorm_bf16_fix_gamma_zero_grad():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    xbf = jnp.asarray(rng.randn(4, 3, 6).astype("f4"), jnp.bfloat16)
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, (3,)).astype("f4"))
+    beta = jnp.zeros((3,), jnp.float32)
+    _, (dx, dg, db) = _bn_run(xbf, gamma, beta, jnp.zeros((3,)),
+                              jnp.ones((3,)), fix_gamma=True)
+    np.testing.assert_array_equal(np.asarray(dg), np.zeros((3,), "f4"))
+    assert np.abs(np.asarray(db)).max() > 0  # beta still trains
+
+
+def test_fused_module_bf16_policy_trains_and_matches_f32():
+    """End to end through the fused Module step under the session dtype
+    policy (MXNET_COMPUTE_DTYPE=bfloat16): params stay f32 masters, BN
+    running stats move, and 2 epochs stay close to the f32 run."""
+    from mxnet_tpu import config
+
+    p_32 = _fit("tpu_sync", "sgd", {"learning_rate": 0.05, "momentum": 0.9,
+                                    "multi_precision": True}, num_epoch=2)
+    with config.override(compute_dtype="bfloat16"):
+        p_bf = _fit("tpu_sync", "sgd", {"learning_rate": 0.05,
+                                        "momentum": 0.9,
+                                        "multi_precision": True},
+                    num_epoch=2)
+    args_bf, aux_bf = p_bf.get_params()
+    args_32, aux_32 = p_32.get_params()
+    for k in args_32:
+        a = args_bf[k].asnumpy()
+        assert np.isfinite(a).all(), k
+        assert a.dtype == np.float32, k  # master copies stay f32
+        assert _rel_err(a, args_32[k].asnumpy()) < 0.05, k
+    # BN running stats updated (and in f32) on the bf16 path
+    rm = aux_bf["bn1_moving_mean"].asnumpy()
+    assert rm.dtype == np.float32 and not np.allclose(rm, 0)
+    rv = aux_bf["bn1_moving_var"].asnumpy()
+    assert _rel_err(rv, aux_32["bn1_moving_var"].asnumpy()) < 0.05

@@ -1,0 +1,65 @@
+"""Chip-free HLO regression budget for the benched fused ResNet-50 step.
+
+The round-5 diagnosis found 766 bf16<->f32 converts (~2.75 Gelem per
+direction) in the lowered train step — one f32 round-trip of every BN
+activation, fwd and bwd. The bf16-native BatchNorm (ops/nn.py) plus the
+grouped parameter downcast (module/fused.py) eliminate them at the trace
+level, so the pre-optimization StableHLO — deterministic on CPU — is the
+regression surface: if a change reintroduces per-tensor round-trips, the
+convert count jumps by hundreds and this test fails without ever needing
+the chip.
+
+Budget: <= 120 bf16<->f32 converts (measured 111 at time of writing:
+109 f32->bf16 one-per-parameter-ish small casts + 2 from the grouped
+downcast pair), versus 766 before.
+"""
+import numpy as np
+import pytest
+
+BUDGET = 120
+BATCH = 128
+
+
+@pytest.fixture(scope="module")
+def step_stats():
+    import jax
+    from mxnet_tpu import hlo_stats as hs
+
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("lowering analysis is defined for the CPU backend")
+    import sys
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        from diagnose_step_hlo import build_fused, lower_step
+    finally:
+        sys.path.pop(0)
+    mod = build_fused(BATCH)
+    text = lower_step(mod).as_text()
+    return hs.analyze_stablehlo(text)
+
+
+def test_convert_budget(step_stats):
+    from mxnet_tpu import hlo_stats as hs
+    n = hs.convert_count_between(step_stats, "f32", "bf16")
+    assert n <= BUDGET, (
+        "bf16<->f32 converts regressed: %d > budget %d (was 766 before "
+        "the bf16-native BatchNorm; pairs=%r). A jump by ~100s means "
+        "some path is round-tripping activations through f32 again."
+        % (n, BUDGET, step_stats["convert_pairs"]))
+    # and the traffic through them stays negligible (< 0.2 Gelem total
+    # vs ~5.5 Gelem before)
+    assert hs.convert_gelems_between(step_stats, "f32", "bf16") < 0.2
+
+
+def test_convolutions_stay_bf16(step_stats):
+    """Every convolution (fwd + both bwd passes) must hit the MXU in
+    bf16 — an f32 conv means the dtype policy broke upstream of it."""
+    assert set(step_stats["convolution"]) == {"bf16"}
+    assert step_stats["convolution"]["bf16"] >= 150  # 53 convs x 3 passes
+
+
+def test_no_layout_transposes(step_stats):
+    """NCHW stays native: no transpose blowup from the policy change."""
+    assert step_stats["transpose_count"] <= 6

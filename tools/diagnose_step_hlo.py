@@ -68,64 +68,9 @@ def lower_step(mod, donate=False):
         _np.float32(1.0), _np.int32(1), jax.random.PRNGKey(0))
 
 
-_SHAPE_RE = re.compile(r"tensor<([0-9x]*)x?([a-z0-9]+)>")
-
-
-def _elems(shape_str):
-    n = 1
-    for d in shape_str.split("x"):
-        if d.isdigit():
-            n *= int(d)
-    return n
-
-
-def analyze_stablehlo(text):
-    """Count the layout/precision ops in StableHLO text. Returns a dict of
-    human-readable counters."""
-    out = collections.OrderedDict()
-    op_counts = collections.Counter()
-    transpose_elems = 0
-    convert_pairs = collections.Counter()
-    convert_elems = collections.Counter()
-    conv_types = collections.Counter()
-    dot_types = collections.Counter()
-
-    for line in text.splitlines():
-        m = re.search(r"stablehlo\.(\w+)", line)
-        if not m:
-            continue
-        op = m.group(1)
-        op_counts[op] += 1
-        if op == "transpose":
-            shapes = _SHAPE_RE.findall(line)
-            if shapes:
-                transpose_elems += _elems(shapes[0][0])
-        elif op == "convert":
-            shapes = _SHAPE_RE.findall(line)
-            if len(shapes) >= 2:
-                pair = "%s->%s" % (shapes[0][1], shapes[-1][1])
-                convert_pairs[pair] += 1
-                convert_elems[pair] += _elems(shapes[0][0])
-        elif op == "convolution":
-            shapes = _SHAPE_RE.findall(line)
-            if shapes:
-                conv_types[shapes[-1][1]] += 1
-        elif op == "dot_general":
-            shapes = _SHAPE_RE.findall(line)
-            if shapes:
-                dot_types[shapes[-1][1]] += 1
-
-    out["transpose_count"] = op_counts["transpose"]
-    out["transpose_gelems"] = transpose_elems / 1e9
-    out["convert_count"] = op_counts["convert"]
-    out["convert_pairs"] = dict(convert_pairs.most_common())
-    out["convert_gelems"] = {k: round(v / 1e9, 3)
-                             for k, v in convert_elems.most_common()}
-    out["convolution"] = dict(conv_types)
-    out["dot_general"] = dict(dot_types)
-    out["total_ops"] = sum(op_counts.values())
-    out["top_ops"] = dict(op_counts.most_common(12))
-    return out
+# the counters live in mxnet_tpu.hlo_stats so regression tests
+# (tests/test_step_hlo_budget.py) and this CLI share one implementation
+from mxnet_tpu.hlo_stats import analyze_stablehlo  # noqa: E402
 
 
 def main():
