@@ -10,6 +10,11 @@ def maybe_force_cpu(argv=None):
     if "--device=cpu" in argv or (i >= 0 and argv[i + 1:i + 2] == ["cpu"]):
         import jax
         jax.config.update("jax_platforms", "cpu")
+        # pure_callback custom ops (e.g. train_rcnn's proposal/target ops)
+        # re-enter jax from the callback thread; with async CPU dispatch
+        # that deadlocks on thread-pool starvation when cores are scarce.
+        # Must be set before the CPU client exists.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 
 def pick_ctx():

@@ -315,7 +315,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=2)
-    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--kv-store", default="local")
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--device", default=None)

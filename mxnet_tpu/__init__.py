@@ -118,6 +118,7 @@ def __getattr__(name):
         "executor_manager": ".executor_manager",
         "rnn": ".rnn",
         "model": ".model",
+        "checkpoint": ".checkpoint",
         "subgraph": ".subgraph",
         "parallel": ".parallel",
         "profiler": ".profiler",

@@ -1,0 +1,451 @@
+"""Atomic training-state checkpointing for elastic training.
+
+A crash at step N must be indistinguishable, arithmetically, from a
+pause at the last checkpoint: ``CheckpointManager`` persists the FULL
+training state — parameters, aux states, optimizer state (momentum
+buffers and per-index update counts), the RNG key chain, and loop
+position (epoch/step/batch) — so a supervised restart (tools/launch.py)
+resumes bitwise-identically.
+
+Durability contract (per snapshot ``ckpt-<step>``):
+
+1. ``ckpt-<step>.npz`` is written to a temp file in the same directory,
+   fsync'd, then ``os.replace``'d into place (POSIX rename atomicity),
+   and the directory fd is fsync'd so the rename itself is durable.
+2. Only then is ``ckpt-<step>.json`` — the manifest carrying the data
+   file's size and CRC32 — committed the same way.
+
+A reader therefore never sees a partial snapshot: no manifest means the
+snapshot doesn't exist; a manifest whose size/CRC doesn't match the
+data means torn/corrupt bytes, and ``restore_latest()`` skips it with a
+warning and falls back to the previous snapshot.
+
+Saves can run on a background thread (``async_save=True``) so the
+training loop only pays for the host transfer; ``wait()`` (called
+automatically before process-critical points) joins the in-flight save.
+Retention keeps the newest ``keep_n`` snapshots — at least 2, so a
+cross-rank skew of one step can always be rolled back to a common step.
+
+Environment knobs (all optional):
+
+* ``MXNET_CHECKPOINT_DIR``   — enables checkpointing in ``Module.fit`` /
+  ``gluon.Trainer`` without code changes.
+* ``MXNET_CHECKPOINT_EVERY`` — save period in steps (default 1).
+* ``MXNET_CHECKPOINT_KEEP``  — retention depth (default 5).
+* ``MXNET_RESUME_DIR``       — set by the launcher on restart attempts;
+  ``should_resume()`` keys off it.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import logging
+import os
+import pickle
+import threading
+import zlib
+
+import numpy as _np
+
+__all__ = ["CheckpointManager", "atomic_replace", "atomic_write_bytes",
+           "module_state", "restore_module", "trainer_state",
+           "restore_trainer"]
+
+_log = logging.getLogger("mxnet_tpu.checkpoint")
+
+_MANIFEST_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# atomic file primitives (shared by model.save_checkpoint and fault.py)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def atomic_replace(path):
+    """Context manager yielding a temp-file path; on clean exit the temp
+    file is fsync'd and atomically renamed onto ``path`` (and the parent
+    directory fsync'd).  On error the temp file is removed and ``path``
+    is untouched — a SIGKILL at any point leaves either the old complete
+    file or the new complete file, never a torn one.
+    """
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, ".%s.tmp.%d" % (os.path.basename(path),
+                                          os.getpid()))
+    try:
+        yield tmp
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def atomic_write_bytes(path, blob):
+    """Atomically write ``blob`` to ``path`` (temp + fsync + rename)."""
+    with atomic_replace(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+
+
+def _fsync_dir(d):
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without dir fds; rename atomicity still holds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# state <-> bytes
+# ---------------------------------------------------------------------------
+
+def _encode_state(state):
+    """Pack a {name: ndarray-or-bytes} dict into npz bytes.
+
+    numpy arrays go in natively; ``bytes`` values (pickled optimizer
+    state, packed RNG) are wrapped as uint8 arrays and their keys listed
+    under ``__bytes_keys__`` so decode can round-trip them.
+    """
+    arrays = {}
+    bytes_keys = []
+    for k, v in state.items():
+        if isinstance(v, (bytes, bytearray)):
+            arrays[k] = _np.frombuffer(bytes(v), dtype=_np.uint8)
+            bytes_keys.append(k)
+        else:
+            arrays[k] = _np.asarray(v)
+    buf = io.BytesIO()
+    _np.savez(buf, __bytes_keys__=_np.array(bytes_keys, dtype=object),
+              **arrays)
+    return buf.getvalue()
+
+
+def _decode_state(blob):
+    with _np.load(io.BytesIO(blob), allow_pickle=True) as z:
+        bytes_keys = set(z["__bytes_keys__"].tolist())
+        out = {}
+        for k in z.files:
+            if k == "__bytes_keys__":
+                continue
+            out[k] = z[k].tobytes() if k in bytes_keys else z[k]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Atomic, CRC-verified, retention-managed training checkpoints.
+
+    One manager owns one directory.  In multi-worker runs each rank gets
+    its own subdirectory (``rank_<r>``) so writers never collide; the
+    manifest still records the world size for sanity checks at restore.
+    """
+
+    def __init__(self, directory, keep_n=None, save_every=None,
+                 async_save=True, per_rank=True):
+        if keep_n is None:
+            keep_n = int(os.environ.get("MXNET_CHECKPOINT_KEEP", "5"))
+        if save_every is None:
+            save_every = int(os.environ.get("MXNET_CHECKPOINT_EVERY", "1"))
+        self.root = os.fspath(directory)
+        self.keep_n = max(2, int(keep_n))
+        self.save_every = max(1, int(save_every))
+        self.async_save = bool(async_save)
+        from .parallel import dist as _dist
+        self._rank = _dist.rank() if _dist.initialized() else int(
+            os.environ.get("MXNET_WORKER_RANK", "0"))
+        self._world = _dist.num_workers() if _dist.initialized() else int(
+            os.environ.get("MXNET_NUM_WORKERS", "1"))
+        self.directory = (os.path.join(self.root, "rank_%d" % self._rank)
+                          if per_rank else self.root)
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = None
+        self._save_err = None
+        self._lock = threading.Lock()
+
+    # -- env-driven construction -------------------------------------------
+
+    @staticmethod
+    def from_env():
+        """Build a manager from MXNET_RESUME_DIR / MXNET_CHECKPOINT_DIR,
+        or return None when neither is set (checkpointing disabled)."""
+        d = os.environ.get("MXNET_RESUME_DIR") or \
+            os.environ.get("MXNET_CHECKPOINT_DIR")
+        return CheckpointManager(d) if d else None
+
+    @staticmethod
+    def should_resume():
+        return bool(os.environ.get("MXNET_RESUME_DIR"))
+
+    # -- paths --------------------------------------------------------------
+
+    def _data_path(self, step):
+        return os.path.join(self.directory, "ckpt-%d.npz" % step)
+
+    def _manifest_path(self, step):
+        return os.path.join(self.directory, "ckpt-%d.json" % step)
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, state, step, epoch=0, nbatch=0, meta=None, blocking=None):
+        """Snapshot ``state`` (a {name: ndarray-or-bytes} dict) as step
+        ``step``.  With ``async_save`` the encode+write happens on a
+        background thread; state values must already be host arrays (the
+        helpers below materialise device buffers before handing off).
+        """
+        if blocking is None:
+            blocking = not self.async_save
+        self.wait()  # one in-flight save at a time; surfaces prior errors
+        if blocking:
+            self._write(state, step, epoch, nbatch, meta)
+        else:
+            t = threading.Thread(
+                target=self._write_guard,
+                args=(state, step, epoch, nbatch, meta),
+                name="mxnet-ckpt-save", daemon=True)
+            self._thread = t
+            t.start()
+
+    def maybe_save(self, state_fn, step, epoch=0, nbatch=0, meta=None):
+        """Save iff ``step`` is on the ``save_every`` grid. ``state_fn``
+        is only invoked (and device→host transfer only paid) when a save
+        actually happens."""
+        if step % self.save_every != 0:
+            return False
+        self.save(state_fn(), step, epoch=epoch, nbatch=nbatch, meta=meta)
+        return True
+
+    def wait(self):
+        """Join an in-flight async save; re-raise its error, if any."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        with self._lock:
+            err, self._save_err = self._save_err, None
+        if err is not None:
+            raise err
+
+    def _write_guard(self, *args):
+        try:
+            self._write(*args)
+        except BaseException as e:  # surfaced by the next wait()/save()
+            with self._lock:
+                self._save_err = e
+            _log.error("async checkpoint save failed: %s", e)
+
+    def _write(self, state, step, epoch, nbatch, meta):
+        from .parallel import faultinject as _fi
+        blob = _encode_state(state)
+        data_path = self._data_path(step)
+        atomic_write_bytes(data_path, blob)
+        # kill/delay window between data and manifest: restore must treat
+        # a manifest-less data file as nonexistent
+        _fi.fire("ckpt", step=step, path=data_path, phase="pre_manifest")
+        manifest = {
+            "version": _MANIFEST_VERSION,
+            "step": int(step),
+            "epoch": int(epoch),
+            "nbatch": int(nbatch),
+            "data": os.path.basename(data_path),
+            "size": len(blob),
+            "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+            "rank": self._rank,
+            "world": self._world,
+            "meta": meta or {},
+        }
+        atomic_write_bytes(self._manifest_path(step),
+                           json.dumps(manifest, indent=1).encode())
+        # truncate target: corrupting a *committed* snapshot proves the
+        # CRC path skips it at restore
+        _fi.fire("ckpt", step=step, path=data_path, phase="committed")
+        self._retain()
+
+    def _retain(self):
+        steps = sorted(self._manifest_steps())
+        for s in steps[:-self.keep_n]:
+            for p in (self._manifest_path(s), self._data_path(s)):
+                with contextlib.suppress(OSError):
+                    os.unlink(p)
+
+    # -- restore ------------------------------------------------------------
+
+    def _manifest_steps(self):
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith("ckpt-") and n.endswith(".json"):
+                try:
+                    out.append(int(n[5:-5]))
+                except ValueError:
+                    continue
+        return out
+
+    def steps(self):
+        """Steps with a committed manifest, newest first."""
+        return sorted(self._manifest_steps(), reverse=True)
+
+    def restore(self, step=None):
+        """Load the newest valid snapshot with step ≤ ``step`` (or the
+        newest overall when ``step`` is None).  Corrupt or partial
+        snapshots are skipped with a warning.  Returns
+        ``(state, manifest)`` or ``(None, None)`` when nothing valid
+        exists.
+        """
+        for s in self.steps():
+            if step is not None and s > step:
+                continue
+            got = self._load_one(s)
+            if got is not None:
+                return got
+        return None, None
+
+    def restore_latest(self):
+        return self.restore()
+
+    def _load_one(self, step):
+        mpath = self._manifest_path(step)
+        try:
+            with open(mpath, "rb") as f:
+                manifest = json.loads(f.read().decode())
+        except (OSError, ValueError) as e:
+            _log.warning("checkpoint %s: unreadable manifest (%s); "
+                         "skipping", mpath, e)
+            return None
+        dpath = os.path.join(self.directory, manifest.get("data", ""))
+        try:
+            with open(dpath, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            _log.warning("checkpoint step %d: missing data file (%s); "
+                         "skipping", step, e)
+            return None
+        if len(blob) != manifest.get("size") or \
+                (zlib.crc32(blob) & 0xFFFFFFFF) != manifest.get("crc32"):
+            _log.warning(
+                "checkpoint step %d: CRC/size mismatch (have %d bytes, "
+                "crc %08x; manifest says %s/%s) — corrupt or truncated; "
+                "skipping", step, len(blob), zlib.crc32(blob) & 0xFFFFFFFF,
+                manifest.get("size"), manifest.get("crc32"))
+            return None
+        try:
+            state = _decode_state(blob)
+        except Exception as e:
+            _log.warning("checkpoint step %d: undecodable payload (%s); "
+                         "skipping", step, e)
+            return None
+        return state, manifest
+
+
+# ---------------------------------------------------------------------------
+# state capture/restore helpers for the Module and Gluon layers
+# ---------------------------------------------------------------------------
+
+def _rng_blob():
+    from . import random as _random
+    st = _random.get_state()
+    return pickle.dumps(st, protocol=2)
+
+
+def _set_rng_blob(blob):
+    from . import random as _random
+    _random.set_state(pickle.loads(bytes(blob)))
+
+
+def module_state(module):
+    """Capture a Module's full training state as a flat dict."""
+    arg_params, aux_params = module.get_params()
+    state = {}
+    for k, v in arg_params.items():
+        state["arg:" + k] = v.asnumpy()
+    for k, v in aux_params.items():
+        state["aux:" + k] = v.asnumpy()
+    opt = getattr(module, "_optimizer_state_bytes", None)
+    if callable(opt):
+        blob = opt()
+        if blob is not None:
+            state["__opt__"] = blob
+    state["__rng__"] = _rng_blob()
+    return state
+
+
+def restore_module(module, state):
+    """Restore a Module (params into executors AND the kvstore, optimizer
+    state, RNG chain) from a ``module_state`` snapshot."""
+    from . import ndarray as _nd
+    arg_params = {k[4:]: _nd.array(v) for k, v in state.items()
+                  if k.startswith("arg:")}
+    aux_params = {k[4:]: _nd.array(v) for k, v in state.items()
+                  if k.startswith("aux:")}
+    module.set_params(arg_params, aux_params, allow_missing=False,
+                      force_init=True)
+    sync = getattr(module, "_sync_params_to_kvstore", None)
+    if callable(sync):
+        sync()
+    if "__opt__" in state:
+        setter = getattr(module, "_set_optimizer_state_bytes", None)
+        if callable(setter):
+            setter(state["__opt__"])
+    if "__rng__" in state:
+        _set_rng_blob(state["__rng__"])
+
+
+def trainer_state(trainer):
+    """Capture a gluon ``Trainer``'s full training state.
+
+    Parameters are keyed by BOTH position and name: names match across a
+    process restart, but gluon's auto-naming renumbers prefixes when a
+    net is re-built inside one process (dense0_ -> dense1_), so restore
+    falls back to position when the name is gone."""
+    state = {}
+    for i, p in enumerate(trainer._params):
+        state["param:%d:%s" % (i, p.name)] = p.data().asnumpy()
+    state["__opt__"] = trainer._updater_state_bytes()
+    state["__rng__"] = _rng_blob()
+    return state
+
+
+def restore_trainer(trainer, state):
+    from . import ndarray as _nd
+    by_name = {p.name: p for p in trainer._params}
+    for k, v in state.items():
+        if not k.startswith("param:"):
+            continue
+        _, idx, name = k.split(":", 2)
+        p = by_name.get(name)
+        if p is None:
+            i = int(idx)
+            if i < len(trainer._params) and \
+                    tuple(trainer._params[i].shape) == tuple(v.shape):
+                p = trainer._params[i]
+                _log.warning(
+                    "restore_trainer: no parameter named %r; matched "
+                    "snapshot slot %d to %r by position", name, i, p.name)
+        if p is None:
+            _log.warning("restore_trainer: snapshot parameter %r (slot "
+                         "%s) has no match in this trainer; skipping",
+                         name, idx)
+            continue
+        p.set_data(_nd.array(v))
+    if "__opt__" in state:
+        trainer._set_updater_state_bytes(state["__opt__"])
+    if "__rng__" in state:
+        _set_rng_blob(state["__rng__"])

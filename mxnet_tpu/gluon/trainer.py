@@ -8,6 +8,8 @@ registered fused update op on device (optimizer-as-op, SURVEY.md §2.2).
 """
 from __future__ import annotations
 
+import pickle as _pickle
+
 from ..base import MXNetError
 from .. import optimizer as _opt
 from .. import kvstore as _kv
@@ -19,7 +21,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, checkpoint=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -45,6 +47,14 @@ class Trainer:
         self._kv_initialized = False
         self._kvstore = None
         self._update_on_kvstore = None
+        # elastic checkpointing (docs/fault_tolerance.md): explicit
+        # manager, or env-driven via MXNET_CHECKPOINT_DIR/MXNET_RESUME_DIR
+        if checkpoint is None:
+            from ..checkpoint import CheckpointManager
+            checkpoint = CheckpointManager.from_env()
+        self._checkpoint = checkpoint
+        self._global_step = 0
+        self._resumed = False
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -102,9 +112,21 @@ class Trainer:
         """allreduce + optimizer update, scaling grads by 1/batch_size."""
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._checkpoint is not None and not self._resumed:
+            self._resumed = True
+            from ..checkpoint import CheckpointManager
+            if CheckpointManager.should_resume():
+                self.restore_checkpoint()
+        from ..parallel import faultinject as _fi
+        _fi.fire("step", step=self._global_step)
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        self._global_step += 1
+        if self._checkpoint is not None:
+            from ..checkpoint import trainer_state
+            self._checkpoint.maybe_save(lambda: trainer_state(self),
+                                        self._global_step)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -288,6 +310,78 @@ class Trainer:
                 for old, new in zip(_flatten_state(upd0.states[i]), ns):
                     old._rebind(new)
         return True
+
+    # -- elastic checkpointing ----------------------------------------------
+    def _live_updater(self):
+        if self._update_on_kvstore:
+            return getattr(self._kvstore, "_updater", None)
+        return self._updaters[0]
+
+    def _updater_state_bytes(self):
+        """Optimizer trajectory (state buffers + update counters) as an
+        opaque blob for CheckpointManager; see Module._optimizer_state_bytes
+        for the format rationale."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        upd = self._live_updater()
+        opt = self._optimizer
+        return _pickle.dumps({
+            "states": upd.get_states(dump_optimizer=False)
+            if upd is not None else None,
+            "num_update": opt.num_update,
+            "index_counts": dict(opt._index_update_count),
+        }, protocol=2)
+
+    def _set_updater_state_bytes(self, blob):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        obj = _pickle.loads(bytes(blob))
+        upd = self._live_updater()
+        if upd is not None and obj.get("states") is not None:
+            upd.set_states(obj["states"])
+            upd.optimizer = self._optimizer
+        opt = self._optimizer
+        opt.num_update = obj["num_update"]
+        opt._index_update_count.clear()
+        opt._index_update_count.update(obj["index_counts"])
+        # drop fused-update caches: restored state arrays replace the ones
+        # the last compiled program rebound
+        self._fused_ops_cache = False
+        self._fused_jit = None
+        self._fused_jit_cache = {}
+
+    def save_checkpoint(self, step=None, blocking=True):
+        """Snapshot params + optimizer state + RNG via the attached
+        CheckpointManager (no-op without one)."""
+        if self._checkpoint is None:
+            return False
+        from ..checkpoint import trainer_state
+        step = self._global_step if step is None else step
+        self._checkpoint.save(trainer_state(self), step, blocking=blocking)
+        return True
+
+    def restore_checkpoint(self, step=None):
+        """Restore the newest valid snapshot (params, optimizer state,
+        RNG chain, step counter). Returns the restored step or None."""
+        if self._checkpoint is None:
+            return None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        state, manifest = self._checkpoint.restore(step=step)
+        if state is None:
+            return None
+        from ..checkpoint import restore_trainer
+        restore_trainer(self, state)
+        # restored params must also replace the kvstore's copy — on
+        # dist_sync that copy is authoritative (push updates it, pull
+        # overwrites the parameter from it)
+        if self._kvstore is not None and \
+                getattr(self._kvstore, "_async_client", None) is None:
+            for i, param in enumerate(self._params):
+                if i in self._kvstore._store:
+                    self._kvstore._store[i] = param.data().copy()
+        self._global_step = manifest["step"]
+        return self._global_step
 
     def save_states(self, fname):
         assert self._optimizer is not None

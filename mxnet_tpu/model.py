@@ -21,13 +21,22 @@ BatchEndParam = collections.namedtuple(
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """Write prefix-symbol.json + prefix-NNNN.params (reference format roles)."""
+    """Write prefix-symbol.json + prefix-NNNN.params (reference format roles).
+
+    Both files go through the atomic temp+fsync+rename helper so a
+    SIGKILL mid-save can never leave a truncated file for
+    ``load_checkpoint`` to crash on — the old epoch's file survives
+    intact, or the new one is complete.
+    """
+    from .checkpoint import atomic_replace
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        with atomic_replace("%s-symbol.json" % prefix) as tmp:
+            symbol.save(tmp)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    _nd.save(param_name, save_dict)
+    with atomic_replace(param_name) as tmp:
+        _nd.save(tmp, save_dict)
 
 
 def load_checkpoint(prefix, epoch):

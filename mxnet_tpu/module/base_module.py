@@ -120,7 +120,8 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=1):
+            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=1,
+            checkpoint=None):
         """Epoch loop (reference base_module.py:410-560).
 
         ``steps_per_dispatch=K > 1`` groups K batches into ONE compiled
@@ -129,7 +130,17 @@ class BaseModule:
         Metric updates stay per-batch; ``batch_end_callback`` fires per
         batch but only after its group completes; lr/wd schedules advance
         in steps of K. Requires a module with a fused grouped step
-        (plain :class:`Module`) and no monitor."""
+        (plain :class:`Module`) and no monitor.
+
+        ``checkpoint``: a :class:`mxnet_tpu.checkpoint.CheckpointManager`
+        enabling elastic training — full training state (params, optimizer
+        trajectory, RNG chain, loop position) is snapshotted every
+        ``save_every`` steps, and when the launcher sets
+        ``MXNET_RESUME_DIR`` after a worker death, fit() restores the
+        newest snapshot all ranks share and continues bitwise-identically
+        to an uninterrupted run (see docs/fault_tolerance.md). Defaults to
+        an env-constructed manager when ``MXNET_CHECKPOINT_DIR`` or
+        ``MXNET_RESUME_DIR`` is set."""
         from .. import initializer as _init
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
@@ -175,12 +186,58 @@ class BaseModule:
                 not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
+        # ---- elastic checkpointing (docs/fault_tolerance.md) ----
+        from .. import checkpoint as _ckpt
+        from ..parallel import faultinject as _fi
+        ckpt = checkpoint if checkpoint is not None \
+            else _ckpt.CheckpointManager.from_env()
+        global_step = 0
+        resume_epoch, resume_nbatch = begin_epoch, 0
+        if ckpt is not None and _ckpt.CheckpointManager.should_resume():
+            state, manifest = ckpt.restore_latest()
+            mine = manifest["step"] if manifest is not None else -1
+            common = self._common_resume_step(mine)
+            if common >= 0 and common != mine:
+                # cross-rank snapshot skew (a rank died between its own
+                # save and a peer's): roll back to the newest step EVERY
+                # rank has, or the post-resume allreduces would silently
+                # mix different weight histories
+                state, manifest = ckpt.restore(step=common)
+            if common >= 0 and state is not None:
+                _ckpt.restore_module(self, state)
+                global_step = manifest["step"]
+                resume_epoch = manifest["epoch"]
+                resume_nbatch = manifest["nbatch"]
+                self.logger.info(
+                    "resumed from checkpoint step %d (epoch %d, batch %d) "
+                    "in %s", global_step, resume_epoch, resume_nbatch,
+                    ckpt.directory)
+            else:
+                self.logger.warning(
+                    "MXNET_RESUME_DIR set but no common valid checkpoint "
+                    "across ranks — starting from scratch")
+        meta = {"kvstore": kvstore if isinstance(kvstore, str)
+                else getattr(kvstore, "type", None)}
+
+        def _snap_state():
+            return _ckpt.module_state(self)
+
+        for epoch in range(max(begin_epoch, resume_epoch), num_epoch):
             tic = time.time()
             if eval_metric is not None:
                 eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
+            if ckpt is not None and epoch == resume_epoch and resume_nbatch:
+                # re-align the (deterministic, unshuffled-or-reseeded)
+                # iterator with the checkpointed loop position: the first
+                # resume_nbatch batches were consumed before the snapshot
+                for _ in range(resume_nbatch):
+                    try:
+                        next(data_iter)
+                    except StopIteration:
+                        break
+                nbatch = resume_nbatch
             if grouped:
                 # one dispatch per K batches; callbacks fire per batch
                 # (from THIS frame, so BatchEndParam.locals matches the
@@ -193,6 +250,7 @@ class BaseModule:
                         end_of_batch = True
                     if len(group) == steps_per_dispatch or \
                             (end_of_batch and group):
+                        _fi.fire("step", step=global_step)
                         if len(group) == steps_per_dispatch:
                             self._fit_group(group, eval_metric)
                         else:
@@ -209,14 +267,27 @@ class BaseModule:
                                         eval_metric=eval_metric,
                                         locals=locals()))
                             nbatch += 1
+                        global_step += len(group)
+                        if ckpt is not None:
+                            ckpt.maybe_save(_snap_state, global_step,
+                                            epoch=epoch, nbatch=nbatch,
+                                            meta=meta)
                         group = []
             else:
                 end_of_batch = False
-                next_data_batch = next(data_iter)
+                try:
+                    next_data_batch = next(data_iter)
+                except StopIteration:
+                    # resume landed exactly on this epoch's end
+                    end_of_batch = True
                 while not end_of_batch:
                     data_batch = next_data_batch
                     if monitor is not None:
                         monitor.tic()
+                    # global_step steps have completed (and, on the save
+                    # grid, been checkpointed) — "kill@step=N" dies HERE,
+                    # so the supervised restart resumes at exactly step N
+                    _fi.fire("step", step=global_step)
                     self._fit_step(data_batch)
                     # metric BEFORE prefetch/prepare (reference
                     # base_module.py:528-545): prepare() may switch the
@@ -238,6 +309,11 @@ class BaseModule:
                                              eval_metric=eval_metric,
                                              locals=locals()))
                     nbatch += 1
+                    global_step += 1
+                    if ckpt is not None:
+                        ckpt.maybe_save(_snap_state, global_step,
+                                        epoch=epoch, nbatch=nbatch,
+                                        meta=meta)
             for name, val in (eval_metric.get_name_value()
                               if eval_metric is not None else []):
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -257,6 +333,18 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
             train_data.reset()
+        if ckpt is not None:
+            ckpt.wait()  # join an in-flight async save; surface errors
+
+    @staticmethod
+    def _common_resume_step(mine):
+        """Newest checkpoint step EVERY rank can restore (allgather-min);
+        -1 if any rank has none. Single-process: just ``mine``."""
+        from ..parallel import dist as _dist
+        if not _dist.initialized() or _dist.num_workers() <= 1:
+            return mine
+        steps = _np.asarray(_dist.allgather(_np.int64(mine)))
+        return int(steps.min())
 
     # ---------------------------------------------------------- to override
     @property

@@ -10,6 +10,7 @@ may be a list for API parity; the first entry selects the mesh.
 from __future__ import annotations
 
 import logging
+import pickle
 
 import numpy as _np
 
@@ -575,6 +576,72 @@ class Module(BaseModule):
             if self._fused is not None:
                 self._fused_opt_state = self._fused.state_from_updater(
                     self._updater.states)
+
+    # ------------------------------------------------- elastic checkpointing
+    def _live_updater(self):
+        """The Updater currently applying updates: the kvstore's when the
+        optimizer runs on the (dist) kvstore, ours otherwise. None on the
+        dist_async path (state lives in the server process)."""
+        if self._update_on_kvstore and self._kvstore is not None:
+            return getattr(self._kvstore, "_updater", None)
+        return self._updater
+
+    def _optimizer_state_bytes(self):
+        """Opaque blob of the full optimizer trajectory for
+        CheckpointManager: momentum/moment buffers (updater states) plus
+        the update counters that drive lr schedules and Adam bias
+        correction. Restored by ``_set_optimizer_state_bytes`` WITHOUT
+        replacing the live optimizer object, so fused-step and kvstore
+        closures over it stay valid."""
+        if not self.optimizer_initialized:
+            return None
+        updater = self._live_updater()
+        states_blob = None
+        if updater is not None:
+            if self._fused is not None and \
+                    self._fused_opt_state is not None:
+                updater.states = self._fused.state_to_updater(
+                    self._fused_opt_state)
+            states_blob = updater.get_states(dump_optimizer=False)
+        opt = self._optimizer
+        return pickle.dumps({
+            "states": states_blob,
+            "num_update": opt.num_update,
+            "index_counts": dict(opt._index_update_count),
+        }, protocol=2)
+
+    def _set_optimizer_state_bytes(self, blob):
+        if not self.optimizer_initialized or blob is None:
+            return
+        obj = pickle.loads(bytes(blob))
+        updater = self._live_updater()
+        if updater is not None and obj.get("states") is not None:
+            updater.set_states(obj["states"])
+            if self._fused is not None:
+                self._fused_opt_state = self._fused.state_from_updater(
+                    updater.states)
+        # counters are copied INTO the live optimizer (not pickled over
+        # it): the kvstore updater and fused step hold references to this
+        # exact object
+        opt = self._optimizer
+        opt.num_update = obj["num_update"]
+        opt._index_update_count.clear()
+        opt._index_update_count.update(obj["index_counts"])
+
+    def _sync_params_to_kvstore(self):
+        """Make the kvstore's weight copy match the executor's.
+
+        On dist_sync the AUTHORITATIVE weights live in ``kv._store`` (push
+        updates them there, update() pulls them back) — restoring only the
+        executor would be overwritten by the first post-resume pull."""
+        kv = self._kvstore
+        if kv is None or not self.binded:
+            return
+        if getattr(kv, "_async_client", None) is not None:
+            return  # dist_async: the server's weights are authoritative
+        for name in self._param_names:
+            if name in kv._store:
+                kv._store[name] = self._exec.arg_dict[name].copy()
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):

@@ -113,12 +113,16 @@ def random_hue(data, *, min_factor, max_factor):
     alpha = _uniform(min_factor, max_factor)
     theta = (alpha - 1.0) * jnp.pi  # factor 1.0 -> no change
     u, w = jnp.cos(theta), jnp.sin(theta)
+    # 4-decimal YIQ coefficients: the I and Q rows must sum to exactly
+    # zero or gray pixels (R=G=B) pick up a hue-dependent cast (the
+    # 3-decimal rounding leaves ±0.001 row residuals that t_rgb's ±1.7
+    # entries amplify to ~3e-3 per channel)
     t_yiq = jnp.array([[0.299, 0.587, 0.114],
-                       [0.596, -0.274, -0.321],
-                       [0.211, -0.523, 0.311]], jnp.float32)
-    t_rgb = jnp.array([[1.0, 0.956, 0.621],
-                       [1.0, -0.272, -0.647],
-                       [1.0, -1.107, 1.705]], jnp.float32)
+                       [0.5959, -0.2746, -0.3213],
+                       [0.2115, -0.5227, 0.3112]], jnp.float32)
+    t_rgb = jnp.array([[1.0, 0.9563, 0.6210],
+                       [1.0, -0.2721, -0.6474],
+                       [1.0, -1.1070, 1.7046]], jnp.float32)
     rot = jnp.array([[1.0, 0.0, 0.0],
                      [0.0, 0.0, 0.0],
                      [0.0, 0.0, 0.0]], jnp.float32) + \

@@ -51,7 +51,11 @@ import struct
 import threading
 import weakref
 
+import time as _time
+
 import numpy as _np
+
+from . import faultinject
 
 __all__ = ["Server", "Client"]
 
@@ -205,6 +209,11 @@ class Server:
                         header, blob = _recv_frame(self.request,
                                                    key=outer._hmac_key,
                                                    chan=chan)
+                        # injected server-side drop ("conn_drop@serve=OP"):
+                        # raised OUTSIDE the dispatch try so it falls
+                        # through to the outer handler and severs the
+                        # connection exactly like a peer failure
+                        faultinject.fire("serve", op=header.get("op"))
                         try:
                             reply_hdr, reply_blob = outer._dispatch(header,
                                                                     blob)
@@ -365,6 +374,12 @@ class Client:
                              sock)
         return sock, self._tls.chan
 
+    # ops safe to retry after a connection failure: init is idempotent
+    # server-side (first writer wins), pull/stats are pure reads. A push
+    # is NOT — the server may have applied the update before the reply
+    # was lost, and re-pushing would apply the gradient twice.
+    _IDEMPOTENT = frozenset(("init", "pull", "stats"))
+
     def call(self, op, *args):
         header = {"op": op}
         blob = b""
@@ -387,18 +402,50 @@ class Client:
         else:
             raise ValueError("unknown kvstore op %r" % op)
 
-        sock, chan = self._connect()
-        try:
-            _send_frame(sock, header, blob, key=self._hmac_key, chan=chan)
-            reply, rblob = _recv_frame(sock, key=self._hmac_key, chan=chan)
-        except OSError:
-            # timeout / ConnectionError: the request-reply stream (and the
-            # channel counter) is desynced — drop the thread-local socket
-            # so the NEXT call reconnects cleanly instead of reusing it
-            self._tls.sock = None
-            self._tls.chan = None
-            _close_quietly(sock)
-            raise
+        retries = int(os.environ.get("MXNET_KVSTORE_RETRIES", "3")) \
+            if op in self._IDEMPOTENT else 0
+        backoff = float(os.environ.get("MXNET_KVSTORE_RETRY_BACKOFF",
+                                       "0.05"))
+        attempt = 0
+        while True:
+            sock, chan = self._connect()
+            try:
+                # injected client-side drop ("conn_drop@call=OP") lands
+                # here so the cleanup + retry path below handles it like
+                # a real mid-call connection loss
+                faultinject.fire("call", op=op)
+                _send_frame(sock, header, blob, key=self._hmac_key,
+                            chan=chan)
+                reply, rblob = _recv_frame(sock, key=self._hmac_key,
+                                           chan=chan)
+                break
+            except OSError as e:
+                # timeout / ConnectionError: the request-reply stream (and
+                # the channel counter) is desynced — drop the thread-local
+                # socket so the next attempt reconnects cleanly (fresh
+                # hello challenge) instead of reusing it
+                self._tls.sock = None
+                self._tls.chan = None
+                _close_quietly(sock)
+                if attempt < retries:
+                    attempt += 1
+                    _time.sleep(min(2.0, backoff * (2 ** (attempt - 1))))
+                    continue
+                if op in ("push", "pushq"):
+                    # fail fast, naming who died: a lost push may already
+                    # be applied server-side, so retrying is unsound — the
+                    # caller must treat this as fatal and resume from a
+                    # checkpoint instead
+                    from ..base import MXNetError
+                    from . import fault
+                    nw = int(os.environ.get("MXNET_NUM_WORKERS", "1"))
+                    dead = fault.dead_nodes(nw, timeout=_dead_timeout())
+                    raise MXNetError(
+                        "async kvstore: connection lost during %r (%s); "
+                        "push is not retried (may already be applied "
+                        "server-side). dead node(s): %s"
+                        % (op, e, dead if dead else "none detected yet"))
+                raise
         if reply.get("status") != "ok":
             from ..base import MXNetError
             raise MXNetError("async server: %s" % reply.get("error"))
@@ -419,6 +466,13 @@ class Client:
             sock = ref()
             if sock is not None:
                 _close_quietly(sock)
+
+
+def _dead_timeout():
+    try:
+        return float(os.environ.get("MXNET_HEARTBEAT_TIMEOUT", "60"))
+    except ValueError:
+        return 60.0
 
 
 def _close_quietly(sock):

@@ -32,6 +32,7 @@ process rank               MXNET_WORKER_RANK               DMLC_WORKER_ID
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import os
 
 import numpy as _np
@@ -110,6 +111,12 @@ def init(coordinator=None, num_workers_=None, rank_=None, strict=True):
             "every worker registering as rank 0 would hang the group")
     import jax
     try:
+        # CPU test fleets need gloo cross-process collectives; must be
+        # configured before the CPU backend client is created or every
+        # collective dies with "Multiprocess computations aren't
+        # implemented on the CPU backend"
+        with _contextlib.suppress(Exception):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=num_workers_,
                                    process_id=rank_)

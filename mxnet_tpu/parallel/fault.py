@@ -66,10 +66,14 @@ def start(rank, dir_=None, interval=None):
         path = _hb_path(dir_, rank)
 
         def beat(evt=_stop_evt):
+            # atomic write (temp + rename): dead_nodes readers and crash
+            # forensics must never observe a partial "pid time" record
+            tmp = path + ".tmp.%d" % os.getpid()
             while not evt.is_set():
                 try:
-                    with open(path, "w") as f:
+                    with open(tmp, "w") as f:
                         f.write("%d %f" % (os.getpid(), time.time()))
+                    os.replace(tmp, path)
                 except OSError:
                     pass  # a vanished dir must not kill the worker
                 evt.wait(interval)
@@ -81,12 +85,16 @@ def start(rank, dir_=None, interval=None):
 
 
 def stop():
+    """Stop heartbeating and JOIN the beat thread, so a test reusing the
+    tmpdir can't race a straggler writing one last heartbeat."""
     global _thread, _stop_evt
     with _lock:
+        t, _thread = _thread, None
         if _stop_evt is not None:
             _stop_evt.set()
-        _thread = None
         _stop_evt = None
+    if t is not None and t.is_alive():
+        t.join(timeout=10.0)
 
 
 def dead_nodes(num_workers, timeout=60.0, dir_=None):

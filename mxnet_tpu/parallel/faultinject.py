@@ -1,0 +1,219 @@
+"""Deterministic fault injection for elastic-training tests.
+
+The reference framework's fault surface (ps-lite node death, dropped
+connections, torn checkpoint writes) is only exercised in production;
+this module makes every failure mode *reproducible* so the recovery
+machinery (checkpoint/resume, launcher supervised restart, kvstore
+client retry) can be tested on CPU with no chips and no flaky sleeps.
+
+Faults are declared in the environment and fired at named injection
+points inside the library::
+
+    MXNET_FAULT_INJECT="kill@step=7:rank=0"
+
+Grammar (comma-separated specs)::
+
+    <action>@<point>=<match>[:key=val]...
+
+Actions and their points:
+
+``kill@step=N``
+    SIGKILL the process when training step ``N`` begins (N steps have
+    completed and been checkpointed).  Fired from ``Module.fit`` and
+    ``gluon.Trainer.step``.  Options: ``rank=R`` (only that worker
+    rank), ``sig=term`` (SIGTERM instead), ``rc=K`` (plain
+    ``os._exit(K)``).
+``delay@step=N:secs=S``
+    Sleep ``S`` seconds (default 1.0) at step ``N`` — simulates a
+    straggler so heartbeat/timeout knobs can be tuned in tests.
+``conn_drop@call=OP[:count=K]``
+    Drop the async-kvstore *client* connection before sending ``OP``
+    (``pull``/``push``/...), ``K`` times (default 1).  Exercises the
+    retry-with-backoff path in ``async_server.Client.call``.
+``conn_drop@serve=OP[:count=K]``
+    Same on the *server* side: the handler drops the connection when
+    dispatching ``OP``.
+``kill@ckpt=N`` / ``delay@ckpt=N``
+    Fire between a checkpoint's data rename and its manifest rename —
+    proves ``restore_latest`` ignores a data file with no manifest.
+``truncate@ckpt=N[:bytes=B]``
+    Corrupt the just-committed snapshot for step ``N`` by truncating
+    ``B`` bytes (default 64) off its data file — proves the CRC check
+    skips it.
+
+Every spec accepts ``rank=R`` (matched against ``MXNET_WORKER_RANK``,
+default 0) and ``count=K`` (max number of firings; ``kill`` and
+``conn_drop`` default to 1, everything else unlimited).
+
+``tools/launch.py`` clears ``MXNET_FAULT_INJECT`` for restarted worker
+incarnations, so an injected kill is a *first-run* event and the
+supervised restart runs clean — which is exactly the recovery scenario
+the tests assert.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+__all__ = ["fire", "specs", "reset", "InjectedFault", "InjectedConnDrop"]
+
+_log = logging.getLogger("mxnet_tpu.faultinject")
+
+_ACTIONS = ("kill", "delay", "conn_drop", "truncate", "raise")
+
+# point name -> the ctx key its @-match compares against
+_POINT_MATCH_KEY = {"step": "step", "call": "op", "serve": "op",
+                    "ckpt": "step"}
+
+
+class InjectedFault(RuntimeError):
+    """Generic injected failure (action ``raise``)."""
+
+
+class InjectedConnDrop(ConnectionError):
+    """Injected connection drop — handled exactly like a real peer
+    failure by both ends of the async kvstore protocol."""
+
+
+class _Spec:
+    __slots__ = ("action", "point", "match", "kwargs", "budget", "raw")
+
+    def __init__(self, action, point, match, kwargs, raw):
+        self.action = action
+        self.point = point
+        self.match = match
+        self.kwargs = kwargs
+        self.raw = raw
+        if "count" in kwargs:
+            self.budget = int(kwargs["count"])
+        elif action in ("kill", "conn_drop"):
+            self.budget = 1
+        else:
+            self.budget = -1  # unlimited
+
+    def matches(self, ctx):
+        key = _POINT_MATCH_KEY.get(self.point, self.point)
+        if self.match != "" and str(ctx.get(key)) != self.match:
+            return False
+        want_rank = self.kwargs.get("rank")
+        if want_rank is not None:
+            have = os.environ.get("MXNET_WORKER_RANK",
+                                  os.environ.get("DMLC_WORKER_ID", "0"))
+            if str(want_rank) != str(have):
+                return False
+        return True
+
+
+_lock = threading.Lock()
+_cache_env = None
+_cache_specs = ()
+
+
+def _parse(text):
+    out = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        action, sep, rest = part.partition("@")
+        action = action.strip()
+        if not sep or action not in _ACTIONS:
+            _log.warning("MXNET_FAULT_INJECT: ignoring malformed spec %r "
+                         "(want <action>@<point>=<match>[:k=v...], "
+                         "actions: %s)", part, "/".join(_ACTIONS))
+            continue
+        toks = rest.split(":")
+        point, _, match = toks[0].partition("=")
+        kwargs = {}
+        ok = True
+        for t in toks[1:]:
+            k, eq, v = t.partition("=")
+            if not eq:
+                _log.warning("MXNET_FAULT_INJECT: ignoring malformed "
+                             "option %r in spec %r", t, part)
+                ok = False
+                break
+            kwargs[k.strip()] = v.strip()
+        if ok:
+            out.append(_Spec(action, point.strip(), match.strip(),
+                             kwargs, part))
+    return tuple(out)
+
+
+def specs():
+    """Parsed specs for the current MXNET_FAULT_INJECT value (cached per
+    value, so monkeypatching the env between tests just works)."""
+    global _cache_env, _cache_specs
+    env = os.environ.get("MXNET_FAULT_INJECT", "")
+    with _lock:
+        if env != _cache_env:
+            _cache_env = env
+            _cache_specs = _parse(env) if env else ()
+        return _cache_specs
+
+
+def reset():
+    """Drop the parse cache and firing budgets (test isolation)."""
+    global _cache_env, _cache_specs
+    with _lock:
+        _cache_env = None
+        _cache_specs = ()
+
+
+def _consume(spec):
+    with _lock:
+        if spec.budget == 0:
+            return False
+        if spec.budget > 0:
+            spec.budget -= 1
+        return True
+
+
+def fire(point, **ctx):
+    """Evaluate the injection specs at a named point.
+
+    Call sites pass the point name plus whatever context the grammar can
+    match on (``step=``, ``op=``, ``path=``...).  No-op (a dict lookup
+    and an env compare) unless MXNET_FAULT_INJECT is set.
+    """
+    sps = specs()
+    if not sps:
+        return
+    for sp in sps:
+        if sp.point != point or not sp.matches(ctx) or not _consume(sp):
+            continue
+        _apply(sp, point, ctx)
+
+
+def _apply(sp, point, ctx):
+    _log.warning("fault injection: firing %r at point %r (ctx %r)",
+                 sp.raw, point, ctx)
+    if sp.action == "kill":
+        # make the death observable in streamed launcher logs before the
+        # process vanishes mid-write
+        sys.stdout.flush()
+        sys.stderr.flush()
+        if "rc" in sp.kwargs:
+            os._exit(int(sp.kwargs["rc"]))
+        sig = signal.SIGTERM if sp.kwargs.get("sig") == "term" \
+            else signal.SIGKILL
+        os.kill(os.getpid(), sig)
+        time.sleep(60)  # SIGKILL delivery is not synchronous
+    elif sp.action == "delay":
+        time.sleep(float(sp.kwargs.get("secs", 1.0)))
+    elif sp.action == "conn_drop":
+        raise InjectedConnDrop(
+            "injected connection drop at %s (%r)" % (point, sp.raw))
+    elif sp.action == "truncate":
+        path = ctx.get("path")
+        if path and os.path.exists(path):
+            nbytes = int(sp.kwargs.get("bytes", 64))
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(0, size - nbytes))
+    elif sp.action == "raise":
+        raise InjectedFault("injected fault at %s (%r)" % (point, sp.raw))

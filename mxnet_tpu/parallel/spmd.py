@@ -203,3 +203,45 @@ class SPMDTrainStep:
         if key is None:
             key = _random.next_key()
         return self._jitted(params, aux, opt_state, data, label, key)
+
+    # -- elastic checkpointing ----------------------------------------------
+    def save_checkpoint(self, manager, params, aux, opt_state, step,
+                        epoch=0, nbatch=0, blocking=None):
+        """Snapshot the SPMD training state through a CheckpointManager.
+
+        Buffers are materialised to host numpy BEFORE handing off to the
+        (possibly async) writer, so donation/in-place reuse of the device
+        buffers by the next step can't race the save."""
+        import pickle as _pickle
+        state = {}
+        for k, v in params.items():
+            state["arg:" + k] = _np.asarray(v)
+        for k, v in aux.items():
+            state["aux:" + k] = _np.asarray(v)
+        for k, v in opt_state.items():
+            state["opt:" + k] = _np.asarray(v)
+        state["__rng__"] = _pickle.dumps(_random.get_state(), protocol=2)
+        manager.save(state, step, epoch=epoch, nbatch=nbatch,
+                     meta={"kvstore": "spmd"}, blocking=blocking)
+
+    def restore_latest(self, manager, step=None):
+        """Load the newest valid snapshot and place every buffer with the
+        compiled shardings. Returns (params, aux, opt_state, manifest) or
+        None. ``compile()`` must have run (the shardings come from it)."""
+        import pickle as _pickle
+        import jax as _jax
+        state, manifest = manager.restore(step=step)
+        if state is None:
+            return None
+        p_sh, a_sh, _, _ = self._shardings
+        params, aux, opt = {}, {}, {}
+        for k, v in state.items():
+            if k == "__rng__":
+                _random.set_state(_pickle.loads(bytes(v)))
+            elif k.startswith("arg:"):
+                params[k[4:]] = _jax.device_put(v, p_sh[k[4:]])
+            elif k.startswith("aux:"):
+                aux[k[4:]] = _jax.device_put(v, a_sh[k[4:]])
+            elif k.startswith("opt:"):
+                opt[k[4:]] = _jax.device_put(v, p_sh[k[4:]])
+        return params, aux, opt, manifest

@@ -23,8 +23,8 @@ _SAMPLERS = ("uniform", "normal", "randn", "poisson", "exponential",
              "gamma", "negative_binomial", "generalized_negative_binomial",
              "multinomial", "shuffle", "randint")
 
-__all__ = ["seed", "next_key", "TraceRng", "current_trace_rng",
-           *_SAMPLERS]
+__all__ = ["seed", "next_key", "get_state", "set_state", "TraceRng",
+           "current_trace_rng", *_SAMPLERS]
 
 
 def __getattr__(name):
@@ -54,6 +54,36 @@ def seed(seed_state):
     """Seed the global RNG (parity: mx.random.seed)."""
     _state.key = jax.random.PRNGKey(int(seed_state))
     _np.random.seed(int(seed_state) % (2**32))
+
+
+def get_state():
+    """Snapshot the thread's RNG state for checkpointing.
+
+    Returns ``(jax_key_data, numpy_state)`` where ``jax_key_data`` is a
+    plain uint32 array (None when the chain was never seeded/drawn) and
+    ``numpy_state`` is ``np.random.get_state()``.  Round-trips through
+    ``set_state`` so a resumed run continues the exact key chain.
+    """
+    key = getattr(_state, "key", None)
+    if key is not None:
+        try:  # typed (new-style) keys need unwrapping to raw uint32 data
+            key = _np.asarray(jax.random.key_data(key))
+        except (TypeError, AttributeError):
+            key = _np.asarray(key)
+    return key, _np.random.get_state()
+
+
+def set_state(snapshot):
+    """Restore a snapshot produced by ``get_state`` (checkpoint resume)."""
+    key, np_state = snapshot
+    if key is None:
+        if hasattr(_state, "key"):
+            del _state.key
+    else:
+        with jax.ensure_compile_time_eval():
+            _state.key = jax.numpy.asarray(key, dtype=jax.numpy.uint32)
+    if np_state is not None:
+        _np.random.set_state(np_state)
 
 
 class TraceRng:

@@ -21,8 +21,22 @@ from mxnet_tpu.config import flags  # noqa: E402  (no jax side effects)
 
 if flags.test_platform == "cpu":
     jax.config.update("jax_platforms", "cpu")
+    # Custom-op tests escape to host via jax.pure_callback; with async CPU
+    # dispatch the main thread races ahead and the callback's nested jax
+    # work can starve the client's thread pool (a hard deadlock on
+    # single-core CI boxes). Inline dispatch is deterministic and must be
+    # set before the CPU client is created.
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    # tier-1 CI runs `-m 'not slow'`; multi-process kill/restart drills
+    # (minutes of wall clock) opt out of it with this marker
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running test, excluded "
+        "from the tier-1 fast suite")
 
 
 @pytest.fixture

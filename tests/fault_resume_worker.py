@@ -1,0 +1,58 @@
+"""Elastic-training worker for the kill/resume integration tests
+(launched by tools/launch.py, 2 processes, dist_sync).
+
+Trains the shared little net with checkpointing enabled (the launcher
+exports MXNET_CHECKPOINT_DIR). The driver test injects
+``MXNET_FAULT_INJECT=kill@step=N:rank=0`` into the FIRST incarnation
+only; the launcher's supervised restart relaunches the group with
+MXNET_RESUME_DIR set, fit() restores the newest snapshot common to both
+ranks, and training finishes. Rank 0 dumps the final params so the
+driver can compare them BITWISE against an uninterrupted run.
+"""
+import logging
+import os
+import sys
+
+logging.basicConfig(level=logging.INFO)  # surface the resume log line
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from tests.dist_train_common import (  # noqa: E402
+    make_net, full_data, fixed_params, PER_WORKER_BATCH,
+    N_SAMPLES_PER_WORKER, EPOCHS)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    # deterministic RNG chain: the snapshot carries it, so the resumed
+    # incarnation continues the chain this seed starts
+    mx.random.seed(7)
+    X, Y = full_data(n)
+    lo, hi = rank * N_SAMPLES_PER_WORKER, (rank + 1) * N_SAMPLES_PER_WORKER
+    it = mx.io.NDArrayIter(X[lo:hi], Y[lo:hi],
+                           batch_size=PER_WORKER_BATCH,
+                           label_name="softmax_label")
+    sym = make_net()
+    mod = mx.mod.Module(sym)
+    mod.fit(it, num_epoch=EPOCHS, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / (PER_WORKER_BATCH * n)},
+            arg_params=fixed_params(sym), initializer=None)
+    args, _ = mod.get_params()
+    if rank == 0 and os.environ.get("FAULT_TRAIN_DUMP"):
+        np.savez(os.environ["FAULT_TRAIN_DUMP"],
+                 **{k: v.asnumpy() for k, v in args.items()})
+    print("rank %d/%d: elastic training run complete" % (rank, n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

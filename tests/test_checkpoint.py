@@ -1,0 +1,440 @@
+"""Unit tests for the elastic-training subsystem: CheckpointManager
+atomicity/CRC/retention, the MXNET_FAULT_INJECT grammar, RNG state
+round-trip, atomic model saves, and single-process fit() resume
+(all chip-free; the multi-process kill drills live in test_fault.py)."""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import (CheckpointManager, atomic_replace,
+                                  atomic_write_bytes)
+from mxnet_tpu.parallel import faultinject
+
+
+def _mgr(tmp_path, **kw):
+    kw.setdefault("async_save", False)
+    kw.setdefault("per_rank", False)
+    return CheckpointManager(str(tmp_path), **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_inject(monkeypatch):
+    monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+# --------------------------------------------------------------- manager
+
+def test_roundtrip_arrays_and_bytes(tmp_path):
+    m = _mgr(tmp_path)
+    state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+             "idx": np.array([1, 2, 3], dtype=np.int64),
+             "__opt__": b"\x00\x01binary blob\xff"}
+    m.save(state, step=3, epoch=1, nbatch=2, meta={"kvstore": "dist_sync"})
+    got, manifest = m.restore_latest()
+    assert manifest["step"] == 3
+    assert manifest["epoch"] == 1
+    assert manifest["nbatch"] == 2
+    assert manifest["meta"]["kvstore"] == "dist_sync"
+    np.testing.assert_array_equal(got["w"], state["w"])
+    np.testing.assert_array_equal(got["idx"], state["idx"])
+    assert got["__opt__"] == state["__opt__"]
+
+
+def test_truncated_snapshot_skipped_with_warning(tmp_path, caplog):
+    m = _mgr(tmp_path)
+    m.save({"w": np.ones(4, np.float32)}, step=1)
+    m.save({"w": np.full(4, 2.0, np.float32)}, step=2)
+    data2 = m._data_path(2)
+    size = os.path.getsize(data2)
+    with open(data2, "r+b") as f:
+        f.truncate(size - 16)
+    with caplog.at_level(logging.WARNING, "mxnet_tpu.checkpoint"):
+        got, manifest = m.restore_latest()
+    assert manifest["step"] == 1  # fell back to the intact snapshot
+    np.testing.assert_array_equal(got["w"], np.ones(4, np.float32))
+    assert any("mismatch" in r.message for r in caplog.records)
+
+
+def test_crc_mismatch_skipped(tmp_path, caplog):
+    m = _mgr(tmp_path)
+    m.save({"w": np.ones(4, np.float32)}, step=1)
+    m.save({"w": np.full(4, 2.0, np.float32)}, step=2)
+    data2 = m._data_path(2)
+    blob = bytearray(open(data2, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # same size, flipped byte
+    with open(data2, "wb") as f:
+        f.write(blob)
+    with caplog.at_level(logging.WARNING, "mxnet_tpu.checkpoint"):
+        got, manifest = m.restore_latest()
+    assert manifest["step"] == 1
+
+
+def test_data_without_manifest_is_invisible(tmp_path):
+    """A kill between the data rename and the manifest rename leaves a
+    data file with no manifest — it must not exist as far as restore is
+    concerned."""
+    m = _mgr(tmp_path)
+    m.save({"w": np.ones(2, np.float32)}, step=1)
+    m.save({"w": np.full(2, 9.0, np.float32)}, step=2)
+    os.unlink(m._manifest_path(2))
+    got, manifest = m.restore_latest()
+    assert manifest["step"] == 1
+    # no valid snapshot at all -> (None, None), not a crash
+    os.unlink(m._manifest_path(1))
+    assert m.restore_latest() == (None, None)
+
+
+def test_retention_keeps_newest(tmp_path):
+    m = _mgr(tmp_path, keep_n=2)
+    for s in range(1, 6):
+        m.save({"w": np.full(2, float(s), np.float32)}, step=s)
+    assert m.steps() == [5, 4]
+    assert not os.path.exists(m._data_path(1))
+    got, manifest = m.restore_latest()
+    assert manifest["step"] == 5
+
+
+def test_restore_at_step_rolls_back(tmp_path):
+    m = _mgr(tmp_path)
+    for s in (1, 2, 3):
+        m.save({"w": np.full(2, float(s), np.float32)}, step=s)
+    got, manifest = m.restore(step=2)
+    assert manifest["step"] == 2
+    np.testing.assert_array_equal(got["w"], np.full(2, 2.0, np.float32))
+
+
+def test_async_save(tmp_path):
+    m = _mgr(tmp_path, async_save=True)
+    m.save({"w": np.arange(3, dtype=np.float32)}, step=1, blocking=False)
+    m.wait()
+    got, manifest = m.restore_latest()
+    assert manifest["step"] == 1
+    np.testing.assert_array_equal(got["w"], np.arange(3, dtype=np.float32))
+
+
+def test_maybe_save_honors_grid(tmp_path):
+    m = _mgr(tmp_path, save_every=2)
+    calls = []
+
+    def state_fn():
+        calls.append(1)
+        return {"w": np.zeros(1, np.float32)}
+
+    for s in (1, 2, 3, 4):
+        m.maybe_save(state_fn, s)
+    # state_fn only invoked (device->host only paid) on the grid
+    assert len(calls) == 2
+    assert m.steps() == [4, 2]
+
+
+def test_atomic_replace_failure_keeps_old_file(tmp_path):
+    p = str(tmp_path / "f.bin")
+    atomic_write_bytes(p, b"v1")
+    with pytest.raises(RuntimeError):
+        with atomic_replace(p) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(b"v2-partial")
+            raise RuntimeError("crash mid-save")
+    assert open(p, "rb").read() == b"v1"
+    assert [n for n in os.listdir(str(tmp_path)) if ".tmp." in n] == []
+
+
+def test_per_rank_subdirectories(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_WORKER_RANK", "1")
+    m = CheckpointManager(str(tmp_path), async_save=False)
+    assert m.directory.endswith("rank_1")
+    m.save({"w": np.zeros(1, np.float32)}, step=1)
+    assert (tmp_path / "rank_1" / "ckpt-1.json").exists()
+
+
+# ----------------------------------------------------------- faultinject
+
+def test_inject_grammar_parse(monkeypatch):
+    monkeypatch.setenv(
+        "MXNET_FAULT_INJECT",
+        "kill@step=7:rank=0,delay@step=2:secs=0.5,conn_drop@call=pull:"
+        "count=2,truncate@ckpt=3:bytes=128,bogus,nope@@")
+    faultinject.reset()
+    sps = faultinject.specs()
+    assert [s.action for s in sps] == ["kill", "delay", "conn_drop",
+                                       "truncate"]
+    kill = sps[0]
+    assert kill.point == "step" and kill.match == "7"
+    assert kill.kwargs["rank"] == "0" and kill.budget == 1
+    assert sps[2].budget == 2
+
+
+def test_inject_conn_drop_budget(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "conn_drop@call=pull")
+    faultinject.reset()
+    with pytest.raises(faultinject.InjectedConnDrop):
+        faultinject.fire("call", op="pull")
+    # budget exhausted (default count=1): next fire is a no-op
+    faultinject.fire("call", op="pull")
+    # different op never matched
+    faultinject.fire("call", op="push")
+
+
+def test_inject_rank_filter(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "raise@step=1:rank=3")
+    monkeypatch.setenv("MXNET_WORKER_RANK", "0")
+    faultinject.reset()
+    faultinject.fire("step", step=1)  # wrong rank: no-op
+    monkeypatch.setenv("MXNET_WORKER_RANK", "3")
+    with pytest.raises(faultinject.InjectedFault):
+        faultinject.fire("step", step=1)
+
+
+def test_inject_ckpt_truncation_end_to_end(tmp_path, monkeypatch):
+    """truncate@ckpt corrupts the committed snapshot; restore must fall
+    back to the previous step."""
+    m = _mgr(tmp_path)
+    m.save({"w": np.ones(64, np.float32)}, step=1)
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "truncate@ckpt=2:count=1")
+    faultinject.reset()
+    m.save({"w": np.full(64, 2.0, np.float32)}, step=2)
+    monkeypatch.delenv("MXNET_FAULT_INJECT")
+    faultinject.reset()
+    got, manifest = m.restore_latest()
+    assert manifest["step"] == 1
+
+
+def test_kvstore_client_retry_and_push_fail_fast(monkeypatch):
+    """Injected connection drops against a live in-process async server:
+    idempotent ops (pull) retry through reconnects; push fails fast with
+    an MXNetError (a lost push may already be applied server-side)."""
+    from mxnet_tpu.parallel.async_server import Server, Client
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_BACKOFF", "0.01")
+    srv = Server()
+    cli = Client("127.0.0.1", srv.port)
+    try:
+        cli.call("init", "w", np.ones((2, 2), "f4"))
+        # client-side: drop the connection twice mid-pull; retries win
+        monkeypatch.setenv("MXNET_FAULT_INJECT",
+                           "conn_drop@call=pull:count=2")
+        faultinject.reset()
+        np.testing.assert_array_equal(cli.call("pull", "w"),
+                                      np.ones((2, 2), "f4"))
+        # server-side: the handler severs the connection dispatching pull
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "conn_drop@serve=pull")
+        faultinject.reset()
+        np.testing.assert_array_equal(cli.call("pull", "w"),
+                                      np.ones((2, 2), "f4"))
+        # push: never retried — fails fast naming the policy
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "conn_drop@call=push")
+        faultinject.reset()
+        with pytest.raises(mx.base.MXNetError, match="not retried"):
+            cli.call("push", "w", np.ones((2, 2), "f4"))
+    finally:
+        monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+        faultinject.reset()
+        cli.call("shutdown")
+        cli.close()
+
+
+# ------------------------------------------------------------- RNG state
+
+def test_rng_state_roundtrip():
+    mx.random.seed(1234)
+    mx.nd.random.uniform(shape=(2,))  # advance the chain
+    snap = mx.random.get_state()
+    a = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    b = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    mx.random.set_state(snap)
+    a2 = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    b2 = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(b, b2)
+
+
+# --------------------------------------------------- atomic model saves
+
+def test_model_save_checkpoint_atomic(tmp_path):
+    from tests.dist_train_common import make_net, fixed_params
+    sym = make_net()
+    prefix = str(tmp_path / "model")
+    params = fixed_params(sym)
+    mx.model.save_checkpoint(prefix, 1, sym, params, {})
+    sym2, args2, _ = mx.model.load_checkpoint(prefix, 1)
+    for k in params:
+        np.testing.assert_array_equal(params[k].asnumpy(),
+                                      args2[k].asnumpy())
+    assert [n for n in os.listdir(str(tmp_path)) if ".tmp." in n] == []
+
+
+def test_heartbeat_files_atomic_and_stop_joins(tmp_path, monkeypatch):
+    import time
+    from mxnet_tpu.parallel import fault
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_HEARTBEAT_DIR", d)
+    assert fault.start(0, interval=0.02)
+    time.sleep(0.1)
+    fault.stop()
+    assert not fault.active()
+    # joined: no straggler beat can race us; and no partial temp records
+    files = os.listdir(d)
+    assert "hb_0" in files
+    assert [n for n in files if ".tmp." in n] == []
+    pid, ts = open(os.path.join(d, "hb_0")).read().split()
+    assert int(pid) == os.getpid() and float(ts) > 0
+
+
+# --------------------------------------------- gluon Trainer resume
+
+def test_trainer_checkpoint_roundtrip_bitwise(tmp_path):
+    """Save a Trainer mid-run, restore into a FRESH net+Trainer, finish:
+    final params must match an uninterrupted run bitwise (params,
+    momentum, and update counters all restored). The fresh net gets a
+    renumbered gluon name prefix, so this also covers restore's
+    positional fallback."""
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    def make(ckpt=None):
+        mx.random.seed(5)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9},
+                           checkpoint=ckpt)
+        return net, tr
+
+    def step(net, tr, k):
+        x = mx.nd.array(np.full((2, 4), 0.1 * (k + 1), np.float32))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(2)
+
+    net_a, tr_a = make()
+    for k in range(4):
+        step(net_a, tr_a, k)
+
+    mgr = CheckpointManager(str(tmp_path), async_save=False,
+                            per_rank=False)
+    net_b, tr_b = make(mgr)
+    for k in range(2):
+        step(net_b, tr_b, k)
+    assert tr_b.save_checkpoint()
+    assert tr_b._global_step == 2
+
+    net_c, tr_c = make(CheckpointManager(str(tmp_path), async_save=False,
+                                         per_rank=False))
+    assert tr_c.restore_checkpoint() == 2
+    for k in range(2, 4):
+        step(net_c, tr_c, k)
+
+    for (na, a), (nc, c) in zip(sorted(net_a.collect_params().items()),
+                                sorted(net_c.collect_params().items())):
+        np.testing.assert_array_equal(
+            a.data().asnumpy(), c.data().asnumpy(),
+            err_msg="%s vs %s diverged across trainer resume" % (na, nc))
+
+
+# ------------------------------------------------ SPMDTrainStep resume
+
+def test_spmd_checkpoint_roundtrip_bitwise(tmp_path):
+    """save_checkpoint/restore_latest on SPMDTrainStep: restore into a
+    FRESHLY compiled step (new program, same mesh) and finish — params
+    must match the uninterrupted trajectory bitwise."""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.spmd import SPMDTrainStep
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    def make_step():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        st = SPMDTrainStep(sym, mesh, lr=0.1, momentum=0.9)
+        pshapes = {"fc1_weight": (8, 6), "fc1_bias": (8,),
+                   "fc2_weight": (4, 8), "fc2_bias": (4,)}
+        st.compile(pshapes, {}, {"data": (16, 6)},
+                   {"softmax_label": (16,)})
+        return st, pshapes
+
+    rng = np.random.RandomState(0)
+    X = {"data": rng.randn(16, 6).astype(np.float32)}
+    Y = {"softmax_label": rng.randint(0, 4, (16,)).astype(np.float32)}
+    key = jax.random.PRNGKey(0)
+
+    st, pshapes = make_step()
+    params, aux, opt = st.init(pshapes, {}, seed=1)
+    for _ in range(2):
+        params, aux, opt, _ = st(params, aux, opt, X, Y, key=key)
+
+    mgr = CheckpointManager(str(tmp_path), async_save=False,
+                            per_rank=False)
+    st.save_checkpoint(mgr, params, aux, opt, step=2)
+    for _ in range(2):
+        params, aux, opt, _ = st(params, aux, opt, X, Y, key=key)
+
+    st2, _ = make_step()
+    got = st2.restore_latest(
+        CheckpointManager(str(tmp_path), async_save=False, per_rank=False))
+    assert got is not None
+    p2, a2, o2, manifest = got
+    assert manifest["step"] == 2
+    assert manifest["meta"]["kvstore"] == "spmd"
+    for _ in range(2):
+        p2, a2, o2, _ = st2(p2, a2, o2, X, Y, key=key)
+
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(params[k]), np.asarray(p2[k]),
+            err_msg="param %r diverged across SPMD resume" % k)
+
+
+# ------------------------------------------- single-process fit() resume
+
+def _fit_once(tmp_path, num_epoch, ckpt_env, tag):
+    """Train the shared little net for `num_epoch` epochs in-process."""
+    from tests.dist_train_common import (make_net, full_data, fixed_params,
+                                         PER_WORKER_BATCH)
+    mx.random.seed(99)
+    X, Y = full_data(1)
+    it = mx.io.NDArrayIter(X, Y, batch_size=PER_WORKER_BATCH,
+                           label_name="softmax_label")
+    sym = make_net()
+    mod = mx.mod.Module(sym)
+    mod.fit(it, num_epoch=num_epoch, kvstore="local", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / PER_WORKER_BATCH},
+            arg_params=fixed_params(sym), initializer=None,
+            eval_metric=None)
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_fit_resume_bitwise_single_process(tmp_path, monkeypatch):
+    """Interrupt-at-epoch-boundary resume: a run checkpointed through
+    epoch 0 and resumed for epoch 1 must finish with BITWISE the same
+    params as an uninterrupted 2-epoch run (same momentum, same update
+    counts, same RNG chain)."""
+    monkeypatch.delenv("MXNET_CHECKPOINT_DIR", raising=False)
+    monkeypatch.delenv("MXNET_RESUME_DIR", raising=False)
+
+    baseline = _fit_once(tmp_path, 2, None, "base")
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    monkeypatch.setenv("MXNET_CHECKPOINT_DIR", ckpt_dir)
+    _fit_once(tmp_path, 1, ckpt_dir, "partial")  # "crashes" after epoch 0
+
+    monkeypatch.setenv("MXNET_RESUME_DIR", ckpt_dir)
+    resumed = _fit_once(tmp_path, 2, ckpt_dir, "resumed")
+
+    assert sorted(baseline) == sorted(resumed)
+    for k in baseline:
+        np.testing.assert_array_equal(
+            baseline[k], resumed[k],
+            err_msg="param %r diverged across resume" % k)

@@ -31,8 +31,12 @@ def test_dist_sync_invariants(n):
 
 
 def test_launcher_propagates_failure():
+    # --max-restarts 0: the failure is deterministic, retries would only
+    # slow the test down (supervised-restart behavior has its own tests
+    # in test_fault.py)
     r = subprocess.run(
-        [sys.executable, LAUNCH, "-n", "2", sys.executable, "-c",
+        [sys.executable, LAUNCH, "-n", "2", "--max-restarts", "0",
+         sys.executable, "-c",
          "import sys, os; sys.exit(3 if os.environ['MXNET_WORKER_RANK'] "
          "== '1' else 0)"],
         capture_output=True, text=True, timeout=120)

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Chip-free elastic-training drill: inject a kill, survive it, prove
+bitwise-identical recovery.
+
+Runs the same 2-process dist_sync training job twice through
+tools/launch.py on CPU:
+
+1. baseline     — uninterrupted run, final params dumped;
+2. kill+resume  — ``MXNET_FAULT_INJECT=kill@step=N:rank=0`` SIGKILLs
+   rank 0 mid-training; the launcher's supervised restart brings the
+   group back up with ``MXNET_RESUME_DIR`` set, training resumes from
+   the newest common checkpoint and finishes.
+
+The drill PASSes iff the killed-and-resumed run's final parameters are
+BITWISE identical to the baseline's.  Exit code 0 on PASS, 1 on FAIL —
+suitable for a nightly cron next to bench.py.
+
+Usage::
+
+    python tools/fault_drill.py [--kill-step N] [-n WORKERS] [--keep]
+"""
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+WORKER = os.path.join(ROOT, "tests", "fault_resume_worker.py")
+
+
+def _run(tag, dump, extra_args, extra_env, verbose):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)       # workers pin CPU themselves
+    env.pop("MXNET_FAULT_INJECT", None)
+    env["FAULT_TRAIN_DUMP"] = dump
+    env.update(extra_env)
+    cmd = [sys.executable, LAUNCH] + extra_args + [sys.executable, WORKER]
+    print("fault_drill: [%s] %s" % (tag, " ".join(cmd)))
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
+                       env=env, cwd=ROOT)
+    if verbose or r.returncode != 0:
+        sys.stdout.write(r.stdout[-8000:])
+        sys.stderr.write(r.stderr[-4000:])
+    return r
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-n", "--num-workers", type=int, default=2)
+    ap.add_argument("--kill-step", type=int, default=3,
+                    help="global step at which rank 0 is SIGKILLed")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory for forensics")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="stream worker output even on success")
+    args = ap.parse_args(argv)
+
+    work = tempfile.mkdtemp(prefix="mxtpu_fault_drill_")
+    base_dump = os.path.join(work, "baseline.npz")
+    kill_dump = os.path.join(work, "killed.npz")
+    ckpt_dir = os.path.join(work, "ckpt")
+    n = str(args.num_workers)
+    ok = False
+    try:
+        r = _run("baseline", base_dump,
+                 ["-n", n, "--max-restarts", "0"], {}, args.verbose)
+        if r.returncode != 0:
+            print("fault_drill: FAIL — baseline run exited rc=%d"
+                  % r.returncode)
+            return 1
+
+        r = _run("kill+resume", kill_dump,
+                 ["-n", n, "--max-restarts", "3", "--restart-backoff",
+                  "0.2", "--checkpoint-dir", ckpt_dir],
+                 {"MXNET_FAULT_INJECT":
+                  "kill@step=%d:rank=0" % args.kill_step}, args.verbose)
+        if r.returncode != 0:
+            print("fault_drill: FAIL — kill+resume run exited rc=%d "
+                  "(restart did not recover)" % r.returncode)
+            return 1
+        if "launch.py: restarting the group" not in r.stderr:
+            print("fault_drill: FAIL — the injected kill never triggered "
+                  "a supervised restart")
+            return 1
+        if "resumed from checkpoint step" not in r.stdout:
+            print("fault_drill: FAIL — restarted workers did not resume "
+                  "from a checkpoint")
+            return 1
+        for ln in r.stderr.splitlines():
+            if ln.startswith("launch.py: summary "):
+                s = json.loads(ln.split("summary ", 1)[1])
+                print("fault_drill: restarts=%d dead_ranks(first)=%s"
+                      % (s["restarts"], s["attempts"][0]["dead_ranks"]))
+
+        import numpy as np
+        with np.load(base_dump) as base, np.load(kill_dump) as killed:
+            names = sorted(base.files)
+            if names != sorted(killed.files):
+                print("fault_drill: FAIL — param sets differ: %s vs %s"
+                      % (names, sorted(killed.files)))
+                return 1
+            bad = [k for k in names
+                   if not np.array_equal(base[k], killed[k])]
+        if bad:
+            print("fault_drill: FAIL — params diverged after kill+resume: "
+                  "%s" % bad)
+            return 1
+        print("fault_drill: PASS — kill@step=%d survived; %d params "
+              "bitwise-identical to the uninterrupted run" %
+              (args.kill_step, len(names)))
+        ok = True
+        return 0
+    finally:
+        if args.keep or not ok:
+            print("fault_drill: scratch kept at %s" % work)
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

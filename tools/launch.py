@@ -11,7 +11,15 @@ coordination service — so this launcher:
   MXNET_NUM_WORKERS / MXNET_WORKER_RANK set (DMLC_* aliases too, so
   reference-era scripts reading DMLC_NUM_WORKER keep working),
 * streams each worker's output with a ``[worker N]`` prefix,
-* on any worker failing, kills the rest and exits non-zero.
+* on any worker failing, kills the rest — then SUPERVISES: up to
+  ``--max-restarts`` times (default 3) the whole group is relaunched
+  with capped jittered exponential backoff, a fresh coordinator port,
+  and ``MXNET_RESUME_DIR`` pointed at the job checkpoint directory so
+  workers resume from the last committed snapshot
+  (docs/fault_tolerance.md). The group restarts as a unit because rank
+  0 hosts the PJRT coordination service — a single rank cannot rejoin a
+  running group. A structured JSON failure summary is emitted on stderr
+  whenever any attempt failed.
 
 Multi-host launches (one process per host over DCN) use the same
 environment contract: ``-H host0,host1,...`` starts one worker per host
@@ -152,7 +160,8 @@ def _multihost(args):
                              daemon=True)
         t.start()
         threads.append(t)
-    return _wait_group(procs, threads)
+    rc, _ = _wait_group(procs, threads)
+    return rc
 
 
 def main(argv=None):
@@ -169,6 +178,17 @@ def main(argv=None):
                     help="print per-host commands instead of executing")
     ap.add_argument("--env", action="append", default=[],
                     help="extra K=V for the workers")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="supervised restarts after a worker death "
+                         "(single-host mode; 0 disables)")
+    ap.add_argument("--restart-backoff", type=float, default=1.0,
+                    help="base seconds for the capped jittered "
+                         "exponential restart backoff")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="job checkpoint directory (exported as "
+                         "MXNET_CHECKPOINT_DIR; restarted workers get it "
+                         "as MXNET_RESUME_DIR). Default: a fresh temp dir "
+                         "when --max-restarts > 0, else none")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:
@@ -178,15 +198,17 @@ def main(argv=None):
     if not args.num_workers:
         ap.error("-n is required in single-host mode")
 
+    import json
+    import random
     import shlex
-    port = args.coordinator_port or _free_port()
-    addr = "127.0.0.1:%d" % port
+    import time
     # per-job kvstore auth secret: separate worker processes must share it
     # to talk to the rank-0 async server (async_server.py trust model)
     if "MXNET_KVSTORE_SECRET" not in os.environ:
         import secrets as _secrets
         os.environ["MXNET_KVSTORE_SECRET"] = _secrets.token_hex(16)
     if args.dry_run:
+        addr = "127.0.0.1:%d" % (args.coordinator_port or _free_port())
         sys.stderr.write(
             "launch.py: export MXNET_KVSTORE_SECRET (same value for "
             "every worker) before running these\n")
@@ -199,34 +221,100 @@ def main(argv=None):
                                  for k, v in sorted(env.items())),
                      " ".join(args.command)))
         return 0
-    hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
-    procs = []
-    threads = []
-    for r in range(args.num_workers):
-        env = dict(os.environ)
-        env.update(_worker_env(addr, args.num_workers, r, hb_dir, args.env))
-        p = subprocess.Popen(args.command, env=env,
-                             stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT, text=True)
-        procs.append(p)
-        t = threading.Thread(target=_stream, args=(p, r, sys.stdout),
-                             daemon=True)
-        t.start()
-        threads.append(t)
-    rc = _wait_group(procs, threads)
-    shutil.rmtree(hb_dir, ignore_errors=True)
+
+    # a checkpoint dir the launcher knows about is what makes restarts
+    # useful: restarted workers get it as MXNET_RESUME_DIR and continue
+    # instead of recomputing from scratch
+    ckpt_dir = args.checkpoint_dir
+    if ckpt_dir is None:
+        for kv in args.env:
+            if kv.startswith(("MXNET_CHECKPOINT_DIR=", "MXNET_RESUME_DIR=")):
+                ckpt_dir = kv.partition("=")[2]
+    owns_ckpt = False
+    if ckpt_dir is None and args.max_restarts > 0:
+        ckpt_dir = tempfile.mkdtemp(prefix="mxtpu_ckpt_")
+        owns_ckpt = True
+
+    attempts = []
+    attempt = 0
+    rc = 0
+    while True:
+        # fresh coordinator port + heartbeat dir per attempt: the old
+        # port may sit in TIME_WAIT and stale heartbeat files would make
+        # the new incarnation see phantom dead nodes
+        port = args.coordinator_port or _free_port()
+        addr = "127.0.0.1:%d" % port
+        hb_dir = tempfile.mkdtemp(prefix="mxtpu_hb_")
+        extra_env = {}
+        if ckpt_dir:
+            extra_env["MXNET_CHECKPOINT_DIR"] = ckpt_dir
+        if attempt > 0:
+            extra_env["MXNET_RESUME_DIR"] = ckpt_dir or ""
+            # injected faults are first-incarnation-only: the restarted
+            # run resumes at the very step the fault fired at, and would
+            # otherwise just die there again
+            extra_env["MXNET_FAULT_INJECT"] = ""
+        tic = time.time()
+        procs = []
+        threads = []
+        for r in range(args.num_workers):
+            env = dict(os.environ)
+            env.update(_worker_env(addr, args.num_workers, r, hb_dir,
+                                   args.env))
+            env.update(extra_env)
+            p = subprocess.Popen(args.command, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+            procs.append(p)
+            t = threading.Thread(target=_stream, args=(p, r, sys.stdout),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        rc, dead = _wait_group(procs, threads)
+        shutil.rmtree(hb_dir, ignore_errors=True)
+        attempts.append({"attempt": attempt, "rc": rc, "dead_ranks": dead,
+                         "duration_s": round(time.time() - tic, 3),
+                         "resumed": attempt > 0})
+        if rc == 0 or rc == 130 or attempt >= args.max_restarts:
+            break
+        delay = min(30.0, args.restart_backoff * (2 ** attempt)) \
+            * random.uniform(0.5, 1.5)
+        sys.stderr.write(
+            "launch.py: restarting the group (attempt %d/%d) in %.1fs; "
+            "workers will resume from %s\n"
+            % (attempt + 1, args.max_restarts, delay,
+               ckpt_dir or "<no checkpoint dir>"))
+        time.sleep(delay)
+        attempt += 1
+    if rc != 0 or attempt > 0:
+        # structured failure summary: one parseable line for fleet tooling
+        sys.stderr.write("launch.py: summary %s\n" % json.dumps(
+            {"rc": rc, "restarts": attempt,
+             "max_restarts": args.max_restarts,
+             "checkpoint_dir": ckpt_dir, "attempts": attempts},
+            sort_keys=True))
+    if owns_ckpt and rc == 0:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
     return rc
 
 
 def _wait_group(procs, threads):
+    """Wait for the group; on the first nonzero exit, terminate the
+    stragglers. Returns ``(rc, dead_ranks)``."""
     rc = 0
+    dead = []
     try:
         # poll ALL workers: a failed one wedges the rest at their next
         # collective, so on first failure terminate the stragglers
         import time
         pending = set(procs)
         while pending:
-            for p in list(pending):
+            # rank order, not set order: when a death cascades (rank 0
+            # dies -> peers abort on the lost coordinator), the lowest
+            # dead rank is the root cause and its rc is the one reported
+            for p in procs:
+                if p not in pending:
+                    continue
                 r = p.poll()
                 if r is None:
                     continue
@@ -250,7 +338,7 @@ def _wait_group(procs, threads):
         rc = 130
     for t in threads:
         t.join(timeout=5)
-    return rc
+    return rc, dead
 
 
 if __name__ == "__main__":
