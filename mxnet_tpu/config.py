@@ -256,6 +256,26 @@ register_flag("compute_dtype", "MXNET_COMPUTE_DTYPE", str, "auto",
               "weights, optimizer state, and normalization statistics "
               "stay f32. 'float32'/'off'/'none': never downcast, even "
               "where the contextual default would.")
+register_flag("engine_depth", "MXNET_ENGINE_DEPTH", int, 2,
+              "Bounded in-flight dispatch depth for the async training "
+              "loops (Module.fit, gluon.Trainer.step, SPMDTrainStep): up "
+              "to this many dispatched steps may be pending on the device "
+              "before the host blocks on the oldest. The TPU analog of "
+              "the reference ThreadedEngine's bounded pending-op queue. "
+              "1 = fully synchronous stepping; 0/negative = unbounded "
+              "(host never throttles; device errors surface late).")
+register_flag("steps_per_dispatch", "MXNET_STEPS_PER_DISPATCH", int, 16,
+              "K used by fit()'s automatic K-step lax.scan dispatch "
+              "(module/fused.py k_step) when the caller leaves "
+              "steps_per_dispatch=None and no per-step host observer "
+              "(batch_end_callback, monitor, lr scheduler, host-side "
+              "metric, checkpoint manager) forces per-step dispatch.")
+register_flag("device_metrics", "MXNET_DEVICE_METRICS", _parse_bool, True,
+              "Fold supported eval metrics (acc/top_k/ce/nll/loss) into "
+              "the fused train step as device-resident (sum, count) "
+              "accumulators, transferring to host only at display/epoch "
+              "boundaries. Off: per-batch host update (reference "
+              "semantics, one device->host sync per batch).")
 register_flag("test_device", "MXNET_TEST_DEVICE", str, "cpu",
               "Device type test_utils.default_context() returns (cpu|tpu) "
               "— the reference's env-switchable default_context (:53).")

@@ -24,9 +24,66 @@ import jax
 from .base import MXNetError
 from .config import flags
 
-__all__ = ["naive_mode", "waitall", "on_complete", "sync_point"]
+__all__ = ["naive_mode", "waitall", "on_complete", "sync_point",
+           "DepthController"]
 
 _NAIVE = flags.engine_type == "NaiveEngine"
+
+
+class DepthController:
+    """Bounded in-flight dispatch (the ThreadedEngine's pending-op bound,
+    reduced to what a PJRT device queue needs).
+
+    Every jitted dispatch returns immediately with futures; an unthrottled
+    fit loop would enqueue the whole epoch, ballooning host memory for the
+    pending feeds and deferring device errors to the epoch end. ``admit``
+    registers the freshly dispatched step's result handles and, once more
+    than ``depth`` steps are outstanding, blocks on the OLDEST — steady
+    state keeps ``depth`` steps in flight while the host runs ahead
+    preparing feeds. ``quiesce`` drains everything: checkpoint snapshots,
+    eval boundaries and epoch ends call it before reading state.
+
+    depth <= 0 disables throttling (unbounded); depth 1 is lockstep
+    (dispatch, then block on it at the next admit).
+    """
+
+    def __init__(self, depth=None):
+        if depth is None:
+            depth = flags.engine_depth
+        self.depth = depth
+        self._inflight = []  # deque of handle lists, oldest first
+
+    def admit(self, handles):
+        """Register one dispatched step's output handles (jax arrays);
+        block on the oldest step beyond the depth bound."""
+        handles = [h for h in handles if hasattr(h, "block_until_ready")]
+        self._inflight.append(handles)
+        if self.depth <= 0:
+            return
+        while len(self._inflight) > self.depth:
+            oldest = self._inflight.pop(0)
+            from . import profiler as _profiler
+            _profiler.record_host_sync("depth_wait")
+            for h in oldest:
+                try:
+                    h.block_until_ready()
+                except Exception as e:
+                    raise MXNetError(str(e)) from e
+
+    def quiesce(self):
+        """Block until every admitted step has completed (checkpoint /
+        eval / display boundary)."""
+        pending, self._inflight = self._inflight, []
+        if not pending:
+            return
+        from . import profiler as _profiler
+        _profiler.record_host_sync("wait")
+        for handles in pending:
+            for h in handles:
+                try:
+                    h.block_until_ready()
+                except Exception as e:
+                    raise MXNetError(str(e)) from e
 
 
 def naive_mode() -> bool:
@@ -45,6 +102,8 @@ def on_complete(array):
     """Block until one array's async computation completes (WaitForVar)."""
     try:
         if hasattr(array, "block_until_ready"):
+            from . import profiler as _profiler
+            _profiler.record_host_sync("wait")
             array.block_until_ready()
     except Exception as e:  # surface async device errors like the reference
         raise MXNetError(str(e)) from e
@@ -61,6 +120,8 @@ def waitall():
     them, so there the (O(live arrays)) walk remains the only correct
     drain, matching the reference's WaitForAll (threaded_engine.cc)."""
     try:
+        from . import profiler as _profiler
+        _profiler.record_host_sync("wait")
         jax.effects_barrier()
         # Every outstanding async execution *and* transfer surfaces as a
         # not-yet-ready live array; is_ready() is a non-blocking poll, so

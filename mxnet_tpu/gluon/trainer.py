@@ -55,6 +55,10 @@ class Trainer:
         self._checkpoint = checkpoint
         self._global_step = 0
         self._resumed = False
+        # bounded in-flight dispatch (engine.DepthController): step() does
+        # not block on the chip; built lazily so a late MXNET_ENGINE_DEPTH
+        # override (tests, config.override) is still honoured
+        self._depth_ctl = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -123,10 +127,33 @@ class Trainer:
         self._allreduce_grads()
         self._update(ignore_stale_grad)
         self._global_step += 1
+        # enqueue, don't wait: one updated-param handle stands for the
+        # whole step; the controller blocks only past flags.engine_depth
+        if self._depth_ctl is None:
+            from ..engine import DepthController
+            self._depth_ctl = DepthController()
+        self._depth_ctl.admit(self._step_handles())
         if self._checkpoint is not None:
             from ..checkpoint import trainer_state
-            self._checkpoint.maybe_save(lambda: trainer_state(self),
-                                        self._global_step)
+
+            def _state():
+                # settle in-flight updates before materializing a snapshot
+                self.quiesce()
+                return trainer_state(self)
+
+            self._checkpoint.maybe_save(_state, self._global_step)
+
+    def _step_handles(self):
+        for param in self._params:
+            if param.grad_req == "null" or param._data is None:
+                continue
+            return [param._data._data]
+        return []
+
+    def quiesce(self):
+        """Block until every in-flight step has retired on device."""
+        if self._depth_ctl is not None:
+            self._depth_ctl.quiesce()
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -355,6 +382,7 @@ class Trainer:
         CheckpointManager (no-op without one)."""
         if self._checkpoint is None:
             return False
+        self.quiesce()
         from ..checkpoint import trainer_state
         step = self._global_step if step is None else step
         self._checkpoint.save(trainer_state(self), step, blocking=blocking)
@@ -365,6 +393,7 @@ class Trainer:
         RNG chain, step counter). Returns the restored step or None."""
         if self._checkpoint is None:
             return None
+        self.quiesce()
         if not self._kv_initialized:
             self._init_kvstore()
         state, manifest = self._checkpoint.restore(step=step)
@@ -385,6 +414,7 @@ class Trainer:
 
     def save_states(self, fname):
         assert self._optimizer is not None
+        self.quiesce()
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 import queue as _queue
 
 import numpy as _np
@@ -245,20 +246,37 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def _start(self):
-        self._queue = _queue.Queue(maxsize=self._depth)
-        self._stop = False
+        # the worker closes over THIS generation's queue/stop-event rather
+        # than reading self attributes: a reset() that swapped self._queue
+        # while a previous worker was alive would otherwise let the zombie
+        # feed stale batches into the NEW queue (reset race)
+        q = self._queue = _queue.Queue(maxsize=self._depth)
+        stop = self._stop_event = threading.Event()
+
+        def put(item):
+            # bounded put that keeps observing the stop flag — a plain
+            # q.put() can block forever on a full queue the consumer
+            # abandoned at reset()
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def worker():
-            while not self._stop:
+            while not stop.is_set():
                 try:
                     batches = [i.next() for i in self.iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    put(None)
                     return
                 except Exception as e:  # propagate async errors to consumer
-                    self._queue.put(e)
+                    put(e)
                     return
-                self._queue.put(batches)
+                if not put(batches):
+                    return
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -282,13 +300,19 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        self._stop = True
-        try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+        # order matters: signal FIRST, then drain-while-joining so a worker
+        # blocked on a full queue can finish its put and observe the stop
+        # flag, and only reset the inner iterators once the worker is dead
+        # (it may be mid-`i.next()` on them)
+        self._stop_event.set()
+        deadline = time.time() + 5
+        while self._thread.is_alive() and time.time() < deadline:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
         for i in self.iters:
             i.reset()
         self._start()

@@ -43,6 +43,24 @@ def _as_np(x):
     return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
 
 
+def _fetch_lists(*array_lists):
+    """Move several lists of label/pred arrays to host in ONE
+    ``jax.device_get`` of the whole pytree (one blocking device->host
+    sync) instead of one ``asnumpy()`` round-trip per array. Host-side
+    values pass through untouched. Returns the lists as numpy arrays."""
+    devs = [[x._data if isinstance(x, NDArray) else x for x in lst]
+            for lst in array_lists]
+    pending = [d for lst in devs for d in lst
+               if hasattr(d, "block_until_ready")]
+    if pending:
+        from . import profiler as _profiler
+        _profiler.record_host_sync(
+            "d2h", sum(int(getattr(d, "nbytes", 0)) for d in pending))
+        import jax
+        devs = jax.device_get(devs)
+    return [[_np.asarray(x) for x in lst] for lst in devs]
+
+
 class EvalMetric:
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
@@ -63,6 +81,8 @@ class EvalMetric:
             label = [label[n] for n in self.label_names if n in label]
         else:
             label = list(label.values())
+        # multi-output modules: one batched fetch, not one sync per array
+        label, pred = _fetch_lists(label, pred)
         self.update(label, pred)
 
     def reset(self):
@@ -99,10 +119,15 @@ class CompositeEvalMetric(EvalMetric):
         return self.metrics[index]
 
     def update(self, labels, preds):
+        # fetch once for ALL sub-metrics, not once per sub-metric per array
+        labels, preds = _fetch_lists(labels, preds)
         for m in self.metrics:
             m.update(labels, preds)
 
     def update_dict(self, labels, preds):
+        lk, pk = list(labels), list(preds)
+        lv, pv = _fetch_lists([labels[k] for k in lk], [preds[k] for k in pk])
+        labels, preds = dict(zip(lk, lv)), dict(zip(pk, pv))
         for m in self.metrics:
             m.update_dict(labels, preds)
 

@@ -120,7 +120,7 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=1,
+            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=None,
             checkpoint=None):
         """Epoch loop (reference base_module.py:410-560).
 
@@ -131,6 +131,20 @@ class BaseModule:
         batch but only after its group completes; lr/wd schedules advance
         in steps of K. Requires a module with a fused grouped step
         (plain :class:`Module`) and no monitor.
+
+        ``steps_per_dispatch=None`` (default) picks K automatically:
+        ``flags.steps_per_dispatch`` (MXNET_STEPS_PER_DISPATCH, default
+        16) when nothing in the loop needs per-step host attention —
+        no monitor/batch_end_callback/checkpoint/sparse_row_id_fn/
+        lr_scheduler, and the eval metric either absent or folded into
+        the device step (see docs/perf.md "Async fit loop"). Otherwise
+        falls back to K=1, reference per-step semantics.
+
+        Completed dispatches are NOT waited on synchronously: a
+        :class:`~mxnet_tpu.engine.DepthController`
+        (``flags.engine_depth``, default 2) bounds the in-flight queue,
+        and the loop blocks only at checkpoint snapshots, epoch
+        boundaries, and metric reads.
 
         ``checkpoint``: a :class:`mxnet_tpu.checkpoint.CheckpointManager`
         enabling elastic training — full training state (params, optimizer
@@ -146,21 +160,24 @@ class BaseModule:
         if initializer is None:
             initializer = _init.Uniform(0.01)
 
-        # validate steps_per_dispatch BEFORE any side effect (bind/
-        # install_monitor/init_optimizer are not undone by the raise)
-        if steps_per_dispatch < 1:
-            raise ValueError("steps_per_dispatch must be >= 1, got %r"
-                             % (steps_per_dispatch,))
-        grouped = steps_per_dispatch > 1
-        if grouped:
-            if not hasattr(self, "_fit_group"):
-                raise ValueError(
-                    "steps_per_dispatch > 1 needs a module with a grouped "
-                    "fused step (plain Module); %s has none"
-                    % type(self).__name__)
-            if monitor is not None or sparse_row_id_fn is not None:
-                raise ValueError("steps_per_dispatch > 1 is incompatible "
-                                 "with monitor / sparse_row_id_fn")
+        # validate an EXPLICIT steps_per_dispatch BEFORE any side effect
+        # (bind/install_monitor/init_optimizer are not undone by the
+        # raise); None = decide automatically after the module is set up
+        explicit_k = steps_per_dispatch is not None
+        if explicit_k:
+            if steps_per_dispatch < 1:
+                raise ValueError("steps_per_dispatch must be >= 1, got %r"
+                                 % (steps_per_dispatch,))
+            if steps_per_dispatch > 1:
+                if not hasattr(self, "_fit_group"):
+                    raise ValueError(
+                        "steps_per_dispatch > 1 needs a module with a "
+                        "grouped fused step (plain Module); %s has none"
+                        % type(self).__name__)
+                if monitor is not None or sparse_row_id_fn is not None:
+                    raise ValueError(
+                        "steps_per_dispatch > 1 is incompatible with "
+                        "monitor / sparse_row_id_fn")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -219,7 +236,42 @@ class BaseModule:
         meta = {"kvstore": kvstore if isinstance(kvstore, str)
                 else getattr(kvstore, "type", None)}
 
+        # ---- async loop setup (docs/perf.md "Async fit loop") ----
+        # 1. fold the metric into the device step when its math allows:
+        #    per-batch update_metric becomes a no-op on the proxy and the
+        #    (sum, count) carry moves to host only at reads
+        from ..config import flags as _flags
+        if hasattr(self, "_engage_device_metric"):
+            if eval_metric is not None and monitor is None:
+                proxy = self._engage_device_metric(eval_metric)
+                if proxy is not None:
+                    eval_metric = proxy
+            else:
+                self._detach_device_metric()
+        # 2. with no per-step host observer left, run K steps per dispatch
+        #    (train-loop-under-scan); anything that must see the host
+        #    between steps keeps the reference per-step loop
+        if not explicit_k:
+            auto_k = (monitor is None and sparse_row_id_fn is None
+                      and batch_end_callback is None and ckpt is None
+                      and hasattr(self, "_fit_group")
+                      and getattr(self, "_fused", None) is not None
+                      and (eval_metric is None or
+                           getattr(eval_metric, "_device_resident", False))
+                      and getattr(getattr(self, "_optimizer", None),
+                                  "lr_scheduler", None) is None)
+            steps_per_dispatch = max(1, int(_flags.steps_per_dispatch)) \
+                if auto_k else 1
+        grouped = steps_per_dispatch > 1
+        # 3. dispatch without blocking; bound the in-flight queue so the
+        #    host can't run unboundedly ahead of the chip
+        from ..engine import DepthController
+        depth_ctl = DepthController()
+
         def _snap_state():
+            # quiesce first: a snapshot must capture a settled trajectory,
+            # not buffers a still-running dispatch is about to donate away
+            depth_ctl.quiesce()
             return _ckpt.module_state(self)
 
         for epoch in range(max(begin_epoch, resume_epoch), num_epoch):
@@ -253,12 +305,14 @@ class BaseModule:
                         _fi.fire("step", step=global_step)
                         if len(group) == steps_per_dispatch:
                             self._fit_group(group, eval_metric)
+                            depth_ctl.admit(self._dispatch_handles())
                         else:
                             # tail: per-step path — reuses/compiles the
                             # single-step program instead of tracing a
                             # second scan variant for the odd group size
                             for b in group:
                                 self._fit_group([b], eval_metric)
+                                depth_ctl.admit(self._dispatch_handles())
                         for data_batch in group:
                             if batch_end_callback is not None:
                                 for cb in _as_list(batch_end_callback):
@@ -289,6 +343,7 @@ class BaseModule:
                     # so the supervised restart resumes at exactly step N
                     _fi.fire("step", step=global_step)
                     self._fit_step(data_batch)
+                    depth_ctl.admit(self._dispatch_handles())
                     # metric BEFORE prefetch/prepare (reference
                     # base_module.py:528-545): prepare() may switch the
                     # bucketing module to the NEXT batch's bucket, whose
@@ -314,6 +369,9 @@ class BaseModule:
                         ckpt.maybe_save(_snap_state, global_step,
                                         epoch=epoch, nbatch=nbatch,
                                         meta=meta)
+            # epoch boundary: drain in-flight dispatches before the host
+            # reads metrics/params (one explicit wait, not one per step)
+            depth_ctl.quiesce()
             for name, val in (eval_metric.get_name_value()
                               if eval_metric is not None else []):
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -345,6 +403,17 @@ class BaseModule:
             return mine
         steps = _np.asarray(_dist.allgather(_np.int64(mine)))
         return int(steps.min())
+
+    def _dispatch_handles(self):
+        """Device handles standing for the most recent dispatch, for
+        :class:`~mxnet_tpu.engine.DepthController` back-pressure. An XLA
+        output buffer becomes ready only when its whole program retires,
+        so the first output handle suffices per dispatch."""
+        try:
+            outs = self.get_outputs()
+        except Exception:
+            return []
+        return [o._data for o in outs[:1] if hasattr(o, "_data")]
 
     # ---------------------------------------------------------- to override
     @property

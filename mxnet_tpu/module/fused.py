@@ -105,6 +105,10 @@ class FusedStep:
         # just reintroduce per-layer converts at the op boundary)
         self._keep_f32 = frozenset(keep_f32)
         self._jitted = None
+        # device-resident metric accumulation (device_metric.py): when
+        # attached, the step threads a small (sum, count) carry and
+        # updates it in-program — no per-batch host transfer
+        self._met_fn = None
         self._build()
 
     # ------------------------------------------------------------------ build
@@ -121,9 +125,10 @@ class FusedStep:
         cdt = self._compute_dtype
         dnames = self._data_names
         keepf = self._keep_f32
+        met_fn = self._met_fn
 
-        def step(params, rest, aux_vals, opt_state, lr_vec, wd_vec, rescale,
-                 t, key):
+        def step(params, rest, aux_vals, opt_state, met_state, lr_vec,
+                 wd_vec, rescale, t, key):
             diff = params
             if cdt is not None:
                 rest = {k: (v.astype(cdt)
@@ -159,7 +164,14 @@ class FusedStep:
                 new_params[k] = nw.astype(params[k].dtype)
                 new_opt[k] = ns
             new_aux = {**aux_vals, **auxu}
-            return outs, new_params, new_aux, new_opt
+            # metric carry update happens in the SAME program, over the
+            # traced outputs/labels — no host round-trip. met_state=None
+            # (a leafless pytree, resolved at trace time) skips it, so
+            # the public forward_backward path never accumulates.
+            new_met = met_state
+            if met_fn is not None and met_state is not None:
+                new_met = met_fn(met_state, outs, rest)
+            return outs, new_params, new_aux, new_opt, new_met
 
         # Shardings are not pinned here: the executor commits params/aux/
         # data to their mesh shardings (dp-sharded batch, replicated
@@ -180,7 +192,7 @@ class FusedStep:
         # jax.jit compiles lazily, so a fit()-only run pays for exactly one
         # compilation.
         self._jitted = jax.jit(step)
-        self._jitted_donate = jax.jit(step, donate_argnums=(0, 2, 3))
+        self._jitted_donate = jax.jit(step, donate_argnums=(0, 2, 3, 4))
 
         # K steps per dispatch: the classic TPU train-loop-under-scan.
         # One host->device dispatch executes K full steps over K stacked
@@ -190,21 +202,40 @@ class FusedStep:
         # scan carry so t-dependent optimizers (adam bias correction,
         # schedules consumed via t) stay exact. Retraces automatically when
         # K (the stacked leading dim) changes.
-        def k_step(params, static_rest, aux_vals, opt_state, feeds,
-                   lr_vec, wd_vec, rescale, t0, keys):
+        def k_step(params, static_rest, aux_vals, opt_state, met_state,
+                   feeds, lr_vec, wd_vec, rescale, t0, keys):
             def body(carry, xs):
-                p, a, o, t = carry
+                p, a, o, m, t = carry
                 feed, key = xs
-                outs, p2, a2, o2 = step(p, {**static_rest, **feed}, a, o,
-                                        lr_vec, wd_vec, rescale, t, key)
-                return (p2, a2, o2, t + jnp.int32(1)), outs
+                outs, p2, a2, o2, m2 = step(p, {**static_rest, **feed},
+                                            a, o, m, lr_vec, wd_vec,
+                                            rescale, t, key)
+                return (p2, a2, o2, m2, t + jnp.int32(1)), outs
 
-            (p, a, o, _), outs = jax.lax.scan(
-                body, (params, aux_vals, opt_state, jnp.int32(t0)),
+            (p, a, o, m, _), outs = jax.lax.scan(
+                body, (params, aux_vals, opt_state, met_state,
+                       jnp.int32(t0)),
                 (feeds, keys))
-            return outs, p, a, o
+            return outs, p, a, o, m
 
-        self._jitted_k = jax.jit(k_step, donate_argnums=(0, 2, 3))
+        self._jitted_k = jax.jit(k_step, donate_argnums=(0, 2, 3, 4))
+
+    # ----------------------------------------------------------------- metric
+    def attach_metric(self, met_fn):
+        """Fold a device metric update into the step: ``met_fn(state,
+        outs, rest) -> new_state`` (pure, traced). Rebuilds the jitted
+        wrappers; compilation is lazy, so attaching before the first
+        dispatch costs nothing extra."""
+        if self._met_fn is met_fn:
+            return
+        self._met_fn = met_fn
+        self._build()
+
+    def detach_metric(self):
+        if self._met_fn is None:
+            return
+        self._met_fn = None
+        self._build()
 
     # ------------------------------------------------------------------- state
     def init_state(self):
@@ -280,30 +311,34 @@ class FusedStep:
         rest = {k: v for k, v in arg_vals.items() if k not in params}
         return params, rest
 
-    def run(self, arg_vals, aux_vals, opt_state, key, donate=False):
+    def run(self, arg_vals, aux_vals, opt_state, key, donate=False,
+            met_state=None):
         """One fused step. With ``donate=True`` the param/aux/opt-state
-        buffers are DONATED to XLA (updated in place); the caller must
-        commit the returned values immediately — the inputs are dead."""
+        (and metric-carry) buffers are DONATED to XLA (updated in place);
+        the caller must commit the returned values immediately — the
+        inputs are dead."""
         lr_vec, wd_vec, rescale, t = self.hyper_peek()
         params, rest = self.split_args(arg_vals)
         fn = self._jitted_donate if donate else self._jitted
-        outs, new_params, new_aux, new_opt = fn(
-            params, rest, aux_vals, opt_state,
+        outs, new_params, new_aux, new_opt, new_met = fn(
+            params, rest, aux_vals, opt_state, met_state,
             jnp.asarray(lr_vec), jnp.asarray(wd_vec), rescale, t, key)
         new_args = dict(rest)
         new_args.update(new_params)
-        return outs, new_args, new_aux, new_opt
+        return outs, new_args, new_aux, new_opt, new_met
 
-    def run_k(self, arg_vals, aux_vals, opt_state, feeds, keys):
+    def run_k(self, arg_vals, aux_vals, opt_state, feeds, keys,
+              met_state=None):
         """K fused steps in ONE XLA program (`lax.scan` over stacked
         batches) — see ``k_step`` in :meth:`_build`.
 
         ``feeds`` is a list of K ``{input_name: jax value}`` dicts (the
         per-step data/label feeds); ``keys`` a list of K PRNG keys. The
-        param/aux/opt-state buffers are DONATED; the caller must commit the
-        returned values immediately. Returns ``(outs, new_params, new_aux,
-        new_opt)`` where each element of ``outs`` is stacked ``(K, ...)``
-        so callers can still update metrics per sub-batch.
+        param/aux/opt-state (and metric-carry) buffers are DONATED; the
+        caller must commit the returned values immediately. Returns
+        ``(outs, new_params, new_aux, new_opt, new_met)`` where each
+        element of ``outs`` is stacked ``(K, ...)`` so callers can still
+        update metrics per sub-batch.
 
         lr/wd are evaluated once per dispatch (a schedule moves in steps of
         K); the optimizer update count still advances per inner step.
@@ -328,11 +363,11 @@ class FusedStep:
                 spec = P(None, "dp") if name in ex._batch_args else P()
                 arr = jax.device_put(arr, NamedSharding(ex._mesh, spec))
             stacked[name] = arr
-        outs, new_params, new_aux, new_opt = self._jitted_k(
-            params, static_rest, aux_vals, opt_state, stacked,
+        outs, new_params, new_aux, new_opt, new_met = self._jitted_k(
+            params, static_rest, aux_vals, opt_state, met_state, stacked,
             jnp.asarray(lr_vec), jnp.asarray(wd_vec), rescale, t,
             jnp.stack(list(keys)))
-        return outs, new_params, new_aux, new_opt
+        return outs, new_params, new_aux, new_opt, new_met
 
     def cost_analysis(self, arg_vals, aux_vals, opt_state):
         """XLA cost analysis of the compiled fused step (flops etc.), via
@@ -341,7 +376,7 @@ class FusedStep:
         npar = len(self.param_names)
         params, rest = self.split_args(arg_vals)
         lowered = self._jitted.lower(
-            params, rest, aux_vals, opt_state,
+            params, rest, aux_vals, opt_state, None,
             jnp.zeros((npar,), jnp.float32), jnp.zeros((npar,), jnp.float32),
             _np.float32(1.0), _np.int32(1), jax.random.PRNGKey(0))
         try:

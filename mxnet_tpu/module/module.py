@@ -69,6 +69,12 @@ class Module(BaseModule):
         self._fused_pending = None
         self._fused_ran = False
         self._monitor_installed = False
+        # device-resident metrics (device_metric.py): the (sum, count)
+        # carry rides the fused step; host sees it only on publish
+        self._fused_met_state = None
+        self._device_plan = None
+        self._device_proxy = None
+        self._device_met_version = 0
 
     # ------------------------------------------------------------ properties
     @property
@@ -248,6 +254,7 @@ class Module(BaseModule):
             self._fused_opt_state = None
             self._fused_pending = None
             self._fused_ran = False
+            self._detach_device_metric()
 
     def _init_fused_step(self, kv):
         """Build the fused one-program train step (module/fused.py) when it
@@ -256,6 +263,7 @@ class Module(BaseModule):
         from ..config import flags as _flags
         self._fused = None
         self._fused_ran = False
+        self._detach_device_metric()
         if not self.for_training or not _flags.module_fused_step:
             return
         if self.inputs_need_grad or self._monitor_installed:
@@ -361,7 +369,7 @@ class Module(BaseModule):
             self.update()
 
     def _commit_fused(self, last_outs, new_params, new_aux, new_opt,
-                      n_steps=1):
+                      n_steps=1, new_met=None):
         """Commit a donating fused dispatch: the input buffers are dead, so
         params/aux/opt-state/outputs must all be adopted now. Shared by the
         per-step and grouped (run_k) paths — the commit protocol must stay
@@ -380,16 +388,21 @@ class Module(BaseModule):
         self._params_dirty = True
         self._fused_pending = None
         self._fused_ran = False
+        if new_met is not None:
+            # donated carry: the old device buffers are dead, adopt now
+            self._fused_met_state = new_met
+            self._device_met_version += 1
 
     def _fit_step_fused_impl(self, data_batch):
         from .. import random as _random
         ex = self._exec
         ex.set_inputs(**self._feed(data_batch))
         key = _random.next_key()
-        outs, new_args, new_aux, new_opt = self._fused.run(
+        outs, new_args, new_aux, new_opt, new_met = self._fused.run(
             ex._arg_vals(), ex._aux_vals(), self._fused_opt_state, key,
-            donate=True)
-        self._commit_fused(outs, new_args, new_aux, new_opt)
+            donate=True, met_state=self._fused_met_state)
+        self._commit_fused(outs, new_args, new_aux, new_opt,
+                           new_met=new_met)
 
     def _fit_group(self, data_batches, eval_metric=None):
         """fit's grouped entry (``steps_per_dispatch``): run the batches
@@ -412,6 +425,8 @@ class Module(BaseModule):
             return
         from ..ndarray.ndarray import NDArray
         outs = self._fit_step_k(data_batches)
+        if getattr(eval_metric, "_device_resident", False):
+            return  # accumulated inside the scan body; nothing to replay
         if eval_metric is not None:
             ex = self._exec
             last = ex.outputs
@@ -454,12 +469,97 @@ class Module(BaseModule):
             ex.arg_dict[name]._rebind(
                 val if place_each else ex._place_input(val, name))
         keys = [_random.next_key() for _ in data_batches]
-        outs, new_params, new_aux, new_opt = self._fused.run_k(
+        outs, new_params, new_aux, new_opt, new_met = self._fused.run_k(
             ex._arg_vals(), ex._aux_vals(), self._fused_opt_state,
-            feeds, keys)
+            feeds, keys, met_state=self._fused_met_state)
         self._commit_fused([o[-1] for o in outs], new_params, new_aux,
-                           new_opt, n_steps=len(data_batches))
+                           new_opt, n_steps=len(data_batches),
+                           new_met=new_met)
         return outs
+
+    # ------------------------------------------------- device-resident metric
+    def _engage_device_metric(self, eval_metric):
+        """Fold ``eval_metric``'s accumulation into the fused step
+        (device_metric.py): returns a :class:`DeviceMetricProxy` for fit's
+        loop, or None when the metric's math can't be replicated on device
+        / the fused step isn't engaged (caller keeps the per-batch host
+        path)."""
+        from ..config import flags as _flags
+        if self._fused is None or not _flags.device_metrics:
+            self._detach_device_metric()
+            return None
+        if eval_metric is None \
+                or getattr(eval_metric, "_device_resident", False):
+            self._detach_device_metric()
+            return None
+        from .. import device_metric as _dm
+        out_names = list(self._output_names)
+        label_names = list(self._label_names)
+        plan = _dm.plan_for(eval_metric, out_names, label_names)
+        if plan is None:
+            # a previous fit() may have attached a met_fn for a different
+            # metric; a stale carry would ride every step for nothing
+            self._detach_device_metric()
+            return None
+
+        def met_fn(state, outs, rest):
+            pred_dict = dict(zip(out_names, outs))
+            label_dict = {k: rest[k] for k in label_names if k in rest}
+            return plan.update(state, label_dict, pred_dict)
+
+        self._device_plan = plan
+        self._fused.attach_metric(met_fn)
+        self._fused_met_state = self._place_met_state(plan.init_state())
+        self._device_met_version += 1
+        proxy = _dm.DeviceMetricProxy(self, eval_metric)
+        proxy._pub_version = self._device_met_version
+        self._device_proxy = proxy
+        return proxy
+
+    def _place_met_state(self, state):
+        """Commit a fresh metric carry to the mesh's replicated sharding
+        (single-device modules take the host scalars as-is; jit places
+        them)."""
+        ex = self._exec
+        if ex._mesh is None:
+            return state
+        import jax
+        return tuple(tuple(jax.device_put(x, ex._rep_sharding) for x in p)
+                     for p in state)
+
+    def _reset_device_metric(self):
+        """Zero the device carry. Safe mid-flight at any engine depth: the
+        in-flight dispatches already consumed the old (donated) handles,
+        and the next dispatch picks up the fresh zeros."""
+        if self._device_plan is None:
+            return
+        self._fused_met_state = self._place_met_state(
+            self._device_plan.init_state())
+        self._device_met_version += 1
+
+    def _publish_device_metric(self):
+        """ONE device->host fetch of the whole metric carry, written into
+        the wrapped metric's host accumulators. This is the only d2h the
+        device-metric path pays, and only when someone reads the metric."""
+        if self._device_plan is None or self._fused_met_state is None:
+            return
+        pending = [x for p in self._fused_met_state for x in p
+                   if hasattr(x, "block_until_ready")]
+        host = self._fused_met_state
+        if pending:
+            from .. import profiler as _profiler
+            _profiler.record_host_sync(
+                "d2h", sum(int(getattr(x, "nbytes", 0)) for x in pending))
+            import jax
+            host = jax.device_get(self._fused_met_state)
+        self._device_plan.publish(host)
+
+    def _detach_device_metric(self):
+        if self._fused is not None:
+            self._fused.detach_metric()
+        self._fused_met_state = None
+        self._device_plan = None
+        self._device_proxy = None
 
     def _forward_fused(self, feed):
         from .. import random as _random
@@ -467,7 +567,9 @@ class Module(BaseModule):
         ex = self._exec
         ex.set_inputs(**feed)
         key = _random.next_key()
-        outs, new_args, new_aux, new_opt = self._fused.run(
+        # met_state=None: the public forward_backward path never touches
+        # metric accumulation (the caller updates its metric by hand)
+        outs, new_args, new_aux, new_opt, _ = self._fused.run(
             ex._arg_vals(), ex._aux_vals(), self._fused_opt_state, key)
         # aux (BN stats) commit at forward time, like the eager path
         for k, v in new_aux.items():

@@ -113,6 +113,8 @@ class NDArray:
 
     # ------------------------------------------------------------ conversion
     def asnumpy(self):
+        from .. import profiler as _profiler
+        _profiler.record_host_sync("d2h", getattr(self._data, "nbytes", 0))
         try:
             return _np.asarray(self._data)
         except Exception as e:

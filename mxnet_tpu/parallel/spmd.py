@@ -149,6 +149,7 @@ class SPMDTrainStep:
         self._param_sharding = {}
         self._step = step
         self._jitted = None
+        self._depth_ctl = None
 
     def _shard_params(self, shapes):
         out = {}
@@ -202,7 +203,21 @@ class SPMDTrainStep:
     def __call__(self, params, aux, opt_state, data, label, key=None):
         if key is None:
             key = _random.next_key()
-        return self._jitted(params, aux, opt_state, data, label, key)
+        out = self._jitted(params, aux, opt_state, data, label, key)
+        # async dispatch with bounded depth: the caller's loop keeps
+        # enqueueing steps; block only once flags.engine_depth programs
+        # are in flight (one output handle stands for the whole step)
+        if self._depth_ctl is None:
+            from ..engine import DepthController
+            self._depth_ctl = DepthController()
+        outs = out[3]
+        self._depth_ctl.admit(list(outs)[:1] if outs else [])
+        return out
+
+    def quiesce(self):
+        """Block until every in-flight SPMD step has retired."""
+        if self._depth_ctl is not None:
+            self._depth_ctl.quiesce()
 
     # -- elastic checkpointing ----------------------------------------------
     def save_checkpoint(self, manager, params, aux, opt_state, step,
@@ -213,6 +228,7 @@ class SPMDTrainStep:
         (possibly async) writer, so donation/in-place reuse of the device
         buffers by the next step can't race the save."""
         import pickle as _pickle
+        self.quiesce()  # settle in-flight steps before materialising
         state = {}
         for k, v in params.items():
             state["arg:" + k] = _np.asarray(v)

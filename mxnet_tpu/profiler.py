@@ -18,7 +18,9 @@ import time
 
 __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "dump", "dumps", "pause", "resume",
-           "Task", "Frame", "Event", "Counter", "Marker"]
+           "Task", "Frame", "Event", "Counter", "Marker",
+           "record_host_sync", "sync_counters", "reset_sync_counters",
+           "set_sync_trace"]
 
 _lock = threading.Lock()
 
@@ -124,6 +126,84 @@ def record_event(name, cat, start_us, dur_us, tid=0):
             ent[1] += dur_us
             ent[2] = min(ent[2], dur_us)
             ent[3] = max(ent[3], dur_us)
+
+
+# ---------------------------------------------------------------------------
+# Host-sync accounting. The async training loop's whole premise is that the
+# host almost never blocks on the device; these counters make that property
+# measurable (and regression-testable, tests/test_step_sync_budget.py)
+# without a chip. Kinds:
+#   d2h        — a device->host transfer (asnumpy / batched metric fetch /
+#                device-metric publish); the involuntary sync the budget
+#                test bounds
+#   wait       — an explicit blocking wait (wait_to_read / waitall)
+#   depth_wait — the engine depth controller throttling dispatch (expected
+#                back-pressure, not a regression)
+# Unlike the event hooks these are always on: a dict bump per sync is noise
+# next to the sync itself.
+# ---------------------------------------------------------------------------
+
+_SYNC_KINDS = ("d2h", "wait", "depth_wait")
+_sync_counts = {k: 0 for k in _SYNC_KINDS}
+_sync_counts["d2h_bytes"] = 0
+_sync_trace = None
+
+
+def record_host_sync(kind, nbytes=0):
+    """Count one host sync of ``kind`` (see module comment). Called by
+    NDArray.asnumpy, the engine wait paths, the batched metric fetch and
+    the device-metric publish."""
+    with _lock:
+        _sync_counts[kind] = _sync_counts.get(kind, 0) + 1
+        if kind == "d2h" and nbytes:
+            _sync_counts["d2h_bytes"] += nbytes
+    cb = _sync_trace
+    if cb is not None:
+        import traceback
+        # drop this frame and the caller's record_host_sync call site noise
+        cb(kind, nbytes, traceback.extract_stack()[:-1])
+    if _active:
+        with _lock:
+            _state.events.append({"name": "host_sync:%s" % kind, "ph": "i",
+                                  "ts": _now_us(), "pid": 0, "tid": 0,
+                                  "s": "t"})
+
+
+def sync_counters():
+    """Snapshot of the host-sync counters: {d2h, wait, depth_wait,
+    d2h_bytes, total} (total excludes depth_wait — throttling is the
+    loop working as designed, not a sync the user's code forced)."""
+    with _lock:
+        out = dict(_sync_counts)
+    out["total"] = out.get("d2h", 0) + out.get("wait", 0)
+    return out
+
+
+def reset_sync_counters():
+    with _lock:
+        for k in list(_sync_counts):
+            _sync_counts[k] = 0
+
+
+def set_sync_trace(trace=None):
+    """Install a callback fired on EVERY host sync: ``trace(kind, nbytes,
+    stack)`` with ``stack`` a ``traceback.StackSummary``. ``trace=True``
+    installs a default printer (one block per sync with the Python stack —
+    the ``tools/diagnose_step_hlo.py --sync-trace`` backend); ``None``
+    uninstalls. Returns the previous callback."""
+    global _sync_trace
+    if trace is True:
+        def trace(kind, nbytes, stack):
+            import sys
+            lines = ["host sync [%s]%s at:" % (
+                kind, " %d bytes" % nbytes if nbytes else "")]
+            lines += ["  %s:%d in %s" % (f.filename, f.lineno, f.name)
+                      for f in stack
+                      if "/profiler.py" not in f.filename]
+            print("\n".join(lines), file=sys.stderr, flush=True)
+    prev = _sync_trace
+    _sync_trace = trace
+    return prev
 
 
 class _OpTimer:

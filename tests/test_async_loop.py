@@ -1,0 +1,301 @@
+"""Async fit loop: depth parity, checkpoint quiesce, metric residency.
+
+Companion to tests/test_step_sync_budget.py. That file bounds the host
+syncs of the benched ResNet-50 loop; this one pins the OBSERVABLE
+semantics of going async on small models:
+
+* engine depth is invisible — callbacks/Speedometer/early-stop logic see
+  bitwise-identical metric values and the trained params are bitwise
+  equal at any depth (depth changes when the host waits, never what the
+  device computes);
+* a checkpoint taken mid-flight (depth > 1, dispatches outstanding)
+  equals one taken in lockstep — the save path quiesces first;
+* device-resident metric accumulation agrees with the reference host
+  path, and the proxy publishes through the user's own metric object;
+* CompositeEvalMetric moves a whole batch's labels+preds in ONE host
+  fetch (satellite of the same PR);
+* PrefetchingIter.reset() no longer races its worker thread.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu import config as _config
+from mxnet_tpu.io import DataBatch, DataDesc, NDArrayIter
+
+N, DIM, CLASSES, BATCH = 128, 16, 5, 16
+
+
+def _data():
+    rng = np.random.RandomState(3)
+    x = rng.randn(N, DIM).astype(np.float32)
+    y = (rng.rand(N) * CLASSES).astype(np.float32)
+    return x, y
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit_mlp(num_epoch=2, metric="acc", depth=None, **kw):
+    x, y = _data()
+    it = NDArrayIter(x, y, batch_size=BATCH, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), data_names=["data"],
+                        label_names=["softmax_label"], context=mx.cpu())
+    import logging
+    logger = logging.getLogger("async_loop_test")
+    logger.addHandler(logging.NullHandler())
+    logger.propagate = False
+    mod.logger = logger
+    np.random.seed(5)  # Initializer draws from the global numpy RNG
+    mx.random.seed(7)  # pin the device key chain (checkpointed state)
+    over = {} if depth is None else {"engine_depth": depth}
+    with _config.override(**over):
+        mod.fit(it, num_epoch=num_epoch, eval_metric=metric,
+                kvstore="tpu_sync",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+                **kw)
+    return mod
+
+
+def _params_np(mod):
+    import jax
+    ex = mod._exec
+    return {k: np.asarray(jax.device_get(ex.arg_dict[k]._data))
+            for k in mod._param_names}
+
+
+# --------------------------------------------------------- depth parity
+def test_depth_bitwise_parity_with_callbacks():
+    """The same training run at in-flight depth 4 vs lockstep depth 1:
+    a per-batch callback (which forces the per-step dispatch path, like
+    Speedometer/early-stop users) must read bitwise-identical metric
+    values, and the final params must match bit for bit."""
+    runs = {}
+    for depth in (4, 1):
+        seen = []
+
+        def cb(param):
+            seen.append(tuple(param.eval_metric.get_name_value()))
+
+        speedo = mx.callback.Speedometer(BATCH, frequent=3)
+        mod = _fit_mlp(depth=depth,
+                       batch_end_callback=[speedo, cb])
+        runs[depth] = (list(seen), _params_np(mod))
+
+    vals4, params4 = runs[4]
+    vals1, params1 = runs[1]
+    # bitwise: same floats at every read point (NaN-aware — Speedometer's
+    # auto_reset legitimately yields a 0/0 reading right after a reset)
+    assert len(vals4) == len(vals1)
+    for a, b in zip(vals4, vals1):
+        assert [n for n, _ in a] == [n for n, _ in b]
+        for (_, v1), (_, v2) in zip(a, b):
+            assert v1 == v2 or (np.isnan(v1) and np.isnan(v2)), (a, b)
+    assert params4.keys() == params1.keys()
+    for k in params4:
+        assert np.array_equal(params4[k], params1[k]), k
+
+
+def test_unbounded_depth_still_correct():
+    mod0 = _fit_mlp(depth=0)   # unbounded in-flight queue
+    mod1 = _fit_mlp(depth=1)
+    p0, p1 = _params_np(mod0), _params_np(mod1)
+    for k in p0:
+        assert np.array_equal(p0[k], p1[k]), k
+
+
+# --------------------------------------------------- checkpoint quiesce
+def test_checkpoint_midflight_equals_lockstep(tmp_path):
+    """A snapshot taken while dispatches are in flight (depth 4) must be
+    byte-equal to one taken in lockstep: fit's state_fn quiesces the
+    depth controller before materialising buffers."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    states = {}
+    for depth in (4, 1):
+        ck = CheckpointManager(str(tmp_path / ("d%d" % depth)),
+                               save_every=5, async_save=False)
+        _fit_mlp(depth=depth, checkpoint=ck)
+        state, manifest = ck.restore_latest()
+        assert manifest is not None
+        states[depth] = (state, manifest["step"])
+
+    s4, step4 = states[4]
+    s1, step1 = states[1]
+    assert step4 == step1
+    assert set(s4.keys()) == set(s1.keys())
+    for k in s4:
+        if k == "__rng__":
+            assert bytes(s4[k]) == bytes(s1[k])
+        else:
+            assert np.array_equal(np.asarray(s4[k]), np.asarray(s1[k])), k
+
+
+def test_gluon_trainer_quiesce_before_save(tmp_path):
+    """Trainer.step() dispatches without waiting; save_checkpoint must
+    settle the in-flight steps first and snapshot the post-update
+    params."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    def run(depth):
+        np.random.seed(9)
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        ck = CheckpointManager(str(tmp_path / ("g%d" % depth)),
+                               save_every=100, async_save=False)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore=None,
+                                checkpoint=ck)
+        x = mx.nd.array(np.random.RandomState(2).randn(4, 8)
+                        .astype(np.float32))
+        with _config.override(engine_depth=depth):
+            from mxnet_tpu import autograd
+            for _ in range(6):
+                with autograd.record():
+                    out = net(x)
+                    loss = out.sum()
+                loss.backward()
+                trainer.step(4)
+            trainer.save_checkpoint()
+        state, _ = ck.restore_latest()
+        # gluon auto-naming renumbers prefixes across runs in one
+        # process (dense0_ -> dense1_) — key by position only
+        return {k.split(":")[1]: np.asarray(v) for k, v in state.items()
+                if k.startswith("param:")}
+
+    a, b = run(3), run(1)
+    assert a.keys() == b.keys() and len(a) > 0
+    for k in a:
+        assert np.array_equal(a[k], b[k]), k
+
+
+# ------------------------------------------------- metric residency
+def test_device_metric_matches_host_path():
+    """flags.device_metrics=False replays the reference per-batch host
+    accumulation; the device carry must agree on acc exactly and on ce
+    to f32-accumulation tolerance (host sums in float64)."""
+    m_dev = mx.metric.CompositeEvalMetric(
+        [mx.metric.Accuracy(), mx.metric.CrossEntropy()])
+    mod = _fit_mlp(metric=m_dev)
+    assert mod._device_plan is not None, "composite acc+ce must fold"
+    dev_vals = dict(m_dev.get_name_value())
+
+    m_host = mx.metric.CompositeEvalMetric(
+        [mx.metric.Accuracy(), mx.metric.CrossEntropy()])
+    with _config.override(device_metrics=False):
+        mod2 = _fit_mlp(metric=m_host)
+    assert mod2._device_plan is None
+    host_vals = dict(m_host.get_name_value())
+
+    assert dev_vals["accuracy"] == host_vals["accuracy"]
+    assert np.isclose(dev_vals["cross-entropy"],
+                      host_vals["cross-entropy"], rtol=1e-5)
+
+
+def test_unsupported_metric_falls_back_to_host():
+    """F1 keeps per-batch state (confusion counts with argmax on host
+    thresholds) — not fusable; fit must quietly keep the host path."""
+    x, y = _data()
+    y = (y > 2).astype(np.float32)  # F1 wants binary labels
+    it = NDArrayIter(x, y, batch_size=BATCH, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"], context=mx.cpu())
+    m = mx.metric.F1()
+    mod.fit(it, num_epoch=1, eval_metric=m, kvstore="tpu_sync",
+            optimizer_params={"learning_rate": 0.05})
+    assert mod._device_plan is None
+    assert m.num_inst > 0  # host path accumulated normally
+
+
+def test_composite_update_is_one_fetch():
+    """CompositeEvalMetric.update moves labels+preds to host as ONE
+    device_get of the whole pytree, not one per leaf metric per array."""
+    m = mx.metric.CompositeEvalMetric(
+        [mx.metric.Accuracy(), mx.metric.CrossEntropy(),
+         mx.metric.TopKAccuracy(top_k=3)])
+    pred = mx.nd.array(np.random.RandomState(0)
+                       .rand(8, CLASSES).astype(np.float32))
+    label = mx.nd.array(np.arange(8, dtype=np.float32) % CLASSES)
+    profiler.reset_sync_counters()
+    m.update([label], [pred])
+    assert profiler.sync_counters()["d2h"] == 1
+    assert m.get_name_value()
+
+
+# ------------------------------------------------- PrefetchingIter race
+class _SlowIter:
+    """Inner iterator with a latency hump, so reset() reliably lands
+    while the worker is mid-next()/mid-put()."""
+
+    def __init__(self, n=8, delay=0.002):
+        self.n = n
+        self.delay = delay
+        self.i = 0
+        self.batch_size = 2
+        self.provide_data = [DataDesc("data", (2, 3))]
+        self.provide_label = [DataDesc("softmax_label", (2,))]
+
+    def next(self):
+        if self.i >= self.n:
+            raise StopIteration
+        time.sleep(self.delay)
+        i = self.i
+        self.i += 1
+        return DataBatch(
+            data=[mx.nd.array(np.full((2, 3), i, np.float32))],
+            label=[mx.nd.array(np.zeros((2,), np.float32))], pad=0)
+
+    def reset(self):
+        self.i = 0
+
+
+def test_prefetch_reset_not_racy():
+    """Hammer reset() mid-stream: the first batch after every reset must
+    be batch 0 (a zombie worker feeding the NEW queue from the OLD
+    iterator position would surface here as a stale batch), and the
+    full epoch must arrive in order afterwards."""
+    from mxnet_tpu.io import PrefetchingIter
+    pf = PrefetchingIter(_SlowIter())
+    try:
+        for _ in range(15):
+            b = pf.next()
+            assert float(b.data[0].asnumpy()[0, 0]) == 0.0
+            pf.next()  # leave the worker busy mid-epoch
+            pf.reset()
+        seq = []
+        while True:
+            try:
+                seq.append(float(pf.next().data[0].asnumpy()[0, 0]))
+            except StopIteration:
+                break
+        assert seq == [float(i) for i in range(8)], seq
+    finally:
+        pf._stop_event.set()
+
+
+def test_prefetch_reset_while_queue_full():
+    """The old worker blocked on a FULL queue must still die at reset:
+    its put() observes the stop event instead of blocking forever."""
+    from mxnet_tpu.io import PrefetchingIter
+    pf = PrefetchingIter(_SlowIter(n=50, delay=0.0), prefetch_depth=1)
+    time.sleep(0.05)  # queue certainly full, worker blocked in put
+    old_worker = pf._thread
+    pf.reset()
+    old_worker.join(timeout=2)
+    assert not old_worker.is_alive()
+    b = pf.next()
+    assert float(b.data[0].asnumpy()[0, 0]) == 0.0
+    pf._stop_event.set()

@@ -62,10 +62,46 @@ def lower_step(mod, donate=False):
     npar = len(fused.param_names)
     params, rest = fused.split_args(ex._arg_vals())
     fn = fused._jitted_donate if donate else fused._jitted
+    # met_state=None: lower the exact benched program (bench.py runs with
+    # eval_metric=None, so no device-metric carry rides the step)
     return fn.lower(
-        params, rest, ex._aux_vals(), mod._fused_opt_state,
+        params, rest, ex._aux_vals(), mod._fused_opt_state, None,
         jnp.zeros((npar,), jnp.float32), jnp.zeros((npar,), jnp.float32),
         _np.float32(1.0), _np.int32(1), jax.random.PRNGKey(0))
+
+
+def run_sync_trace(mod, batch, steps):
+    """Execute a few REAL fused fit steps with the profiler's host-sync
+    tracer installed: every blocking d2h/wait prints its Python stack to
+    stderr as it happens (who synced, from where), then the aggregate
+    counters. An async-loop regression (a stray asnumpy in the hot path)
+    shows up as d2h lines per step instead of none."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.io import DataBatch
+
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.randn(batch, 3, 224, 224).astype(np.float32),
+                       ctx=mx.context.current_context())
+    label = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32),
+                        ctx=mx.context.current_context())
+    b = DataBatch(data=[data], label=[label])
+    mod._fit_step(b)  # compile outside the traced window
+    profiler.reset_sync_counters()
+    prev = profiler.set_sync_trace(True)
+    try:
+        for _ in range(steps):
+            mod._fit_step(b)
+        # one deliberate read — the epoch-boundary-style sync, for contrast
+        print("[sync-trace] reading a parameter (expected d2h):",
+              flush=True)
+        mod._exec.arg_dict[mod._param_names[0]].asnumpy()
+    finally:
+        profiler.set_sync_trace(prev)
+    print("\n== host-sync counters over %d dispatched steps ==" % steps)
+    for k, v in profiler.sync_counters().items():
+        print("  %-12s %s" % (k, v))
 
 
 # the counters live in mxnet_tpu.hlo_stats so regression tests
@@ -83,6 +119,12 @@ def main():
     ap.add_argument("--on-chip", action="store_true",
                     help="compile on the device: memory_analysis + "
                          "donation aliases + post-opt HLO counts")
+    ap.add_argument("--sync-trace", action="store_true",
+                    help="run a few real fit steps with the host-sync "
+                         "tracer on: every blocking d2h/wait prints a "
+                         "Python stack, then the aggregate counters")
+    ap.add_argument("--steps", type=int, default=4,
+                    help="steps to run under --sync-trace")
     args = ap.parse_args()
 
     import jax
@@ -94,6 +136,9 @@ def main():
              os.environ.get("MXNET_CONV_LAYOUT", "NCHW")), flush=True)
 
     mod = build_fused(args.batch)
+    if args.sync_trace:
+        run_sync_trace(mod, args.batch, args.steps)
+        return
     lowered = lower_step(mod)
     text = lowered.as_text()
     print("\n== pre-optimization StableHLO (exact benched program) ==")
