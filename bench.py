@@ -136,6 +136,85 @@ def _secondary_legs(out, on_tpu):
         out["kvstore_dist_push_pull_us"] = _dist_kv_us()
     except Exception as e:
         out["kvstore_dist_push_pull_us"] = "failed: %s" % e
+    # online-serving leg: dynamic-batch ResNet-50 artifact driven by the
+    # closed-loop loadgen through mxnet_tpu.serve (BENCH_SERVING=0 skips)
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            out["serving"] = _serving_leg(on_tpu)
+        except Exception as e:
+            out["serving"] = "failed: %s" % e
+
+
+def _serving_leg(on_tpu):
+    """ResNet-50 through the online serving runtime: export ONE
+    dynamic-batch artifact, then for each batch bucket run a dedicated
+    single-bucket server under the closed-loop load generator
+    (tools/serve_loadgen.py, concurrency = bucket) and report p50/p99
+    latency, goodput and padding-waste. Buckets {1, 8, 32} on the chip;
+    a shrunken smoke (64x64 input, buckets {1, 8}) on CPU rounds so the
+    serving path itself is regression-tracked every round."""
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.serve import Server
+    from tools.serve_loadgen import measure
+
+    side = 224 if on_tpu else 64
+    classes = 1000 if on_tpu else 10
+    buckets = (1, 8, 32) if on_tpu else (1, 8)
+    reqs_per_bucket = 8 if on_tpu else 4
+
+    sym = models.resnet_symbol(num_classes=classes, num_layers=50,
+                               image_shape="3,%d,%d" % (side, side))
+    shapes, _, aux_shapes = sym.infer_shape(data=(2, 3, side, side))
+    rng = np.random.RandomState(0)
+    args = {n: mx.nd.array(rng.uniform(-0.05, 0.05, s).astype("f4"))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    aux = {n: mx.nd.array(np.ones(s, "f4") if "var" in n
+                          else np.zeros(s, "f4"))
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    art = tempfile.mktemp(suffix=".mxtpu")
+    t0 = time.perf_counter()
+    mx.serving.export_compiled(sym, args, aux,
+                               {"data": (None, 3, side, side)}, art)
+    leg = {"platform": "tpu" if on_tpu else "cpu_smoke",
+           "model": "resnet50_%dx%d" % (side, side),
+           "export_s": round(time.perf_counter() - t0, 2),
+           "artifact_mb": round(os.path.getsize(art) / 1e6, 1),
+           "buckets": {}}
+    try:
+        for b in buckets:
+            srv = Server(art, buckets=(b,), batch_timeout_ms=2)
+            t1 = time.perf_counter()
+            # pre-build the bucket engine: compile+warmup must not
+            # pollute the latency percentiles (one-time cost, reported
+            # separately)
+            srv.model.engine_cache.engine(b)
+            compile_s = time.perf_counter() - t1
+            res = measure(srv, concurrency=b,
+                          requests=reqs_per_bucket * b,
+                          timeout_ms=600000)
+            snap = srv.metrics()["buckets"].get(str(b), {})
+            srv.close(drain=True)
+            leg["buckets"][str(b)] = {
+                "p50_ms": round(res["latency_ms"]["p50"], 2),
+                "p99_ms": round(res["latency_ms"]["p99"], 2),
+                "goodput_qps": res["goodput_qps"],
+                "padding_waste": snap.get("padding_waste"),
+                "occupancy": snap.get("occupancy"),
+                "batches": snap.get("batches"),
+                "engine_compile_s": round(compile_s, 2),
+                "completed": res["completed"],
+                "errors": res["errors"],
+            }
+    finally:
+        try:
+            os.unlink(art)
+        except OSError:
+            pass
+    return leg
 
 
 def _make_rec(n_images, side, path="/tmp/mxtpu_bench_%d_%d.rec"):

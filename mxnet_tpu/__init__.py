@@ -127,6 +127,7 @@ def __getattr__(name):
         "visualization": ".visualization",
         "viz": ".visualization",
         "serving": ".serving",
+        "serve": ".serve",
         "contrib": ".contrib",
     }
     if name in lazy:

@@ -276,6 +276,40 @@ register_flag("device_metrics", "MXNET_DEVICE_METRICS", _parse_bool, True,
               "accumulators, transferring to host only at display/epoch "
               "boundaries. Off: per-batch host update (reference "
               "semantics, one device->host sync per batch).")
+register_flag("serve_buckets", "MXNET_SERVE_BUCKETS", str, "1,2,4,8,16,32",
+              "Batch-size buckets the online serving runtime "
+              "(mxnet_tpu.serve) pads coalesced request batches to, comma "
+              "separated ascending. Each bucket lazily compiles one "
+              "executable from the artifact (the TensorRT optimization-"
+              "profile analog). Only consulted for dynamic-batch "
+              "artifacts; fixed-batch artifacts serve at their frozen "
+              "batch size.")
+register_flag("serve_batch_timeout_ms", "MXNET_SERVE_BATCH_TIMEOUT_MS",
+              float, 2.0,
+              "Micro-batching window: after the first queued request, "
+              "wait up to this long for more requests to coalesce before "
+              "dispatching a (possibly padded) device batch. 0 = dispatch "
+              "immediately (latency-optimal, throughput-poor).")
+register_flag("serve_queue_depth", "MXNET_SERVE_QUEUE_DEPTH", int, 256,
+              "Admission-control bound: max requests queued ahead of the "
+              "micro-batcher. A submit beyond this is rejected "
+              "immediately with a retry-after hint (HTTP 429) instead of "
+              "queueing into a timeout storm. 0/negative = unbounded.")
+register_flag("serve_timeout_ms", "MXNET_SERVE_TIMEOUT_MS", float, 1000.0,
+              "Default per-request deadline. A request still queued when "
+              "its deadline passes is expired (never dispatched); the "
+              "caller gets DeadlineExceeded (HTTP 504). 0 = no deadline.")
+register_flag("serve_cache_engines", "MXNET_SERVE_CACHE_ENGINES", int, 8,
+              "LRU capacity of the per-bucket executable cache: at most "
+              "this many bucket engines stay resident per server. "
+              "0/negative = unbounded.")
+register_flag("serve_warmup", "MXNET_SERVE_WARMUP", _parse_bool, True,
+              "Run one zero-batch through every freshly compiled bucket "
+              "engine before it serves traffic, so the first real request "
+              "never pays lazy-initialization cost.")
+register_flag("serve_drain_timeout_s", "MXNET_SERVE_DRAIN_S", float, 30.0,
+              "Graceful-shutdown budget: how long Server.close(drain=True) "
+              "waits for queued requests to finish before giving up.")
 register_flag("test_device", "MXNET_TEST_DEVICE", str, "cpu",
               "Device type test_utils.default_context() returns (cpu|tpu) "
               "— the reference's env-switchable default_context (:53).")

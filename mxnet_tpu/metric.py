@@ -43,11 +43,21 @@ def _as_np(x):
     return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
 
 
+class _HostArray(_np.ndarray):
+    """numpy view that still answers the NDArray host API, so user
+    metrics written against the reference (``preds[0].asnumpy()``) keep
+    working after the batched one-sync fetch below."""
+
+    def asnumpy(self):
+        return _np.asarray(self)
+
+
 def _fetch_lists(*array_lists):
     """Move several lists of label/pred arrays to host in ONE
     ``jax.device_get`` of the whole pytree (one blocking device->host
     sync) instead of one ``asnumpy()`` round-trip per array. Host-side
-    values pass through untouched. Returns the lists as numpy arrays."""
+    values pass through untouched. Returns the lists as numpy arrays
+    (``asnumpy()``-compatible views)."""
     devs = [[x._data if isinstance(x, NDArray) else x for x in lst]
             for lst in array_lists]
     pending = [d for lst in devs for d in lst
@@ -58,7 +68,7 @@ def _fetch_lists(*array_lists):
             "d2h", sum(int(getattr(d, "nbytes", 0)) for d in pending))
         import jax
         devs = jax.device_get(devs)
-    return [[_np.asarray(x) for x in lst] for lst in devs]
+    return [[_np.asarray(x).view(_HostArray) for x in lst] for lst in devs]
 
 
 class EvalMetric:

@@ -20,7 +20,7 @@ __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "dump", "dumps", "pause", "resume",
            "Task", "Frame", "Event", "Counter", "Marker",
            "record_host_sync", "sync_counters", "reset_sync_counters",
-           "set_sync_trace"]
+           "set_sync_trace", "record_counter"]
 
 _lock = threading.Lock()
 
@@ -204,6 +204,16 @@ def set_sync_trace(trace=None):
     prev = _sync_trace
     _sync_trace = trace
     return prev
+
+
+def record_counter(name, value):
+    """Stateless chrome-trace counter sample (ph='C') — a gauge track on
+    the trace timeline. Used by the serving runtime for queue depth;
+    unlike the stateful :class:`Counter` object, callers that already
+    own the value just stamp it."""
+    with _lock:
+        _state.events.append({"name": name, "ph": "C", "ts": _now_us(),
+                              "pid": 0, "args": {name: value}})
 
 
 class _OpTimer:

@@ -1,0 +1,32 @@
+"""Online serving runtime over ``.mxtpu`` AOT artifacts.
+
+The export side (:mod:`mxnet_tpu.serving`) freezes a model into an
+engine file; this package is the layer that serves traffic from it:
+
+* :class:`Server` — dynamic micro-batcher + admission control over a
+  :class:`~mxnet_tpu.serving.CompiledModel`; in-process ``submit()`` /
+  ``predict()`` API.
+* :mod:`~mxnet_tpu.serve.engine_cache` — shape-bucketed LRU of
+  warmup-compiled executables (one dynamic-batch artifact -> N bucket
+  engines).
+* :mod:`~mxnet_tpu.serve.http` — stdlib HTTP/JSON front end
+  (``tools/serve.py`` CLI).
+* :mod:`~mxnet_tpu.serve.metrics` — per-bucket latency percentiles,
+  occupancy, padding waste; chrome-trace via the profiler.
+
+See docs/serving.md for the operational story.
+"""
+from .admission import (DeadlineExceeded, Request, ServeError, ServerBusy,
+                        ServerClosed)
+from .engine_cache import BucketedEngineCache, pick_bucket
+from .metrics import ServeMetrics, percentile
+from .server import ServeConfig, Server
+
+__all__ = ["Server", "ServeConfig", "Request", "ServeError", "ServerBusy",
+           "ServerClosed", "DeadlineExceeded", "BucketedEngineCache",
+           "ServeMetrics", "pick_bucket", "percentile", "serve_http"]
+
+
+def serve_http(server, host="127.0.0.1", port=8080, verbose=False):
+    from .http import serve_http as _serve_http
+    return _serve_http(server, host, port, verbose=verbose)

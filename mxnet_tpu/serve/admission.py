@@ -1,0 +1,179 @@
+"""Admission control for the online serving runtime.
+
+The reference framework has no serving queue at all (TensorRT engines
+are driven by whatever the caller does); real deployments die without
+one. This module is the bounded front door: a request either gets a
+seat in the queue or is REJECTED IMMEDIATELY with a retry-after hint
+(the HTTP layer turns that into 429) — queueing unboundedly just moves
+the failure to a timeout storm later. Expiry (per-request deadlines)
+and graceful drain are decided here too, so the micro-batcher never
+wastes a device dispatch on a request whose caller already gave up.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["Request", "AdmissionQueue", "ServeError", "ServerBusy",
+           "ServerClosed", "DeadlineExceeded"]
+
+
+class ServeError(MXNetError):
+    """Base class for serving-runtime errors."""
+
+
+class ServerBusy(ServeError):
+    """Queue full — back off and retry (HTTP 429)."""
+
+    def __init__(self, msg, retry_after=0.05):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class ServerClosed(ServeError):
+    """Server is shut down (or was closed before this request ran)."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before a response was produced."""
+
+
+class Request:
+    """One admitted inference request: input arrays + a completion slot.
+
+    ``result()`` blocks until the micro-batcher completes or fails the
+    request. Requests are immutable after submit; the batcher owns them
+    until completion.
+    """
+
+    __slots__ = ("arrays", "rows", "deadline", "t_submit", "bucket",
+                 "_event", "_result", "_error")
+
+    def __init__(self, arrays, rows, deadline=None):
+        self.arrays = arrays          # tuple of device arrays, one/input
+        self.rows = rows
+        self.deadline = deadline      # absolute time.monotonic(), or None
+        self.t_submit = time.monotonic()
+        self.bucket = None
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Host-side outputs (tuple of np arrays, ``rows`` rows each)."""
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded(
+                "serve: no response within %.3fs (request still queued "
+                "or in flight)" % (timeout or 0.0))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    # batcher-side completion
+    def _complete(self, result):
+        self._result = result
+        self._event.set()
+
+    def _fail(self, exc):
+        self._error = exc
+        self._event.set()
+
+
+class AdmissionQueue:
+    """Bounded FIFO of admitted requests.
+
+    ``depth`` bounds the number of QUEUED requests (in-flight batches are
+    the engine's concern, not the queue's). ``submit`` never blocks: it
+    admits or raises. ``take`` implements the micro-batching window:
+    block for the first request, then keep collecting until ``max_rows``
+    rows are gathered or ``window_s`` elapses — the classic
+    max-batch/max-latency coalescing policy.
+    """
+
+    def __init__(self, depth, retry_after_fn=None):
+        self.depth = int(depth)
+        self._retry_after_fn = retry_after_fn
+        self._q = []
+        self._cond = threading.Condition()
+        self._closed = False
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def pending_count(self):
+        with self._cond:
+            return len(self._q)
+
+    def pending_rows(self):
+        with self._cond:
+            return sum(r.rows for r in self._q)
+
+    def submit(self, req):
+        with self._cond:
+            if self._closed:
+                raise ServerClosed(
+                    "serve: server is shut down; no new requests")
+            if self.depth > 0 and len(self._q) >= self.depth:
+                retry = 0.05
+                if self._retry_after_fn is not None:
+                    try:
+                        retry = max(0.001,
+                                    float(self._retry_after_fn(self)))
+                    except Exception:
+                        pass
+                raise ServerBusy(
+                    "serve: admission queue full (%d queued, depth %d); "
+                    "retry after %.3fs" % (len(self._q), self.depth,
+                                           retry),
+                    retry_after=retry)
+            self._q.append(req)
+            self._cond.notify()
+
+    def take(self, max_rows, window_s, block=True):
+        """Pop up to ``max_rows`` rows worth of requests. Blocks for the
+        first request (unless ``block=False``), then waits up to
+        ``window_s`` for more to coalesce. Returns [] when closed and
+        empty (or immediately when non-blocking and empty)."""
+        with self._cond:
+            while not self._q:
+                if self._closed or not block:
+                    return []
+                self._cond.wait(0.1)
+            batch = []
+            rows = 0
+
+            def _pop_fitting():
+                nonlocal rows
+                while self._q and rows + self._q[0].rows <= max_rows:
+                    r = self._q.pop(0)
+                    rows += r.rows
+                    batch.append(r)
+
+            _pop_fitting()
+            if window_s > 0 and rows < max_rows:
+                t_end = time.monotonic() + window_s
+                while rows < max_rows and not self._closed:
+                    remaining = t_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    _pop_fitting()
+            return batch
+
+    def close(self, drain=True):
+        """Stop admitting. ``drain=True`` leaves queued requests for the
+        batcher to finish; ``drain=False`` evicts and returns them so
+        the caller can fail them (counted as dropped)."""
+        with self._cond:
+            self._closed = True
+            evicted = []
+            if not drain:
+                evicted, self._q = self._q, []
+            self._cond.notify_all()
+            return evicted

@@ -1,0 +1,191 @@
+"""Shape-bucketed executable cache over one AOT artifact.
+
+A ``.mxtpu`` artifact exported with ``dynamic_batch=True`` carries ONE
+StableHLO module with a symbolic batch dim; every concrete batch size
+still needs its own XLA executable. This cache is the TensorRT
+"optimization profile" analog for that: a small set of batch BUCKETS,
+each backed by a lazily built, warmup-compiled ``jax.jit(...).lower()
+.compile()`` executable, held in an LRU so a long-lived server does not
+accumulate one engine per shape it ever saw. Fixed-batch (v1) artifacts
+degrade gracefully: their only legal bucket is the frozen batch size.
+
+Engines run entirely on device — padding, execution and the
+slice-back-to-real-rows all stay device-resident so the caller (the
+micro-batcher) can do its single d2h per response batch (the PR 3
+host-sync discipline).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..config import flags
+
+__all__ = ["BucketedEngineCache", "check_buckets", "pick_bucket"]
+
+
+def parse_buckets(spec):
+    """'1,8,32' -> sorted unique positive ints."""
+    if isinstance(spec, str):
+        spec = [s for s in spec.replace(";", ",").split(",") if s.strip()]
+    out = sorted({int(b) for b in spec})
+    if not out or out[0] < 1:
+        raise MXNetError("serve: buckets must be positive ints, got %r"
+                         % (spec,))
+    return tuple(out)
+
+
+def check_buckets(buckets, model):
+    """Validate a bucket set against an artifact; None -> the default set
+    (MXNET_SERVE_BUCKETS for dynamic artifacts, the frozen batch for
+    fixed ones)."""
+    frozen = None
+    shape = model.meta["inputs"][0]["shape"]
+    if not model.dynamic_batch and shape:
+        frozen = shape[0]
+    if buckets is None:
+        if frozen is not None:
+            return (int(frozen),)
+        return parse_buckets(flags.serve_buckets)
+    buckets = parse_buckets(buckets)
+    if frozen is not None and tuple(buckets) != (int(frozen),):
+        raise MXNetError(
+            "serve: artifact has a FIXED batch size %d (exported without "
+            "dynamic_batch=True); the only legal bucket set is (%d,), got "
+            "%s. Re-export with dynamic_batch=True for multi-bucket "
+            "serving." % (frozen, frozen, list(buckets)))
+    return buckets
+
+
+def pick_bucket(buckets, rows):
+    """Smallest bucket >= rows, or None when rows exceeds every bucket."""
+    for b in buckets:
+        if rows <= b:
+            return b
+    return None
+
+
+class _Engine:
+    __slots__ = ("bucket", "compiled", "compile_ms", "warmup_ms", "calls",
+                 "rows", "padded_rows")
+
+    def __init__(self, bucket, compiled, compile_ms, warmup_ms):
+        self.bucket = bucket
+        self.compiled = compiled
+        self.compile_ms = compile_ms
+        self.warmup_ms = warmup_ms
+        self.calls = 0
+        self.rows = 0
+        self.padded_rows = 0
+
+
+class BucketedEngineCache:
+    """LRU of per-bucket executables over one loaded artifact."""
+
+    def __init__(self, model, capacity=None, warmup=None):
+        self._model = model
+        self._exp = model._exp
+        self._specs = model.meta["inputs"]
+        self.capacity = (flags.serve_cache_engines if capacity is None
+                         else int(capacity))
+        self.warmup = flags.serve_warmup if warmup is None else bool(warmup)
+        self._engines = OrderedDict()   # bucket -> _Engine, LRU order
+        self._lock = threading.Lock()
+        self.builds = 0
+        self.evictions = 0
+
+    def _build(self, bucket):
+        frozen = (None if self._model.dynamic_batch
+                  else self._specs[0]["shape"][0])
+        if frozen is not None and bucket != frozen:
+            raise MXNetError(
+                "serve: bucket %d on a fixed-batch-%d artifact"
+                % (bucket, frozen))
+        in_specs = [jax.ShapeDtypeStruct((bucket,) + tuple(s["shape"][1:]),
+                                         _np.dtype(s["dtype"]))
+                    for s in self._specs]
+        t0 = time.perf_counter()
+        compiled = jax.jit(self._exp.call).lower(*in_specs).compile()
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        warmup_ms = 0.0
+        if self.warmup:
+            t1 = time.perf_counter()
+            zeros = [jnp.zeros(s.shape, s.dtype) for s in in_specs]
+            for o in compiled(*zeros):
+                if hasattr(o, "block_until_ready"):
+                    o.block_until_ready()
+            warmup_ms = (time.perf_counter() - t1) * 1e3
+        self.builds += 1
+        return _Engine(bucket, compiled, compile_ms, warmup_ms)
+
+    def engine(self, bucket):
+        """Fetch (building lazily) the executable for one bucket."""
+        with self._lock:
+            eng = self._engines.get(bucket)
+            if eng is not None:
+                self._engines.move_to_end(bucket)
+                return eng
+        # build outside the lock: XLA compiles can take seconds and other
+        # buckets' traffic must not stall behind them
+        eng = self._build(bucket)
+        with self._lock:
+            cur = self._engines.get(bucket)
+            if cur is not None:          # lost a build race: keep the first
+                self._engines.move_to_end(bucket)
+                return cur
+            self._engines[bucket] = eng
+            while self.capacity > 0 and len(self._engines) > self.capacity:
+                self._engines.popitem(last=False)
+                self.evictions += 1
+            return eng
+
+    def run(self, bucket, arrs, rows):
+        """Pad ``arrs`` (one per input, ``rows`` real rows each) to
+        ``bucket``, execute, slice back to the real rows. Everything
+        stays on device; no host sync."""
+        eng = self.engine(bucket)
+        pad = bucket - rows
+        if pad:
+            arrs = [jnp.concatenate(
+                        [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+                    for a in arrs]
+        outs = eng.compiled(*arrs)
+        with self._lock:
+            eng.calls += 1
+            eng.rows += rows
+            eng.padded_rows += pad
+        if pad:
+            outs = tuple(o[:rows] if hasattr(o, "ndim") and o.ndim
+                         else o for o in outs)
+        return tuple(outs)
+
+    def run_padded(self, buckets, arrs, rows):
+        bucket = pick_bucket(buckets, rows)
+        if bucket is None:
+            raise MXNetError(
+                "serve: batch of %d rows exceeds the largest bucket %d"
+                % (rows, buckets[-1]))
+        return self.run(bucket, arrs, rows)
+
+    def stats(self):
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "builds": self.builds,
+                "evictions": self.evictions,
+                "engines": {
+                    str(e.bucket): {
+                        "compile_ms": round(e.compile_ms, 3),
+                        "warmup_ms": round(e.warmup_ms, 3),
+                        "calls": e.calls,
+                        "rows": e.rows,
+                        "padded_rows": e.padded_rows,
+                    } for e in self._engines.values()},
+            }

@@ -1,0 +1,146 @@
+"""Thin HTTP/JSON front end over :class:`mxnet_tpu.serve.Server`.
+
+Deliberately stdlib-only (http.server) — the serving runtime must not
+drag a web framework into the deployment image. One request thread per
+connection (ThreadingHTTPServer) feeding the in-process admission
+queue; the micro-batcher coalesces across connections.
+
+Protocol:
+  POST /v1/predict   {"inputs": {name: nested-list}, "timeout_ms": opt}
+                  -> {"outputs": [...], "latency_ms": f, "bucket": b}
+  GET  /metrics      -> the Server.metrics() snapshot (JSON)
+  GET  /healthz      -> {"status": "ok"|"draining"|"closed"}
+
+Errors: 400 bad input, 429 queue full (with Retry-After), 503 closed,
+504 deadline exceeded, 500 execution failure.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as _np
+
+from ..base import MXNetError
+from .admission import DeadlineExceeded, ServerBusy, ServerClosed
+
+__all__ = ["serve_http", "HttpFrontEnd"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # quiet by default
+        if getattr(self.server, "verbose", False):
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+    def _reply(self, code, payload, headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv = self.server.mx_server
+        if self.path == "/metrics":
+            self._reply(200, srv.metrics())
+        elif self.path == "/healthz":
+            status = ("closed" if srv.closed
+                      else "draining" if srv.draining else "ok")
+            self._reply(200 if status == "ok" else 503,
+                        {"status": status})
+        else:
+            self._reply(404, {"error": "no such endpoint %r" % self.path})
+
+    def do_POST(self):
+        srv = self.server.mx_server
+        if self.path not in ("/v1/predict", "/predict"):
+            self._reply(404, {"error": "no such endpoint %r" % self.path})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n).decode() or "{}")
+            inputs = payload.get("inputs")
+            if not isinstance(inputs, dict):
+                raise MXNetError('body must be {"inputs": {name: array}}')
+            dtypes = {i["name"]: i["dtype"]
+                      for i in srv.model.meta["inputs"]}
+            kw = {}
+            for name, v in inputs.items():
+                kw[name] = _np.asarray(v, dtype=dtypes.get(name, "float32"))
+            req = srv.submit(timeout_ms=payload.get("timeout_ms"), **kw)
+        except ServerBusy as e:
+            self._reply(429, {"error": str(e),
+                              "retry_after_s": e.retry_after},
+                        {"Retry-After": "%.3f" % e.retry_after})
+            return
+        except ServerClosed as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except (MXNetError, ValueError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        import time
+        t0 = time.monotonic()
+        try:
+            budget = (None if req.deadline is None
+                      else max(0.001, req.deadline - t0) + 1.0)
+            outs = req.result(timeout=budget)
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except ServerClosed as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except MXNetError as e:
+            self._reply(500, {"error": str(e)})
+            return
+        self._reply(200, {"outputs": [o.tolist() for o in outs],
+                          "latency_ms": round(
+                              (time.monotonic() - req.t_submit) * 1e3, 3),
+                          "bucket": req.bucket})
+
+
+class HttpFrontEnd:
+    """Owns the ThreadingHTTPServer + its accept thread."""
+
+    def __init__(self, server, host="127.0.0.1", port=8080, verbose=False):
+        self.mx_server = server
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.mx_server = server
+        self.httpd.verbose = verbose
+        self.httpd.daemon_threads = True
+        self._thread = None
+
+    @property
+    def address(self):
+        h, p = self.httpd.server_address[:2]
+        return "http://%s:%d" % (h, p)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="mxtpu-serve-http",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop accepting connections, then gracefully drain the model
+        server (every admitted request finishes)."""
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        if not self.mx_server.closed:
+            self.mx_server.close(drain=drain)
+
+
+def serve_http(server, host="127.0.0.1", port=8080, verbose=False):
+    """Start an HTTP front end for ``server``; returns the running
+    :class:`HttpFrontEnd` (``.stop()`` to shut down)."""
+    return HttpFrontEnd(server, host, port, verbose=verbose).start()

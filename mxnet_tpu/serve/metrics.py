@@ -1,0 +1,183 @@
+"""Serving observability, wired into the existing profiler.
+
+Per-bucket latency percentiles (p50/p95/p99), queue depth, batch
+occupancy, padding-waste ratio and rejection counts — the numbers that
+tell an operator whether the bucket set and batching window are right.
+Two faces:
+
+* ``snapshot()`` — a JSON-able dict, the ``/metrics`` endpoint body and
+  the ``bench.py`` serving leg's raw material;
+* chrome-trace events through :mod:`mxnet_tpu.profiler` when profiling
+  is active: one ``serve/bucket{B}`` duration event per device batch and
+  a ``serve/queue_depth`` counter track, so serving shows up on the same
+  timeline as everything else the profiler sees.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from .. import profiler
+
+__all__ = ["ServeMetrics", "percentile"]
+
+_SAMPLE_CAP = 8192   # bounded reservoir per series (latest wins)
+
+
+def percentile(samples, p):
+    """Linear-interpolated percentile of an unsorted sample list."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    if len(s) == 1:
+        return s[0]
+    rank = (p / 100.0) * (len(s) - 1)
+    lo = int(rank)
+    frac = rank - lo
+    if lo + 1 >= len(s):
+        return s[-1]
+    return s[lo] * (1.0 - frac) + s[lo + 1] * frac
+
+
+class _BucketStats:
+    __slots__ = ("batches", "rows", "padded_rows", "latency_ms", "exec_ms")
+
+    def __init__(self):
+        self.batches = 0
+        self.rows = 0
+        self.padded_rows = 0
+        self.latency_ms = deque(maxlen=_SAMPLE_CAP)
+        self.exec_ms = deque(maxlen=_SAMPLE_CAP)
+
+
+class ServeMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets = {}           # bucket -> _BucketStats
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.expired = 0
+        self.dropped = 0             # failed by a non-drain shutdown
+        self.errors = 0              # batch execution failures
+        self.queue_depth = 0
+        self.queue_peak = 0
+        self._exec_s_total = 0.0
+        self._rows_total = 0
+        self._t_start = time.monotonic()
+
+    def _bucket(self, bucket):
+        st = self._buckets.get(bucket)
+        if st is None:
+            st = self._buckets[bucket] = _BucketStats()
+        return st
+
+    # -- event hooks --------------------------------------------------------
+    def note_submit(self, rows=1):
+        with self._lock:
+            self.submitted += 1
+
+    def note_reject(self):
+        with self._lock:
+            self.rejected += 1
+
+    def note_expire(self, n=1):
+        with self._lock:
+            self.expired += n
+
+    def note_drop(self, n=1):
+        with self._lock:
+            self.dropped += n
+
+    def note_error(self, n=1):
+        with self._lock:
+            self.errors += n
+
+    def note_batch(self, bucket, rows, padded, exec_ms):
+        with self._lock:
+            st = self._bucket(bucket)
+            st.batches += 1
+            st.rows += rows
+            st.padded_rows += padded
+            st.exec_ms.append(exec_ms)
+            self._exec_s_total += exec_ms / 1e3
+            self._rows_total += rows
+        if profiler.is_active("serve"):
+            now = profiler._now_us()
+            profiler.record_event("serve/bucket%d" % bucket, "serve",
+                                  now - exec_ms * 1e3, exec_ms * 1e3)
+
+    def note_request_done(self, bucket, latency_ms):
+        with self._lock:
+            self.completed += 1
+            self._bucket(bucket).latency_ms.append(latency_ms)
+
+    def set_queue_depth(self, depth):
+        with self._lock:
+            self.queue_depth = depth
+            self.queue_peak = max(self.queue_peak, depth)
+        if profiler.is_active("serve"):
+            profiler.record_counter("serve/queue_depth", depth)
+
+    # -- derived ------------------------------------------------------------
+    def throughput_rows_per_s(self):
+        """Recent device throughput; used for retry-after estimates."""
+        with self._lock:
+            if self._exec_s_total <= 0:
+                return 0.0
+            return self._rows_total / self._exec_s_total
+
+    def estimate_drain_s(self, pending_rows):
+        rate = self.throughput_rows_per_s()
+        if rate <= 0:
+            return 0.05
+        return max(0.005, pending_rows / rate)
+
+    def snapshot(self, engine_stats=None):
+        with self._lock:
+            buckets = {}
+            for b, st in sorted(self._buckets.items()):
+                total = st.rows + st.padded_rows
+                lat = list(st.latency_ms)
+                ex = list(st.exec_ms)
+                buckets[str(b)] = {
+                    "batches": st.batches,
+                    "rows": st.rows,
+                    "padded_rows": st.padded_rows,
+                    "occupancy": round(st.rows / total, 4) if total else None,
+                    "padding_waste": (round(st.padded_rows / total, 4)
+                                      if total else None),
+                    "latency_ms": {
+                        "count": len(lat),
+                        "p50": percentile(lat, 50),
+                        "p95": percentile(lat, 95),
+                        "p99": percentile(lat, 99),
+                        "mean": (sum(lat) / len(lat)) if lat else None,
+                    },
+                    "exec_ms": {
+                        "count": len(ex),
+                        "p50": percentile(ex, 50),
+                        "p99": percentile(ex, 99),
+                    },
+                }
+            out = {
+                "uptime_s": round(time.monotonic() - self._t_start, 3),
+                "requests": {
+                    "submitted": self.submitted,
+                    "completed": self.completed,
+                    "rejected": self.rejected,
+                    "expired": self.expired,
+                    "dropped": self.dropped,
+                    "errors": self.errors,
+                },
+                "queue": {"depth": self.queue_depth,
+                          "peak": self.queue_peak},
+                "throughput_rows_per_s": round(
+                    self._rows_total / self._exec_s_total, 2)
+                    if self._exec_s_total > 0 else None,
+                "buckets": buckets,
+            }
+        if engine_stats is not None:
+            out["engines"] = engine_stats
+        return out

@@ -1,0 +1,301 @@
+"""Online inference server over one AOT artifact.
+
+The paper's deployment story ends at an engine file; this is the piece
+that turns one into a service: a dynamic MICRO-BATCHER coalesces
+concurrent single requests into padded device batches under a
+max-batch/max-latency policy (TVM/TensorRT serving practice: AOT
+engines only pay off when a runtime amortizes them across callers),
+admission control bounds the queue and rejects early, and a graceful
+drain finishes every admitted request on shutdown.
+
+Host-sync discipline (PR 3): the request path performs exactly ONE
+device->host transfer per response batch — padding, execution and the
+slice back to real rows all happen on device; the single
+``jax.device_get`` of the sliced outputs is counted via
+``profiler.record_host_sync("d2h")``.
+
+In-process use (tests, bench, embedding in an existing event loop)::
+
+    server = Server("model.mxtpu", buckets=(1, 8, 32))
+    pending = server.submit(data=x)        # never blocks; may raise
+    out = pending.result(timeout=1.0)      # tuple of np arrays
+    server.close(drain=True)
+
+``tools/serve.py`` wraps this in the HTTP/JSON front end
+(:mod:`mxnet_tpu.serve.http`).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as _np
+
+import jax
+
+from ..base import MXNetError
+from ..config import flags
+from .. import profiler
+from ..serving import CompiledModel
+from .admission import (AdmissionQueue, DeadlineExceeded, Request,
+                        ServerClosed)
+from .engine_cache import check_buckets, pick_bucket
+from .metrics import ServeMetrics
+
+__all__ = ["Server", "ServeConfig"]
+
+
+class ServeConfig:
+    """Serving knobs; every default comes from the MXNET_SERVE_* flags."""
+
+    def __init__(self, buckets=None, batch_timeout_ms=None,
+                 queue_depth=None, timeout_ms=None, cache_engines=None,
+                 warmup=None, drain_timeout_s=None):
+        self.buckets = buckets    # None -> artifact-appropriate default
+        self.batch_timeout_ms = (flags.serve_batch_timeout_ms
+                                 if batch_timeout_ms is None
+                                 else float(batch_timeout_ms))
+        self.queue_depth = (flags.serve_queue_depth if queue_depth is None
+                            else int(queue_depth))
+        self.timeout_ms = (flags.serve_timeout_ms if timeout_ms is None
+                           else float(timeout_ms))
+        self.cache_engines = cache_engines
+        self.warmup = warmup
+        self.drain_timeout_s = (flags.serve_drain_timeout_s
+                                if drain_timeout_s is None
+                                else float(drain_timeout_s))
+
+
+class Server:
+    """Dynamic micro-batching server over a :class:`CompiledModel`.
+
+    ``model`` is a loaded CompiledModel or an artifact path.
+    ``auto_start=False`` leaves the batcher thread unstarted — requests
+    queue until the test/driver calls :meth:`run_once` (deterministic
+    coalescing for tests) or :meth:`start`.
+    """
+
+    def __init__(self, model, config=None, auto_start=True, **overrides):
+        if config is None:
+            config = ServeConfig(**overrides)
+        elif overrides:
+            raise MXNetError("Server: pass either config or kwargs, "
+                             "not both")
+        if not isinstance(model, CompiledModel):
+            model = CompiledModel.load(model)
+        self.model = model
+        self.config = config
+        self.buckets = check_buckets(config.buckets, model)
+        if (model.engine_cache is None
+                or model.buckets != self.buckets):
+            model.set_buckets(self.buckets,
+                              cache_engines=config.cache_engines,
+                              warmup=config.warmup)
+        self._cache = model.engine_cache
+        self.metrics_ = ServeMetrics()
+        self._queue = AdmissionQueue(
+            config.queue_depth,
+            retry_after_fn=lambda q: self.metrics_.estimate_drain_s(
+                q.pending_rows() if hasattr(q, "pending_rows") else 0))
+        self._thread = None
+        self._closing = False
+        self._closed = threading.Event()
+        if auto_start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop,
+                                            name="mxtpu-serve-batcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    @property
+    def draining(self):
+        return self._queue.closed and not self._closed.is_set()
+
+    @property
+    def closed(self):
+        return self._closed.is_set()
+
+    def close(self, drain=True, timeout=None):
+        """Shut down. ``drain=True`` (graceful): stop admitting, finish
+        every queued request, then return. ``drain=False``: evict queued
+        requests, failing them with ServerClosed (counted as dropped)."""
+        self._closing = True
+        evicted = self._queue.close(drain=drain)
+        for r in evicted:
+            r._fail(ServerClosed("serve: server closed before this "
+                                 "request was dispatched"))
+        if evicted:
+            self.metrics_.note_drop(len(evicted))
+        if drain:
+            budget = (self.config.drain_timeout_s if timeout is None
+                      else timeout)
+            if self._thread is not None and self._thread.is_alive():
+                self._thread.join(budget)
+                if self._thread.is_alive():
+                    raise MXNetError(
+                        "serve: drain did not finish within %.1fs (%d "
+                        "requests still queued)"
+                        % (budget, self._queue.pending_count()))
+            else:
+                # no batcher thread (auto_start=False): drain inline
+                t_end = time.monotonic() + budget
+                while self._queue.pending_count():
+                    if time.monotonic() > t_end:
+                        raise MXNetError(
+                            "serve: inline drain did not finish within "
+                            "%.1fs" % budget)
+                    self.run_once(block=False)
+        self._closed.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if not self.closed:
+            self.close(drain=True)
+
+    # -- request path -------------------------------------------------------
+    def _prepare(self, data, kwdata):
+        if data and kwdata:
+            raise MXNetError("Server.submit: pass inputs positionally or "
+                             "by name, not both")
+        if kwdata:
+            names = self.model.input_names
+            extra = sorted(set(kwdata) - set(names))
+            missing = sorted(set(names) - set(kwdata))
+            if extra or missing:
+                raise MXNetError(
+                    "Server.submit: artifact inputs are %s%s%s"
+                    % (names,
+                       ("; missing %s" % missing) if missing else "",
+                       ("; unexpected %s" % extra) if extra else ""))
+            data = [kwdata[n] for n in names]
+        arrs = self.model._check_inputs(list(data))
+        rows = int(arrs[0].shape[0]) if arrs[0].ndim else 1
+        if rows > self.buckets[-1]:
+            raise MXNetError(
+                "Server.submit: request batch of %d rows exceeds the "
+                "largest bucket %d; split the request or serve with "
+                "larger buckets" % (rows, self.buckets[-1]))
+        return arrs, rows
+
+    def submit(self, *data, timeout_ms=None, **kwdata):
+        """Admit one request; never blocks. Returns a :class:`Request`
+        whose ``.result()`` blocks for the response. Raises ServerBusy
+        (queue full), ServerClosed, or MXNetError (validation)."""
+        arrs, rows = self._prepare(data, kwdata)
+        if timeout_ms is None:
+            timeout_ms = self.config.timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms and timeout_ms > 0 else None)
+        req = Request(tuple(arrs), rows, deadline)
+        try:
+            self._queue.submit(req)
+        except ServerClosed:
+            raise
+        except Exception:
+            self.metrics_.note_reject()
+            raise
+        # counted only when ADMITTED, so completed+expired == submitted
+        # is a per-server drain invariant (the soak test's zero-dropped
+        # check)
+        self.metrics_.note_submit(rows)
+        self.metrics_.set_queue_depth(self._queue.pending_count())
+        return req
+
+    def predict(self, *data, timeout_ms=None, **kwdata):
+        """Blocking convenience: submit + result."""
+        req = self.submit(*data, timeout_ms=timeout_ms, **kwdata)
+        budget = (None if req.deadline is None
+                  else max(0.001, req.deadline - time.monotonic()) + 1.0)
+        return req.result(timeout=budget)
+
+    # -- batcher ------------------------------------------------------------
+    def run_once(self, block=True):
+        """One coalescing round: take a window's worth of requests, drop
+        the expired, dispatch one padded bucket batch, distribute the
+        results. Returns the number of requests taken (0 = nothing to
+        do). Public so tests and auto_start=False drivers can step the
+        batcher deterministically."""
+        reqs = self._queue.take(self.buckets[-1],
+                                self.config.batch_timeout_ms / 1e3,
+                                block=block)
+        self.metrics_.set_queue_depth(self._queue.pending_count())
+        if not reqs:
+            return 0
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                self.metrics_.note_expire()
+                r._fail(DeadlineExceeded(
+                    "serve: deadline passed %.1fms before dispatch"
+                    % ((now - r.deadline) * 1e3)))
+            else:
+                live.append(r)
+        if not live:
+            return len(reqs)
+        rows = sum(r.rows for r in live)
+        bucket = pick_bucket(self.buckets, rows)
+        # take() caps at the largest bucket, so bucket is never None
+        try:
+            import jax.numpy as jnp
+            if len(live) == 1:
+                stacked = list(live[0].arrays)
+            else:
+                stacked = [jnp.concatenate([r.arrays[i] for r in live])
+                           for i in range(len(self.model.input_names))]
+            t0 = time.perf_counter()
+            outs = self._cache.run(bucket, stacked, rows)
+            # ONE d2h for the whole response batch (PR 3 discipline)
+            host = jax.device_get(outs)
+            exec_ms = (time.perf_counter() - t0) * 1e3
+        except Exception as e:
+            self.metrics_.note_error(len(live))
+            err = e if isinstance(e, MXNetError) else MXNetError(str(e))
+            for r in live:
+                r._fail(err)
+            return len(reqs)
+        nbytes = sum(getattr(h, "nbytes", 0) for h in host)
+        profiler.record_host_sync("d2h", nbytes)
+        self.metrics_.note_batch(bucket, rows, bucket - rows, exec_ms)
+        t_done = time.monotonic()
+        off = 0
+        for r in live:
+            r.bucket = bucket
+            r._complete(tuple(_np.asarray(h[off:off + r.rows])
+                              for h in host))
+            off += r.rows
+            self.metrics_.note_request_done(
+                bucket, (t_done - r.t_submit) * 1e3)
+        return len(reqs)
+
+    def _loop(self):
+        while True:
+            try:
+                self.run_once(block=True)
+            except Exception:
+                # a batch failure already failed its requests; a bug in
+                # the loop itself must not silently kill serving
+                if self._queue.closed:
+                    break
+                time.sleep(0.01)
+                continue
+            if self._queue.closed and self._queue.pending_count() == 0:
+                break
+
+    # -- observability ------------------------------------------------------
+    def metrics(self):
+        """JSON-able snapshot: request counters, queue depth, per-bucket
+        latency percentiles / occupancy / padding waste, engine-cache
+        stats. The ``/metrics`` endpoint body."""
+        snap = self.metrics_.snapshot(engine_stats=self._cache.stats())
+        snap["buckets_configured"] = list(self.buckets)
+        snap["status"] = ("closed" if self.closed
+                          else "draining" if self.draining else "ok")
+        return snap

@@ -11,6 +11,12 @@ platform-retargetable at export time (``platforms=["tpu"]`` from a CPU
 build host — the cross-compile TensorRT cannot do) and carries its
 input/output signature as JSON metadata.
 
+Batch dimension: fixed (one TensorRT profile point per artifact, the
+original behavior) or symbolic (``dynamic_batch=True``) — a single
+artifact that any concrete batch size can run. A dynamic artifact is
+what the online serving runtime (:mod:`mxnet_tpu.serve`) builds its
+shape-bucketed executable cache from: one artifact -> N bucket engines.
+
 File layout (.mxtpu): 8-byte magic ``MXTPUAOT``, u32 metadata length,
 metadata JSON, then the serialized StableHLO module.
 
@@ -56,20 +62,35 @@ def _infer_fn(symbol, arg_params, aux_params, data_names):
     return fn
 
 
+def _is_dynamic_dim(d):
+    return d is None or d == -1 or (isinstance(d, str))
+
+
 def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
-                    dtype="float32", platforms=None):
+                    dtype="float32", platforms=None, dynamic_batch=False):
     """Freeze (symbol, params) into an AOT artifact at ``path``.
 
-    data_shapes: dict name -> shape (the batch shape is FIXED, like a
-    TensorRT profile point). platforms: e.g. ["tpu"] to target TPU from a
-    CPU host; default = the current backend.
+    data_shapes: dict name -> shape. With ``dynamic_batch=False`` the
+    batch shape is FIXED, like a TensorRT profile point. With
+    ``dynamic_batch=True`` (or a leading dim of None/-1 in any shape)
+    the batch dim is exported SYMBOLIC — one shared size variable across
+    all inputs — so a single artifact serves any concrete batch size
+    (each size compiles its own executable at load/serve time; see
+    mxnet_tpu.serve). platforms: e.g. ["tpu"] to target TPU from a CPU
+    host; default = the current backend.
     """
     from jax import export as _export
+    data_shapes = {k: tuple(v) for k, v in data_shapes.items()}
+    if any(_is_dynamic_dim(s[0]) for s in data_shapes.values() if s):
+        dynamic_batch = True
     missing = [n for n in symbol.list_arguments()
                if n not in arg_params and n not in data_shapes
                and not n.endswith("label")]
     if missing:
         raise MXNetError("export_compiled: unbound arguments %s" % missing)
+    # concrete shapes for shape inference (probe batch 2 when symbolic)
+    probe_shapes = {k: tuple(2 if _is_dynamic_dim(d) else d for d in v)
+                    for k, v in data_shapes.items()}
     # loss heads keep their label input in the graph; inference ignores the
     # values, so bake zeros of the inferred shape (executor bind does the
     # same for unprovided labels)
@@ -77,8 +98,7 @@ def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
                    if n.endswith("label") and n not in arg_params
                    and n not in data_shapes]
     if label_names:
-        shapes, _, _ = symbol.infer_shape_partial(**{
-            k: tuple(v) for k, v in data_shapes.items()})
+        shapes, _, _ = symbol.infer_shape_partial(**probe_shapes)
         arg_params = dict(arg_params)
         for n, s in zip(symbol.list_arguments(), shapes):
             if n in label_names:
@@ -86,19 +106,30 @@ def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
                                           _np.float32)
     data_names = list(data_shapes)
     fn = _infer_fn(symbol, arg_params, aux_params, data_names)
-    args = [jax.ShapeDtypeStruct(tuple(data_shapes[n]), _np.dtype(dtype))
-            for n in data_names]
+    if dynamic_batch:
+        # ONE size variable shared by every input: requests batch together
+        (b,) = _export.symbolic_shape("b")
+        args = [jax.ShapeDtypeStruct((b,) + probe_shapes[n][1:],
+                                     _np.dtype(dtype))
+                for n in data_names]
+    else:
+        args = [jax.ShapeDtypeStruct(probe_shapes[n], _np.dtype(dtype))
+                for n in data_names]
     kw = {}
     if platforms is not None:
         kw["platforms"] = [p.lower() for p in platforms]
     exp = _export.export(jax.jit(fn), **kw)(*args)
     blob = exp.serialize()
     meta = {
-        "inputs": [{"name": n, "shape": list(data_shapes[n]),
+        "inputs": [{"name": n,
+                    "shape": ([None] + list(probe_shapes[n][1:])
+                              if dynamic_batch
+                              else list(probe_shapes[n])),
                     "dtype": str(_np.dtype(dtype))} for n in data_names],
         "num_outputs": len(symbol._entries),
         "platforms": list(exp.platforms),
-        "format_version": 1,
+        "dynamic_batch": bool(dynamic_batch),
+        "format_version": 2,
     }
     mjson = json.dumps(meta).encode()
     with open(path, "wb") as f:
@@ -109,16 +140,48 @@ def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
     return meta
 
 
-class CompiledModel:
-    """A loaded AOT artifact: call with data arrays, get output arrays."""
+def _platform_ok(backend, platforms):
+    plats = [p.lower() for p in platforms]
+    if backend in plats:
+        return True
+    # jax.default_backend() says 'gpu'; export records 'cuda'/'rocm'
+    if backend == "gpu" and ("cuda" in plats or "rocm" in plats):
+        return True
+    return False
 
-    def __init__(self, exported, meta):
+
+class CompiledModel:
+    """A loaded AOT artifact: call with data arrays, get output arrays.
+
+    ``buckets``: optional ascending batch-size buckets. When set, calls
+    whose batch is not an exact bucket are zero-PADDED up to the nearest
+    bucket and the outputs sliced back — each bucket is served by a
+    lazily built, warmup-compiled executable from a shared LRU cache
+    (mxnet_tpu.serve.engine_cache). This is the single-caller face of
+    the same machinery the online Server batches many callers onto.
+    Requires a dynamic-batch artifact unless the only bucket equals the
+    artifact's frozen batch size.
+    """
+
+    def __init__(self, exported, meta, buckets=None, cache_engines=None,
+                 warmup=None):
         self._exp = exported
         self.meta = meta
         self.input_names = [i["name"] for i in meta["inputs"]]
+        self.dynamic_batch = bool(meta.get("dynamic_batch", False))
+        self._cache = None
+        self.buckets = None
+        if buckets:
+            self.set_buckets(buckets, cache_engines=cache_engines,
+                             warmup=warmup)
 
     @classmethod
-    def load(cls, path):
+    def load(cls, path, buckets=None, allow_platform_mismatch=False,
+             cache_engines=None, warmup=None):
+        """Load an artifact. Fails fast (before touching the StableHLO
+        payload) when the artifact does not target the current jax
+        backend — pass ``allow_platform_mismatch=True`` to load anyway
+        for inspection or to relay the artifact to a matching host."""
         from jax import export as _export
         with open(path, "rb") as f:
             magic = f.read(8)
@@ -127,12 +190,121 @@ class CompiledModel:
             (n,) = struct.unpack("<I", f.read(4))
             meta = json.loads(f.read(n).decode())
             blob = f.read()
-        return cls(_export.deserialize(blob), meta)
+        backend = jax.default_backend().lower()
+        if (not allow_platform_mismatch
+                and not _platform_ok(backend, meta.get("platforms", []))):
+            raise MXNetError(
+                "artifact %r targets platform(s) %s but the current jax "
+                "backend is %r. Either run this process on a matching "
+                "backend, re-export with platforms=[%r] (cross-compile "
+                "works from any build host), or pass "
+                "allow_platform_mismatch=True to load it for inspection "
+                "only (calling it will fail)."
+                % (path, meta.get("platforms", []), backend, backend))
+        return cls(_export.deserialize(blob), meta, buckets=buckets,
+                   cache_engines=cache_engines, warmup=warmup)
 
+    # -- bucketed execution -------------------------------------------------
+    def set_buckets(self, buckets, cache_engines=None, warmup=None):
+        """Enable bucket-padded dispatch (see class docstring)."""
+        from .serve.engine_cache import BucketedEngineCache, check_buckets
+        buckets = check_buckets(buckets, self)
+        self._cache = BucketedEngineCache(self, capacity=cache_engines,
+                                          warmup=warmup)
+        self.buckets = buckets
+        return self
+
+    @property
+    def engine_cache(self):
+        return self._cache
+
+    # -- validation ---------------------------------------------------------
+    def _check_one(self, name, spec, arr):
+        """Validate one input against the artifact signature; returns the
+        (possibly same-kind-cast) array. Batch dim is free for dynamic
+        artifacts; the caller's dispatch path bounds it."""
+        want_dtype = _np.dtype(spec["dtype"])
+        want_shape = spec["shape"]
+        shape = tuple(getattr(arr, "shape", ()) or ())
+        if len(shape) != len(want_shape):
+            raise MXNetError(
+                "CompiledModel: input %r expects rank %d (shape %s), got "
+                "rank %d (shape %s)" % (name, len(want_shape),
+                                        _fmt_shape(want_shape), len(shape),
+                                        tuple(shape)))
+        for axis, (w, g) in enumerate(zip(want_shape, shape)):
+            if axis == 0 and (w is None or self.dynamic_batch
+                              or self.buckets):
+                continue
+            if w != g:
+                raise MXNetError(
+                    "CompiledModel: input %r expects shape %s, got %s "
+                    "(mismatch at axis %d)" % (name, _fmt_shape(want_shape),
+                                               tuple(shape), axis))
+        got_dtype = _np.dtype(getattr(arr, "dtype", _np.float32))
+        if got_dtype != want_dtype:
+            if not _np.can_cast(got_dtype, want_dtype, casting="same_kind"):
+                raise MXNetError(
+                    "CompiledModel: input %r expects dtype %s, got %s "
+                    "(refusing an unsafe cast)" % (name, want_dtype,
+                                                   got_dtype))
+            arr = jnp.asarray(arr).astype(want_dtype)
+        return arr
+
+    def _check_inputs(self, arrs):
+        if len(arrs) != len(self.input_names):
+            raise MXNetError(
+                "CompiledModel: expects %d input(s) %s, got %d"
+                % (len(self.input_names), self.input_names, len(arrs)))
+        out = []
+        batches = []
+        for name, spec, a in zip(self.input_names, self.meta["inputs"],
+                                 arrs):
+            a = a._data if hasattr(a, "_data") else jnp.asarray(a)
+            a = self._check_one(name, spec, a)
+            out.append(a)
+            batches.append(a.shape[0] if a.ndim else 0)
+        if len(set(batches)) > 1:
+            raise MXNetError(
+                "CompiledModel: inconsistent batch sizes across inputs: %s"
+                % dict(zip(self.input_names, batches)))
+        return out
+
+    # -- execution ----------------------------------------------------------
     def __call__(self, *data):
-        arrs = [v._data if hasattr(v, "_data") else jnp.asarray(v)
-                for v in data]
+        arrs = self._check_inputs(data)
+        if self._cache is not None:
+            return self._call_bucketed(arrs)
         return self._exp.call(*arrs)
 
+    def _call_bucketed(self, arrs):
+        rows = int(arrs[0].shape[0])
+        top = self.buckets[-1]
+        if rows <= top:
+            return self._cache.run_padded(self.buckets, arrs, rows)
+        # larger than the biggest bucket: chunk through it
+        outs = None
+        for lo in range(0, rows, top):
+            part = [a[lo:lo + top] for a in arrs]
+            res = self._cache.run_padded(self.buckets, part,
+                                         int(part[0].shape[0]))
+            outs = (list(res) if outs is None
+                    else [jnp.concatenate([o, r]) for o, r in
+                          zip(outs, res)])
+        return tuple(outs)
+
     def predict(self, **data):
+        extra = sorted(set(data) - set(self.input_names))
+        missing = sorted(set(self.input_names) - set(data))
+        if extra or missing:
+            raise MXNetError(
+                "CompiledModel.predict: artifact inputs are %s%s%s"
+                % (self.input_names,
+                   ("; missing %s" % missing) if missing else "",
+                   ("; unexpected %s" % extra) if extra else ""))
         return self(*[data[n] for n in self.input_names])
+
+
+def _fmt_shape(shape):
+    return "(" + ", ".join("N" if d is None else str(d)
+                           for d in shape) + ")"

@@ -56,6 +56,28 @@ def test_composite_and_custom():
     assert cm.get()[1] == 1.0
 
 
+def test_update_dict_preds_keep_asnumpy():
+    """User metric subclasses written against the reference call
+    .asnumpy() on what update() receives (examples/train_ssd.py,
+    examples/train_rcnn.py do); the batched one-sync fetch in
+    update_dict must hand them asnumpy()-compatible arrays."""
+    seen = {}
+
+    class UserMetric(metric.EvalMetric):
+        def update(self, labels, preds):
+            seen["pred"] = preds[0].asnumpy()
+            seen["label"] = labels[0].asnumpy()
+            self.sum_metric += float(seen["pred"].sum())
+            self.num_inst += 1
+
+    m = UserMetric("user")
+    m.update_dict({"softmax_label": mx.nd.array([1.0, 0.0])},
+                  {"softmax_output": mx.nd.array([[0.1, 0.9], [0.8, 0.2]])})
+    assert seen["pred"].shape == (2, 2)
+    np.testing.assert_allclose(seen["label"], [1.0, 0.0])
+    assert m.get()[1] == pytest.approx(2.0)
+
+
 def test_f1():
     m = metric.F1()
     pred = mx.nd.array([[0.3, 0.7], [0.8, 0.2], [0.4, 0.6]])

@@ -1,0 +1,403 @@
+"""Online serving runtime (mxnet_tpu.serve): dynamic micro-batching,
+shape-bucketed executable cache, admission control — all chip-free.
+
+The acceptance property: >= 8 concurrent single requests coalesce into
+ONE bucketed device batch whose per-request outputs are BITWISE equal
+to individual CompiledModel calls through the same bucket engine, with
+the metrics snapshot reporting per-bucket latency percentiles and the
+padding-waste ratio for the run.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.serve import (DeadlineExceeded, Server, ServerBusy,
+                             ServerClosed, serve_http)
+
+
+@pytest.fixture(scope="module")
+def art(tmp_path_factory):
+    """A dynamic-batch artifact of a small conv+BN net, plus the raw
+    (sym, args, aux) for live-executor parity checks."""
+    tmp = tmp_path_factory.mktemp("serve")
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    shapes, _, aux_shapes = net.infer_shape(data=(2, 1, 8, 8))
+    args = {n: mx.nd.array(rng.uniform(-0.3, 0.3, s).astype("f4"))
+            for n, s in zip(net.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    aux = {n: mx.nd.array(np.ones(s, "f4") if "var" in n
+                          else np.zeros(s, "f4"))
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    path = str(tmp / "m.mxtpu")
+    meta = mx.serving.export_compiled(net, args, {k: v for k, v in
+                                                  aux.items()},
+                                      {"data": (None, 1, 8, 8)}, path)
+    assert meta["dynamic_batch"] is True
+    return {"path": path, "sym": net, "args": args, "aux": aux}
+
+
+def _x(rng, n=1):
+    return rng.randn(n, 1, 8, 8).astype("f4")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: coalescing + bitwise parity + metrics
+# ---------------------------------------------------------------------------
+
+def test_coalesces_eight_concurrent_requests_into_one_batch_bitwise(art):
+    srv = Server(art["path"], buckets=(8,), auto_start=False,
+                 batch_timeout_ms=0)
+    cm_ref = mx.serving.CompiledModel.load(art["path"], buckets=(8,))
+    rng = np.random.RandomState(1)
+    xs = [_x(rng) for _ in range(8)]
+    results = [None] * 8
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def caller(i):
+        try:
+            barrier.wait(5)
+            req = srv.submit(data=xs[i], timeout_ms=30000)
+            results[i] = req.result(timeout=30)
+        except Exception as e:   # pragma: no cover - diagnostic
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=caller, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    # wait until ALL 8 are queued, then run ONE batcher round
+    t_end = time.monotonic() + 10
+    while srv._queue.pending_count() < 8:
+        assert time.monotonic() < t_end, "submissions did not arrive"
+        time.sleep(0.002)
+    taken = srv.run_once(block=False)
+    assert taken == 8
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+
+    # bitwise equality vs individual CompiledModel calls (same bucket)
+    for i in range(8):
+        ref = np.asarray(cm_ref.predict(data=xs[i])[0])
+        assert (results[i][0] == ref).all(), "row %d not bitwise equal" % i
+
+    snap = srv.metrics()
+    b8 = snap["buckets"]["8"]
+    assert b8["batches"] == 1            # ONE device batch for all 8
+    assert b8["rows"] == 8
+    assert b8["padded_rows"] == 0
+    assert b8["occupancy"] == 1.0
+    assert b8["padding_waste"] == 0.0
+    lat = b8["latency_ms"]
+    assert lat["count"] == 8
+    for p in ("p50", "p95", "p99"):
+        assert lat[p] is not None and lat[p] > 0
+    assert snap["requests"]["completed"] == 8
+    assert snap["requests"]["rejected"] == 0
+    srv.close(drain=True)
+
+
+def test_padded_rows_never_leak_and_waste_is_reported(art):
+    srv = Server(art["path"], buckets=(8,), auto_start=False,
+                 batch_timeout_ms=0)
+    cm_ref = mx.serving.CompiledModel.load(art["path"], buckets=(8,))
+    rng = np.random.RandomState(2)
+    xs = [_x(rng) for _ in range(5)]
+    reqs = [srv.submit(data=x, timeout_ms=30000) for x in xs]
+    assert srv.run_once(block=False) == 5
+    for x, r in zip(xs, reqs):
+        out = r.result(5)
+        assert out[0].shape == (1, 3)            # real rows only
+        assert (out[0] == np.asarray(cm_ref.predict(data=x)[0])).all()
+    b8 = srv.metrics()["buckets"]["8"]
+    assert b8["rows"] == 5 and b8["padded_rows"] == 3
+    assert b8["padding_waste"] == round(3 / 8, 4)
+    assert b8["occupancy"] == round(5 / 8, 4)
+    srv.close(drain=True)
+
+
+def test_multi_row_requests_coalesce_to_the_right_bucket(art):
+    srv = Server(art["path"], buckets=(1, 2, 4, 8), auto_start=False,
+                 batch_timeout_ms=0)
+    rng = np.random.RandomState(3)
+    r1 = srv.submit(data=_x(rng, 2), timeout_ms=30000)
+    r2 = srv.submit(data=_x(rng, 3), timeout_ms=30000)
+    assert srv.run_once(block=False) == 2
+    assert r1.result(5)[0].shape == (2, 3)
+    assert r2.result(5)[0].shape == (3, 3)
+    assert r1.bucket == r2.bucket == 8           # 5 rows -> bucket 8
+    srv.close(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_skips_dispatch(art):
+    srv = Server(art["path"], buckets=(8,), auto_start=False,
+                 batch_timeout_ms=0)
+    rng = np.random.RandomState(4)
+    req = srv.submit(data=_x(rng), timeout_ms=5)
+    time.sleep(0.05)
+    srv.run_once(block=False)
+    with pytest.raises(DeadlineExceeded):
+        req.result(1)
+    snap = srv.metrics()
+    assert snap["requests"]["expired"] == 1
+    assert snap["buckets"] == {}                 # nothing was dispatched
+    srv.close(drain=True)
+
+
+def test_backpressure_rejects_with_retry_after(art):
+    srv = Server(art["path"], buckets=(8,), auto_start=False,
+                 queue_depth=2, batch_timeout_ms=0)
+    rng = np.random.RandomState(5)
+    srv.submit(data=_x(rng), timeout_ms=30000)
+    srv.submit(data=_x(rng), timeout_ms=30000)
+    with pytest.raises(ServerBusy) as ei:
+        srv.submit(data=_x(rng), timeout_ms=30000)
+    assert ei.value.retry_after > 0
+    assert srv.metrics()["requests"]["rejected"] == 1
+    srv.run_once(block=False)                    # free the queue
+    srv.close(drain=True)
+
+
+def test_request_larger_than_biggest_bucket_is_rejected(art):
+    srv = Server(art["path"], buckets=(8,), auto_start=False)
+    with pytest.raises(mx.base.MXNetError) as ei:
+        srv.submit(data=np.zeros((9, 1, 8, 8), "f4"))
+    assert "exceeds the largest bucket" in str(ei.value)
+    srv.close(drain=True)
+
+
+def test_drain_on_shutdown_completes_everything(art):
+    srv = Server(art["path"], buckets=(1, 8), batch_timeout_ms=2)
+    rng = np.random.RandomState(6)
+    reqs = [srv.submit(data=_x(rng), timeout_ms=30000)
+            for _ in range(12)]
+    srv.close(drain=True)                        # graceful
+    for r in reqs:
+        assert r.result(1)[0].shape == (1, 3)
+    snap = srv.metrics()
+    assert snap["requests"]["completed"] == 12
+    assert snap["requests"]["dropped"] == 0
+    assert snap["status"] == "closed"
+    with pytest.raises(ServerClosed):
+        srv.submit(data=_x(rng))
+
+
+def test_close_without_drain_fails_pending_as_dropped(art):
+    srv = Server(art["path"], buckets=(8,), auto_start=False,
+                 batch_timeout_ms=0)
+    rng = np.random.RandomState(7)
+    reqs = [srv.submit(data=_x(rng), timeout_ms=30000) for _ in range(3)]
+    srv.close(drain=False)
+    for r in reqs:
+        with pytest.raises(ServerClosed):
+            r.result(1)
+    assert srv.metrics()["requests"]["dropped"] == 3
+
+
+# ---------------------------------------------------------------------------
+# parity + engine cache + observability
+# ---------------------------------------------------------------------------
+
+def test_server_predict_parity_vs_live_module(art):
+    """export -> load -> batched Server.predict matches the live
+    executor (Module forward) on the same params."""
+    rng = np.random.RandomState(8)
+    x = _x(rng, 4)
+    srv = Server(art["path"], buckets=(1, 4, 8), batch_timeout_ms=0)
+    out = srv.predict(data=x, timeout_ms=30000)[0]
+    srv.close(drain=True)
+
+    m = mx.mod.Module(art["sym"])
+    m.bind([("data", (4, 1, 8, 8))], [("softmax_label", (4,))],
+           for_training=False)
+    m.set_params(art["args"], art["aux"])
+    from mxnet_tpu.io import DataBatch
+    m.forward(DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    live = m.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, live, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_cache_lru_eviction(art):
+    srv = Server(art["path"], buckets=(1, 2), auto_start=False,
+                 cache_engines=1, batch_timeout_ms=0)
+    rng = np.random.RandomState(9)
+    r = srv.submit(data=_x(rng, 1), timeout_ms=30000)
+    srv.run_once(block=False)
+    r.result(5)
+    r = srv.submit(data=_x(rng, 2), timeout_ms=30000)
+    srv.run_once(block=False)
+    r.result(5)
+    eng = srv.metrics()["engines"]
+    assert eng["builds"] == 2
+    assert eng["evictions"] == 1
+    assert list(eng["engines"]) == ["2"]         # only the LRU survivor
+    srv.close(drain=True)
+
+
+def test_fixed_batch_artifact_serves_at_frozen_bucket(art, tmp_path):
+    fixed = str(tmp_path / "fixed.mxtpu")
+    mx.serving.export_compiled(art["sym"], art["args"], art["aux"],
+                               {"data": (4, 1, 8, 8)}, fixed)
+    srv = Server(fixed, auto_start=False, batch_timeout_ms=0)
+    assert srv.buckets == (4,)                   # frozen batch IS the bucket
+    rng = np.random.RandomState(10)
+    xs = [_x(rng) for _ in range(2)]
+    reqs = [srv.submit(data=x, timeout_ms=30000) for x in xs]
+    srv.run_once(block=False)
+    cm_ref = mx.serving.CompiledModel.load(fixed, buckets=(4,))
+    for x, r in zip(xs, reqs):
+        assert (r.result(5)[0] == np.asarray(
+            cm_ref.predict(data=x)[0])).all()
+    assert srv.metrics()["buckets"]["4"]["padded_rows"] == 2
+    srv.close(drain=True)
+
+
+def test_profiler_sees_serve_events(art, tmp_path):
+    prof = str(tmp_path / "serve_prof.json")
+    mx.profiler.set_config(filename=prof)
+    mx.profiler.set_state("run")
+    try:
+        srv = Server(art["path"], buckets=(8,), auto_start=False,
+                     batch_timeout_ms=0)
+        rng = np.random.RandomState(11)
+        req = srv.submit(data=_x(rng), timeout_ms=30000)
+        srv.run_once(block=False)
+        req.result(5)
+        srv.close(drain=True)
+    finally:
+        mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    with open(prof) as f:
+        events = json.load(f)["traceEvents"]
+    names = [e.get("name") for e in events]
+    assert "serve/bucket8" in names              # duration event
+    assert "serve/queue_depth" in names          # counter track
+
+
+def test_loadgen_inprocess_accounting(art):
+    from tools.serve_loadgen import measure
+    srv = Server(art["path"], buckets=(1, 8), batch_timeout_ms=1)
+    res = measure(srv, concurrency=4, requests=16, timeout_ms=30000)
+    srv.close(drain=True)
+    assert (res["completed"] + res["rejected"] + res["expired"]
+            + res["errors"]) == res["attempted"] == 16
+    assert res["errors"] == 0
+    assert res["completed"] > 0
+    assert res["latency_ms"]["p50"] is not None
+    assert sum(res["histogram"]["counts"]) == res["completed"]
+    assert res["goodput_qps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+def test_http_front_end_round_trip(art):
+    srv = Server(art["path"], buckets=(1, 8), batch_timeout_ms=1)
+    front = serve_http(srv, host="127.0.0.1", port=0)
+    try:
+        url = front.address
+        rng = np.random.RandomState(12)
+        x = _x(rng)
+        body = json.dumps({"inputs": {"data": x.tolist()}}).encode()
+        req = urllib.request.Request(
+            url + "/v1/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            payload = json.loads(r.read().decode())
+        cm = mx.serving.CompiledModel.load(art["path"], buckets=(1, 8))
+        ref = np.asarray(cm.predict(data=x)[0])
+        np.testing.assert_allclose(
+            np.asarray(payload["outputs"][0], "f4"), ref,
+            rtol=1e-6, atol=1e-7)
+        assert payload["bucket"] == 1
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            snap = json.loads(r.read().decode())
+        assert snap["requests"]["completed"] >= 1
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            assert json.loads(r.read().decode())["status"] == "ok"
+        # malformed input -> 400 naming the input, not a 500
+        bad = json.dumps({"inputs": {"data": [[0.0] * 3]}}).encode()
+        breq = urllib.request.Request(
+            url + "/v1/predict", data=bad,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(breq, timeout=10)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "data" in json.loads(e.read().decode())["error"]
+    finally:
+        front.stop(drain=True)
+    assert srv.closed
+
+
+# ---------------------------------------------------------------------------
+# soak: graceful restart drops nothing (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_graceful_restart_drops_no_inflight_requests(art):
+    """Closed-loop load against server A; mid-run A is gracefully
+    drained and replaced by server B. Every admitted request must
+    complete (zero dropped); rejected submits retry onto B."""
+    from tools.serve_loadgen import measure
+
+    servers = [Server(art["path"], buckets=(1, 8), batch_timeout_ms=1,
+                      queue_depth=64)]
+    swapped = threading.Event()
+
+    def current():
+        return servers[-1]
+
+    result = {}
+
+    def drive():
+        result.update(measure(current, concurrency=8, requests=300,
+                              timeout_ms=30000, retries=20))
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    time.sleep(0.5)                    # mid-flight...
+    old = servers[-1]
+    servers.append(Server(art["path"], buckets=(1, 8), batch_timeout_ms=1,
+                          queue_depth=64))
+    swapped.set()
+    old.close(drain=True)              # graceful: finish every admitted req
+    t.join(120)
+    assert not t.is_alive(), "loadgen did not finish"
+    new = servers[-1]
+    new.close(drain=True)
+
+    assert result["errors"] == 0
+    assert result["expired"] == 0
+    assert result["rejected"] == 0     # retries rerouted every reject
+    assert result["completed"] == result["attempted"] == 300
+    for s in (old, new):
+        snap = s.metrics()
+        assert snap["requests"]["dropped"] == 0
+        # every request ADMITTED by this server got a response
+        assert (snap["requests"]["completed"] + snap["requests"]["expired"]
+                ) == snap["requests"]["submitted"]
+    total = (old.metrics()["requests"]["completed"]
+             + new.metrics()["requests"]["completed"])
+    assert total == 300

@@ -100,7 +100,10 @@ def test_compile_model_cli(tmp_path):
 
 def test_cross_platform_tpu_export_from_cpu_host(tmp_path):
     """The artifact can target TPU from a CPU build host (the
-    cross-compile the reference's TensorRT path cannot do)."""
+    cross-compile the reference's TensorRT path cannot do). Loading it
+    on a mismatched backend fails FAST with an actionable message, not a
+    deep XLA crash at call time; allow_platform_mismatch=True keeps the
+    inspect/relay path open."""
     data = mx.sym.Variable("data")
     net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
     net = mx.sym.SoftmaxOutput(net, name="softmax")
@@ -110,8 +113,81 @@ def test_cross_platform_tpu_export_from_cpu_host(tmp_path):
     meta = mx.serving.export_compiled(net, args, {}, {"data": (2, 8)},
                                       art, platforms=["tpu"])
     assert meta["platforms"] == ["tpu"]
-    cm = mx.serving.CompiledModel.load(art)   # loads anywhere
+    with pytest.raises(mx.base.MXNetError) as ei:
+        mx.serving.CompiledModel.load(art)    # cpu backend, tpu artifact
+    msg = str(ei.value)
+    assert "tpu" in msg and "cpu" in msg and "re-export" in msg
+    cm = mx.serving.CompiledModel.load(art, allow_platform_mismatch=True)
     assert cm.meta["platforms"] == ["tpu"]    # runs only on a tpu backend
+
+
+def test_predict_validates_shape_dtype_naming_input(tmp_path):
+    """VERDICT-style satellite: a shape/dtype mismatch must be a clear
+    MXNetError naming the offending input, not an opaque XLA error out
+    of exp.call."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"fc_weight": mx.nd.array(np.ones((3, 6), "f4")),
+            "fc_bias": mx.nd.zeros((3,))}
+    art = str(tmp_path / "v.mxtpu")
+    mx.serving.export_compiled(net, args, {}, {"data": (2, 6)}, art)
+    cm = mx.serving.CompiledModel.load(art)
+
+    # wrong trailing dim
+    with pytest.raises(mx.base.MXNetError) as ei:
+        cm.predict(data=np.zeros((2, 7), "f4"))
+    assert "'data'" in str(ei.value) and "(2, 7)" in str(ei.value)
+    # wrong rank
+    with pytest.raises(mx.base.MXNetError) as ei:
+        cm.predict(data=np.zeros((2, 6, 1), "f4"))
+    assert "'data'" in str(ei.value) and "rank" in str(ei.value)
+    # fixed artifact: wrong batch is named too
+    with pytest.raises(mx.base.MXNetError) as ei:
+        cm.predict(data=np.zeros((3, 6), "f4"))
+    assert "'data'" in str(ei.value)
+    # unsafe dtype refuses; same-kind dtype casts
+    with pytest.raises(mx.base.MXNetError) as ei:
+        cm.predict(data=np.zeros((2, 6), "complex64"))
+    assert "dtype" in str(ei.value) and "'data'" in str(ei.value)
+    out = cm.predict(data=np.zeros((2, 6), "f8"))   # f8 -> f4 same-kind
+    assert np.asarray(out[0]).shape == (2, 3)
+    # wrong input NAME
+    with pytest.raises(mx.base.MXNetError) as ei:
+        cm.predict(input=np.zeros((2, 6), "f4"))
+    assert "missing" in str(ei.value) and "unexpected" in str(ei.value)
+
+
+def test_dynamic_batch_export_serves_any_batch(tmp_path):
+    """dynamic_batch=True: ONE artifact, any concrete batch size, and
+    bucketed CompiledModel calls chunk past the largest bucket."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fc_weight": mx.nd.array(rng.randn(3, 6).astype("f4")),
+            "fc_bias": mx.nd.zeros((3,))}
+    art = str(tmp_path / "dyn.mxtpu")
+    meta = mx.serving.export_compiled(net, args, {}, {"data": (None, 6)},
+                                      art)
+    assert meta["dynamic_batch"] is True
+    assert meta["inputs"][0]["shape"] == [None, 6]
+    cm = mx.serving.CompiledModel.load(art)
+    for bs in (1, 3, 8):
+        out = cm.predict(data=rng.randn(bs, 6).astype("f4"))
+        assert np.asarray(out[0]).shape == (bs, 3)
+    # bucketed: batch 11 > max bucket 4 chunks through the 4-engine
+    cmb = mx.serving.CompiledModel.load(art, buckets=(1, 4))
+    x = rng.randn(11, 6).astype("f4")
+    got = np.asarray(cmb.predict(data=x)[0])
+    ref = np.asarray(cm.predict(data=x)[0])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # fixed artifact refuses a multi-bucket set with a clear message
+    fixed = str(tmp_path / "fix.mxtpu")
+    mx.serving.export_compiled(net, args, {}, {"data": (2, 6)}, fixed)
+    with pytest.raises(mx.base.MXNetError) as ei:
+        mx.serving.CompiledModel.load(fixed, buckets=(1, 4))
+    assert "dynamic_batch" in str(ei.value)
 
 
 def test_int8_model_exports_and_serves(tmp_path):

@@ -27,6 +27,11 @@ def main():
     p.add_argument("--dtype", default="float32")
     p.add_argument("--platforms", default=None,
                    help="comma list, e.g. tpu (default: current backend)")
+    p.add_argument("--dynamic-batch", action="store_true",
+                   help="export the batch dim SYMBOLIC: one artifact "
+                        "serves any batch size (what mxnet_tpu.serve's "
+                        "shape-bucketed engine cache wants); the "
+                        "--data-shape batch value becomes a probe size")
     p.add_argument("--platform", default=None, choices=[None, "cpu"],
                    help="backend to run the EXPORT on")
     args = p.parse_args()
@@ -41,7 +46,8 @@ def main():
     plats = args.platforms.split(",") if args.platforms else None
     meta = mx.serving.export_compiled(
         sym, arg_params, aux_params, {args.data_name: shape}, args.out,
-        dtype=args.dtype, platforms=plats)
+        dtype=args.dtype, platforms=plats,
+        dynamic_batch=args.dynamic_batch)
     print(json.dumps({"artifact": args.out,
                       "bytes": os.path.getsize(args.out), **meta}))
 

@@ -1,0 +1,80 @@
+"""Serve an ``.mxtpu`` AOT artifact over HTTP with dynamic
+micro-batching and admission control.
+
+    python tools/serve.py --artifact model.mxtpu --port 8080 \
+        [--buckets 1,8,32] [--batch-timeout-ms 2] [--queue-depth 256] \
+        [--timeout-ms 1000] [--no-warmup] [--verbose]
+
+Endpoints (see mxnet_tpu/serve/http.py):
+    POST /v1/predict   {"inputs": {"data": [[...]]}}
+    GET  /metrics      per-bucket p50/p95/p99, occupancy, padding waste
+    GET  /healthz
+
+SIGINT/SIGTERM triggers a graceful drain: the listener stops accepting,
+every admitted request finishes, then the final metrics snapshot is
+printed to stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--artifact", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--buckets", default=None,
+                   help="comma batch buckets, e.g. 1,8,32 (default: "
+                        "MXNET_SERVE_BUCKETS for dynamic artifacts, the "
+                        "frozen batch for fixed ones)")
+    p.add_argument("--batch-timeout-ms", type=float, default=None)
+    p.add_argument("--queue-depth", type=int, default=None)
+    p.add_argument("--timeout-ms", type=float, default=None)
+    p.add_argument("--cache-engines", type=int, default=None)
+    p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--platform", default=None, choices=[None, "cpu"],
+                   help="pin jax to this backend before loading")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from mxnet_tpu.serve import ServeConfig, Server, serve_http
+
+    cfg = ServeConfig(
+        buckets=args.buckets,
+        batch_timeout_ms=args.batch_timeout_ms,
+        queue_depth=args.queue_depth,
+        timeout_ms=args.timeout_ms,
+        cache_engines=args.cache_engines,
+        warmup=False if args.no_warmup else None)
+    server = Server(args.artifact, config=cfg)
+    front = serve_http(server, args.host, args.port, verbose=args.verbose)
+    print(json.dumps({"serving": args.artifact, "url": front.address,
+                      "buckets": list(server.buckets)}), flush=True)
+
+    done = threading.Event()
+
+    def _shutdown(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGINT, _shutdown)
+    signal.signal(signal.SIGTERM, _shutdown)
+    done.wait()
+    print("draining...", file=sys.stderr, flush=True)
+    front.stop(drain=True)
+    print(json.dumps(server.metrics()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

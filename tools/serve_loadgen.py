@@ -1,0 +1,259 @@
+"""Closed-loop load generator for the online serving runtime.
+
+Drives an in-process :class:`mxnet_tpu.serve.Server` (or a running
+``tools/serve.py`` HTTP endpoint) with N concurrent workers, each
+submitting its next request as soon as the previous one completes
+(optionally paced to a target aggregate QPS), and reports a latency
+histogram + goodput JSON:
+
+    python tools/serve_loadgen.py --artifact model.mxtpu \
+        --concurrency 16 --requests 512 [--qps 200] [--buckets 1,8,32]
+    python tools/serve_loadgen.py --url http://127.0.0.1:8080 \
+        --shape 1,3,224,224 --concurrency 16 --requests 512
+
+Importable: ``measure(target, ...)`` where ``target`` is a Server, an
+artifact path, a URL, or a zero-arg callable returning the current
+Server (the hook the graceful-restart soak test uses to re-point
+workers at a replacement server mid-run).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_HIST_EDGES_MS = [0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
+                  5000]
+
+
+def _http_call(url, payload, timeout_s):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            json.loads(r.read().decode())
+            return "ok", None
+    except urllib.error.HTTPError as e:
+        if e.code == 429:
+            return "rejected", float(e.headers.get("Retry-After", 0.05))
+        if e.code == 504:
+            return "expired", None
+        if e.code == 503:
+            return "closed", None
+        return "error", None
+    except Exception:
+        return "error", None
+
+
+def measure(target, concurrency=8, requests=256, qps=None, rows=1,
+            timeout_ms=None, shape=None, retries=0, seed=0):
+    """Run the closed loop; returns the result dict (see module doc).
+
+    ``retries``: how many times a rejected (429/ServerBusy) or
+    closed-server submit is retried (after the retry-after hint) before
+    being counted as rejected. The graceful-restart soak sets this > 0
+    with a callable ``target`` so retried requests land on the
+    replacement server.
+    """
+    import numpy as np
+
+    is_url = isinstance(target, str) and target.startswith("http")
+    get_server = None
+    if not is_url:
+        from mxnet_tpu.serve import Server
+        if callable(target) and not isinstance(target, Server):
+            get_server = target
+        else:
+            if isinstance(target, str):
+                target = Server(target)
+            get_server = lambda: target  # noqa: E731
+        meta_inputs = get_server().model.meta["inputs"]
+        shapes = {i["name"]: (rows,) + tuple(i["shape"][1:])
+                  for i in meta_inputs}
+        dtypes = {i["name"]: i["dtype"] for i in meta_inputs}
+    else:
+        if shape is None:
+            raise ValueError("HTTP mode needs --shape (incl. batch dim)")
+        shapes = {"data": tuple(shape)}
+        dtypes = {"data": "float32"}
+
+    rng = np.random.RandomState(seed)
+    feeds = [{n: rng.randn(*s).astype(dtypes[n])
+              for n, s in shapes.items()} for _ in range(8)]
+
+    counters = {"completed": 0, "rejected": 0, "expired": 0, "errors": 0}
+    latencies = []
+    lock = threading.Lock()
+    next_idx = [0]
+    pace = (concurrency / qps) if qps else 0.0   # per-worker inter-arrival
+
+    def worker(wid):
+        from mxnet_tpu.serve import (DeadlineExceeded, ServerBusy,
+                                     ServerClosed)
+        while True:
+            with lock:
+                if next_idx[0] >= requests:
+                    return
+                i = next_idx[0]
+                next_idx[0] += 1
+            feed = feeds[i % len(feeds)]
+            t0 = time.monotonic()
+            outcome = "error"
+            for attempt in range(retries + 1):
+                if is_url:
+                    payload = {"inputs": {n: v.tolist()
+                                          for n, v in feed.items()}}
+                    if timeout_ms:
+                        payload["timeout_ms"] = timeout_ms
+                    outcome, retry_after = _http_call(
+                        target, payload,
+                        timeout_s=(timeout_ms or 30000) / 1e3 + 5)
+                    if outcome == "ok":
+                        break
+                    if outcome in ("rejected", "closed") \
+                            and attempt < retries:
+                        time.sleep(retry_after or 0.05)
+                        continue
+                    break
+                try:
+                    req = get_server().submit(timeout_ms=timeout_ms,
+                                              **feed)
+                    budget = ((timeout_ms or 30000) / 1e3) + 5
+                    req.result(timeout=budget)
+                    outcome = "ok"
+                    break
+                except ServerBusy as e:
+                    outcome = "rejected"
+                    if attempt < retries:
+                        time.sleep(e.retry_after)
+                        continue
+                    break
+                except ServerClosed:
+                    outcome = "closed"
+                    if attempt < retries:
+                        time.sleep(0.05)
+                        continue
+                    break
+                except DeadlineExceeded:
+                    outcome = "expired"
+                    break
+                except Exception:
+                    outcome = "error"
+                    break
+            dt_ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                if outcome == "ok":
+                    counters["completed"] += 1
+                    latencies.append(dt_ms)
+                elif outcome in ("rejected", "closed"):
+                    counters["rejected"] += 1
+                elif outcome == "expired":
+                    counters["expired"] += 1
+                else:
+                    counters["errors"] += 1
+            if pace:
+                budget = pace - (time.monotonic() - t0)
+                if budget > 0:
+                    time.sleep(budget)
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t_start
+
+    from mxnet_tpu.serve import percentile
+    hist = [0] * (len(_HIST_EDGES_MS) + 1)
+    for ms in latencies:
+        for j, edge in enumerate(_HIST_EDGES_MS):
+            if ms <= edge:
+                hist[j] += 1
+                break
+        else:
+            hist[-1] += 1
+    out = {
+        "attempted": requests,
+        **counters,
+        "wall_s": round(wall_s, 3),
+        "goodput_qps": round(counters["completed"] / wall_s, 2)
+                       if wall_s > 0 else None,
+        "concurrency": concurrency,
+        "target_qps": qps,
+        "latency_ms": {
+            "p50": percentile(latencies, 50),
+            "p95": percentile(latencies, 95),
+            "p99": percentile(latencies, 99),
+            "mean": (sum(latencies) / len(latencies)) if latencies else None,
+            "max": max(latencies) if latencies else None,
+        },
+        "histogram": {"edges_ms": _HIST_EDGES_MS, "counts": hist},
+    }
+    if not is_url and get_server is not None:
+        try:
+            out["server_metrics"] = get_server().metrics()
+        except Exception:
+            pass
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--artifact", help="serve in-process from this artifact")
+    g.add_argument("--url", help="drive a running tools/serve.py endpoint")
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--requests", type=int, default=256)
+    p.add_argument("--qps", type=float, default=None,
+                   help="aggregate target QPS (default: unpaced)")
+    p.add_argument("--rows", type=int, default=1,
+                   help="rows per request (in-process mode)")
+    p.add_argument("--shape", default=None,
+                   help="request shape incl. batch, e.g. 1,3,224,224 "
+                        "(HTTP mode)")
+    p.add_argument("--timeout-ms", type=float, default=None)
+    p.add_argument("--retries", type=int, default=0)
+    p.add_argument("--buckets", default=None)
+    p.add_argument("--platform", default=None, choices=[None, "cpu"])
+    p.add_argument("--out", default=None, help="also write JSON here")
+    args = p.parse_args()
+
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.url:
+        target = args.url
+        shape = tuple(int(x) for x in args.shape.split(",")) \
+            if args.shape else None
+    else:
+        from mxnet_tpu.serve import Server
+        target = Server(args.artifact, buckets=args.buckets)
+        shape = None
+
+    res = measure(target, concurrency=args.concurrency,
+                  requests=args.requests, qps=args.qps, rows=args.rows,
+                  timeout_ms=args.timeout_ms, shape=shape,
+                  retries=args.retries)
+    if not args.url:
+        target.close(drain=True)
+    line = json.dumps(res)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line)
+
+
+if __name__ == "__main__":
+    main()
