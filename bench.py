@@ -413,6 +413,23 @@ def main():
     except Exception:
         pass
 
+    # mxlint Layer-2 metrics of the exact benched step program (convert
+    # count, donation coverage, d2h count) so BENCH_*.json tracks the
+    # lint health of the hot path alongside its throughput
+    mxlint_metrics = None
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        try:
+            from diagnose_step_hlo import lower_step
+        finally:
+            sys.path.pop(0)
+        from mxnet_tpu.analysis import hlo_passes
+        mxlint_metrics = hlo_passes.metrics_from_text(
+            lower_step(mod, donate=True).as_text())
+    except Exception as e:
+        mxlint_metrics = "failed: %s" % e
+
     # ---- real-data variant (OPT-IN: BENCH_RECORDIO=1): threaded RecordIO
     # pipeline feeding the same fused module (decode+augment+H2D overlapped
     # with training). Reported as extra fields: recordio_img_s and
@@ -483,6 +500,8 @@ def main():
         "device": dev.device_kind,
         "flops_per_step": flops_per_step,
     }
+    if mxlint_metrics is not None:
+        out["mxlint"] = mxlint_metrics
     if grouped_img_s is not None:
         out["steps_per_dispatch"] = k_disp
         out["grouped_img_s"] = round(grouped_img_s, 2)

@@ -64,9 +64,10 @@ def main():
                 loss = loss_fn(out, label)
             loss.backward()
             trainer.step(args.batch_size)
-            cum_loss += float(loss.asnumpy().sum())
-            correct += int((out.asnumpy().argmax(1)
-                            == label.asnumpy()).sum())
+            # one device->host sync for all three (mxlint MXL103)
+            loss_h, out_h, label_h = mx.nd.asnumpy_all(loss, out, label)
+            cum_loss += float(loss_h.sum())
+            correct += int((out_h.argmax(1) == label_h).sum())
             total += len(label)
         print("epoch %d: loss %.4f acc %.3f"
               % (epoch, cum_loss / total, correct / total))

@@ -78,9 +78,11 @@ def main():
         correct = total = 0
         for batch in it:
             x = batch.data[0]                       # CSRNDArray (B, D)
-            y = batch.label[0].asnumpy()
-            logits = sp.dot(x, weight).asnumpy().ravel() + \
-                float(bias.asscalar())
+            # one device->host sync for label, logits, and bias
+            # (mxlint MXL103)
+            y, logits_h, bias_h = mx.nd.asnumpy_all(
+                batch.label[0], sp.dot(x, weight), bias)
+            logits = logits_h.ravel() + float(bias_h.ravel()[0])
             prob = 1.0 / (1.0 + np.exp(-logits))
             # logistic grad wrt logits
             g = (prob - y)[:, None].astype("f4") / len(y)

@@ -145,9 +145,13 @@ def main():
                     g_loss = loss_fn(out, ones)
                 g_loss.backward()
                 g_tr.step(args.batch_size)
-            d_losses.append(float(d_loss.mean().asnumpy()))
-            g_losses.append(float(g_loss.mean().asnumpy()))
-            fooled.append(float((out.sigmoid() > 0.5).mean().asnumpy()))
+            # one device->host sync for all three (mxlint MXL103)
+            d_h, g_h, f_h = mx.nd.asnumpy_all(
+                d_loss.mean(), g_loss.mean(),
+                (out.sigmoid() > 0.5).mean())
+            d_losses.append(float(d_h))
+            g_losses.append(float(g_h))
+            fooled.append(float(f_h))
         fool_rate = float(np.mean(fooled))
         logging.info("epoch %d  d_loss %.3f  g_loss %.3f  fool-rate %.2f",
                      epoch, np.mean(d_losses), np.mean(g_losses),

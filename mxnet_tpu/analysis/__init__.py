@@ -1,0 +1,24 @@
+"""mxlint: TPU-discipline static analysis for mxnet_tpu (PR 5).
+
+Two layers over one diagnostic/baseline engine:
+
+* **Layer 1 (AST)** — :mod:`.rules_ast` walks Python source and flags
+  host-sync calls in traced bodies, retrace hazards, donated-buffer
+  re-use, and lock-discipline violations. No chip, no jax import.
+* **Layer 2 (HLO)** — :mod:`.hlo_passes` runs pluggable passes (convert
+  budget, donation coverage, d2h transfer count, recompile fingerprint)
+  over chip-free ``JAX_PLATFORMS=cpu`` lowerings.
+
+Entry points: ``tools/mxlint.py`` (CLI), ``tests/test_lint_clean.py``
+(tier-1 gate), :func:`mxnet_tpu.analysis.runner.run` (API). This package
+is import-light by design (stdlib only at import time) and is *not*
+re-exported from ``mxnet_tpu/__init__`` — importing mxnet_tpu must not
+pay for the analyzer, and the analyzer must not initialize a backend.
+"""
+from __future__ import annotations
+
+from .diagnostics import Diagnostic, assign_indices
+from .runner import LintResult, all_rules, lint_paths, lint_sources, run
+
+__all__ = ["Diagnostic", "assign_indices", "LintResult", "all_rules",
+           "lint_paths", "lint_sources", "run"]

@@ -1,0 +1,85 @@
+"""Baseline ratchet for mxlint.
+
+Existing debt is recorded in a committed JSON file (one entry per
+:meth:`Diagnostic.key`); the gate fails only on NEW violations. The
+ratchet only tightens: ``update()`` refuses to add entries unless the
+caller explicitly passes ``allow_growth=True``, so "just re-baseline it"
+can never silently absorb a regression — the same one-way valve the
+convert-count budget (tests/test_step_hlo_budget.py) applies to HLO.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+VERSION = 1
+
+
+def load(path):
+    """Baseline entries as {key: note}; missing file -> empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != VERSION:
+        raise ValueError("mxlint baseline %s: unsupported format "
+                         "(expected {'version': %d, 'entries': {...}})"
+                         % (path, VERSION))
+    entries = data.get("entries", {})
+    if not isinstance(entries, dict):
+        raise ValueError("mxlint baseline %s: 'entries' must be a dict"
+                         % path)
+    return dict(entries)
+
+
+def save(path, entries):
+    """Write entries (sorted, pretty) atomically-enough for a dev tool."""
+    data = {"version": VERSION,
+            "entries": {k: entries[k] for k in sorted(entries)}}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def partition(diags, entries):
+    """Split diagnostics against a baseline.
+
+    Returns ``(new, baselined, stale)``: diagnostics whose key is absent
+    from / present in the baseline, and baseline keys that no longer fire
+    (debt that was paid off — prune them with ``--baseline-update``).
+    """
+    new, baselined = [], []
+    seen = set()
+    for d in diags:
+        k = d.key()
+        seen.add(k)
+        (baselined if k in entries else new).append(d)
+    stale = sorted(set(entries) - seen)
+    return new, baselined, stale
+
+
+def update(path, diags, allow_growth=False):
+    """Rewrite the baseline from the current diagnostics.
+
+    Shrinking (pruning stale entries) is always allowed; GROWING — adding
+    keys the old baseline did not contain — requires ``allow_growth=True``.
+    Returns the new entries dict; raises ``BaselineGrowthError`` otherwise.
+    """
+    old = load(path)
+    current = {}
+    for d in diags:
+        current[d.key()] = "%s (%s:%d)" % (d.message, d.path, d.line)
+    grown = sorted(set(current) - set(old))
+    if grown and not allow_growth:
+        raise BaselineGrowthError(
+            "baseline update would ADD %d entries (the ratchet only "
+            "tightens; fix the violations or pass --allow-growth):\n  %s"
+            % (len(grown), "\n  ".join(grown)))
+    save(path, current)
+    return current
+
+
+class BaselineGrowthError(Exception):
+    """--baseline-update would grow the baseline without --allow-growth."""

@@ -1,0 +1,66 @@
+"""Structured diagnostics for mxlint (the shared reporter of both layers).
+
+Every rule — AST or HLO — emits :class:`Diagnostic` objects through one
+funnel, so the CLI, the baseline ratchet, and the tier-1 gate all agree
+on identity and formatting. A diagnostic's :meth:`Diagnostic.key` is
+deliberately **line-number free**: it is built from (rule, file, enclosing
+symbol, occurrence index), so editing unrelated code above a baselined
+violation does not churn the committed baseline file — the same property
+clang-tidy/ruff baselines rely on.
+"""
+from __future__ import annotations
+
+SEVERITIES = ("error", "warning")
+
+
+class Diagnostic:
+    """One finding: rule id, location, severity, message, fix hint."""
+
+    __slots__ = ("rule", "path", "line", "col", "severity", "message",
+                 "hint", "symbol", "index")
+
+    def __init__(self, rule, path, line, col, severity, message, hint="",
+                 symbol="<module>"):
+        assert severity in SEVERITIES, severity
+        self.rule = rule
+        self.path = path          # repo-relative, forward slashes
+        self.line = int(line)
+        self.col = int(col)
+        self.severity = severity
+        self.message = message
+        self.hint = hint
+        self.symbol = symbol      # enclosing function/class qualname
+        self.index = 0            # occurrence index within (rule,path,symbol)
+
+    def key(self):
+        """Stable baseline identity (no line numbers — see module doc)."""
+        return "%s::%s::%s#%d" % (self.rule, self.path, self.symbol,
+                                  self.index)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "severity": self.severity,
+                "symbol": self.symbol, "message": self.message,
+                "hint": self.hint, "key": self.key()}
+
+    def format(self):
+        s = "%s:%d:%d: %s [%s] %s" % (self.path, self.line, self.col,
+                                      self.severity, self.rule,
+                                      self.message)
+        if self.hint:
+            s += "\n    hint: %s" % self.hint
+        return s
+
+    def __repr__(self):
+        return "Diagnostic(%s)" % self.format()
+
+
+def assign_indices(diags):
+    """Stamp per-(rule, path, symbol) occurrence indices in source order,
+    making :meth:`Diagnostic.key` unique and deterministic."""
+    counts = {}
+    for d in sorted(diags, key=lambda d: (d.path, d.line, d.col, d.rule)):
+        k = (d.rule, d.path, d.symbol)
+        d.index = counts.get(k, 0)
+        counts[k] = d.index + 1
+    return diags

@@ -1,0 +1,221 @@
+"""Layer-2 mxlint passes: pluggable checks over lowered StableHLO text.
+
+These generalize the one-off counters of :mod:`mxnet_tpu.hlo_stats` (PR 1)
+and tests/test_step_sync_budget.py (PR 3) into named, baselinable rules.
+Every pass is **pure text analysis** — the caller lowers chip-free with
+``JAX_PLATFORMS=cpu`` (``jax.jit(f).lower(*args).as_text()``) and hands the
+module text in; this module never imports jax, so importing it costs
+nothing and it works in environments with no accelerator at all.
+
+Pass inputs are the *pre-optimization* StableHLO: a deterministic function
+of the traced graph, so CPU-lowered counts bound what the TPU backend
+will compile (the property PR 1's convert budget relies on).
+"""
+from __future__ import annotations
+
+import collections
+
+from .. import hlo_stats
+from .diagnostics import Diagnostic
+from .rules_ast import Rule
+
+__all__ = [
+    "HLO_RULES", "convert_budget_pass", "donation_coverage_pass",
+    "d2h_transfer_pass", "RecompileFingerprint", "metrics_from_text",
+]
+
+HLO_RULES = {r.id: r for r in [
+    Rule("MXL501", "hlo-convert-budget", "error",
+         "dtype converts above budget mean a layer is computing in the "
+         "wrong dtype; check compute_dtype policy / BN param exclusion "
+         "(see docs/perf.md) and tools/diagnose_step_hlo.py for the pairs"),
+    Rule("MXL502", "hlo-donation-coverage", "error",
+         "large parameters not marked as donated double peak HBM; pass "
+         "donate_argnums for the param/optimizer-state trees (the fused "
+         "step donates args 0,2,3,4)"),
+    Rule("MXL503", "hlo-d2h-transfer", "error",
+         "host callbacks / outfeed in the step program force a device "
+         "sync per call; keep metrics device-resident and fetch once per "
+         "K-step window (see docs/perf.md sync budget)"),
+    Rule("MXL504", "recompile-fingerprint", "warning",
+         "the same jitted function saw many distinct shape/dtype/static "
+         "signatures — each one is a full recompile; pad/bucket shapes "
+         "(serve/engine_cache pattern) or mark true constants static"),
+]}
+
+# custom_call targets (and ops) that imply a device<->host transfer or
+# host-blocking rendezvous inside the compiled program
+_D2H_TARGET_FRAGMENTS = (
+    "callback", "outfeed", "infeed", "send", "recv", "host",
+)
+_D2H_OPS = ("outfeed", "infeed", "send", "recv")
+
+
+def _diag(rule_id, label, message, index_hint=0):
+    r = HLO_RULES[rule_id]
+    d = Diagnostic(rule_id, label, 1, 0, r.severity, message,
+                   hint=r.hint, symbol=r.name)
+    d.index = index_hint
+    return d
+
+
+def convert_budget_pass(text, label, budget, pairs=(("f32", "bf16"),)):
+    """Fail when dtype-convert count between the given pairs exceeds
+    ``budget`` (the PR-1 convert ratchet as a reusable pass)."""
+    stats = hlo_stats.analyze_stablehlo(text)
+    count = sum(hlo_stats.convert_count_between(stats, a, b)
+                for a, b in pairs)
+    if count <= budget:
+        return []
+    detail = ", ".join("%s<->%s" % p for p in pairs)
+    return [_diag("MXL501", label,
+                  "%d %s converts (budget %d); pairs seen: %s"
+                  % (count, detail, budget,
+                     dict(stats.get("convert_pairs", {}))))]
+
+
+def donation_coverage(text, large_bytes=1 << 20):
+    """(donated_bytes, large_bytes_total, coverage) over entry params at
+    least ``large_bytes`` big. Zero large params -> coverage 1.0 (nothing
+    worth donating)."""
+    params = hlo_stats.entry_params(text)
+    large = [p for p in params if p["bytes"] >= large_bytes]
+    total = sum(p["bytes"] for p in large)
+    donated = sum(p["bytes"] for p in large if p["donated"])
+    cov = (donated / total) if total else 1.0
+    return donated, total, cov
+
+
+def donation_coverage_pass(text, label, min_coverage=0.5,
+                           large_bytes=1 << 20):
+    """Fail when less than ``min_coverage`` of large-parameter bytes are
+    donated (``jax.buffer_donor`` / ``tf.aliasing_output`` attrs)."""
+    donated, total, cov = donation_coverage(text, large_bytes=large_bytes)
+    if cov >= min_coverage:
+        return []
+    return [_diag("MXL502", label,
+                  "only %.0f%% of large-param bytes donated "
+                  "(%.1f/%.1f MiB; floor %.0f%%) — undonated params are "
+                  "double-buffered in HBM"
+                  % (cov * 100, donated / 2**20, total / 2**20,
+                     min_coverage * 100))]
+
+
+def d2h_count(text):
+    """Count of ops implying a device->host (or host-blocking) transfer:
+    callback-ish custom_calls plus outfeed/infeed/send/recv ops."""
+    n = 0
+    for target, c in hlo_stats.custom_call_targets(text).items():
+        low = target.lower()
+        if any(f in low for f in _D2H_TARGET_FRAGMENTS):
+            n += c
+    stats = hlo_stats.analyze_stablehlo(text)
+    for op in _D2H_OPS:
+        n += stats.get("top_ops", {}).get(op, 0)
+    return n
+
+
+def d2h_transfer_pass(text, label, budget=0):
+    """Fail when the module contains more than ``budget`` host-transfer
+    ops (the PR-3 sync-budget discipline applied to the lowered graph)."""
+    n = d2h_count(text)
+    if n <= budget:
+        return []
+    targets = {t: c for t, c in
+               hlo_stats.custom_call_targets(text).items()
+               if any(f in t.lower() for f in _D2H_TARGET_FRAGMENTS)}
+    return [_diag("MXL503", label,
+                  "%d host-transfer op(s) in the compiled program "
+                  "(budget %d): %s" % (n, budget, targets or "infeed/"
+                                       "outfeed ops"))]
+
+
+def _sig(x):
+    """Hashable shape/dtype fingerprint of one call argument. Arrays
+    collapse to (shape, dtype) — the thing jit keys compilation on —
+    scalars keep their type, and static-able values keep their value."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(x, (int, float, bool, str, bytes, type(None))):
+        return ("val", x)
+    if isinstance(x, (list, tuple)):
+        return ("seq", tuple(_sig(e) for e in x))
+    if isinstance(x, dict):
+        return ("map", tuple(sorted((k, _sig(v)) for k, v in x.items())))
+    return ("type", type(x).__name__)
+
+
+class RecompileFingerprint:
+    """Observes call signatures of one jitted function and flags churn.
+
+    Each distinct (shape, dtype, static-value) fingerprint is one XLA
+    compilation; seeing more than ``max_variants`` of them means the
+    caller is feeding unbucketed shapes or passing varying Python values
+    where an array (or a static constant) belongs.
+
+        fp = RecompileFingerprint("serve/predict", max_variants=4)
+        for batch in batches:
+            fp.observe(batch)
+        diags = fp.diagnostics()
+    """
+
+    def __init__(self, label, max_variants=3):
+        self.label = label
+        self.max_variants = max_variants
+        self._seen = collections.OrderedDict()   # fingerprint -> count
+
+    def observe(self, *args, **kwargs):
+        fp = (_sig(args), _sig(kwargs))
+        self._seen[fp] = self._seen.get(fp, 0) + 1
+        return fp
+
+    @property
+    def variants(self):
+        return len(self._seen)
+
+    def diagnostics(self):
+        if self.variants <= self.max_variants:
+            return []
+        shapes = []
+        for (asig, _ksig), count in list(self._seen.items())[:6]:
+            shapes.append("%sx%d" % (_fmt_sig(asig), count))
+        return [_diag("MXL504", self.label,
+                      "%d distinct call signatures (limit %d) — each is "
+                      "a recompile: %s%s"
+                      % (self.variants, self.max_variants,
+                         "; ".join(shapes),
+                         "; ..." if self.variants > 6 else ""))]
+
+
+def _fmt_sig(sig):
+    kind = sig[0]
+    if kind == "arr":
+        return "%s[%s]" % (sig[2], ",".join(map(str, sig[1])))
+    if kind == "seq":
+        return "(%s)" % ",".join(_fmt_sig(e) for e in sig[1])
+    if kind == "val":
+        return repr(sig[1])
+    if kind == "map":
+        return "{%s}" % ",".join("%s=%s" % (k, _fmt_sig(v))
+                                 for k, v in sig[1])
+    return sig[1] if len(sig) > 1 else kind
+
+
+def metrics_from_text(text, large_bytes=1 << 20):
+    """The bench-facing summary of the HLO passes: one flat dict suitable
+    for a BENCH_*.json line (satellite: trajectory files track lint
+    metrics alongside step time)."""
+    stats = hlo_stats.analyze_stablehlo(text)
+    donated, total, cov = donation_coverage(text, large_bytes=large_bytes)
+    return {
+        "convert_count": stats["convert_count"],
+        "convert_f32_bf16": hlo_stats.convert_count_between(
+            stats, "f32", "bf16"),
+        "donation_coverage": round(cov, 4),
+        "donated_mib": round(donated / 2**20, 2),
+        "large_param_mib": round(total / 2**20, 2),
+        "d2h_count": d2h_count(text),
+        "total_ops": stats["total_ops"],
+    }

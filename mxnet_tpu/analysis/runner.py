@@ -1,0 +1,147 @@
+"""mxlint driver: file discovery, rule dispatch, baseline partition.
+
+The runner owns the only piece of cross-file state — the lock-order
+graph — so ``lint_paths`` must see all files of interest in one call for
+MXL402 to compare acquisition orders between e.g. ``serve/server.py``
+and ``io/io.py``.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+
+from . import baseline as baseline_mod
+from .diagnostics import Diagnostic, assign_indices
+from .rules_ast import (LockOrderCollector, RULES, analyze_module)
+from .rules_ast import Rule
+
+__all__ = ["all_rules", "iter_python_files", "changed_files",
+           "lint_sources", "lint_paths", "LintResult", "run"]
+
+# parse failures are findings too (a file the analyzer cannot read is a
+# file the analyzer cannot vouch for), not crashes
+PARSE_RULE = Rule("MXL001", "parse-error", "error",
+                  "fix the syntax error so mxlint can analyze the file")
+
+_SKIP_DIRS = frozenset([
+    "__pycache__", ".git", ".pytest_cache", "build", "dist",
+    ".ipynb_checkpoints",
+])
+
+
+def all_rules():
+    """{rule_id: Rule} across both layers (AST + HLO) plus MXL001."""
+    from .hlo_passes import HLO_RULES
+    out = dict(RULES)
+    out.update(HLO_RULES)
+    out[PARSE_RULE.id] = PARSE_RULE
+    return out
+
+
+def _norm(path, root=None):
+    """Repo-relative forward-slash path for stable baseline keys."""
+    p = os.path.abspath(path)
+    base = os.path.abspath(root) if root else os.getcwd()
+    try:
+        rel = os.path.relpath(p, base)
+    except ValueError:          # different drive (windows)
+        rel = p
+    if not rel.startswith(".."):
+        p = rel
+    return p.replace(os.sep, "/")
+
+
+def iter_python_files(paths):
+    """Expand files/directories into a sorted list of .py files."""
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif path.endswith(".py") and os.path.exists(path):
+            out.append(path)
+    return sorted(set(out))
+
+
+def changed_files(root=None):
+    """.py files touched per ``git diff --name-only HEAD`` (staged +
+    unstaged) — the --changed pre-commit mode. Returns None when git is
+    unavailable so the caller can fall back to a full run."""
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, cwd=root or None, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    base = root or os.getcwd()
+    out = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            p = os.path.join(base, line)
+            if os.path.exists(p):
+                out.append(p)
+    return out
+
+
+def lint_sources(sources, enabled=None):
+    """Lint {path: source_text} (already-normalized paths). The unit the
+    tests drive with synthetic fixtures — no filesystem involved."""
+    diags = []
+    locks = LockOrderCollector()
+    for path in sorted(sources):
+        try:
+            diags.extend(analyze_module(path, sources[path],
+                                        lock_collector=locks,
+                                        enabled=enabled))
+        except SyntaxError as e:
+            if enabled is None or PARSE_RULE.id in enabled:
+                diags.append(Diagnostic(
+                    PARSE_RULE.id, path, e.lineno or 1, (e.offset or 1) - 1,
+                    "error", "syntax error: %s" % e.msg,
+                    hint=PARSE_RULE.hint))
+    diags.extend(locks.diagnostics(enabled=enabled))
+    return assign_indices(diags)
+
+
+def lint_paths(paths, enabled=None, root=None):
+    """Lint files/directories; returns indexed diagnostics."""
+    sources = {}
+    for f in iter_python_files(paths):
+        try:
+            with open(f, encoding="utf-8", errors="replace") as fh:
+                sources[_norm(f, root)] = fh.read()
+        except OSError:
+            continue
+    return lint_sources(sources, enabled=enabled)
+
+
+class LintResult:
+    """Outcome of one run against a baseline."""
+
+    __slots__ = ("diags", "new", "baselined", "stale")
+
+    def __init__(self, diags, new, baselined, stale):
+        self.diags = diags          # all diagnostics, indexed
+        self.new = new              # not in baseline -> gate fails
+        self.baselined = baselined  # known debt -> gate passes
+        self.stale = stale          # paid-off baseline keys
+
+    @property
+    def exit_code(self):
+        return 1 if self.new else 0
+
+
+def run(paths, baseline_path=None, enabled=None, root=None):
+    """Lint ``paths`` and partition against the baseline (if given)."""
+    diags = lint_paths(paths, enabled=enabled, root=root)
+    entries = baseline_mod.load(baseline_path) if baseline_path else {}
+    new, baselined, stale = baseline_mod.partition(diags, entries)
+    return LintResult(diags, new, baselined, stale)

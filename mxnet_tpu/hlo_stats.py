@@ -89,6 +89,101 @@ def analyze_stablehlo(text):
     return out
 
 
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "c64": 8, "c128": 16,
+}
+
+_ENTRY_RE = re.compile(r"func\.func\s+(?:public\s+)?@(\w+)\s*\(")
+_DONOR_RE = re.compile(r"jax\.buffer_donor\s*=\s*true|tf\.aliasing_output")
+_CUSTOM_CALL_RE = re.compile(r"stablehlo\.custom_call\s+@([\w.$-]+)")
+
+
+def _matching_paren(text, open_idx):
+    """Index just past the ')' matching the '(' at ``open_idx``; -1 if the
+    text ends first (truncated module)."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    return -1
+
+
+def entry_params(text):
+    """Parse the entry computation's parameter list from StableHLO text.
+
+    Returns a list of dicts — ``{"name", "dtype", "elems", "bytes",
+    "donated"}`` in argument order — for the first ``func.func public``
+    (falling back to any ``func.func``). A module with **zero entry
+    computations** (e.g. an empty or constant-folded-away lowering)
+    returns ``[]`` instead of raising, and parameters whose type is not a
+    plain ranked tensor (token, tuple) are included with ``elems=0``.
+    """
+    m = None
+    for cand in _ENTRY_RE.finditer(text):
+        m = cand
+        # prefer @main / the first public func; _ENTRY_RE already skips
+        # private helper parens like stablehlo.reduce regions
+        break
+    if m is None:
+        return []
+    open_idx = text.index("(", m.end() - 1)
+    close_idx = _matching_paren(text, open_idx)
+    if close_idx < 0:
+        return []
+    sig = text[open_idx + 1:close_idx]
+    params = []
+    # split on top-level commas only (attr dicts contain commas)
+    depth = 0
+    start = 0
+    parts = []
+    for i, c in enumerate(sig):
+        if c in "({<[":
+            depth += 1
+        elif c in ")}>]":
+            depth -= 1
+        elif c == "," and depth == 0:
+            parts.append(sig[start:i])
+            start = i + 1
+    if sig[start:].strip():
+        parts.append(sig[start:])
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        name = part.split(":", 1)[0].strip()
+        tm = _SHAPE_RE.search(part)
+        if tm:
+            dtype = tm.group(2)
+            elems = _elems(tm.group(1)) if tm.group(1) else 1
+        else:
+            dtype, elems = "unknown", 0
+        params.append({
+            "name": name,
+            "dtype": dtype,
+            "elems": elems,
+            "bytes": elems * _DTYPE_BYTES.get(dtype, 4),
+            "donated": bool(_DONOR_RE.search(part)),
+        })
+    return params
+
+
+def custom_call_targets(text):
+    """Counter of ``stablehlo.custom_call`` target names in the module.
+
+    Robust to tuple-returning custom calls (``%0:2 = stablehlo.custom_call
+    @target(...) : (...) -> (tensor<...>, tensor<...>)``) — the target is
+    read from the op token itself, never from the result arity."""
+    return collections.Counter(_CUSTOM_CALL_RE.findall(text))
+
+
 def convert_count_between(stats, a, b):
     """Total converts in either direction between element types ``a`` and
     ``b`` (e.g. ``("f32", "bf16")``) from an :func:`analyze_stablehlo`

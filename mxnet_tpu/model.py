@@ -139,11 +139,17 @@ class FeedForward:
                 # trimmed the same way or rows misalign (reference
                 # model.py:677 trims all three)
                 pad = getattr(batch, "pad", None) or 0
-                outs.append(batch_outs[0].asnumpy())
-                d = batch.data[0].asnumpy()
-                datas.append(d[:d.shape[0] - pad] if pad else d)
+                # one device->host sync per batch (mxlint MXL103)
                 if batch.label:
-                    lab = batch.label[0].asnumpy()
+                    out_h, d, lab = _nd.asnumpy_all(
+                        batch_outs[0], batch.data[0], batch.label[0])
+                else:
+                    out_h, d = _nd.asnumpy_all(batch_outs[0],
+                                               batch.data[0])
+                    lab = None
+                outs.append(out_h)
+                datas.append(d[:d.shape[0] - pad] if pad else d)
+                if lab is not None:
                     labels.append(lab[:lab.shape[0] - pad] if pad else lab)
             return (_np.concatenate(outs),
                     _np.concatenate(datas),

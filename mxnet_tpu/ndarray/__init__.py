@@ -1,7 +1,7 @@
 """Eager NDArray package (parity: python/mxnet/ndarray/)."""
 from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,
                       concat, invoke, waitall, save, load, moveaxis,
-                      imperative_invoke)
+                      imperative_invoke, asnumpy_all)
 from . import register as _register
 from . import random
 from . import contrib

@@ -705,6 +705,27 @@ def waitall():
     _engine.waitall()
 
 
+def asnumpy_all(*arrays):
+    """Fetch several arrays to host in ONE blocking device->host sync.
+
+    The batched counterpart of per-array ``asnumpy()``: N separate
+    fetches in a loop body are N device round-trips (mxlint MXL103);
+    this moves the whole tuple in a single ``jax.device_get``. Non-device
+    values (numpy, scalars) pass through unchanged.
+
+        loss_h, out_h, label_h = nd.asnumpy_all(loss, out, label)
+    """
+    devs = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    pending = [d for d in devs if hasattr(d, "block_until_ready")]
+    if pending:
+        from .. import profiler as _profiler
+        _profiler.record_host_sync(
+            "d2h", sum(int(getattr(d, "nbytes", 0)) for d in pending))
+        import jax
+        devs = jax.device_get(devs)
+    return tuple(_np.asarray(d) for d in devs)
+
+
 # ---------------------------------------------------------------------------
 # serialization — reference binary .params format (ndarray.cc:1583-1795),
 # see serialization.py for the wire layout. Round-1/2 npz files still load.

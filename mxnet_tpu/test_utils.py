@@ -158,10 +158,12 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=1e-5, atol=1e-7):
     """Run fn on each context and cross-compare outputs
     (reference test_utils.check_consistency:1213)."""
     ctx_list = ctx_list or [cpu(), default_context()]
+    # fetch inputs to host once, not once per context (mxlint MXL103)
+    ins_np = [x.asnumpy() if isinstance(x, nd.NDArray) else x
+              for x in inputs]
     outs = []
     for c in ctx_list:
-        ins = [nd.array(x.asnumpy() if isinstance(x, nd.NDArray) else x, ctx=c)
-               for x in inputs]
+        ins = [nd.array(x, ctx=c) for x in ins_np]
         o = fn(*ins)
         outs.append(o.asnumpy())
     for o in outs[1:]:

@@ -56,6 +56,60 @@ def test_analyze_real_lowering():
     assert st["total_ops"] >= 4
 
 
+_TUPLE_CUSTOM_CALL = """
+module @jit_g {
+  func.func public @main(%arg0: tensor<4xf32> {tf.aliasing_output = 0 : i32}, %arg1: tensor<2x2xf32>, %arg2: !stablehlo.token) -> tensor<4xf32> {
+    %0:2 = stablehlo.custom_call @xla_python_cpu_callback(%arg0) {api_version = 2 : i32} : (tensor<4xf32>) -> (tensor<4xf32>, tensor<4xi32>)
+    %1 = stablehlo.custom_call @Sharding(%0#0) : (tensor<4xf32>) -> tensor<4xf32>
+    return %1 : tensor<4xf32>
+  }
+}
+"""
+
+
+def test_entry_params_zero_entry_module():
+    """A module with no entry computation returns [] instead of raising
+    (found while generalizing hlo_stats into mxlint Layer 2)."""
+    assert hs.entry_params("") == []
+    assert hs.entry_params("module @jit_empty {\n}\n") == []
+    # truncated signature (unbalanced parens) degrades to [] too
+    assert hs.entry_params("func.func public @main(%arg0: tensor<") == []
+
+
+def test_entry_params_parses_donation_and_bytes():
+    params = hs.entry_params(_TUPLE_CUSTOM_CALL)
+    assert [p["name"] for p in params] == ["%arg0", "%arg1", "%arg2"]
+    assert params[0]["donated"] and params[0]["bytes"] == 16
+    assert not params[1]["donated"] and params[1]["bytes"] == 16
+    # non-tensor (token) params are included but carry no bytes
+    assert params[2]["elems"] == 0
+
+
+def test_custom_call_targets_tuple_returning():
+    """Tuple-returning custom calls (``%0:2 = ...``) must not confuse the
+    target census."""
+    targets = hs.custom_call_targets(_TUPLE_CUSTOM_CALL)
+    assert targets == {"xla_python_cpu_callback": 1, "Sharding": 1}
+    assert hs.custom_call_targets("") == {}
+
+
+def test_analyze_stablehlo_empty_module():
+    st = hs.analyze_stablehlo("")
+    assert st["convert_count"] == 0 and st["total_ops"] == 0
+
+
+def test_entry_params_real_lowering():
+    def step(w, g):
+        return w - 0.1 * g
+
+    z = jnp.zeros((16, 16), jnp.float32)
+    text = jax.jit(step, donate_argnums=(0,)).lower(z, z).as_text()
+    params = hs.entry_params(text)
+    assert len(params) == 2
+    assert params[0]["donated"] and not params[1]["donated"]
+    assert params[0]["bytes"] == 16 * 16 * 4
+
+
 def test_tool_reexports_shared_impl():
     """tools/diagnose_step_hlo.py must consume the same counters the
     regression test does."""

@@ -1,0 +1,75 @@
+"""Tier-1 gate: the repo is mxlint-clean against its committed baseline.
+
+This is the CI teeth of PR 5 — a new TPU-discipline violation anywhere in
+mxnet_tpu/, tools/, or examples/ fails the suite with the exact file:line
+and fix hint, while the committed debt (tools/mxlint_baseline.json) is
+tolerated but ratcheted: it may only shrink. Chip-free and fast (pure AST
+— Layer 2 passes have their own lowering-based tests in test_mxlint.py),
+so it is deliberately NOT marked slow.
+"""
+import os
+import subprocess
+import sys
+
+from mxnet_tpu import profiler
+from mxnet_tpu.analysis import baseline as baseline_mod
+from mxnet_tpu.analysis.runner import run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "mxlint_baseline.json")
+SCOPE = [os.path.join(REPO, d) for d in ("mxnet_tpu", "tools", "examples")]
+
+MAX_BASELINE_ENTRIES = 25
+
+
+def test_repo_is_lint_clean():
+    result = run(SCOPE, baseline_path=BASELINE, root=REPO)
+    # chrome traces chart lint debt over time (satellite: profiler hook)
+    profiler.record_counter("lint/violations",
+                            len(result.new) + len(result.baselined))
+    assert not result.new, (
+        "new mxlint violations (see docs/lint.md; run `python "
+        "tools/mxlint.py` locally):\n"
+        + "\n".join(d.format() for d in result.new))
+    assert not result.stale, (
+        "baseline entries no longer fire — pay the ratchet forward with "
+        "`python tools/mxlint.py --baseline-update`:\n  "
+        + "\n  ".join(result.stale))
+
+
+def test_baseline_is_bounded():
+    entries = baseline_mod.load(BASELINE)
+    assert len(entries) <= MAX_BASELINE_ENTRIES, (
+        "mxlint baseline grew to %d entries (cap %d): fix violations "
+        "instead of baselining them" % (len(entries),
+                                        MAX_BASELINE_ENTRIES))
+
+
+def test_analysis_package_is_import_light():
+    """Importing (and running Layer 1 of) the analyzer must initialize
+    no XLA backend — the same hygiene `import mxnet_tpu` promises — so
+    the CLI and the pre-commit --changed mode stay chip-free and fast."""
+    code = (
+        "import jax\n"
+        "import jax._src.xla_bridge as xb\n"
+        "import mxnet_tpu.analysis\n"
+        "import mxnet_tpu.analysis.rules_ast\n"
+        "import mxnet_tpu.analysis.hlo_passes\n"
+        "from mxnet_tpu.analysis import lint_sources\n"
+        "lint_sources({'x.py': 'import jax\\n'\n"
+        "              'def f(x):\\n    return float(x)\\n'\n"
+        "              'g = jax.jit(f)\\n'})\n"
+        "assert not xb._backends, "
+        "'backends initialized: %r' % list(xb._backends)\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_exits_zero_on_repo():
+    """The acceptance-criteria invocation, exactly as documented."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "mxlint.py"),
+         "mxnet_tpu", "tools", "examples"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,567 @@
+"""mxlint unit tests: every rule catches a seeded bug and passes on the
+corrected version; the baseline ratchet only tightens; the CLI exit codes
+hold. All chip-free — Layer 1 never imports jax, Layer 2 lowers under the
+CPU platform the suite already pins."""
+import json
+import os
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import baseline as baseline_mod
+from mxnet_tpu.analysis import lint_sources
+from mxnet_tpu.analysis import hlo_passes
+from mxnet_tpu.analysis.runner import lint_paths
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import mxlint as mxlint_cli  # noqa: E402
+
+sys.path.pop(0)
+
+
+def _rules(src, path="fix.py"):
+    return sorted({d.rule for d in lint_sources({path: src})})
+
+
+def _diags(src, path="fix.py"):
+    return lint_sources({path: src})
+
+
+# ---------------------------------------------------------------- layer 1
+
+class TestHostSyncRules:
+    def test_asnumpy_in_jitted_body_fires(self):
+        bad = (
+            "import jax\n"
+            "def step(params, batch):\n"
+            "    h = batch.asnumpy()\n"
+            "    return params\n"
+            "train = jax.jit(step)\n")
+        assert "MXL101" in _rules(bad)
+
+    def test_device_get_in_scanned_body_fires(self):
+        bad = (
+            "import jax\n"
+            "from jax import lax\n"
+            "def body(carry, x):\n"
+            "    v = jax.device_get(x)\n"
+            "    return carry, v\n"
+            "def run(xs):\n"
+            "    return lax.scan(body, 0, xs)\n")
+        assert "MXL101" in _rules(bad)
+
+    def test_np_asarray_in_fused_decorated_fires(self):
+        bad = (
+            "import numpy as np\n"
+            "def fused(f):\n"
+            "    return f\n"
+            "@fused\n"
+            "def step(x):\n"
+            "    return np.asarray(x)\n")
+        assert "MXL101" in _rules(bad)
+
+    def test_float_coercion_fires_and_corrected_passes(self):
+        bad = (
+            "import jax\n"
+            "def step(x):\n"
+            "    return float(x) * 2\n"
+            "f = jax.jit(step)\n")
+        good = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def step(x):\n"
+            "    return x.astype(jnp.float32) * 2\n"
+            "f = jax.jit(step)\n")
+        assert "MXL102" in _rules(bad)
+        assert _rules(good) == []
+
+    def test_asnumpy_outside_traced_body_is_fine(self):
+        good = (
+            "def evaluate(out):\n"
+            "    return out.asnumpy().sum()\n")
+        assert _rules(good) == []
+
+    def test_unbatched_loop_fetch_fires_and_batched_passes(self):
+        bad = (
+            "import jax\n"
+            "def loop(batches, f):\n"
+            "    for b in batches:\n"
+            "        out = f(b)\n"
+            "        x = out[0].asnumpy()\n"
+            "        y = out[1].asnumpy()\n")
+        good = (
+            "import jax\n"
+            "def loop(batches, f):\n"
+            "    for b in batches:\n"
+            "        out = f(b)\n"
+            "        x, y = jax.device_get((out[0], out[1]))\n")
+        assert "MXL103" in _rules(bad)
+        assert _rules(good) == []
+
+
+class TestRetraceRules:
+    def test_python_branch_on_traced_fires(self):
+        bad = (
+            "import jax\n"
+            "def step(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+            "f = jax.jit(step)\n")
+        assert "MXL201" in _rules(bad)
+
+    def test_branch_on_tainted_local_fires(self):
+        bad = (
+            "import jax\n"
+            "def step(batch):\n"
+            "    x = batch['data'] * 2\n"
+            "    if x.sum() > 0:\n"
+            "        x = -x\n"
+            "    return x\n"
+            "f = jax.jit(step)\n")
+        assert "MXL201" in _rules(bad)
+
+    def test_branch_on_shape_or_none_passes(self):
+        good = (
+            "import jax\n"
+            "def step(x, state):\n"
+            "    if x.shape[0] > 4:\n"
+            "        x = x[:4]\n"
+            "    if state is not None and x.ndim == 2:\n"
+            "        x = x + state\n"
+            "    return x\n"
+            "f = jax.jit(step)\n")
+        assert _rules(good) == []
+
+    def test_branch_on_dict_key_comprehension_passes(self):
+        # dict keys are static pytree structure under jit — the fused
+        # Module's per-group downcast filter must stay clean
+        good = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def step(d):\n"
+            "    cast = [k for k, v in d.items()\n"
+            "            if v.dtype == jnp.float32 and v.size > 0]\n"
+            "    if cast:\n"
+            "        pass\n"
+            "    return d\n"
+            "f = jax.jit(step)\n")
+        assert _rules(good) == []
+
+    def test_branch_on_traced_dict_value_in_comprehension_fires(self):
+        bad = (
+            "import jax\n"
+            "def step(d):\n"
+            "    pos = [v for k, v in d.items() if v > 0]\n"
+            "    return d\n"
+            "f = jax.jit(step)\n")
+        assert "MXL201" not in _rules(bad)  # comprehension itself is fine
+        bad2 = (
+            "import jax\n"
+            "def step(d):\n"
+            "    total = sum(v.sum() for k, v in d.items())\n"
+            "    if total > 0:\n"
+            "        pass\n"
+            "    return d\n"
+            "f = jax.jit(step)\n")
+        assert "MXL201" in _rules(bad2)
+
+    def test_fstring_of_traced_value_fires_and_shape_passes(self):
+        bad = (
+            "import jax\n"
+            "def step(x):\n"
+            "    name = f'val={x}'\n"
+            "    return x\n"
+            "f = jax.jit(step)\n")
+        good = (
+            "import jax\n"
+            "def step(x):\n"
+            "    name = f'shape={x.shape}'\n"
+            "    return x\n"
+            "f = jax.jit(step)\n")
+        assert "MXL202" in _rules(bad)
+        assert _rules(good) == []
+
+    def test_unhashable_static_arg_fires_and_tuple_passes(self):
+        bad = (
+            "import jax\n"
+            "def step(x, dims):\n"
+            "    return x\n"
+            "f = jax.jit(step, static_argnums=(1,))\n"
+            "def run(x):\n"
+            "    return f(x, [1, 2])\n")
+        good = bad.replace("[1, 2]", "(1, 2)")
+        assert "MXL203" in _rules(bad)
+        assert _rules(good) == []
+
+    def test_unhashable_static_argname_fires(self):
+        bad = (
+            "import jax\n"
+            "def step(x, dims=None):\n"
+            "    return x\n"
+            "f = jax.jit(step, static_argnames=('dims',))\n"
+            "def run(x):\n"
+            "    return f(x, dims={'a': 1})\n")
+        assert "MXL203" in _rules(bad)
+
+
+class TestDonationRule:
+    BAD = (
+        "import jax\n"
+        "def step(params, grads):\n"
+        "    return params\n"
+        "train = jax.jit(step, donate_argnums=(0,))\n"
+        "def loop(params, grads):\n"
+        "    out = train(params, grads)\n"
+        "    norm = params.sum()\n"      # use-after-donation
+        "    return out, norm\n")
+    GOOD = (
+        "import jax\n"
+        "def step(params, grads):\n"
+        "    return params\n"
+        "train = jax.jit(step, donate_argnums=(0,))\n"
+        "def loop(params, grads):\n"
+        "    params = train(params, grads)\n"   # rebind: buffer is new
+        "    norm = params.sum()\n"
+        "    return params, norm\n")
+
+    def test_use_after_donation_fires(self):
+        assert "MXL301" in _rules(self.BAD)
+
+    def test_rebind_after_donation_passes(self):
+        assert _rules(self.GOOD) == []
+
+    def test_method_style_wrapper_tracked(self):
+        bad = (
+            "import jax\n"
+            "class T:\n"
+            "    def __init__(self, step):\n"
+            "        self._jitted = jax.jit(step, donate_argnums=(0,))\n"
+            "    def run(self, params, batch):\n"
+            "        out = self._jitted(params, batch)\n"
+            "        stale = params\n"
+            "        return out, stale\n")
+        assert "MXL301" in _rules(bad)
+
+
+class TestLockRules:
+    def test_blocking_queue_put_under_lock_fires(self):
+        bad = (
+            "import threading, queue\n"
+            "_lock = threading.Lock()\n"
+            "_q = queue.Queue()\n"
+            "def produce(x):\n"
+            "    with _lock:\n"
+            "        _q.put(x)\n")
+        assert "MXL401" in _rules(bad)
+
+    def test_device_get_under_lock_fires_and_outside_passes(self):
+        bad = (
+            "import jax, threading\n"
+            "class Cache:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def fetch(self, arr):\n"
+            "        with self._lock:\n"
+            "            return jax.device_get(arr)\n")
+        good = (
+            "import jax, threading\n"
+            "class Cache:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def fetch(self, arr):\n"
+            "        host = jax.device_get(arr)\n"
+            "        with self._lock:\n"
+            "            self.last = host\n"
+            "        return host\n")
+        assert "MXL401" in _rules(bad)
+        assert _rules(good) == []
+
+    def test_condition_wait_is_not_blocking(self):
+        # Condition.wait releases the lock while sleeping — the
+        # admission-queue pattern must stay clean
+        good = (
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._cond = threading.Condition()\n"
+            "    def take(self):\n"
+            "        with self._cond:\n"
+            "            while not self.items:\n"
+            "                self._cond.wait(0.1)\n"
+            "            return self.items.pop()\n")
+        assert _rules(good) == []
+
+    def test_nonblocking_put_passes(self):
+        good = (
+            "import threading, queue\n"
+            "_lock = threading.Lock()\n"
+            "_q = queue.Queue()\n"
+            "def produce(x):\n"
+            "    with _lock:\n"
+            "        _q.put(x, block=False)\n")
+        assert _rules(good) == []
+
+    def test_inconsistent_lock_order_across_files_fires(self):
+        a = (
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n")
+        b = (
+            "from mod_a import a_lock, b_lock\n"
+            "def g():\n"
+            "    with b_lock:\n"
+            "        with a_lock:\n"
+            "            pass\n")
+        diags = lint_sources({"mod_a.py": a, "mod_b.py": b})
+        assert {d.rule for d in diags} == {"MXL402"}
+        assert {d.path for d in diags} == {"mod_a.py", "mod_b.py"}
+
+    def test_consistent_lock_order_passes(self):
+        a = (
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n")
+        assert _rules(a) == []
+
+
+def test_parse_error_is_a_diagnostic_not_a_crash():
+    diags = _diags("def broken(:\n")
+    assert [d.rule for d in diags] == ["MXL001"]
+
+
+# ------------------------------------------------------------ diagnostics
+
+def test_baseline_key_is_line_number_free():
+    """Inserting code above a violation must not churn its baseline key."""
+    bad = (
+        "import jax\n"
+        "def step(x):\n"
+        "    return float(x)\n"
+        "f = jax.jit(step)\n")
+    shifted = "import os\n\n\n" + bad
+    k1 = [d.key() for d in _diags(bad)]
+    k2 = [d.key() for d in _diags(shifted)]
+    assert k1 == k2 and len(k1) == 1
+    assert "::step#0" in k1[0]
+
+
+def test_diagnostic_payload_fields():
+    d = _diags("import jax\n"
+               "def step(x):\n"
+               "    return float(x)\n"
+               "f = jax.jit(step)\n")[0]
+    payload = d.to_dict()
+    for field in ("rule", "path", "line", "col", "severity", "symbol",
+                  "message", "hint", "key"):
+        assert field in payload
+    assert payload["line"] == 3
+    assert "float" in d.format()
+
+
+# ---------------------------------------------------------------- layer 2
+
+@pytest.fixture(scope="module")
+def lowerings():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    w = np.zeros((256, 256), np.float32)
+    g = np.zeros((256, 256), np.float32)
+
+    def sgd(w, g):
+        # two outputs so BOTH donated inputs have a buffer to alias
+        return w - 0.1 * g, g * 0.9
+
+    def sgd_bf16_detour(w, g):
+        return (w - (0.1 * g.astype(jnp.bfloat16)).astype(jnp.float32),
+                g * 0.9)
+
+    def with_callback(w, g):
+        jax.debug.callback(lambda v: None, g.sum())
+        return w - 0.1 * g, g * 0.9
+
+    return {
+        "donated": jax.jit(sgd, donate_argnums=(0, 1)).lower(w, g).as_text(),
+        "undonated": jax.jit(sgd).lower(w, g).as_text(),
+        "bf16_detour": jax.jit(sgd_bf16_detour).lower(w, g).as_text(),
+        "callback": jax.jit(with_callback).lower(w, g).as_text(),
+    }
+
+
+class TestHloPasses:
+    def test_convert_budget_catches_and_passes(self, lowerings):
+        bad = hlo_passes.convert_budget_pass(
+            lowerings["bf16_detour"], "step", budget=0)
+        assert len(bad) == 1 and bad[0].rule == "MXL501"
+        assert hlo_passes.convert_budget_pass(
+            lowerings["donated"], "step", budget=0) == []
+
+    def test_donation_coverage_catches_and_passes(self, lowerings):
+        bad = hlo_passes.donation_coverage_pass(
+            lowerings["undonated"], "step", min_coverage=0.5,
+            large_bytes=1024)
+        assert len(bad) == 1 and bad[0].rule == "MXL502"
+        assert hlo_passes.donation_coverage_pass(
+            lowerings["donated"], "step", min_coverage=0.99,
+            large_bytes=1024) == []
+
+    def test_donation_coverage_no_large_params_is_clean(self):
+        # zero large params -> nothing worth donating -> coverage 1.0
+        assert hlo_passes.donation_coverage("", large_bytes=1)[2] == 1.0
+
+    def test_d2h_catches_callback_and_passes_clean(self, lowerings):
+        bad = hlo_passes.d2h_transfer_pass(
+            lowerings["callback"], "step", budget=0)
+        assert len(bad) == 1 and bad[0].rule == "MXL503"
+        assert hlo_passes.d2h_transfer_pass(
+            lowerings["donated"], "step", budget=0) == []
+
+    def test_metrics_from_text(self, lowerings):
+        m = hlo_passes.metrics_from_text(lowerings["donated"],
+                                         large_bytes=1024)
+        assert m["donation_coverage"] == 1.0
+        assert m["d2h_count"] == 0
+        m2 = hlo_passes.metrics_from_text(lowerings["bf16_detour"],
+                                          large_bytes=1024)
+        assert m2["convert_f32_bf16"] >= 2
+
+
+class TestRecompileFingerprint:
+    def test_shape_churn_flagged(self):
+        import numpy as np
+        fp = hlo_passes.RecompileFingerprint("predict", max_variants=2)
+        for n in (1, 2, 3, 4):
+            fp.observe(np.zeros((n, 8), np.float32))
+        diags = fp.diagnostics()
+        assert len(diags) == 1 and diags[0].rule == "MXL504"
+        assert fp.variants == 4
+
+    def test_bucketed_shapes_pass(self):
+        import numpy as np
+        fp = hlo_passes.RecompileFingerprint("predict", max_variants=2)
+        for n in (1, 3, 2, 4):
+            bucket = 4    # serve/engine_cache-style padding
+            fp.observe(np.zeros((bucket, 8), np.float32))
+        assert fp.diagnostics() == [] and fp.variants == 1
+
+    def test_static_value_churn_flagged(self):
+        fp = hlo_passes.RecompileFingerprint("step", max_variants=2)
+        for lr in (0.1, 0.2, 0.3):
+            fp.observe(lr=lr)
+        assert fp.diagnostics() and fp.variants == 3
+
+
+# ------------------------------------------------------------ the ratchet
+
+BAD_SRC = (
+    "import jax\n"
+    "def step(x):\n"
+    "    return float(x)\n"
+    "f = jax.jit(step)\n")
+
+
+class TestBaselineRatchet:
+    def _write(self, tmp_path, name, src):
+        p = tmp_path / name
+        p.write_text(src)
+        return str(p)
+
+    def test_new_violation_fails_baselined_passes(self, tmp_path):
+        f = self._write(tmp_path, "mod.py", BAD_SRC)
+        bl = str(tmp_path / "baseline.json")
+        diags = lint_paths([f], root=str(tmp_path))
+        assert diags
+        # not baselined -> new
+        new, baselined, stale = baseline_mod.partition(
+            diags, baseline_mod.load(bl))
+        assert new and not baselined
+        # baselined -> passes
+        baseline_mod.update(bl, diags, allow_growth=True)
+        new, baselined, stale = baseline_mod.partition(
+            diags, baseline_mod.load(bl))
+        assert not new and baselined and not stale
+
+    def test_update_shrinks_but_never_grows(self, tmp_path):
+        f = self._write(tmp_path, "mod.py", BAD_SRC)
+        bl = str(tmp_path / "baseline.json")
+        diags = lint_paths([f], root=str(tmp_path))
+        baseline_mod.update(bl, diags, allow_growth=True)
+        assert len(baseline_mod.load(bl)) == 1
+
+        # violation fixed -> shrink happens without any flag
+        self._write(tmp_path, "mod.py",
+                    "def step(x):\n    return x\n")
+        diags = lint_paths([str(tmp_path / "mod.py")], root=str(tmp_path))
+        baseline_mod.update(bl, diags)
+        assert baseline_mod.load(bl) == {}
+
+        # new violation -> growth refused without allow_growth
+        self._write(tmp_path, "mod.py", BAD_SRC)
+        diags = lint_paths([str(tmp_path / "mod.py")], root=str(tmp_path))
+        with pytest.raises(baseline_mod.BaselineGrowthError):
+            baseline_mod.update(bl, diags)
+        assert baseline_mod.load(bl) == {}    # refused update wrote nothing
+        baseline_mod.update(bl, diags, allow_growth=True)
+        assert len(baseline_mod.load(bl)) == 1
+
+    def test_unsupported_baseline_format_raises(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"version": 99, "entries": {}}))
+        with pytest.raises(ValueError):
+            baseline_mod.load(str(bl))
+
+
+# ------------------------------------------------------------------- CLI
+
+class TestCli:
+    def test_exit_codes_and_json(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text(BAD_SRC)
+        bl = str(tmp_path / "bl.json")
+
+        rc = mxlint_cli.main([str(mod), "--no-baseline", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and out["new"] == 1
+        assert out["diagnostics"][0]["rule"] == "MXL102"
+
+        # clean file -> 0
+        clean = tmp_path / "ok.py"
+        clean.write_text("def f(x):\n    return x\n")
+        assert mxlint_cli.main([str(clean), "--no-baseline"]) == 0
+
+    def test_rule_filter_and_unknown_rule(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text(BAD_SRC)
+        rc = mxlint_cli.main([str(mod), "--no-baseline", "--rule",
+                              "MXL401"])
+        capsys.readouterr()
+        assert rc == 0          # only lock rules requested; none fire
+        assert mxlint_cli.main(["--rule", "MXL999"]) == 2
+
+    def test_list_rules(self, capsys):
+        assert mxlint_cli.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("MXL101", "MXL201", "MXL301", "MXL401", "MXL501",
+                    "MXL502", "MXL503", "MXL504"):
+            assert rid in out
+
+    def test_baseline_update_guard_needs_full_scope(self, tmp_path,
+                                                    capsys):
+        rc = mxlint_cli.main(["--baseline-update", "--rule", "MXL101"])
+        capsys.readouterr()
+        assert rc == 2

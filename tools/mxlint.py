@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""mxlint CLI: TPU-discipline static analysis over Python source.
+
+    python tools/mxlint.py                      # lint mxnet_tpu tools examples
+    python tools/mxlint.py mxnet_tpu/serve      # lint a subtree
+    python tools/mxlint.py --changed            # only git-diffed files
+    python tools/mxlint.py --json               # machine-readable output
+    python tools/mxlint.py --rule MXL401        # one rule family
+    python tools/mxlint.py --baseline-update    # prune paid-off debt
+    python tools/mxlint.py --list-rules         # rule catalog
+
+Exit codes: 0 = clean (or all findings baselined), 1 = new violations,
+2 = internal/usage error. The committed baseline (tools/mxlint_baseline
+.json) is a one-way ratchet: --baseline-update shrinks it, and refuses
+to grow it without --allow-growth. See docs/lint.md for the rule catalog.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from mxnet_tpu.analysis import baseline as baseline_mod   # noqa: E402
+from mxnet_tpu.analysis import runner                     # noqa: E402
+
+DEFAULT_PATHS = ["mxnet_tpu", "tools", "examples"]
+DEFAULT_BASELINE = os.path.join("tools", "mxlint_baseline.json")
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: %s)"
+                    % " ".join(DEFAULT_PATHS))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit diagnostics as one JSON object")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="only run this rule id (repeatable)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report all findings as new)")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(shrink-only unless --allow-growth)")
+    ap.add_argument("--allow-growth", action="store_true",
+                    help="let --baseline-update ADD entries")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files in `git diff --name-only HEAD`")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    return ap.parse_args(argv)
+
+
+def _list_rules():
+    rules = runner.all_rules()
+    for rid in sorted(rules):
+        r = rules[rid]
+        print("%s  %-26s %-7s %s" % (rid, r.name, r.severity, r.hint))
+    return 0
+
+
+def main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if args.list_rules:
+        return _list_rules()
+
+    enabled = None
+    if args.rule:
+        known = runner.all_rules()
+        bad = [r for r in args.rule if r not in known]
+        if bad:
+            print("mxlint: unknown rule id(s): %s (see --list-rules)"
+                  % ", ".join(bad), file=sys.stderr)
+            return 2
+        enabled = frozenset(args.rule)
+
+    if args.changed:
+        paths = runner.changed_files(root=_REPO)
+        if paths is None:
+            print("mxlint: git unavailable; falling back to full lint",
+                  file=sys.stderr)
+            paths = args.paths or DEFAULT_PATHS
+        elif not paths:
+            if args.as_json:
+                print(json.dumps({"diagnostics": [], "new": 0,
+                                  "baselined": 0, "stale": []}))
+            else:
+                print("mxlint: no changed .py files")
+            return 0
+    else:
+        paths = args.paths or DEFAULT_PATHS
+
+    baseline_path = None if args.no_baseline else args.baseline
+
+    try:
+        result = runner.run(paths, baseline_path=baseline_path,
+                            enabled=enabled, root=_REPO)
+    except Exception as e:   # internal error, distinct exit code
+        print("mxlint: internal error: %s: %s"
+              % (type(e).__name__, e), file=sys.stderr)
+        return 2
+
+    if args.baseline_update:
+        if args.rule or args.changed or args.paths:
+            print("mxlint: --baseline-update requires a full default-"
+                  "scope run (no --rule/--changed/path args): a partial "
+                  "run would prune entries it never scanned",
+                  file=sys.stderr)
+            return 2
+        try:
+            entries = baseline_mod.update(args.baseline, result.diags,
+                                          allow_growth=args.allow_growth)
+        except baseline_mod.BaselineGrowthError as e:
+            print("mxlint: %s" % e, file=sys.stderr)
+            return 1
+        print("mxlint: baseline %s now has %d entries"
+              % (args.baseline, len(entries)))
+        return 0
+
+    # a filtered run (--rule/--changed/explicit subset) cannot see every
+    # diagnostic, so absent baseline keys are not evidence of paid debt
+    full_scope = not (args.rule or args.changed or args.paths)
+    stale = result.stale if full_scope else []
+
+    if args.as_json:
+        print(json.dumps({
+            "diagnostics": [d.to_dict() for d in result.new],
+            "baselined": len(result.baselined),
+            "new": len(result.new),
+            "stale": stale,
+        }, indent=2))
+    else:
+        for d in result.new:
+            print(d.format())
+        if stale:
+            print("mxlint: %d baseline entr%s no longer fire%s — run "
+                  "--baseline-update to prune:"
+                  % (len(stale),
+                     "y" if len(stale) == 1 else "ies",
+                     "s" if len(stale) == 1 else ""))
+            for k in stale:
+                print("  stale: %s" % k)
+        print("mxlint: %d new, %d baselined, %d stale, "
+              "%d file(s) with findings"
+              % (len(result.new), len(result.baselined), len(stale),
+                 len({d.path for d in result.diags})))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
