@@ -29,21 +29,20 @@ import sys
 import time
 
 BASELINE_IMG_S = 363.69  # V100 ResNet-50 train, batch 128 (perf.md:237)
-RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9  # fwd+bwd ~= 3x fwd MACs*2
 
-# bf16 peak FLOP/s per chip by device kind substring
-_PEAK_FLOPS = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),  # v5 lite (v5e)
-    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-]
+
+def _perfmodel():
+    # lazy: bench probes the TPU in a subprocess BEFORE touching anything
+    # that imports jax in this process; mxnet_tpu.perfmodel itself is
+    # jax-free but pulls in the package __init__
+    from mxnet_tpu import perfmodel
+    return perfmodel
 
 
 def _peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return 197e12  # assume v5e
+    # shared with tools/microbench_convs.py and the kernel-tier cost
+    # model (mxnet_tpu/tune/cost_model.py) via mxnet_tpu.perfmodel
+    return _perfmodel().peak_flops(device_kind)
 
 
 def probe_tpu(deadline_s: float, attempt_timeout: float) -> bool:
@@ -403,7 +402,7 @@ def main():
         grouped_step_ms = dt_k / n_timed_k * 1e3
 
     # FLOPs/step from XLA cost analysis of the compiled fused program
-    flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
+    flops_per_step = _perfmodel().RESNET50_TRAIN_FLOPS_PER_IMG * batch
     try:
         ex = mod._exec
         cost = mod._fused.cost_analysis(ex._arg_vals(), ex._aux_vals(),
@@ -429,6 +428,31 @@ def main():
             lower_step(mod, donate=True).as_text())
     except Exception as e:
         mxlint_metrics = "failed: %s" % e
+
+    # kernel-tier dispatch report: which ops the Pallas tier took over in
+    # the traced program (counters accumulate from the module bind/trace
+    # in this process), tuner hit/miss split, and the tuning-cache
+    # fingerprint so BENCH_*.json lines are attributable to a specific
+    # set of tuned configs (docs/tuning.md)
+    kernel_tier_report = None
+    try:
+        from mxnet_tpu.kernels import tier as _ktier
+        from mxnet_tpu.tune import cache as _tcache
+        st = _ktier.stats()
+        tcache = _tcache.get_default()
+        kernel_tier_report = {
+            "tier": st["tier"],
+            "dispatch": dict(st["dispatch"]),
+            "fallback": dict(st["fallback"]),
+            "tuner_hits": st["tuner_hits"],
+            "tuner_misses": st["tuner_misses"],
+            "configs": {k: dict(v) for k, v in st["configs"].items()},
+            "tuning_cache": {"entries": len(tcache.entries),
+                             "version_ok": tcache.version_ok,
+                             "fingerprint": tcache.fingerprint()},
+        }
+    except Exception as e:
+        kernel_tier_report = "failed: %s" % e
 
     # ---- real-data variant (OPT-IN: BENCH_RECORDIO=1): threaded RecordIO
     # pipeline feeding the same fused module (decode+augment+H2D overlapped
@@ -502,6 +526,8 @@ def main():
     }
     if mxlint_metrics is not None:
         out["mxlint"] = mxlint_metrics
+    if kernel_tier_report is not None:
+        out["kernel_tier"] = kernel_tier_report
     if grouped_img_s is not None:
         out["steps_per_dispatch"] = k_disp
         out["grouped_img_s"] = round(grouped_img_s, 2)

@@ -21,7 +21,8 @@ from .rules_ast import Rule
 
 __all__ = [
     "HLO_RULES", "convert_budget_pass", "donation_coverage_pass",
-    "d2h_transfer_pass", "RecompileFingerprint", "metrics_from_text",
+    "d2h_transfer_pass", "fusion_bytes_pass", "RecompileFingerprint",
+    "metrics_from_text",
 ]
 
 HLO_RULES = {r.id: r for r in [
@@ -41,6 +42,11 @@ HLO_RULES = {r.id: r for r in [
          "the same jitted function saw many distinct shape/dtype/static "
          "signatures — each one is a full recompile; pad/bucket shapes "
          "(serve/engine_cache pattern) or mark true constants static"),
+    Rule("MXL505", "hlo-fusion-bytes-budget", "error",
+         "nominal bytes written by elementwise/layout ops exceed budget: "
+         "the step materializes intermediates the backend must fuse away "
+         "or spill to HBM; fuse epilogues (MXNET_KERNEL_TIER=auto, see "
+         "docs/tuning.md) or hunt accidental f32 widening / transposes"),
 ]}
 
 # custom_call targets (and ops) that imply a device<->host transfer or
@@ -130,6 +136,29 @@ def d2h_transfer_pass(text, label, budget=0):
                                        "outfeed ops"))]
 
 
+def fusion_bytes_pass(text, label, budget_gib, top=4):
+    """Fail when nominal elementwise/layout bytes exceed ``budget_gib``.
+
+    Ratcheted like MXL501: the budget is the committed ceiling for one
+    named program (e.g. the benched ResNet-50 fused step) and may only
+    come DOWN as fusion improves. The count is pre-optimization and
+    chip-free, so a regression — an unfused epilogue, an f32 widening, a
+    layout shuffle — shows up as hundreds of MiB before any chip time is
+    spent. The Pallas kernel tier (``MXNET_KERNEL_TIER=auto``) lowers
+    this number by collapsing BN/act/residual epilogues into single
+    custom calls whose intermediates never exist in HLO."""
+    total, per_op = hlo_stats.elementwise_bytes(text)
+    gib = total / 2**30
+    if gib <= budget_gib:
+        return []
+    worst = ", ".join("%s=%.2f" % (op, b / 2**30)
+                      for op, b in per_op.most_common(top))
+    return [_diag("MXL505", label,
+                  "%.2f GiB nominal elementwise/layout bytes (budget "
+                  "%.2f GiB); top ops (GiB): %s"
+                  % (gib, budget_gib, worst))]
+
+
 def _sig(x):
     """Hashable shape/dtype fingerprint of one call argument. Arrays
     collapse to (shape, dtype) — the thing jit keys compilation on —
@@ -209,6 +238,7 @@ def metrics_from_text(text, large_bytes=1 << 20):
     metrics alongside step time)."""
     stats = hlo_stats.analyze_stablehlo(text)
     donated, total, cov = donation_coverage(text, large_bytes=large_bytes)
+    ew_bytes, _per_op = hlo_stats.elementwise_bytes(text)
     return {
         "convert_count": stats["convert_count"],
         "convert_f32_bf16": hlo_stats.convert_count_between(
@@ -218,4 +248,7 @@ def metrics_from_text(text, large_bytes=1 << 20):
         "large_param_mib": round(total / 2**20, 2),
         "d2h_count": d2h_count(text),
         "total_ops": stats["total_ops"],
+        "elementwise_gib": round(ew_bytes / 2**30, 3),
+        "pallas_kernels": sum(
+            hlo_stats.pallas_kernel_names(text).values()),
     }

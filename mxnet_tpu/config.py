@@ -256,6 +256,31 @@ register_flag("compute_dtype", "MXNET_COMPUTE_DTYPE", str, "auto",
               "weights, optimizer state, and normalization statistics "
               "stay f32. 'float32'/'off'/'none': never downcast, even "
               "where the contextual default would.")
+register_flag("kernel_tier", "MXNET_KERNEL_TIER", str, "off",
+              "Pallas kernel tier dispatch policy (mxnet_tpu/kernels/). "
+              "'off' (default): every op runs its pure-JAX/XLA "
+              "implementation. 'safe': dispatch to a hand-written Pallas "
+              "kernel only where the eligibility guard passes AND the "
+              "tuning cache (tools/kernel_tuning.json) holds a measured "
+              "or model-ranked config for the (op, shape-bucket, dtype). "
+              "'auto': dispatch wherever the guard passes, using the "
+              "tuned config when cached and a heuristic default "
+              "otherwise. Read at bind/trace time; ineligible call-sites "
+              "always fall back to pure JAX. See docs/tuning.md.")
+register_flag("kernel_interpret", "MXNET_KERNEL_INTERPRET", str, "auto",
+              "Pallas execution mode for the kernel tier. 'auto' "
+              "(default): interpreter off-TPU (CPU tests), Mosaic on the "
+              "chip — the pallas_flash idiom. '0'/'compiled': force "
+              "Mosaic lowering even on a CPU host (used to EXPORT "
+              "TPU-platform HLO chip-free; such a program cannot "
+              "execute on the host). '1'/'interpret': force interpreter "
+              "everywhere (debugging on-chip numerics).")
+register_flag("kernel_tuning_cache", "MXNET_KERNEL_TUNING_CACHE", str, "",
+              "Path of the kernel-tier tuning cache consulted at trace "
+              "time. Empty (default): tools/kernel_tuning.json in the "
+              "repo. The cache is versioned JSON written by "
+              "tools/autotune.py; a schema/version mismatch invalidates "
+              "it wholesale (dispatch falls back to heuristic configs).")
 register_flag("engine_depth", "MXNET_ENGINE_DEPTH", int, 2,
               "Bounded in-flight dispatch depth for the async training "
               "loops (Module.fit, gluon.Trainer.step, SPMDTrainStep): up "

@@ -60,9 +60,37 @@ def _graph_eval_fn(symbol):
     """
     nodes = symbol._topo()
     entries = list(symbol._entries)
+    # kernel-tier graph fusion (BN->relu(+residual), FC->act, ...):
+    # planned structurally at bind time, decided per-shape at trace time.
+    # Empty when MXNET_KERNEL_TIER=off, which is the default.
+    from .kernels import graph_fuse as _gfuse
+    kplan, kdeferred = _gfuse.plan(nodes, entries)
 
     def eval_fn(arg_vals, aux_vals, key, training):
         values = {}
+        aux_updates = {}
+
+        def route_aux(node, out):
+            # route aux output slots back to their aux variable names
+            if node.op.aux_outputs:
+                outs = out if isinstance(out, tuple) else (out,)
+                for in_slot, out_slot in zip(node.op.aux_inputs,
+                                             node.op.aux_outputs):
+                    src, _ = node.inputs[in_slot]
+                    if src.is_variable and src.name in aux_vals:
+                        aux_updates[src.name] = outs[out_slot]
+
+        def force(node):
+            """Eager (pure-JAX) evaluation of one node — the normal path,
+            and the lazy fallback for deferred fusion interiors."""
+            ins = [read(s, oi) for (s, oi) in node.inputs]
+            params = dict(node.params)
+            if "_training" in node.op.param_names:
+                params["_training"] = training
+            out = node.op.fn(*ins, **params)
+            values[id(node)] = out
+            route_aux(node, out)
+            return out
 
         def read(src, oi):
             if src.is_variable:
@@ -71,32 +99,25 @@ def _graph_eval_fn(symbol):
                 if src.name in aux_vals:
                     return aux_vals[src.name]
                 raise MXNetError("unbound variable %r" % src.name)
-            v = values[id(src)]
+            v = values.get(id(src))
+            if v is None and id(src) not in values:
+                # deferred fusion interior read outside its pattern
+                # (guard rejected the kernel): evaluate it unfused
+                v = force(src)
             return v[oi] if isinstance(v, tuple) else v
 
-        aux_updates = {}
         with _random.trace_scope(key):
             for node in nodes:
                 if node.is_variable:
                     continue
-                ins = [read(s, oi) for (s, oi) in node.inputs]
-                params = dict(node.params)
-                if "_training" in node.op.param_names:
-                    params["_training"] = training
-                out = node.op.fn(*ins, **params)
-                values[id(node)] = out
-                # route aux output slots back to their aux variable names
-                if node.op.aux_outputs:
-                    outs = out if isinstance(out, tuple) else (out,)
-                    for in_slot, out_slot in zip(node.op.aux_inputs,
-                                                 node.op.aux_outputs):
-                        src, _ = node.inputs[in_slot]
-                        if src.is_variable and src.name in aux_vals:
-                            aux_updates[src.name] = outs[out_slot]
-        outputs = [read(n, oi) if n.is_variable else
-                   (values[id(n)][oi] if isinstance(values[id(n)], tuple)
-                    else values[id(n)])
-                   for (n, oi) in entries]
+                if id(node) in kdeferred:
+                    continue    # forced lazily only if a guard rejects
+                kp = kplan.get(id(node))
+                if kp is not None and _gfuse.try_eval(
+                        kp, node, read, values, route_aux, training):
+                    continue
+                force(node)
+        outputs = [read(n, oi) for (n, oi) in entries]
         return outputs, aux_updates
 
     return eval_fn

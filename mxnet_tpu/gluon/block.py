@@ -570,10 +570,20 @@ class HybridBlock(Block):
         leaves, treedef = jax.tree_util.tree_flatten(list(args))
         training = _autograd.is_training()
         from .. import config as _config
+        # the kernel tier changes what a trace lowers to (Pallas custom
+        # calls vs pure JAX), and so does the tuning cache feeding it —
+        # both join the signature so flipping MXNET_KERNEL_TIER or
+        # re-tuning invalidates cached runners instead of silently
+        # serving stale programs
+        from ..kernels import tier as _ktier
+        ktier = _ktier.tier()
+        if ktier != "off":
+            from ..tune import cache as _tcache
+            ktier = "%s/%s" % (ktier, _tcache.get_default().fingerprint())
         sig = (treedef,
                tuple((a.shape, str(a.dtype)) if isinstance(a, _nd.NDArray)
                      else ("static", repr(a)) for a in leaves), training,
-               str(_config.compute_dtype(default=None)))
+               str(_config.compute_dtype(default=None)), ktier)
         runner = self._cached_graph.get(sig)
         if runner is None:
             runner = self._build_cache(treedef, leaves, training)

@@ -184,6 +184,58 @@ def custom_call_targets(text):
     return collections.Counter(_CUSTOM_CALL_RE.findall(text))
 
 
+# ops whose results are pure data movement / pointwise math: every byte
+# they write is an intermediate XLA must either fuse away or spill to HBM.
+# The *nominal* sum over them (pre-optimization) is an upper bound on the
+# fusion work the backend has to do — and the number a fused Pallas
+# epilogue (kernels/) removes from the program outright.
+_ELEMENTWISE_OPS = frozenset((
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "select", "convert", "transpose", "negate", "exponential", "tanh",
+    "logistic", "rsqrt", "sqrt", "compare", "clamp", "abs", "power",
+    "and", "or", "xor", "broadcast_in_dim",
+))
+
+
+def elementwise_bytes(text):
+    """(total_bytes, per_op_bytes) nominally written by elementwise and
+    layout ops in the module.
+
+    Counts the RESULT tensor of every op in ``_ELEMENTWISE_OPS`` (the last
+    ``tensor<...>`` on the line — StableHLO prints the result type last).
+    Pre-optimization this is a deterministic, chip-free proxy for the
+    bytes-moved pressure the fusion pass (mxlint MXL505) budgets."""
+    total = 0
+    per_op = collections.Counter()
+    for line in text.splitlines():
+        m = _OP_RE.search(line)
+        if not m or m.group(1) not in _ELEMENTWISE_OPS:
+            continue
+        shapes = _SHAPE_RE.findall(line)
+        if not shapes:
+            continue
+        shape_str, dtype = shapes[-1]
+        b = _elems(shape_str) * _DTYPE_BYTES.get(dtype, 4)
+        total += b
+        per_op[m.group(1)] += b
+    return total, per_op
+
+
+_KERNEL_NAME_RE = re.compile(r'kernel_name\s*=\s*"([\w.$-]+)"')
+
+
+def pallas_kernel_names(text):
+    """Counter of Pallas ``kernel_name`` attributes in the module.
+
+    A ``pl.pallas_call(..., name="mxk_foo")`` lowered for TPU shows up as
+    a ``stablehlo.custom_call @tpu_custom_call`` whose backend config
+    carries ``kernel_name = "mxk_foo"`` in plain text — so a chip-free
+    ``jax.export``-for-TPU module proves which kernels the tier actually
+    dispatched, no accelerator needed. Interpreter-mode lowerings inline
+    to plain HLO and (correctly) report nothing here."""
+    return collections.Counter(_KERNEL_NAME_RE.findall(text))
+
+
 def convert_count_between(stats, a, b):
     """Total converts in either direction between element types ``a`` and
     ``b`` (e.g. ``("f32", "bf16")``) from an :func:`analyze_stablehlo`

@@ -1,0 +1,33 @@
+"""Pallas TPU kernel tier: hand-tiled kernels for ops XLA fuses poorly.
+
+The tier sits behind the op registry and the executor's graph-fusion
+pass — models never call it directly. ``MXNET_KERNEL_TIER=off|safe|auto``
+picks the policy (off by default), strict per-kernel eligibility guards
+pick the call-sites, and the tuner cache (``mxnet_tpu/tune``,
+``tools/kernel_tuning.json``) picks the tile configs. Every kernel
+follows the ``ops/pallas_flash.py`` pattern: interpreter-mode CPU
+execution for tests, Mosaic on the chip, ``jax.custom_vjp`` with a
+pure-JAX recompute backward. See docs/tuning.md.
+"""
+from . import tier  # noqa: F401  (policy + dispatch stats, import-light)
+from .tier import (enabled, force_compiled, reset_stats,  # noqa: F401
+                   should_dispatch, stats)
+
+__all__ = ["tier", "enabled", "stats", "reset_stats", "should_dispatch",
+           "force_compiled", "KERNEL_OPS"]
+
+# op-name -> module path, for the tuner/CLI (modules import lazily so
+# `import mxnet_tpu.kernels` stays cheap and jax-light)
+KERNEL_OPS = {
+    "bn_act": "mxnet_tpu.kernels.bn_act",
+    "scale_bias_act": "mxnet_tpu.kernels.mlp",
+    "take_rows": "mxnet_tpu.kernels.take",
+}
+
+
+def kernel_module(op_name):
+    import importlib
+    if op_name not in KERNEL_OPS:
+        raise KeyError("unknown kernel-tier op %r (have %s)"
+                       % (op_name, sorted(KERNEL_OPS)))
+    return importlib.import_module(KERNEL_OPS[op_name])

@@ -1,0 +1,296 @@
+"""Fused BatchNorm epilogue + activation (+ residual add) Pallas kernel.
+
+XLA fuses the BN normalize/scale/shift into neighbouring elementwise work
+reasonably, but the conv-path epilogue — per-channel affine, optional
+residual add, ReLU — still materializes intermediate activation tensors
+between the BN apply, the add and the activation in the lowered step.
+This kernel does the whole epilogue in one VMEM pass: the NCHW tensor is
+viewed as a (N*C, H*W) matrix, per-channel f32 coefficients ride along as
+a (N*C, 1) column, and each grid cell computes
+``act(x * scale + shift [+ residual])`` in f32 on the VPU with a single
+downcast on the way out.
+
+Batch statistics stay in XLA (reusing the f32-widened reductions of
+``ops/nn.py``'s bf16-native BatchNorm); only the bandwidth-bound epilogue
+is hand-written. Backward is the ``ops/pallas_flash.py`` pattern:
+``jax.custom_vjp`` whose bwd recomputes with the pure-JAX BatchNorm
+(+add+act) reference and differentiates it, so gradients are bitwise
+those of the unfused path.
+
+On CPU the kernel runs in interpreter mode; on TPU it lowers via Mosaic
+(kernel_name ``mxk_bn_act`` / ``mxk_bn_act_res`` in the exported HLO —
+``hlo_stats.pallas_kernel_names`` finds it chip-free).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from . import tier
+
+__all__ = ["fused_bn_act", "eligible", "DEFAULT_CONFIG", "OP_NAME"]
+
+OP_NAME = "bn_act"
+DEFAULT_CONFIG = {"block_r": 256, "block_s": 512}
+
+_ACTS = ("relu", "identity")
+
+
+class _Cfg(NamedTuple):
+    eps: float
+    momentum: float
+    fix_gamma: bool
+    use_global_stats: bool
+    training: bool
+    act: str
+    block_r: int
+    block_s: int
+    interpret: bool
+
+
+# ------------------------------------------------------------------ kernel
+def _epilogue_kernel(x_ref, sc_ref, sh_ref, o_ref, *, act):
+    y = (x_ref[...].astype(jnp.float32) * sc_ref[...]
+         + sh_ref[...])
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _epilogue_res_kernel(x_ref, sc_ref, sh_ref, r_ref, o_ref, *, act):
+    y = (x_ref[...].astype(jnp.float32) * sc_ref[...]
+         + sh_ref[...] + r_ref[...].astype(jnp.float32))
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _epilogue(x2, sc_col, sh_col, res2, act, block_r, block_s, interpret):
+    """act((R,S) * (R,1) + (R,1) [+ (R,S)]) in one pallas pass."""
+    R, S = x2.shape
+    block_r = max(1, min(block_r, R))
+    block_s = max(1, min(block_s, S))
+    pad_r = (-R) % block_r
+    pad_s = (-S) % block_s
+    if pad_r or pad_s:
+        x2 = jnp.pad(x2, ((0, pad_r), (0, pad_s)))
+        sc_col = jnp.pad(sc_col, ((0, pad_r), (0, 0)))
+        sh_col = jnp.pad(sh_col, ((0, pad_r), (0, 0)))
+        if res2 is not None:
+            res2 = jnp.pad(res2, ((0, pad_r), (0, pad_s)))
+    grid = ((R + pad_r) // block_r, (S + pad_s) // block_s)
+    x_spec = pl.BlockSpec((block_r, block_s), lambda ri, si: (ri, si))
+    col_spec = pl.BlockSpec((block_r, 1), lambda ri, si: (ri, 0))
+    if res2 is None:
+        kernel = functools.partial(_epilogue_kernel, act=act)
+        in_specs = [x_spec, col_spec, col_spec]
+        operands = (x2, sc_col, sh_col)
+        name = "mxk_bn_act"
+    else:
+        kernel = functools.partial(_epilogue_res_kernel, act=act)
+        in_specs = [x_spec, col_spec, col_spec, x_spec]
+        operands = (x2, sc_col, sh_col, res2)
+        name = "mxk_bn_act_res"
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=interpret,
+        name=name,
+    )(*operands)
+    if pad_r or pad_s:
+        out = out[:R, :S]
+    return out
+
+
+# ----------------------------------------------------- stats (XLA, shared)
+def _coefs(data, gamma, beta, moving_mean, moving_var, cfg):
+    """f32 per-channel (scale, shift) + the BatchNorm stat outputs,
+    matching ops/nn.py batch_norm's widened-reduction discipline."""
+    from ..ops import nn as _nn
+    g = jnp.ones_like(gamma) if cfg.fix_gamma else gamma
+    g32 = g.astype(jnp.float32) if g.dtype != jnp.float32 else g
+    b32 = beta.astype(jnp.float32) if beta.dtype != jnp.float32 else beta
+    red = (0, 2, 3)
+    if cfg.training and not cfg.use_global_stats:
+        if data.dtype in (jnp.bfloat16, jnp.float16):
+            s1, s2, n = _nn._bn_widened_sums(data, red)
+            mean = s1 / n
+            var = jnp.maximum(s2 / n - mean * mean, 0.0)
+        else:
+            mean = jnp.mean(data, axis=red)
+            var = jnp.var(data, axis=red)
+        new_mean = moving_mean * cfg.momentum + mean * (1.0 - cfg.momentum)
+        new_var = moving_var * cfg.momentum + var * (1.0 - cfg.momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var + cfg.eps)
+    sc32 = inv * g32
+    sh32 = b32 - mean * sc32
+    return sc32, sh32, mean, var, new_mean, new_var
+
+
+def _tile_col(vec32, n_batch):
+    """(C,) f32 -> (N*C, 1): row r of the flattened (N*C, HW) view has
+    channel r % C, which is exactly jnp.tile's repeat order."""
+    return jnp.tile(vec32, n_batch)[:, None]
+
+
+def _impl(data, gamma, beta, moving_mean, moving_var, residual, cfg):
+    N, C, H, W = data.shape
+    sc32, sh32, mean, var, new_mean, new_var = _coefs(
+        data, gamma, beta, moving_mean, moving_var, cfg)
+    x2 = data.reshape(N * C, H * W)
+    res2 = None if residual is None else residual.reshape(N * C, H * W)
+    out2 = _epilogue(x2, _tile_col(sc32, N), _tile_col(sh32, N), res2,
+                     cfg.act, cfg.block_r, cfg.block_s, cfg.interpret)
+    out = out2.reshape(N, C, H, W)
+    return (out, lax.stop_gradient(mean), lax.stop_gradient(var),
+            lax.stop_gradient(new_mean), lax.stop_gradient(new_var))
+
+
+def _reference(data, gamma, beta, moving_mean, moving_var, residual, cfg):
+    """Pure-JAX recompute target: the exact unfused op composition."""
+    from ..ops import nn as _nn
+    out, mean, var, nm, nv = _nn.batch_norm(
+        data, gamma, beta, moving_mean, moving_var, eps=cfg.eps,
+        momentum=cfg.momentum, fix_gamma=cfg.fix_gamma,
+        use_global_stats=cfg.use_global_stats, axis=1,
+        _training=cfg.training)
+    if residual is not None:
+        out = out + residual
+    if cfg.act == "relu":
+        out = jax.nn.relu(out)
+    return out, mean, var, nm, nv
+
+
+# -------------------------------------------------------------- custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _fused(data, gamma, beta, moving_mean, moving_var, cfg):
+    return _impl(data, gamma, beta, moving_mean, moving_var, None, cfg)
+
+
+def _fused_fwd(data, gamma, beta, moving_mean, moving_var, cfg):
+    out = _impl(data, gamma, beta, moving_mean, moving_var, None, cfg)
+    return out, (data, gamma, beta, moving_mean, moving_var)
+
+
+def _fused_bwd(cfg, res, cots):
+    data, gamma, beta, mm, mv = res
+    _, vjp = jax.vjp(
+        lambda d, g, b: _reference(d, g, b, mm, mv, None, cfg),
+        data, gamma, beta)
+    dd, dg, db = vjp(cots)
+    return dd, dg, db, jnp.zeros_like(mm), jnp.zeros_like(mv)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _fused_res(data, gamma, beta, moving_mean, moving_var, residual, cfg):
+    return _impl(data, gamma, beta, moving_mean, moving_var, residual, cfg)
+
+
+def _fused_res_fwd(data, gamma, beta, moving_mean, moving_var, residual,
+                   cfg):
+    out = _impl(data, gamma, beta, moving_mean, moving_var, residual, cfg)
+    return out, (data, gamma, beta, moving_mean, moving_var, residual)
+
+
+def _fused_res_bwd(cfg, res, cots):
+    data, gamma, beta, mm, mv, residual = res
+    _, vjp = jax.vjp(
+        lambda d, g, b, r: _reference(d, g, b, mm, mv, r, cfg),
+        data, gamma, beta, residual)
+    dd, dg, db, dr = vjp(cots)
+    return dd, dg, db, jnp.zeros_like(mm), jnp.zeros_like(mv), dr
+
+
+_fused_res.defvjp(_fused_res_fwd, _fused_res_bwd)
+
+
+# ------------------------------------------------------------------ public
+def eligible(shape, dtype, axis=1, act="relu",
+             residual_shape=None):
+    """Strict guard; returns None when dispatchable, else the reason."""
+    if len(shape) != 4:
+        return "data must be NCHW 4-D, got %d-D" % len(shape)
+    if axis % len(shape) != 1:
+        return "channel axis must be 1 (NCHW), got %d" % axis
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return "dtype must be f32 or bf16, got %s" % jnp.dtype(dtype)
+    if act not in _ACTS:
+        return "unsupported activation %r" % (act,)
+    if residual_shape is not None and tuple(residual_shape) != tuple(shape):
+        return "residual shape %s != data shape %s" % (
+            tuple(residual_shape), tuple(shape))
+    if shape[0] * shape[1] < 1 or shape[2] * shape[3] < 1:
+        return "empty tensor"
+    return None
+
+
+def shape_key_shapes(shape):
+    """Shapes the tuner keys this op on: the flattened (rows, cols) view."""
+    N, C, H, W = shape
+    return ((N * C, H * W),)
+
+
+def fused_bn_act(data, gamma, beta, moving_mean, moving_var, residual=None,
+                 *, eps=1e-3, momentum=0.9, fix_gamma=True,
+                 use_global_stats=False, act="relu", training=True,
+                 config=None, interpret=None):
+    """BatchNorm -> (+residual) -> act in one Pallas epilogue pass.
+
+    Same 5-output contract as the registered BatchNorm op —
+    ``(out, batch_mean, batch_var, new_moving_mean, new_moving_var)`` —
+    with ``out`` already activated, so the executor's aux routing and the
+    fused step see an unchanged interface.
+    """
+    reason = eligible(data.shape, data.dtype, act=act,
+                      residual_shape=None if residual is None
+                      else residual.shape)
+    if reason is not None:
+        raise ValueError("fused_bn_act guard: %s" % reason)
+    cfgd = dict(DEFAULT_CONFIG)
+    cfgd.update(config or {})
+    if interpret is None:
+        interpret = tier.resolve_interpret()
+    cfg = _Cfg(float(eps), float(momentum), bool(fix_gamma),
+               bool(use_global_stats), bool(training), act,
+               int(cfgd["block_r"]), int(cfgd["block_s"]), bool(interpret))
+    if residual is None:
+        return _fused(data, gamma, beta, moving_mean, moving_var, cfg)
+    return _fused_res(data, gamma, beta, moving_mean, moving_var,
+                      residual, cfg)
+
+
+# eager/symbolic surface: mx.nd._contrib_FusedBNAct(...)
+from ..ops.registry import register as _register  # noqa: E402
+from ..ops.registry import set_op_meta as _set_op_meta  # noqa: E402
+
+
+@_register("_contrib_FusedBNAct", num_outputs=5)
+def _contrib_fused_bn_act(data, gamma, beta, moving_mean, moving_var,
+                          residual=None, *, eps=1e-3, momentum=0.9,
+                          fix_gamma=True, use_global_stats=False,
+                          act="relu", _training=True):
+    """BatchNorm+act(+residual) as a registered op (Pallas epilogue)."""
+    return fused_bn_act(data, gamma, beta, moving_mean, moving_var,
+                        residual, eps=eps, momentum=momentum,
+                        fix_gamma=fix_gamma,
+                        use_global_stats=use_global_stats, act=act,
+                        training=_training)
+
+
+_set_op_meta("_contrib_FusedBNAct", aux_inputs=(3, 4), aux_outputs=(3, 4),
+             num_visible_outputs=1)

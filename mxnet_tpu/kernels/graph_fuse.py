@@ -1,0 +1,249 @@
+"""Symbol-DAG pattern fusion for the kernel tier (zero model changes).
+
+The models compose ops symbolically — ``sym.Activation(bn, 'relu')``,
+``relu(add(bn, shortcut))``, ``gelu(FullyConnected(x, w, b))`` — so the
+kernel tier's fused epilogues must be matched at the *graph* level; no
+single op call-site sees the whole pattern. This module plans those
+rewrites for ``executor._graph_eval_fn``:
+
+* :func:`plan` (bind time, pure structure): scan the topo list for
+
+  - ``BatchNorm -> Activation(relu)``
+  - ``BatchNorm -> broadcast_add(residual) -> Activation(relu)``
+  - ``FullyConnected(+bias) -> Activation(relu) | LeakyReLU(gelu)``
+  - ``broadcast_mul(x, scale) -> broadcast_add(+bias) -> LeakyReLU(gelu)``
+
+  guarded by single-use edges (nothing else may observe the interior
+  values). Interior nodes become *deferred*: the executor skips them and
+  only forces them (normal pure-JAX evaluation) if the trace-time guard
+  rejects the fusion — so fallback never duplicates work in the lowered
+  program.
+
+* :func:`try_eval` (trace time, shapes/dtypes known): run the strict
+  kernel eligibility guard plus the tier policy/tuning-cache lookup, and
+  either evaluate the fused Pallas kernel (routing BatchNorm's aux
+  updates from the fused 5-tuple) or return False so the executor falls
+  back to the eager path.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from . import tier
+
+__all__ = ["plan", "try_eval"]
+
+_ADD_OPS = ("broadcast_add", "elemwise_add")
+_MUL_OPS = ("broadcast_mul", "elemwise_mul")
+
+
+class _Plan(NamedTuple):
+    kind: str          # 'bn_act' | 'fc_act' | 'scale_bias_act'
+    act: str           # 'relu' | 'gelu'
+    base: object       # BatchNorm / FullyConnected / broadcast_mul node
+    mid: object        # interior add node or None
+    res_entry: object  # (node, out_idx) residual entry or None
+    deferred: tuple    # node ids the executor must skip
+
+
+def _act_kind(node):
+    """'relu'/'gelu' for activation-ish nodes the tier can absorb."""
+    name = node.op.name
+    if name == "Activation" and node.params.get("act_type",
+                                                "relu") == "relu":
+        return "relu"
+    if name == "LeakyReLU" and node.params.get("act_type",
+                                               "leaky") == "gelu":
+        return "gelu"
+    return None
+
+
+def _sole_use(uses, node, src):
+    """src's out0 is consumed exactly once (by node) and no other output
+    slot of src is observed anywhere."""
+    for (sid, oi), n in uses.items():
+        if sid != id(src):
+            continue
+        if oi != 0 or n != 1:
+            return False
+    return uses.get((id(src), 0)) == 1
+
+
+def plan(nodes, entries):
+    """Bind-time structural pass -> ({id(act_node): _Plan}, deferred_ids).
+
+    Purely topological — no shapes — so it is cheap enough to run on
+    every bind; returns empty when the tier is off.
+    """
+    if not tier.enabled():
+        return {}, frozenset()
+    uses = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        for (src, oi) in node.inputs:
+            if not src.is_variable:
+                key = (id(src), oi)
+                uses[key] = uses.get(key, 0) + 1
+    for (src, oi) in entries:
+        if not src.is_variable:
+            key = (id(src), oi)
+            uses[key] = uses.get(key, 0) + 1
+
+    plans = {}
+    deferred = set()
+    for node in nodes:
+        if node.is_variable:
+            continue
+        act = _act_kind(node)
+        if act is None or not node.inputs:
+            continue
+        src, src_oi = node.inputs[0]
+        if src.is_variable or src_oi != 0:
+            continue
+        p = None
+        if act == "relu" and src.op.name == "BatchNorm" \
+                and _sole_use(uses, node, src) \
+                and not src.params.get("output_mean_var"):
+            p = _Plan("bn_act", act, src, None, None, (id(src),))
+        elif act == "relu" and src.op.name in _ADD_OPS \
+                and _sole_use(uses, node, src) and len(src.inputs) == 2:
+            for side in (0, 1):
+                bn, bn_oi = src.inputs[side]
+                if bn.is_variable or bn_oi != 0 \
+                        or bn.op.name != "BatchNorm" \
+                        or bn.params.get("output_mean_var") \
+                        or not _sole_use(uses, src, bn):
+                    continue
+                p = _Plan("bn_act", act, bn, src, src.inputs[1 - side],
+                          (id(bn), id(src)))
+                break
+        elif src.op.name == "FullyConnected" \
+                and _sole_use(uses, node, src) \
+                and len(src.inputs) == 3 \
+                and not src.params.get("no_bias"):
+            p = _Plan("fc_act", act, src, None, None, (id(src),))
+        elif act == "gelu" and src.op.name in _ADD_OPS \
+                and _sole_use(uses, node, src) and len(src.inputs) == 2:
+            for side in (0, 1):
+                mul, mul_oi = src.inputs[side]
+                if mul.is_variable or mul_oi != 0 \
+                        or mul.op.name not in _MUL_OPS \
+                        or not _sole_use(uses, src, mul):
+                    continue
+                p = _Plan("scale_bias_act", act, mul, src,
+                          src.inputs[1 - side], (id(mul), id(src)))
+                break
+        if p is not None:
+            plans[id(node)] = p
+            deferred.update(p.deferred)
+    return plans, frozenset(deferred)
+
+
+# --------------------------------------------------------------- trace time
+def _vector_of(arr, length):
+    """View arr as a (length,) vector if its shape allows, else None."""
+    n = 1
+    for d in arr.shape:
+        n *= d
+    if n != length:
+        return None
+    if sum(1 for d in arr.shape if d != 1) > 1:
+        return None
+    return arr.reshape(length)
+
+
+def _eval_bn_act(p, read, training):
+    from . import bn_act
+    ins = [read(s, oi) for (s, oi) in p.base.inputs]
+    data, gamma, beta, mm, mv = ins
+    bp = p.base.params
+    axis = int(bp.get("axis", 1))
+    residual = None if p.res_entry is None else read(*p.res_entry)
+    reason = bn_act.eligible(
+        data.shape, data.dtype, axis=axis, act=p.act,
+        residual_shape=None if residual is None else residual.shape)
+    go, cfg = tier.should_dispatch(
+        bn_act.OP_NAME, bn_act.shape_key_shapes(data.shape), data.dtype,
+        guard_reason=reason)
+    if not go:
+        return None
+    fused = bn_act.fused_bn_act(
+        data, gamma, beta, mm, mv, residual,
+        eps=float(bp.get("eps", 1e-3)),
+        momentum=float(bp.get("momentum", 0.9)),
+        fix_gamma=bool(bp.get("fix_gamma", True)),
+        use_global_stats=bool(bp.get("use_global_stats", False)),
+        act=p.act, training=bool(training), config=cfg)
+    return fused
+
+
+def _eval_fc_act(p, read):
+    from . import mlp
+    from ..ops import nn as _nn
+    data, weight, bias = [read(s, oi) for (s, oi) in p.base.inputs]
+    fp = p.base.params
+    num_hidden = int(fp.get("num_hidden", 0)) or weight.shape[0]
+    flatten = bool(fp.get("flatten", True))
+    out_shape = ((data.shape[0], num_hidden) if flatten or data.ndim <= 2
+                 else tuple(data.shape[:-1]) + (num_hidden,))
+    reason = mlp.eligible(out_shape, data.dtype, act=p.act,
+                          bias_shape=bias.shape)
+    go, cfg = tier.should_dispatch(
+        mlp.OP_NAME, mlp.shape_key_shapes(out_shape), data.dtype,
+        guard_reason=reason)
+    if not go:
+        return None
+    y = _nn.fully_connected(data, weight, None, num_hidden=num_hidden,
+                            no_bias=True, flatten=flatten)
+    return mlp.fused_scale_bias_act(y, None, bias, act=p.act, config=cfg)
+
+
+def _eval_scale_bias_act(p, read):
+    from . import mlp
+    a = read(*p.base.inputs[0])
+    b = read(*p.base.inputs[1])
+    bias_arr = read(*p.res_entry)
+    # which mul operand is the data? the >=2-D one whose partner views
+    # as a (features,) vector
+    for data, sc in ((a, b), (b, a)):
+        if data.ndim < 2:
+            continue
+        F = data.shape[-1]
+        scale = _vector_of(sc, F)
+        bias = _vector_of(bias_arr, F)
+        if scale is None or bias is None:
+            continue
+        reason = mlp.eligible(data.shape, data.dtype, act=p.act,
+                              scale_shape=scale.shape, bias_shape=bias.shape)
+        go, cfg = tier.should_dispatch(
+            mlp.OP_NAME, mlp.shape_key_shapes(data.shape), data.dtype,
+            guard_reason=reason)
+        if not go:
+            return None
+        return mlp.fused_scale_bias_act(data, scale, bias, act=p.act,
+                                        config=cfg)
+    tier.record_fallback(mlp.OP_NAME,
+                         "scale/bias operands are not feature vectors")
+    return None
+
+
+def try_eval(p, node, read, values, route_aux, training):
+    """Trace-time attempt at one planned fusion. True -> the act node's
+    value is stored (and BN aux updates routed); False -> the executor
+    must evaluate the pattern unfused (forcing the deferred thunks)."""
+    if p.kind == "bn_act":
+        fused = _eval_bn_act(p, read, training)
+        if fused is None:
+            return False
+        values[id(node)] = fused[0]
+        route_aux(p.base, fused)
+        return True
+    if p.kind == "fc_act":
+        out = _eval_fc_act(p, read)
+    else:
+        out = _eval_scale_bias_act(p, read)
+    if out is None:
+        return False
+    values[id(node)] = out
+    return True

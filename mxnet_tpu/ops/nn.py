@@ -654,10 +654,35 @@ def dropout(data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
     return jnp.where(mask, data / keep, jnp.zeros_like(data))
 
 
+def _maybe_take_rows(data, weight):
+    """Kernel-tier dispatch for the embedding gather: the Pallas
+    scalar-prefetch row-DMA kernel when the tier policy + guard allow,
+    else None (caller falls back to jnp.take)."""
+    from ..kernels import tier as _ktier
+    if not _ktier.enabled():
+        return None
+    from ..kernels import take as _ktake
+    reason = _ktake.eligible(weight.shape, weight.dtype, data.shape,
+                             data.dtype)
+    go, cfg = _ktier.should_dispatch(
+        _ktake.OP_NAME,
+        _ktake.shape_key_shapes(weight.shape, data.shape),
+        weight.dtype, guard_reason=reason)
+    if not go:
+        return None
+    return _ktake.take_rows(weight, data, config=cfg)
+
+
 @register("Embedding")
 def embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
               sparse_grad=False):
-    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+    out = _maybe_take_rows(data, weight)
+    if out is not None:
+        return out
+    # clip mode: the reference take/Embedding clamp out-of-range rows,
+    # and the Pallas take_rows kernel clips too — dispatch must never
+    # change numerics
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
 
 
 @register("_contrib_SparseEmbedding")
@@ -670,7 +695,10 @@ def sparse_embedding(data, weight, *, input_dim=0, output_dim=0,
     RowSparseNDArray), which the optimizers' lazy row updates consume —
     XLA scatters the VJP, so there is no dense-vs-rsp kernel split to
     reproduce."""
-    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+    out = _maybe_take_rows(data, weight)
+    if out is not None:
+        return out
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
 
 
 # ---------------------------------------------------------------------------

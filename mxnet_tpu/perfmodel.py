@@ -1,0 +1,57 @@
+"""Chip capability tables + MFU math, shared by bench and the tuner.
+
+One home for the numbers that used to be copy-pasted between ``bench.py``
+and ``tools/microbench_convs.py`` (bf16 peak FLOP/s per device kind, the
+MFU formula) plus the HBM bandwidth table the kernel tuner's chip-free
+cost model needs for its roofline term. Import-light on purpose: no jax,
+so the mxlint CLI / analysis layer can use it without touching a backend.
+"""
+from __future__ import annotations
+
+__all__ = ["PEAK_FLOPS", "HBM_GBPS", "peak_flops", "hbm_bytes_per_s",
+           "mfu", "RESNET50_TRAIN_FLOPS_PER_IMG", "DEFAULT_DEVICE_KIND"]
+
+# fwd+bwd ~= 3x fwd MACs * 2 flops/MAC (ResNet-50 @ 224: 4.089 GMACs fwd)
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9
+
+DEFAULT_DEVICE_KIND = "v5e"
+
+# bf16 peak FLOP/s per chip by device-kind substring (first match wins;
+# 'v5p' must precede 'v5' so the pod chip doesn't fall into the lite row)
+PEAK_FLOPS = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),  # v5 lite (v5e)
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
+
+# HBM bandwidth (bytes/s) per chip by the same substring scheme — the
+# denominator of the tuner's bytes-moved roofline term
+HBM_GBPS = [
+    ("v6", 1640e9), ("v5p", 2765e9), ("v5", 819e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+]
+
+
+def _lookup(table, device_kind, default):
+    kind = (device_kind or "").lower()
+    for sub, val in table:
+        if sub in kind:
+            return val
+    return default
+
+
+def peak_flops(device_kind: str) -> float:
+    """bf16 peak FLOP/s for a device kind string; assumes v5e if unknown."""
+    return _lookup(PEAK_FLOPS, device_kind, 197e12)
+
+
+def hbm_bytes_per_s(device_kind: str) -> float:
+    """HBM bandwidth in bytes/s for a device kind; assumes v5e if unknown."""
+    return _lookup(HBM_GBPS, device_kind, 819e9)
+
+
+def mfu(flops_per_step: float, step_seconds: float,
+        device_kind: str = DEFAULT_DEVICE_KIND) -> float:
+    """Model FLOPs utilization: achieved FLOP/s over the chip's bf16 peak."""
+    if step_seconds <= 0.0:
+        return 0.0
+    return (flops_per_step / step_seconds) / peak_flops(device_kind)

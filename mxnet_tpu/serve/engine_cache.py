@@ -180,6 +180,10 @@ class BucketedEngineCache:
                 "capacity": self.capacity,
                 "builds": self.builds,
                 "evictions": self.evictions,
+                # export-time kernel-tier record (tier, tuning
+                # fingerprint, Pallas kernels baked into the artifact) —
+                # None for pre-tier artifacts
+                "kernel_tier": self._model.meta.get("kernel_tier"),
                 "engines": {
                     str(e.bucket): {
                         "compile_ms": round(e.compile_ms, 3),

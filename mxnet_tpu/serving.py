@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 
 from .base import MXNetError
+from . import hlo_stats as _hlo_stats
+from .kernels import tier as _kernels_tier
 
 __all__ = ["export_compiled", "CompiledModel"]
 
@@ -120,6 +122,20 @@ def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
         kw["platforms"] = [p.lower() for p in platforms]
     exp = _export.export(jax.jit(fn), **kw)(*args)
     blob = exp.serialize()
+    # record what the kernel tier did to THIS artifact: the tier policy
+    # and tuning-cache fingerprint at export time, plus the Pallas
+    # kernels actually present in the serialized module (readable from
+    # the MLIR text, so the claim is about the artifact, not the env)
+    kernel_tier_meta = {"tier": _kernels_tier.tier()}
+    if kernel_tier_meta["tier"] != "off":
+        from .tune import cache as _tcache
+        kernel_tier_meta["tuning_fingerprint"] = \
+            _tcache.get_default().fingerprint()
+    try:
+        kernel_tier_meta["pallas_kernels"] = dict(
+            _hlo_stats.pallas_kernel_names(exp.mlir_module()))
+    except Exception:
+        pass
     meta = {
         "inputs": [{"name": n,
                     "shape": ([None] + list(probe_shapes[n][1:])
@@ -129,6 +145,7 @@ def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
         "num_outputs": len(symbol._entries),
         "platforms": list(exp.platforms),
         "dynamic_batch": bool(dynamic_batch),
+        "kernel_tier": kernel_tier_meta,
         "format_version": 2,
     }
     mjson = json.dumps(meta).encode()

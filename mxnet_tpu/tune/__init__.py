@@ -1,0 +1,15 @@
+"""Shape-keyed auto-tuning for the Pallas kernel tier.
+
+Offline search (``tuner.tune`` via ``tools/autotune.py``), chip-free
+ranking (``cost_model``), and the versioned winners file the dispatch
+layer consults at trace time (``cache``). See docs/tuning.md.
+"""
+from . import cache    # noqa: F401  (import-light; no jax)
+from . import space    # noqa: F401
+from .cache import (TuningCache, CacheRewriteError,  # noqa: F401
+                    shape_bucket_key, lookup_config, get_default,
+                    invalidate_default, SCHEMA_VERSION)
+
+__all__ = ["cache", "space", "TuningCache", "CacheRewriteError",
+           "shape_bucket_key", "lookup_config", "get_default",
+           "invalidate_default", "SCHEMA_VERSION"]

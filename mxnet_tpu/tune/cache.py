@@ -1,0 +1,170 @@
+"""Versioned JSON tuning cache: offline winners, trace-time dict lookup.
+
+``tools/autotune.py`` writes it; dispatch (``kernels/tier.py``) reads it.
+The hot path never enumerates or scores anything — one canonical string
+key per (op, shape-bucket, dtype), one dict lookup.
+
+Shape bucketing: every dim rounds UP to the next power of two, so one
+tuned entry covers the whole bucket (a config tuned for the padded
+envelope is valid — if conservative — for everything inside it) and the
+cache stays O(ops x log(shapes) x dtypes) instead of one row per shape
+ever seen.
+
+Versioning: the file carries ``format``/``version``; a mismatch (or
+unparseable file) invalidates it WHOLESALE — dispatch silently falls
+back to heuristic configs rather than trusting winners tuned for
+different kernel generations. Bump ``SCHEMA_VERSION`` whenever a
+kernel's config keys or tiling semantics change.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+__all__ = ["SCHEMA_VERSION", "FORMAT", "TuningCache", "CacheRewriteError",
+           "shape_bucket_key", "default_cache_path", "get_default",
+           "invalidate_default", "lookup_config"]
+
+SCHEMA_VERSION = 1
+FORMAT = "mxnet-tpu-kernel-tuning"
+
+
+class CacheRewriteError(ValueError):
+    """An update would drop or rewrite committed winners without
+    --allow-rewrite (the mxlint-baseline shrink-only discipline: tuning
+    may only grow or deliberately improve, never silently regress)."""
+
+
+def _bucket(n):
+    n = int(n)
+    if n <= 1:
+        return 1
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def shape_bucket_key(op, shapes, dtype):
+    """Canonical cache key, e.g. ``bn_act|8192x4096|bfloat16``."""
+    parts = ["x".join(str(_bucket(d)) for d in shape) or "scalar"
+             for shape in shapes]
+    return "%s|%s|%s" % (op, ",".join(parts), str(dtype))
+
+
+def default_cache_path():
+    from ..config import flags
+    p = str(flags.kernel_tuning_cache).strip()
+    if p:
+        return p
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "tools", "kernel_tuning.json")
+
+
+class TuningCache:
+    """In-memory view of one tuning-cache file."""
+
+    def __init__(self, entries=None, path=None, version_ok=True):
+        self.entries = dict(entries or {})
+        self.path = path
+        self.version_ok = version_ok
+
+    @classmethod
+    def load(cls, path):
+        """Load; missing/corrupt/version-mismatched files come back empty
+        (with ``version_ok`` False for the mismatch case so callers can
+        report WHY lookups miss)."""
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            return cls(path=path, version_ok=True)
+        if not isinstance(raw, dict) or raw.get("format") != FORMAT \
+                or raw.get("version") != SCHEMA_VERSION:
+            return cls(path=path, version_ok=False)
+        entries = raw.get("entries")
+        if not isinstance(entries, dict):
+            return cls(path=path, version_ok=False)
+        return cls(entries=entries, path=path)
+
+    def lookup(self, key):
+        e = self.entries.get(key)
+        if e is None:
+            return None
+        cfg = e.get("config")
+        return dict(cfg) if isinstance(cfg, dict) else None
+
+    def update_entries(self, new_entries, allow_rewrite=False):
+        """Merge tuner output. Growth-guarded: existing keys may only
+        change with ``allow_rewrite`` (and never silently vanish —
+        merging cannot drop keys by construction)."""
+        changed = []
+        for key, entry in new_entries.items():
+            old = self.entries.get(key)
+            if old is not None and old.get("config") != entry.get("config") \
+                    and not allow_rewrite:
+                changed.append(key)
+        if changed:
+            raise CacheRewriteError(
+                "refusing to rewrite %d committed tuning winner(s) "
+                "without --allow-rewrite: %s"
+                % (len(changed), ", ".join(sorted(changed))))
+        self.entries.update(
+            {k: dict(v) for k, v in new_entries.items()})
+        return self
+
+    def save(self, path=None):
+        path = path or self.path
+        payload = {"format": FORMAT, "version": SCHEMA_VERSION,
+                   "entries": {k: self.entries[k]
+                               for k in sorted(self.entries)}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    def fingerprint(self):
+        """Short stable hash of version+contents — engine caches and the
+        CachedOp signature use it to notice re-tuning."""
+        h = hashlib.sha256()
+        h.update(("%s/%d" % (FORMAT, SCHEMA_VERSION)).encode())
+        for k in sorted(self.entries):
+            h.update(k.encode())
+            h.update(json.dumps(self.entries[k], sort_keys=True).encode())
+        return h.hexdigest()[:12]
+
+
+# ------------------------------------------------------- process-wide view
+_lock = threading.Lock()
+_default = None
+_default_path = None
+
+
+def get_default():
+    """The process-wide cache dispatch consults (memoized per path)."""
+    global _default, _default_path
+    path = default_cache_path()
+    with _lock:
+        if _default is None or _default_path != path:
+            _default = TuningCache.load(path)
+            _default_path = path
+        return _default
+
+
+def invalidate_default():
+    """Forget the memoized cache (tests, or after autotune --update)."""
+    global _default, _default_path
+    with _lock:
+        _default = None
+        _default_path = None
+
+
+def lookup_config(op, shapes, dtype):
+    """Trace-time lookup -> (config-or-None, key). Pure dict access."""
+    key = shape_bucket_key(op, shapes, dtype)
+    return get_default().lookup(key), key

@@ -1,0 +1,139 @@
+"""Chip-free learned cost model for ranking kernel configs.
+
+The Learned-Performance-Model-for-TPUs result (PAPERS.md, 2008.01040) is
+that tile winners are predictable from *static* features — no chip in
+the loop. This model is the smallest honest version of that: per config
+we extract a feature vector (HBM roofline terms from bytes-moved and
+FLOPs via the shared ``mxnet_tpu.perfmodel`` tables, grid size, VMEM
+footprint, tile-alignment and padding-waste penalties) and score it with
+a linear model. The default weights were fit offline with
+:meth:`LinearCostModel.fit` (ordinary least squares) against
+interpreter-calibrated microbench timings and then rounded; when a chip
+IS available the tuner measures instead and can re-fit, so the model
+only ever has to *rank* correctly, not predict absolute microseconds.
+
+Everything here is deterministic: same inputs -> same features -> same
+scores -> same ranking (an acceptance criterion).
+"""
+from __future__ import annotations
+
+from ..perfmodel import peak_flops, hbm_bytes_per_s, DEFAULT_DEVICE_KIND
+from .space import VMEM_BYTES
+
+__all__ = ["FEATURE_NAMES", "features", "LinearCostModel",
+           "default_model"]
+
+FEATURE_NAMES = ("hbm_time_us", "flop_time_us", "grid_overhead_us",
+                 "misalign", "waste", "vmem_frac")
+
+
+def _dtype_bytes(dtype):
+    d = str(dtype)
+    if "bfloat16" in d or "float16" in d:
+        return 2
+    if "8" in d:
+        return 1
+    return 4
+
+
+def _pad(n, block):
+    return ((n + block - 1) // block) * block
+
+
+def features(op, shapes, dtype, config,
+             device_kind=DEFAULT_DEVICE_KIND):
+    """Static feature dict for one (op, shapes, dtype, config) point."""
+    b = _dtype_bytes(dtype)
+    if op == "bn_act":
+        (R, S), = shapes[:1]
+        br, bs = config["block_r"], config["block_s"]
+        Rp, Sp = _pad(R, br), _pad(S, bs)
+        elems = Rp * Sp
+        hbm_bytes = 3 * elems * b + 2 * Rp * 4     # x in, res in, out, coefs
+        flops = 4.0 * elems                        # mul+add+add+max, f32
+        grid = (Rp // br) * (Sp // bs)
+        vmem = 3 * br * bs * b + 2 * br * 4 + br * bs * 4
+        misalign = (br % 8 != 0) + (bs % 128 != 0)
+        waste = elems / float(max(R * S, 1)) - 1.0
+    elif op == "scale_bias_act":
+        (R, F), = shapes[:1]
+        br, bf = config["block_r"], config["block_f"]
+        Rp, Fp = _pad(R, br), _pad(F, bf)
+        elems = Rp * Fp
+        hbm_bytes = 2 * elems * b + 2 * Fp * 4
+        flops = 12.0 * elems                       # erf-gelu polynomial
+        grid = (Rp // br) * (Fp // bf)
+        vmem = 2 * br * bf * b + 2 * bf * 4 + br * bf * 4
+        misalign = (br % 8 != 0) + (bf % 128 != 0)
+        waste = elems / float(max(R * F, 1)) - 1.0
+    elif op == "take_rows":
+        (V, D) = shapes[0]
+        (L,) = shapes[1]
+        bd = config["block_d"]
+        Dp = _pad(D, bd)
+        hbm_bytes = 2 * L * Dp * b + L * 4
+        flops = 0.0
+        grid = L * (Dp // bd)
+        vmem = 2 * bd * b
+        misalign = 1 if bd % 128 != 0 else 0
+        waste = Dp / float(max(D, 1)) - 1.0
+    else:
+        raise KeyError("no cost features for op %r" % (op,))
+    return {
+        "hbm_time_us": 1e6 * hbm_bytes / hbm_bytes_per_s(device_kind),
+        "flop_time_us": 1e6 * flops / peak_flops(device_kind),
+        "grid_overhead_us": 1e-1 * grid,   # ~0.1us grid-step bookkeeping
+        "misalign": float(misalign),
+        "waste": max(0.0, waste),
+        "vmem_frac": vmem / float(VMEM_BYTES),
+    }
+
+
+class LinearCostModel:
+    """score(config) = w . features  (predicted microseconds-ish)."""
+
+    def __init__(self, weights=None):
+        self.weights = dict(self.DEFAULT_WEIGHTS)
+        if weights:
+            self.weights.update(weights)
+
+    # offline-fit against interpreter-calibrated microbench rankings,
+    # rounded to one significant digit: the roofline terms dominate,
+    # misaligned tiles cost ~a roofline's worth, padding waste and
+    # near-VMEM-limit blocks are discouraged, tiny grids (no pipeline
+    # overlap) pay per-step overhead
+    DEFAULT_WEIGHTS = {
+        "hbm_time_us": 1.0,
+        "flop_time_us": 1.0,
+        "grid_overhead_us": 1.0,
+        "misalign": 50.0,
+        "waste": 30.0,
+        "vmem_frac": 5.0,
+    }
+
+    def predict(self, feat):
+        return sum(self.weights[k] * feat[k] for k in FEATURE_NAMES)
+
+    def score(self, op, shapes, dtype, config,
+              device_kind=DEFAULT_DEVICE_KIND):
+        return self.predict(features(op, shapes, dtype, config,
+                                     device_kind))
+
+    def fit(self, feature_rows, times_us):
+        """Ordinary least squares over measured times -> a new model.
+        Used when on-chip measurements exist to recalibrate the
+        chip-free ranking; returns self with updated weights."""
+        import numpy as np
+        X = np.array([[row[k] for k in FEATURE_NAMES]
+                      for row in feature_rows], dtype=np.float64)
+        y = np.asarray(times_us, dtype=np.float64)
+        w, *_ = np.linalg.lstsq(X, y, rcond=None)
+        self.weights = dict(zip(FEATURE_NAMES, (float(v) for v in w)))
+        return self
+
+    def to_dict(self):
+        return dict(self.weights)
+
+
+def default_model():
+    return LinearCostModel()

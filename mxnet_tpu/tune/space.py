@@ -1,0 +1,81 @@
+"""Bounded per-op config spaces for the kernel tuner (the TVM idea at
+TPU scale: a SMALL searchable schedule space per op beats one fixed
+kernel, and on TPU the only knobs that matter are tile/block shapes —
+layout and vectorization belong to Mosaic).
+
+Spaces are deterministic lists of plain dicts, filtered by hard VMEM
+feasibility so the measuring path never launches a config Mosaic would
+reject. ``default_config`` is the heuristic the dispatch layer uses when
+the tuning cache has no entry ('auto' tier).
+"""
+from __future__ import annotations
+
+__all__ = ["space_for", "default_config", "VMEM_BYTES"]
+
+# per-core VMEM budget the tuner plans against (half of the 16 MiB v5e
+# arsenal: Mosaic needs headroom for double-buffered DMA)
+VMEM_BYTES = 8 * 1024 * 1024
+
+_BLOCK_R = (8, 16, 32, 64, 128, 256, 512)
+_BLOCK_S = (128, 256, 512, 1024, 2048)
+_BLOCK_D = (128, 256, 512, 1024)
+
+
+def _dtype_bytes(dtype):
+    d = str(dtype)
+    if "bfloat16" in d or "float16" in d:
+        return 2
+    if "8" in d:
+        return 1
+    return 4
+
+
+def _clamp_pow2ish(options, limit):
+    """Options no bigger than the first option >= limit (so tiny dims
+    still get one covering block instead of an empty space)."""
+    out = [o for o in options if o <= limit]
+    bigger = [o for o in options if o > limit]
+    if bigger:
+        out.append(bigger[0])
+    return out or [options[0]]
+
+
+def space_for(op, shapes, dtype):
+    """Deterministic list of candidate configs for (op, shapes, dtype).
+
+    ``shapes`` is the tuple-of-shape-tuples the kernel's
+    ``shape_key_shapes`` produced (the kernel's own canonical view).
+    """
+    b = _dtype_bytes(dtype)
+    out = []
+    if op == "bn_act":
+        (R, S), = shapes[:1]
+        for br in _clamp_pow2ish(_BLOCK_R, R):
+            for bs in _clamp_pow2ish(_BLOCK_S, S):
+                # x block + residual/out blocks (in+out+res) + coef column
+                vmem = 3 * br * bs * b + 2 * br * 4 + br * bs * 4
+                if vmem <= VMEM_BYTES:
+                    out.append({"block_r": br, "block_s": bs})
+    elif op == "scale_bias_act":
+        (R, F), = shapes[:1]
+        for br in _clamp_pow2ish(_BLOCK_R, R):
+            for bf in _clamp_pow2ish(_BLOCK_S, F):
+                vmem = 2 * br * bf * b + 2 * bf * 4 + br * bf * 4
+                if vmem <= VMEM_BYTES:
+                    out.append({"block_r": br, "block_f": bf})
+    elif op == "take_rows":
+        (V, D) = shapes[0]
+        for bd in _clamp_pow2ish(_BLOCK_D, D):
+            if D % bd == 0 and 2 * bd * b <= VMEM_BYTES:
+                out.append({"block_d": bd})
+    else:
+        raise KeyError("no tuning space for op %r" % (op,))
+    if not out:
+        out.append(default_config(op, shapes, dtype))
+    return out
+
+
+def default_config(op, shapes, dtype):
+    """Heuristic config for untuned dispatch ('auto' tier cache miss)."""
+    from .. import kernels
+    return dict(kernels.kernel_module(op).DEFAULT_CONFIG)

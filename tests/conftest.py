@@ -43,3 +43,29 @@ def pytest_configure(config):
 def ctx():
     from mxnet_tpu import test_utils
     return test_utils.default_context()
+
+
+RESNET_STEP_BATCH = 128
+
+
+@pytest.fixture(scope="session")
+def resnet_step_text():
+    """Pre-optimization StableHLO of the benched ResNet-50 fused step.
+
+    One session-scoped lowering (a few seconds) shared by every chip-free
+    HLO budget: the convert/transpose ratchets (test_step_hlo_budget) and
+    the MXL505 fusion-bytes ratchet (test_lint_clean). Lowered at the
+    bench batch with the default kernel tier — the committed budgets
+    describe the program users get without opting in to anything."""
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("lowering analysis is defined for the CPU backend")
+    import sys
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        from diagnose_step_hlo import build_fused, lower_step
+    finally:
+        sys.path.pop(0)
+    mod = build_fused(RESNET_STEP_BATCH)
+    return lower_step(mod).as_text()

@@ -66,6 +66,22 @@ def test_analysis_package_is_import_light():
     assert proc.returncode == 0, proc.stderr
 
 
+FUSION_BYTES_BUDGET_GIB = 42.0   # measured 40.56 at time of writing
+
+
+def test_step_fusion_bytes_budget(resnet_step_text):
+    """MXL505 ratchet: nominal elementwise/layout bytes in the benched
+    ResNet-50 fused step (session-scoped lowering from conftest). Like
+    the MXL501 convert budget this may only come DOWN — an unfused
+    epilogue or f32 widening adds hundreds of MiB and fails here before
+    any chip time is spent. The Pallas kernel tier (docs/tuning.md)
+    exists to push it lower."""
+    from mxnet_tpu.analysis import hlo_passes
+    diags = hlo_passes.fusion_bytes_pass(
+        resnet_step_text, "resnet50/fused-step", FUSION_BYTES_BUDGET_GIB)
+    assert not diags, "\n".join(d.format() for d in diags)
+
+
 def test_cli_exits_zero_on_repo():
     """The acceptance-criteria invocation, exactly as documented."""
     proc = subprocess.run(

@@ -431,11 +431,23 @@ class TestHloPasses:
         assert hlo_passes.d2h_transfer_pass(
             lowerings["donated"], "step", budget=0) == []
 
+    def test_fusion_bytes_catches_and_passes(self, lowerings):
+        # the sgd program writes a few elementwise results (256x256 f32
+        # each): a zero budget must flag it, a generous one must not
+        bad = hlo_passes.fusion_bytes_pass(
+            lowerings["donated"], "step", budget_gib=0.0)
+        assert len(bad) == 1 and bad[0].rule == "MXL505"
+        assert "GiB" in bad[0].message
+        assert hlo_passes.fusion_bytes_pass(
+            lowerings["donated"], "step", budget_gib=64.0) == []
+
     def test_metrics_from_text(self, lowerings):
         m = hlo_passes.metrics_from_text(lowerings["donated"],
                                          large_bytes=1024)
         assert m["donation_coverage"] == 1.0
         assert m["d2h_count"] == 0
+        assert m["elementwise_gib"] >= 0.0
+        assert m["pallas_kernels"] == 0
         m2 = hlo_passes.metrics_from_text(lowerings["bf16_detour"],
                                           large_bytes=1024)
         assert m2["convert_f32_bf16"] >= 2

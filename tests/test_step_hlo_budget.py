@@ -17,27 +17,15 @@ import numpy as np
 import pytest
 
 BUDGET = 120
-BATCH = 128
 
 
 @pytest.fixture(scope="module")
-def step_stats():
-    import jax
+def step_stats(resnet_step_text):
+    # the lowering itself is the session-scoped `resnet_step_text`
+    # fixture (tests/conftest.py), shared with the MXL505 fusion-bytes
+    # ratchet in test_lint_clean.py
     from mxnet_tpu import hlo_stats as hs
-
-    if jax.devices()[0].platform != "cpu":
-        pytest.skip("lowering analysis is defined for the CPU backend")
-    import sys
-    import os
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "tools"))
-    try:
-        from diagnose_step_hlo import build_fused, lower_step
-    finally:
-        sys.path.pop(0)
-    mod = build_fused(BATCH)
-    text = lower_step(mod).as_text()
-    return hs.analyze_stablehlo(text)
+    return hs.analyze_stablehlo(resnet_step_text)
 
 
 def test_convert_budget(step_stats):

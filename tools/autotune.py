@@ -1,0 +1,160 @@
+"""Offline auto-tuner CLI for the Pallas kernel tier.
+
+Enumerates the bounded config space of each requested (op, shape-bucket,
+dtype), ranks it — on-chip wall time when an accelerator is attached,
+the chip-free learned cost model otherwise — and (with --update-cache)
+persists the winners to the versioned tuning cache the dispatch layer
+consults at trace time (tools/kernel_tuning.json by default).
+
+    # tune one op on one shape bucket, chip-free
+    python tools/autotune.py --op bn_act --shape 8192x4096 \
+        --dtype bfloat16 --chip-free
+
+    # derive the shape list from the benched ResNet-50 fused step and
+    # commit the winners (shrink-only growth guard: re-tuning a key the
+    # cache already holds needs --allow-rewrite)
+    python tools/autotune.py --shapes-from-bench --chip-free --update-cache
+
+Shape syntax mirrors the cache key's middle segment: ``RxS`` for one
+operand, comma-separated for several (take_rows: ``65536x512,1024``).
+Chip-free rankings are deterministic (ties broken by config key), so two
+runs over the same inputs produce byte-identical caches — that property
+is tested in tests/test_autotune.py.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_shapes(spec):
+    """'8192x4096' -> ((8192, 4096),); '65536x512,1024' -> two operands."""
+    shapes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        shapes.append(tuple(int(d) for d in part.split("x")))
+    if not shapes:
+        raise ValueError("empty shape spec %r" % (spec,))
+    return tuple(shapes)
+
+
+def parse_cache_key(key):
+    """Invert ``cache.shape_bucket_key``: 'op|RxS|dtype' -> task tuple."""
+    op, shapes, dtype = key.split("|")
+    return op, parse_shapes(shapes), dtype
+
+
+def bench_step_tasks(batch):
+    """Trace the benched ResNet-50 fused step under tier=auto and return
+    the (op, shapes, dtype) buckets the dispatch layer actually asked
+    for — tuning exactly what the hot path will look up."""
+    from diagnose_step_hlo import build_fused, lower_step
+    from mxnet_tpu import config
+    from mxnet_tpu.kernels import tier
+
+    with config.override(kernel_tier="auto"):
+        tier.reset_stats()
+        mod = build_fused(batch)
+        lower_step(mod)          # chip-free trace records dispatch keys
+        keys = sorted(tier.stats()["configs"])
+    return [parse_cache_key(k) for k in keys]
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="tune Pallas kernel-tier tile configs")
+    ap.add_argument("--op", action="append", default=[],
+                    help="kernel op name (repeatable); requires --shape")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="shape spec like 8192x4096 (repeatable; paired "
+                         "with --op by cross product)")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--shapes-from-bench", action="store_true",
+                    help="derive (op, shape, dtype) tasks from the "
+                         "benched ResNet-50 fused step (chip-free trace)")
+    ap.add_argument("--batch", type=int, default=128,
+                    help="bench batch for --shapes-from-bench")
+    ap.add_argument("--chip-free", action="store_true",
+                    help="rank with the static cost model even when an "
+                         "accelerator is attached")
+    ap.add_argument("--iters", type=int, default=20,
+                    help="timing iterations per config (on-chip mode)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="ranking rows to print per task")
+    ap.add_argument("--update-cache", action="store_true",
+                    help="merge winners into the tuning cache")
+    ap.add_argument("--allow-rewrite", action="store_true",
+                    help="permit changing configs of committed keys "
+                         "(growth guard override)")
+    ap.add_argument("--cache", default=None,
+                    help="cache path (default: MXNET_KERNEL_TUNING_CACHE "
+                         "or tools/kernel_tuning.json)")
+    args = ap.parse_args()
+
+    from mxnet_tpu.tune import cache as tcache
+    from mxnet_tpu.tune import tuner
+
+    tasks = []
+    if args.shapes_from_bench:
+        tasks.extend(bench_step_tasks(args.batch))
+    for op in args.op:
+        if not args.shape:
+            ap.error("--op needs at least one --shape")
+        for spec in args.shape:
+            tasks.append((op, parse_shapes(spec), args.dtype))
+    if not tasks:
+        ap.error("nothing to tune: pass --op/--shape or "
+                 "--shapes-from-bench")
+
+    chip_free = args.chip_free or None   # None -> auto (cpu => chip-free)
+    new_entries = {}
+    for op, shapes, dtype in tasks:
+        result = tuner.tune(op, shapes, dtype, chip_free=chip_free,
+                            iters=args.iters)
+        print("%s  (%d candidates, %s)" % (
+            result["key"], len(result["ranking"]), result["source"]))
+        for row in result["ranking"][:args.top]:
+            print("  %10.2f us  %s" % (row["score_us"], row["config"]))
+        best = result["best"]
+        new_entries[result["key"]] = {
+            "op": op, "dtype": dtype,
+            "shapes": result["shapes"],
+            "config": best["config"],
+            "score_us": best["score_us"],
+            "source": best["source"],
+            "device_kind": result["device_kind"],
+        }
+
+    if not args.update_cache:
+        print("(dry run: pass --update-cache to persist %d winner(s))"
+              % len(new_entries))
+        return 0
+
+    path = args.cache or tcache.default_cache_path()
+    cache = tcache.TuningCache.load(path)
+    if not cache.version_ok:
+        print("cache %s has a stale format/version — rebuilding it "
+              "wholesale" % path)
+        cache = tcache.TuningCache(path=path)
+    try:
+        cache.update_entries(new_entries,
+                             allow_rewrite=args.allow_rewrite)
+    except tcache.CacheRewriteError as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 2
+    cache.save(path)
+    tcache.invalidate_default()
+    print("wrote %d entr%s to %s (fingerprint %s)"
+          % (len(cache.entries),
+             "y" if len(cache.entries) == 1 else "ies",
+             path, cache.fingerprint()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

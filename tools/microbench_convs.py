@@ -29,8 +29,10 @@ CASES = [
 
 
 def peak_flops(kind):
-    from bench import _peak_flops
-    return _peak_flops(kind)
+    # one shared table: bench.py MFU, this CLI, and the kernel-tier cost
+    # model all read mxnet_tpu.perfmodel
+    from mxnet_tpu.perfmodel import peak_flops as _pf
+    return _pf(kind)
 
 
 def main():
