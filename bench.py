@@ -338,6 +338,14 @@ def main():
             lst.append(time.perf_counter())
         return cb
 
+    # seed the run-wide telemetry registry (docs/observability.md): with
+    # flops known, fit()'s window sampling publishes a live train/mfu
+    # gauge; the analytic estimate is refined from XLA cost analysis below
+    from mxnet_tpu import telemetry as _telemetry
+    _telemetry.set_run_info(
+        flops_per_step=_perfmodel().RESNET50_TRAIN_FLOPS_PER_IMG * batch,
+        device_kind=dev.device_kind, batch_size=batch)
+
     times = []
     epoch_cb = timing_cb(times)
 
@@ -411,6 +419,7 @@ def main():
             flops_per_step = float(cost["flops"])
     except Exception:
         pass
+    _telemetry.set_run_info(flops_per_step=flops_per_step)
 
     # mxlint Layer-2 metrics of the exact benched step program (convert
     # count, donation coverage, d2h count) so BENCH_*.json tracks the
@@ -544,6 +553,14 @@ def main():
     # LSTM tokens/sec) ride along as extra fields; BENCH_EXTRA=0 skips
     if os.environ.get("BENCH_EXTRA", "1") == "1":
         _secondary_legs(out, on_tpu)
+
+    # end-of-run registry snapshot: the BENCH_*.json line carries the
+    # same step-time/MFU/engine-depth/kernel-dispatch series an operator
+    # would scrape from the Prometheus endpoint mid-run
+    try:
+        out["telemetry"] = _telemetry.snapshot()
+    except Exception as e:
+        out["telemetry"] = "failed: %s" % e
 
     if on_tpu:
         # persist: future runs where the TPU is unreachable re-emit this

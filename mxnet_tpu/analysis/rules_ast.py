@@ -16,7 +16,12 @@ the disciplines PRs 1-4 enforced by hand:
 * **lock discipline** (MXL401/MXL402) — blocking device/queue work while
   holding a lock serializes the batcher/engine threads (and inconsistent
   acquisition order across engine/serve/io is a deadlock waiting for
-  load).
+  load);
+* **telemetry discipline** (MXL506) — named metric series belong to the
+  run-wide telemetry registry (mxnet_tpu/telemetry), which mirrors them
+  into the chrome trace itself; a direct ``profiler.record_counter``
+  call forks a second source of truth that Prometheus/JSONL exporters
+  and the flight recorder never see.
 
 A function body is considered **traced** when its def is decorated with
 a jit-like wrapper (``jax.jit``, ``partial(jax.jit, ...)``,
@@ -80,6 +85,12 @@ RULES = {r.id: r for r in [
          "these two locks are acquired in both nestings; pick one global "
          "order (document it where the locks are defined) to make "
          "deadlock impossible"),
+    Rule("MXL506", "raw-profiler-counter", "error",
+         "publish through the telemetry registry instead "
+         "(telemetry.counter(name).inc() / telemetry.gauge(name).set()); "
+         "the registry mirrors label-free series into the chrome trace, "
+         "and a direct profiler.record_counter call is invisible to the "
+         "Prometheus/JSONL exporters and the flight recorder"),
 ]}
 
 
@@ -394,6 +405,21 @@ class ModuleLinter(ast.NodeVisitor):
         # MXL401: blocking call while a lock is held
         if self._locks_held:
             self._check_blocking(node, callee, last)
+
+        # MXL506: metric series published around the telemetry registry.
+        # Only slash-named series (the registry's namespace convention)
+        # are claimed; the registry's own trace mirror is the one place
+        # allowed to call through.
+        if last == "record_counter" and callee and "profiler" in callee \
+                and "telemetry" not in self.path.replace("\\", "/") \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and "/" in node.args[0].value:
+            self._emit("MXL506", node,
+                       "profiler.record_counter(%r) bypasses the "
+                       "telemetry registry that owns slash-named series"
+                       % node.args[0].value)
 
         self.generic_visit(node)
 

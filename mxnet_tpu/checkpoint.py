@@ -274,6 +274,21 @@ class CheckpointManager:
         # truncate target: corrupting a *committed* snapshot proves the
         # CRC path skips it at restore
         _fi.fire("ckpt", step=step, path=data_path, phase="committed")
+        # run-wide telemetry: committed-snapshot census + a flight-recorder
+        # event, so a postmortem shows how far behind the last durable
+        # state the death was (host-side only; may run on the async saver
+        # thread — both sinks are thread-safe)
+        try:
+            from . import telemetry as _telemetry
+            _telemetry.counter("ckpt/saves_total",
+                               "committed checkpoint snapshots").inc()
+            _telemetry.gauge("ckpt/last_step",
+                             "step of the newest committed snapshot"
+                             ).set(step)
+            _telemetry.flight_recorder().record_event(
+                "ckpt", step=int(step), bytes=len(blob))
+        except Exception:
+            pass
         self._retain()
 
     def _retain(self):

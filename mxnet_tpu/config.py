@@ -335,6 +335,54 @@ register_flag("serve_warmup", "MXNET_SERVE_WARMUP", _parse_bool, True,
 register_flag("serve_drain_timeout_s", "MXNET_SERVE_DRAIN_S", float, 30.0,
               "Graceful-shutdown budget: how long Server.close(drain=True) "
               "waits for queued requests to finish before giving up.")
+register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
+              "Training-side telemetry HTTP listener port "
+              "(mxnet_tpu.telemetry.exporters): serves /metrics "
+              "(Prometheus text exposition of the run-wide registry), "
+              "/metrics.json and /healthz from a daemon thread. 0 "
+              "(default) = no listener. Serving replicas don't need "
+              "this: serve/http.py exposes the same exposition on its "
+              "existing /metrics route.")
+register_flag("telemetry_dir", "MXNET_TELEMETRY_DIR", str, "",
+              "Directory for crash-surviving telemetry artifacts: the "
+              "flight-recorder postmortem JSON written on SIGTERM / "
+              "unhandled exception / faultinject kill "
+              "(postmortem_rank<R>_pid<P>.json) and, unless overridden "
+              "by the dedicated flags, the telemetry JSONL stream and "
+              "kernel timing log. Empty (default): postmortem dumping "
+              "and the derived paths are disabled — no surprise files, "
+              "no altered SIGTERM disposition.")
+register_flag("telemetry_jsonl", "MXNET_TELEMETRY_JSONL", str, "",
+              "Path of the per-window telemetry JSONL snapshot stream "
+              "(one registry snapshot per K-step dispatch window, "
+              "appended — the machine-readable sibling of the chrome "
+              "trace). Empty: $MXNET_TELEMETRY_DIR/telemetry.jsonl when "
+              "the dir is set, else disabled.")
+register_flag("telemetry_flight_len", "MXNET_TELEMETRY_FLIGHT_LEN", int,
+              256,
+              "Ring-buffer capacity of the flight recorder: how many "
+              "recent step-window records survive into a postmortem "
+              "dump.")
+register_flag("telemetry_mfu", "MXNET_TELEMETRY_MFU", _parse_bool, False,
+              "Let Module.fit derive flops_per_step for the live MFU "
+              "gauge by lowering the fused step for cost analysis once "
+              "at fit start (chip-free but seconds of lowering). Off "
+              "(default): the train/mfu gauge appears only when the "
+              "caller supplied flops via telemetry.set_run_info "
+              "(bench.py does).")
+register_flag("kernel_timings", "MXNET_KERNEL_TIMINGS", str, "",
+              "Path of the measured kernel-timing JSONL log the on-chip "
+              "tuner appends to (mxnet_tpu/tune/timings.py) and "
+              "`tools/autotune.py --recalibrate` fits the chip-free "
+              "cost model from. Empty: "
+              "$MXNET_TELEMETRY_DIR/kernel_timings.jsonl when the dir "
+              "is set, else recording is off.")
+register_flag("kernel_cost_model", "MXNET_KERNEL_COST_MODEL", str, "",
+              "Path of a recalibrated cost-model weights JSON (written "
+              "by `tools/autotune.py --recalibrate --save-model`). When "
+              "set and valid, tune.cost_model.default_model() ranks "
+              "with these weights instead of the shipped hand-rounded "
+              "ones. Empty (default): shipped weights.")
 register_flag("test_device", "MXNET_TEST_DEVICE", str, "cpu",
               "Device type test_utils.default_context() returns (cpu|tpu) "
               "— the reference's env-switchable default_context (:53).")

@@ -110,6 +110,16 @@ def _record_dispatch(op, cache_key, config, tuned):
         else:
             _stats["tuner_misses"] += 1
         _stats["configs"][cache_key] = dict(config)
+    # trace-time census into the run-wide registry (one counter bump per
+    # dispatch DECISION, not per execution — this code never runs inside
+    # the compiled program)
+    from .. import telemetry as _telemetry
+    _telemetry.counter("kernel/dispatch_total",
+                       "Pallas-tier dispatch decisions").inc(1, op=op)
+    _telemetry.counter(
+        "kernel/tuner_lookups_total",
+        "tuning-cache consults at dispatch").inc(
+            1, outcome="hit" if tuned else "miss")
 
 
 def record_fallback(op, reason):
@@ -118,6 +128,9 @@ def record_fallback(op, reason):
     with _lock:
         key = "%s: %s" % (op, reason)
         _stats["fallback"][key] = _stats["fallback"].get(key, 0) + 1
+    from .. import telemetry as _telemetry
+    _telemetry.counter("kernel/fallback_total",
+                       "Pallas-tier guard/policy fallbacks").inc(1, op=op)
 
 
 # --------------------------------------------------------------- dispatch

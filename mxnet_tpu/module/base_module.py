@@ -268,6 +268,39 @@ class BaseModule:
         from ..engine import DepthController
         depth_ctl = DepthController()
 
+        # 4. run-wide telemetry (docs/observability.md): publish step
+        #    time / throughput / live MFU / engine depth / sync census at
+        #    K-step window boundaries, using ONLY values this frame
+        #    already holds on the host (wall clock, batch shapes, the
+        #    in-flight dispatch count) — zero extra device->host syncs,
+        #    pinned by tests/test_step_sync_budget.py
+        from .. import telemetry as _telemetry
+        if _flags.telemetry_mfu and \
+                "flops_per_step" not in _telemetry.run_info():
+            flops_fn = getattr(self, "_fused_step_flops", None)
+            flops = flops_fn() if flops_fn is not None else None
+            if flops:
+                _telemetry.set_run_info(flops_per_step=flops)
+        _telem_t0 = time.monotonic()
+        _telem_every = max(1, int(_flags.steps_per_dispatch))
+        _telem_acc = [0, 0]          # per-step path: (steps, examples)
+
+        def _batch_examples(b):
+            try:
+                return int(b.data[0].shape[0])   # host metadata, no sync
+            except Exception:
+                return 0
+
+        def _telem_window(n_steps, examples, gstep):
+            nonlocal _telem_t0
+            now = time.monotonic()
+            _telemetry.publish_window(
+                steps=n_steps, window_s=now - _telem_t0,
+                examples=examples or None,
+                engine_depth=len(depth_ctl._inflight),
+                global_step=gstep)
+            _telem_t0 = now
+
         def _snap_state():
             # quiesce first: a snapshot must capture a settled trajectory,
             # not buffers a still-running dispatch is about to donate away
@@ -322,6 +355,9 @@ class BaseModule:
                                         locals=locals()))
                             nbatch += 1
                         global_step += len(group)
+                        _telem_window(len(group),
+                                      sum(_batch_examples(b)
+                                          for b in group), global_step)
                         if ckpt is not None:
                             ckpt.maybe_save(_snap_state, global_step,
                                             epoch=epoch, nbatch=nbatch,
@@ -365,6 +401,12 @@ class BaseModule:
                                              locals=locals()))
                     nbatch += 1
                     global_step += 1
+                    _telem_acc[0] += 1
+                    _telem_acc[1] += _batch_examples(data_batch)
+                    if _telem_acc[0] >= _telem_every:
+                        _telem_window(_telem_acc[0], _telem_acc[1],
+                                      global_step)
+                        _telem_acc = [0, 0]
                     if ckpt is not None:
                         ckpt.maybe_save(_snap_state, global_step,
                                         epoch=epoch, nbatch=nbatch,
@@ -372,6 +414,9 @@ class BaseModule:
             # epoch boundary: drain in-flight dispatches before the host
             # reads metrics/params (one explicit wait, not one per step)
             depth_ctl.quiesce()
+            if _telem_acc[0]:    # flush the partial per-step window
+                _telem_window(_telem_acc[0], _telem_acc[1], global_step)
+                _telem_acc = [0, 0]
             for name, val in (eval_metric.get_name_value()
                               if eval_metric is not None else []):
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -304,6 +304,24 @@ class Module(BaseModule):
                                 keep_f32=self._norm_stat_params())
         self._fused_opt_state = self._fused.init_state()
 
+    def _fused_step_flops(self):
+        """Chip-free FLOPs of one fused step via XLA cost analysis, for
+        the live MFU telemetry gauge. Pays a lowering, so only the
+        MXNET_TELEMETRY_MFU=1 path in fit() calls it (bench.py supplies
+        flops via telemetry.set_run_info instead); None when no fused
+        step is bound or the backend has no cost model."""
+        if self._fused is None or self._exec is None:
+            return None
+        try:
+            ex = self._exec
+            cost = self._fused.cost_analysis(
+                ex._arg_vals(), ex._aux_vals(), self._fused_opt_state)
+            if cost and cost.get("flops", 0) > 0:
+                return float(cost["flops"])
+        except Exception:
+            pass
+        return None
+
     def _norm_stat_params(self):
         """Names of params that must stay f32 under a low-precision compute
         policy: BatchNorm gamma/beta. The bf16-native BN kernel keeps its

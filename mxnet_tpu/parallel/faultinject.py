@@ -193,6 +193,20 @@ def _apply(sp, point, ctx):
     _log.warning("fault injection: firing %r at point %r (ctx %r)",
                  sp.raw, point, ctx)
     if sp.action == "kill":
+        # flight-recorder postmortem BEFORE the process vanishes: the
+        # default kill is SIGKILL (uncatchable), so this is the only
+        # chance to leave an artifact (no-op unless MXNET_TELEMETRY_DIR
+        # is set; tools/fault_drill.py asserts the artifact). Best
+        # effort — a telemetry bug must not turn a clean injected kill
+        # into a different death.
+        try:
+            from ..telemetry import recorder as _trec
+            rec = _trec.flight_recorder()
+            rec.record_event("fault", point=point, spec=sp.raw,
+                             ctx={k: str(v) for k, v in ctx.items()})
+            rec.dump("faultinject: %s" % sp.raw)
+        except Exception:
+            pass
         # make the death observable in streamed launcher logs before the
         # process vanishes mid-write
         sys.stdout.flush()

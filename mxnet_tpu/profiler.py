@@ -37,7 +37,14 @@ class _ProfilerState:
         self.trace_dir = None       # jax.profiler XLA trace output
         self.events = []            # chrome trace events
         self.agg = {}               # name -> [count, total_us, min, max]
+        # Two clocks, captured together: durations are differences of the
+        # MONOTONIC clock (immune to NTP steps/slew mid-span), while event
+        # `ts` start fields are anchored to the wall-clock epoch so traces
+        # from different processes/hosts line up and telemetry JSONL
+        # timestamps are comparable. Producers only ever pass
+        # monotonic-relative microseconds; _ts_us converts at append time.
         self.epoch = time.monotonic()
+        self.epoch_wall_us = time.time() * 1e6
 
 
 _state = _ProfilerState()
@@ -54,6 +61,11 @@ def _maybe_autostart():
 
 def _now_us():
     return (time.monotonic() - _state.epoch) * 1e6
+
+
+def _ts_us(rel_us):
+    """Monotonic-relative microseconds -> epoch (wall) timestamp."""
+    return _state.epoch_wall_us + rel_us
 
 
 def set_config(**kwargs):
@@ -118,8 +130,8 @@ def record_event(name, cat, start_us, dur_us, tid=0):
     """Internal: called by dispatch hooks."""
     with _lock:
         _state.events.append({"name": name, "cat": cat, "ph": "X",
-                              "ts": start_us, "dur": dur_us, "pid": 0,
-                              "tid": tid})
+                              "ts": _ts_us(start_us), "dur": dur_us,
+                              "pid": 0, "tid": tid})
         if _state.aggregate_stats:
             ent = _state.agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
             ent[0] += 1
@@ -165,8 +177,8 @@ def record_host_sync(kind, nbytes=0):
     if _active:
         with _lock:
             _state.events.append({"name": "host_sync:%s" % kind, "ph": "i",
-                                  "ts": _now_us(), "pid": 0, "tid": 0,
-                                  "s": "t"})
+                                  "ts": _ts_us(_now_us()), "pid": 0,
+                                  "tid": 0, "s": "t"})
 
 
 def sync_counters():
@@ -212,8 +224,9 @@ def record_counter(name, value):
     unlike the stateful :class:`Counter` object, callers that already
     own the value just stamp it."""
     with _lock:
-        _state.events.append({"name": name, "ph": "C", "ts": _now_us(),
-                              "pid": 0, "args": {name: value}})
+        _state.events.append({"name": name, "ph": "C",
+                              "ts": _ts_us(_now_us()), "pid": 0,
+                              "args": {name: value}})
 
 
 class _OpTimer:
@@ -348,7 +361,7 @@ class Counter:
         self._value = value
         with _lock:
             _state.events.append({"name": self.name, "ph": "C",
-                                  "ts": _now_us(), "pid": 0,
+                                  "ts": _ts_us(_now_us()), "pid": 0,
                                   "args": {self.name: value}})
 
     def increment(self, delta=1):
@@ -373,7 +386,8 @@ class Marker:
     def mark(self, scope="process"):
         with _lock:
             _state.events.append({"name": self.name, "ph": "i",
-                                  "ts": _now_us(), "pid": 0, "tid": 0,
+                                  "ts": _ts_us(_now_us()), "pid": 0,
+                                  "tid": 0,
                                   "s": "p" if scope == "process" else "t"})
 
 

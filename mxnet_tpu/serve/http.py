@@ -8,7 +8,11 @@ queue; the micro-batcher coalesces across connections.
 Protocol:
   POST /v1/predict   {"inputs": {name: nested-list}, "timeout_ms": opt}
                   -> {"outputs": [...], "latency_ms": f, "bucket": b}
-  GET  /metrics      -> the Server.metrics() snapshot (JSON)
+  GET  /metrics      -> the Server.metrics() snapshot (JSON, default) or
+                        the Prometheus text exposition of the run-wide
+                        telemetry registry when the client asks for it
+                        (Accept: text/plain — what Prometheus sends — or
+                        ?format=prometheus); docs/observability.md
   GET  /healthz      -> {"status": "ok"|"draining"|"closed"}
 
 Errors: 400 bad input, 429 queue full (with Retry-After), 503 closed,
@@ -45,11 +49,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_raw(self, code, body, content_type):
+        data = body.encode("utf-8") if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
         srv = self.server.mx_server
-        if self.path == "/metrics":
-            self._reply(200, srv.metrics())
-        elif self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/metrics":
+            accept = self.headers.get("Accept", "")
+            wants_prom = ("format=prometheus" in query
+                          or ("text/plain" in accept
+                              and "application/json" not in accept))
+            if wants_prom:
+                from .. import telemetry as _telemetry
+                self._reply_raw(200, _telemetry.prometheus_text(),
+                                _telemetry.prom.CONTENT_TYPE)
+            else:
+                self._reply(200, srv.metrics())
+        elif path == "/healthz":
             status = ("closed" if srv.closed
                       else "draining" if srv.draining else "ok")
             self._reply(200 if status == "ok" else 503,

@@ -1,16 +1,22 @@
-"""Serving observability, wired into the existing profiler.
+"""Serving observability, wired into the profiler and the telemetry
+registry.
 
 Per-bucket latency percentiles (p50/p95/p99), queue depth, batch
 occupancy, padding-waste ratio and rejection counts — the numbers that
 tell an operator whether the bucket set and batching window are right.
-Two faces:
+Three faces:
 
-* ``snapshot()`` — a JSON-able dict, the ``/metrics`` endpoint body and
-  the ``bench.py`` serving leg's raw material;
-* chrome-trace events through :mod:`mxnet_tpu.profiler` when profiling
-  is active: one ``serve/bucket{B}`` duration event per device batch and
-  a ``serve/queue_depth`` counter track, so serving shows up on the same
-  timeline as everything else the profiler sees.
+* ``snapshot()`` — a JSON-able dict, the ``/metrics`` (JSON) endpoint
+  body and the ``bench.py`` serving leg's raw material;
+* the run-wide :mod:`mxnet_tpu.telemetry` registry — every hook bumps
+  the process-level ``serve/*`` series the Prometheus exposition serves
+  (``/metrics`` with ``Accept: text/plain``) and the flight recorder
+  dumps. The registry is the single source of truth for counter-style
+  series (mxlint MXL506): it mirrors label-free gauges back into the
+  chrome trace, which keeps the ``serve/queue_depth`` counter track;
+* chrome-trace duration events through :mod:`mxnet_tpu.profiler` when
+  profiling is active: one ``serve/bucket{B}`` event per device batch,
+  so serving shows up on the same timeline as everything else.
 """
 from __future__ import annotations
 
@@ -19,6 +25,7 @@ import time
 from collections import deque
 
 from .. import profiler
+from .. import telemetry as _telemetry
 
 __all__ = ["ServeMetrics", "percentile"]
 
@@ -66,6 +73,36 @@ class ServeMetrics:
         self._exec_s_total = 0.0
         self._rows_total = 0
         self._t_start = time.monotonic()
+        # run-wide registry series (docs/observability.md). Process-wide
+        # by design: several Server instances in one process aggregate,
+        # like any multi-threaded Prometheus target. Handles are cached
+        # so the hot hooks skip the registry's get-or-create lock.
+        self._tm_submitted = _telemetry.counter(
+            "serve/submitted_total", "requests admitted to the queue")
+        self._tm_completed = _telemetry.counter(
+            "serve/completed_total", "requests answered successfully")
+        self._tm_rejected = _telemetry.counter(
+            "serve/rejected_total", "requests rejected by admission "
+            "control (HTTP 429)")
+        self._tm_expired = _telemetry.counter(
+            "serve/expired_total", "requests expired in queue (HTTP 504)")
+        self._tm_dropped = _telemetry.counter(
+            "serve/dropped_total", "requests failed by non-drain shutdown")
+        self._tm_errors = _telemetry.counter(
+            "serve/errors_total", "device batch execution failures")
+        self._tm_queue_depth = _telemetry.gauge(
+            "serve/queue_depth", "requests queued ahead of the batcher")
+        self._tm_batches = _telemetry.counter(
+            "serve/batches_total", "device batches dispatched")
+        self._tm_rows = _telemetry.counter(
+            "serve/rows_total", "real rows served")
+        self._tm_padded = _telemetry.counter(
+            "serve/padded_rows_total", "pad rows wasted on bucket "
+            "rounding")
+        self._tm_latency = _telemetry.histogram(
+            "serve/latency_ms", "end-to-end request latency")
+        self._tm_exec = _telemetry.histogram(
+            "serve/exec_ms", "device batch execution time")
 
     def _bucket(self, bucket):
         st = self._buckets.get(bucket)
@@ -77,22 +114,27 @@ class ServeMetrics:
     def note_submit(self, rows=1):
         with self._lock:
             self.submitted += 1
+        self._tm_submitted.inc()
 
     def note_reject(self):
         with self._lock:
             self.rejected += 1
+        self._tm_rejected.inc()
 
     def note_expire(self, n=1):
         with self._lock:
             self.expired += n
+        self._tm_expired.inc(n)
 
     def note_drop(self, n=1):
         with self._lock:
             self.dropped += n
+        self._tm_dropped.inc(n)
 
     def note_error(self, n=1):
         with self._lock:
             self.errors += n
+        self._tm_errors.inc(n)
 
     def note_batch(self, bucket, rows, padded, exec_ms):
         with self._lock:
@@ -103,6 +145,12 @@ class ServeMetrics:
             st.exec_ms.append(exec_ms)
             self._exec_s_total += exec_ms / 1e3
             self._rows_total += rows
+        b = str(bucket)
+        self._tm_batches.inc(1, bucket=b)
+        self._tm_rows.inc(rows, bucket=b)
+        if padded:
+            self._tm_padded.inc(padded, bucket=b)
+        self._tm_exec.observe(exec_ms, bucket=b)
         if profiler.is_active("serve"):
             now = profiler._now_us()
             profiler.record_event("serve/bucket%d" % bucket, "serve",
@@ -112,13 +160,17 @@ class ServeMetrics:
         with self._lock:
             self.completed += 1
             self._bucket(bucket).latency_ms.append(latency_ms)
+        self._tm_completed.inc()
+        self._tm_latency.observe(latency_ms, bucket=str(bucket))
 
     def set_queue_depth(self, depth):
         with self._lock:
             self.queue_depth = depth
             self.queue_peak = max(self.queue_peak, depth)
-        if profiler.is_active("serve"):
-            profiler.record_counter("serve/queue_depth", depth)
+        # registry gauge is the single source of truth (MXL506); it
+        # mirrors into the chrome-trace serve/queue_depth counter track
+        # whenever the profiler is active
+        self._tm_queue_depth.set(depth)
 
     # -- derived ------------------------------------------------------------
     def throughput_rows_per_s(self):

@@ -1,0 +1,131 @@
+"""Run-wide telemetry: one registry, many producers, many exporters.
+
+The pieces (each its own module, all stdlib-only and import-light):
+
+* ``registry`` — thread-safe named counters/gauges/histograms that
+  every subsystem publishes into; ``snapshot()`` is the JSON-able view.
+* ``prom`` — Prometheus text exposition of the registry plus a strict
+  parser (``tools/serve_loadgen.py`` scrape-asserts with it).
+* ``exporters`` — training-side HTTP listener (``MXNET_TELEMETRY_PORT``)
+  and the per-window JSONL snapshot stream.
+* ``recorder`` — bounded flight recorder dumped to a postmortem JSON on
+  SIGTERM / unhandled exception / faultinject kill.
+
+The one entry point producers on the training path use is
+``publish_window``: called by ``Module.fit`` at K-step window
+boundaries with values it already holds on the host, so telemetry adds
+**zero** device→host syncs to the step loop (pinned by
+tests/test_step_sync_budget.py). Serving, the kernel tier, checkpoint,
+and fault injection publish into the same registry from their own code.
+See docs/observability.md for the operator-facing tour.
+"""
+from __future__ import annotations
+
+import time
+
+from mxnet_tpu.telemetry import exporters, prom, recorder
+from mxnet_tpu.telemetry.prom import parse_exposition
+from mxnet_tpu.telemetry.recorder import FlightRecorder, flight_recorder
+from mxnet_tpu.telemetry.registry import (
+    Counter, Gauge, Histogram, Registry, counter, default_registry, gauge,
+    histogram, run_info, set_run_info, snapshot,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "FlightRecorder",
+    "counter", "gauge", "histogram", "snapshot", "default_registry",
+    "set_run_info", "run_info", "flight_recorder", "prometheus_text",
+    "parse_exposition", "publish_window", "exporters", "prom", "recorder",
+]
+
+_jsonl = None
+
+
+def prometheus_text(registry=None):
+    return prom.exposition(registry)
+
+
+def _ensure_exporters():
+    global _jsonl
+    exporters.maybe_start_http()
+    recorder.maybe_install_handlers()
+    if _jsonl is None:
+        path = exporters.jsonl_path()
+        if path:
+            _jsonl = exporters.JsonlWriter(path)
+    return _jsonl
+
+
+def _live_mfu(steps, window_s):
+    """Host-side live MFU from run-scoped flops — no device traffic.
+    Returns None until someone (bench.py, or fit's flag-gated lazy
+    cost_analysis) has called ``set_run_info(flops_per_step=...)``."""
+    info = run_info()
+    flops = info.get("flops_per_step")
+    if not flops or window_s <= 0:
+        return None
+    from mxnet_tpu import perfmodel
+    kind = info.get("device_kind") or perfmodel.DEFAULT_DEVICE_KIND
+    try:
+        return perfmodel.mfu(float(flops), window_s / steps, kind)
+    except Exception:
+        return None
+
+
+def publish_window(*, steps, window_s, examples=None, engine_depth=None,
+                   global_step=None, source="train"):
+    """Publish one K-step window's worth of training telemetry.
+
+    Everything passed in (and everything read here) is already host
+    memory: wall-clock seconds, host-side batch shapes, the in-flight
+    dispatch count, and ``profiler.sync_counters()``. Nothing touches a
+    device array, so the PR-3 sync budget is untouched. Returns the
+    step record (also pushed into the flight recorder and, when
+    enabled, the JSONL stream).
+    """
+    from mxnet_tpu import profiler
+
+    steps = max(1, int(steps))
+    window_s = max(float(window_s), 1e-9)
+    step_ms = window_s * 1e3 / steps
+
+    gauge("train/step_time_ms",
+          "mean wall-clock ms per step over the last window").set(step_ms)
+    counter("train/steps_total", "optimizer steps dispatched").inc(steps)
+    gauge("train/window_steps", "steps per dispatch window (K)").set(steps)
+    if examples is not None and examples > 0:
+        gauge("train/examples_per_s",
+              "training throughput over the last window").set(
+                  examples / window_s)
+        counter("train/examples_total", "examples consumed").inc(examples)
+    if engine_depth is not None:
+        gauge("train/engine_depth",
+              "in-flight dispatch windows (DepthController)").set(
+                  engine_depth)
+    if global_step is not None:
+        gauge("train/global_step", "global optimizer step").set(global_step)
+
+    mfu = _live_mfu(steps, window_s)
+    if mfu is not None:
+        gauge("train/mfu",
+              "live model-flops utilization vs device peak").set(mfu)
+
+    sync = profiler.sync_counters()
+    for key in ("d2h", "wait", "depth_wait", "d2h_bytes", "total"):
+        if key in sync:
+            gauge("host_sync/%s" % key,
+                  "cumulative host-sync census (profiler)").set(sync[key])
+
+    record = {"source": source, "global_step": global_step,
+              "steps": steps, "window_s": window_s, "step_ms": step_ms,
+              "examples": examples, "engine_depth": engine_depth,
+              "mfu": mfu, "sync": dict(sync)}
+
+    jsonl = _ensure_exporters()
+    rec = flight_recorder()
+    rec.record_step(record)
+    rec.note_snapshot(snapshot())
+    if jsonl is not None:
+        jsonl.write({"ts": time.time(), "global_step": global_step,
+                     "registry": snapshot()})
+    return record

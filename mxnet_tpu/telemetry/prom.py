@@ -1,0 +1,188 @@
+"""Prometheus text exposition (format 0.0.4) for the telemetry registry.
+
+Hand-rolled on stdlib only — the container policy forbids new
+dependencies — and round-trippable: ``parse_exposition`` is a strict
+parser used by tests/test_telemetry.py (format conformance) and by
+``tools/serve_loadgen.py --scrape-metrics`` to assert a live endpoint
+actually speaks the format.
+
+Registry names are slash-namespaced (``train/step_time_ms``); exposition
+sanitizes them to ``mxtpu_train_step_time_ms`` and appends the
+conventional ``_total`` suffix to counters.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?$")
+_LABEL_RE = re.compile(
+    r'(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"')
+
+
+def sanitize_name(name, prefix="mxtpu_"):
+    """``train/step_time_ms`` -> ``mxtpu_train_step_time_ms``."""
+    base = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not base or not re.match(r"[a-zA-Z_:]", base[0]):
+        base = "_" + base
+    return prefix + base if not base.startswith(prefix) else base
+
+
+def _escape_label(value):
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(text):
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v):
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return "NaN"
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    inner = ",".join('%s="%s"' % (k, _escape_label(v))
+                     for k, v in sorted(labels.items()))
+    return "{%s}" % inner
+
+
+def _fmt_le(edge):
+    return "+Inf" if math.isinf(edge) else _fmt_value(float(edge))
+
+
+def exposition(registry=None):
+    """Render every registry series as exposition text (ends with \\n)."""
+    if registry is None:
+        from mxnet_tpu.telemetry import registry as _reg
+        registry = _reg.default_registry()
+    lines = []
+    for m in registry.collect():
+        name = sanitize_name(m.name)
+        if m.kind == "counter" and not name.endswith("_total"):
+            name += "_total"
+        if m.help:
+            lines.append("# HELP %s %s" % (name, _escape_help(m.help)))
+        lines.append("# TYPE %s %s" % (name, m.kind))
+        if m.kind == "histogram":
+            for labels, s in m.samples():
+                for le, c in sorted(s["buckets"].items()):
+                    bl = dict(labels, le=_fmt_le(le))
+                    lines.append("%s_bucket%s %s"
+                                 % (name, _fmt_labels(bl), _fmt_value(c)))
+                lines.append("%s_sum%s %s"
+                             % (name, _fmt_labels(labels),
+                                _fmt_value(s["sum"])))
+                lines.append("%s_count%s %s"
+                             % (name, _fmt_labels(labels),
+                                _fmt_value(s["count"])))
+        else:
+            for labels, v in m.samples():
+                lines.append("%s%s %s"
+                             % (name, _fmt_labels(labels), _fmt_value(v)))
+    return "\n".join(lines) + "\n"
+
+
+def _parse_value(text):
+    if text == "NaN":
+        return math.nan
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    return float(text)
+
+
+def parse_exposition(text):
+    """Strict parse of exposition text.
+
+    Returns ``{name: {"type": str|None, "help": str|None,
+    "samples": [(labels_dict, value), ...]}}`` keyed by the sample name
+    as it appears on the wire (so histogram children ``_bucket``/
+    ``_sum``/``_count`` key under their parent metric name). Raises
+    ``ValueError`` on any malformed line — that strictness is the point:
+    the serve loadgen uses this to assert a live endpoint conforms.
+    """
+    families = {}
+
+    def fam(name):
+        return families.setdefault(
+            name, {"type": None, "help": None, "samples": []})
+
+    typed = {}
+    for lineno, raw in enumerate(text.split("\n"), 1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            if not parts or not _NAME_RE.match(parts[0]):
+                raise ValueError("line %d: bad HELP: %r" % (lineno, raw))
+            fam(parts[0])["help"] = parts[1] if len(parts) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if (len(parts) != 2 or not _NAME_RE.match(parts[0]) or
+                    parts[1] not in ("counter", "gauge", "histogram",
+                                     "summary", "untyped")):
+                raise ValueError("line %d: bad TYPE: %r" % (lineno, raw))
+            fam(parts[0])["type"] = parts[1]
+            typed[parts[0]] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue            # free-form comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError("line %d: bad sample: %r" % (lineno, raw))
+        name = m.group("name")
+        labels = {}
+        if m.group("labels"):
+            body = m.group("labels")
+            pos = 0
+            while pos < len(body):
+                lm = _LABEL_RE.match(body, pos)
+                if not lm:
+                    raise ValueError("line %d: bad labels: %r"
+                                     % (lineno, raw))
+                if not _LABEL_NAME_RE.match(lm.group("name")):
+                    raise ValueError("line %d: bad label name %r"
+                                     % (lineno, lm.group("name")))
+                labels[lm.group("name")] = (
+                    lm.group("value").replace('\\"', '"')
+                    .replace("\\n", "\n").replace("\\\\", "\\"))
+                pos = lm.end()
+                if pos < len(body):
+                    if body[pos] != ",":
+                        raise ValueError("line %d: bad labels: %r"
+                                         % (lineno, raw))
+                    pos += 1
+        try:
+            value = _parse_value(m.group("value"))
+        except ValueError:
+            raise ValueError("line %d: bad value %r"
+                             % (lineno, m.group("value")))
+        # histogram children key under the parent family
+        parent = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stem = name[:-len(suffix)] if name.endswith(suffix) else None
+            if stem and typed.get(stem) == "histogram":
+                parent = stem
+                break
+        fam(parent)["samples"].append((labels, value))
+    return families

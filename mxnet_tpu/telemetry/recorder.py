@@ -1,0 +1,192 @@
+"""Flight recorder: bounded in-memory history, dumped on the way down.
+
+A ring buffer of recent step records, the last few full counter
+snapshots, and notable events (checkpoint commits, fault injections),
+all host-side and O(1) per record. When the process dies — SIGTERM,
+unhandled exception, or a ``faultinject`` kill — the recorder writes a
+postmortem JSON under ``MXNET_TELEMETRY_DIR`` so ``tools/launch.py``
+restarts and fault drills leave a readable artifact instead of a silent
+corpse (tools/fault_drill.py asserts exactly that).
+
+Dumping is opt-in via the directory flag: with ``MXNET_TELEMETRY_DIR``
+unset, ``dump()`` is a no-op and no signal handlers are installed, so
+test runs and one-off scripts never grow surprise files or altered
+SIGTERM dispositions.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+SNAPSHOT_KEEP = 8      # full registry snapshots kept alongside the ring
+EVENT_KEEP = 64
+
+
+def _flight_len():
+    try:
+        from mxnet_tpu.config import flags
+        return max(1, int(flags.telemetry_flight_len))
+    except Exception:
+        return 256
+
+
+def _dump_dir():
+    try:
+        from mxnet_tpu.config import flags
+        return flags.telemetry_dir or None
+    except Exception:
+        return None
+
+
+def _rank():
+    # same resolution order as faultinject's rank matching, so the
+    # postmortem filename names the rank the drill killed
+    for var in ("MXNET_WORKER_RANK", "DMLC_WORKER_ID", "RANK"):
+        v = os.environ.get(var)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+class FlightRecorder:
+    def __init__(self, maxlen=None):
+        self._lock = threading.Lock()
+        self._steps = collections.deque(maxlen=maxlen or _flight_len())
+        self._snapshots = collections.deque(maxlen=SNAPSHOT_KEEP)
+        self._events = collections.deque(maxlen=EVENT_KEEP)
+        self._dumped = False
+
+    def record_step(self, record):
+        """Append one step-window record (a small JSON-able dict)."""
+        rec = dict(record)
+        rec.setdefault("wall_time", time.time())
+        with self._lock:
+            self._steps.append(rec)
+
+    def record_event(self, kind, **fields):
+        ev = {"kind": kind, "wall_time": time.time()}
+        ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+
+    def note_snapshot(self, snap):
+        with self._lock:
+            self._snapshots.append({"wall_time": time.time(),
+                                    "registry": snap})
+
+    def postmortem(self, reason):
+        from mxnet_tpu.telemetry import registry as _reg
+        from mxnet_tpu import profiler
+        with self._lock:
+            steps = list(self._steps)
+            snapshots = list(self._snapshots)
+            events = list(self._events)
+        try:
+            sync = profiler.sync_counters()
+        except Exception:
+            sync = {}
+        return {
+            "reason": reason,
+            "wall_time": time.time(),
+            "pid": os.getpid(),
+            "rank": _rank(),
+            "argv": list(sys.argv),
+            "run_info": _reg.run_info(),
+            "sync_counters": sync,
+            "steps": steps,
+            "snapshots": snapshots,
+            "events": events,
+            "registry": _reg.snapshot(),
+        }
+
+    def dump(self, reason, path=None, force=False):
+        """Write the postmortem JSON; returns the path or None.
+
+        Best-effort by design: this runs inside signal handlers, the
+        excepthook, and the faultinject kill path, where a secondary
+        failure must never mask the original death. Once per process
+        unless ``force`` — SIGTERM followed by the excepthook should
+        not clobber the first (closest-to-the-fault) artifact.
+        """
+        with self._lock:
+            if self._dumped and not force:
+                return None
+        try:
+            if path is None:
+                d = _dump_dir()
+                if d is None:
+                    return None
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, "postmortem_rank%d_pid%d.json"
+                    % (_rank(), os.getpid()))
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.postmortem(reason), f, indent=1,
+                          default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            with self._lock:
+                self._dumped = True
+            return path
+        except Exception:
+            return None
+
+
+_recorder = FlightRecorder()
+_install_lock = threading.Lock()
+_installed = False
+
+
+def flight_recorder():
+    return _recorder
+
+
+def maybe_install_handlers():
+    """Chain a SIGTERM handler and sys.excepthook that dump before the
+    process goes down. No-op (and no disposition change) unless a dump
+    directory is configured; safe off the main thread (signal install
+    silently skipped there)."""
+    global _installed
+    if _dump_dir() is None:
+        return False
+    with _install_lock:
+        if _installed:
+            return True
+        _installed = True
+
+    prev_hook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        _recorder.record_event("exception", type=exc_type.__name__,
+                               message=str(exc))
+        _recorder.dump("exception: %s: %s" % (exc_type.__name__, exc))
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            _recorder.record_event("signal", signum=signum)
+            _recorder.dump("SIGTERM")
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass        # not the main thread: excepthook alone still works
+    return True

@@ -21,7 +21,7 @@ from ..perfmodel import peak_flops, hbm_bytes_per_s, DEFAULT_DEVICE_KIND
 from .space import VMEM_BYTES
 
 __all__ = ["FEATURE_NAMES", "features", "LinearCostModel",
-           "default_model"]
+           "default_model", "save_weights", "default_weights_path"]
 
 FEATURE_NAMES = ("hbm_time_us", "flop_time_us", "grid_overhead_us",
                  "misalign", "waste", "vmem_frac")
@@ -135,5 +135,67 @@ class LinearCostModel:
         return dict(self.weights)
 
 
+WEIGHTS_VERSION = 1
+_loaded_weights = (None, None, None)   # (path, mtime, weights | None)
+
+
+def default_weights_path():
+    """Recalibrated-weights file consulted by :func:`default_model`:
+    ``MXNET_KERNEL_COST_MODEL`` when set, else unset (ship weights)."""
+    try:
+        from mxnet_tpu.config import flags
+        return flags.kernel_cost_model or None
+    except Exception:
+        return None
+
+
+def save_weights(model, path):
+    """Persist recalibrated weights (``autotune.py --recalibrate
+    --save-model``) in the format ``default_model`` reloads."""
+    import json
+    import os
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": WEIGHTS_VERSION,
+                   "features": list(FEATURE_NAMES),
+                   "weights": model.to_dict()}, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def _load_weights(path):
+    """mtime-memoized read of a persisted weights file; None when the
+    file is missing, stale-formatted, or unreadable (ship weights win)."""
+    global _loaded_weights
+    import json
+    import os
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    if _loaded_weights[0] == path and _loaded_weights[1] == mtime:
+        return _loaded_weights[2]
+    weights = None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if (isinstance(doc, dict) and doc.get("version") == WEIGHTS_VERSION
+                and isinstance(doc.get("weights"), dict)
+                and all(k in doc["weights"] for k in FEATURE_NAMES)):
+            weights = {k: float(doc["weights"][k]) for k in FEATURE_NAMES}
+    except (OSError, ValueError, TypeError):
+        weights = None
+    _loaded_weights = (path, mtime, weights)
+    return weights
+
+
 def default_model():
+    path = default_weights_path()
+    if path:
+        weights = _load_weights(path)
+        if weights:
+            return LinearCostModel(weights)
     return LinearCostModel()

@@ -1,0 +1,183 @@
+"""Measured kernel timings: the data that feeds ``LinearCostModel.fit``.
+
+ROADMAP item 1 left one loop open: the chip-free cost model ships with
+hand-rounded weights and "nothing feeds it yet". This module closes it.
+When the tuner measures candidates **on-chip**, every (features,
+wall-time) pair is appended to a JSONL log (``MXNET_KERNEL_TIMINGS``,
+or ``$MXNET_TELEMETRY_DIR/kernel_timings.jsonl``); a later chip-free
+``tools/autotune.py --recalibrate`` run loads the log, refits the
+linear model with ordinary least squares, and reports how much the
+model's *ranking* agrees with the measured ground truth before and
+after — ranking is all the tuner needs from it (2008.01040's framing).
+
+Row schema (one JSON object per line)::
+
+    {"op": "bn_act", "key": "bn_act|8192x4096|bfloat16",
+     "shapes": [[8192, 4096]], "dtype": "bfloat16",
+     "config": {"block_r": 256, "block_s": 512},
+     "features": {"hbm_time_us": ..., ...}, "time_us": 183.2,
+     "device_kind": "TPU v5 lite", "wall_time": 1754380000.0}
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+
+from . import cost_model as _cm
+from .cache import shape_bucket_key
+
+__all__ = ["timings_path", "record_rows", "load", "ranking_agreement",
+           "recalibrate"]
+
+REQUIRED = ("op", "shapes", "dtype", "config", "features", "time_us")
+
+
+def timings_path():
+    """Resolved timing-log path, or None when recording is disabled."""
+    try:
+        from mxnet_tpu.config import flags
+        if flags.kernel_timings:
+            return flags.kernel_timings
+        if flags.telemetry_dir:
+            return os.path.join(flags.telemetry_dir, "kernel_timings.jsonl")
+    except Exception:
+        pass
+    return None
+
+
+def record_rows(op, shapes, dtype, device_kind, rows, path=None):
+    """Append the tuner's *measured* ranking rows to the timing log.
+    No-op (returns 0) when no path is configured."""
+    path = path or timings_path()
+    if not path:
+        return 0
+    shapes = [list(s) for s in shapes]
+    key = shape_bucket_key(op, tuple(tuple(s) for s in shapes), str(dtype))
+    now = time.time()
+    written = 0
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        for row in rows:
+            if row.get("source") != "measured":
+                continue
+            f.write(json.dumps({
+                "op": op, "key": key, "shapes": shapes,
+                "dtype": str(dtype), "config": row["config"],
+                "features": row["features"],
+                "time_us": row["score_us"],
+                "device_kind": device_kind, "wall_time": now,
+            }) + "\n")
+            written += 1
+    return written
+
+
+def load(path):
+    """Parse a timing log; returns (rows, n_skipped). Lines that are not
+    JSON objects with the full schema are counted, not fatal — a log
+    that survived a mid-write kill should still recalibrate."""
+    rows, skipped = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if (not isinstance(row, dict)
+                    or any(k not in row for k in REQUIRED)
+                    or any(k not in row["features"]
+                           for k in _cm.FEATURE_NAMES)):
+                skipped += 1
+                continue
+            rows.append(row)
+    return rows, skipped
+
+
+def _group_by_task(rows):
+    keyed = {}
+    for row in rows:
+        key = row.get("key") or shape_bucket_key(
+            row["op"], tuple(tuple(s) for s in row["shapes"]),
+            str(row["dtype"]))
+        keyed.setdefault(key, []).append(row)
+    return keyed
+
+
+def ranking_agreement(model, rows):
+    """How well the model *ranks* measured rows, per tuning task.
+
+    Returns ``{"pairwise": frac, "top1": frac, "tasks": {key: {...}}}``
+    where pairwise is the fraction of (faster, slower) measured pairs
+    the model orders the same way (ties in either ordering count half),
+    and top1 is the fraction of tasks whose measured winner the model
+    also ranks first.
+    """
+    tasks = {}
+    agree = total = 0.0
+    top1_hits = top1_tasks = 0
+    for key, group in sorted(_group_by_task(rows).items()):
+        if len(group) < 2:
+            continue
+        preds = [model.predict(r["features"]) for r in group]
+        times = [float(r["time_us"]) for r in group]
+        t_agree = t_total = 0.0
+        for i, j in itertools.combinations(range(len(group)), 2):
+            dt, dp = times[i] - times[j], preds[i] - preds[j]
+            if dt == 0:
+                continue
+            t_total += 1
+            if dp == 0:
+                t_agree += 0.5
+            elif (dt > 0) == (dp > 0):
+                t_agree += 1
+        measured_best = min(range(len(group)), key=lambda k: times[k])
+        model_best = min(range(len(group)), key=lambda k: preds[k])
+        top1 = measured_best == model_best
+        top1_tasks += 1
+        top1_hits += int(top1)
+        agree += t_agree
+        total += t_total
+        tasks[key] = {
+            "n": len(group),
+            "pairwise": (t_agree / t_total) if t_total else 1.0,
+            "top1": top1,
+        }
+    return {
+        "pairwise": (agree / total) if total else 1.0,
+        "top1": (top1_hits / top1_tasks) if top1_tasks else 1.0,
+        "tasks": tasks,
+    }
+
+
+def recalibrate(rows, base_model=None):
+    """Fit a fresh model on the measured rows and compare rankings.
+
+    Returns ``(fitted_model, report)`` where report carries the
+    before/after ``ranking_agreement`` summaries plus row counts; the
+    caller (autotune CLI) renders it and decides whether to persist the
+    fitted weights.
+    """
+    if not rows:
+        raise ValueError("no usable timing rows to recalibrate from")
+    base = base_model or _cm.default_model()
+    before = ranking_agreement(base, rows)
+    fitted = _cm.LinearCostModel().fit(
+        [r["features"] for r in rows],
+        [float(r["time_us"]) for r in rows])
+    after = ranking_agreement(fitted, rows)
+    report = {
+        "rows": len(rows),
+        "tasks": len(_group_by_task(rows)),
+        "before": before,
+        "after": after,
+        "weights_before": base.to_dict(),
+        "weights_after": fitted.to_dict(),
+    }
+    return fitted, report

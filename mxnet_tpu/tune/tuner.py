@@ -126,6 +126,14 @@ def tune(op, shapes, dtype, chip_free=None, model=None,
         rows.append({"config": config, "score_us": float(score),
                      "features": feat, "source": source})
     rows.sort(key=lambda r: (r["score_us"], _config_key(r["config"])))
+    if not chip_free:
+        # feed the chip-free cost model: measured (features, time) pairs
+        # land in the timing log for `autotune.py --recalibrate`
+        from . import timings as _timings
+        try:
+            _timings.record_rows(op, shapes, str(dtype), device_kind, rows)
+        except OSError:
+            pass
     key = shape_bucket_key(op, shapes, str(dtype))
     return TuneResult(
         op=op, key=key, dtype=str(dtype),

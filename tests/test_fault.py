@@ -129,6 +129,61 @@ def test_fault_inject_kill_fires_only_on_matching_rank(tmp_path):
     # both are fine; rank 0 must NOT have survived the injection
 
 
+def test_fault_inject_kill_dumps_flight_recorder_postmortem(tmp_path):
+    """An injected kill must leave a flight-recorder postmortem under
+    MXNET_TELEMETRY_DIR — written on the kill path BEFORE the signal,
+    so it works even for uncatchable SIGKILL specs."""
+    import glob
+    import json
+    telem = str(tmp_path / "telemetry")
+    prog = (
+        "from mxnet_tpu import telemetry\n"
+        "from mxnet_tpu.parallel import faultinject\n"
+        "for s in range(5):\n"
+        "    telemetry.publish_window(steps=1, window_s=0.01, examples=4,\n"
+        "                             engine_depth=1, global_step=s)\n"
+        "    faultinject.fire('step', step=s)\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["MXNET_FAULT_INJECT"] = "kill@step=3:rc=7"
+    env["MXNET_TELEMETRY_DIR"] = telem
+    env["MXNET_WORKER_RANK"] = "0"
+    r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                       text=True, timeout=120, cwd=ROOT, env=env)
+    assert r.returncode == 7, r.stdout[-2000:] + r.stderr[-2000:]
+    pm = glob.glob(os.path.join(telem, "postmortem_rank0_pid*.json"))
+    assert len(pm) == 1, pm
+    with open(pm[0]) as f:
+        post = json.load(f)
+    assert post["reason"] == "faultinject: kill@step=3:rc=7"
+    assert post["rank"] == 0
+    # the ring holds the windows published up to the kill; the fault
+    # itself is on the event log and the registry snapshot rode along
+    assert [s["global_step"] for s in post["steps"]] == [0, 1, 2, 3]
+    assert any(ev["kind"] == "fault" for ev in post["events"])
+    assert "train/step_time_ms" in post["registry"]
+
+
+def test_no_telemetry_dir_no_postmortem(tmp_path):
+    """Opt-in contract: without MXNET_TELEMETRY_DIR the kill path writes
+    nothing anywhere (and still kills)."""
+    prog = (
+        "from mxnet_tpu.parallel import faultinject\n"
+        "for s in range(5):\n"
+        "    faultinject.fire('step', step=s)\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TELEMETRY_DIR", None)
+    env["MXNET_FAULT_INJECT"] = "kill@step=2:rc=3"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                       text=True, timeout=120, cwd=str(tmp_path), env=env)
+    assert r.returncode == 3
+    assert list(tmp_path.iterdir()) == []
+
+
 @pytest.mark.slow
 def test_kill_resume_bitwise_matches_uninterrupted(tmp_path):
     """THE elastic-training acceptance test: an injected kill of rank 0

@@ -338,6 +338,37 @@ class TestLockRules:
         assert _rules(a) == []
 
 
+class TestTelemetryDiscipline:
+    def test_raw_profiler_counter_fires(self):
+        bad = (
+            "from mxnet_tpu import profiler\n"
+            "def publish(depth):\n"
+            "    profiler.record_counter('serve/queue_depth', depth)\n")
+        assert "MXL506" in _rules(bad)
+
+    def test_registry_path_and_slash_free_names_pass(self):
+        # the registry's own trace mirror is the sanctioned caller, and
+        # slash-free names are not registry-owned series
+        mirror = (
+            "from mxnet_tpu import profiler\n"
+            "def _mirror_to_trace(name, value):\n"
+            "    profiler.record_counter(name, value)\n")
+        assert "MXL506" not in _rules(
+            mirror, path="mxnet_tpu/telemetry/registry.py")
+        plain = (
+            "from mxnet_tpu import profiler\n"
+            "def publish(n):\n"
+            "    profiler.record_counter('lintdebt', n)\n")
+        assert "MXL506" not in _rules(plain)
+
+    def test_registry_publish_passes(self):
+        good = (
+            "from mxnet_tpu import telemetry\n"
+            "def publish(depth):\n"
+            "    telemetry.gauge('serve/queue_depth').set(depth)\n")
+        assert _rules(good) == []
+
+
 def test_parse_error_is_a_diagnostic_not_a_crash():
     diags = _diags("def broken(:\n")
     assert [d.rule for d in diags] == ["MXL001"]

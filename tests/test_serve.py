@@ -351,6 +351,31 @@ def test_http_front_end_round_trip(art):
     assert srv.closed
 
 
+def test_http_metrics_speaks_prometheus_on_request(art):
+    """Content negotiation on /metrics: JSON snapshot by default (the
+    back-compat path asserted above), Prometheus text exposition for a
+    scraper's Accept header or ?format=prometheus."""
+    from mxnet_tpu.telemetry import prom
+    srv = Server(art["path"], buckets=(1,), batch_timeout_ms=1)
+    front = serve_http(srv, host="127.0.0.1", port=0)
+    try:
+        url = front.address
+        rng = np.random.RandomState(13)
+        srv.submit(data=_x(rng), timeout_ms=30000).result(30)
+        req = urllib.request.Request(url + "/metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers["Content-Type"] == prom.CONTENT_TYPE
+            fams = prom.parse_exposition(r.read().decode())
+        assert fams["mxtpu_serve_completed_total"]["samples"][0][1] >= 1
+        assert "mxtpu_serve_latency_ms" in fams
+        with urllib.request.urlopen(url + "/metrics?format=prometheus",
+                                    timeout=10) as r:
+            prom.parse_exposition(r.read().decode())
+    finally:
+        front.stop(drain=True)
+
+
 # ---------------------------------------------------------------------------
 # soak: graceful restart drops nothing (slow tier)
 # ---------------------------------------------------------------------------

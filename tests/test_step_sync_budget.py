@@ -29,6 +29,7 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import profiler
+from mxnet_tpu import telemetry
 from mxnet_tpu import config as _config
 from mxnet_tpu.config import flags
 from mxnet_tpu.io import DataBatch, DataDesc
@@ -121,8 +122,25 @@ def test_resnet50_fit_syncs_at_most_once_per_k_steps():
     # the budget: <= 1 involuntary d2h for the whole K-step window. The
     # single allowed transfer is the epoch-end metric publish (a few
     # bytes); compile/dispatch/feed never move device data to host.
+    # Telemetry is ON (registry default-enabled, no flag) for this run,
+    # so these bounds also pin the tentpole claim: window sampling adds
+    # ZERO device->host transfers on top of the metric publish.
     assert counters["d2h"] <= 1, counters
     assert counters["d2h_bytes"] <= 64, counters
+
+    # ...and the windows really were published from host-held values:
+    # the K-batch epoch is one dispatch window, so every train/ series
+    # carries the whole epoch
+    reg = telemetry.default_registry()
+    assert reg.get("train/step_time_ms").value() > 0
+    assert reg.get("train/window_steps").value() == K
+    assert reg.get("train/examples_per_s").value() > 0
+    assert reg.get("train/engine_depth").value() is not None
+    assert reg.get("train/global_step").value() >= K
+    assert reg.get("train/steps_total").value() >= K
+    # the host_sync/* gauges republish the same census sampled ABOVE at
+    # the last window boundary — they can only lag counters, never add
+    assert reg.get("host_sync/d2h").value() <= counters["d2h"]
 
     # the epoch-end publish wrote the device carry into the wrapped
     # host metric, so the caller's own metric object reads normally

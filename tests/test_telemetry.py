@@ -1,0 +1,434 @@
+"""Run-wide telemetry tests: registry semantics and thread-safety,
+Prometheus exposition conformance (round-trip through the strict
+parser), the exporters (HTTP listener, JSONL stream), the flight
+recorder's ring bounds and postmortem dumps, and the chip-timing
+recalibration path (timings log -> LinearCostModel.fit -> ranking
+agreement, plus the autotune --recalibrate CLI). All chip-free."""
+import json
+import math
+import os
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from mxnet_tpu import config as _config
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import prom
+from mxnet_tpu.telemetry.recorder import FlightRecorder
+from mxnet_tpu.telemetry.registry import Registry
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import autotune as autotune_cli  # noqa: E402
+
+sys.path.pop(0)
+
+
+# ---------------------------------------------------------------- registry
+
+class TestRegistry:
+    def test_counter_inc_value_and_labels(self):
+        reg = Registry()
+        c = reg.counter("kernel/dispatch_total")
+        c.inc()
+        c.inc(2, op="bn_act")
+        c.inc(3, op="bn_act")
+        assert c.value() == 1
+        assert c.value(op="bn_act") == 5
+        assert c.value(op="other") == 0
+        assert sorted((lb.get("op", ""), v) for lb, v in c.samples()) \
+            == [("", 1.0), ("bn_act", 5.0)]
+
+    def test_counter_cannot_decrease(self):
+        with pytest.raises(ValueError):
+            Registry().counter("x").inc(-1)
+
+    def test_gauge_set_and_add(self):
+        g = Registry().gauge("train/engine_depth")
+        assert g.value() is None
+        g.set(3)
+        g.add(-1)
+        assert g.value() == 2.0
+
+    def test_histogram_cumulative_buckets(self):
+        h = Registry().histogram("serve/latency_ms", buckets=(1, 10, 100))
+        for v in (0.5, 5, 5, 50, 5000):
+            h.observe(v)
+        ((labels, s),) = h.samples()
+        assert labels == {}
+        assert s["buckets"] == {1.0: 1, 10.0: 3, 100.0: 4, math.inf: 5}
+        assert s["count"] == 5 and s["sum"] == pytest.approx(5060.5)
+
+    def test_get_or_create_and_kind_clash(self):
+        reg = Registry()
+        a = reg.counter("a/b", "first help wins")
+        assert reg.counter("a/b") is a
+        assert a.help == "first help wins"
+        with pytest.raises(TypeError):
+            reg.gauge("a/b")
+        assert reg.get("a/b") is a and reg.get("nope") is None
+
+    def test_snapshot_is_json_able(self):
+        reg = Registry()
+        reg.counter("c").inc(2, op="x")
+        reg.gauge("g").set(1.5)
+        reg.histogram("h", buckets=(1.0,)).observe(3)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["c"]["type"] == "counter"
+        assert snap["h"]["samples"][0]["buckets"] == {"1.0": 0, "+Inf": 1}
+
+    def test_run_info_merge_skips_none(self):
+        reg = Registry()
+        reg.set_run_info(flops_per_step=1e9, device_kind=None)
+        reg.set_run_info(batch_size=128)
+        assert reg.run_info() == {"flops_per_step": 1e9, "batch_size": 128}
+
+    def test_concurrent_publishers_lose_nothing(self):
+        """The exactness contract: N threads x M increments == N*M, with
+        scrapes running concurrently (collect must not deadlock or tear)."""
+        reg = Registry()
+        c = reg.counter("stress/total")
+        h = reg.histogram("stress/lat", buckets=(1, 10))
+        N, M = 8, 500
+        stop = threading.Event()
+
+        def publish(tid):
+            for i in range(M):
+                c.inc()
+                c.inc(1, worker=str(tid))
+                h.observe(i % 20)
+
+        def scrape():
+            while not stop.is_set():
+                prom.parse_exposition(prom.exposition(reg))
+
+        scraper = threading.Thread(target=scrape, daemon=True)
+        scraper.start()
+        threads = [threading.Thread(target=publish, args=(t,))
+                   for t in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        scraper.join(5)
+        assert c.value() == N * M
+        assert sum(c.value(worker=str(t)) for t in range(N)) == N * M
+        ((_, s),) = h.samples()
+        assert s["count"] == N * M
+
+
+# ------------------------------------------------------------- prometheus
+
+class TestPrometheusExposition:
+    def _reg(self):
+        reg = Registry()
+        reg.counter("kernel/dispatch_total", "dispatches").inc(
+            4, op="bn_act")
+        reg.gauge("train/step_time_ms", "per-step ms").set(12.25)
+        reg.histogram("serve/latency_ms", buckets=(1, 10)).observe(3)
+        return reg
+
+    def test_round_trip_and_naming(self):
+        text = prom.exposition(self._reg())
+        fams = prom.parse_exposition(text)
+        # counters grow _total exactly once; slashes sanitize to _
+        assert "mxtpu_kernel_dispatch_total" in fams
+        assert fams["mxtpu_kernel_dispatch_total"]["type"] == "counter"
+        assert fams["mxtpu_kernel_dispatch_total"]["samples"] \
+            == [({"op": "bn_act"}, 4.0)]
+        assert fams["mxtpu_train_step_time_ms"]["samples"] == [({}, 12.25)]
+
+    def test_histogram_children_key_under_parent(self):
+        fams = prom.parse_exposition(prom.exposition(self._reg()))
+        f = fams["mxtpu_serve_latency_ms"]
+        assert f["type"] == "histogram"
+        by_le = {lb.get("le"): v for lb, v in f["samples"] if "le" in lb}
+        assert by_le == {"1": 0.0, "10": 1.0, "+Inf": 1.0}
+        # _sum and _count folded in too: 2 extra label-free samples
+        assert len(f["samples"]) == 5
+
+    def test_label_escaping_survives_round_trip(self):
+        reg = Registry()
+        reg.counter("c").inc(1, path='a"b\\c\nd')
+        fams = prom.parse_exposition(prom.exposition(reg))
+        ((labels, v),) = fams["mxtpu_c_total"]["samples"]
+        assert labels == {"path": 'a"b\\c\nd'} and v == 1.0
+
+    def test_special_values(self):
+        reg = Registry()
+        reg.gauge("g").set(math.inf)
+        reg.gauge("g").set(math.nan, kind="n")
+        fams = prom.parse_exposition(prom.exposition(reg))
+        vals = {tuple(lb.items()): v for lb, v in
+                fams["mxtpu_g"]["samples"]}
+        assert vals[()] == math.inf
+        assert math.isnan(vals[(("kind", "n"),)])
+
+    def test_parser_is_strict(self):
+        for bad in ("metric 1 2 3 junk\n", "1bad_name 2\n",
+                    'm{no_quote=3} 1\n', "m nope\n"):
+            with pytest.raises(ValueError):
+                prom.parse_exposition(bad)
+
+    def test_sanitize_name(self):
+        assert prom.sanitize_name("train/step_time_ms") \
+            == "mxtpu_train_step_time_ms"
+        assert prom.sanitize_name("0weird-name") == "mxtpu__0weird_name"
+
+
+# -------------------------------------------------------------- exporters
+
+class TestExporters:
+    def test_http_listener_on_ephemeral_port(self):
+        telemetry.gauge("exporters_test/alive").set(1)
+        srv = telemetry.exporters.TelemetryHTTPServer(
+            host="127.0.0.1", port=0).start()
+        try:
+            assert srv.port > 0
+            with urllib.request.urlopen(srv.address + "/metrics",
+                                        timeout=10) as r:
+                assert r.headers["Content-Type"] == prom.CONTENT_TYPE
+                fams = prom.parse_exposition(r.read().decode())
+            assert "mxtpu_exporters_test_alive" in fams
+            with urllib.request.urlopen(srv.address + "/metrics.json",
+                                        timeout=10) as r:
+                snap = json.loads(r.read().decode())
+            assert "exporters_test/alive" in snap
+            with urllib.request.urlopen(srv.address + "/healthz",
+                                        timeout=10) as r:
+                assert json.loads(r.read().decode())["status"] == "ok"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(srv.address + "/nope", timeout=10)
+        finally:
+            srv.stop()
+
+    def test_jsonl_writer_appends(self, tmp_path):
+        path = str(tmp_path / "sub" / "telemetry.jsonl")
+        w = telemetry.exporters.JsonlWriter(path)
+        assert w.write({"global_step": 1})
+        assert w.write({"global_step": 2})
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ln["global_step"] for ln in lines] == [1, 2]
+
+    def test_jsonl_path_resolution(self, tmp_path):
+        with _config.override(telemetry_jsonl="", telemetry_dir=""):
+            assert telemetry.exporters.jsonl_path() is None
+        with _config.override(telemetry_dir=str(tmp_path)):
+            assert telemetry.exporters.jsonl_path() \
+                == os.path.join(str(tmp_path), "telemetry.jsonl")
+        with _config.override(telemetry_jsonl="/x/y.jsonl",
+                              telemetry_dir=str(tmp_path)):
+            assert telemetry.exporters.jsonl_path() == "/x/y.jsonl"
+
+
+# --------------------------------------------------------- publish_window
+
+class TestPublishWindow:
+    def test_populates_series_and_returns_record(self):
+        rec = telemetry.publish_window(steps=16, window_s=0.8,
+                                       examples=512, engine_depth=2,
+                                       global_step=160)
+        assert rec["step_ms"] == pytest.approx(50.0)
+        reg = telemetry.default_registry()
+        assert reg.get("train/step_time_ms").value() \
+            == pytest.approx(50.0)
+        assert reg.get("train/examples_per_s").value() \
+            == pytest.approx(512 / 0.8)
+        assert reg.get("train/engine_depth").value() == 2
+        assert reg.get("train/global_step").value() == 160
+        assert reg.get("host_sync/d2h") is not None
+
+    def test_adds_zero_host_syncs(self):
+        """The tentpole invariant: publishing a window touches no device
+        array, so the profiler's sync census does not move."""
+        from mxnet_tpu import profiler
+        before = profiler.sync_counters()
+        for i in range(5):
+            telemetry.publish_window(steps=4, window_s=0.1, examples=16,
+                                     engine_depth=1, global_step=i)
+        assert profiler.sync_counters() == before
+
+    def test_live_mfu_from_run_info(self):
+        reg = telemetry.default_registry()
+        reg.set_run_info(flops_per_step=1e12, device_kind=None)
+        try:
+            telemetry.publish_window(steps=10, window_s=1.0)
+            mfu = reg.get("train/mfu").value()
+            assert mfu is not None and 0 < mfu
+        finally:
+            reg._run_info.pop("flops_per_step", None)
+
+    def test_mirrors_label_free_series_into_trace(self, tmp_path):
+        import mxnet_tpu as mx
+        prof = str(tmp_path / "telemetry_prof.json")
+        mx.profiler.set_config(filename=prof)
+        mx.profiler.set_state("run")
+        try:
+            telemetry.gauge("mirror_test/depth").set(7)
+        finally:
+            mx.profiler.set_state("stop")
+        mx.profiler.dump()
+        with open(prof) as f:
+            events = json.load(f)["traceEvents"]
+        tracks = [e for e in events if e.get("ph") == "C"
+                  and e.get("name") == "mirror_test/depth"]
+        assert tracks and tracks[-1]["args"]["mirror_test/depth"] == 7.0
+
+
+# --------------------------------------------------------- flight recorder
+
+class TestFlightRecorder:
+    def test_ring_bounds(self):
+        rec = FlightRecorder(maxlen=4)
+        for i in range(10):
+            rec.record_step({"global_step": i})
+        pm = rec.postmortem("test")
+        assert [s["global_step"] for s in pm["steps"]] == [6, 7, 8, 9]
+
+    def test_postmortem_payload(self):
+        rec = FlightRecorder(maxlen=8)
+        rec.record_step({"global_step": 1})
+        rec.record_event("ckpt", step=1)
+        rec.note_snapshot({"some": "registry"})
+        pm = rec.postmortem("why not")
+        assert pm["reason"] == "why not"
+        assert pm["pid"] == os.getpid()
+        assert pm["events"][0]["kind"] == "ckpt"
+        assert pm["snapshots"][0]["registry"] == {"some": "registry"}
+        assert "registry" in pm and "sync_counters" in pm
+        json.dumps(pm, default=str)   # JSON-able end to end
+
+    def test_dump_is_noop_without_dir(self):
+        with _config.override(telemetry_dir=""):
+            assert FlightRecorder(maxlen=2).dump("no dir") is None
+
+    def test_dump_writes_once_unless_forced(self, tmp_path):
+        rec = FlightRecorder(maxlen=2)
+        rec.record_step({"global_step": 3})
+        with _config.override(telemetry_dir=str(tmp_path)):
+            path = rec.dump("first")
+            assert path and os.path.dirname(path) == str(tmp_path)
+            with open(path) as f:
+                post = json.load(f)
+            assert post["reason"] == "first"
+            assert post["steps"][0]["global_step"] == 3
+            assert rec.dump("second") is None          # once per process
+            assert rec.dump("third", force=True) == path
+            with open(path) as f:
+                assert json.load(f)["reason"] == "third"
+
+
+# ----------------------------------------------------- recalibration path
+
+def _synthetic_rows(n_tasks=3, n_cfg=8, seed=7):
+    """Timing rows from a perturbed linear ground truth: a fresh OLS fit
+    must rank them (near-)perfectly, the shipped weights imperfectly."""
+    import random
+    from mxnet_tpu.tune import cost_model as cm
+    rng = random.Random(seed)
+    true_w = {"hbm_time_us": 1.7, "flop_time_us": 0.4,
+              "grid_overhead_us": 3.0, "misalign": 120.0,
+              "waste": 5.0, "vmem_frac": 0.5}
+    rows = []
+    for t in range(n_tasks):
+        for _ in range(n_cfg):
+            feat = {k: rng.random() * 10 for k in cm.FEATURE_NAMES}
+            rows.append({
+                "op": "bn_act", "key": "bn_act|task%d|bfloat16" % t,
+                "shapes": [[8192, 4096]], "dtype": "bfloat16",
+                "config": {"block_r": 8 * (t + 1)},
+                "features": feat,
+                "time_us": sum(true_w[k] * feat[k]
+                               for k in cm.FEATURE_NAMES),
+            })
+    return rows
+
+
+class TestRecalibration:
+    def test_record_rows_writes_only_measured(self, tmp_path):
+        from mxnet_tpu.tune import cost_model as cm, timings
+        path = str(tmp_path / "kt.jsonl")
+        rows = [
+            {"config": {"block_r": 8}, "source": "measured",
+             "features": {k: 1.0 for k in cm.FEATURE_NAMES},
+             "score_us": 10.0},
+            {"config": {"block_r": 16}, "source": "model",
+             "features": {}, "score_us": 5.0},
+        ]
+        n = timings.record_rows("bn_act", ((8192, 4096),), "bfloat16",
+                                "TPU v5 lite", rows, path=path)
+        assert n == 1
+        loaded, skipped = timings.load(path)
+        assert len(loaded) == 1 and skipped == 0
+        assert loaded[0]["time_us"] == 10.0
+        assert loaded[0]["key"].startswith("bn_act|")
+
+    def test_record_rows_disabled_without_path(self, tmp_path):
+        from mxnet_tpu.tune import timings
+        with _config.override(kernel_timings="", telemetry_dir=""):
+            assert timings.timings_path() is None
+            assert timings.record_rows("bn_act", ((8, 8),), "f32",
+                                       "cpu", [{"source": "measured",
+                                                "config": {},
+                                                "features": {},
+                                                "score_us": 1.0}]) == 0
+
+    def test_load_skips_torn_lines(self, tmp_path):
+        from mxnet_tpu.tune import timings
+        path = tmp_path / "torn.jsonl"
+        good = _synthetic_rows(1, 2)
+        path.write_text(json.dumps(good[0]) + "\n"
+                        + "{\"torn\": tru\n"
+                        + json.dumps(good[1]) + "\n"
+                        + json.dumps({"op": "x"}) + "\n")
+        rows, skipped = timings.load(str(path))
+        assert len(rows) == 2 and skipped == 2
+
+    def test_recalibrate_improves_ranking_agreement(self):
+        from mxnet_tpu.tune import timings
+        rows = _synthetic_rows()
+        fitted, report = timings.recalibrate(rows)
+        assert report["rows"] == len(rows) and report["tasks"] == 3
+        assert report["after"]["pairwise"] >= report["before"]["pairwise"]
+        assert report["after"]["pairwise"] == pytest.approx(1.0)
+        assert report["after"]["top1"] == 1.0
+        # the fit recovered the ground-truth misalign >> waste ordering
+        assert fitted.weights["misalign"] > fitted.weights["waste"]
+        with pytest.raises(ValueError):
+            timings.recalibrate([])
+
+    def test_saved_weights_round_trip_into_default_model(self, tmp_path):
+        from mxnet_tpu.tune import cost_model as cm, timings
+        fitted, _ = timings.recalibrate(_synthetic_rows())
+        path = str(tmp_path / "weights.json")
+        assert cm.save_weights(fitted, path) == path
+        with _config.override(kernel_cost_model=path):
+            loaded = cm.default_model()
+            assert loaded.weights == pytest.approx(fitted.weights)
+        with _config.override(kernel_cost_model=""):
+            assert cm.default_model().weights == cm.LinearCostModel.\
+                DEFAULT_WEIGHTS
+
+    def test_autotune_recalibrate_cli(self, tmp_path, capsys):
+        path = str(tmp_path / "kt.jsonl")
+        with open(path, "w") as f:
+            for row in _synthetic_rows():
+                f.write(json.dumps(row) + "\n")
+        model_out = str(tmp_path / "model.json")
+        rc = autotune_cli.main(["--recalibrate", "--timings", path,
+                                "--save-model", model_out])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ranking agreement" in out and "pairwise" in out
+        assert "->" in out            # before -> after rendering
+        doc = json.load(open(model_out))
+        assert doc["version"] == 1 and "weights" in doc
+
+    def test_autotune_recalibrate_no_log_is_rc2(self, tmp_path, capsys):
+        rc = autotune_cli.main(["--recalibrate", "--timings",
+                                str(tmp_path / "missing.jsonl")])
+        assert rc == 2
+        assert "no timing log" in capsys.readouterr().err

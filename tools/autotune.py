@@ -15,6 +15,14 @@ consults at trace time (tools/kernel_tuning.json by default).
     # cache already holds needs --allow-rewrite)
     python tools/autotune.py --shapes-from-bench --chip-free --update-cache
 
+    # close the cost-model loop: fit the chip-free linear model on the
+    # wall times an earlier ON-CHIP tuning run logged (the timing JSONL
+    # mxnet_tpu/tune/timings.py appends), report before/after ranking
+    # agreement, and persist the weights default_model() will pick up
+    python tools/autotune.py --recalibrate \
+        --timings work/kernel_timings.jsonl \
+        --save-model tools/kernel_cost_model.json
+
 Shape syntax mirrors the cache key's middle segment: ``RxS`` for one
 operand, comma-separated for several (take_rows: ``65536x512,1024``).
 Chip-free rankings are deterministic (ties broken by config key), so two
@@ -65,7 +73,59 @@ def bench_step_tasks(batch):
     return [parse_cache_key(k) for k in keys]
 
 
-def main():
+def _pct(x):
+    return "%.1f%%" % (100.0 * x)
+
+
+def recalibrate_main(args):
+    """``--recalibrate``: measured timings -> LinearCostModel.fit ->
+    before/after ranking-fidelity report (ISSUE 7 / ROADMAP item 1)."""
+    from mxnet_tpu.tune import cost_model as _cm
+    from mxnet_tpu.tune import timings as _timings
+
+    path = args.timings or _timings.timings_path()
+    if not path or not os.path.exists(path):
+        print("error: no timing log%s — run the tuner with a chip "
+              "attached first (it appends to MXNET_KERNEL_TIMINGS or "
+              "$MXNET_TELEMETRY_DIR/kernel_timings.jsonl), or pass "
+              "--timings PATH" % (" at %s" % path if path else ""),
+              file=sys.stderr)
+        return 2
+    rows, skipped = _timings.load(path)
+    if skipped:
+        print("(skipped %d malformed timing row(s))" % skipped)
+    if not rows:
+        print("error: %s holds no usable timing rows" % path,
+              file=sys.stderr)
+        return 2
+    fitted, report = _timings.recalibrate(rows)
+    before, after = report["before"], report["after"]
+    print("recalibrated on %d measured row(s), %d task(s), from %s"
+          % (report["rows"], report["tasks"], path))
+    print("ranking agreement vs measured ground truth "
+          "(before -> after fit):")
+    print("  pairwise  %s -> %s" % (_pct(before["pairwise"]),
+                                    _pct(after["pairwise"])))
+    print("  top-1     %s -> %s" % (_pct(before["top1"]),
+                                    _pct(after["top1"])))
+    for key in sorted(after["tasks"]):
+        b, a = before["tasks"][key], after["tasks"][key]
+        print("  %-40s %2d cfgs  pairwise %s -> %s  top1 %s -> %s"
+              % (key, a["n"], _pct(b["pairwise"]), _pct(a["pairwise"]),
+                 "y" if b["top1"] else "n", "y" if a["top1"] else "n"))
+    print("weights:")
+    for k in _cm.FEATURE_NAMES:
+        print("  %-18s %12.6g -> %12.6g"
+              % (k, report["weights_before"][k],
+                 report["weights_after"][k]))
+    if args.save_model:
+        p = _cm.save_weights(fitted, args.save_model)
+        print("wrote recalibrated weights to %s (set "
+              "MXNET_KERNEL_COST_MODEL=%s to rank with them)" % (p, p))
+    return 0
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser(
         description="tune Pallas kernel-tier tile configs")
     ap.add_argument("--op", action="append", default=[],
@@ -94,7 +154,22 @@ def main():
     ap.add_argument("--cache", default=None,
                     help="cache path (default: MXNET_KERNEL_TUNING_CACHE "
                          "or tools/kernel_tuning.json)")
-    args = ap.parse_args()
+    ap.add_argument("--recalibrate", action="store_true",
+                    help="fit the chip-free cost model on the measured "
+                         "kernel-timing log and report before/after "
+                         "ranking agreement (no tuning tasks needed)")
+    ap.add_argument("--timings", default=None,
+                    help="timing JSONL for --recalibrate (default: "
+                         "MXNET_KERNEL_TIMINGS or "
+                         "$MXNET_TELEMETRY_DIR/kernel_timings.jsonl)")
+    ap.add_argument("--save-model", default=None,
+                    help="with --recalibrate: persist the fitted weights "
+                         "to this JSON (consulted via "
+                         "MXNET_KERNEL_COST_MODEL)")
+    args = ap.parse_args(argv)
+
+    if args.recalibrate:
+        return recalibrate_main(args)
 
     from mxnet_tpu.tune import cache as tcache
     from mxnet_tpu.tune import tuner

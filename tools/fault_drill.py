@@ -73,11 +73,13 @@ def main(argv=None):
                   % r.returncode)
             return 1
 
+        telem_dir = os.path.join(work, "telemetry")
         r = _run("kill+resume", kill_dump,
                  ["-n", n, "--max-restarts", "3", "--restart-backoff",
                   "0.2", "--checkpoint-dir", ckpt_dir],
                  {"MXNET_FAULT_INJECT":
-                  "kill@step=%d:rank=0" % args.kill_step}, args.verbose)
+                  "kill@step=%d:rank=0" % args.kill_step,
+                  "MXNET_TELEMETRY_DIR": telem_dir}, args.verbose)
         if r.returncode != 0:
             print("fault_drill: FAIL — kill+resume run exited rc=%d "
                   "(restart did not recover)" % r.returncode)
@@ -90,6 +92,23 @@ def main(argv=None):
             print("fault_drill: FAIL — restarted workers did not resume "
                   "from a checkpoint")
             return 1
+        import glob
+        pm = glob.glob(os.path.join(telem_dir, "postmortem_rank0_*.json"))
+        if not pm:
+            print("fault_drill: FAIL — the killed worker left no "
+                  "flight-recorder postmortem under %s" % telem_dir)
+            return 1
+        with open(pm[0]) as f:
+            post = json.load(f)       # must be valid JSON
+        if not post.get("reason", "").startswith("faultinject:"):
+            print("fault_drill: FAIL — postmortem %s has unexpected "
+                  "reason %r" % (pm[0], post.get("reason")))
+            return 1
+        print("fault_drill: postmortem ok — %s (%d step records, "
+              "%d events)" % (os.path.basename(pm[0]),
+                              len(post.get("steps", [])),
+                              len(post.get("events", []))))
+
         for ln in r.stderr.splitlines():
             if ln.startswith("launch.py: summary "):
                 s = json.loads(ln.split("summary ", 1)[1])

@@ -31,6 +31,28 @@ _HIST_EDGES_MS = [0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
                   5000]
 
 
+def scrape_prometheus(url, timeout_s=10.0):
+    """GET ``/metrics`` with ``Accept: text/plain`` (what a Prometheus
+    scraper sends), run the strict exposition parser over the body, and
+    return a small summary — raises if the endpoint serves anything the
+    parser rejects, so load tests double as conformance checks."""
+    import urllib.request
+    from mxnet_tpu.telemetry import prom
+    req = urllib.request.Request(url.rstrip("/") + "/metrics",
+                                 headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        ctype = r.headers.get("Content-Type", "")
+        text = r.read().decode("utf-8")
+    families = prom.parse_exposition(text)   # ValueError on bad output
+    n_samples = sum(len(f["samples"]) for f in families.values())
+    return {
+        "content_type": ctype,
+        "families": len(families),
+        "samples": n_samples,
+        "names": sorted(families),
+    }
+
+
 def _http_call(url, payload, timeout_s):
     import urllib.error
     import urllib.request
@@ -227,7 +249,13 @@ def main():
     p.add_argument("--buckets", default=None)
     p.add_argument("--platform", default=None, choices=[None, "cpu"])
     p.add_argument("--out", default=None, help="also write JSON here")
+    p.add_argument("--scrape-metrics", action="store_true",
+                   help="after the run, scrape the endpoint's Prometheus "
+                        "/metrics exposition, assert it parses, and "
+                        "embed a summary (HTTP mode only)")
     args = p.parse_args()
+    if args.scrape_metrics and not args.url:
+        p.error("--scrape-metrics needs --url (HTTP mode)")
 
     if args.platform == "cpu":
         import jax
@@ -248,6 +276,10 @@ def main():
                   retries=args.retries)
     if not args.url:
         target.close(drain=True)
+    if args.scrape_metrics:
+        res["prometheus"] = scrape_prometheus(args.url)
+        assert res["prometheus"]["families"] > 0, \
+            "/metrics exposition parsed but held no metric families"
     line = json.dumps(res)
     print(line)
     if args.out:
