@@ -14,6 +14,7 @@ will compile (the property PR 1's convert budget relies on).
 from __future__ import annotations
 
 import collections
+import re as _re
 
 from .. import hlo_stats
 from .diagnostics import Diagnostic
@@ -22,6 +23,7 @@ from .rules_ast import Rule
 __all__ = [
     "HLO_RULES", "convert_budget_pass", "donation_coverage_pass",
     "d2h_transfer_pass", "fusion_bytes_pass", "RecompileFingerprint",
+    "collective_interleave_pass", "collective_overlap_report",
     "metrics_from_text",
 ]
 
@@ -47,6 +49,13 @@ HLO_RULES = {r.id: r for r in [
          "the step materializes intermediates the backend must fuse away "
          "or spill to HBM; fuse epilogues (MXNET_KERNEL_TIER=auto, see "
          "docs/tuning.md) or hunt accidental f32 widening / transposes"),
+    Rule("MXL507", "hlo-collective-interleave", "error",
+         "the DDP step's gradient all-reduces must stay few (one fused "
+         "collective per bucket — more means the GradReducer plan "
+         "regressed to per-param reduces) and schedulable off the "
+         "critical path (a collective whose ancestors include EVERY "
+         "matmul cannot overlap the backward; check bucket order / "
+         "MXNET_DDP_BUCKET_MB, see docs/distributed.md)"),
 ]}
 
 # custom_call targets (and ops) that imply a device<->host transfer or
@@ -159,6 +168,129 @@ def fusion_bytes_pass(text, label, budget_gib, top=4):
                   % (gib, budget_gib, worst))]
 
 
+# ---------------------------------------------------------------- MXL507
+# StableHLO SSA dataflow over collectives. Text-POSITION checks are wrong
+# here (trace order prints the psums after every dot even when the
+# scheduler can interleave them), so we walk the def-use graph: a
+# collective can overlap compute that is neither its ancestor (feeding
+# it) nor its descendant (waiting on it).
+
+_COLLECTIVE_FRAGMENTS = ("all_reduce", "reduce_scatter", "all_gather",
+                         "all_to_all", "collective_permute")
+_COMPUTE_FRAGMENTS = ("dot_general", "convolution", "dot")
+
+_SSA_DEF_RE = _re.compile(
+    r'^\s*(%[A-Za-z0-9_]+)(?::\d+)?\s*=\s*"?([\w.]+)"?')
+_SSA_REF_RE = _re.compile(r"%[A-Za-z0-9_]+")
+
+
+def _parse_funcs(text):
+    """Split module text into per-``func.func`` line groups. SSA names
+    restart in every function (``@main`` and shard_map's private
+    ``@shmap_body`` both have a ``%0``), so dataflow must never cross
+    function boundaries."""
+    funcs, cur = [], None
+    for line in text.splitlines():
+        if "func.func" in line:
+            cur = []
+            funcs.append(cur)
+        elif cur is not None:
+            cur.append(line)
+    return funcs
+
+
+def _func_dataflow(lines):
+    """defs: ssa-id -> (op_name, operand ids). Operands are every %ref
+    after the ``=`` with multi-result ``#k`` suffixes collapsed to the
+    defining id; block args (``%arg0``) stay as leaves."""
+    defs = {}
+    for line in lines:
+        m = _SSA_DEF_RE.match(line)
+        if not m:
+            continue
+        rhs = line[m.end(1):]
+        refs = [r.split("#")[0] for r in _SSA_REF_RE.findall(rhs)]
+        defs[m.group(1)] = (m.group(2), tuple(refs))
+    return defs
+
+
+def _reach(start, adj):
+    seen, work = set(), [start]
+    while work:
+        for nxt in adj.get(work.pop(), ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                work.append(nxt)
+    return seen
+
+
+def collective_overlap_report(text):
+    """Dataflow summary of the module's collectives:
+    ``{"collectives": n, "compute_ops": m, "overlappable": k}`` where a
+    collective counts as overlappable when at least one dot/conv is
+    dataflow-independent of it (neither ancestor nor descendant) — i.e.
+    the latency-hiding scheduler has compute to slide under it."""
+    n_coll = n_comp = n_overlap = 0
+    for lines in _parse_funcs(text):
+        defs = _func_dataflow(lines)
+        fwd = {}
+        for d, (_op, operands) in defs.items():
+            for o in operands:
+                fwd.setdefault(o, []).append(d)
+        back = {d: list(ops) for d, (_op, ops) in defs.items()}
+        colls = [d for d, (op, _) in defs.items()
+                 if any(f in op for f in _COLLECTIVE_FRAGMENTS)]
+        comps = [d for d, (op, _) in defs.items()
+                 if any(op.endswith(f) for f in _COMPUTE_FRAGMENTS)]
+        n_coll += len(colls)
+        n_comp += len(comps)
+        for c in colls:
+            anc = _reach(c, back)
+            desc = _reach(c, fwd)
+            if any(d not in anc and d not in desc for d in comps):
+                n_overlap += 1
+    return {"collectives": n_coll, "compute_ops": n_comp,
+            "overlappable": n_overlap}
+
+
+def collective_interleave_pass(text, label, max_collectives=None,
+                               require_any=True, require_overlap=True):
+    """MXL507: the bucketed-DDP collective discipline over lowered text.
+
+    * ``max_collectives`` — usually the GradReducer's bucket count (plus
+      any per-param tp reduces): more all-reduces than buckets means the
+      fusion plan regressed to per-param collectives.
+    * ``require_any`` — a program labelled as a DDP step with ZERO
+      collectives isn't reducing gradients at all.
+    * ``require_overlap`` — every collective being dataflow-dependent on
+      every dot/conv (and vice versa) leaves the scheduler nothing to
+      hide the comm under. Skipped when the program has no compute ops
+      (pure-comm microbenchmarks).
+    """
+    rep = collective_overlap_report(text)
+    diags = []
+    if require_any and rep["collectives"] == 0:
+        diags.append(_diag(
+            "MXL507", label,
+            "no collective ops in a DDP-labelled program — gradients are "
+            "not being reduced across the dp axis"))
+    if max_collectives is not None and rep["collectives"] > max_collectives:
+        diags.append(_diag(
+            "MXL507", label,
+            "%d collectives exceed the bucket plan's %d — gradient "
+            "bucketing regressed toward per-param all-reduces"
+            % (rep["collectives"], max_collectives)))
+    if require_overlap and rep["collectives"] and rep["compute_ops"] \
+            and rep["overlappable"] == 0:
+        diags.append(_diag(
+            "MXL507", label,
+            "none of the %d collective(s) is dataflow-independent of any "
+            "of the %d compute op(s): every all-reduce sits on the "
+            "critical path and cannot overlap the backward"
+            % (rep["collectives"], rep["compute_ops"])))
+    return diags
+
+
 def _sig(x):
     """Hashable shape/dtype fingerprint of one call argument. Arrays
     collapse to (shape, dtype) — the thing jit keys compilation on —
@@ -247,6 +379,7 @@ def metrics_from_text(text, large_bytes=1 << 20):
         "donated_mib": round(donated / 2**20, 2),
         "large_param_mib": round(total / 2**20, 2),
         "d2h_count": d2h_count(text),
+        "collective_count": collective_overlap_report(text)["collectives"],
         "total_ops": stats["total_ops"],
         "elementwise_gib": round(ew_bytes / 2**30, 3),
         "pallas_kernels": sum(

@@ -301,6 +301,27 @@ register_flag("device_metrics", "MXNET_DEVICE_METRICS", _parse_bool, True,
               "accumulators, transferring to host only at display/epoch "
               "boundaries. Off: per-batch host update (reference "
               "semantics, one device->host sync per batch).")
+register_flag("ddp", "MXNET_DDP", _parse_bool, False,
+              "Route dist_sync gradient exchange through the bucketed, "
+              "backward-overlapped all-reduce path (parallel/ddp.py): "
+              "gradients are partitioned into size-bounded dtype-"
+              "homogeneous buckets and reduced with jax.lax.psum inside "
+              "the traced step on a 'dp' mesh axis, letting XLA overlap "
+              "collectives with remaining backward compute. Off: the "
+              "ps-lite-style kvstore push/pull path (one host-mediated "
+              "collective per tensor). tools/launch.py --ddp exports "
+              "this to every worker.")
+register_flag("ddp_axis", "MXNET_DDP_AXIS", str, "dp",
+              "Mesh axis name the DDP reducer psums over. Only change "
+              "when composing with a custom mesh whose data-parallel "
+              "axis is not called 'dp'.")
+register_flag("ddp_bucket_mb", "MXNET_DDP_BUCKET_MB", float, 0.0,
+              "Gradient bucket size in MiB for the DDP all-reduce path. "
+              "0 (default) = auto: sized from the perfmodel interconnect "
+              "table so one bucket's transfer time amortizes collective "
+              "launch overhead (clamped to [1, 64] MiB). Small values "
+              "force many buckets (finer overlap, more launches); one "
+              "huge bucket disables overlap entirely.")
 register_flag("serve_buckets", "MXNET_SERVE_BUCKETS", str, "1,2,4,8,16,32",
               "Batch-size buckets the online serving runtime "
               "(mxnet_tpu.serve) pads coalesced request batches to, comma "

@@ -77,6 +77,7 @@ class Trainer:
         config = self._kvstore_params
         kvstore = config["kvstore"]
         update_on_kvstore = config["update_on_kvstore"]
+        self._ddp = False
         if kvstore is None:
             self._kvstore = None
             self._update_on_kvstore = False
@@ -92,6 +93,15 @@ class Trainer:
                 # SPMD program; the updater applies them to the replicated
                 # parameters directly.
                 update_on_kvstore = kv.type.startswith("dist")
+                # MXNET_DDP=1: dist_sync gradient exchange becomes one
+                # bucketed collective per dtype-bucket (dist.allreduce_tree)
+                # with the optimizer replicated on every rank; dist_async
+                # keeps the kvstore server path (parallel/ddp.py)
+                if update_on_kvstore and not kv.type.endswith("async"):
+                    from ..parallel import ddp as _ddp
+                    if _ddp.enabled():
+                        update_on_kvstore = False
+                        self._ddp = True
             self._update_on_kvstore = update_on_kvstore
             for i, param in enumerate(self._params):
                 if param.grad_req == "null":
@@ -166,6 +176,16 @@ class Trainer:
 
     def _allreduce_grads(self):
         if self._kvstore is None:
+            return
+        if self._ddp:
+            # bucketed tree reduce: ONE fused collective per dtype-bucket
+            # over the whole grad set, not one push+pull per parameter
+            from ..parallel import dist as _dist
+            grads = [p.grad() for p in self._params
+                     if p.grad_req != "null"]
+            reduced = _dist.allreduce_tree([g._data for g in grads])
+            for g, r in zip(grads, reduced):
+                g._rebind(r)
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":

@@ -27,6 +27,11 @@ class BaseModule:
         self.optimizer_initialized = False
         self._symbol = None
 
+    def _ddp_stats(self, n_steps):
+        """Per-window DDP telemetry payload for publish_window; Module
+        overrides when the bucketed all-reduce path is engaged."""
+        return None
+
     # ------------------------------------------------------------ high level
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
@@ -298,7 +303,8 @@ class BaseModule:
                 steps=n_steps, window_s=now - _telem_t0,
                 examples=examples or None,
                 engine_depth=len(depth_ctl._inflight),
-                global_step=gstep)
+                global_step=gstep,
+                ddp=self._ddp_stats(n_steps))
             _telem_t0 = now
 
         def _snap_state():

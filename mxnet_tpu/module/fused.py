@@ -86,7 +86,8 @@ class FusedStep:
     """
 
     def __init__(self, executor, optimizer, param_names, compute_dtype=None,
-                 data_names=(), keep_f32=()):
+                 data_names=(), keep_f32=(), ddp_mesh=None, ddp_axis=None,
+                 ddp_bucket_bytes=None):
         self._exec = executor
         self._opt = optimizer
         fused = optimizer.fused_ops()
@@ -109,6 +110,25 @@ class FusedStep:
         # attached, the step threads a small (sum, count) carry and
         # updates it in-program — no per-batch host transfer
         self._met_fn = None
+        # Bucketed data-parallel mode (parallel/ddp.py): the step is
+        # shard_map'ped over `ddp_mesh`'s `ddp_axis` (batch args sharded,
+        # everything else replicated) and the gradients pass through a
+        # GradReducer — one fused lax.psum per size-bounded bucket, emitted
+        # in reverse-production order so XLA can overlap the collectives
+        # with the remaining backward compute.
+        self._ddp_mesh = ddp_mesh
+        self._reducer = None
+        if ddp_mesh is not None:
+            from ..parallel import ddp as _ddp
+            self._ddp_axis = ddp_axis or _ddp.flags.ddp_axis
+            # param order is forward/creation order, so the reducer's
+            # reversed walk matches backward production order
+            entries = [(k, tuple(executor.arg_dict[k].shape),
+                        _np.dtype(executor.arg_dict[k].dtype))
+                       for k in self.param_names]
+            self._reducer = _ddp.GradReducer(
+                entries, axis_name=self._ddp_axis,
+                bucket_bytes=ddp_bucket_bytes, axis_size=ddp_mesh.size)
         self._build()
 
     # ------------------------------------------------------------------ build
@@ -126,6 +146,7 @@ class FusedStep:
         dnames = self._data_names
         keepf = self._keep_f32
         met_fn = self._met_fn
+        reducer = self._reducer
 
         def step(params, rest, aux_vals, opt_state, met_state, lr_vec,
                  wd_vec, rescale, t, key):
@@ -156,6 +177,13 @@ class FusedStep:
             # output (bf16 under mixed precision)
             ones = [jnp.ones(o.shape, o.dtype) for o in outs]
             grads = vjp(list(ones))[0]
+            if reducer is not None:
+                # bucketed cross-replica sum BEFORE the optimizer update —
+                # every rank then applies the identical aggregated gradient
+                # (the ps-lite server aggregation, collapsed into the step).
+                # Each psum depends only on its own bucket's grads, so the
+                # scheduler may hoist it over the rest of the backward.
+                grads = reducer.reduce(grads)
             new_params = {}
             new_opt = {}
             for i, k in enumerate(pnames):
@@ -191,8 +219,14 @@ class FusedStep:
         #   reuse device-resident batches across steps.
         # jax.jit compiles lazily, so a fit()-only run pays for exactly one
         # compilation.
-        self._jitted = jax.jit(step)
-        self._jitted_donate = jax.jit(step, donate_argnums=(0, 2, 3, 4))
+        if self._ddp_mesh is not None:
+            sharded = self._ddp_shard(step)
+            self._jitted = jax.jit(sharded)
+            self._jitted_donate = jax.jit(sharded,
+                                          donate_argnums=(0, 2, 3, 4))
+        else:
+            self._jitted = jax.jit(step)
+            self._jitted_donate = jax.jit(step, donate_argnums=(0, 2, 3, 4))
 
         # K steps per dispatch: the classic TPU train-loop-under-scan.
         # One host->device dispatch executes K full steps over K stacked
@@ -218,7 +252,77 @@ class FusedStep:
                 (feeds, keys))
             return outs, p, a, o, m
 
-        self._jitted_k = jax.jit(k_step, donate_argnums=(0, 2, 3, 4))
+        if self._ddp_mesh is not None:
+            # the K-step in_specs depend on which args arrive stacked as
+            # feeds (run_k's split), so the shard_map is built lazily per
+            # feed-name set (stable across a fit run -> one jit cache hit)
+            self._k_fn = k_step
+            self._k_cache = {}
+            self._jitted_k = None
+        else:
+            self._jitted_k = jax.jit(k_step, donate_argnums=(0, 2, 3, 4))
+
+    # -------------------------------------------------------------------- ddp
+    def _ddp_spec(self, name):
+        """Input spec for one executor arg: batch args shard over the dp
+        axis, everything else is replicated."""
+        from jax.sharding import PartitionSpec as P
+        return (P(self._ddp_axis) if name in self._exec._batch_args
+                else P())
+
+    def _ddp_shard(self, step):
+        """shard_map the per-step fn over the dp mesh: params/aux/opt/
+        hypers replicated, batch args sharded, outputs batch-sharded.
+        check_rep=False because the replication of the updated params is
+        established by construction (identical update from the psum'd
+        gradient on every rank), which the checker cannot prove."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        pset = set(self.param_names)
+        rest_spec = {k: self._ddp_spec(k) for k in self._exec.arg_dict
+                     if k not in pset}
+        in_specs = (P(), rest_spec, P(), P(), P(), P(), P(), P(), P(), P())
+        out_specs = (P(self._ddp_axis), P(), P(), P(), P())
+        return shard_map(step, mesh=self._ddp_mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+    def _ddp_jitted_k(self, feed_names):
+        """The K-step variant of :meth:`_ddp_shard`, cached per feed-name
+        set; feeds are stacked (K, batch, ...) so their batch axis is
+        dim 1 (spec ``P(None, dp)``)."""
+        key = frozenset(feed_names)
+        fn = self._k_cache.get(key)
+        if fn is None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            ax = self._ddp_axis
+            pset = set(self.param_names)
+            static_spec = {k: self._ddp_spec(k) for k in self._exec.arg_dict
+                           if k not in pset and k not in key}
+            feed_spec = {k: (P(None, ax) if k in self._exec._batch_args
+                             else P()) for k in key}
+            in_specs = (P(), static_spec, P(), P(), P(), feed_spec,
+                        P(), P(), P(), P(), P())
+            out_specs = (P(None, ax), P(), P(), P(), P())
+            fn = jax.jit(
+                shard_map(self._k_fn, mesh=self._ddp_mesh,
+                          in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False),
+                donate_argnums=(0, 2, 3, 4))
+            self._k_cache[key] = fn
+        return fn
+
+    def _ddp_globalize(self, tree, spec):
+        """Promote every leaf of ``tree`` to a global array on the dp mesh
+        (no-op for leaves already there — params/opt state after step 1)."""
+        from ..parallel import ddp as _ddp
+        return jax.tree_util.tree_map(
+            lambda v: _ddp.to_global(v, self._ddp_mesh, spec), tree)
+
+    def ddp_stats(self):
+        """Host-held bucket/comm summary (telemetry source), or None when
+        the step is not in DDP mode."""
+        return self._reducer.stats() if self._reducer is not None else None
 
     # ----------------------------------------------------------------- metric
     def attach_metric(self, met_fn):
@@ -228,6 +332,13 @@ class FusedStep:
         dispatch costs nothing extra."""
         if self._met_fn is met_fn:
             return
+        if self._ddp_mesh is not None:
+            # the metric carry is replicated (out spec P()) but would
+            # accumulate per-rank LOCAL batches under check_rep=False —
+            # silently wrong. Module keeps the host metric path in DDP
+            # mode; fail loudly if something routes around that guard.
+            raise ValueError("device metrics cannot fold into a DDP step; "
+                             "keep the host metric path (MXNET_DDP)")
         self._met_fn = met_fn
         self._build()
 
@@ -320,9 +431,31 @@ class FusedStep:
         lr_vec, wd_vec, rescale, t = self.hyper_peek()
         params, rest = self.split_args(arg_vals)
         fn = self._jitted_donate if donate else self._jitted
+        if self._ddp_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from ..parallel import ddp as _ddp
+            mesh = self._ddp_mesh
+            # every array input must be a global array on the dp mesh
+            # (mixing process-local and global arrays in one multi-host
+            # jit is an error); hypers stay host numpy == replicated
+            params = self._ddp_globalize(params, P())
+            aux_vals = self._ddp_globalize(aux_vals, P())
+            opt_state = self._ddp_globalize(opt_state, P())
+            rest = {k: _ddp.to_global(v, mesh, self._ddp_spec(k))
+                    for k, v in rest.items()}
+            key = _ddp.to_global(key, mesh, P())
+        else:
+            lr_vec, wd_vec = jnp.asarray(lr_vec), jnp.asarray(wd_vec)
         outs, new_params, new_aux, new_opt, new_met = fn(
             params, rest, aux_vals, opt_state, met_state,
-            jnp.asarray(lr_vec), jnp.asarray(wd_vec), rescale, t, key)
+            lr_vec, wd_vec, rescale, t, key)
+        if self._ddp_mesh is not None:
+            # outputs are global batch-sharded; hand the commit/metric
+            # path this rank's local view (reference per-worker semantics)
+            outs = jax.tree_util.tree_map(
+                lambda o: _ddp.from_global(o, self._ddp_mesh,
+                                           P(self._ddp_axis)),
+                outs)
         new_args = dict(rest)
         new_args.update(new_params)
         return outs, new_args, new_aux, new_opt, new_met
@@ -363,6 +496,27 @@ class FusedStep:
                 spec = P(None, "dp") if name in ex._batch_args else P()
                 arr = jax.device_put(arr, NamedSharding(ex._mesh, spec))
             stacked[name] = arr
+        if self._ddp_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from ..parallel import ddp as _ddp
+            mesh, ax = self._ddp_mesh, self._ddp_axis
+            params = self._ddp_globalize(params, P())
+            aux_vals = self._ddp_globalize(aux_vals, P())
+            opt_state = self._ddp_globalize(opt_state, P())
+            static_rest = {k: _ddp.to_global(v, mesh, self._ddp_spec(k))
+                           for k, v in static_rest.items()}
+            stacked = {k: _ddp.to_global(
+                           v, mesh,
+                           P(None, ax) if k in ex._batch_args else P())
+                       for k, v in stacked.items()}
+            kk = _ddp.to_global(jnp.stack(list(keys)), mesh, P())
+            outs, new_params, new_aux, new_opt, new_met = \
+                self._ddp_jitted_k(stacked)(
+                    params, static_rest, aux_vals, opt_state, met_state,
+                    stacked, lr_vec, wd_vec, rescale, t, kk)
+            outs = jax.tree_util.tree_map(
+                lambda o: _ddp.from_global(o, mesh, P(None, ax)), outs)
+            return outs, new_params, new_aux, new_opt, new_met
         outs, new_params, new_aux, new_opt, new_met = self._jitted_k(
             params, static_rest, aux_vals, opt_state, met_state, stacked,
             jnp.asarray(lr_vec), jnp.asarray(wd_vec), rescale, t,

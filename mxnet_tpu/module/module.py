@@ -68,6 +68,7 @@ class Module(BaseModule):
         self._fused_opt_state = None
         self._fused_pending = None
         self._fused_ran = False
+        self._ddp = False
         self._monitor_installed = False
         # device-resident metrics (device_metric.py): the (sum, count)
         # carry rides the fused step; host sees it only on publish
@@ -229,6 +230,24 @@ class Module(BaseModule):
             # over the executor's mesh), so the update applies directly to
             # the executor's replicated weights via the updater path.
             update_on_kvstore = kv.type.startswith("dist")
+            # MXNET_DDP=1 (tools/launch.py --ddp): the dist_sync gradient
+            # exchange moves INSIDE the compiled step — bucketed lax.psum
+            # over the dp mesh (parallel/ddp.py), optimizer replicated on
+            # every rank. dist_async keeps the kvstore server path.
+            if update_on_kvstore and not kv.type.endswith("async"):
+                from ..parallel import ddp as _ddp
+                if _ddp.enabled():
+                    mesh = _ddp.process_mesh()
+                    batch = (self._data_shapes[0][1][0]
+                             if self._data_shapes else 0)
+                    if mesh.size > 1 and batch % mesh.size == 0:
+                        update_on_kvstore = False
+                        self._ddp = True
+                    elif mesh.size > 1:
+                        self.logger.warning(
+                            "MXNET_DDP: batch %d not divisible by dp "
+                            "mesh size %d; falling back to the kvstore "
+                            "path", batch, mesh.size)
         self._kvstore = kv
         self._update_on_kvstore = update_on_kvstore
 
@@ -272,7 +291,7 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             return  # optimizer runs on the (dist) kvstore server
         on_tpu = all(c.device_type == "tpu" for c in self._context)
-        if not (kv_type == "tpu_sync"
+        if not (kv_type == "tpu_sync" or self._ddp
                 or (on_tpu and kv_type in (None, "local", "device"))):
             return
         # 'add' grad accumulation needs the eager grad buffers
@@ -297,11 +316,16 @@ class Module(BaseModule):
             default_cdt = _jnp.bfloat16
         from .. import config as _config
         compute_dtype = _config.compute_dtype(default=default_cdt)
+        ddp_mesh = None
+        if self._ddp:
+            from ..parallel import ddp as _ddp
+            ddp_mesh = _ddp.process_mesh()
         self._fused = FusedStep(self._exec, self._optimizer,
                                 self._param_names,
                                 compute_dtype=compute_dtype,
                                 data_names=self._data_names,
-                                keep_f32=self._norm_stat_params())
+                                keep_f32=self._norm_stat_params(),
+                                ddp_mesh=ddp_mesh)
         self._fused_opt_state = self._fused.init_state()
 
     def _fused_step_flops(self):
@@ -321,6 +345,20 @@ class Module(BaseModule):
         except Exception:
             pass
         return None
+
+    def _ddp_stats(self, n_steps):
+        """Host-held DDP bucket/comm summary scaled to a telemetry window
+        of ``n_steps`` (base_module._telem_window). Pure bookkeeping from
+        the reducer's static plan — ZERO device syncs, so the ≤1
+        d2h-per-window budget is untouched. None when DDP is off."""
+        if not self._ddp or self._fused is None:
+            return None
+        s = self._fused.ddp_stats()
+        if s is None:
+            return None
+        return {"buckets": s["buckets"],
+                "comm_bytes": s["comm_bytes"] * max(int(n_steps), 0),
+                "overlap_ms": s["overlap_ms"]}
 
     def _norm_stat_params(self):
         """Names of params that must stay f32 under a low-precision compute
@@ -506,6 +544,13 @@ class Module(BaseModule):
         if self._fused is None or not _flags.device_metrics:
             self._detach_device_metric()
             return None
+        if self._ddp:
+            # under check_rep=False a replicated metric carry would
+            # silently accumulate only each rank's LOCAL batches — keep
+            # the host metric path (per-worker metric, reference
+            # dist_sync semantics)
+            self._detach_device_metric()
+            return None
         if eval_metric is None \
                 or getattr(eval_metric, "_device_resident", False):
             self._detach_device_metric()
@@ -628,6 +673,18 @@ class Module(BaseModule):
                 self._kvstore.pull(name, self._exec.arg_dict[name],
                                    ignore_sparse=False)
         else:
+            if self._ddp:
+                # eager DDP fallback (optimizer without a fused form):
+                # the backward is already done so there is nothing left
+                # to overlap with, but the exchange is still ONE bucketed
+                # collective per dtype-bucket instead of one per tensor
+                from ..parallel import dist as _dist
+                names = [n for n in self._param_names
+                         if self._exec.grad_dict.get(n) is not None]
+                reduced = _dist.allreduce_tree(
+                    [self._exec.grad_dict[n]._data for n in names])
+                for n, g in zip(names, reduced):
+                    self._exec.grad_dict[n]._rebind(g)
             for i, name in enumerate(self._param_names):
                 grad = self._exec.grad_dict.get(name)
                 if grad is None:

@@ -189,10 +189,20 @@ class Server:
         self._push_log = []       # (monotonic_ts, key) — test observability
         self._commands = []
         self._hmac_key = _secret()
+        # shutdown drain: handlers poll this between requests, so stopping
+        # lets every in-flight push/pull FINISH (and its reply flush)
+        # instead of a daemon thread dying mid-_apply with half-updated
+        # weights and a worker wedged on a reply that never comes
+        self._stop = threading.Event()
+        self._active = 0          # connections currently inside handle()
+        self._closed = False
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                import select
+                with outer._glock:
+                    outer._active += 1
                 try:
                     # per-connection anti-replay channel: issue a fresh
                     # random challenge in a hello frame (MAC'd with the
@@ -205,7 +215,15 @@ class Server:
                                  "challenge": challenge.hex()},
                                 key=outer._hmac_key)
                     chan = _Channel(challenge)
-                    while True:
+                    while not outer._stop.is_set():
+                        # wait for readability OUTSIDE _recv_frame: a plain
+                        # socket timeout could fire mid-frame and desync
+                        # the stream; this poll only gates the idle gap
+                        # between requests
+                        ready, _, _ = select.select([self.request], [], [],
+                                                    0.5)
+                        if not ready:
+                            continue
                         header, blob = _recv_frame(self.request,
                                                    key=outer._hmac_key,
                                                    chan=chan)
@@ -233,6 +251,9 @@ class Server:
                             return
                 except (ConnectionError, OSError, ValueError):
                     return  # incl. failed authentication: drop the peer
+                finally:
+                    with outer._glock:
+                        outer._active -= 1
 
         class TS(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -295,10 +316,32 @@ class Server:
                               "commands": [list(c) for c in
                                            self._commands]}}, b""
         if op == "shutdown":
-            threading.Thread(target=self._srv.shutdown,
-                             daemon=True).start()
+            # the requesting handler still has its "ok" reply to flush, so
+            # the full drain runs on a side thread; close() waits for the
+            # active-handler census (this connection included) to hit zero
+            threading.Thread(target=self.close, daemon=True).start()
             return {"status": "ok"}, b""
         return {"status": "err", "error": "unknown op %r" % (op,)}, b""
+
+    def close(self, drain_s=5.0):
+        """Stop accepting work and shut the listener down after a BOUNDED
+        drain: handlers finish (at most) their current request — replies
+        flushed, no weight left half-applied — then exit at the next
+        stop-event poll. Idempotent; safe from any thread."""
+        with self._glock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        deadline = _time.monotonic() + max(drain_s, 0.0)
+        while _time.monotonic() < deadline:
+            with self._glock:
+                if self._active == 0:
+                    break
+            _time.sleep(0.05)
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=max(drain_s, 1.0))
 
     def _handle_push(self, key, grad, time):
         with self._key_lock(key):
@@ -345,9 +388,15 @@ class Client:
         self._tls = threading.local()
         self._conns = []          # weakrefs: threads own their sockets
         self._conns_lock = threading.Lock()
+        self._closed = threading.Event()
         self._connect()  # fail fast on a bad address
 
     def _connect(self):
+        if self._closed.is_set():
+            # a racing call() in another thread must not resurrect a
+            # connection after close() — it would hang on a server that
+            # is itself draining
+            raise ConnectionError("async kvstore client is closed")
         sock = getattr(self._tls, "sock", None)
         if sock is None:
             sock = socket.create_connection(self._addr,
@@ -427,7 +476,7 @@ class Client:
                 self._tls.sock = None
                 self._tls.chan = None
                 _close_quietly(sock)
-                if attempt < retries:
+                if attempt < retries and not self._closed.is_set():
                     attempt += 1
                     _time.sleep(min(2.0, backoff * (2 ** (attempt - 1))))
                     continue
@@ -460,6 +509,7 @@ class Client:
         return None
 
     def close(self):
+        self._closed.set()   # before the socket sweep: no reconnect race
         with self._conns_lock:
             refs, self._conns = self._conns, []
         for ref in refs:

@@ -38,7 +38,8 @@ import os
 import numpy as _np
 
 __all__ = ["init", "initialized", "rank", "num_workers", "barrier",
-           "allreduce_sum", "allgather", "broadcast", "env_spec"]
+           "barrier_stats", "allreduce_sum", "allreduce_tree", "allgather",
+           "broadcast", "env_spec"]
 
 _INITIALIZED = False
 
@@ -150,13 +151,34 @@ def num_workers():
     return jax.process_count()
 
 
+# sync_global_devices builds (and caches) one tiny collective computation
+# PER DISTINCT TAG STRING — callers minting per-step tags ("epoch3_batch42")
+# grow the compile cache without bound. Tags are therefore folded onto a
+# fixed slot pool with crc32 (deterministic across processes, unlike
+# hash() under PYTHONHASHSEED); correctness only needs every rank to reach
+# the same call site with the same tag, which maps to the same slot.
+_BARRIER_SLOTS = 8
+_BARRIER_TAGS = {}
+
+
 def barrier(tag="mxnet_tpu_barrier"):
     """Block until every process reaches the same point (reference
-    kvstore_dist.h Barrier RPC)."""
+    kvstore_dist.h Barrier RPC). Tags are batched onto a fixed slot pool
+    — see ``barrier_stats()`` for the per-tag call census."""
     if not initialized():
         return
+    import zlib
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(tag)
+    _BARRIER_TAGS[tag] = _BARRIER_TAGS.get(tag, 0) + 1
+    slot = zlib.crc32(tag.encode("utf-8")) % _BARRIER_SLOTS
+    multihost_utils.sync_global_devices("mxnet_tpu_barrier_slot%d" % slot)
+
+
+def barrier_stats():
+    """{tag: call count} for this process — observability for the slot
+    pool (which tag families are hot; all of them share _BARRIER_SLOTS
+    compiled computations instead of one each)."""
+    return dict(_BARRIER_TAGS)
 
 
 def allreduce_sum(value):
@@ -187,6 +209,45 @@ def allreduce_sum(value):
         from jax.experimental import multihost_utils
         gathered = multihost_utils.process_allgather(value)
         return jnp.asarray(gathered.sum(axis=0, dtype=gathered.dtype))
+
+
+def allreduce_tree(tree, bucket_bytes=None):
+    """Sum every leaf of a pytree over all processes with bucketed,
+    dtype-coalesced collectives.
+
+    The per-tensor ``allreduce_sum`` loop pays one host round-trip and one
+    collective launch PER LEAF — launch overhead dominates on the many
+    small params of a real net. This path flattens the leaves into
+    size-bounded dtype-homogeneous buckets (``ddp.partition_buckets``, the
+    same sizer the traced path uses) and issues ONE fused collective per
+    bucket. It is the eager/non-traced fallback: the gradients are already
+    materialized, so there is no backward left to overlap with — the win
+    here is purely launch-count and per-call host overhead.
+    """
+    if not initialized():
+        return tree
+    import jax
+    import jax.numpy as jnp
+    from . import ddp as _ddp
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    arrs = [jnp.asarray(leaf) for leaf in leaves]
+    entries = [(i, a.shape, a.dtype) for i, a in enumerate(arrs)]
+    buckets = _ddp.partition_buckets(entries, bucket_bytes, reverse=False)
+    out = [None] * len(arrs)
+    for b in buckets:
+        if len(b.keys) == 1:
+            i = b.keys[0]
+            out[i] = allreduce_sum(arrs[i])
+            continue
+        flat = jnp.concatenate([jnp.ravel(arrs[i]) for i in b.keys])
+        red = jnp.asarray(allreduce_sum(flat))
+        off = 0
+        for i, shape, size in zip(b.keys, b.shapes, b.sizes):
+            out[i] = red[off:off + size].reshape(shape)
+            off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 _REDUCER = None

@@ -85,7 +85,8 @@ class SPMDTrainStep:
     def __init__(self, symbol, mesh, data_names=("data",),
                  label_names=("softmax_label",), dp_axis="dp", tp_axis=None,
                  lr=0.05, momentum=0.9, wd=0.0, rescale_grad=None,
-                 tp_rule=None, dtype=None):
+                 tp_rule=None, dtype=None, ddp_bucketed=False,
+                 bucket_bytes=None):
         self.symbol = symbol
         self.mesh = mesh
         self.dp_axis = dp_axis
@@ -115,6 +116,14 @@ class SPMDTrainStep:
 
         def step(params, aux, opt_state, data, label, key):
             n_batch = data[dn[0]].shape[0]
+            if self._reducer is not None:
+                # manual-dp body: shapes are PER-SHARD — the mean must
+                # still be over the global batch, and the psum'd gradient
+                # is the global sum, so scale by local * dp_size (static)
+                n_batch = n_batch * self._ddp_size
+                # decorrelate per-shard dropout/noise deterministically
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(self.dp_axis))
             scale = (1.0 / n_batch) if rescale_grad is None else rescale_grad
 
             def loss_fn(p):
@@ -135,6 +144,18 @@ class SPMDTrainStep:
             from ..executor import mirror_wrap
             grads, (outs, auxu) = jax.grad(mirror_wrap(loss_fn),
                                            has_aux=True)(params)
+            if self._reducer is not None:
+                # bucketed manual psum over dp (parallel/ddp.py): one
+                # fused collective per bucket, in reverse-production
+                # order, interleavable with the remaining backward.
+                # tp-sharded params (GSPMD's auto axis) are reduced
+                # per-param so their flat buffers never force a layout
+                # change of the tp sharding.
+                red = self._reducer.reduce(
+                    {k: grads[k] for k in self._reducer_keys})
+                for k in self._ddp_tp_names:
+                    red[k] = jax.lax.psum(grads[k], self.dp_axis)
+                grads = red
             new_params = {}
             new_opt = {}
             for k, w in params.items():
@@ -150,6 +171,15 @@ class SPMDTrainStep:
         self._step = step
         self._jitted = None
         self._depth_ctl = None
+        # bucketed-DDP mode: the dp gradient reduction becomes explicit
+        # (shard_map + GradReducer) instead of GSPMD-inferred; built in
+        # compile() where the param shapes are known
+        self._ddp_bucketed = bool(ddp_bucketed)
+        self._bucket_bytes = bucket_bytes
+        self._reducer = None
+        self._reducer_keys = frozenset()
+        self._ddp_tp_names = ()
+        self._ddp_size = int(mesh.shape[dp_axis]) if ddp_bucketed else 1
 
     def _shard_params(self, shapes):
         out = {}
@@ -160,6 +190,33 @@ class SPMDTrainStep:
             out[name] = NamedSharding(self.mesh, spec if spec is not None else P())
         return out
 
+    def _build_reducer(self, param_shapes):
+        """Split params into the bucketed-replicated set and the
+        tp-sharded set (reduced per-param), then build the GradReducer
+        over the replicated ones in forward order (it re-walks them in
+        reverse-production order itself)."""
+        from . import ddp as _ddp
+        rep, tp_names = [], []
+        for n in self.param_names:
+            if n not in param_shapes:
+                continue
+            spec = self.tp_rule(n, param_shapes[n]) \
+                if self.tp_axis is not None else None
+            if spec is not None and tuple(spec) and \
+                    any(ax is not None for ax in tuple(spec)):
+                tp_names.append(n)
+            else:
+                rep.append((n, tuple(param_shapes[n]), _np.dtype(_np.float32)))
+        self._reducer = _ddp.GradReducer(
+            rep, axis_name=self.dp_axis, bucket_bytes=self._bucket_bytes,
+            axis_size=self._ddp_size)
+        self._reducer_keys = frozenset(e[0] for e in rep)
+        self._ddp_tp_names = tuple(tp_names)
+
+    def ddp_stats(self):
+        """Host-held bucket plan summary (None unless ddp_bucketed)."""
+        return self._reducer.stats() if self._reducer is not None else None
+
     def compile(self, param_shapes, aux_shapes, data_shapes, label_shapes):
         p_sh = self._shard_params(param_shapes)
         a_sh = {k: NamedSharding(self.mesh, P()) for k in aux_shapes}
@@ -168,8 +225,26 @@ class SPMDTrainStep:
         l_sh = {k: NamedSharding(self.mesh, P(self.dp_axis))
                 for k in label_shapes}
         key_sh = NamedSharding(self.mesh, P())
+        fn = self._step
+        if self._ddp_bucketed:
+            # explicit-collective mode: dp becomes a MANUAL mesh axis
+            # (shard_map) so the bucketed psums in step() are real; any
+            # other axes (tp) stay auto — GSPMD still places those.
+            from jax.experimental.shard_map import shard_map
+            self._build_reducer(param_shapes)
+            auto = frozenset(a for a in self.mesh.axis_names
+                             if a != self.dp_axis)
+            d_spec = {k: P(self.dp_axis) for k in data_shapes}
+            l_spec = {k: P(self.dp_axis) for k in label_shapes}
+            p_spec = {k: P() for k in param_shapes}
+            a_spec = {k: P() for k in aux_shapes}
+            fn = shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(p_spec, a_spec, p_spec, d_spec, l_spec, P()),
+                out_specs=(p_spec, a_spec, p_spec, P(self.dp_axis)),
+                check_rep=False, auto=auto)
         self._jitted = jax.jit(
-            self._step,
+            fn,
             in_shardings=(p_sh, a_sh, p_sh, d_sh, l_sh, key_sh),
             out_shardings=(p_sh, a_sh, p_sh, None),
             donate_argnums=(0, 1, 2))

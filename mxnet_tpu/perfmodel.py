@@ -8,8 +8,9 @@ so the mxlint CLI / analysis layer can use it without touching a backend.
 """
 from __future__ import annotations
 
-__all__ = ["PEAK_FLOPS", "HBM_GBPS", "peak_flops", "hbm_bytes_per_s",
-           "mfu", "RESNET50_TRAIN_FLOPS_PER_IMG", "DEFAULT_DEVICE_KIND"]
+__all__ = ["PEAK_FLOPS", "HBM_GBPS", "ICI_GBPS", "peak_flops",
+           "hbm_bytes_per_s", "interconnect_bytes_per_s", "mfu",
+           "RESNET50_TRAIN_FLOPS_PER_IMG", "DEFAULT_DEVICE_KIND"]
 
 # fwd+bwd ~= 3x fwd MACs * 2 flops/MAC (ResNet-50 @ 224: 4.089 GMACs fwd)
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9
@@ -31,6 +32,15 @@ HBM_GBPS = [
 ]
 
 
+# Per-chip interconnect (ICI) bandwidth (bytes/s), same substring scheme.
+# One link direction's worth — the number the DDP bucket sizer uses to
+# amortize per-collective launch latency against transfer time.
+ICI_GBPS = [
+    ("v6", 3584e9 / 8), ("v5p", 4800e9 / 8), ("v5", 1600e9 / 8),
+    ("v4", 2400e9 / 8), ("v3", 656e9 / 8), ("v2", 496e9 / 8),
+]
+
+
 def _lookup(table, device_kind, default):
     kind = (device_kind or "").lower()
     for sub, val in table:
@@ -47,6 +57,11 @@ def peak_flops(device_kind: str) -> float:
 def hbm_bytes_per_s(device_kind: str) -> float:
     """HBM bandwidth in bytes/s for a device kind; assumes v5e if unknown."""
     return _lookup(HBM_GBPS, device_kind, 819e9)
+
+
+def interconnect_bytes_per_s(device_kind: str) -> float:
+    """ICI bandwidth in bytes/s for a device kind; assumes v5e if unknown."""
+    return _lookup(ICI_GBPS, device_kind, 1600e9 / 8)
 
 
 def mfu(flops_per_step: float, step_seconds: float,

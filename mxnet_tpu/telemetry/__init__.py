@@ -73,7 +73,7 @@ def _live_mfu(steps, window_s):
 
 
 def publish_window(*, steps, window_s, examples=None, engine_depth=None,
-                   global_step=None, source="train"):
+                   global_step=None, source="train", ddp=None):
     """Publish one K-step window's worth of training telemetry.
 
     Everything passed in (and everything read here) is already host
@@ -82,6 +82,11 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
     device array, so the PR-3 sync budget is untouched. Returns the
     step record (also pushed into the flight recorder and, when
     enabled, the JSONL stream).
+
+    ``ddp`` (optional) is the Module's host-held bucketed-all-reduce
+    summary for the window — ``{"buckets", "comm_bytes", "overlap_ms"}``
+    from the GradReducer's STATIC plan (parallel/ddp.py), never a device
+    read.
     """
     from mxnet_tpu import profiler
 
@@ -110,6 +115,17 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
         gauge("train/mfu",
               "live model-flops utilization vs device peak").set(mfu)
 
+    if ddp:
+        counter("ddp/comm_bytes",
+                "gradient bytes exchanged by the bucketed all-reduce").inc(
+                    ddp.get("comm_bytes", 0))
+        gauge("ddp/buckets",
+              "gradient buckets per step (fused collectives)").set(
+                  ddp.get("buckets", 0))
+        gauge("ddp/overlap_ms",
+              "model-estimated collective ms hidden under backward").set(
+                  ddp.get("overlap_ms", 0.0))
+
     sync = profiler.sync_counters()
     for key in ("d2h", "wait", "depth_wait", "d2h_bytes", "total"):
         if key in sync:
@@ -120,6 +136,8 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
               "steps": steps, "window_s": window_s, "step_ms": step_ms,
               "examples": examples, "engine_depth": engine_depth,
               "mfu": mfu, "sync": dict(sync)}
+    if ddp:
+        record["ddp"] = dict(ddp)
 
     jsonl = _ensure_exporters()
     rec = flight_recorder()

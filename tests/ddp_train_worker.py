@@ -1,0 +1,105 @@
+"""N-process bucketed-DDP Module.fit worker (launched by
+``tools/launch.py --ddp``). Trains the shared little net TWICE — once
+with sub-KiB buckets (several fused all-reduces) and once with one huge
+bucket — and asserts the two runs are BITWISE identical: bucketing is a
+scheduling choice, never a numerics choice. Also asserts:
+
+* every rank holds identical params after each run (broadcast compare);
+* the optimizer (momentum) state files are byte-identical across bucket
+  sizes — the whole update chain matches, not just the weights;
+* the DDP path really engaged (``mod._ddp``) and the bucket counts
+  differ the way the override says they must.
+
+Rank 0 dumps the tiny-bucket run's params for the driver to compare
+against the kvstore dist_sync path.
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import config  # noqa: E402
+from mxnet_tpu.parallel import dist  # noqa: E402
+from tests.dist_train_common import (  # noqa: E402
+    make_net, full_data, fixed_params, PER_WORKER_BATCH,
+    N_SAMPLES_PER_WORKER, EPOCHS)
+
+
+def train_once(kv, bucket_mb, states_path):
+    # identical RNG chain for every run: bucketing must not touch it
+    mx.random.seed(7)
+    rank, n = kv.rank, kv.num_workers
+    X, Y = full_data(n)
+    lo, hi = rank * N_SAMPLES_PER_WORKER, (rank + 1) * N_SAMPLES_PER_WORKER
+    it = mx.io.NDArrayIter(X[lo:hi], Y[lo:hi],
+                           batch_size=PER_WORKER_BATCH,
+                           label_name="softmax_label")
+    sym = make_net()
+    mod = mx.mod.Module(sym)
+    with config.override(ddp_bucket_mb=bucket_mb):
+        mod.fit(it, num_epoch=EPOCHS, kvstore=kv, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "rescale_grad":
+                                      1.0 / (PER_WORKER_BATCH * n)},
+                arg_params=fixed_params(sym), initializer=None)
+    assert mod._ddp, "bucketed DDP did not engage (MXNET_DDP unset?)"
+    mod.save_optimizer_states(states_path)
+    stats = mod._ddp_stats(1)
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}, stats
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    tmp = tempfile.mkdtemp(prefix="ddp_states_")
+    tiny_states = os.path.join(tmp, "tiny.states")
+    huge_states = os.path.join(tmp, "huge.states")
+
+    # ~300 bytes per bucket: the little net's grads split across several
+    # fused all-reduces (fc1_weight alone overflows one bucket)
+    tiny, tiny_stats = train_once(kv, 0.0003, tiny_states)
+    huge, huge_stats = train_once(kv, 64.0, huge_states)
+
+    assert tiny_stats and tiny_stats["buckets"] >= 2, tiny_stats
+    assert huge_stats and huge_stats["buckets"] == 1, huge_stats
+    assert tiny_stats["comm_bytes"] > 0
+
+    # bucketing is numerics-neutral: BITWISE equal params + momentum
+    for name in sorted(tiny):
+        np.testing.assert_array_equal(
+            tiny[name], huge[name],
+            err_msg="rank %d: bucket size changed the math on %s"
+                    % (rank, name))
+    with open(tiny_states, "rb") as f:
+        tb = f.read()
+    with open(huge_states, "rb") as f:
+        hb = f.read()
+    assert tb == hb, \
+        "rank %d: optimizer state diverged across bucket sizes" % rank
+
+    # every rank holds identical params (replication by construction)
+    for name in sorted(tiny):
+        theirs = np.asarray(dist.broadcast(tiny[name], root=0))
+        np.testing.assert_array_equal(
+            tiny[name], theirs,
+            err_msg="rank %d diverged from rank 0 on %s" % (rank, name))
+
+    if rank == 0 and os.environ.get("DDP_TRAIN_DUMP"):
+        np.savez(os.environ["DDP_TRAIN_DUMP"], **tiny)
+    print("rank %d/%d: ddp bucketed training bitwise-stable "
+          "(buckets %d vs %d)" % (rank, n, tiny_stats["buckets"],
+                                  huge_stats["buckets"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

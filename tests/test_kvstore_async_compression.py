@@ -182,6 +182,64 @@ def test_async_client_threads_use_independent_sockets():
         cli.close()
 
 
+def test_async_server_shutdown_drains_inflight_push():
+    """Server.close() is a bounded DRAIN, not a kill: a push already being
+    applied when shutdown lands must finish and get its "ok" reply (no
+    half-applied weights, no worker wedged on a lost reply)."""
+    import threading
+
+    srv = Server()
+    cli = Client("127.0.0.1", srv.port)
+    cli.call("init", "w", np.zeros((4,), "f4"))
+    real_dispatch = srv._dispatch
+    entered = threading.Event()
+
+    def slow_dispatch(header, blob):
+        if header.get("op") == "push":
+            entered.set()
+            time.sleep(0.4)     # push caught mid-apply by the shutdown
+        return real_dispatch(header, blob)
+
+    srv._dispatch = slow_dispatch
+    result = {}
+
+    def pusher():
+        try:
+            result["reply"] = cli.call("push", "w", np.ones((4,), "f4"))
+            result["ok"] = True
+        except Exception as e:   # noqa: BLE001 — recorded for the assert
+            result["ok"] = False
+            result["err"] = e
+
+    t = threading.Thread(target=pusher)
+    t.start()
+    assert entered.wait(5.0)
+    srv.close(drain_s=5.0)       # idempotent; second call is a no-op
+    srv.close(drain_s=5.0)
+    t.join(10.0)
+    assert not t.is_alive()
+    assert result.get("ok"), result.get("err")
+    np.testing.assert_allclose(np.asarray(srv._store["w"]),
+                               np.ones((4,), "f4"))
+    assert not srv._thread.is_alive()
+    # the listener is really gone: a fresh client cannot connect
+    with pytest.raises(OSError):
+        Client("127.0.0.1", srv.port, timeout=1.0)
+    cli.close()
+
+
+def test_async_client_close_blocks_reconnect():
+    """close() must win the race against a retrying call() in another
+    thread: once closed, the client never dials the (draining) server."""
+    srv = Server()
+    cli = Client("127.0.0.1", srv.port)
+    cli.call("init", "w", np.zeros((2,), "f4"))
+    cli.close()
+    with pytest.raises(ConnectionError):
+        cli.call("pull", "w")
+    srv.close()
+
+
 def test_send_command_refuses_without_server():
     kv = mx.kv.create("local")
     with pytest.raises(mx.base.MXNetError):

@@ -472,6 +472,60 @@ class TestHloPasses:
         assert hlo_passes.fusion_bytes_pass(
             lowerings["donated"], "step", budget_gib=64.0) == []
 
+    # MXL507 fixtures: hand-written StableHLO with known dataflow. The
+    # chained module reduces THROUGH the only compute chain (dot ->
+    # all_reduce -> dot): nothing can overlap. The overlapped module has
+    # an independent dot the scheduler can slide under the collective.
+    _DDP_BAD = (
+        'func.func public @main(%arg0: tensor<4x4xf32>) {\n'
+        '  %0 = stablehlo.dot_general %arg0, %arg0 : tensor<4x4xf32>\n'
+        '  %1 = "stablehlo.all_reduce"(%0) <{replica_groups = '
+        'dense<[[0,1]]>}> ({\n'
+        '  ^bb0(%arg1: tensor<f32>, %arg2: tensor<f32>):\n'
+        '    %4 = stablehlo.add %arg1, %arg2 : tensor<f32>\n'
+        '    stablehlo.return %4 : tensor<f32>\n'
+        '  }) : tensor<4x4xf32>\n'
+        '  %2 = stablehlo.dot_general %1, %1 : tensor<4x4xf32>\n'
+        '  return %2 : tensor<4x4xf32>\n'
+        '}\n')
+    _DDP_GOOD = (
+        'func.func public @main(%arg0: tensor<4x4xf32>) {\n'
+        '  %0 = stablehlo.dot_general %arg0, %arg0 : tensor<4x4xf32>\n'
+        '  %1 = "stablehlo.all_reduce"(%0) <{replica_groups = '
+        'dense<[[0,1]]>}> ({\n'
+        '  ^bb0(%arg1: tensor<f32>, %arg2: tensor<f32>):\n'
+        '    %4 = stablehlo.add %arg1, %arg2 : tensor<f32>\n'
+        '    stablehlo.return %4 : tensor<f32>\n'
+        '  }) : tensor<4x4xf32>\n'
+        '  %2 = stablehlo.dot_general %arg0, %arg0 : tensor<4x4xf32>\n'
+        '  %3 = stablehlo.add %1, %2 : tensor<4x4xf32>\n'
+        '  return %3 : tensor<4x4xf32>\n'
+        '}\n')
+
+    def test_collective_interleave_catches_and_passes(self):
+        bad = hlo_passes.collective_interleave_pass(
+            self._DDP_BAD, "ddp/step", max_collectives=1)
+        assert len(bad) == 1 and bad[0].rule == "MXL507"
+        assert "critical path" in bad[0].message
+        assert hlo_passes.collective_interleave_pass(
+            self._DDP_GOOD, "ddp/step", max_collectives=1) == []
+
+    def test_collective_interleave_budget_and_absence(self):
+        over = hlo_passes.collective_interleave_pass(
+            self._DDP_GOOD, "ddp/step", max_collectives=0)
+        assert len(over) == 1 and "bucket plan" in over[0].message
+        none = hlo_passes.collective_interleave_pass(
+            "func.func public @main() {\n  return\n}\n", "ddp/step")
+        assert len(none) == 1 and "not being reduced" in none[0].message
+
+    def test_collective_overlap_report_is_per_func(self):
+        # SSA names restart per func.func: a %0 in a second function must
+        # not alias the first function's dataflow
+        two = self._DDP_BAD + self._DDP_GOOD.replace("@main", "@shmap_body")
+        rep = hlo_passes.collective_overlap_report(two)
+        assert rep["collectives"] == 2
+        assert rep["overlappable"] == 1
+
     def test_metrics_from_text(self, lowerings):
         m = hlo_passes.metrics_from_text(lowerings["donated"],
                                          large_bytes=1024)

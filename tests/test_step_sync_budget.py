@@ -169,6 +169,42 @@ def test_resnet50_fit_syncs_at_most_once_per_k_steps():
     assert acc_async == acc_sync, (acc_async, acc_sync)
 
 
+def test_ddp_window_stats_add_no_d2h():
+    """The DDP telemetry contract: ``ddp/comm_bytes``/``buckets``/
+    ``overlap_ms`` come from the GradReducer's STATIC bucket plan — host
+    memory decided at compile time — so sampling them at a window
+    boundary performs ZERO device->host transfers."""
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-virtual-device mesh")
+    rng = np.random.RandomState(5)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = rng.randint(0, 4, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym)
+    mod.logger = _logger
+    with _config.override(ddp=True):
+        mod.fit(it, num_epoch=1, kvstore="dist_sync", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+    assert mod._ddp and mod._fused is not None
+
+    profiler.reset_sync_counters()
+    stats = mod._ddp_stats(K)
+    telemetry.publish_window(steps=K, window_s=0.1, examples=16 * K,
+                             global_step=K, ddp=stats)
+    counters = profiler.sync_counters()
+    assert counters["d2h"] == 0 and counters["d2h_bytes"] == 0, counters
+
+    assert stats["buckets"] >= 1 and stats["comm_bytes"] > 0
+    reg = telemetry.default_registry()
+    assert reg.get("ddp/buckets").value() == stats["buckets"]
+    assert reg.get("ddp/comm_bytes").value() >= stats["comm_bytes"]
+    assert reg.get("ddp/overlap_ms").value() == stats["overlap_ms"]
+
+
 def test_counters_shape():
     profiler.reset_sync_counters()
     c = profiler.sync_counters()

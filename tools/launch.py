@@ -178,6 +178,16 @@ def main(argv=None):
                     help="print per-host commands instead of executing")
     ap.add_argument("--env", action="append", default=[],
                     help="extra K=V for the workers")
+    ap.add_argument("--ddp", action="store_true",
+                    help="bucketed data-parallel gradient all-reduce: "
+                         "export MXNET_DDP=1 to every worker so dist_sync "
+                         "training reduces gradients inside the jitted "
+                         "step (parallel/ddp.py) instead of through the "
+                         "kvstore (docs/distributed.md)")
+    ap.add_argument("--ddp-bucket-mb", type=float, default=None,
+                    help="override the gradient bucket size in MiB "
+                         "(MXNET_DDP_BUCKET_MB; default: auto from the "
+                         "interconnect cost model)")
     ap.add_argument("--max-restarts", type=int, default=3,
                     help="supervised restarts after a worker death "
                          "(single-host mode; 0 disables)")
@@ -193,6 +203,14 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
+    # --ddp rides the existing --env plumbing so both the single-host and
+    # the ssh multi-host path export the same contract
+    if args.ddp:
+        args.env = list(args.env) + ["MXNET_DDP=1"]
+        if args.ddp_bucket_mb is not None:
+            args.env.append("MXNET_DDP_BUCKET_MB=%g" % args.ddp_bucket_mb)
+    elif args.ddp_bucket_mb is not None:
+        ap.error("--ddp-bucket-mb requires --ddp")
     if args.hosts:
         return _multihost(args)
     if not args.num_workers:
