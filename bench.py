@@ -142,6 +142,117 @@ def _secondary_legs(out, on_tpu):
             out["serving"] = _serving_leg(on_tpu)
         except Exception as e:
             out["serving"] = "failed: %s" % e
+    # continuous-batching decode leg: tokens/s goodput, TTFT/TPOT, and
+    # the continuous-vs-static speedup on a ragged synthetic workload
+    # (BENCH_DECODE=0 skips)
+    if os.environ.get("BENCH_DECODE", "1") == "1":
+        try:
+            out["decode"] = _decode_leg(on_tpu)
+        except Exception as e:
+            out["decode"] = "failed: %s" % e
+
+
+def _decode_leg(on_tpu):
+    """Autoregressive decode through the continuous-batching engine
+    (serve/decode.py): export ONE generate artifact, then run the same
+    ragged workload — per group of ``max_slots`` requests, all but one
+    want a handful of tokens and one wants a long completion — in
+    continuous mode (finished slots refill between decode steps) and in
+    static mode (a group runs to its last straggler). The headline is
+    the goodput ratio; decode STEP counts are reported too since they
+    are the deterministic, load-independent form of the same ratio.
+    Also runs the MXL508 chip-free gate over the served decode step."""
+    import tempfile
+    import numpy as np
+    from mxnet_tpu import serving
+    from mxnet_tpu.serve import GenerateSession
+    from mxnet_tpu.serve import decode_model as _dm
+
+    if on_tpu:
+        spec = _dm.DecoderSpec(vocab=512, dim=256, num_heads=8,
+                               num_layers=4, max_prompt_len=16,
+                               page_size=16, max_pages_per_slot=8,
+                               max_slots=16, num_pages=160)
+        short_new, long_new, groups = 4, 108, 3
+    else:
+        spec = _dm.DecoderSpec(vocab=128, dim=64, num_heads=4,
+                               num_layers=2, max_prompt_len=8,
+                               page_size=8, max_pages_per_slot=6,
+                               max_slots=8, num_pages=64)
+        short_new, long_new, groups = 2, 40, 3
+    params = _dm.init_params(spec, seed=0)
+    art = tempfile.mktemp(suffix=".gen.mxtpu")
+    t0 = time.perf_counter()
+    serving.export_generate(params, spec, art)
+    leg = {"platform": "tpu" if on_tpu else "cpu_smoke",
+           "model": "gpt_d%d_l%d" % (spec.dim, spec.num_layers),
+           "export_s": round(time.perf_counter() - t0, 2),
+           "artifact_mb": round(os.path.getsize(art) / 1e6, 1),
+           "slots": spec.max_slots, "page_size": spec.page_size,
+           "kv_pages": spec.num_pages - 1}
+
+    rng = np.random.RandomState(0)
+    S = spec.max_slots
+    work = []   # (prompt, max_new)
+    for _ in range(groups):
+        for j in range(S):
+            plen = int(rng.randint(2, spec.max_prompt_len + 1))
+            prompt = rng.randint(2, spec.vocab, size=plen).tolist()
+            work.append((prompt, long_new if j == S - 1 else short_new))
+
+    def run_mode(continuous):
+        sess = GenerateSession(art, auto_start=False,
+                               continuous=continuous, timeout_ms=0,
+                               queue_depth=len(work) + 1)
+        t1 = time.perf_counter()
+        reqs = [sess.submit(p, max_new_tokens=n, temperature=0.0, seed=0)
+                for p, n in work]
+        rounds = 0
+        cap = sum(n for _, n in work) * 4 + 64
+        while not all(r.done() for r in reqs) and rounds < cap:
+            sess.run_round()
+            rounds += 1
+        wall = time.perf_counter() - t1
+        outs = [r.result(timeout=1.0) for r in reqs]
+        toks = sum(len(o["tokens"]) for o in outs)
+        ttfts = sorted(o["ttft_ms"] for o in outs)
+        tpots = sorted(o["tpot_ms"] for o in outs
+                       if o["tpot_ms"] is not None)
+        sess._publish_window(force=True)
+        steps = sess.metrics_.snapshot()["decode_steps"]
+        diags = sess.check_discipline() if continuous else []
+        sess.close(drain=True)
+
+        def pct(xs, q):
+            return round(xs[min(len(xs) - 1,
+                                int(q / 100.0 * len(xs)))], 3) \
+                if xs else None
+        return {"tokens": toks, "wall_s": round(wall, 3),
+                "tokens_per_s": round(toks / wall, 1),
+                "decode_steps": steps,
+                "ttft_ms_p50": pct(ttfts, 50),
+                "ttft_ms_p99": pct(ttfts, 99),
+                "tpot_ms_p50": pct(tpots, 50),
+                "tpot_ms_p99": pct(tpots, 99)}, diags
+
+    try:
+        cont, diags = run_mode(True)
+        stat, _ = run_mode(False)
+    finally:
+        try:
+            os.unlink(art)
+        except OSError:
+            pass
+    leg["continuous"] = cont
+    leg["static"] = stat
+    leg["speedup_tokens_per_s"] = round(
+        cont["tokens_per_s"] / stat["tokens_per_s"], 2) \
+        if stat["tokens_per_s"] else None
+    leg["speedup_steps"] = round(
+        stat["decode_steps"] / float(cont["decode_steps"]), 2) \
+        if cont["decode_steps"] else None
+    leg["mxl508"] = "clean" if not diags else [str(d) for d in diags]
+    return leg
 
 
 def _serving_leg(on_tpu):

@@ -24,7 +24,7 @@ __all__ = [
     "HLO_RULES", "convert_budget_pass", "donation_coverage_pass",
     "d2h_transfer_pass", "fusion_bytes_pass", "RecompileFingerprint",
     "collective_interleave_pass", "collective_overlap_report",
-    "metrics_from_text",
+    "decode_cache_discipline_pass", "metrics_from_text",
 ]
 
 HLO_RULES = {r.id: r for r in [
@@ -49,6 +49,12 @@ HLO_RULES = {r.id: r for r in [
          "the step materializes intermediates the backend must fuse away "
          "or spill to HBM; fuse epilogues (MXNET_KERNEL_TIER=auto, see "
          "docs/tuning.md) or hunt accidental f32 widening / transposes"),
+    Rule("MXL508", "hlo-decode-cache-discipline", "error",
+         "the decode step must update the paged KV cache IN PLACE "
+         "(donate the k/v page buffers to the jit — an undonated cache "
+         "is copied every token, doubling HBM and killing tokens/s) and "
+         "contain zero device->host ops (fetch only the sampled tokens, "
+         "outside the program; see docs/serving.md continuous batching)"),
     Rule("MXL507", "hlo-collective-interleave", "error",
          "the DDP step's gradient all-reduces must stay few (one fused "
          "collective per bucket — more means the GradReducer plan "
@@ -166,6 +172,49 @@ def fusion_bytes_pass(text, label, budget_gib, top=4):
                   "%.2f GiB nominal elementwise/layout bytes (budget "
                   "%.2f GiB); top ops (GiB): %s"
                   % (gib, budget_gib, worst))]
+
+
+def decode_cache_discipline_pass(text, label, cache_params,
+                                 d2h_budget=0):
+    """MXL508: the continuous-batching decode step's cache discipline.
+
+    ``cache_params`` names the entry-parameter indices holding the paged
+    KV cache (the decode engine donates argnums (5, 6)). The pass fails
+    when ANY of those buffers lacks a donation attr (``jax.buffer_donor``
+    / ``tf.aliasing_output``) — an undonated cache means XLA copies the
+    whole page store every token — or when the program contains more
+    than ``d2h_budget`` host-transfer ops (the per-token sync budget:
+    the ONLY fetch is the sampled-token vector, and that happens outside
+    the compiled program). Chip-free like every Layer-2 pass: lower the
+    served jit under JAX_PLATFORMS=cpu and hand the text in."""
+    params = hlo_stats.entry_params(text)
+    diags = []
+    if not params:
+        return [_diag("MXL508", label,
+                      "no entry computation found — cannot verify KV "
+                      "cache donation on an empty module")]
+    missing = []
+    for idx in cache_params:
+        if idx >= len(params):
+            missing.append("arg%d (out of range, %d params)"
+                           % (idx, len(params)))
+        elif not params[idx]["donated"]:
+            p = params[idx]
+            missing.append("%s (%s, %.1f MiB)"
+                           % (p["name"], p["dtype"], p["bytes"] / 2**20))
+    if missing:
+        diags.append(_diag(
+            "MXL508", label,
+            "KV cache buffer(s) not donated — the decode step copies "
+            "the page store every token: %s" % ", ".join(missing)))
+    n = d2h_count(text)
+    if n > d2h_budget:
+        diags.append(_diag(
+            "MXL508", label,
+            "%d host-transfer op(s) inside the decode step (budget %d) "
+            "— every one is a device sync per generated token"
+            % (n, d2h_budget)))
+    return diags
 
 
 # ---------------------------------------------------------------- MXL507

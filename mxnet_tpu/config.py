@@ -356,6 +356,18 @@ register_flag("serve_warmup", "MXNET_SERVE_WARMUP", _parse_bool, True,
 register_flag("serve_drain_timeout_s", "MXNET_SERVE_DRAIN_S", float, 30.0,
               "Graceful-shutdown budget: how long Server.close(drain=True) "
               "waits for queued requests to finish before giving up.")
+register_flag("serve_drain_tokens", "MXNET_SERVE_DRAIN_TOKENS", int, 32,
+              "Bounded-drain token budget for continuous-batching decode: "
+              "on graceful shutdown each active generation may produce at "
+              "most this many MORE tokens before it is evicted with a "
+              "resumable cursor (HTTP 429 + cursor). Without the bound a "
+              "single long max_new_tokens request holds the drain hostage. "
+              "0/negative = evict immediately at drain.")
+register_flag("serve_decode_window", "MXNET_SERVE_DECODE_WINDOW", int, 16,
+              "Decode telemetry window: publish decode/tokens_per_s, "
+              "kv_page_occupancy, active_slots and eviction counts every "
+              "this many decode steps — all from host-held scheduler "
+              "state, zero extra device->host transfers.")
 register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
               "Training-side telemetry HTTP listener port "
               "(mxnet_tpu.telemetry.exporters): serves /metrics "

@@ -30,7 +30,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import tier
 
-__all__ = ["take_rows", "eligible", "DEFAULT_CONFIG", "OP_NAME"]
+__all__ = ["take_rows", "gather_pages", "eligible", "DEFAULT_CONFIG",
+           "OP_NAME"]
 
 OP_NAME = "take_rows"
 DEFAULT_CONFIG = {"block_d": 512}
@@ -147,3 +148,22 @@ def take_rows(weight, idx, *, config=None, interpret=None):
                         weight.shape[0] - 1)
     out = _fused(weight, idx_flat, cfg)
     return out.reshape(tuple(idx.shape) + (weight.shape[1],))
+
+
+def gather_pages(table, idx, *, interpret=None):
+    """Tier-dispatched row gather for the paged-KV decode step.
+
+    ``table`` is one layer's flat page store ``(rows, dim)``; ``idx`` is
+    the block-table expansion ``(max_slots, max_context)`` of flat row
+    ids (serve/decode_model.py). Same numerics contract as
+    ``jnp.take(table, idx, axis=0)`` — the scalar-prefetch kernel is
+    bit-identical to it, so the bitwise-parity guarantee of the decode
+    engine is tier-independent. Falls back to ``jnp.take`` whenever the
+    tier is off or the guard declines (non-lane-aligned dim, dtype)."""
+    reason = eligible(table.shape, table.dtype, idx.shape, idx.dtype)
+    go, cfg = tier.should_dispatch(
+        OP_NAME, shape_key_shapes(table.shape, idx.shape), table.dtype,
+        guard_reason=reason)
+    if go:
+        return take_rows(table, idx, config=cfg, interpret=interpret)
+    return jnp.take(table, idx.astype(jnp.int32), axis=0)

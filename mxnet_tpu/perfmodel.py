@@ -10,6 +10,7 @@ from __future__ import annotations
 
 __all__ = ["PEAK_FLOPS", "HBM_GBPS", "ICI_GBPS", "peak_flops",
            "hbm_bytes_per_s", "interconnect_bytes_per_s", "mfu",
+           "roofline_seconds",
            "RESNET50_TRAIN_FLOPS_PER_IMG", "DEFAULT_DEVICE_KIND"]
 
 # fwd+bwd ~= 3x fwd MACs * 2 flops/MAC (ResNet-50 @ 224: 4.089 GMACs fwd)
@@ -70,3 +71,17 @@ def mfu(flops_per_step: float, step_seconds: float,
     if step_seconds <= 0.0:
         return 0.0
     return (flops_per_step / step_seconds) / peak_flops(device_kind)
+
+
+def roofline_seconds(flops: float, bytes_moved: float,
+                     device_kind: str = DEFAULT_DEVICE_KIND) -> float:
+    """Roofline lower bound on one program dispatch: the slower of the
+    compute term (flops over bf16 peak) and the memory term (bytes over
+    HBM bandwidth). This is the cost table the decode engine's
+    admission/retry-after/drain estimates are driven from
+    (serve/decode.py) — deliberately the same capability numbers the
+    kernel tuner's chip-free cost model uses, not a new heuristic."""
+    flops = max(0.0, float(flops))
+    bytes_moved = max(0.0, float(bytes_moved))
+    return max(flops / peak_flops(device_kind),
+               bytes_moved / hbm_bytes_per_s(device_kind))

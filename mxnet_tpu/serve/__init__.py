@@ -13,18 +13,26 @@ engine file; this package is the layer that serves traffic from it:
   (``tools/serve.py`` CLI).
 * :mod:`~mxnet_tpu.serve.metrics` — per-bucket latency percentiles,
   occupancy, padding waste; chrome-trace via the profiler.
+* :mod:`~mxnet_tpu.serve.decode` — continuous-batching autoregressive
+  decode: token-level scheduler + device-resident paged KV cache over a
+  generate artifact (:func:`~mxnet_tpu.serving.export_generate`).
+  ``Server`` auto-detects the artifact kind and serves either.
 
 See docs/serving.md for the operational story.
 """
-from .admission import (DeadlineExceeded, Request, ServeError, ServerBusy,
-                        ServerClosed)
+from .admission import (DeadlineExceeded, Evicted, Request, ServeError,
+                        ServerBusy, ServerClosed)
+from .decode import (GenerateConfig, GenerateRequest, GenerateSession,
+                     PagedKVCache)
 from .engine_cache import BucketedEngineCache, pick_bucket
-from .metrics import ServeMetrics, percentile
+from .metrics import DecodeMetrics, ServeMetrics, percentile
 from .server import ServeConfig, Server
 
 __all__ = ["Server", "ServeConfig", "Request", "ServeError", "ServerBusy",
-           "ServerClosed", "DeadlineExceeded", "BucketedEngineCache",
-           "ServeMetrics", "pick_bucket", "percentile", "serve_http"]
+           "ServerClosed", "DeadlineExceeded", "Evicted",
+           "BucketedEngineCache", "ServeMetrics", "DecodeMetrics",
+           "GenerateSession", "GenerateConfig", "GenerateRequest",
+           "PagedKVCache", "pick_bucket", "percentile", "serve_http"]
 
 
 def serve_http(server, host="127.0.0.1", port=8080, verbose=False):

@@ -17,7 +17,7 @@ import time
 from ..base import MXNetError
 
 __all__ = ["Request", "AdmissionQueue", "ServeError", "ServerBusy",
-           "ServerClosed", "DeadlineExceeded"]
+           "ServerClosed", "DeadlineExceeded", "Evicted"]
 
 
 class ServeError(MXNetError):
@@ -38,6 +38,21 @@ class ServerClosed(ServeError):
 
 class DeadlineExceeded(ServeError):
     """The request's deadline passed before a response was produced."""
+
+
+class Evicted(ServeError):
+    """A generation was evicted mid-decode (deadline expiry, or a
+    bounded drain past the per-sequence token budget). Carries the
+    tokens produced so far and a RESUMABLE CURSOR — prompt + generated
+    prefix — so the caller can resubmit and continue where it stopped
+    (the HTTP layer maps this to a 429-style reply with the cursor in
+    the body and a Retry-After hint)."""
+
+    def __init__(self, msg, tokens=None, cursor=None, retry_after=0.05):
+        super().__init__(msg)
+        self.tokens = list(tokens or [])
+        self.cursor = cursor
+        self.retry_after = retry_after
 
 
 class Request:

@@ -1,0 +1,451 @@
+"""The GPT-block decoder family behind the continuous-batching engine.
+
+Three pure-JAX programs over ONE weight set (the gluon GPT of
+``examples/train_transformer_lm.py``: token+position embedding, pre-LN
+blocks of causal attention + ReLU MLP, tied head):
+
+* ``make_prefill`` — dense causal forward over a padded ``(b, P)``
+  prompt batch; returns the first sampled token plus the per-layer K/V
+  rows for the whole prompt. Exported with a SYMBOLIC batch dim and
+  served through the bucketed ``engine_cache`` like any other artifact.
+* ``make_decode`` — ONE token for every slot at once, shape
+  ``[max_slots, 1]``: writes this step's K/V row into the paged cache
+  (in place — the caller donates the page buffers), gathers each slot's
+  pages back via the block table, and samples the next token on device.
+  Inactive slots are pointed at the reserved scratch page 0 by the host
+  scheduler; no active-mask input exists in the device program.
+* ``make_commit`` — scatters a prefilled prompt's K/V rows into that
+  sequence's freshly allocated pages (device-to-device, pages donated).
+
+Bitwise-parity design (the test_serve_decode.py contract): every
+per-slot computation here is row-wise independent (matmul rows, LayerNorm,
+per-row softmax, per-slot vmapped sampling), masked scores are forced to
+-1e30 BEFORE the softmax max so stale page contents contribute an exact
+0.0, and the sampling key depends only on (request seed, token position)
+— never on the slot index or on what else is in the batch. A request
+therefore produces the same token bits whether it runs alone or packed
+with others, as long as both runs use the SAME compiled executables
+(one prefill bucket, one decode program — the GenerateSession guarantees
+that).
+"""
+from __future__ import annotations
+
+import functools as _functools
+import math
+from typing import NamedTuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["DecoderSpec", "init_params", "params_from_gluon",
+           "make_prefill", "make_decode", "make_commit",
+           "reference_generate"]
+
+_LN_EPS = 1e-5   # gluon nn.LayerNorm default
+_NEG_INF = -1e30
+
+
+class DecoderSpec(NamedTuple):
+    """Static geometry of a generate artifact: model dims + cache layout.
+
+    ``num_pages`` INCLUDES the reserved scratch page 0 (never allocated;
+    inactive slots and overflow rows write there). A sequence may span at
+    most ``max_pages_per_slot`` pages, so its context is capped at
+    ``max_context = page_size * max_pages_per_slot`` tokens (prompt +
+    generated).
+    """
+
+    vocab: int
+    dim: int
+    num_heads: int
+    num_layers: int
+    max_prompt_len: int        # P: prefill pad length (prompt capacity)
+    page_size: int             # tokens per KV page
+    max_pages_per_slot: int    # block-table width per slot
+    max_slots: int             # decode step capacity [max_slots, 1]
+    num_pages: int             # total pages in the cache, incl. scratch 0
+    eos_id: int = -1           # host-side stop token; -1 = none
+
+    @property
+    def head_dim(self):
+        return self.dim // self.num_heads
+
+    @property
+    def max_context(self):
+        return self.page_size * self.max_pages_per_slot
+
+    @property
+    def prompt_pages(self):
+        """Width of commit's page-id vector: pages covering a full prompt."""
+        return -(-self.max_prompt_len // self.page_size)
+
+    @property
+    def cache_rows(self):
+        """KV rows per layer: every page's tokens, flat."""
+        return self.num_pages * self.page_size
+
+    def validate(self):
+        if self.dim % self.num_heads:
+            raise MXNetError("DecoderSpec: dim %d not divisible by "
+                             "num_heads %d" % (self.dim, self.num_heads))
+        if self.max_prompt_len > self.max_context:
+            raise MXNetError(
+                "DecoderSpec: max_prompt_len %d exceeds max_context %d "
+                "(page_size * max_pages_per_slot)"
+                % (self.max_prompt_len, self.max_context))
+        if self.num_pages < 2:
+            raise MXNetError("DecoderSpec: num_pages must be >= 2 (page 0 "
+                             "is the reserved scratch page)")
+        return self
+
+    def cache_bytes(self, dtype_bytes=4):
+        """HBM footprint of the paged K+V cache (both tensors)."""
+        return 2 * self.num_layers * self.cache_rows * self.dim * dtype_bytes
+
+
+# -- parameters -------------------------------------------------------------
+
+def _param_names(spec):
+    names = ["tok_w", "pos_w"]
+    for i in range(spec.num_layers):
+        names += ["l%d_ln1_g" % i, "l%d_ln1_b" % i,
+                  "l%d_qkv_w" % i, "l%d_qkv_b" % i,
+                  "l%d_proj_w" % i, "l%d_proj_b" % i,
+                  "l%d_ln2_g" % i, "l%d_ln2_b" % i,
+                  "l%d_mlp1_w" % i, "l%d_mlp1_b" % i,
+                  "l%d_mlp2_w" % i, "l%d_mlp2_b" % i]
+    return names + ["lnf_g", "lnf_b", "head_w", "head_b"]
+
+
+def init_params(spec, seed=0):
+    """Random f32 parameter dict (gluon Dense convention: W is (out, in),
+    the forward computes ``x @ W.T + b``)."""
+    spec.validate()
+    rng = _np.random.RandomState(seed)
+    C, V = spec.dim, spec.vocab
+
+    def n(*shape):
+        return rng.normal(0.0, 0.02, shape).astype(_np.float32)
+
+    p = {"tok_w": n(V, C), "pos_w": n(spec.max_context, C)}
+    for i in range(spec.num_layers):
+        p["l%d_ln1_g" % i] = _np.ones(C, _np.float32)
+        p["l%d_ln1_b" % i] = _np.zeros(C, _np.float32)
+        p["l%d_qkv_w" % i] = n(3 * C, C)
+        p["l%d_qkv_b" % i] = _np.zeros(3 * C, _np.float32)
+        p["l%d_proj_w" % i] = n(C, C)
+        p["l%d_proj_b" % i] = _np.zeros(C, _np.float32)
+        p["l%d_ln2_g" % i] = _np.ones(C, _np.float32)
+        p["l%d_ln2_b" % i] = _np.zeros(C, _np.float32)
+        p["l%d_mlp1_w" % i] = n(4 * C, C)
+        p["l%d_mlp1_b" % i] = _np.zeros(4 * C, _np.float32)
+        p["l%d_mlp2_w" % i] = n(C, 4 * C)
+        p["l%d_mlp2_b" % i] = _np.zeros(C, _np.float32)
+    p["lnf_g"] = _np.ones(C, _np.float32)
+    p["lnf_b"] = _np.zeros(C, _np.float32)
+    p["head_w"] = n(V, C)
+    p["head_b"] = _np.zeros(V, _np.float32)
+    return p
+
+
+def params_from_gluon(net, spec):
+    """Extract the weight dict from a trained
+    ``examples/train_transformer_lm.GPT`` (or any net with the same
+    attribute structure: tok, pos, blocks[i].{ln1,attn.{qkv,proj},ln2,
+    mlp1,mlp2}, ln_f, head). The position table must cover
+    ``spec.max_context`` rows; longer tables are truncated."""
+
+    def a(param):
+        arr = param.data() if callable(getattr(param, "data", None)) \
+            else param
+        return _np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy")
+                           else arr, _np.float32)
+
+    pos = a(net.pos)
+    if pos.shape[0] < spec.max_context:
+        raise MXNetError(
+            "params_from_gluon: position table has %d rows but the spec "
+            "needs max_context=%d; retrain with a longer seq_len or "
+            "shrink max_pages_per_slot" % (pos.shape[0], spec.max_context))
+    p = {"tok_w": a(net.tok.weight), "pos_w": pos[:spec.max_context]}
+    blocks = list(net.blocks)
+    if len(blocks) != spec.num_layers:
+        raise MXNetError("params_from_gluon: net has %d blocks, spec says "
+                         "%d layers" % (len(blocks), spec.num_layers))
+    for i, blk in enumerate(blocks):
+        p["l%d_ln1_g" % i] = a(blk.ln1.gamma)
+        p["l%d_ln1_b" % i] = a(blk.ln1.beta)
+        p["l%d_qkv_w" % i] = a(blk.attn.qkv.weight)
+        p["l%d_qkv_b" % i] = a(blk.attn.qkv.bias)
+        p["l%d_proj_w" % i] = a(blk.attn.proj.weight)
+        p["l%d_proj_b" % i] = a(blk.attn.proj.bias)
+        p["l%d_ln2_g" % i] = a(blk.ln2.gamma)
+        p["l%d_ln2_b" % i] = a(blk.ln2.beta)
+        p["l%d_mlp1_w" % i] = a(blk.mlp1.weight)
+        p["l%d_mlp1_b" % i] = a(blk.mlp1.bias)
+        p["l%d_mlp2_w" % i] = a(blk.mlp2.weight)
+        p["l%d_mlp2_b" % i] = a(blk.mlp2.bias)
+    p["lnf_g"] = a(net.ln_f.gamma)
+    p["lnf_b"] = a(net.ln_f.beta)
+    p["head_w"] = a(net.head.weight)
+    p["head_b"] = a(net.head.bias)
+    missing = set(_param_names(spec)) - set(p)
+    if missing:
+        raise MXNetError("params_from_gluon: missing %s" % sorted(missing))
+    return p
+
+
+# -- shared layer math ------------------------------------------------------
+
+def _ln(x, g, b):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + _LN_EPS) * g + b
+
+
+def _dense(x, w, b):
+    # gluon FullyConnected convention: w is (out, in)
+    return x @ w.T + b
+
+
+def _mlp(h, p, i):
+    x = _ln(h, p["l%d_ln2_g" % i], p["l%d_ln2_b" % i])
+    x = jax.nn.relu(_dense(x, p["l%d_mlp1_w" % i], p["l%d_mlp1_b" % i]))
+    return h + _dense(x, p["l%d_mlp2_w" % i], p["l%d_mlp2_b" % i])
+
+
+def _sample(logits, temps, seeds, counters):
+    """Per-row on-device sampling. The key is a pure function of the
+    request's seed and the POSITION the sampled token will occupy, so a
+    request's token stream is independent of slot index and batchmates
+    (the bitwise-parity contract). temp <= 0 selects greedy argmax."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def one(lg, t, s, c):
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), s), c)
+        return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+
+    sampled = jax.vmap(one)(logits, temps, seeds.astype(jnp.int32),
+                            counters.astype(jnp.int32)).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+# -- prefill ----------------------------------------------------------------
+
+def make_prefill(params, spec):
+    """Dense causal forward over a right-padded prompt batch.
+
+    (tokens[b,P] i32, lengths[b] i32, temps[b] f32, seeds[b] i32) ->
+    (first_token[b] i32, k[b,L,P,C] f32, v[b,L,P,C] f32)
+    """
+    spec.validate()
+    P, C, H = spec.max_prompt_len, spec.dim, spec.num_heads
+    Dh, L, V = spec.head_dim, spec.num_layers, spec.vocab
+    scale = 1.0 / math.sqrt(Dh)
+    p = {k: jnp.asarray(v) for k, v in params.items()}
+
+    def prefill(tokens, lengths, temps, seeds):
+        b = tokens.shape[0]
+        tok = jnp.clip(tokens.astype(jnp.int32), 0, V - 1)
+        h = jnp.take(p["tok_w"], tok, axis=0) + p["pos_w"][:P][None]
+        pos = jnp.arange(P)
+        causal = pos[None, :] <= pos[:, None]                   # (P, P)
+        valid = pos[None, None, :] < lengths[:, None, None]     # (b,1,P)
+        mask = causal[None] & valid                             # (b,P,P)
+        ks, vs = [], []
+        for i in range(L):
+            x = _ln(h, p["l%d_ln1_g" % i], p["l%d_ln1_b" % i])
+            qkv = _dense(x, p["l%d_qkv_w" % i], p["l%d_qkv_b" % i])
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            ks.append(k)
+            vs.append(v)
+            qh = q.reshape(b, P, H, Dh)
+            kh = k.reshape(b, P, H, Dh)
+            vh = v.reshape(b, P, H, Dh)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+            s = jnp.where(mask[:, None], s, _NEG_INF)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", w, vh).reshape(b, P, C)
+            h = h + _dense(o, p["l%d_proj_w" % i], p["l%d_proj_b" % i])
+            h = _mlp(h, p, i)
+        hf = _ln(h, p["lnf_g"], p["lnf_b"])
+        last = jnp.take_along_axis(
+            hf, jnp.clip(lengths - 1, 0, P - 1)[:, None, None], axis=1)[:, 0]
+        logits = _dense(last, p["head_w"], p["head_b"])
+        # the sampled token will sit at position `length`
+        nxt = _sample(logits, temps, seeds, lengths)
+        k_rows = jnp.stack(ks, axis=1)   # (b, L, P, C)
+        v_rows = jnp.stack(vs, axis=1)
+        return nxt, k_rows, v_rows
+
+    return prefill
+
+
+# -- decode -----------------------------------------------------------------
+
+def _gather_rows(table, idx):
+    """(rows, C) table gathered by (S, ctx) indices -> (S, ctx, C).
+    Dispatches to the Pallas scalar-prefetch row-gather kernel
+    (kernels/take.py) when the tier allows; jnp.take otherwise."""
+    from ..kernels import take as _take
+    return _take.gather_pages(table, idx)
+
+
+def make_decode(params, spec):
+    """One decode step for every slot: write this token's K/V row into
+    the paged cache IN PLACE, gather each slot's pages via its block
+    table, attend, sample.
+
+    (tokens[S,1] i32, positions[S] i32, block_tables[S,MP] i32,
+     temps[S] f32, seeds[S] i32, k_pages[L,R,C] f32, v_pages[L,R,C] f32)
+    -> (next_token[S] i32, k_pages, v_pages)
+
+    The caller MUST donate k_pages/v_pages (argnums 5, 6) — MXL508
+    gates on it. Inactive slots carry position 0 and an all-zeros block
+    table row, so their writes land in scratch page 0 and their sampled
+    token is garbage the host scheduler ignores.
+    """
+    spec.validate()
+    S, MP, page = spec.max_slots, spec.max_pages_per_slot, spec.page_size
+    C, H, Dh, L, V = (spec.dim, spec.num_heads, spec.head_dim,
+                      spec.num_layers, spec.vocab)
+    ctx = spec.max_context
+    scale = 1.0 / math.sqrt(Dh)
+    p = {k: jnp.asarray(v) for k, v in params.items()}
+
+    def decode(tokens, positions, block_tables, temps, seeds,
+               k_pages, v_pages):
+        t = jnp.clip(tokens[:, 0].astype(jnp.int32), 0, V - 1)
+        positions = positions.astype(jnp.int32)
+        bt = block_tables.astype(jnp.int32)
+        h = (jnp.take(p["tok_w"], t, axis=0)
+             + jnp.take(p["pos_w"], jnp.clip(positions, 0, ctx - 1),
+                        axis=0))
+        # flat cache row this token writes: its page * page_size + offset
+        write_idx = (bt[jnp.arange(S), positions // page] * page
+                     + positions % page)                        # (S,)
+        # every row this slot may attend to, in logical position order
+        ctx_idx = (bt[:, :, None] * page
+                   + jnp.arange(page)[None, None, :]).reshape(S, ctx)
+        att = jnp.arange(ctx)[None, :] <= positions[:, None]    # (S, ctx)
+        for i in range(L):
+            x = _ln(h, p["l%d_ln1_g" % i], p["l%d_ln1_b" % i])
+            qkv = _dense(x, p["l%d_qkv_w" % i], p["l%d_qkv_b" % i])
+            q, k, v = jnp.split(qkv, 3, axis=-1)                # (S, C)
+            k_pages = k_pages.at[i, write_idx].set(k)
+            v_pages = v_pages.at[i, write_idx].set(v)
+            k_ctx = _gather_rows(k_pages[i], ctx_idx)           # (S,ctx,C)
+            v_ctx = _gather_rows(v_pages[i], ctx_idx)
+            qh = q.reshape(S, H, Dh)
+            kh = k_ctx.reshape(S, ctx, H, Dh)
+            vh = v_ctx.reshape(S, ctx, H, Dh)
+            s = jnp.einsum("shd,sthd->sht", qh, kh) * scale
+            s = jnp.where(att[:, None, :], s, _NEG_INF)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("sht,sthd->shd", w, vh).reshape(S, C)
+            h = h + _dense(o, p["l%d_proj_w" % i], p["l%d_proj_b" % i])
+            h = _mlp(h, p, i)
+        logits = _dense(_ln(h, p["lnf_g"], p["lnf_b"]),
+                        p["head_w"], p["head_b"])
+        nxt = _sample(logits, temps, seeds, positions + 1)
+        return nxt, k_pages, v_pages
+
+    return decode
+
+
+# -- commit (prompt KV -> pages) -------------------------------------------
+
+def make_commit(spec):
+    """Scatter one prefilled prompt's K/V rows into its pages.
+
+    (k_pages[L,R,C], v_pages[L,R,C], k_new[L,P,C], v_new[L,P,C],
+     page_ids[prompt_pages] i32, n_rows () i32) -> (k_pages, v_pages)
+
+    Rows >= n_rows (prompt padding) are routed to scratch page 0. The
+    caller donates the page buffers (argnums 0, 1).
+    """
+    spec.validate()
+    P, page = spec.max_prompt_len, spec.page_size
+
+    def commit(k_pages, v_pages, k_new, v_new, page_ids, n_rows):
+        i = jnp.arange(P)
+        rows = (jnp.take(page_ids.astype(jnp.int32), i // page) * page
+                + i % page)
+        rows = jnp.where(i < n_rows, rows, 0)
+        k_pages = k_pages.at[:, rows].set(k_new)
+        v_pages = v_pages.at[:, rows].set(v_new)
+        return k_pages, v_pages
+
+    return commit
+
+
+# -- dense reference (tests) ------------------------------------------------
+
+@_functools.partial(jax.jit, static_argnames=("H", "L"))
+def _dense_logits_at(p, tokens, n, *, H, L):
+    """Dense causal forward over a fixed-length padded token buffer;
+    logits for the row at position ``n - 1``. Fixed shape so the oracle
+    compiles ONCE per weight geometry instead of once per prefix length
+    (jit caches on pytree shapes — fresh dicts of the same weights hit).
+    Rows at positions >= n are garbage but unread: row n-1 attends only
+    to columns <= n-1 (causal mask, masked scores an exact -1e30)."""
+    T = tokens.shape[0]
+    V, C = p["tok_w"].shape
+    Dh = C // H
+    scale = 1.0 / math.sqrt(Dh)
+    h = (jnp.take(p["tok_w"], jnp.clip(tokens, 0, V - 1), axis=0)
+         + p["pos_w"][:T])
+    pos = jnp.arange(T)
+    mask = pos[None, :] <= pos[:, None]
+    for i in range(L):
+        x = _ln(h, p["l%d_ln1_g" % i], p["l%d_ln1_b" % i])
+        qkv = _dense(x, p["l%d_qkv_w" % i], p["l%d_qkv_b" % i])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qh = q.reshape(T, H, Dh)
+        kh = k.reshape(T, H, Dh)
+        vh = v.reshape(T, H, Dh)
+        s = jnp.einsum("qhd,khd->hqk", qh, kh) * scale
+        s = jnp.where(mask[None], s, _NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hqk,khd->qhd", w, vh).reshape(T, C)
+        h = h + _dense(o, p["l%d_proj_w" % i], p["l%d_proj_b" % i])
+        h = _mlp(h, p, i)
+    hf = _ln(jnp.take(h, n - 1, axis=0)[None], p["lnf_g"], p["lnf_b"])
+    return _dense(hf, p["head_w"], p["head_b"])[0]
+
+
+def reference_generate(params, spec, prompt, max_new_tokens,
+                       temperature=0.0, seed=0):
+    """Slow, paging-free reference: full dense forward over the whole
+    (padded) token prefix for every generated token. Same math, same
+    sampling keys — the KV-cache-correctness oracle for
+    test_serve_decode.py (greedy comparisons are exact-token; the paged
+    path reassociates reductions, so logits agree only to fp
+    tolerance)."""
+    spec.validate()
+    p = {k: jnp.asarray(v) for k, v in params.items()}
+    buf = _np.zeros(spec.max_context, _np.int32)
+    toks = [int(t) for t in prompt]
+    buf[:len(toks)] = toks
+    out = []
+    for _ in range(max_new_tokens):
+        n = len(toks)   # position the new token will occupy
+        logits = _dense_logits_at(p, jnp.asarray(buf),
+                                  jnp.asarray(n, jnp.int32),
+                                  H=spec.num_heads, L=spec.num_layers)
+        nxt = _sample(logits[None], jnp.asarray([temperature], jnp.float32),
+                      jnp.asarray([seed], jnp.int32),
+                      jnp.asarray([n], jnp.int32))
+        tok = int(jax.device_get(nxt)[0])
+        out.append(tok)
+        toks.append(tok)
+        if n < buf.shape[0]:
+            buf[n] = tok
+        if spec.eos_id >= 0 and tok == spec.eos_id:
+            break
+    return out

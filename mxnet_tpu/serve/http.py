@@ -8,6 +8,14 @@ queue; the micro-batcher coalesces across connections.
 Protocol:
   POST /v1/predict   {"inputs": {name: nested-list}, "timeout_ms": opt}
                   -> {"outputs": [...], "latency_ms": f, "bucket": b}
+  POST /v1/generate  {"prompt": [ids], "max_new_tokens": opt,
+                      "temperature": opt, "seed": opt, "timeout_ms": opt}
+                  -> {"tokens": [...], "finish_reason": "stop"|"length",
+                      "ttft_ms": f, "tpot_ms": f|null, "latency_ms": f}
+                     (generate-mode servers only; an eviction comes back
+                     as 429 with the partial tokens, a resumable
+                     "cursor" whose resume_prompt continues the
+                     generation on resubmit, and a Retry-After hint)
   GET  /metrics      -> the Server.metrics() snapshot (JSON, default) or
                         the Prometheus text exposition of the run-wide
                         telemetry registry when the client asks for it
@@ -27,7 +35,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from ..base import MXNetError
-from .admission import DeadlineExceeded, ServerBusy, ServerClosed
+from .admission import (DeadlineExceeded, Evicted, ServerBusy,
+                        ServerClosed)
 
 __all__ = ["serve_http", "HttpFrontEnd"]
 
@@ -81,6 +90,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         srv = self.server.mx_server
+        if self.path in ("/v1/generate", "/generate"):
+            self._do_generate(srv)
+            return
         if self.path not in ("/v1/predict", "/predict"):
             self._reply(404, {"error": "no such endpoint %r" % self.path})
             return
@@ -126,6 +138,56 @@ class _Handler(BaseHTTPRequestHandler):
                           "latency_ms": round(
                               (time.monotonic() - req.t_submit) * 1e3, 3),
                           "bucket": req.bucket})
+
+    def _do_generate(self, srv):
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n).decode() or "{}")
+            prompt = payload.get("prompt")
+            if not isinstance(prompt, list) or not prompt:
+                raise MXNetError(
+                    'body must be {"prompt": [token ids], ...}')
+            req = srv.submit_generate(
+                prompt,
+                max_new_tokens=payload.get("max_new_tokens"),
+                temperature=payload.get("temperature", 0.0),
+                seed=payload.get("seed", 0),
+                timeout_ms=payload.get("timeout_ms"))
+        except ServerBusy as e:
+            self._reply(429, {"error": str(e),
+                              "retry_after_s": e.retry_after},
+                        {"Retry-After": "%.3f" % e.retry_after})
+            return
+        except ServerClosed as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except (MXNetError, ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        import time
+        try:
+            budget = (None if req.deadline is None
+                      else max(0.001, req.deadline - time.monotonic())
+                      + 30.0)
+            out = req.result(timeout=budget)
+        except Evicted as e:
+            # 429-style: partial progress + a resumable cursor — the
+            # client resubmits cursor["resume_prompt"] after Retry-After
+            self._reply(429, {"error": str(e), "tokens": e.tokens,
+                              "cursor": e.cursor,
+                              "retry_after_s": e.retry_after},
+                        {"Retry-After": "%.3f" % e.retry_after})
+            return
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except ServerClosed as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except MXNetError as e:
+            self._reply(500, {"error": str(e)})
+            return
+        self._reply(200, out)
 
 
 class HttpFrontEnd:

@@ -27,7 +27,7 @@ from collections import deque
 from .. import profiler
 from .. import telemetry as _telemetry
 
-__all__ = ["ServeMetrics", "percentile"]
+__all__ = ["ServeMetrics", "DecodeMetrics", "percentile"]
 
 _SAMPLE_CAP = 8192   # bounded reservoir per series (latest wins)
 
@@ -233,3 +233,132 @@ class ServeMetrics:
         if engine_stats is not None:
             out["engines"] = engine_stats
         return out
+
+
+class DecodeMetrics:
+    """Continuous-batching decode observability.
+
+    Same zero-extra-d2h contract as the training window publish
+    (test_step_sync_budget.py): every number here is HOST state the
+    scheduler already holds — step counts, wall clock, the free-page
+    list, completion timestamps. ``publish_window`` is called every
+    MXNET_SERVE_DECODE_WINDOW decode steps and touches no device array.
+    The registry series are the ones ISSUE'd for the decode loop:
+    ``decode/tokens_per_s``, ``decode/kv_page_occupancy``,
+    ``decode/active_slots``, ``decode/evictions``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.evicted = 0
+        self.expired = 0
+        self.rejected = 0
+        self.prefill_batches = 0
+        self.prefill_rows = 0
+        self.decode_steps = 0
+        self.tokens = 0
+        self.ttft_ms = deque(maxlen=_SAMPLE_CAP)
+        self.tpot_ms = deque(maxlen=_SAMPLE_CAP)
+        self._t_start = time.monotonic()
+        self._tm_tokens_per_s = _telemetry.gauge(
+            "decode/tokens_per_s", "generated tokens/s over the last "
+            "decode window (goodput, all slots)")
+        self._tm_occupancy = _telemetry.gauge(
+            "decode/kv_page_occupancy", "fraction of allocatable KV "
+            "pages currently held by live sequences")
+        self._tm_active = _telemetry.gauge(
+            "decode/active_slots", "decode slots holding a live sequence")
+        self._tm_evictions = _telemetry.counter(
+            "decode/evictions", "sequences evicted mid-decode (deadline "
+            "expiry or bounded drain); each carries a resumable cursor")
+        self._tm_steps = _telemetry.counter(
+            "decode/steps_total", "compiled decode steps dispatched")
+        self._tm_tokens = _telemetry.counter(
+            "decode/tokens_total", "tokens sampled for live sequences")
+        self._tm_ttft = _telemetry.histogram(
+            "decode/ttft_ms", "time to first token (admission+prefill)")
+        self._tm_tpot = _telemetry.histogram(
+            "decode/tpot_ms", "per-output-token latency after the first")
+
+    # -- host-side event hooks (no device arrays anywhere below) ----------
+    def note_submit(self, n=1):
+        with self._lock:
+            self.submitted += n
+
+    def note_reject(self, n=1):
+        with self._lock:
+            self.rejected += n
+
+    def note_prefill(self, rows):
+        with self._lock:
+            self.prefill_batches += 1
+            self.prefill_rows += rows
+
+    def note_ttft(self, ms):
+        with self._lock:
+            self.ttft_ms.append(ms)
+        self._tm_ttft.observe(ms)
+
+    def note_complete(self, tpot_ms=None):
+        with self._lock:
+            self.completed += 1
+            if tpot_ms is not None:
+                self.tpot_ms.append(tpot_ms)
+        if tpot_ms is not None:
+            self._tm_tpot.observe(tpot_ms)
+
+    def note_evict(self, expired=False):
+        with self._lock:
+            self.evicted += 1
+            if expired:
+                self.expired += 1
+        self._tm_evictions.inc()
+
+    def publish_window(self, *, steps, window_s, tokens, active_slots,
+                       page_occupancy):
+        """One decode window's registry publish, from host-held values."""
+        with self._lock:
+            self.decode_steps += steps
+            self.tokens += tokens
+        self._tm_steps.inc(steps)
+        self._tm_tokens.inc(tokens)
+        if window_s > 0:
+            self._tm_tokens_per_s.set(tokens / window_s)
+        self._tm_active.set(active_slots)
+        self._tm_occupancy.set(page_occupancy)
+
+    def snapshot(self):
+        with self._lock:
+            ttft = list(self.ttft_ms)
+            tpot = list(self.tpot_ms)
+            up = time.monotonic() - self._t_start
+            return {
+                "uptime_s": round(up, 3),
+                "requests": {
+                    "submitted": self.submitted,
+                    "completed": self.completed,
+                    "evicted": self.evicted,
+                    "expired": self.expired,
+                    "rejected": self.rejected,
+                },
+                "prefill": {"batches": self.prefill_batches,
+                            "rows": self.prefill_rows},
+                "decode_steps": self.decode_steps,
+                "tokens": self.tokens,
+                "tokens_per_s": round(self.tokens / up, 2) if up > 0
+                else None,
+                "ttft_ms": {
+                    "count": len(ttft),
+                    "p50": percentile(ttft, 50),
+                    "p95": percentile(ttft, 95),
+                    "p99": percentile(ttft, 99),
+                },
+                "tpot_ms": {
+                    "count": len(tpot),
+                    "p50": percentile(tpot, 50),
+                    "p95": percentile(tpot, 95),
+                    "p99": percentile(tpot, 99),
+                },
+            }

@@ -36,9 +36,10 @@ import jax
 from ..base import MXNetError
 from ..config import flags
 from .. import profiler
-from ..serving import CompiledModel
+from ..serving import CompiledModel, GenerateModel, load_artifact
 from .admission import (AdmissionQueue, DeadlineExceeded, Request,
                         ServerClosed)
+from .decode import GenerateConfig, GenerateSession
 from .engine_cache import check_buckets, pick_bucket
 from .metrics import ServeMetrics
 
@@ -76,13 +77,35 @@ class Server:
     """
 
     def __init__(self, model, config=None, auto_start=True, **overrides):
+        if not isinstance(model, (CompiledModel, GenerateModel)):
+            model = load_artifact(model)
+        if isinstance(model, GenerateModel):
+            # generate artifact: the continuous-batching decode engine
+            # replaces the micro-batcher wholesale; Server proxies
+            # lifecycle + metrics so the HTTP front end / CLI are shared
+            if config is None:
+                config = GenerateConfig(**overrides)
+            elif overrides:
+                raise MXNetError("Server: pass either config or kwargs, "
+                                 "not both")
+            if not isinstance(config, GenerateConfig):
+                raise MXNetError(
+                    "Server: a generate artifact takes a GenerateConfig "
+                    "(continuous-batching knobs), not ServeConfig")
+            self.mode = "generate"
+            self.model = model
+            self.config = config
+            self.session = GenerateSession(model, config=config,
+                                           auto_start=auto_start)
+            self.metrics_ = self.session.metrics_
+            return
+        self.mode = "predict"
+        self.session = None
         if config is None:
             config = ServeConfig(**overrides)
         elif overrides:
             raise MXNetError("Server: pass either config or kwargs, "
                              "not both")
-        if not isinstance(model, CompiledModel):
-            model = CompiledModel.load(model)
         self.model = model
         self.config = config
         self.buckets = check_buckets(config.buckets, model)
@@ -105,6 +128,9 @@ class Server:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
+        if self.mode == "generate":
+            self.session.start()
+            return self
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(target=self._loop,
                                             name="mxtpu-serve-batcher",
@@ -114,16 +140,25 @@ class Server:
 
     @property
     def draining(self):
+        if self.mode == "generate":
+            return self.session.draining
         return self._queue.closed and not self._closed.is_set()
 
     @property
     def closed(self):
+        if self.mode == "generate":
+            return self.session.closed
         return self._closed.is_set()
 
     def close(self, drain=True, timeout=None):
         """Shut down. ``drain=True`` (graceful): stop admitting, finish
         every queued request, then return. ``drain=False``: evict queued
-        requests, failing them with ServerClosed (counted as dropped)."""
+        requests, failing them with ServerClosed (counted as dropped).
+        Generate mode: drain is BOUNDED — each live sequence gets at
+        most ``drain_tokens`` more tokens, then is evicted with a
+        resumable cursor (see GenerateSession.close)."""
+        if self.mode == "generate":
+            return self.session.close(drain=drain, timeout=timeout)
         self._closing = True
         evicted = self._queue.close(drain=drain)
         for r in evicted:
@@ -160,6 +195,29 @@ class Server:
             self.close(drain=True)
 
     # -- request path -------------------------------------------------------
+    def _require_mode(self, mode, what):
+        if self.mode != mode:
+            other = ("submit_generate()/generate() or POST /v1/generate"
+                     if self.mode == "generate"
+                     else "submit()/predict() or POST /v1/predict")
+            raise MXNetError(
+                "Server.%s: this server holds a %s artifact; use %s"
+                % (what, self.mode, other))
+
+    def submit_generate(self, prompt, max_new_tokens=None,
+                        temperature=0.0, seed=0, timeout_ms=None):
+        """Generate-mode admit (never blocks); see
+        :meth:`GenerateSession.submit`."""
+        self._require_mode("generate", "submit_generate")
+        return self.session.submit(prompt, max_new_tokens=max_new_tokens,
+                                   temperature=temperature, seed=seed,
+                                   timeout_ms=timeout_ms)
+
+    def generate(self, prompt, **kw):
+        """Blocking generate-mode convenience: submit + result."""
+        self._require_mode("generate", "generate")
+        return self.session.generate(prompt, **kw)
+
     def _prepare(self, data, kwdata):
         if data and kwdata:
             raise MXNetError("Server.submit: pass inputs positionally or "
@@ -188,6 +246,7 @@ class Server:
         """Admit one request; never blocks. Returns a :class:`Request`
         whose ``.result()`` blocks for the response. Raises ServerBusy
         (queue full), ServerClosed, or MXNetError (validation)."""
+        self._require_mode("predict", "submit")
         arrs, rows = self._prepare(data, kwdata)
         if timeout_ms is None:
             timeout_ms = self.config.timeout_ms
@@ -221,7 +280,10 @@ class Server:
         the expired, dispatch one padded bucket batch, distribute the
         results. Returns the number of requests taken (0 = nothing to
         do). Public so tests and auto_start=False drivers can step the
-        batcher deterministically."""
+        batcher deterministically. Generate mode: one scheduler round
+        (evict/admit/decode-step)."""
+        if self.mode == "generate":
+            return self.session.run_round()
         reqs = self._queue.take(self.buckets[-1],
                                 self.config.batch_timeout_ms / 1e3,
                                 block=block)
@@ -293,8 +355,14 @@ class Server:
     def metrics(self):
         """JSON-able snapshot: request counters, queue depth, per-bucket
         latency percentiles / occupancy / padding waste, engine-cache
-        stats. The ``/metrics`` endpoint body."""
+        stats. The ``/metrics`` endpoint body. Generate mode: decode
+        counters, TTFT/TPOT percentiles, slot/page occupancy."""
+        if self.mode == "generate":
+            snap = self.session.metrics()
+            snap["mode"] = "generate"
+            return snap
         snap = self.metrics_.snapshot(engine_stats=self._cache.stats())
+        snap["mode"] = "predict"
         snap["buckets_configured"] = list(self.buckets)
         snap["status"] = ("closed" if self.closed
                           else "draining" if self.draining else "ok")

@@ -23,6 +23,11 @@ metadata JSON, then the serialized StableHLO module.
 Surface:
   * export_compiled(sym, arg_params, aux_params, data_shapes, path)
   * CompiledModel.load(path) -> .predict(**data) / callable
+  * export_generate(params, spec, path) — continuous-batching decode
+    artifact (format_version 3): THREE modules (prefill / decode step /
+    KV commit) plus the paged-cache spec, serving
+    :class:`mxnet_tpu.serve.GenerateSession`.
+  * GenerateModel.load(path) / load_artifact(path) — version dispatch.
   * tools/compile_model.py — checkpoint pair -> artifact CLI.
 """
 from __future__ import annotations
@@ -39,9 +44,22 @@ from .base import MXNetError
 from . import hlo_stats as _hlo_stats
 from .kernels import tier as _kernels_tier
 
-__all__ = ["export_compiled", "CompiledModel"]
+__all__ = ["export_compiled", "CompiledModel", "export_generate",
+           "GenerateModel", "load_artifact"]
 
 _MAGIC = b"MXTPUAOT"
+
+
+def _read_artifact(path):
+    """(meta, payload bytes) of any .mxtpu artifact, version-agnostic."""
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError("%r is not an mxtpu AOT artifact" % path)
+        (n,) = struct.unpack("<I", f.read(4))
+        meta = json.loads(f.read(n).decode())
+        payload = f.read()
+    return meta, payload
 
 
 def _infer_fn(symbol, arg_params, aux_params, data_names):
@@ -200,13 +218,14 @@ class CompiledModel:
         backend — pass ``allow_platform_mismatch=True`` to load anyway
         for inspection or to relay the artifact to a matching host."""
         from jax import export as _export
-        with open(path, "rb") as f:
-            magic = f.read(8)
-            if magic != _MAGIC:
-                raise MXNetError("%r is not an mxtpu AOT artifact" % path)
-            (n,) = struct.unpack("<I", f.read(4))
-            meta = json.loads(f.read(n).decode())
-            blob = f.read()
+        meta, blob = _read_artifact(path)
+        if meta.get("format_version", 2) >= 3 or "modules" in meta:
+            raise MXNetError(
+                "artifact %r is a generate (continuous-batching) artifact "
+                "(format_version %s); load it with GenerateModel.load / "
+                "load_artifact, and serve it with "
+                "mxnet_tpu.serve.GenerateSession"
+                % (path, meta.get("format_version")))
         backend = jax.default_backend().lower()
         if (not allow_platform_mismatch
                 and not _platform_ok(backend, meta.get("platforms", []))):
@@ -325,3 +344,175 @@ class CompiledModel:
 def _fmt_shape(shape):
     return "(" + ", ".join("N" if d is None else str(d)
                            for d in shape) + ")"
+
+
+# -- generate artifacts (format_version 3) ---------------------------------
+
+def _kernel_tier_meta(exps):
+    meta = {"tier": _kernels_tier.tier()}
+    if meta["tier"] != "off":
+        from .tune import cache as _tcache
+        meta["tuning_fingerprint"] = _tcache.get_default().fingerprint()
+    kernels = {}
+    for exp in exps:
+        try:
+            for name, n in _hlo_stats.pallas_kernel_names(
+                    exp.mlir_module()).items():
+                kernels[name] = kernels.get(name, 0) + n
+        except Exception:
+            pass
+    if kernels:
+        meta["pallas_kernels"] = kernels
+    return meta
+
+
+def export_generate(params, spec, path, platforms=None, dtype="float32"):
+    """Freeze a decoder (weights + :class:`~mxnet_tpu.serve.decode_model.
+    DecoderSpec` geometry) into a generate-capable artifact.
+
+    The artifact carries THREE serialized StableHLO modules:
+
+    * ``prefill`` — symbolic batch dim, served through the bucketed
+      engine_cache exactly like a v2 predict artifact;
+    * ``decode``  — ONE token-step of fixed shape ``[max_slots, 1]``
+      over the paged KV cache (the caller donates the page buffers);
+    * ``commit``  — prompt-KV scatter into freshly allocated pages.
+
+    Cache capacity (``spec.num_pages``) is BAKED into the decode/commit
+    shapes — the TensorRT-profile trade: one artifact, one KV budget.
+    Donation is NOT recorded in the modules; the serve side re-jits with
+    ``donate_argnums`` (GenerateSession) and the MXL508 gate checks the
+    lowering it actually runs.
+    """
+    from jax import export as _export
+    from .serve import decode_model as _dm
+    spec = _dm.DecoderSpec(*spec).validate()
+    kw = {}
+    if platforms is not None:
+        kw["platforms"] = [p.lower() for p in platforms]
+    i32, f32 = _np.dtype("int32"), _np.dtype(dtype)
+    P, S, MP = spec.max_prompt_len, spec.max_slots, spec.max_pages_per_slot
+    L, C, R = spec.num_layers, spec.dim, spec.cache_rows
+    SDS = jax.ShapeDtypeStruct
+
+    (b,) = _export.symbolic_shape("b")
+    prefill_exp = _export.export(jax.jit(_dm.make_prefill(params, spec)),
+                                 **kw)(
+        SDS((b, P), i32), SDS((b,), i32), SDS((b,), f32), SDS((b,), i32))
+    pages = SDS((L, R, C), f32)
+    decode_exp = _export.export(jax.jit(_dm.make_decode(params, spec)),
+                                **kw)(
+        SDS((S, 1), i32), SDS((S,), i32), SDS((S, MP), i32),
+        SDS((S,), f32), SDS((S,), i32), pages, pages)
+    commit_exp = _export.export(jax.jit(_dm.make_commit(spec)), **kw)(
+        pages, pages, SDS((L, P, C), f32), SDS((L, P, C), f32),
+        SDS((spec.prompt_pages,), i32), SDS((), i32))
+
+    blobs = [exp.serialize() for exp in (prefill_exp, decode_exp,
+                                         commit_exp)]
+    meta = {
+        "format_version": 3,
+        "platforms": list(prefill_exp.platforms),
+        "dynamic_batch": True,
+        # the prefill signature, v2-shaped so BucketedEngineCache serves
+        # it unchanged
+        "inputs": [
+            {"name": "tokens", "shape": [None, P], "dtype": "int32"},
+            {"name": "lengths", "shape": [None], "dtype": "int32"},
+            {"name": "temperatures", "shape": [None], "dtype": str(f32)},
+            {"name": "seeds", "shape": [None], "dtype": "int32"},
+        ],
+        "num_outputs": 3,
+        "modules": [
+            {"name": "prefill", "bytes": len(blobs[0])},
+            {"name": "decode", "bytes": len(blobs[1])},
+            {"name": "commit", "bytes": len(blobs[2])},
+        ],
+        "generate": {"spec": spec._asdict(), "dtype": str(f32)},
+        "kernel_tier": _kernel_tier_meta((prefill_exp, decode_exp,
+                                          commit_exp)),
+    }
+    mjson = json.dumps(meta).encode()
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(mjson)))
+        f.write(mjson)
+        for blob in blobs:
+            f.write(blob)
+    return meta
+
+
+class GenerateModel:
+    """A loaded generate artifact: the prefill module wrapped as a
+    :class:`CompiledModel` (bucketed engine_cache compatible) plus the
+    deserialized decode/commit modules and the cache spec. Execution
+    lives in :class:`mxnet_tpu.serve.GenerateSession`."""
+
+    def __init__(self, prefill, decode_exp, commit_exp, meta):
+        self.prefill = prefill            # CompiledModel (dynamic batch)
+        self.decode_exp = decode_exp
+        self.commit_exp = commit_exp
+        self.meta = meta
+        self._decode_jit = None
+        self._commit_jit = None
+
+    @property
+    def spec(self):
+        from .serve.decode_model import DecoderSpec
+        return DecoderSpec(**self.meta["generate"]["spec"])
+
+    # The jitted step/commit are cached on the MODEL, not the session:
+    # every GenerateSession over one loaded artifact shares the same
+    # compiled executables (the programs are stateless — each session
+    # passes and donates its own cache buffers).
+    def decode_jit(self):
+        if self._decode_jit is None:
+            self._decode_jit = jax.jit(self.decode_exp.call,
+                                       donate_argnums=(5, 6))
+        return self._decode_jit
+
+    def commit_jit(self):
+        if self._commit_jit is None:
+            self._commit_jit = jax.jit(self.commit_exp.call,
+                                       donate_argnums=(0, 1))
+        return self._commit_jit
+
+    @classmethod
+    def load(cls, path, allow_platform_mismatch=False):
+        from jax import export as _export
+        meta, payload = _read_artifact(path)
+        if meta.get("format_version", 2) < 3 or "modules" not in meta:
+            raise MXNetError(
+                "artifact %r is a single-module predict artifact "
+                "(format_version %s); load it with CompiledModel.load"
+                % (path, meta.get("format_version")))
+        backend = jax.default_backend().lower()
+        if (not allow_platform_mismatch
+                and not _platform_ok(backend, meta.get("platforms", []))):
+            raise MXNetError(
+                "generate artifact %r targets platform(s) %s but the "
+                "current jax backend is %r; re-export for this backend "
+                "or pass allow_platform_mismatch=True"
+                % (path, meta.get("platforms", []), backend))
+        exps = {}
+        off = 0
+        for mod in meta["modules"]:
+            blob = payload[off:off + mod["bytes"]]
+            off += mod["bytes"]
+            exps[mod["name"]] = _export.deserialize(blob)
+        missing = {"prefill", "decode", "commit"} - set(exps)
+        if missing:
+            raise MXNetError("generate artifact %r is missing module(s) "
+                             "%s" % (path, sorted(missing)))
+        prefill = CompiledModel(exps["prefill"], meta)
+        return cls(prefill, exps["decode"], exps["commit"], meta)
+
+
+def load_artifact(path, **kw):
+    """Open any ``.mxtpu`` artifact: :class:`CompiledModel` for predict
+    artifacts (format_version <= 2), :class:`GenerateModel` for generate
+    artifacts (format_version 3)."""
+    meta, _ = _read_artifact(path)
+    if meta.get("format_version", 2) >= 3 or "modules" in meta:
+        return GenerateModel.load(path, **kw)
+    return CompiledModel.load(path, **kw)

@@ -518,6 +518,21 @@ class TestHloPasses:
             "func.func public @main() {\n  return\n}\n", "ddp/step")
         assert len(none) == 1 and "not being reduced" in none[0].message
 
+    def test_decode_cache_discipline_catches_and_passes(self, lowerings):
+        # donated in-place update over the "cache" params: clean
+        assert hlo_passes.decode_cache_discipline_pass(
+            lowerings["donated"], "decode", cache_params=(0, 1)) == []
+        # same program without donation: the KV buffers round-trip
+        bad = hlo_passes.decode_cache_discipline_pass(
+            lowerings["undonated"], "decode", cache_params=(0, 1))
+        assert len(bad) == 1 and bad[0].rule == "MXL508"
+        assert "not donated" in bad[0].message
+        # host callback inside the step: a d2h per token
+        leak = hlo_passes.decode_cache_discipline_pass(
+            lowerings["callback"], "decode", cache_params=())
+        assert len(leak) == 1 and leak[0].rule == "MXL508"
+        assert "host-transfer" in leak[0].message
+
     def test_collective_overlap_report_is_per_func(self):
         # SSA names restart per func.func: a %0 in a second function must
         # not alias the first function's dataflow

@@ -1,0 +1,485 @@
+"""Continuous-batching decode engine (mxnet_tpu.serve.decode) —
+chip-free.
+
+The acceptance property: CONTINUOUS batching changes THROUGHPUT, never
+TOKENS. A ragged mix of generations scheduled together (slots refilled
+between decode steps, evictions mid-flight) must produce, per request,
+the bitwise-identical token sequence the same artifact produces serving
+that request alone — greedy and temperature>0 alike — while taking
+materially fewer decode steps than static batching, holding the decode
+loop to one d2h per step, and passing the MXL508 cache-discipline gate
+over the exact lowering being served.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import profiler, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import (DeadlineExceeded, Evicted, GenerateSession,
+                             Server, ServerBusy, serve_http)
+from mxnet_tpu.serve import decode_model as dm
+
+SPEC = dm.DecoderSpec(vocab=61, dim=32, num_heads=4, num_layers=2,
+                      max_prompt_len=8, page_size=4, max_pages_per_slot=8,
+                      max_slots=4, num_pages=33)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return dm.init_params(SPEC, seed=0)
+
+
+@pytest.fixture(scope="module")
+def art(tmp_path_factory, params):
+    path = str(tmp_path_factory.mktemp("decode") / "m.gen.mxtpu")
+    meta = serving.export_generate(params, SPEC, path)
+    assert meta["format_version"] == 3
+    return path
+
+
+@pytest.fixture(scope="module")
+def gm(art):
+    # ONE loaded GenerateModel shared by every session in this file:
+    # sessions share the model-cached compiled prefill/decode/commit, so
+    # the suite pays the compile bill once
+    return serving.load_artifact(art)
+
+
+def _ref(params, prompt, n, temperature=0.0, seed=0):
+    return list(dm.reference_generate(params, SPEC, prompt, n,
+                                      temperature=temperature, seed=seed))
+
+
+def _drive(sess, reqs, cap=400):
+    rounds = 0
+    while not all(r.done() for r in reqs) and rounds < cap:
+        sess.run_round()
+        rounds += 1
+    assert all(r.done() for r in reqs), "scheduler stalled"
+    return [r.result(timeout=1.0) for r in reqs]
+
+
+def _session(model, **kw):
+    kw.setdefault("auto_start", False)
+    kw.setdefault("timeout_ms", 0)
+    return GenerateSession(model, **kw)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bitwise parity, continuous vs sequential vs dense reference
+# ---------------------------------------------------------------------------
+
+WORK = [  # (prompt, max_new, temperature, seed) — ragged on purpose
+    ([5, 9, 13], 12, 0.0, 0),
+    ([2, 3], 3, 0.0, 0),
+    ([4, 4, 4, 4, 6, 7], 8, 0.0, 0),
+    ([7], 2, 0.0, 0),
+    ([11, 60, 1, 2, 3], 16, 0.0, 0),
+    ([8, 8, 9], 5, 0.0, 0),
+]
+
+
+def test_continuous_equals_sequential_bitwise_greedy(gm):
+    seq = _session(gm)
+    sequential = []
+    for p, n, t, s in WORK:
+        req = seq.submit(p, max_new_tokens=n, temperature=t, seed=s)
+        sequential.append(_drive(seq, [req])[0]["tokens"])
+    seq.close(drain=True)
+
+    cont = _session(gm)
+    reqs = [cont.submit(p, max_new_tokens=n, temperature=t, seed=s)
+            for p, n, t, s in WORK]
+    batched = [o["tokens"] for o in _drive(cont, reqs)]
+    cont.close(drain=True)
+    assert batched == sequential
+
+
+def test_continuous_equals_sequential_bitwise_temperature(gm):
+    work = [(p, n, 0.8, 40 + i) for i, (p, n, _, _) in enumerate(WORK)]
+    seq = _session(gm)
+    sequential = []
+    for p, n, t, s in work:
+        req = seq.submit(p, max_new_tokens=n, temperature=t, seed=s)
+        sequential.append(_drive(seq, [req])[0]["tokens"])
+    seq.close(drain=True)
+
+    cont = _session(gm)
+    reqs = [cont.submit(p, max_new_tokens=n, temperature=t, seed=s)
+            for p, n, t, s in work]
+    batched = [o["tokens"] for o in _drive(cont, reqs)]
+    cont.close(drain=True)
+    assert batched == sequential
+
+
+def test_paged_decode_matches_dense_reference(gm, params):
+    """KV-correctness oracle: the paged gather/scatter decode must equal
+    a dense full-recompute of the same weights token-for-token (greedy:
+    fp reduction-order differences cannot flip an argmax here without a
+    real indexing bug)."""
+    sess = _session(gm)
+    reqs = [sess.submit(p, max_new_tokens=n) for p, n, _, _ in WORK]
+    outs = _drive(sess, reqs)
+    sess.close(drain=True)
+    for (p, n, _, _), o in zip(WORK, outs):
+        assert o["tokens"] == _ref(params, p, n)
+
+
+def test_result_reports_latency_metrics(gm):
+    sess = _session(gm)
+    out = _drive(sess, [sess.submit([5, 9, 13], max_new_tokens=4)])[0]
+    sess.close(drain=True)
+    assert out["finish_reason"] == "length"
+    assert out["ttft_ms"] is not None and out["ttft_ms"] >= 0
+    assert out["tpot_ms"] is not None and out["tpot_ms"] >= 0
+    assert out["latency_ms"] >= out["ttft_ms"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler: eviction, backpressure, bounded drain
+# ---------------------------------------------------------------------------
+
+def test_mid_decode_eviction_frees_pages_admits_queued_and_leaves_survivors_bitwise(gm, params):
+    sess = _session(gm)
+    free0 = sess.cache.free_pages
+    prompts = [[5, 9, 13], [2, 3], [4, 4, 4], [7, 8]]
+    reqs = [sess.submit(p, max_new_tokens=12) for p in prompts]
+    queued = sess.submit([11, 60, 1], max_new_tokens=12)
+    sess.run_round()          # admit 4, queued waits on a slot
+    sess.run_round()
+    assert sum(s is not None for s in sess._slots) == 4
+    victim_pages = next(s.pages for s in sess._slots
+                        if s is not None and s.req is reqs[0])
+    held = sess.cache.free_pages
+    # force a deadline expiry on the first request, mid-decode
+    reqs[0].deadline = time.monotonic() - 1.0
+    sess.run_round()          # evict victim, admit `queued` SAME round
+
+    with pytest.raises(Evicted) as ei:
+        reqs[0].result(timeout=0.1)
+    exc = ei.value
+    assert exc.tokens and exc.tokens == _ref(params, prompts[0],
+                                             12)[:len(exc.tokens)]
+    assert exc.cursor["resume_prompt"] == prompts[0] + exc.tokens
+    assert exc.retry_after > 0
+    # the victim's pages cycled straight into the admitted request
+    assert queued in [s.req for s in sess._slots if s is not None]
+    newly_held = [s.pages for s in sess._slots
+                  if s is not None and s.req is queued][0]
+    assert set(victim_pages) & set(newly_held)
+    assert sess.cache.free_pages >= held  # nothing leaked
+    outs = _drive(sess, reqs[1:] + [queued])
+    sess.close(drain=True)
+    # survivors and the late admission: bitwise equal to solo runs
+    for p, o in zip(prompts[1:] + [[11, 60, 1]], outs):
+        assert o["tokens"] == _ref(params, p, 12)
+    assert sess.cache.free_pages == free0
+    snap = sess.metrics_.snapshot()
+    assert snap["requests"]["evicted"] == 1
+    assert snap["requests"]["expired"] == 1
+
+
+def test_page_backpressure_holds_admission_until_pages_free(tmp_path,
+                                                            params):
+    # same geometry, starved page pool: 6 allocatable pages, so two
+    # 3-page requests exhaust it with slots to spare
+    tight = SPEC._replace(num_pages=7, max_pages_per_slot=3)
+    path = str(tmp_path / "tight.gen.mxtpu")
+    serving.export_generate(params, tight, path)
+    sess = _session(path)
+    reqs = [sess.submit([5, 9], max_new_tokens=10) for _ in range(3)]
+    sess.run_round()
+    # only two fit page-wise, despite 4 slots
+    assert sum(s is not None for s in sess._slots) == 2
+    assert sess.cache.free_pages == 0
+    outs = _drive(sess, reqs)
+    sess.close(drain=True)
+    ref = list(dm.reference_generate(params, tight, [5, 9], 10))
+    assert [o["tokens"] for o in outs] == [ref] * 3
+
+
+def test_bounded_drain_evicts_past_budget_with_resumable_cursor(gm,
+                                                                params):
+    sess = _session(gm, drain_tokens=2)
+    prompt = [5, 9, 13]
+    full = _ref(params, prompt, 10)
+    req = sess.submit(prompt, max_new_tokens=10)
+    sess.run_round()          # prefill (token 1) + decode step (token 2)
+    sess.run_round()          # decode: token 3
+    sess.close(drain=True)    # inline bounded drain: at most 2 more
+    with pytest.raises(Evicted) as ei:
+        req.result(timeout=0.1)
+    exc = ei.value
+    assert exc.tokens == full[:5]          # 3 pre-drain + 2 budget
+    cursor = exc.cursor
+    assert cursor["resume_prompt"] == prompt + exc.tokens
+    assert cursor["remaining_tokens"] == 5
+    # the cursor actually resumes: greedy continuation equals the tail
+    # of the uninterrupted generation (position-keyed sampling)
+    sess2 = _session(gm)
+    out = _drive(sess2, [sess2.submit(cursor["resume_prompt"],
+                                      max_new_tokens=5)])[0]
+    sess2.close(drain=True)
+    assert exc.tokens + out["tokens"] == full
+
+
+def test_drain_evicts_queued_requests_with_empty_cursor(gm):
+    sess = _session(gm)
+    active = sess.submit([5, 9], max_new_tokens=4)
+    sess.run_round()
+    queued = sess.submit([2, 3], max_new_tokens=4)   # never prefilled
+    sess.close(drain=True)
+    assert active.result(timeout=0.1)["finish_reason"] == "length"
+    with pytest.raises(Evicted) as ei:
+        queued.result(timeout=0.1)
+    assert ei.value.tokens == []
+    assert ei.value.cursor["resume_prompt"] == [2, 3]
+
+
+def test_queue_depth_rejects_with_cost_model_retry_after(gm):
+    sess = _session(gm, queue_depth=2)
+    for _ in range(2):
+        sess.submit([5], max_new_tokens=4)
+    with pytest.raises(ServerBusy) as ei:
+        sess.submit([5], max_new_tokens=4)
+    assert ei.value.retry_after > 0
+    sess.close(drain=False)
+
+
+def test_eos_stops_generation_early(tmp_path, params):
+    base = _ref(params, [5, 9, 13], 6)
+    eos_spec = SPEC._replace(eos_id=int(base[2]))
+    path = str(tmp_path / "eos.gen.mxtpu")
+    serving.export_generate(params, eos_spec, path)
+    sess = _session(path)
+    out = _drive(sess, [sess.submit([5, 9, 13], max_new_tokens=6)])[0]
+    sess.close(drain=True)
+    assert out["finish_reason"] == "stop"
+    assert out["tokens"] == base[:3]
+
+
+def test_prompt_and_budget_validation(gm):
+    sess = _session(gm)
+    with pytest.raises(MXNetError):
+        sess.submit([], max_new_tokens=2)
+    with pytest.raises(MXNetError):
+        sess.submit(list(range(SPEC.max_prompt_len + 1)), max_new_tokens=2)
+    with pytest.raises(MXNetError):
+        sess.submit([5], max_new_tokens=SPEC.max_context)
+    sess.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# throughput: continuous must beat static on ragged work (deterministic)
+# ---------------------------------------------------------------------------
+
+def test_continuous_takes_at_least_2x_fewer_decode_steps_than_static(gm):
+    """The deterministic, load-independent form of the >=2x goodput
+    claim: on a mostly-short/one-long ragged workload, static batching
+    (a group runs to its last straggler) dispatches >= 2x the compiled
+    decode steps continuous batching does for the SAME tokens."""
+    rng = np.random.RandomState(0)
+    work = []
+    for _ in range(3):                      # 3 groups of max_slots
+        for j in range(SPEC.max_slots):
+            plen = int(rng.randint(2, SPEC.max_prompt_len + 1))
+            prompt = rng.randint(2, SPEC.vocab, size=plen).tolist()
+            work.append((prompt, 24 if j == SPEC.max_slots - 1 else 2))
+
+    def steps(continuous):
+        sess = _session(gm, continuous=continuous, queue_depth=64)
+        reqs = [sess.submit(p, max_new_tokens=n) for p, n in work]
+        outs = _drive(sess, reqs)
+        sess._publish_window(force=True)
+        n_steps = sess.metrics_.snapshot()["decode_steps"]
+        sess.close(drain=True)
+        return n_steps, [o["tokens"] for o in outs]
+
+    s_static, toks_static = steps(False)
+    s_cont, toks_cont = steps(True)
+    assert toks_cont == toks_static          # scheduling never changes tokens
+    assert s_static >= 2 * s_cont, (s_static, s_cont)
+
+
+# ---------------------------------------------------------------------------
+# discipline: sync budget + MXL508 chip-free gate
+# ---------------------------------------------------------------------------
+
+def test_decode_loop_sync_budget_one_d2h_per_step_and_prefill(gm):
+    sess = _session(gm)                     # warmup happens in init
+    profiler.reset_sync_counters()
+    reqs = [sess.submit(p, max_new_tokens=n) for p, n, _, _ in WORK[:4]]
+    _drive(sess, reqs)
+    d2h = profiler.sync_counters()["d2h"]
+    prefills = sess.metrics_.prefill_batches
+    sess._publish_window(force=True)
+    steps = sess.metrics_.snapshot()["decode_steps"]
+    assert prefills >= 1 and steps >= 1
+    # exactly one fetch per decode step (the sampled tokens) plus one
+    # per prefill group (the first tokens) — nothing else syncs
+    assert d2h == steps + prefills, (d2h, steps, prefills)
+    # the telemetry window publish adds ZERO device transfers
+    profiler.reset_sync_counters()
+    sess._win_steps = 1
+    sess._publish_window(force=True)
+    assert profiler.sync_counters()["d2h"] == 0
+    sess.close(drain=True)
+
+
+def test_mxl508_gate_clean_on_served_decode_step(gm):
+    sess = _session(gm)
+    assert sess.check_discipline() == []
+    text = sess.decode_lowered_text()
+    sess.close(drain=False)
+    # donated cache params are visible in the exact served lowering
+    from mxnet_tpu import hlo_stats
+    entry = hlo_stats.entry_params(text)
+    assert entry[5]["donated"] and entry[6]["donated"]
+
+
+def test_mxl508_flags_undonated_cache_and_host_transfers(gm):
+    import jax
+    from mxnet_tpu.analysis import hlo_passes
+    sess = _session(gm)
+    spec = sess.spec
+    S, MP = spec.max_slots, spec.max_pages_per_slot
+    pages = jax.ShapeDtypeStruct(
+        (spec.num_layers, spec.cache_rows, spec.dim), np.float32)
+    args = (jax.ShapeDtypeStruct((S, 1), np.int32),
+            jax.ShapeDtypeStruct((S,), np.int32),
+            jax.ShapeDtypeStruct((S, MP), np.int32),
+            jax.ShapeDtypeStruct((S,), np.float32),
+            jax.ShapeDtypeStruct((S,), np.int32), pages, pages)
+    undonated = jax.jit(sess.model.decode_exp.call).lower(
+        *args).as_text()
+    sess.close(drain=False)
+    diags = hlo_passes.decode_cache_discipline_pass(
+        undonated, "decode_step", cache_params=(5, 6))
+    assert len(diags) == 1 and diags[0].rule == "MXL508"
+    assert "not donated" in diags[0].message
+
+    def leaky(w):
+        jax.debug.callback(lambda v: None, w.sum())
+        return w * 2
+    text = jax.jit(leaky).lower(np.ones(4, np.float32)).as_text()
+    diags = hlo_passes.decode_cache_discipline_pass(
+        text, "leaky", cache_params=())
+    assert len(diags) == 1 and "host-transfer" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# artifact format + loading
+# ---------------------------------------------------------------------------
+
+def test_artifact_round_trip_and_version_dispatch(art, tmp_path):
+    m = serving.load_artifact(art)
+    assert isinstance(m, serving.GenerateModel)
+    assert m.meta["format_version"] == 3
+    assert sorted(mod["name"] for mod in m.meta["modules"]) == \
+        ["commit", "decode", "prefill"]
+    assert m.spec == SPEC
+    # v3 through the v2 loader: a pointed error, not garbage
+    with pytest.raises(MXNetError, match="Generate"):
+        serving.CompiledModel.load(art)
+    # corrupted magic
+    bad = tmp_path / "bad.mxtpu"
+    bad.write_bytes(b"NOTMAGIC" + b"\x00" * 64)
+    with pytest.raises(MXNetError):
+        serving.load_artifact(str(bad))
+
+
+def test_telemetry_registry_carries_decode_series(gm):
+    from mxnet_tpu import telemetry
+    sess = _session(gm)
+    _drive(sess, [sess.submit([5, 9], max_new_tokens=4)])
+    sess._publish_window(force=True)
+    sess.close(drain=True)
+    snap = telemetry.snapshot()
+    for name in ("decode/tokens_per_s", "decode/kv_page_occupancy",
+                 "decode/active_slots", "decode/evictions"):
+        assert name in snap, name
+
+
+# ---------------------------------------------------------------------------
+# server + HTTP + loadgen integration
+# ---------------------------------------------------------------------------
+
+def test_server_autodetects_generate_artifact(gm, params):
+    srv = Server(gm)
+    try:
+        assert srv.mode == "generate"
+        out = srv.generate([5, 9, 13], max_new_tokens=6)
+        assert out["tokens"] == _ref(params, [5, 9, 13], 6)
+        with pytest.raises(MXNetError, match="generate artifact"):
+            srv.submit(data=np.zeros((1, 4), np.float32))
+        m = srv.metrics()
+        assert m["mode"] == "generate"
+        assert m["slots"]["max"] == SPEC.max_slots
+        assert m["kv_pages"]["total"] == SPEC.num_pages - 1
+    finally:
+        srv.close(drain=True)
+    assert srv.closed
+
+
+def test_http_generate_round_trip_and_errors(gm, params):
+    srv = Server(gm)
+    front = serve_http(srv, port=0)
+    url = front.address
+    try:
+        body = json.dumps({"prompt": [5, 9, 13],
+                           "max_new_tokens": 6}).encode()
+        req = urllib.request.Request(
+            url + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read().decode())
+        assert out["tokens"] == _ref(params, [5, 9, 13], 6)
+        assert out["finish_reason"] == "length"
+        assert out["ttft_ms"] >= 0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/v1/generate", data=b"{}",
+                headers={"Content-Type": "application/json"}),
+                timeout=10)
+        assert ei.value.code == 400
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            snap = json.loads(r.read().decode())
+        assert snap["mode"] == "generate"
+    finally:
+        front.stop(drain=True)
+
+
+def test_loadgen_generate_mode_accounting(gm):
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    loadgen = importlib.import_module("serve_loadgen")
+    srv = Server(gm)
+    try:
+        res = loadgen.measure_generate(srv, users=3, requests=9,
+                                       prompt_len=3, max_new=5, seed=2)
+    finally:
+        srv.close(drain=True)
+    assert res["completed"] == 9
+    assert res["evicted"] == res["rejected"] == res["errors"] == 0
+    assert res["tokens_completed"] > 0
+    assert res["tokens_per_s_goodput"] > 0
+    assert res["ttft_ms"]["p50"] is not None
+    assert res["server_metrics"]["requests"]["completed"] >= 9
+
+
+def test_gluon_converter_matches_decode_model_structure(params):
+    """params_from_gluon pulls weights off the example GPT; the family
+    contract is that the extracted dict drops into make_prefill/decode.
+    Structure check only (example import is heavyweight)."""
+    names = set(dm._param_names(SPEC))
+    assert set(params) == names
+    for k, v in params.items():
+        assert v.dtype == np.float32, k

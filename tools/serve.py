@@ -6,9 +6,15 @@ micro-batching and admission control.
         [--timeout-ms 1000] [--no-warmup] [--verbose]
 
 Endpoints (see mxnet_tpu/serve/http.py):
-    POST /v1/predict   {"inputs": {"data": [[...]]}}
+    POST /v1/predict   {"inputs": {"data": [[...]]}}     (predict mode)
+    POST /v1/generate  {"prompt": [ids], ...}            (generate mode)
     GET  /metrics      per-bucket p50/p95/p99, occupancy, padding waste
+                       (generate mode: tokens/s, TTFT/TPOT, page occ.)
     GET  /healthz
+
+The artifact kind picks the mode: a format_version-3 generate artifact
+(serving.export_generate) starts the continuous-batching decode engine;
+anything else starts the predict micro-batcher.
 
 SIGINT/SIGTERM triggers a graceful drain: the listener stops accepting,
 every admitted request finishes, then the final metrics snapshot is
@@ -39,6 +45,13 @@ def main():
     p.add_argument("--queue-depth", type=int, default=None)
     p.add_argument("--timeout-ms", type=float, default=None)
     p.add_argument("--cache-engines", type=int, default=None)
+    p.add_argument("--drain-tokens", type=int, default=None,
+                   help="generate mode: per-sequence token budget a "
+                        "graceful drain grants before eviction "
+                        "(default MXNET_SERVE_DRAIN_TOKENS)")
+    p.add_argument("--max-new-tokens", type=int, default=64,
+                   help="generate mode: default completion budget when "
+                        "the request does not set one")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--platform", default=None, choices=[None, "cpu"],
                    help="pin jax to this backend before loading")
@@ -49,19 +62,38 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
 
-    from mxnet_tpu.serve import ServeConfig, Server, serve_http
+    from mxnet_tpu.serve import (GenerateConfig, ServeConfig, Server,
+                                 serve_http)
+    from mxnet_tpu.serving import GenerateModel, load_artifact
 
-    cfg = ServeConfig(
-        buckets=args.buckets,
-        batch_timeout_ms=args.batch_timeout_ms,
-        queue_depth=args.queue_depth,
-        timeout_ms=args.timeout_ms,
-        cache_engines=args.cache_engines,
-        warmup=False if args.no_warmup else None)
-    server = Server(args.artifact, config=cfg)
+    model = load_artifact(args.artifact)
+    if isinstance(model, GenerateModel):
+        cfg = GenerateConfig(
+            queue_depth=args.queue_depth,
+            timeout_ms=args.timeout_ms,
+            drain_tokens=args.drain_tokens,
+            max_new_tokens=args.max_new_tokens,
+            warmup=False if args.no_warmup else None)
+    else:
+        cfg = ServeConfig(
+            buckets=args.buckets,
+            batch_timeout_ms=args.batch_timeout_ms,
+            queue_depth=args.queue_depth,
+            timeout_ms=args.timeout_ms,
+            cache_engines=args.cache_engines,
+            warmup=False if args.no_warmup else None)
+    server = Server(model, config=cfg)
     front = serve_http(server, args.host, args.port, verbose=args.verbose)
-    print(json.dumps({"serving": args.artifact, "url": front.address,
-                      "buckets": list(server.buckets)}), flush=True)
+    banner = {"serving": args.artifact, "mode": server.mode,
+              "url": front.address}
+    if server.mode == "generate":
+        spec = server.session.spec
+        banner["slots"] = spec.max_slots
+        banner["kv_pages"] = server.session.cache.total_pages
+        banner["page_size"] = spec.page_size
+    else:
+        banner["buckets"] = list(server.buckets)
+    print(json.dumps(banner), flush=True)
 
     done = threading.Event()
 

@@ -15,6 +15,11 @@ Importable: ``measure(target, ...)`` where ``target`` is a Server, an
 artifact path, a URL, or a zero-arg callable returning the current
 Server (the hook the graceful-restart soak test uses to re-point
 workers at a replacement server mid-run).
+
+``--generate`` switches to the generation workload (generate-mode
+artifacts): closed-loop users with per-request prompt/output lengths
+drawn from fixed/uniform/longtail distributions, reporting TTFT/TPOT
+percentiles and tokens/s goodput. Importable as ``measure_generate``.
 """
 from __future__ import annotations
 
@@ -230,6 +235,229 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
     return out
 
 
+def _sample_lengths(rng, n, mean, dist, lo, hi):
+    """Length distributions for generation workloads. ``longtail`` is
+    the shape that makes continuous batching matter: mostly-short with a
+    geometric tail out to ``hi`` — a static batch runs at the pace of
+    its longest member, a continuous one refills the short finishers."""
+    import numpy as np
+    mean = max(lo, min(mean, hi))
+    if dist == "fixed":
+        vals = np.full(n, mean)
+    elif dist == "uniform":
+        vals = rng.randint(lo, hi + 1, size=n)
+    else:   # longtail (geometric)
+        p_geo = min(0.95, 1.0 / max(1.0, mean - lo + 1))
+        vals = lo + rng.geometric(p=p_geo, size=n) - 1
+    return np.clip(vals, lo, hi).astype(int)
+
+
+def _http_generate(url, payload, timeout_s):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return "ok", json.loads(r.read().decode()), None
+    except urllib.error.HTTPError as e:
+        retry = float(e.headers.get("Retry-After", 0.05))
+        if e.code == 429:
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                body = {}
+            # an eviction carries partial tokens + a resumable cursor;
+            # a plain 429 is an admission reject
+            kind = "evicted" if body.get("cursor") else "rejected"
+            return kind, body, retry
+        if e.code == 504:
+            return "expired", None, None
+        if e.code == 503:
+            return "closed", None, retry
+        return "error", None, None
+    except Exception:
+        return "error", None, None
+
+
+def measure_generate(target, users=4, requests=64, prompt_len=8,
+                     prompt_dist="longtail", max_new=16,
+                     output_dist="longtail", temperature=0.0,
+                     timeout_ms=None, retries=0, seed=0, vocab=None,
+                     max_prompt_len=None, max_context=None):
+    """Closed-loop generation benchmark: ``users`` workers, each
+    submitting its next prompt the moment the previous completion lands.
+    Prompt/output lengths are drawn per-request from the configured
+    distributions. Reports TTFT/TPOT percentiles and tokens/s goodput
+    (completed requests' tokens over wall time) — the serving numbers
+    that actually matter for autoregressive decode.
+
+    ``target``: a generate-mode Server, a GenerateSession, an artifact
+    path, or an ``http://`` URL of a running generate server. HTTP mode
+    needs ``vocab``/``max_prompt_len``/``max_context`` since the spec is
+    not visible through the wire.
+    """
+    import numpy as np
+
+    is_url = isinstance(target, str) and target.startswith("http")
+    session = None
+    if not is_url:
+        from mxnet_tpu.serve import GenerateSession, Server
+        if isinstance(target, str):
+            target = Server(target)
+        if isinstance(target, Server):
+            session = target.session
+            if session is None:
+                raise ValueError("measure_generate needs a generate-mode "
+                                 "server (a format_version-3 artifact)")
+        elif isinstance(target, GenerateSession):
+            session = target
+        else:
+            raise ValueError("unsupported generate target %r" % (target,))
+        spec = session.spec
+        vocab = spec.vocab
+        max_prompt_len = spec.max_prompt_len
+        max_context = spec.max_context
+    else:
+        if not (vocab and max_prompt_len and max_context):
+            raise ValueError("HTTP generate mode needs --vocab, "
+                             "--max-prompt-len and --max-context")
+
+    rng = np.random.RandomState(seed)
+    plens = _sample_lengths(rng, requests, prompt_len, prompt_dist,
+                            1, max_prompt_len)
+    olens = _sample_lengths(rng, requests, max_new, output_dist, 1,
+                            max(1, max_context - int(plens.max())))
+    olens = np.minimum(olens, max_context - plens)
+    prompts = [rng.randint(2, max(3, vocab), size=int(plens[i])).tolist()
+               for i in range(requests)]
+
+    counters = {"completed": 0, "evicted": 0, "rejected": 0,
+                "expired": 0, "errors": 0}
+    ttfts, tpots, latencies = [], [], []
+    tokens_ok = [0]
+    tokens_partial = [0]
+    lock = threading.Lock()
+    next_idx = [0]
+
+    def worker(wid):
+        from mxnet_tpu.serve import (DeadlineExceeded, Evicted,
+                                     ServerBusy, ServerClosed)
+        while True:
+            with lock:
+                if next_idx[0] >= requests:
+                    return
+                i = next_idx[0]
+                next_idx[0] += 1
+            t0 = time.monotonic()
+            outcome, out = "error", None
+            for attempt in range(retries + 1):
+                if is_url:
+                    payload = {"prompt": prompts[i],
+                               "max_new_tokens": int(olens[i]),
+                               "temperature": temperature,
+                               "seed": int(seed + i)}
+                    if timeout_ms:
+                        payload["timeout_ms"] = timeout_ms
+                    outcome, out, retry_after = _http_generate(
+                        target, payload,
+                        timeout_s=(timeout_ms or 60000) / 1e3 + 30)
+                    if outcome in ("rejected", "closed") \
+                            and attempt < retries:
+                        time.sleep(retry_after or 0.05)
+                        continue
+                    break
+                try:
+                    out = session.generate(
+                        prompts[i], max_new_tokens=int(olens[i]),
+                        temperature=temperature, seed=int(seed + i),
+                        timeout_ms=timeout_ms)
+                    outcome = "ok"
+                    break
+                except Evicted as e:
+                    outcome, out = "evicted", {"tokens": e.tokens}
+                    break
+                except ServerBusy as e:
+                    outcome = "rejected"
+                    if attempt < retries:
+                        time.sleep(e.retry_after)
+                        continue
+                    break
+                except (ServerClosed,) :
+                    outcome = "closed"
+                    if attempt < retries:
+                        time.sleep(0.05)
+                        continue
+                    break
+                except DeadlineExceeded:
+                    outcome = "expired"
+                    break
+                except Exception:
+                    outcome = "error"
+                    break
+            dt_ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                if outcome == "ok":
+                    counters["completed"] += 1
+                    latencies.append(dt_ms)
+                    tokens_ok[0] += len(out.get("tokens", []))
+                    if out.get("ttft_ms") is not None:
+                        ttfts.append(out["ttft_ms"])
+                    if out.get("tpot_ms") is not None:
+                        tpots.append(out["tpot_ms"])
+                elif outcome == "evicted":
+                    counters["evicted"] += 1
+                    tokens_partial[0] += len((out or {}).get("tokens", []))
+                elif outcome in ("rejected", "closed"):
+                    counters["rejected"] += 1
+                elif outcome == "expired":
+                    counters["expired"] += 1
+                else:
+                    counters["errors"] += 1
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(users)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t_start
+
+    from mxnet_tpu.serve import percentile
+
+    def _pct(xs):
+        return {"p50": percentile(xs, 50), "p95": percentile(xs, 95),
+                "p99": percentile(xs, 99),
+                "mean": (sum(xs) / len(xs)) if xs else None}
+
+    out = {
+        "attempted": requests,
+        **counters,
+        "users": users,
+        "wall_s": round(wall_s, 3),
+        "tokens_completed": tokens_ok[0],
+        "tokens_evicted_partial": tokens_partial[0],
+        "tokens_per_s_goodput": round(tokens_ok[0] / wall_s, 2)
+                                if wall_s > 0 else None,
+        "prompt_len": {"dist": prompt_dist, "mean": float(plens.mean()),
+                       "max": int(plens.max())},
+        "output_len": {"dist": output_dist, "mean": float(olens.mean()),
+                       "max": int(olens.max())},
+        "ttft_ms": _pct(ttfts),
+        "tpot_ms": _pct(tpots),
+        "latency_ms": _pct(latencies),
+    }
+    if session is not None:
+        try:
+            out["server_metrics"] = session.metrics()
+        except Exception:
+            pass
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     g = p.add_mutually_exclusive_group(required=True)
@@ -247,6 +475,24 @@ def main():
     p.add_argument("--timeout-ms", type=float, default=None)
     p.add_argument("--retries", type=int, default=0)
     p.add_argument("--buckets", default=None)
+    p.add_argument("--generate", action="store_true",
+                   help="generation workload (generate-mode artifact / "
+                        "server): closed-loop users, sampled prompt/"
+                        "output lengths, TTFT/TPOT + tokens/s goodput")
+    p.add_argument("--prompt-len", type=int, default=8,
+                   help="mean prompt length (--generate)")
+    p.add_argument("--prompt-dist", default="longtail",
+                   choices=["fixed", "uniform", "longtail"])
+    p.add_argument("--max-new", type=int, default=16,
+                   help="mean output length (--generate)")
+    p.add_argument("--output-dist", default="longtail",
+                   choices=["fixed", "uniform", "longtail"])
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--vocab", type=int, default=None,
+                   help="HTTP --generate mode: the model's vocab size")
+    p.add_argument("--max-prompt-len", type=int, default=None)
+    p.add_argument("--max-context", type=int, default=None)
     p.add_argument("--platform", default=None, choices=[None, "cpu"])
     p.add_argument("--out", default=None, help="also write JSON here")
     p.add_argument("--scrape-metrics", action="store_true",
@@ -267,13 +513,26 @@ def main():
             if args.shape else None
     else:
         from mxnet_tpu.serve import Server
-        target = Server(args.artifact, buckets=args.buckets)
+        if args.generate:
+            target = Server(args.artifact)
+        else:
+            target = Server(args.artifact, buckets=args.buckets)
         shape = None
 
-    res = measure(target, concurrency=args.concurrency,
-                  requests=args.requests, qps=args.qps, rows=args.rows,
-                  timeout_ms=args.timeout_ms, shape=shape,
-                  retries=args.retries)
+    if args.generate:
+        res = measure_generate(
+            target, users=args.concurrency, requests=args.requests,
+            prompt_len=args.prompt_len, prompt_dist=args.prompt_dist,
+            max_new=args.max_new, output_dist=args.output_dist,
+            temperature=args.temperature, timeout_ms=args.timeout_ms,
+            retries=args.retries, seed=args.seed, vocab=args.vocab,
+            max_prompt_len=args.max_prompt_len,
+            max_context=args.max_context)
+    else:
+        res = measure(target, concurrency=args.concurrency,
+                      requests=args.requests, qps=args.qps, rows=args.rows,
+                      timeout_ms=args.timeout_ms, shape=shape,
+                      retries=args.retries)
     if not args.url:
         target.close(drain=True)
     if args.scrape_metrics:
