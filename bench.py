@@ -319,12 +319,106 @@ def _serving_leg(on_tpu):
                 "completed": res["completed"],
                 "errors": res["errors"],
             }
+        # int8 leg: quantize the SAME model (format_version 4 artifact),
+        # serve it side-by-side with the f32 engines through the
+        # dtype-routed bucket cache, and gate each bucket's top-1 delta
+        # on flags.quant_accuracy_budget (BENCH_QUANT=0 skips)
+        if os.environ.get("BENCH_QUANT", "1") == "1":
+            try:
+                leg["quant"] = _quant_serving_leg(
+                    art, sym, args, aux, side, buckets, reqs_per_bucket)
+            except Exception as e:
+                leg["quant"] = "failed: %s" % e
     finally:
         try:
             os.unlink(art)
         except OSError:
             pass
     return leg
+
+
+def _quant_serving_leg(f32_art, sym, args, aux, side, buckets,
+                       reqs_per_bucket):
+    """Int8 post-training quantization leg of the serving benchmark.
+
+    Calibrates on deterministic synthetic batches, freezes a
+    ``format_version`` 4 artifact (tools/quantize_model.py is the same
+    path as a CLI), then for every bucket runs ONE server holding the
+    f32 and int8 engines side-by-side: the loadgen drives the int8
+    engines (``dtype="int8"``), and the accuracy probe replays an
+    identical probe set through BOTH engine families at the bucket's
+    batch size so the reported top-1 delta is per-bucket (it sees that
+    bucket's padding). The probe numbers are already host-side, so the
+    ``quant/accuracy_delta`` gauge costs zero extra device syncs."""
+    import tempfile
+    import numpy as np
+    from mxnet_tpu import quant, telemetry as _telemetry
+    from mxnet_tpu.config import flags as _flags
+    from mxnet_tpu.serve import Server
+    from tools.serve_loadgen import measure, measure_accuracy
+
+    rng = np.random.RandomState(1)
+    calib = [{"data": rng.randn(8, 3, side, side).astype("f4")}
+             for _ in range(4)]
+    q_art = tempfile.mktemp(suffix=".int8.mxtpu")
+    t0 = time.perf_counter()
+    meta = quant.export_quantized(sym, args, aux, calib,
+                                  {"data": (None, 3, side, side)}, q_art)
+    rep = meta["quant"]
+    wb = rep["weight_bytes"]
+    out = {"export_s": round(time.perf_counter() - t0, 2),
+           "artifact_bytes_f32": os.path.getsize(f32_art),
+           "artifact_bytes_int8": os.path.getsize(q_art),
+           "weight_payload_ratio": round(wb["int8"] / float(wb["f32"]), 3)
+           if wb["f32"] else None,
+           "sites": len(rep["sites"]),
+           "skipped": len(rep["skipped"]),
+           "calibration_fingerprint": rep["calibration"]["fingerprint"],
+           "accuracy_budget": float(_flags.quant_accuracy_budget),
+           "buckets": {}}
+    gauge = _telemetry.gauge(
+        "quant/accuracy_delta",
+        "top-1 accuracy delta (f32 - int8) of the quantized serving "
+        "engines on the bench probe set, labelled by bucket")
+    try:
+        for b in buckets:
+            srv = Server(f32_art, quantized=q_art, buckets=(b,),
+                         batch_timeout_ms=2)
+            t1 = time.perf_counter()
+            srv.model.engine_cache.engine(b, dtype="int8")
+            compile_s = time.perf_counter() - t1
+            # the probe replays through BOTH engine families; build the
+            # f32 sibling up front too so compiles stay out of every
+            # latency number
+            srv.model.engine_cache.engine(b, dtype="f32")
+            res = measure(srv, concurrency=b,
+                          requests=reqs_per_bucket * b,
+                          timeout_ms=600000, dtype="int8")
+            probe = measure_accuracy(srv, srv, examples=4 * b, batch=b)
+            snap = (srv.metrics().get("buckets_by_dtype", {})
+                    .get("int8", {}).get(str(b), {}))
+            srv.close(drain=True)
+            delta = probe["top1_delta"]
+            gauge.set(delta, bucket=str(b))
+            out["buckets"][str(b)] = {
+                "p50_ms": round(res["latency_ms"]["p50"], 2),
+                "p99_ms": round(res["latency_ms"]["p99"], 2),
+                "goodput_qps": res["goodput_qps"],
+                "padding_waste": snap.get("padding_waste"),
+                "batches": snap.get("batches"),
+                "engine_compile_s": round(compile_s, 2),
+                "completed": res["completed"],
+                "errors": res["errors"],
+                "top1_delta": delta,
+                "agreement": probe["agreement"],
+                "accuracy_ok": delta <= float(_flags.quant_accuracy_budget),
+            }
+    finally:
+        try:
+            os.unlink(q_art)
+        except OSError:
+            pass
+    return out
 
 
 def _make_rec(n_images, side, path="/tmp/mxtpu_bench_%d_%d.rec"):

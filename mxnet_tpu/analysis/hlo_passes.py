@@ -24,7 +24,8 @@ __all__ = [
     "HLO_RULES", "convert_budget_pass", "donation_coverage_pass",
     "d2h_transfer_pass", "fusion_bytes_pass", "RecompileFingerprint",
     "collective_interleave_pass", "collective_overlap_report",
-    "decode_cache_discipline_pass", "metrics_from_text",
+    "decode_cache_discipline_pass", "quant_dequant_budget_pass",
+    "metrics_from_text",
 ]
 
 HLO_RULES = {r.id: r for r in [
@@ -55,6 +56,15 @@ HLO_RULES = {r.id: r for r in [
          "is copied every token, doubling HBM and killing tokens/s) and "
          "contain zero device->host ops (fetch only the sampled tokens, "
          "outside the program; see docs/serving.md continuous batching)"),
+    Rule("MXL509", "hlo-quant-dequant-budget", "error",
+         "a program labelled int8-quantized must actually compute in "
+         "int8: every eligible dot/conv carries int8 operands with an "
+         "i32 accumulator, and int8 WEIGHTS are never upcast to f32 "
+         "outside the budgeted dequant epilogue (an i8->f32 convert "
+         "feeding a matmul means XLA is doing f32 math on dequantized "
+         "weights — the artifact shrank but the MXU speedup is gone; "
+         "re-quantize with tools/quantize_model.py, see "
+         "docs/quantization.md)"),
     Rule("MXL507", "hlo-collective-interleave", "error",
          "the DDP step's gradient all-reduces must stay few (one fused "
          "collective per bucket — more means the GradReducer plan "
@@ -214,6 +224,50 @@ def decode_cache_discipline_pass(text, label, cache_params,
             "%d host-transfer op(s) inside the decode step (budget %d) "
             "— every one is a device sync per generated token"
             % (n, d2h_budget)))
+    return diags
+
+
+def quant_dequant_budget_pass(text, label, min_int8_ops=1,
+                              upcast_budget=0):
+    """MXL509: the int8 serving-graph discipline over lowered text.
+
+    Two checks on a program CLAIMING to be quantized (a format_version-4
+    artifact's module, or any jit labelled int8):
+
+    * at least ``min_int8_ops`` dot/conv ops compute with an int32
+      accumulator (int8 x int8 -> i32 is how the quantized ops lower;
+      zero of them means the "quantized" graph is still doing f32 math);
+    * at most ``upcast_budget`` ``i8->f32`` converts. The fused dequant
+      epilogue converts the i32 ACCUMULATOR to f32 — that pair is
+      ``i32->f32`` and is free — so any ``i8->f32`` is an int8 weight or
+      activation being upcast for f32 compute, exactly the regression
+      this budget ratchets against (MXL501 idiom: the budget only comes
+      down).
+
+    Chip-free like every Layer-2 pass; feed it
+    ``jax.jit(model._exp.call).lower(x).as_text()``.
+    """
+    stats = hlo_stats.analyze_stablehlo(text)
+    int8_ops = (stats.get("dot_general", {}).get("i32", 0)
+                + stats.get("convolution", {}).get("i32", 0))
+    diags = []
+    if int8_ops < min_int8_ops:
+        diags.append(_diag(
+            "MXL509", label,
+            "%d int8-accumulating dot/conv op(s) (floor %d) in a "
+            "program labelled quantized — result types seen: dot %s, "
+            "conv %s" % (int8_ops, min_int8_ops,
+                         dict(stats.get("dot_general", {})),
+                         dict(stats.get("convolution", {})))))
+    upcasts = stats.get("convert_pairs", {}).get("i8->f32", 0)
+    if upcasts > upcast_budget:
+        diags.append(_diag(
+            "MXL509", label,
+            "%d i8->f32 convert(s) (budget %d): int8 weights are being "
+            "dequantized OUTSIDE the fused epilogue and fed to f32 "
+            "compute; convert pairs: %s"
+            % (upcasts, upcast_budget,
+               dict(stats.get("convert_pairs", {})))))
     return diags
 
 

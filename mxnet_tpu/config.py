@@ -368,6 +368,14 @@ register_flag("serve_decode_window", "MXNET_SERVE_DECODE_WINDOW", int, 16,
               "kv_page_occupancy, active_slots and eviction counts every "
               "this many decode steps — all from host-held scheduler "
               "state, zero extra device->host transfers.")
+register_flag("quant_accuracy_budget", "MXNET_QUANT_ACCURACY_BUDGET",
+              float, 0.005,
+              "Per-bucket accuracy-delta budget for int8 serving: the "
+              "bench serving leg (and any caller of the loadgen "
+              "accuracy probe) fails the quantized engines when the "
+              "top-1 delta vs the f32 reference exceeds this fraction "
+              "(default 0.5%). Ratchet like the perf budgets: only "
+              "tighten.")
 register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
               "Training-side telemetry HTTP listener port "
               "(mxnet_tpu.telemetry.exporters): serves /metrics "

@@ -16,7 +16,10 @@ from __future__ import annotations
 import collections
 import re
 
-_SHAPE_RE = re.compile(r"tensor<([0-9x]*)x?([a-z0-9]+)>")
+# "?" dims appear in dynamic-batch (jax.export symbolic-shape) modules;
+# an unknown dim counts as 1 element in _elems, which keeps every count
+# a LOWER bound — the direction the budgets ratchet against
+_SHAPE_RE = re.compile(r"tensor<([0-9?x]*)x?([a-z0-9]+)>")
 _OP_RE = re.compile(r"stablehlo\.(\w+)")
 
 

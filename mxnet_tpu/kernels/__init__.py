@@ -22,6 +22,7 @@ KERNEL_OPS = {
     "bn_act": "mxnet_tpu.kernels.bn_act",
     "scale_bias_act": "mxnet_tpu.kernels.mlp",
     "take_rows": "mxnet_tpu.kernels.take",
+    "int8_dequant": "mxnet_tpu.kernels.int8_dequant",
 }
 
 

@@ -1,0 +1,108 @@
+"""Fused int8 dequantize -> per-channel affine -> activation epilogue.
+
+The int8 serving path (mxnet_tpu/quant) computes FullyConnected /
+Convolution as ``int8 x int8 -> int32`` on the MXU and then needs one
+bandwidth-bound epilogue per site: scale the int32 accumulator by the
+per-output-channel dequant factor (already folded with the inference
+BatchNorm affine), add the per-channel bias, apply ReLU, and emit f32.
+XLA materializes the intermediate f32 tensor between those steps; this
+kernel does the whole epilogue in one VMEM pass, the ``bn_act`` mold:
+the accumulator is viewed as a 2-D matrix and the per-channel f32
+coefficients ride along as a broadcastable column (conv NCHW, channel
+rows) or row (FC, channel columns).
+
+Inference only — the quantized graph is never differentiated, so there
+is no custom_vjp here (the PR-6 kernels carry one because they run in
+the train step; this one runs only under serve engines).
+
+On CPU the kernel runs in interpreter mode; on TPU it lowers via Mosaic
+(kernel_name ``mxk_int8_dequant`` in the exported HLO —
+``hlo_stats.pallas_kernel_names`` finds it chip-free).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import tier
+
+__all__ = ["dequant_epilogue", "eligible", "DEFAULT_CONFIG", "OP_NAME"]
+
+OP_NAME = "int8_dequant"
+DEFAULT_CONFIG = {"block_r": 256, "block_s": 512}
+
+_ACTS = ("relu", "identity")
+
+
+def _dequant_kernel(acc_ref, sc_ref, sh_ref, o_ref, *, act):
+    y = acc_ref[...].astype(jnp.float32) * sc_ref[...] + sh_ref[...]
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def eligible(shape, act="relu"):
+    """Strict guard; returns None when dispatchable, else the reason."""
+    if len(shape) != 2:
+        return "accumulator view must be 2-D, got %d-D" % len(shape)
+    if act not in _ACTS:
+        return "unsupported activation %r" % (act,)
+    if shape[0] < 1 or shape[1] < 1:
+        return "empty tensor"
+    return None
+
+
+def shape_key_shapes(shape):
+    """Shapes the tuner keys this op on: the 2-D accumulator view."""
+    return (tuple(shape),)
+
+
+def dequant_epilogue(acc2, sc, sh, *, per_row, act="relu", config=None,
+                     interpret=None):
+    """``act(acc2.f32 * sc + sh)`` in one pallas pass.
+
+    ``acc2`` is the int32 accumulator viewed 2-D: (N*C, H*W) for conv
+    (``per_row=True`` — coefficients are an (R, 1) column) or (N, K) for
+    FC (``per_row=False`` — coefficients are a (1, S) row).
+    """
+    reason = eligible(acc2.shape, act=act)
+    if reason is not None:
+        raise ValueError("dequant_epilogue guard: %s" % reason)
+    cfgd = dict(DEFAULT_CONFIG)
+    cfgd.update(config or {})
+    if interpret is None:
+        interpret = tier.resolve_interpret()
+    R, S = acc2.shape
+    block_r = max(1, min(int(cfgd["block_r"]), R))
+    block_s = max(1, min(int(cfgd["block_s"]), S))
+    pad_r = (-R) % block_r
+    pad_s = (-S) % block_s
+    if pad_r or pad_s:
+        acc2 = jnp.pad(acc2, ((0, pad_r), (0, pad_s)))
+        if per_row:
+            sc = jnp.pad(sc, ((0, pad_r), (0, 0)))
+            sh = jnp.pad(sh, ((0, pad_r), (0, 0)))
+        else:
+            sc = jnp.pad(sc, ((0, 0), (0, pad_s)))
+            sh = jnp.pad(sh, ((0, 0), (0, pad_s)))
+    grid = ((R + pad_r) // block_r, (S + pad_s) // block_s)
+    x_spec = pl.BlockSpec((block_r, block_s), lambda ri, si: (ri, si))
+    if per_row:
+        c_spec = pl.BlockSpec((block_r, 1), lambda ri, si: (ri, 0))
+    else:
+        c_spec = pl.BlockSpec((1, block_s), lambda ri, si: (0, si))
+    out = pl.pallas_call(
+        functools.partial(_dequant_kernel, act=act),
+        grid=grid,
+        in_specs=[x_spec, c_spec, c_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(acc2.shape, jnp.float32),
+        interpret=interpret,
+        name="mxk_int8_dequant",
+    )(acc2, sc, sh)
+    if pad_r or pad_s:
+        out = out[:R, :S]
+    return out

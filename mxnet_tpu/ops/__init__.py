@@ -15,6 +15,7 @@ from . import init_ops      # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import image_ops     # noqa: F401
 from . import quantization  # noqa: F401
+from . import quant_serve   # noqa: F401
 from . import contrib_ops   # noqa: F401
 from . import custom_op     # noqa: F401
 from . import vision_ops    # noqa: F401

@@ -1,0 +1,108 @@
+"""Serving-side int8 operators for post-training quantization
+(mxnet_tpu/quant): the closed primitive set the rewrite pass lowers
+eligible FullyConnected / Convolution sites onto.
+
+Unlike the reference-parity ops in :mod:`ops/quantization` (runtime
+min/max triples threaded through the graph), these bake the calibrated
+activation scale as a STATIC hyperparameter and carry the per-output-
+channel dequant scale / bias — with the inference BatchNorm affine and
+any f32 bias already folded in — as small f32 parameter arrays. One op
+per site:
+
+    f32 data -> static-scale int8 quantize -> int8 x int8 dot/conv
+    (int32 accumulate on the MXU) -> fused dequant epilogue
+    ``act(acc * scale[oc] + bias[oc])`` -> f32
+
+The epilogue dispatches through the PR-6 kernel tier
+(``kernels/int8_dequant``, pure-JAX fallback). Inference only: no
+custom_vjp, the quantized graph is never differentiated.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..kernels import tier as _tier
+from .registry import register
+
+__all__ = ["quantized_fc_int8", "quantized_conv_int8"]
+
+
+def _quantize_static(data, act_scale):
+    """f32 -> int8 with the calibrated per-tensor scale (symmetric)."""
+    q = jnp.round(data.astype(jnp.float32) * jnp.float32(act_scale))
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def _epilogue(acc, scale, bias, channel_axis, act):
+    """Fused dequant->affine->act over the int32 accumulator; kernel-tier
+    dispatched with a pure-JAX fallback (models never see the difference
+    except in speed)."""
+    from ..kernels import int8_dequant as _k
+    if channel_axis == 1 and acc.ndim == 4:
+        N, C, H, W = acc.shape
+        acc2 = acc.reshape(N * C, H * W)
+        sc = jnp.tile(scale.astype(jnp.float32), N)[:, None]
+        sh = jnp.tile(bias.astype(jnp.float32), N)[:, None]
+        per_row = True
+    else:
+        acc2 = acc
+        sc = scale.astype(jnp.float32)[None, :]
+        sh = bias.astype(jnp.float32)[None, :]
+        per_row = False
+    reason = _k.eligible(acc2.shape, act=act)
+    go, cfg = _tier.should_dispatch(_k.OP_NAME,
+                                    _k.shape_key_shapes(acc2.shape),
+                                    "int32", guard_reason=reason)
+    if go:
+        out2 = _k.dequant_epilogue(acc2, sc, sh, per_row=per_row, act=act,
+                                   config=cfg)
+        return out2.reshape(acc.shape)
+    bshape = [1] * acc.ndim
+    bshape[channel_axis] = -1
+    y = (acc.astype(jnp.float32) * scale.reshape(bshape)
+         + bias.reshape(bshape))
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    return y
+
+
+@register("_contrib_quantized_fc_int8")
+def quantized_fc_int8(data, weight_q, out_scale, out_bias, *, num_hidden,
+                      act_scale, act="identity", flatten=True):
+    """int8 FullyConnected for the serving path.
+
+    data f32 (quantized in-op with the static calibrated ``act_scale``),
+    weight_q int8 (K, D), out_scale/out_bias f32 (K,) holding
+    dequant * BN-affine and BN-shift + dequantized bias.
+    """
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    xq = _quantize_static(x, act_scale)
+    acc = lax.dot_general(xq, weight_q.astype(jnp.int8),
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    return _epilogue(acc, out_scale, out_bias, acc.ndim - 1, act)
+
+
+@register("_contrib_quantized_conv_int8")
+def quantized_conv_int8(data, weight_q, out_scale, out_bias, *, kernel,
+                        num_filter, act_scale, stride=None, dilate=None,
+                        pad=None, act="identity"):
+    """int8 NCHW Convolution for the serving path (groups=1 only — the
+    rewrite guard enforces it). Same scale/bias contract as the FC op,
+    per output channel (axis 1)."""
+    n = len(kernel)
+    stride = tuple(s if s else 1 for s in (stride or (1,) * n))
+    dilate = tuple(d if d else 1 for d in (dilate or (1,) * n))
+    padding = [(p, p) for p in (pad or (0,) * n)]
+    fmt = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+           3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+    dn = lax.conv_dimension_numbers(data.shape, weight_q.shape, fmt)
+    xq = _quantize_static(data, act_scale)
+    acc = lax.conv_general_dilated(
+        xq, weight_q.astype(jnp.int8), window_strides=stride,
+        padding=padding, rhs_dilation=dilate, dimension_numbers=dn,
+        preferred_element_type=jnp.int32)
+    return _epilogue(acc, out_scale, out_bias, 1, act)

@@ -1,0 +1,63 @@
+"""Post-training int8 quantization for the serving path (ROADMAP item 5).
+
+Pipeline (PAPER.md capability 7, TPP-style closed primitive set):
+
+1. :func:`calibrate` — one traced forward per calibration batch over the
+   eligible FullyConnected/Convolution sites' data inputs, activation
+   amax accumulated as a DONATED device carry (the PR-3 device-metric
+   discipline), ONE batched device->host fetch at the very end.
+   Per-output-channel weight ranges come host-side from the checkpoint.
+2. :func:`quantize_serving_graph` — rewrite eligible sites onto the two
+   serving ops in :mod:`ops/quant_serve` (static-scale int8 quantize ->
+   int8 dot/conv with int32 accumulate -> fused dequant epilogue through
+   the kernel tier), folding the inference BatchNorm affine and a
+   trailing ReLU into the epilogue. Strict eligibility guards; every
+   "no" keeps the f32 node and is reported with its reason.
+3. :func:`export_quantized` — emit a ``format_version`` 4 ``.mxtpu``
+   artifact (int8 weight constants baked into the StableHLO, ~4x
+   smaller weight payload) that ``load_artifact`` / the serve engine
+   cache treat as a first-class predict artifact with dtype "int8".
+
+CLI: ``tools/quantize_model.py``. Docs: docs/quantization.md.
+"""
+from .calibrate import CalibrationResult, calibrate, find_sites
+from .rewrite import quantize_serving_graph
+
+__all__ = ["CalibrationResult", "calibrate", "find_sites",
+           "quantize_serving_graph", "quantize_serving_model",
+           "export_quantized"]
+
+
+def quantize_serving_model(sym, arg_params, aux_params, calib_batches,
+                           data_names=("data",), excluded=(),
+                           num_calib_examples=None):
+    """Calibrate + rewrite in one call.
+
+    ``calib_batches``: iterable of dict name -> array (host or device).
+    Returns ``(qsym, qarg_params, qaux_params, report)`` where report is
+    the JSON-able ``quant`` record the artifact metadata carries.
+    """
+    calib = calibrate(sym, arg_params, aux_params, calib_batches,
+                      data_names=data_names, excluded=excluded,
+                      num_calib_examples=num_calib_examples)
+    return quantize_serving_graph(sym, arg_params, aux_params, calib)
+
+
+def export_quantized(sym, arg_params, aux_params, calib_batches,
+                     data_shapes, path, data_names=None, excluded=(),
+                     num_calib_examples=None, dtype="float32",
+                     platforms=None, dynamic_batch=False):
+    """Quantize and freeze into a ``format_version`` 4 artifact at
+    ``path``; returns the artifact metadata (with the ``quant`` record).
+    """
+    from .. import serving as _serving
+    if data_names is None:
+        data_names = tuple(data_shapes)
+    qsym, qargs, qaux, report = quantize_serving_model(
+        sym, arg_params, aux_params, calib_batches,
+        data_names=data_names, excluded=excluded,
+        num_calib_examples=num_calib_examples)
+    return _serving.export_compiled(
+        qsym, qargs, qaux, data_shapes, path, dtype=dtype,
+        platforms=platforms, dynamic_batch=dynamic_batch,
+        format_version=4, extra_meta={"quant": report})

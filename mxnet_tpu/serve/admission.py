@@ -63,14 +63,15 @@ class Request:
     until completion.
     """
 
-    __slots__ = ("arrays", "rows", "deadline", "t_submit", "bucket",
-                 "_event", "_result", "_error")
+    __slots__ = ("arrays", "rows", "deadline", "dtype", "t_submit",
+                 "bucket", "_event", "_result", "_error")
 
-    def __init__(self, arrays, rows, deadline=None):
+    def __init__(self, arrays, rows, deadline=None, dtype=None):
         self.arrays = arrays          # tuple of device arrays, one/input
         self.rows = rows
         self.deadline = deadline      # absolute time.monotonic(), or None
-        self.t_submit = time.monotonic()
+        self.dtype = dtype            # engine dtype route ("f32"/"int8");
+        self.t_submit = time.monotonic()  # None -> server primary
         self.bucket = None
         self._event = threading.Event()
         self._result = None

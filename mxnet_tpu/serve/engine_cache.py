@@ -27,8 +27,16 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..config import flags
+from .. import telemetry as _telemetry
 
-__all__ = ["BucketedEngineCache", "check_buckets", "pick_bucket"]
+__all__ = ["BucketedEngineCache", "check_buckets", "pick_bucket",
+           "model_dtype_label"]
+
+
+def model_dtype_label(model):
+    """Serving dtype label for a loaded artifact: "int8" for
+    format_version-4 quantized artifacts, "f32" otherwise."""
+    return "int8" if getattr(model, "quantized", False) else "f32"
 
 
 def parse_buckets(spec):
@@ -73,11 +81,12 @@ def pick_bucket(buckets, rows):
 
 
 class _Engine:
-    __slots__ = ("bucket", "compiled", "compile_ms", "warmup_ms", "calls",
-                 "rows", "padded_rows")
+    __slots__ = ("bucket", "dtype", "compiled", "compile_ms", "warmup_ms",
+                 "calls", "rows", "padded_rows")
 
-    def __init__(self, bucket, compiled, compile_ms, warmup_ms):
+    def __init__(self, bucket, dtype, compiled, compile_ms, warmup_ms):
         self.bucket = bucket
+        self.dtype = dtype
         self.compiled = compiled
         self.compile_ms = compile_ms
         self.warmup_ms = warmup_ms
@@ -87,22 +96,76 @@ class _Engine:
 
 
 class BucketedEngineCache:
-    """LRU of per-bucket executables over one loaded artifact."""
+    """LRU of per-bucket executables, possibly over several PRECISION
+    VARIANTS of one model.
+
+    The primary artifact (usually f32) defines the input signature; an
+    int8 format-version-4 artifact of the same model can be attached
+    side-by-side with :meth:`add_model`, after which every bucket can
+    hold one engine PER DTYPE — ``(dtype, bucket)`` is the cache key —
+    and callers route per request with ``dtype=``. Omitting ``dtype``
+    everywhere keeps the exact single-model behaviour of earlier
+    releases (stats keys included).
+    """
 
     def __init__(self, model, capacity=None, warmup=None):
         self._model = model
-        self._exp = model._exp
         self._specs = model.meta["inputs"]
+        self.primary_dtype = model_dtype_label(model)
+        self._models = {self.primary_dtype: model}
         self.capacity = (flags.serve_cache_engines if capacity is None
                          else int(capacity))
         self.warmup = flags.serve_warmup if warmup is None else bool(warmup)
-        self._engines = OrderedDict()   # bucket -> _Engine, LRU order
+        self._engines = OrderedDict()   # (dtype, bucket) -> _Engine, LRU
         self._lock = threading.Lock()
         self.builds = 0
         self.evictions = 0
+        # dtype-labelled build counter: bumped host-side at build time,
+        # zero extra device syncs
+        self._tm_builds = _telemetry.counter(
+            "serve/engine_builds_total",
+            "bucket executables compiled, by serving dtype")
 
-    def _build(self, bucket):
-        frozen = (None if self._model.dynamic_batch
+    @property
+    def dtypes(self):
+        """Serving dtypes available for routing, primary first."""
+        rest = sorted(d for d in self._models if d != self.primary_dtype)
+        return (self.primary_dtype,) + tuple(rest)
+
+    def add_model(self, model, dtype=None):
+        """Attach a precision variant (e.g. the int8 quantized artifact)
+        of the SAME model: identical input names, per-row shapes, input
+        dtypes and batch mode. Engines for it build lazily per bucket,
+        exactly like the primary's."""
+        dtype = model_dtype_label(model) if dtype is None else str(dtype)
+        def sig(specs, dyn):
+            return (tuple((s["name"], tuple(s["shape"][1:]), s["dtype"])
+                          for s in specs), bool(dyn))
+        have = sig(self._specs, self._model.dynamic_batch)
+        got = sig(model.meta["inputs"], model.dynamic_batch)
+        if have != got:
+            raise MXNetError(
+                "serve: %r variant's input signature %r does not match "
+                "the primary artifact's %r — quantize the SAME model "
+                "with the same export shapes" % (dtype, got, have))
+        with self._lock:
+            if dtype in self._models:
+                raise MXNetError(
+                    "serve: a %r model is already attached" % dtype)
+            self._models[dtype] = model
+        return dtype
+
+    def _resolve(self, dtype):
+        d = self.primary_dtype if dtype is None else str(dtype)
+        model = self._models.get(d)
+        if model is None:
+            raise MXNetError(
+                "serve: no %r engines; attached dtypes are %s"
+                % (d, list(self.dtypes)))
+        return d, model
+
+    def _build(self, bucket, dtype, model):
+        frozen = (None if model.dynamic_batch
                   else self._specs[0]["shape"][0])
         if frozen is not None and bucket != frozen:
             raise MXNetError(
@@ -112,7 +175,7 @@ class BucketedEngineCache:
                                          _np.dtype(s["dtype"]))
                     for s in self._specs]
         t0 = time.perf_counter()
-        compiled = jax.jit(self._exp.call).lower(*in_specs).compile()
+        compiled = jax.jit(model._exp.call).lower(*in_specs).compile()
         compile_ms = (time.perf_counter() - t0) * 1e3
         warmup_ms = 0.0
         if self.warmup:
@@ -123,34 +186,38 @@ class BucketedEngineCache:
                     o.block_until_ready()
             warmup_ms = (time.perf_counter() - t1) * 1e3
         self.builds += 1
-        return _Engine(bucket, compiled, compile_ms, warmup_ms)
+        self._tm_builds.inc(1, dtype=dtype, bucket=str(bucket))
+        return _Engine(bucket, dtype, compiled, compile_ms, warmup_ms)
 
-    def engine(self, bucket):
-        """Fetch (building lazily) the executable for one bucket."""
+    def engine(self, bucket, dtype=None):
+        """Fetch (building lazily) the executable for one bucket of one
+        attached dtype (default: the primary artifact's)."""
+        dtype, model = self._resolve(dtype)
+        key = (dtype, bucket)
         with self._lock:
-            eng = self._engines.get(bucket)
+            eng = self._engines.get(key)
             if eng is not None:
-                self._engines.move_to_end(bucket)
+                self._engines.move_to_end(key)
                 return eng
         # build outside the lock: XLA compiles can take seconds and other
         # buckets' traffic must not stall behind them
-        eng = self._build(bucket)
+        eng = self._build(bucket, dtype, model)
         with self._lock:
-            cur = self._engines.get(bucket)
+            cur = self._engines.get(key)
             if cur is not None:          # lost a build race: keep the first
-                self._engines.move_to_end(bucket)
+                self._engines.move_to_end(key)
                 return cur
-            self._engines[bucket] = eng
+            self._engines[key] = eng
             while self.capacity > 0 and len(self._engines) > self.capacity:
                 self._engines.popitem(last=False)
                 self.evictions += 1
             return eng
 
-    def run(self, bucket, arrs, rows):
+    def run(self, bucket, arrs, rows, dtype=None):
         """Pad ``arrs`` (one per input, ``rows`` real rows each) to
         ``bucket``, execute, slice back to the real rows. Everything
         stays on device; no host sync."""
-        eng = self.engine(bucket)
+        eng = self.engine(bucket, dtype)
         pad = bucket - rows
         if pad:
             arrs = [jnp.concatenate(
@@ -166,30 +233,38 @@ class BucketedEngineCache:
                          else o for o in outs)
         return tuple(outs)
 
-    def run_padded(self, buckets, arrs, rows):
+    def run_padded(self, buckets, arrs, rows, dtype=None):
         bucket = pick_bucket(buckets, rows)
         if bucket is None:
             raise MXNetError(
                 "serve: batch of %d rows exceeds the largest bucket %d"
                 % (rows, buckets[-1]))
-        return self.run(bucket, arrs, rows)
+        return self.run(bucket, arrs, rows, dtype=dtype)
 
     def stats(self):
         with self._lock:
+            engines = {}
+            for e in self._engines.values():
+                # primary engines keep their historical plain-bucket key;
+                # secondary dtypes are namespaced "dtype:bucket"
+                key = (str(e.bucket) if e.dtype == self.primary_dtype
+                       else "%s:%d" % (e.dtype, e.bucket))
+                engines[key] = {
+                    "dtype": e.dtype,
+                    "compile_ms": round(e.compile_ms, 3),
+                    "warmup_ms": round(e.warmup_ms, 3),
+                    "calls": e.calls,
+                    "rows": e.rows,
+                    "padded_rows": e.padded_rows,
+                }
             return {
                 "capacity": self.capacity,
                 "builds": self.builds,
                 "evictions": self.evictions,
+                "dtypes": list(self.dtypes),
                 # export-time kernel-tier record (tier, tuning
                 # fingerprint, Pallas kernels baked into the artifact) —
                 # None for pre-tier artifacts
                 "kernel_tier": self._model.meta.get("kernel_tier"),
-                "engines": {
-                    str(e.bucket): {
-                        "compile_ms": round(e.compile_ms, 3),
-                        "warmup_ms": round(e.warmup_ms, 3),
-                        "calls": e.calls,
-                        "rows": e.rows,
-                        "padded_rows": e.padded_rows,
-                    } for e in self._engines.values()},
+                "engines": engines,
             }

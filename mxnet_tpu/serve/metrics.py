@@ -61,7 +61,7 @@ class _BucketStats:
 class ServeMetrics:
     def __init__(self):
         self._lock = threading.Lock()
-        self._buckets = {}           # bucket -> _BucketStats
+        self._buckets = {}           # (dtype, bucket) -> _BucketStats
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -136,9 +136,9 @@ class ServeMetrics:
             self.errors += n
         self._tm_errors.inc(n)
 
-    def note_batch(self, bucket, rows, padded, exec_ms):
+    def note_batch(self, bucket, rows, padded, exec_ms, dtype="f32"):
         with self._lock:
-            st = self._bucket(bucket)
+            st = self._bucket((dtype, bucket))
             st.batches += 1
             st.rows += rows
             st.padded_rows += padded
@@ -146,22 +146,23 @@ class ServeMetrics:
             self._exec_s_total += exec_ms / 1e3
             self._rows_total += rows
         b = str(bucket)
-        self._tm_batches.inc(1, bucket=b)
-        self._tm_rows.inc(rows, bucket=b)
+        self._tm_batches.inc(1, bucket=b, dtype=dtype)
+        self._tm_rows.inc(rows, bucket=b, dtype=dtype)
         if padded:
-            self._tm_padded.inc(padded, bucket=b)
-        self._tm_exec.observe(exec_ms, bucket=b)
+            self._tm_padded.inc(padded, bucket=b, dtype=dtype)
+        self._tm_exec.observe(exec_ms, bucket=b, dtype=dtype)
         if profiler.is_active("serve"):
             now = profiler._now_us()
             profiler.record_event("serve/bucket%d" % bucket, "serve",
                                   now - exec_ms * 1e3, exec_ms * 1e3)
 
-    def note_request_done(self, bucket, latency_ms):
+    def note_request_done(self, bucket, latency_ms, dtype="f32"):
         with self._lock:
             self.completed += 1
-            self._bucket(bucket).latency_ms.append(latency_ms)
+            self._bucket((dtype, bucket)).latency_ms.append(latency_ms)
         self._tm_completed.inc()
-        self._tm_latency.observe(latency_ms, bucket=str(bucket))
+        self._tm_latency.observe(latency_ms, bucket=str(bucket),
+                                 dtype=dtype)
 
     def set_queue_depth(self, depth):
         with self._lock:
@@ -186,33 +187,50 @@ class ServeMetrics:
             return 0.05
         return max(0.005, pending_rows / rate)
 
+    @staticmethod
+    def _render(batches, rows, padded, lat, ex):
+        total = rows + padded
+        return {
+            "batches": batches,
+            "rows": rows,
+            "padded_rows": padded,
+            "occupancy": round(rows / total, 4) if total else None,
+            "padding_waste": (round(padded / total, 4)
+                              if total else None),
+            "latency_ms": {
+                "count": len(lat),
+                "p50": percentile(lat, 50),
+                "p95": percentile(lat, 95),
+                "p99": percentile(lat, 99),
+                "mean": (sum(lat) / len(lat)) if lat else None,
+            },
+            "exec_ms": {
+                "count": len(ex),
+                "p50": percentile(ex, 50),
+                "p99": percentile(ex, 99),
+            },
+        }
+
     def snapshot(self, engine_stats=None):
         with self._lock:
-            buckets = {}
-            for b, st in sorted(self._buckets.items()):
-                total = st.rows + st.padded_rows
-                lat = list(st.latency_ms)
-                ex = list(st.exec_ms)
-                buckets[str(b)] = {
-                    "batches": st.batches,
-                    "rows": st.rows,
-                    "padded_rows": st.padded_rows,
-                    "occupancy": round(st.rows / total, 4) if total else None,
-                    "padding_waste": (round(st.padded_rows / total, 4)
-                                      if total else None),
-                    "latency_ms": {
-                        "count": len(lat),
-                        "p50": percentile(lat, 50),
-                        "p95": percentile(lat, 95),
-                        "p99": percentile(lat, 99),
-                        "mean": (sum(lat) / len(lat)) if lat else None,
-                    },
-                    "exec_ms": {
-                        "count": len(ex),
-                        "p50": percentile(ex, 50),
-                        "p99": percentile(ex, 99),
-                    },
-                }
+            # "buckets" aggregates across dtypes (the historical shape —
+            # identical to before when only f32 serves); per-dtype
+            # percentiles live under "buckets_by_dtype"
+            merged = {}   # bucket -> [batches, rows, padded, lat, ex]
+            by_dtype = {}
+            for (dt, b), st in sorted(self._buckets.items(),
+                                      key=lambda kv: (kv[0][1], kv[0][0])):
+                m = merged.setdefault(b, [0, 0, 0, [], []])
+                m[0] += st.batches
+                m[1] += st.rows
+                m[2] += st.padded_rows
+                m[3].extend(st.latency_ms)
+                m[4].extend(st.exec_ms)
+                by_dtype.setdefault(dt, {})[str(b)] = self._render(
+                    st.batches, st.rows, st.padded_rows,
+                    list(st.latency_ms), list(st.exec_ms))
+            buckets = {str(b): self._render(*m)
+                       for b, m in sorted(merged.items())}
             out = {
                 "uptime_s": round(time.monotonic() - self._t_start, 3),
                 "requests": {
@@ -229,6 +247,7 @@ class ServeMetrics:
                     self._rows_total / self._exec_s_total, 2)
                     if self._exec_s_total > 0 else None,
                 "buckets": buckets,
+                "buckets_by_dtype": by_dtype,
             }
         if engine_stats is not None:
             out["engines"] = engine_stats

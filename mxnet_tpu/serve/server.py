@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 
 import numpy as _np
 
@@ -76,10 +77,15 @@ class Server:
     coalescing for tests) or :meth:`start`.
     """
 
-    def __init__(self, model, config=None, auto_start=True, **overrides):
+    def __init__(self, model, config=None, auto_start=True, quantized=None,
+                 **overrides):
         if not isinstance(model, (CompiledModel, GenerateModel)):
             model = load_artifact(model)
         if isinstance(model, GenerateModel):
+            if quantized is not None:
+                raise MXNetError("Server: quantized= is a predict-mode "
+                                 "option; generate artifacts do not take "
+                                 "a precision sibling")
             # generate artifact: the continuous-batching decode engine
             # replaces the micro-batcher wholesale; Server proxies
             # lifecycle + metrics so the HTTP front end / CLI are shared
@@ -115,6 +121,20 @@ class Server:
                               cache_engines=config.cache_engines,
                               warmup=config.warmup)
         self._cache = model.engine_cache
+        if quantized is not None:
+            # attach the int8 sibling artifact: same model, quantized by
+            # tools/quantize_model.py, served side-by-side per bucket
+            if not isinstance(quantized, CompiledModel):
+                quantized = load_artifact(quantized)
+            if not isinstance(quantized, CompiledModel):
+                raise MXNetError(
+                    "Server: quantized= must be a predict artifact")
+            if not quantized.quantized:
+                raise MXNetError(
+                    "Server: quantized= artifact is not format_version 4 "
+                    "(run tools/quantize_model.py to produce one)")
+            if "int8" not in self._cache.dtypes:  # cache may be reused
+                self._cache.add_model(quantized, "int8")
         self.metrics_ = ServeMetrics()
         self._queue = AdmissionQueue(
             config.queue_depth,
@@ -242,17 +262,26 @@ class Server:
                 "larger buckets" % (rows, self.buckets[-1]))
         return arrs, rows
 
-    def submit(self, *data, timeout_ms=None, **kwdata):
+    def submit(self, *data, timeout_ms=None, dtype=None, **kwdata):
         """Admit one request; never blocks. Returns a :class:`Request`
-        whose ``.result()`` blocks for the response. Raises ServerBusy
-        (queue full), ServerClosed, or MXNetError (validation)."""
+        whose ``.result()`` blocks for the response. ``dtype`` routes to
+        an attached precision variant ("f32"/"int8"; default the
+        primary artifact). Raises ServerBusy (queue full), ServerClosed,
+        or MXNetError (validation)."""
         self._require_mode("predict", "submit")
+        if dtype is not None and dtype not in self._cache.dtypes:
+            raise MXNetError(
+                "Server.submit: no %r engines on this server; available "
+                "dtypes are %s (pass quantized= at construction to "
+                "attach an int8 artifact)"
+                % (dtype, list(self._cache.dtypes)))
         arrs, rows = self._prepare(data, kwdata)
         if timeout_ms is None:
             timeout_ms = self.config.timeout_ms
         deadline = (time.monotonic() + timeout_ms / 1e3
                     if timeout_ms and timeout_ms > 0 else None)
-        req = Request(tuple(arrs), rows, deadline)
+        req = Request(tuple(arrs), rows, deadline,
+                      dtype=dtype or self._cache.primary_dtype)
         try:
             self._queue.submit(req)
         except ServerClosed:
@@ -267,9 +296,10 @@ class Server:
         self.metrics_.set_queue_depth(self._queue.pending_count())
         return req
 
-    def predict(self, *data, timeout_ms=None, **kwdata):
+    def predict(self, *data, timeout_ms=None, dtype=None, **kwdata):
         """Blocking convenience: submit + result."""
-        req = self.submit(*data, timeout_ms=timeout_ms, **kwdata)
+        req = self.submit(*data, timeout_ms=timeout_ms, dtype=dtype,
+                          **kwdata)
         budget = (None if req.deadline is None
                   else max(0.001, req.deadline - time.monotonic()) + 1.0)
         return req.result(timeout=budget)
@@ -302,6 +332,18 @@ class Server:
                 live.append(r)
         if not live:
             return len(reqs)
+        # one padded device batch PER DTYPE GROUP (f32 and int8 requests
+        # coexist in a window but run on different engines); each group
+        # keeps the one-d2h-per-device-batch discipline
+        primary = self._cache.primary_dtype
+        groups = OrderedDict()
+        for r in live:
+            groups.setdefault(r.dtype or primary, []).append(r)
+        for dtype, group in groups.items():
+            self._dispatch_group(dtype, group)
+        return len(reqs)
+
+    def _dispatch_group(self, dtype, live):
         rows = sum(r.rows for r in live)
         bucket = pick_bucket(self.buckets, rows)
         # take() caps at the largest bucket, so bucket is never None
@@ -313,7 +355,7 @@ class Server:
                 stacked = [jnp.concatenate([r.arrays[i] for r in live])
                            for i in range(len(self.model.input_names))]
             t0 = time.perf_counter()
-            outs = self._cache.run(bucket, stacked, rows)
+            outs = self._cache.run(bucket, stacked, rows, dtype=dtype)
             # ONE d2h for the whole response batch (PR 3 discipline)
             host = jax.device_get(outs)
             exec_ms = (time.perf_counter() - t0) * 1e3
@@ -322,10 +364,11 @@ class Server:
             err = e if isinstance(e, MXNetError) else MXNetError(str(e))
             for r in live:
                 r._fail(err)
-            return len(reqs)
+            return
         nbytes = sum(getattr(h, "nbytes", 0) for h in host)
         profiler.record_host_sync("d2h", nbytes)
-        self.metrics_.note_batch(bucket, rows, bucket - rows, exec_ms)
+        self.metrics_.note_batch(bucket, rows, bucket - rows, exec_ms,
+                                 dtype=dtype)
         t_done = time.monotonic()
         off = 0
         for r in live:
@@ -334,8 +377,7 @@ class Server:
                               for h in host))
             off += r.rows
             self.metrics_.note_request_done(
-                bucket, (t_done - r.t_submit) * 1e3)
-        return len(reqs)
+                bucket, (t_done - r.t_submit) * 1e3, dtype=dtype)
 
     def _loop(self):
         while True:

@@ -49,6 +49,57 @@ __all__ = ["export_compiled", "CompiledModel", "export_generate",
 
 _MAGIC = b"MXTPUAOT"
 
+# -- format-version dispatch (single source of truth) -----------------------
+# Every .mxtpu reader resolves the artifact's version through this table:
+# version -> (kind, loader name). ``CompiledModel.load`` accepts the
+# "predict" versions (2 = f32, 4 = int8-quantized — same single-module
+# layout, int8 weight constants baked into the StableHLO),
+# ``GenerateModel.load`` the "generate" ones, and ``load_artifact``
+# dispatches. New versions are added HERE, nowhere else — the pointer
+# error message is generated from the table instead of being copied into
+# a third loader.
+_FORMAT_DISPATCH = {
+    1: ("predict", "CompiledModel"),
+    2: ("predict", "CompiledModel"),
+    3: ("generate", "GenerateModel"),
+    4: ("predict", "CompiledModel"),
+}
+
+
+def _effective_format_version(meta):
+    """The artifact's format version; pre-versioned generate artifacts
+    (a ``modules`` list without the bumped number) count as 3."""
+    v = int(meta.get("format_version", 2))
+    if "modules" in meta and v < 3:
+        v = 3
+    return v
+
+
+def _artifact_kind(path, meta):
+    """'predict' or 'generate'; raises on a version this build can't read."""
+    v = _effective_format_version(meta)
+    if v not in _FORMAT_DISPATCH:
+        raise MXNetError(
+            "artifact %r has format_version %s; this build reads versions "
+            "%s — upgrade mxnet_tpu or re-export the artifact"
+            % (path, v, sorted(_FORMAT_DISPATCH)))
+    return _FORMAT_DISPATCH[v][0]
+
+
+def _require_kind(path, meta, want):
+    """Shared version gate for the typed loaders — ONE error message for
+    every cross-kind load attempt, generated from the dispatch table."""
+    kind = _artifact_kind(path, meta)
+    if kind != want:
+        v = _effective_format_version(meta)
+        loader = _FORMAT_DISPATCH[v][1]
+        raise MXNetError(
+            "artifact %r is a %s artifact (format_version %s); load it "
+            "with %s.load or the version-dispatching load_artifact%s"
+            % (path, kind, v, loader,
+               ", and serve it with mxnet_tpu.serve.GenerateSession"
+               if kind == "generate" else ""))
+
 
 def _read_artifact(path):
     """(meta, payload bytes) of any .mxtpu artifact, version-agnostic."""
@@ -87,7 +138,8 @@ def _is_dynamic_dim(d):
 
 
 def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
-                    dtype="float32", platforms=None, dynamic_batch=False):
+                    dtype="float32", platforms=None, dynamic_batch=False,
+                    format_version=2, extra_meta=None):
     """Freeze (symbol, params) into an AOT artifact at ``path``.
 
     data_shapes: dict name -> shape. With ``dynamic_batch=False`` the
@@ -97,9 +149,16 @@ def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
     all inputs — so a single artifact serves any concrete batch size
     (each size compiles its own executable at load/serve time; see
     mxnet_tpu.serve). platforms: e.g. ["tpu"] to target TPU from a CPU
-    host; default = the current backend.
+    host; default = the current backend. ``format_version`` must map to a
+    "predict" artifact in the dispatch table (2 = f32, 4 = int8-quantized
+    — the quantization pipeline passes 4); ``extra_meta`` is merged into
+    the metadata JSON (e.g. the ``quant`` calibration record).
     """
     from jax import export as _export
+    if _FORMAT_DISPATCH.get(int(format_version), ("",))[0] != "predict":
+        raise MXNetError(
+            "export_compiled emits predict artifacts; format_version %s "
+            "is not one (table: %s)" % (format_version, _FORMAT_DISPATCH))
     data_shapes = {k: tuple(v) for k, v in data_shapes.items()}
     if any(_is_dynamic_dim(s[0]) for s in data_shapes.values() if s):
         dynamic_batch = True
@@ -164,8 +223,10 @@ def export_compiled(symbol, arg_params, aux_params, data_shapes, path,
         "platforms": list(exp.platforms),
         "dynamic_batch": bool(dynamic_batch),
         "kernel_tier": kernel_tier_meta,
-        "format_version": 2,
+        "format_version": int(format_version),
     }
+    if extra_meta:
+        meta.update(extra_meta)
     mjson = json.dumps(meta).encode()
     with open(path, "wb") as f:
         f.write(_MAGIC)
@@ -204,6 +265,9 @@ class CompiledModel:
         self.meta = meta
         self.input_names = [i["name"] for i in meta["inputs"]]
         self.dynamic_batch = bool(meta.get("dynamic_batch", False))
+        # int8-quantized predict artifact (format_version 4): the serve
+        # layer labels its engines/metrics "int8" instead of "f32"
+        self.quantized = _effective_format_version(meta) == 4
         self._cache = None
         self.buckets = None
         if buckets:
@@ -219,13 +283,7 @@ class CompiledModel:
         for inspection or to relay the artifact to a matching host."""
         from jax import export as _export
         meta, blob = _read_artifact(path)
-        if meta.get("format_version", 2) >= 3 or "modules" in meta:
-            raise MXNetError(
-                "artifact %r is a generate (continuous-batching) artifact "
-                "(format_version %s); load it with GenerateModel.load / "
-                "load_artifact, and serve it with "
-                "mxnet_tpu.serve.GenerateSession"
-                % (path, meta.get("format_version")))
+        _require_kind(path, meta, "predict")
         backend = jax.default_backend().lower()
         if (not allow_platform_mismatch
                 and not _platform_ok(backend, meta.get("platforms", []))):
@@ -481,11 +539,7 @@ class GenerateModel:
     def load(cls, path, allow_platform_mismatch=False):
         from jax import export as _export
         meta, payload = _read_artifact(path)
-        if meta.get("format_version", 2) < 3 or "modules" not in meta:
-            raise MXNetError(
-                "artifact %r is a single-module predict artifact "
-                "(format_version %s); load it with CompiledModel.load"
-                % (path, meta.get("format_version")))
+        _require_kind(path, meta, "generate")
         backend = jax.default_backend().lower()
         if (not allow_platform_mismatch
                 and not _platform_ok(backend, meta.get("platforms", []))):
@@ -509,10 +563,11 @@ class GenerateModel:
 
 
 def load_artifact(path, **kw):
-    """Open any ``.mxtpu`` artifact: :class:`CompiledModel` for predict
-    artifacts (format_version <= 2), :class:`GenerateModel` for generate
+    """Open any ``.mxtpu`` artifact through the format-version dispatch
+    table: :class:`CompiledModel` for predict artifacts (format_version
+    2, and 4 for int8-quantized), :class:`GenerateModel` for generate
     artifacts (format_version 3)."""
     meta, _ = _read_artifact(path)
-    if meta.get("format_version", 2) >= 3 or "modules" in meta:
-        return GenerateModel.load(path, **kw)
-    return CompiledModel.load(path, **kw)
+    kind = _artifact_kind(path, meta)
+    cls = GenerateModel if kind == "generate" else CompiledModel
+    return cls.load(path, **kw)

@@ -533,6 +533,58 @@ class TestHloPasses:
         assert len(leak) == 1 and leak[0].rule == "MXL508"
         assert "host-transfer" in leak[0].message
 
+    # MXL509 fixtures: hand-written StableHLO in the shape the quantized
+    # serving ops lower to. GOOD: f32 activations quantize (f32->i8), an
+    # int8 dot accumulates in i32, and the only upcast is the i32
+    # accumulator entering the dequant epilogue. BAD: the int8 weight is
+    # upcast i8->f32 and the dot runs in f32 — the artifact shrank but
+    # the compute did not quantize.
+    _QUANT_GOOD = (
+        'func.func public @main(%arg0: tensor<4x256xf32>) {\n'
+        '  %c = stablehlo.constant dense<1> : tensor<8x256xi8>\n'
+        '  %0 = stablehlo.convert %arg0 : (tensor<4x256xf32>) -> '
+        'tensor<4x256xi8>\n'
+        '  %1 = stablehlo.dot_general %0, %c, contracting_dims = [1] x '
+        '[1] : (tensor<4x256xi8>, tensor<8x256xi8>) -> tensor<4x8xi32>\n'
+        '  %2 = stablehlo.convert %1 : (tensor<4x8xi32>) -> '
+        'tensor<4x8xf32>\n'
+        '  return %2 : tensor<4x8xf32>\n'
+        '}\n')
+    _QUANT_BAD = (
+        'func.func public @main(%arg0: tensor<4x256xf32>) {\n'
+        '  %c = stablehlo.constant dense<1> : tensor<8x256xi8>\n'
+        '  %0 = stablehlo.convert %c : (tensor<8x256xi8>) -> '
+        'tensor<8x256xf32>\n'
+        '  %1 = stablehlo.dot_general %arg0, %0, contracting_dims = [1] '
+        'x [1] : (tensor<4x256xf32>, tensor<8x256xf32>) -> '
+        'tensor<4x8xf32>\n'
+        '  return %1 : tensor<4x8xf32>\n'
+        '}\n')
+
+    def test_quant_dequant_budget_catches_and_passes(self):
+        assert hlo_passes.quant_dequant_budget_pass(
+            self._QUANT_GOOD, "int8/predict", min_int8_ops=1) == []
+        bad = hlo_passes.quant_dequant_budget_pass(
+            self._QUANT_BAD, "int8/predict", min_int8_ops=1)
+        # both failure modes: no int8 compute AND a weight upcast
+        assert len(bad) == 2
+        assert all(d.rule == "MXL509" for d in bad)
+        assert "i8->f32" in bad[1].message
+
+    def test_quant_dequant_upcast_budget_is_a_ratchet(self):
+        # a module with valid int8 compute plus ONE stray i8->f32: the
+        # budget tolerates it at 1 (MXL501 idiom) and flags it at 0
+        mixed = self._QUANT_GOOD.replace(
+            '  return %2 : tensor<4x8xf32>\n',
+            '  %3 = stablehlo.convert %c : (tensor<8x256xi8>) -> '
+            'tensor<8x256xf32>\n'
+            '  return %2 : tensor<4x8xf32>\n')
+        assert hlo_passes.quant_dequant_budget_pass(
+            mixed, "int8/predict", upcast_budget=1) == []
+        over = hlo_passes.quant_dequant_budget_pass(
+            mixed, "int8/predict", upcast_budget=0)
+        assert len(over) == 1 and over[0].rule == "MXL509"
+
     def test_collective_overlap_report_is_per_func(self):
         # SSA names restart per func.func: a %0 in a second function must
         # not alias the first function's dataflow

@@ -1,0 +1,188 @@
+"""Int8 post-training quantization (mxnet_tpu.quant) — all chip-free.
+
+The acceptance properties of the quantization pipeline
+(docs/quantization.md):
+
+* calibration is DETERMINISTIC — same data, same checkpoint -> the same
+  bit-exact scale fingerprint, regardless of engine depth — and performs
+  exactly ONE device->host transfer regardless of batch count (the PR-3
+  device-carry discipline, witnessed by the profiler sync counters);
+* the rewrite quantizes every eligible site and reports every refusal
+  with its reason; the int8 weight payload is <= 0.3x the f32 one;
+* the ``format_version`` 4 artifact round-trips bitwise (save -> load ->
+  serve twice == same bits) and its lowered StableHLO passes the MXL509
+  all-int8 gate (every quantizable matmul/conv accumulates in i32, no
+  dequantize-before-matmul);
+* quantized outputs track f32 (argmax agreement on the probe batch).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config as _config
+from mxnet_tpu import profiler, quant, serving
+from mxnet_tpu.analysis import hlo_passes
+
+BATCH = 4
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+@pytest.fixture(scope="module")
+def model():
+    sym = _net()
+    rng = np.random.RandomState(0)
+    shapes, _, aux_shapes = sym.infer_shape(data=(2, 1, 8, 8))
+    args = {n: mx.nd.array(rng.uniform(-0.3, 0.3, s).astype("f4"))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    aux = {n: mx.nd.array(np.ones(s, "f4") if "var" in n
+                          else np.zeros(s, "f4"))
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    return {"sym": sym, "args": args, "aux": aux}
+
+
+def _calib(seed=5, n=3):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.randn(BATCH, 1, 8, 8).astype("f4")}
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def qart(model, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("quant") / "m.int8.mxtpu")
+    meta = quant.export_quantized(model["sym"], model["args"],
+                                  model["aux"], _calib(),
+                                  {"data": (None, 1, 8, 8)}, path)
+    return {"path": path, "meta": meta}
+
+
+# ---------------------------------------------------------------------------
+# calibration: determinism + the one-d2h budget
+# ---------------------------------------------------------------------------
+
+def test_calibration_is_deterministic_and_syncs_exactly_once(model):
+    profiler.reset_sync_counters()
+    c1 = quant.calibrate(model["sym"], model["args"], model["aux"],
+                         _calib(n=4))
+    counters = profiler.sync_counters()
+    # the whole pass — 4 batches, conv + fc sites — moves device data to
+    # host exactly ONCE: the batched fetch of the folded amax carry
+    assert counters["d2h"] == 1, counters
+
+    # same data -> bit-exact fingerprint, and engine depth must not
+    # change WHAT was computed (it only changes when the host waits)
+    c2 = quant.calibrate(model["sym"], model["args"], model["aux"],
+                         _calib(n=4))
+    with _config.override(engine_depth=1):
+        c3 = quant.calibrate(model["sym"], model["args"], model["aux"],
+                             _calib(n=4))
+    assert c1.fingerprint() == c2.fingerprint() == c3.fingerprint()
+    assert set(c1.act_scale) == {"c1", "fc"}
+
+    # more data widens (or keeps) the observed range — never invents one
+    c_less = quant.calibrate(model["sym"], model["args"], model["aux"],
+                             _calib(n=1))
+    for name in c1.act_amax:
+        assert c1.act_amax[name] >= c_less.act_amax[name]
+
+
+def test_find_sites_reports_every_refusal_with_reason(model):
+    sites, skipped = quant.find_sites(model["sym"], model["args"],
+                                      excluded=("fc",))
+    assert [s.name for s in sites] == ["c1"]
+    assert "fc" in skipped and "excluded" in skipped["fc"]
+
+
+# ---------------------------------------------------------------------------
+# the v4 artifact: payload, round trip, MXL509
+# ---------------------------------------------------------------------------
+
+def test_quantized_artifact_weight_payload_and_sites(qart):
+    rep = qart["meta"]["quant"]
+    assert qart["meta"]["format_version"] == 4
+    assert sorted(rep["sites"]) == ["c1", "fc"]
+    assert rep["skipped"] == {}
+    wb = rep["weight_bytes"]
+    assert wb["int8"] <= 0.3 * wb["f32"], wb
+    assert rep["calibration"]["fingerprint"]
+
+
+def test_round_trip_is_bitwise_stable(qart, model, tmp_path):
+    m1 = serving.load_artifact(qart["path"])
+    assert m1.quantized is True
+    rng = np.random.RandomState(9)
+    x = rng.randn(BATCH, 1, 8, 8).astype("f4")
+    out_a = np.asarray(m1.predict(data=x)[0])
+    out_b = np.asarray(m1.predict(data=x)[0])
+    assert (out_a == out_b).all()                # static scales: no drift
+    m2 = serving.load_artifact(qart["path"])     # fresh load, same bits
+    assert (np.asarray(m2.predict(data=x)[0]) == out_a).all()
+
+    # ...and tracks f32: same argmax on the probe batch
+    f32_path = str(tmp_path / "rt_f32.mxtpu")
+    serving.export_compiled(model["sym"], model["args"], model["aux"],
+                            {"data": (BATCH, 1, 8, 8)}, f32_path)
+    ref = np.asarray(
+        serving.load_artifact(f32_path).predict(data=x)[0])
+    assert (np.argmax(out_a, -1) == np.argmax(ref, -1)).all()
+    np.testing.assert_allclose(out_a, ref, atol=0.06)
+
+
+def test_every_eligible_site_is_int8_in_the_lowering(qart, model,
+                                                     tmp_path):
+    text = serving.load_artifact(qart["path"])._exp.mlir_module()
+    # MXL509: both MXU ops accumulate in i32, and no int8 tensor is
+    # upcast back to f32 ahead of a matmul (dequantize-before-matmul)
+    diags = hlo_passes.quant_dequant_budget_pass(text, "int8 artifact",
+                                                 min_int8_ops=2)
+    assert diags == [], [str(d) for d in diags]
+
+    # the same gate flags the UNQUANTIZED artifact: zero int8 MXU ops
+    f32_path = str(tmp_path / "f32.mxtpu")
+    serving.export_compiled(model["sym"], model["args"], model["aux"],
+                            {"data": (BATCH, 1, 8, 8)}, f32_path)
+    text = serving.load_artifact(f32_path)._exp.mlir_module()
+    diags = hlo_passes.quant_dequant_budget_pass(text, "f32 artifact",
+                                                 min_int8_ops=2)
+    assert diags and all(d.rule == "MXL509" for d in diags)
+
+
+def test_quantize_model_cli_round_trip(model, tmp_path):
+    """tools/quantize_model.py: checkpoint in, v4 artifact + one JSON
+    report line out — the deployment path users actually run."""
+    prefix = str(tmp_path / "ckpt")
+    mx.model.save_checkpoint(prefix, 0, model["sym"], model["args"],
+                             model["aux"])
+    out = str(tmp_path / "cli.int8.mxtpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "quantize_model.py"),
+         "--prefix", prefix, "--epoch", "0",
+         "--data-shape", "4,1,8,8", "--out", out,
+         "--calib-batches", "3", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["format_version"] == 4
+    assert sorted(rep["sites"]) == ["c1", "fc"]
+    m = serving.load_artifact(out)
+    assert m.quantized is True
+    x = np.zeros((4, 1, 8, 8), "f4")
+    assert np.asarray(m.predict(data=x)[0]).shape == (4, 3)

@@ -50,6 +50,20 @@ def art(tmp_path_factory):
     return {"path": path, "sym": net, "args": args, "aux": aux}
 
 
+@pytest.fixture(scope="module")
+def qart(art, tmp_path_factory):
+    """The int8-quantized sibling of ``art`` (format_version 4)."""
+    from mxnet_tpu import quant
+    path = str(tmp_path_factory.mktemp("serve_q") / "m.int8.mxtpu")
+    rng = np.random.RandomState(20)
+    calib = [{"data": rng.randn(4, 1, 8, 8).astype("f4")}
+             for _ in range(3)]
+    meta = quant.export_quantized(art["sym"], art["args"], art["aux"],
+                                  calib, {"data": (None, 1, 8, 8)}, path)
+    assert meta["format_version"] == 4
+    return path
+
+
 def _x(rng, n=1):
     return rng.randn(n, 1, 8, 8).astype("f4")
 
@@ -291,6 +305,82 @@ def test_profiler_sees_serve_events(art, tmp_path):
     names = [e.get("name") for e in events]
     assert "serve/bucket8" in names              # duration event
     assert "serve/queue_depth" in names          # counter track
+
+
+def test_quantized_engines_serve_side_by_side_with_dtype_metrics(art,
+                                                                 qart):
+    """One server, one bucket, BOTH precisions: f32 and int8 requests
+    coalesce into their own device batches through the dtype-routed
+    engine cache, each request's output is bitwise equal to the matching
+    CompiledModel through the same bucket, and the metrics snapshot
+    tags every per-bucket series with its dtype."""
+    # max bucket 4 => ONE coalescing window admits all 4 requests; the
+    # per-dtype split then lands each pair in its own bucket-2 batch
+    srv = Server(art["path"], quantized=qart, buckets=(2, 4),
+                 auto_start=False, batch_timeout_ms=0)
+    assert srv.model.engine_cache.dtypes == ("f32", "int8")
+    rng = np.random.RandomState(21)
+    xs = [_x(rng) for _ in range(4)]
+    f32_reqs = [srv.submit(data=xs[i], timeout_ms=30000)
+                for i in range(2)]
+    int8_reqs = [srv.submit(data=xs[2 + i], timeout_ms=30000,
+                            dtype="int8") for i in range(2)]
+    assert srv.run_once(block=False) == 4        # ONE coalescing round...
+
+    cm_f32 = mx.serving.CompiledModel.load(art["path"], buckets=(2,))
+    cm_int8 = mx.serving.CompiledModel.load(qart, buckets=(2,))
+    for i, r in enumerate(f32_reqs):
+        ref = np.asarray(cm_f32.predict(data=xs[i])[0])
+        assert (r.result(30)[0] == ref).all()
+    for i, r in enumerate(int8_reqs):
+        ref = np.asarray(cm_int8.predict(data=xs[2 + i])[0])
+        assert (r.result(30)[0] == ref).all()
+    # ...but one device batch PER dtype (precisions never mix in a batch)
+    snap = srv.metrics()
+    assert snap["buckets"]["2"]["batches"] == 2  # merged (historical key)
+    by_dtype = snap["buckets_by_dtype"]
+    assert by_dtype["f32"]["2"]["batches"] == 1
+    assert by_dtype["f32"]["2"]["rows"] == 2
+    assert by_dtype["int8"]["2"]["batches"] == 1
+    assert by_dtype["int8"]["2"]["rows"] == 2
+    for d in ("f32", "int8"):
+        lat = by_dtype[d]["2"]["latency_ms"]
+        assert lat["count"] == 2
+        assert lat["p50"] is not None and lat["p99"] is not None
+
+    eng = snap["engines"]
+    assert eng["dtypes"] == ["f32", "int8"]
+    assert sorted(eng["engines"]) == ["2", "int8:2"]
+    assert eng["engines"]["int8:2"]["dtype"] == "int8"
+
+    # unknown dtypes are rejected at admission, not at dispatch
+    with pytest.raises(mx.base.MXNetError) as ei:
+        srv.submit(data=_x(rng), dtype="bf16")
+    assert "bf16" in str(ei.value)
+    srv.close(drain=True)
+
+
+def test_quantized_attach_requires_v4_artifact(art, tmp_path):
+    """quantized= refuses a plain f32 artifact: the int8 route must not
+    silently serve f32 weights as 'int8'."""
+    with pytest.raises(mx.base.MXNetError) as ei:
+        Server(art["path"], quantized=art["path"], auto_start=False)
+    assert "quantize_model" in str(ei.value)
+
+
+def test_loadgen_routes_dtype_to_quantized_engines(art, qart):
+    from tools.serve_loadgen import measure
+    srv = Server(art["path"], quantized=qart, buckets=(1, 8),
+                 batch_timeout_ms=1)
+    res = measure(srv, concurrency=4, requests=12, timeout_ms=30000,
+                  dtype="int8")
+    snap = srv.metrics()
+    srv.close(drain=True)
+    assert res["errors"] == 0 and res["completed"] == 12
+    int8_rows = sum(b["rows"]
+                    for b in snap["buckets_by_dtype"]["int8"].values())
+    assert int8_rows == 12                       # every request went int8
+    assert "f32" not in snap["buckets_by_dtype"]
 
 
 def test_loadgen_inprocess_accounting(art):

@@ -1,0 +1,103 @@
+"""Post-training int8 quantization: checkpoint -> format_version-4
+``.mxtpu`` artifact (calibrated activation ranges, per-channel int8
+weights baked into the StableHLO, ~4x smaller weight payload).
+
+    python tools/quantize_model.py --prefix model --epoch 10 \
+        --data-shape 32,3,224,224 --out model_int8.mxtpu \
+        [--calib-npz calib.npz] [--calib-batches 8] [--dynamic-batch]
+
+Calibration data: ``--calib-npz`` (an .npz whose arrays are batches of
+the data input, concatenated along axis 0) when you have a labelled
+sample of production traffic; otherwise deterministic synthetic batches
+from ``--seed`` (fine for pipeline tests, NOT for deployment scales).
+The whole calibration pass performs exactly ONE device->host transfer
+(see mxnet_tpu/quant/calibrate.py).
+
+Prints one JSON line: artifact path/bytes, f32-vs-int8 weight payload,
+quantized and skipped sites (each skip with its reason).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _calib_batches(args, shape):
+    import numpy as np
+    n = args.calib_batches
+    if args.calib_npz:
+        data = np.load(args.calib_npz)
+        arr = np.concatenate([data[k] for k in sorted(data.files)], axis=0)
+        arr = arr.astype(np.float32)
+        bs = shape[0]
+        batches = [arr[i:i + bs] for i in range(0, len(arr), bs)]
+        return [{args.data_name: b} for b in batches[:n] if len(b) == bs]
+    rng = np.random.RandomState(args.seed)
+    return [{args.data_name: rng.randn(*shape).astype(np.float32)}
+            for _ in range(n)]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--prefix", required=True)
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--data-shape", required=True,
+                   help="comma dims incl. calibration batch, e.g. "
+                        "32,3,224,224")
+    p.add_argument("--data-name", default="data")
+    p.add_argument("--out", required=True)
+    p.add_argument("--platforms", default=None,
+                   help="comma list, e.g. tpu (default: current backend)")
+    p.add_argument("--dynamic-batch", action="store_true",
+                   help="symbolic batch dim: one int8 artifact serves "
+                        "every bucket of the serve engine cache")
+    p.add_argument("--calib-npz", default=None,
+                   help=".npz of real calibration batches (data input, "
+                        "concat on axis 0); default: synthetic from "
+                        "--seed")
+    p.add_argument("--calib-batches", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--excluded", default=None,
+                   help="comma list of layer names to keep f32")
+    p.add_argument("--num-calib-examples", type=int, default=None)
+    p.add_argument("--platform", default=None, choices=[None, "cpu"],
+                   help="backend to run calibration + export on")
+    args = p.parse_args()
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import quant
+    sym, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                           args.epoch)
+    shape = tuple(int(x) for x in args.data_shape.split(","))
+    plats = args.platforms.split(",") if args.platforms else None
+    excluded = (tuple(s for s in args.excluded.split(",") if s)
+                if args.excluded else ())
+    meta = quant.export_quantized(
+        sym, arg_params, aux_params, _calib_batches(args, shape),
+        {args.data_name: shape}, args.out, excluded=excluded,
+        num_calib_examples=args.num_calib_examples, platforms=plats,
+        dynamic_batch=args.dynamic_batch)
+    q = meta["quant"]
+    print(json.dumps({
+        "artifact": args.out,
+        "bytes": os.path.getsize(args.out),
+        "format_version": meta["format_version"],
+        "weight_bytes": q["weight_bytes"],
+        "weight_payload_ratio": round(
+            q["weight_bytes"]["int8"] / q["weight_bytes"]["f32"], 4)
+            if q["weight_bytes"]["f32"] else None,
+        "sites": q["sites"],
+        "skipped": q["skipped"],
+        "calibration": q["calibration"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

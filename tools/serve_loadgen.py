@@ -82,7 +82,7 @@ def _http_call(url, payload, timeout_s):
 
 
 def measure(target, concurrency=8, requests=256, qps=None, rows=1,
-            timeout_ms=None, shape=None, retries=0, seed=0):
+            timeout_ms=None, shape=None, retries=0, seed=0, dtype=None):
     """Run the closed loop; returns the result dict (see module doc).
 
     ``retries``: how many times a rejected (429/ServerBusy) or
@@ -90,6 +90,10 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
     being counted as rejected. The graceful-restart soak sets this > 0
     with a callable ``target`` so retried requests land on the
     replacement server.
+
+    ``dtype``: route every request to that engine family of a
+    multi-dtype server ("int8" for the quantized engines); None serves
+    the primary model. Local-server mode only.
     """
     import numpy as np
 
@@ -153,7 +157,7 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
                     break
                 try:
                     req = get_server().submit(timeout_ms=timeout_ms,
-                                              **feed)
+                                              dtype=dtype, **feed)
                     budget = ((timeout_ms or 30000) / 1e3) + 5
                     req.result(timeout=budget)
                     outcome = "ok"
@@ -233,6 +237,85 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
         except Exception:
             pass
     return out
+
+
+def _predict_callable(target, dtype=None):
+    """(callable feed-dict -> first output np array, input meta) for a
+    Server (routed to ``dtype`` engines), artifact path, or loaded
+    CompiledModel."""
+    import numpy as np
+    from mxnet_tpu import serving
+    from mxnet_tpu.serve import Server
+    if isinstance(target, Server):
+        meta = target.model.meta["inputs"]
+
+        def call(feed):
+            # generous deadline: a probe row may be the first request a
+            # bucket engine sees, i.e. it pays the XLA compile
+            outs = target.predict(timeout_ms=600000, dtype=dtype, **feed)
+            return np.asarray(outs[0])
+        return call, meta
+    if isinstance(target, str):
+        target = serving.load_artifact(target)
+    meta = target.meta["inputs"]
+    model = target
+
+    def call(feed):
+        outs = model(*[feed[s["name"]] for s in meta])
+        if isinstance(outs, (list, tuple)):
+            outs = outs[0]
+        return np.asarray(outs)
+    return call, meta
+
+
+def measure_accuracy(ref_target, quant_target, feeds=None, labels=None,
+                     examples=256, batch=32, seed=0):
+    """Replay the same (labelled) probe set through the f32 reference
+    and the int8 quantized engines and report the top-1 delta — the
+    number the per-bucket accuracy budget in ``bench.py`` gates on.
+
+    ``feeds``: list of feed dicts (each ``batch`` rows); default
+    deterministic synthetic batches from ``seed``. ``labels``: int
+    array over all probe rows; when absent the f32 argmax IS the label
+    (agreement mode: ``top1_f32`` reads 1.0 and ``top1_delta`` is the
+    f32-vs-int8 disagreement rate). ``per_class_drift`` is the per-class
+    |predicted-fraction(f32) - predicted-fraction(int8)| — which classes
+    the quantized model drifts toward/away from.
+    """
+    import numpy as np
+
+    ref_call, meta = _predict_callable(ref_target, dtype="f32")
+    q_call, _ = _predict_callable(quant_target, dtype="int8")
+    if feeds is None:
+        rng = np.random.RandomState(seed)
+        n_batches = max(1, examples // batch)
+        feeds = [{s["name"]: rng.randn(batch, *s["shape"][1:])
+                  .astype(s["dtype"]) for s in meta}
+                 for _ in range(n_batches)]
+    ref_top1, q_top1 = [], []
+    for feed in feeds:
+        ref_top1.append(np.argmax(ref_call(feed), axis=-1).ravel())
+        q_top1.append(np.argmax(q_call(feed), axis=-1).ravel())
+    ref_top1 = np.concatenate(ref_top1)
+    q_top1 = np.concatenate(q_top1)
+    n = len(ref_top1)
+    labelled = labels is not None
+    labels = (np.asarray(labels).ravel()[:n] if labelled else ref_top1)
+    acc_f = float((ref_top1 == labels).mean())
+    acc_q = float((q_top1 == labels).mean())
+    classes = np.unique(np.concatenate([ref_top1, q_top1, labels]))
+    drift = {int(c): round(abs(float((ref_top1 == c).mean())
+                               - float((q_top1 == c).mean())), 6)
+             for c in classes}
+    return {
+        "examples": n,
+        "top1_f32": round(acc_f, 6),
+        "top1_int8": round(acc_q, 6),
+        "top1_delta": round(acc_f - acc_q, 6),
+        "agreement": round(float((ref_top1 == q_top1).mean()), 6),
+        "per_class_drift": drift,
+        "labelled": labelled,
+    }
 
 
 def _sample_lengths(rng, n, mean, dist, lo, hi):
@@ -493,6 +576,19 @@ def main():
                    help="HTTP --generate mode: the model's vocab size")
     p.add_argument("--max-prompt-len", type=int, default=None)
     p.add_argument("--max-context", type=int, default=None)
+    p.add_argument("--accuracy-probe", action="store_true",
+                   help="instead of a load run: replay a labelled probe "
+                        "set through --artifact (f32) and "
+                        "--quant-artifact (int8), report top-1 delta + "
+                        "per-class drift")
+    p.add_argument("--quant-artifact", default=None,
+                   help="format_version-4 int8 artifact "
+                        "(--accuracy-probe)")
+    p.add_argument("--probe-npz", default=None,
+                   help=".npz with 'data' (+ optional 'labels') for the "
+                        "probe; default synthetic from --seed")
+    p.add_argument("--probe-examples", type=int, default=256)
+    p.add_argument("--probe-batch", type=int, default=32)
     p.add_argument("--platform", default=None, choices=[None, "cpu"])
     p.add_argument("--out", default=None, help="also write JSON here")
     p.add_argument("--scrape-metrics", action="store_true",
@@ -506,6 +602,31 @@ def main():
     if args.platform == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    if args.accuracy_probe:
+        if not (args.artifact and args.quant_artifact):
+            p.error("--accuracy-probe needs --artifact (the f32 "
+                    "reference) and --quant-artifact (the int8 sibling)")
+        import numpy as np
+        feeds = labels = None
+        if args.probe_npz:
+            blob = np.load(args.probe_npz)
+            arr = blob["data"].astype(np.float32)
+            bs = args.probe_batch
+            feeds = [{"data": arr[i:i + bs]}
+                     for i in range(0, len(arr) - bs + 1, bs)]
+            if "labels" in blob.files:
+                labels = blob["labels"][:len(feeds) * bs]
+        res = measure_accuracy(
+            args.artifact, args.quant_artifact, feeds=feeds,
+            labels=labels, examples=args.probe_examples,
+            batch=args.probe_batch, seed=args.seed)
+        line = json.dumps(res)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line)
+        return
 
     if args.url:
         target = args.url
