@@ -376,6 +376,35 @@ register_flag("quant_accuracy_budget", "MXNET_QUANT_ACCURACY_BUDGET",
               "top-1 delta vs the f32 reference exceeds this fraction "
               "(default 0.5%). Ratchet like the perf budgets: only "
               "tighten.")
+register_flag("fleet_heartbeat_s", "MXNET_FLEET_HEARTBEAT_S", float, 1.0,
+              "Replica -> router heartbeat interval (seconds) when "
+              "serving with --register. Each beat carries readiness "
+              "(liveness != readiness) and the perfmodel-derived load "
+              "summary the router's least-loaded policy scores on.")
+register_flag("fleet_heartbeat_timeout_s", "MXNET_FLEET_HEARTBEAT_TIMEOUT_S",
+              float, 5.0,
+              "Router-side liveness: a replica whose last heartbeat is "
+              "older than this is marked dead and pulled from rotation "
+              "(the HTTP twin of parallel/fault.py's stale heartbeat "
+              "files). In-flight decode sessions on a dead replica are "
+              "resumed on survivors via their eviction cursors.")
+register_flag("fleet_hop_tokens", "MXNET_FLEET_HOP_TOKENS", int, 32,
+              "Router generate-path hop size: the router forwards at "
+              "most this many tokens per replica round-trip, so it "
+              "always holds a recent resume cursor for transparent "
+              "migration when the owning replica dies or drains. 0 = "
+              "forward the whole budget in one hop (no mid-request "
+              "migration checkpointing).")
+register_flag("fleet_retry_limit", "MXNET_FLEET_RETRY_LIMIT", int, 3,
+              "How many alternate replicas the router tries for one "
+              "request after rejections/deaths before propagating the "
+              "last error to the client.")
+register_flag("fleet_proxy_timeout_s", "MXNET_FLEET_PROXY_TIMEOUT_S",
+              float, 60.0,
+              "Router-side socket timeout for one proxied replica call "
+              "(requests with their own timeout_ms get that + margin "
+              "instead). A hop that exceeds it counts as a replica "
+              "failure and is retried on a survivor.")
 register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
               "Training-side telemetry HTTP listener port "
               "(mxnet_tpu.telemetry.exporters): serves /metrics "

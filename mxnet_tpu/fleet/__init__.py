@@ -1,0 +1,27 @@
+"""Fleet serving tier: router, replica registry, and supervisor.
+
+The layer above one ``serve/`` process (ROADMAP item 1): N replica
+servers register into a :class:`ReplicaRegistry`, a :class:`Router`
+load-balances ``/v1/predict`` least-loaded on perfmodel-derived cost
+estimates and routes ``/v1/generate`` session-affine with transparent
+cursor migration off dead/draining replicas, and a
+:class:`ReplicaSupervisor` keeps replica processes alive with the same
+capped-jittered-backoff restart discipline ``tools/launch.py`` gives
+training workers. Blue/green multi-version hosting and int8 canary
+auto-rollback ride on the registry's ``(model, version)`` identity.
+
+Entry points: ``tools/route.py`` (router CLI), ``tools/serve.py
+--register`` (replica side). docs/fleet.md is the operator tour.
+"""
+from __future__ import annotations
+
+from .registry import Replica, ReplicaAnnouncer, ReplicaRegistry
+from .router import (NoReplica, Router, RouterHTTPFrontEnd,
+                     route_http)
+from .supervisor import ReplicaSpec, ReplicaSupervisor, backoff_delay
+
+__all__ = [
+    "Replica", "ReplicaAnnouncer", "ReplicaRegistry",
+    "NoReplica", "Router", "RouterHTTPFrontEnd", "route_http",
+    "ReplicaSpec", "ReplicaSupervisor", "backoff_delay",
+]

@@ -1,0 +1,348 @@
+"""Replica registry: the router's authoritative view of the fleet.
+
+Push-based, mirroring ``parallel/fault.py``'s heartbeat-file liveness
+but over HTTP (replicas and router are separate hosts in production):
+each ``tools/serve.py --register`` replica POSTs ``/fleet/register``
+once, then ``/fleet/heartbeat`` every ``MXNET_FLEET_HEARTBEAT_S``
+carrying its readiness (liveness != readiness — a draining or
+engine-warming replica is alive but must leave rotation) and a
+perfmodel-derived load summary (``load_s`` = estimated seconds of
+queued work, ``unit_s`` = estimated seconds per additional request —
+the same ``perfmodel.roofline_seconds`` numbers the replica's own
+admission control uses, NOT a new router-side heuristic). A heartbeat
+older than ``MXNET_FLEET_HEARTBEAT_TIMEOUT_S`` marks the replica dead,
+exactly like a stale heartbeat file marks a training rank dead.
+
+Identity matters for blue/green: a replica registers under a
+``(model, version)`` pair plus the artifact's content hash
+(:func:`mxnet_tpu.serving.artifact_identity`), so a traffic split is a
+statement about *artifacts*, not processes.
+
+Stdlib-only; the announcer half (replica side) is a thin urllib client.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["Replica", "ReplicaRegistry", "ReplicaAnnouncer"]
+
+
+class Replica:
+    """One registered serving process, as the router sees it."""
+
+    __slots__ = ("id", "url", "model", "version", "mode", "identity",
+                 "pid", "registered_at", "last_heartbeat", "ready",
+                 "reason", "load", "dead", "dead_reason", "draining",
+                 "inflight", "served", "static", "spec")
+
+    def __init__(self, rid, url, model, version, mode, identity=None,
+                 pid=None):
+        self.id = str(rid)
+        self.url = str(url).rstrip("/")
+        self.model = str(model)
+        self.version = str(version)
+        self.mode = str(mode)          # "predict" | "generate"
+        self.identity = identity or {}
+        self.pid = pid
+        now = time.monotonic()
+        self.registered_at = now
+        self.last_heartbeat = now
+        self.ready = False             # as reported by the replica
+        self.reason = "registered"     # why not ready, when not
+        self.load = {}                 # {"load_s", "unit_s", ...}
+        self.dead = False
+        self.dead_reason = None
+        self.draining = False          # router-side: pulled from rotation
+        self.inflight = 0              # router-side in-flight counter
+        self.served = 0                # router-side routed-request count
+        self.static = False            # seeded, no heartbeats: never swept
+        self.spec = {}                 # generate wire geometry (e.g.
+                                       # max_prompt_len caps hop chunking)
+
+    def score(self):
+        """Least-loaded routing score: estimated seconds of queued work
+        on the replica plus the marginal cost of the requests this
+        router already has in flight there. Both terms come from the
+        replica's perfmodel-derived heartbeat."""
+        load_s = float(self.load.get("load_s", 0.0) or 0.0)
+        unit_s = float(self.load.get("unit_s", 0.0) or 0.0)
+        return load_s + self.inflight * unit_s
+
+    def snapshot(self, now=None):
+        now = time.monotonic() if now is None else now
+        return {
+            "id": self.id, "url": self.url, "model": self.model,
+            "version": self.version, "mode": self.mode,
+            "identity": self.identity, "pid": self.pid,
+            "ready": self.ready, "reason": self.reason,
+            "dead": self.dead, "dead_reason": self.dead_reason,
+            "draining": self.draining, "load": self.load,
+            "inflight": self.inflight, "served": self.served,
+            "heartbeat_age_s": round(now - self.last_heartbeat, 3),
+        }
+
+
+class ReplicaRegistry:
+    """Thread-safe replica table with heartbeat-staleness sweeping."""
+
+    def __init__(self, heartbeat_timeout_s=None):
+        if heartbeat_timeout_s is None:
+            from ..config import flags
+            heartbeat_timeout_s = flags.fleet_heartbeat_timeout_s
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._lock = threading.Lock()
+        self._replicas = {}
+
+    # -- replica-driven lifecycle ------------------------------------------
+    def register(self, info):
+        """Upsert from a registration payload (dict with id/url/model/
+        version/mode + optional identity/pid/ready/reason/load).
+        Re-registration (a supervised restart reusing the id) resets
+        death state."""
+        rid = str(info["id"])
+        with self._lock:
+            rep = Replica(rid, info["url"], info.get("model", "default"),
+                          info.get("version", "0"),
+                          info.get("mode", "predict"),
+                          identity=info.get("identity"),
+                          pid=info.get("pid"))
+            rep.ready = bool(info.get("ready", False))
+            rep.reason = info.get("reason")
+            rep.load = dict(info.get("load") or {})
+            rep.static = bool(info.get("static", False))
+            rep.spec = dict(info.get("spec") or {})
+            self._replicas[rid] = rep
+        return rep
+
+    def heartbeat(self, rid, ready=None, reason=None, load=None):
+        """Refresh liveness + readiness; returns False for an unknown id
+        (the announcer re-registers on that — the router may have
+        restarted and lost its table)."""
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            if rep is None:
+                return False
+            rep.last_heartbeat = time.monotonic()
+            if rep.dead:
+                # a heartbeat from the "dead" is a liveness correction
+                # (e.g. a transient proxy failure marked it dead)
+                rep.dead = False
+                rep.dead_reason = None
+            if ready is not None:
+                rep.ready = bool(ready)
+            if reason is not None or ready:
+                rep.reason = reason
+            if load is not None:
+                rep.load = dict(load)
+            return True
+
+    def deregister(self, rid):
+        with self._lock:
+            return self._replicas.pop(str(rid), None) is not None
+
+    # -- router-driven state -----------------------------------------------
+    def mark_dead(self, rid, why):
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            if rep is not None and not rep.dead:
+                rep.dead = True
+                rep.dead_reason = str(why)
+                rep.ready = False
+
+    def mark_not_ready(self, rid, why):
+        """Soft pull (a 503 from the data path): out of rotation until
+        its next heartbeat says otherwise."""
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            if rep is not None:
+                rep.ready = False
+                rep.reason = str(why)
+
+    def set_draining(self, rid, draining=True):
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            if rep is None:
+                return False
+            rep.draining = bool(draining)
+            return True
+
+    def note_inflight(self, rid, delta):
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            if rep is not None:
+                rep.inflight = max(0, rep.inflight + delta)
+                if delta > 0:
+                    rep.served += 1
+
+    def sweep(self, now=None):
+        """Mark replicas with stale heartbeats dead; returns the newly
+        dead ids. Called lazily from every routing decision — no
+        background thread needed."""
+        now = time.monotonic() if now is None else now
+        newly = []
+        with self._lock:
+            for rep in self._replicas.values():
+                if (not rep.dead and not rep.static
+                        and now - rep.last_heartbeat
+                        > self.heartbeat_timeout_s):
+                    rep.dead = True
+                    rep.ready = False
+                    rep.dead_reason = ("no heartbeat for %.1fs (timeout "
+                                       "%.1fs)" % (now - rep.last_heartbeat,
+                                                   self.heartbeat_timeout_s))
+                    newly.append(rep.id)
+        return newly
+
+    # -- queries ------------------------------------------------------------
+    def get(self, rid):
+        with self._lock:
+            return self._replicas.get(str(rid))
+
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas.values())
+
+    def live_replicas(self):
+        return [r for r in self.replicas() if not r.dead]
+
+    def is_routable(self, rid):
+        rep = self.get(rid)
+        return (rep is not None and not rep.dead and not rep.draining
+                and rep.ready)
+
+    def routable(self, model=None, mode=None, version=None):
+        """Replicas eligible for new traffic: alive, fresh heartbeat,
+        reporting ready, not router-drained — filtered by model/mode/
+        version when given."""
+        self.sweep()
+        out = []
+        with self._lock:
+            for rep in self._replicas.values():
+                if rep.dead or rep.draining or not rep.ready:
+                    continue
+                if model is not None and rep.model != str(model):
+                    continue
+                if mode is not None and rep.mode != mode:
+                    continue
+                if version is not None and rep.version != str(version):
+                    continue
+                out.append(rep)
+        return out
+
+    def models(self):
+        """{model: {version: [replica ids]}} over non-dead replicas."""
+        out = {}
+        with self._lock:
+            for rep in self._replicas.values():
+                if rep.dead:
+                    continue
+                out.setdefault(rep.model, {}).setdefault(
+                    rep.version, []).append(rep.id)
+        return out
+
+    def snapshot(self):
+        now = time.monotonic()
+        with self._lock:
+            reps = [r.snapshot(now) for r in self._replicas.values()]
+        reps.sort(key=lambda r: r["id"])
+        return {
+            "replicas": reps,
+            "counts": {
+                "total": len(reps),
+                "ready": sum(1 for r in reps
+                             if r["ready"] and not r["dead"]
+                             and not r["draining"]),
+                "dead": sum(1 for r in reps if r["dead"]),
+                "draining": sum(1 for r in reps if r["draining"]),
+            },
+            "heartbeat_timeout_s": self.heartbeat_timeout_s,
+        }
+
+
+def _post_json(url, payload, timeout_s=3.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return json.loads(r.read().decode() or "{}")
+
+
+class ReplicaAnnouncer:
+    """Replica-side registration + heartbeat client.
+
+    ``info`` is the static registration payload (id/url/model/version/
+    mode/identity/pid); ``status_fn()`` returns the live part each beat:
+    ``{"ready": bool, "reason": str|None, "load": {...}}``. Failures are
+    absorbed (a router restart must not kill a healthy replica); an
+    unknown-id heartbeat answer triggers re-registration."""
+
+    def __init__(self, router_url, info, status_fn, interval_s=None):
+        if interval_s is None:
+            from ..config import flags
+            interval_s = flags.fleet_heartbeat_s
+        self.router_url = str(router_url).rstrip("/")
+        self.info = dict(info)
+        self.status_fn = status_fn
+        self.interval_s = float(interval_s)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = None
+        self.registered = threading.Event()
+
+    def _register_once(self):
+        payload = dict(self.info)
+        payload.update(self.status_fn())
+        _post_json(self.router_url + "/fleet/register", payload)
+        self.registered.set()
+
+    def _beat_once(self):
+        status = self.status_fn()
+        out = _post_json(self.router_url + "/fleet/heartbeat",
+                         {"id": self.info["id"], **status})
+        if not out.get("known", True):
+            self._register_once()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                if not self.registered.is_set():
+                    self._register_once()
+                else:
+                    self._beat_once()
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    ValueError):
+                pass      # router down/restarting; keep beating
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="mxtpu-fleet-announcer",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def notify(self):
+        """Force an immediate heartbeat (readiness just changed — e.g.
+        drain began; the router should pull us from rotation *now*, not
+        an interval later)."""
+        self._wake.set()
+
+    def stop(self, deregister=True):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+        if deregister:
+            try:
+                _post_json(self.router_url + "/fleet/deregister",
+                           {"id": self.info["id"]}, timeout_s=2.0)
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    ValueError):
+                pass

@@ -1,0 +1,701 @@
+"""Fleet router: the tier above one ``serve/`` process.
+
+One router process fronts N replica servers (``tools/serve.py
+--register``) and speaks the *same* client protocol they do, so a
+client pointed at a replica yesterday points at the router today:
+
+* ``POST /v1/predict`` — **least-loaded**: each replica's heartbeat
+  carries ``load_s`` (estimated seconds of queued work) and ``unit_s``
+  (estimated seconds per marginal request), both derived from
+  ``perfmodel.roofline_seconds`` on the replica (the identical cost
+  tables its own admission control uses); the router picks the minimum
+  ``load_s + inflight * unit_s`` and retries rejections/deaths on the
+  next-best replica.
+* ``POST /v1/generate`` — **session-affine with cursor migration**: a
+  decode session's KV pages live on one replica, so the router parks
+  the whole generation there — but forwards it in *hops* of at most
+  ``MXNET_FLEET_HOP_TOKENS`` tokens, which means it always holds a
+  resume point (``prompt + tokens so far``, the exact shape of the
+  PR-9 eviction cursor). When the owner dies mid-hop or drains
+  (eviction cursor in a 429), the router resubmits on a survivor and
+  stitches the tail; position-keyed sampling makes the stitched stream
+  **bitwise identical** to an uninterrupted run, which the migration
+  test asserts token-for-token.
+* blue/green + canary: replicas register under ``(model, version)``;
+  ``/admin/split`` sets version weights, ``/admin/canary`` starts a
+  canary at a small split with the PR-10 accuracy-probe delta as the
+  rollback signal (``/admin/canary/report``; budget
+  ``MXNET_QUANT_ACCURACY_BUDGET``), and rollback is router-side only —
+  new traffic stops, in-flight requests on the canary finish — so zero
+  requests drop.
+* ``GET /metrics`` — federation: every live replica's exposition
+  merged under ``replica="<id>"`` labels plus the router's own
+  ``fleet/*`` series (``telemetry/federate.py``).
+
+Import-light by design (stdlib + config + telemetry): the router never
+runs model code or touches a device — replicas own the accelerators;
+the router holds only cursors, counters, and the registry.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..base import MXNetError
+from ..config import flags
+from .. import telemetry
+from ..telemetry import federate
+from .registry import ReplicaRegistry
+
+__all__ = ["Router", "NoReplica", "RouterHTTPFrontEnd", "route_http"]
+
+
+class NoReplica(MXNetError):
+    """No ready replica can take this request."""
+
+
+class Router:
+    """Routing core; :class:`RouterHTTPFrontEnd` is the wire skin.
+
+    Public entry points (``route_predict``/``route_generate``) return
+    ``(status_code, payload_dict, extra_headers)`` so the HTTP handler
+    and in-process tests share one code path."""
+
+    def __init__(self, registry=None, hop_tokens=None, retry_limit=None,
+                 proxy_timeout_s=None, rng=None):
+        self.registry = registry or ReplicaRegistry()
+        self.hop_tokens = (flags.fleet_hop_tokens if hop_tokens is None
+                           else int(hop_tokens))
+        self.retry_limit = (flags.fleet_retry_limit if retry_limit is None
+                            else int(retry_limit))
+        self.proxy_timeout_s = (flags.fleet_proxy_timeout_s
+                                if proxy_timeout_s is None
+                                else float(proxy_timeout_s))
+        self._rng = rng or random.Random(0x5EED)
+        self._lock = threading.Lock()
+        self.splits = {}     # model -> {version: weight} (normalized)
+        self.canaries = {}   # model -> canary record dict
+        reg = telemetry.default_registry()
+        self._c_requests = reg.counter(
+            "fleet/requests", "Requests routed, by kind and outcome.")
+        self._c_retries = reg.counter(
+            "fleet/retries", "Re-routes after a replica rejected/died.")
+        self._c_hops = reg.counter(
+            "fleet/generate_hops", "Generate hops forwarded to replicas.")
+        self._c_migrations = reg.counter(
+            "fleet/migrations",
+            "Decode sessions moved to a surviving replica via cursor.")
+        self._c_deaths = reg.counter(
+            "fleet/replica_deaths", "Replicas marked dead by the router.")
+        self._c_rollbacks = reg.counter(
+            "fleet/canary_rollbacks", "Canaries auto-rolled back.")
+        self._g_ready = reg.gauge(
+            "fleet/replicas_ready", "Replicas currently in rotation.")
+
+    # -- proxy plumbing -----------------------------------------------------
+    def _call(self, url, payload, timeout_s):
+        """POST json; returns (status, body_dict, headers). Connection
+        failures raise (the caller marks the replica dead)."""
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return r.status, json.loads(r.read().decode() or "{}"), \
+                    dict(r.headers)
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read().decode() or "{}")
+            except ValueError:
+                body = {"error": "replica returned unparseable body"}
+            return e.code, body, dict(e.headers)
+
+    def _scrape(self, url, timeout_s=5.0):
+        req = urllib.request.Request(
+            url + "/metrics?format=prometheus",
+            headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.read().decode("utf-8")
+
+    # -- replica selection --------------------------------------------------
+    def _resolve_model(self, model, cands):
+        if model is not None:
+            return str(model)
+        names = sorted({r.model for r in cands})
+        if len(names) == 1:
+            return names[0]
+        raise NoReplica(
+            "fleet: %d models hosted (%s); the request must name one "
+            'with {"model": ...}' % (len(names), names))
+
+    def _choose_version(self, model, by_version):
+        """Weighted version choice per the model's traffic split; falls
+        back to every ready version (availability beats policy) when
+        the split names none of them."""
+        with self._lock:
+            split = dict(self.splits.get(model) or {})
+        if split:
+            avail = {v: w for v, w in split.items()
+                     if v in by_version and w > 0.0}
+            if avail:
+                total = sum(avail.values())
+                x = self._rng.random() * total
+                for v, w in sorted(avail.items()):
+                    x -= w
+                    if x <= 0:
+                        return v
+                return sorted(avail)[-1]
+            # a split is a statement of intent: versions weighted 0 (a
+            # rolled-back canary) stay out even when the split's chosen
+            # versions are all down — unless NOTHING else is ready.
+            allowed = [v for v in by_version if v not in split]
+            if allowed:
+                return None if len(allowed) > 1 else allowed[0]
+        return None    # no preference: least-loaded across all versions
+
+    def _pick(self, model=None, version=None, mode=None, exclude=()):
+        cands = self.registry.routable(model=model, mode=mode)
+        cands = [r for r in cands if r.id not in exclude]
+        self._g_ready.set(len(cands))
+        if not cands:
+            raise NoReplica(
+                "fleet: no ready %s replica%s%s (check /fleet for "
+                "replica states)"
+                % (mode or "", " for model %r" % model if model else "",
+                   " excluding %s" % sorted(exclude) if exclude else ""))
+        model = self._resolve_model(model, cands)
+        cands = [r for r in cands if r.model == model]
+        if not cands:
+            raise NoReplica("fleet: no ready replica for model %r" % model)
+        if version is None:
+            by_version = {}
+            for r in cands:
+                by_version.setdefault(r.version, []).append(r)
+            chosen = self._choose_version(model, by_version)
+            if chosen is not None:
+                cands = by_version[chosen]
+        else:
+            cands = [r for r in cands if r.version == str(version)]
+            if not cands:
+                raise NoReplica(
+                    "fleet: no ready replica for model %r version %r"
+                    % (model, version))
+        # least-loaded on the perfmodel-derived heartbeat score;
+        # `served` tie-breaks into round-robin on a cold fleet
+        return min(cands, key=lambda r: (r.score(), r.served, r.id))
+
+    # -- predict path -------------------------------------------------------
+    def route_predict(self, payload):
+        model = payload.get("model")
+        version = payload.get("version")
+        body = {k: v for k, v in payload.items()
+                if k not in ("model", "version")}
+        timeout_s = self.proxy_timeout_s
+        if payload.get("timeout_ms"):
+            timeout_s = payload["timeout_ms"] / 1e3 + 5.0
+        tried = set()
+        last = None
+        for attempt in range(self.retry_limit + 1):
+            try:
+                rep = self._pick(model, version, "predict", exclude=tried)
+            except NoReplica as e:
+                if last is not None:
+                    self._c_requests.inc(kind="predict", outcome="rejected")
+                    return last
+                self._c_requests.inc(kind="predict", outcome="no_replica")
+                return 503, {"error": str(e)}, {}
+            tried.add(rep.id)
+            if attempt > 0:
+                self._c_retries.inc(kind="predict")
+            self.registry.note_inflight(rep.id, +1)
+            try:
+                status, out, headers = self._call(
+                    rep.url + "/v1/predict", body, timeout_s)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                self.registry.mark_dead(
+                    rep.id, "predict proxy failed: %s" % e)
+                self._c_deaths.inc()
+                continue
+            finally:
+                self.registry.note_inflight(rep.id, -1)
+            if status == 200:
+                out["replica"] = rep.id
+                out["version"] = rep.version
+                self._c_requests.inc(kind="predict", outcome="ok")
+                return 200, out, {}
+            if status in (429, 503):
+                # busy/draining: remember the hint, try the next-best
+                extra = {}
+                if headers.get("Retry-After"):
+                    extra["Retry-After"] = headers["Retry-After"]
+                if status == 503:
+                    self.registry.mark_not_ready(rep.id, "answered 503")
+                last = (status, out, extra)
+                continue
+            # 400/500/504: the replica answered definitively
+            self._c_requests.inc(kind="predict", outcome="error")
+            return status, out, {}
+        self._c_requests.inc(kind="predict", outcome="rejected")
+        return last or (503, {"error": "fleet: every replica rejected "
+                                       "this request"}, {})
+
+    # -- generate path ------------------------------------------------------
+    def _partial_cursor(self, prompt, tokens, remaining):
+        # same shape GenerateSession._cursor emits, so a client can
+        # resubmit a router-partial exactly like a replica eviction
+        return {"prompt": list(prompt), "generated": list(tokens),
+                "resume_prompt": list(prompt) + list(tokens),
+                "remaining_tokens": int(remaining)}
+
+    def route_generate(self, payload):
+        model = payload.get("model")
+        version = payload.get("version")
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            return 400, {"error": 'body must be {"prompt": [ids], ...}'}, {}
+        # mirror tools/serve.py's --max-new-tokens default: hop chunking
+        # needs a concrete total budget
+        remaining = int(payload.get("max_new_tokens") or 64)
+        temperature = payload.get("temperature", 0.0)
+        seed = payload.get("seed", 0)
+        deadline = None
+        if payload.get("timeout_ms"):
+            deadline = time.monotonic() + payload["timeout_ms"] / 1e3
+        hop = self.hop_tokens
+        t0 = time.monotonic()
+        tokens = []
+        cur_prompt = [int(t) for t in prompt]
+        finish = "length"
+        owner = None
+        owner_version = None
+        hops = 0
+        migrations = 0
+        replicas_used = []
+        failures = 0          # deaths + busy-rejections, bounded
+        stalls = 0            # consecutive zero-token hops
+        ttft_ms = None
+        max_failures = max(2, self.retry_limit) * 4
+
+        def _partial(status, err, retry_after=0.1):
+            self._c_requests.inc(kind="generate", outcome="partial")
+            return status, {
+                "error": err, "tokens": tokens,
+                "cursor": self._partial_cursor(prompt, tokens, remaining),
+                "retry_after_s": retry_after,
+            }, {"Retry-After": "%.3f" % retry_after}
+
+        last_oid = None       # survives owner=None across a death
+        while remaining > 0:
+            if owner is None or not self.registry.is_routable(owner.id):
+                try:
+                    owner = self._pick(model, version, "generate",
+                                       exclude=())
+                except NoReplica as e:
+                    return _partial(429, str(e), retry_after=1.0)
+                owner_version = owner.version
+                if last_oid is not None and owner.id != last_oid:
+                    migrations += 1
+                    self._c_migrations.inc()
+                last_oid = owner.id
+                if owner.id not in replicas_used:
+                    replicas_used.append(owner.id)
+            if deadline is not None and time.monotonic() >= deadline:
+                return _partial(429, "fleet: request deadline reached "
+                                     "mid-generation")
+            n = min(remaining, hop) if hop > 0 else remaining
+            cap = int(owner.spec.get("max_prompt_len") or 0)
+            if n < remaining and cap and len(cur_prompt) + n > cap:
+                # a resume point is prompt+generated, and it must fit
+                # the artifact's prefill window to be resubmittable (the
+                # same bound gates PR-9 eviction cursors). Once the
+                # post-hop prompt would exceed max_prompt_len there is
+                # nothing to migrate to, so stop chunking and forward
+                # the whole remaining budget in one final hop.
+                n = remaining
+            body = {"prompt": cur_prompt, "max_new_tokens": int(n),
+                    "temperature": temperature, "seed": seed}
+            timeout_s = self.proxy_timeout_s
+            if deadline is not None:
+                budget_ms = max(1.0, (deadline - time.monotonic()) * 1e3)
+                body["timeout_ms"] = budget_ms
+                timeout_s = budget_ms / 1e3 + 30.0
+            oid = owner.id
+            self.registry.note_inflight(oid, +1)
+            try:
+                status, out, _headers = self._call(
+                    owner.url + "/v1/generate", body, timeout_s)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                # the owner died mid-hop; the hop's tokens died with its
+                # KV pages — resubmitting cur_prompt on a survivor
+                # regenerates them bitwise (position-keyed sampling)
+                self.registry.mark_dead(
+                    oid, "generate proxy failed: %s" % e)
+                self._c_deaths.inc()
+                failures += 1
+                if failures > max_failures:
+                    return _partial(429, "fleet: replica kept failing "
+                                         "mid-generation")
+                owner = None
+                continue
+            finally:
+                self.registry.note_inflight(oid, -1)
+            hops += 1
+            self._c_hops.inc()
+            if status == 200:
+                got = [int(t) for t in out.get("tokens", [])]
+                tokens.extend(got)
+                remaining -= len(got)
+                cur_prompt = cur_prompt + got
+                if ttft_ms is None:
+                    ttft_ms = out.get("ttft_ms")
+                stalls = stalls + 1 if not got else 0
+                if out.get("finish_reason") == "stop":
+                    finish = "stop"
+                    break
+                if stalls >= 3:
+                    return _partial(429, "fleet: generation stalled "
+                                         "(3 empty hops)")
+                continue
+            if status == 429 and out.get("cursor"):
+                # eviction (drain/deadline on the replica): bank the
+                # partial tokens, resume from the cursor elsewhere
+                got = [int(t) for t in out.get("tokens", [])]
+                tokens.extend(got)
+                remaining -= len(got)
+                cur_prompt = [int(t) for t in out["cursor"]["resume_prompt"]]
+                stalls = stalls + 1 if not got else 0
+                if stalls >= 3:
+                    return _partial(429, "fleet: generation stalled "
+                                         "(3 empty eviction hops)")
+                time.sleep(min(float(out.get("retry_after_s", 0.05)), 0.5))
+                continue
+            if status in (429, 503):       # busy or draining, no progress
+                if status == 503:
+                    self.registry.mark_not_ready(owner.id, "answered 503")
+                    owner = None
+                failures += 1
+                if failures > max_failures:
+                    return _partial(status, out.get(
+                        "error", "fleet: replicas kept rejecting"))
+                time.sleep(min(float((out or {}).get("retry_after_s",
+                                                     0.05)), 0.5))
+                continue
+            # 400/500/504: definitive — propagate the replica's answer
+            self._c_requests.inc(kind="generate", outcome="error")
+            return status, out, {}
+        self._c_requests.inc(kind="generate", outcome="ok")
+        lat_ms = (time.monotonic() - t0) * 1e3
+        n_gen = len(tokens)
+        return 200, {
+            "tokens": tokens,
+            "finish_reason": finish,
+            "ttft_ms": ttft_ms,
+            "tpot_ms": (round((lat_ms - (ttft_ms or 0.0))
+                              / max(1, n_gen - 1), 3)
+                        if n_gen > 1 else None),
+            "latency_ms": round(lat_ms, 3),
+            "hops": hops,
+            "migrations": migrations,
+            "replicas": replicas_used,
+            "replica": replicas_used[-1] if replicas_used else None,
+            "version": owner_version,
+        }, {}
+
+    # -- blue/green + canary ------------------------------------------------
+    def set_split(self, model, weights):
+        """Set the version traffic split for ``model`` (weights are
+        normalized; a missing version gets zero traffic)."""
+        clean = {}
+        for v, w in dict(weights).items():
+            w = float(w)
+            if w < 0:
+                raise MXNetError("fleet: negative split weight %r for "
+                                 "version %r" % (w, v))
+            clean[str(v)] = w
+        total = sum(clean.values())
+        if total <= 0:
+            raise MXNetError("fleet: split weights must sum > 0")
+        with self._lock:
+            self.splits[str(model)] = {v: w / total
+                                       for v, w in clean.items()}
+        return dict(self.splits[str(model)])
+
+    def clear_split(self, model):
+        with self._lock:
+            self.splits.pop(str(model), None)
+
+    def promote(self, model, version):
+        """Blue/green flip: 100% of ``model`` traffic to ``version``.
+        Old-version replicas stay registered (instant rollback path);
+        their in-flight requests finish — the router just stops handing
+        them new ones."""
+        model, version = str(model), str(version)
+        with self._lock:
+            self.splits[model] = {version: 1.0}
+            c = self.canaries.get(model)
+            if c is not None and c["version"] == version:
+                c["state"] = "promoted"
+        return {"model": model, "split": {version: 1.0}}
+
+    def start_canary(self, model, version, split=0.1, budget=None):
+        """Send ``split`` of ``model`` traffic to ``version``; keep the
+        previous split as the rollback baseline. ``budget`` defaults to
+        the int8 accuracy budget flag — the PR-10 probe's top-1 delta
+        is the rollback signal."""
+        model, version = str(model), str(version)
+        split = float(split)
+        if not 0.0 < split < 1.0:
+            raise MXNetError("fleet: canary split must be in (0, 1)")
+        if budget is None:
+            budget = flags.quant_accuracy_budget
+        with self._lock:
+            baseline = dict(self.splits.get(model) or {})
+            if not baseline:
+                versions = sorted(v for v in
+                                  self.registry.models().get(model, {})
+                                  if v != version)
+                if not versions:
+                    raise MXNetError(
+                        "fleet: no baseline version of %r to canary "
+                        "against" % model)
+                baseline = {v: 1.0 / len(versions) for v in versions}
+            mixed = {v: w * (1.0 - split) for v, w in baseline.items()}
+            mixed[version] = mixed.get(version, 0.0) + split
+            self.splits[model] = mixed
+            self.canaries[model] = {
+                "model": model, "version": version, "split": split,
+                "budget": float(budget), "baseline": baseline,
+                "deltas": [], "state": "active", "reason": None,
+            }
+            return dict(self.canaries[model], deltas=[])
+
+    def report_canary(self, model, delta, version=None):
+        """Feed one accuracy-probe delta (f32-vs-canary top-1 delta,
+        ``tools/serve_loadgen.py --accuracy-probe`` shape). Exceeding
+        the budget triggers automatic rollback: the canary version's
+        weight goes to ZERO (baseline split restored) and its replicas
+        are put in router-side draining — new traffic stops instantly,
+        in-flight requests complete on the still-running processes, so
+        nothing drops."""
+        model = str(model)
+        with self._lock:
+            c = self.canaries.get(model)
+            if c is None or c["state"] != "active":
+                raise MXNetError(
+                    "fleet: no active canary for model %r" % model)
+            if version is not None and str(version) != c["version"]:
+                raise MXNetError(
+                    "fleet: canary for %r is version %r, not %r"
+                    % (model, c["version"], version))
+            delta = float(delta)
+            c["deltas"].append(delta)
+            if abs(delta) <= c["budget"]:
+                return {"state": "active", "action": "none",
+                        "delta": delta, "budget": c["budget"]}
+            # rollback: restore the baseline split; the canary version
+            # keeps weight 0 via absence from the split
+            c["state"] = "rolled_back"
+            reason = ("accuracy delta %.6f exceeds budget %.6f"
+                      % (delta, c["budget"]))
+            c["reason"] = reason
+            self.splits[model] = {v: w for v, w in c["baseline"].items()
+                                  if v != c["version"]} or c["baseline"]
+            canary_version = c["version"]
+            budget = c["budget"]
+        self._c_rollbacks.inc()
+        drained = []
+        for rep in self.registry.live_replicas():
+            if rep.model == model and rep.version == canary_version:
+                self.registry.set_draining(rep.id)
+                drained.append(rep.id)
+        return {"state": "rolled_back", "action": "rollback",
+                "delta": delta, "budget": budget, "reason": reason,
+                "drained_replicas": drained}
+
+    # -- observability ------------------------------------------------------
+    def federated_metrics(self):
+        """The fleet ``/metrics`` body: every live replica's exposition
+        merged under ``replica=<id>`` labels, plus the router's own
+        series as ``replica="router"``."""
+        sources = [("router", telemetry.prometheus_text())]
+        errors = {}
+        for rep in self.registry.live_replicas():
+            try:
+                sources.append((rep.id, self._scrape(rep.url)))
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    ValueError) as e:
+                errors[rep.id] = str(e)
+        text, skipped = federate.merge_expositions(sources)
+        for sid, err in skipped:
+            errors[sid] = "unparseable exposition: %s" % err
+        return text, errors
+
+    def fleet_snapshot(self):
+        self.registry.sweep()
+        with self._lock:
+            splits = {m: dict(s) for m, s in self.splits.items()}
+            canaries = {m: {k: v for k, v in c.items() if k != "deltas"}
+                        for m, c in self.canaries.items()}
+        snap = self.registry.snapshot()
+        snap["splits"] = splits
+        snap["canaries"] = canaries
+        snap["models"] = self.registry.models()
+        return snap
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        if getattr(self.server, "verbose", False):
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+    def _reply(self, code, payload, headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n).decode() or "{}")
+
+    def do_GET(self):
+        router = self.server.mx_router
+        path, _, query = self.path.partition("?")
+        if path == "/metrics":
+            accept = self.headers.get("Accept", "")
+            wants_prom = ("format=prometheus" in query
+                          or ("text/plain" in accept
+                              and "application/json" not in accept))
+            if wants_prom:
+                text, errors = router.federated_metrics()
+                if errors:
+                    text += "# fleet: %d replica scrapes failed\n" \
+                        % len(errors)
+                data = text.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 telemetry.prom.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._reply(200, router.fleet_snapshot())
+        elif path == "/fleet":
+            self._reply(200, router.fleet_snapshot())
+        elif path == "/healthz":
+            snap = router.registry.snapshot()
+            ok = snap["counts"]["ready"] > 0
+            self._reply(200 if ok else 503,
+                        {"status": "ok" if ok else "no_ready_replicas",
+                         "replicas": snap["counts"]})
+        elif path == "/readyz":
+            snap = router.registry.snapshot()
+            ok = snap["counts"]["ready"] > 0
+            self._reply(200 if ok else 503,
+                        {"ready": ok, "replicas": snap["counts"]})
+        elif path == "/livez":
+            self._reply(200, {"alive": True})
+        else:
+            self._reply(404, {"error": "no such endpoint %r" % self.path})
+
+    def do_POST(self):
+        router = self.server.mx_router
+        try:
+            payload = self._read_json()
+        except ValueError as e:
+            self._reply(400, {"error": "bad json: %s" % e})
+            return
+        try:
+            if self.path in ("/v1/predict", "/predict"):
+                code, out, headers = router.route_predict(payload)
+                self._reply(code, out, headers)
+            elif self.path in ("/v1/generate", "/generate"):
+                code, out, headers = router.route_generate(payload)
+                self._reply(code, out, headers)
+            elif self.path == "/fleet/register":
+                rep = router.registry.register(payload)
+                self._reply(200, {"registered": rep.id})
+            elif self.path == "/fleet/heartbeat":
+                known = router.registry.heartbeat(
+                    payload.get("id"), ready=payload.get("ready"),
+                    reason=payload.get("reason"),
+                    load=payload.get("load"))
+                self._reply(200, {"known": known})
+            elif self.path == "/fleet/deregister":
+                router.registry.deregister(payload.get("id"))
+                self._reply(200, {"deregistered": True})
+            elif self.path == "/admin/split":
+                split = router.set_split(payload["model"],
+                                         payload["weights"])
+                self._reply(200, {"model": payload["model"],
+                                  "split": split})
+            elif self.path == "/admin/promote":
+                self._reply(200, router.promote(payload["model"],
+                                                payload["version"]))
+            elif self.path == "/admin/canary":
+                self._reply(200, router.start_canary(
+                    payload["model"], payload["version"],
+                    split=payload.get("split", 0.1),
+                    budget=payload.get("budget")))
+            elif self.path == "/admin/canary/report":
+                self._reply(200, router.report_canary(
+                    payload["model"], payload["delta"],
+                    version=payload.get("version")))
+            elif self.path == "/admin/drain":
+                ok = router.registry.set_draining(
+                    payload["id"], payload.get("draining", True))
+                self._reply(200 if ok else 404,
+                            {"id": payload["id"], "draining": ok})
+            else:
+                self._reply(404, {"error": "no such endpoint %r"
+                                           % self.path})
+        except (MXNetError, KeyError, TypeError, ValueError) as e:
+            self._reply(400, {"error": str(e)})
+
+
+class RouterHTTPFrontEnd:
+    """Owns the router's ThreadingHTTPServer + accept thread (the same
+    shape as serve/http.HttpFrontEnd, so tools share idiom)."""
+
+    def __init__(self, router, host="127.0.0.1", port=8090, verbose=False):
+        self.mx_router = router
+        self.httpd = ThreadingHTTPServer((host, port), _RouterHandler)
+        self.httpd.mx_router = router
+        self.httpd.verbose = verbose
+        self.httpd.daemon_threads = True
+        self._thread = None
+
+    @property
+    def address(self):
+        h, p = self.httpd.server_address[:2]
+        return "http://%s:%d" % (h, p)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="mxtpu-fleet-http",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+
+def route_http(router, host="127.0.0.1", port=8090, verbose=False):
+    """Start the fleet HTTP front end; returns the running
+    :class:`RouterHTTPFrontEnd` (``.stop()`` to shut down)."""
+    return RouterHTTPFrontEnd(router, host, port, verbose=verbose).start()

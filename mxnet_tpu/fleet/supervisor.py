@@ -1,0 +1,275 @@
+"""Supervised replica processes: the serving twin of ``tools/launch.py``.
+
+The elastic-training launcher grew the restart discipline first —
+capped jittered exponential backoff, a clean environment for restarted
+incarnations (``MXNET_FAULT_INJECT`` cleared so an injected kill is a
+first-run event), and postmortem-friendly death reporting. This module
+extracts that discipline so serving replicas get the exact same
+kill/resume treatment training workers do, and ``tools/launch.py``
+imports :func:`backoff_delay` from here (by file path, so the launcher
+keeps its no-library-imports property) instead of keeping a private
+copy.
+
+Deliberately **stdlib-only and import-light**: the supervisor runs in
+the router/operator process, which must never pay a jax import (or pull
+device state into a process that only fork/execs children).
+
+    sup = ReplicaSupervisor()
+    sup.add(ReplicaSpec("r0", [sys.executable, "tools/serve.py", ...]))
+    sup.poll()          # reap deaths, launch due restarts; returns events
+    sup.stop()          # SIGTERM everything (graceful replica drain)
+
+The supervisor is *policy-free about readiness*: it keeps processes
+alive; the fleet registry (heartbeats) decides when a replica is
+routable. Death of a child is an **event**, not an exception — the
+router keeps serving the survivors while the supervisor backs off and
+restarts (ROADMAP item 1's ~1/N degradation story).
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+__all__ = ["backoff_delay", "ReplicaSpec", "ReplicaSupervisor"]
+
+
+def backoff_delay(attempt, base=1.0, cap=30.0, jitter=0.5, rng=None):
+    """Capped jittered exponential backoff delay for restart ``attempt``
+    (0-based): ``min(cap, base * 2**attempt)`` scaled by a uniform
+    ``[1-jitter, 1+jitter]`` factor. The one restart schedule shared by
+    the training launcher and the serving fleet supervisor — jitter
+    de-synchronizes mass restarts, the cap bounds recovery latency."""
+    rng = rng if rng is not None else random
+    base = max(0.0, float(base))
+    raw = min(float(cap), base * (2.0 ** int(attempt)))
+    return raw * rng.uniform(1.0 - jitter, 1.0 + jitter)
+
+
+class ReplicaSpec:
+    """How to (re)launch one supervised child process.
+
+    ``argv`` is the full command line. ``env`` overlays ``os.environ``.
+    ``max_restarts`` bounds supervised restarts (0 = never restart —
+    fault-drill victims stay down so degraded goodput is observable).
+    Restarted incarnations get ``MXNET_FAULT_INJECT`` cleared (same
+    contract as tools/launch.py) and ``MXNET_REPLICA_INCARNATION`` set,
+    so an injected death never re-fires on the replacement."""
+
+    def __init__(self, replica_id, argv, env=None, cwd=None,
+                 max_restarts=2, log_path=None):
+        self.replica_id = str(replica_id)
+        self.argv = list(argv)
+        self.env = dict(env or {})
+        self.cwd = cwd
+        self.max_restarts = int(max_restarts)
+        self.log_path = log_path
+
+
+class _Child:
+    __slots__ = ("spec", "proc", "incarnation", "state", "rc",
+                 "restart_at", "started_at", "log_file")
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.proc = None
+        self.incarnation = 0      # how many times spawned
+        self.state = "new"        # new|running|backoff|failed|stopped
+        self.rc = None
+        self.restart_at = None
+        self.started_at = None
+        self.log_file = None
+
+
+class ReplicaSupervisor:
+    """Keeps a set of :class:`ReplicaSpec` children running.
+
+    Synchronous by design: callers drive :meth:`poll` (tests step it
+    deterministically) or run :meth:`start` for a background poller
+    thread. ``on_event`` (optional callable) receives each event dict
+    as it happens; :meth:`poll` also returns the batch."""
+
+    def __init__(self, backoff_base=1.0, backoff_cap=30.0, rng=None,
+                 on_event=None):
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self._rng = rng if rng is not None else random
+        self._on_event = on_event
+        self._children = {}
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- membership ---------------------------------------------------------
+    def add(self, spec, start=True):
+        """Register (and by default immediately launch) one replica."""
+        with self._lock:
+            if spec.replica_id in self._children:
+                raise ValueError("supervisor: duplicate replica id %r"
+                                 % spec.replica_id)
+            child = _Child(spec)
+            self._children[spec.replica_id] = child
+        if start:
+            self._spawn(child)
+        return self
+
+    def _spawn(self, child):
+        spec = child.spec
+        env = dict(os.environ)
+        env.update(spec.env)
+        if child.incarnation > 0:
+            # restarted incarnation runs clean: the injected fault that
+            # killed incarnation N must not kill N+1 (launch.py contract)
+            env["MXNET_FAULT_INJECT"] = ""
+        env["MXNET_REPLICA_INCARNATION"] = str(child.incarnation)
+        stdout = stderr = None
+        if spec.log_path:
+            child.log_file = open(spec.log_path, "ab", buffering=0)
+            stdout = stderr = child.log_file
+        child.proc = subprocess.Popen(spec.argv, env=env, cwd=spec.cwd,
+                                      stdout=stdout, stderr=stderr)
+        child.incarnation += 1
+        child.state = "running"
+        child.rc = None
+        child.restart_at = None
+        child.started_at = time.monotonic()
+
+    # -- polling ------------------------------------------------------------
+    def _emit(self, events, **ev):
+        events.append(ev)
+        if self._on_event is not None:
+            try:
+                self._on_event(ev)
+            except Exception:
+                pass
+
+    def poll(self):
+        """One supervision round: reap dead children, schedule restarts
+        with backoff, launch restarts whose delay elapsed. Returns the
+        list of event dicts (``exit``/``restart_scheduled``/
+        ``restart``/``failed``)."""
+        events = []
+        now = time.monotonic()
+        with self._lock:
+            children = list(self._children.values())
+        for child in children:
+            if child.state == "running":
+                rc = child.proc.poll()
+                if rc is None:
+                    continue
+                child.rc = rc
+                restarts_used = child.incarnation - 1
+                self._emit(events, event="exit",
+                           replica=child.spec.replica_id, rc=rc,
+                           incarnation=child.incarnation - 1)
+                if restarts_used < child.spec.max_restarts:
+                    delay = backoff_delay(restarts_used,
+                                          base=self.backoff_base,
+                                          cap=self.backoff_cap,
+                                          rng=self._rng)
+                    child.restart_at = now + delay
+                    child.state = "backoff"
+                    self._emit(events, event="restart_scheduled",
+                               replica=child.spec.replica_id,
+                               delay_s=round(delay, 3),
+                               attempt=restarts_used)
+                else:
+                    child.state = "failed"
+                    self._emit(events, event="failed",
+                               replica=child.spec.replica_id, rc=rc,
+                               restarts=restarts_used)
+            if child.state == "backoff" and now >= child.restart_at:
+                self._spawn(child)
+                self._emit(events, event="restart",
+                           replica=child.spec.replica_id,
+                           incarnation=child.incarnation - 1)
+        return events
+
+    def run(self, duration_s, interval_s=0.2):
+        """Poll for ``duration_s`` seconds (drill convenience)."""
+        t_end = time.monotonic() + duration_s
+        events = []
+        while time.monotonic() < t_end and not self._stop.is_set():
+            events.extend(self.poll())
+            time.sleep(interval_s)
+        return events
+
+    def start(self, interval_s=0.2):
+        """Background poller thread (daemon); :meth:`stop` ends it."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self.run, args=(float("inf"), interval_s),
+                name="mxtpu-fleet-supervisor", daemon=True)
+            self._thread.start()
+        return self
+
+    # -- shutdown -----------------------------------------------------------
+    def stop(self, replica_id=None, sig=signal.SIGTERM, wait_s=10.0):
+        """Signal children (default SIGTERM — replicas drain gracefully)
+        and wait for exit; SIGKILL anything that overstays ``wait_s``.
+        ``replica_id=None`` stops every child and the poller thread."""
+        if replica_id is None:
+            self._stop.set()
+            targets = list(self._children.values())
+        else:
+            targets = [self._children[replica_id]]
+        for child in targets:
+            child.state = "stopped"     # poll() must not restart it
+            if child.proc is not None and child.proc.poll() is None:
+                try:
+                    child.proc.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + wait_s
+        for child in targets:
+            if child.proc is None:
+                continue
+            budget = max(0.0, deadline - time.monotonic())
+            try:
+                child.proc.wait(budget)
+            except subprocess.TimeoutExpired:
+                child.proc.kill()
+                child.proc.wait(5.0)
+            if child.log_file is not None:
+                try:
+                    child.log_file.close()
+                except OSError:
+                    pass
+                child.log_file = None
+        if replica_id is None and self._thread is not None:
+            self._thread.join(wait_s)
+
+    # -- observability ------------------------------------------------------
+    def statuses(self):
+        """JSON-able per-replica supervision state."""
+        out = {}
+        with self._lock:
+            for rid, c in self._children.items():
+                out[rid] = {
+                    "state": c.state,
+                    "pid": c.proc.pid if c.proc is not None else None,
+                    "incarnation": max(0, c.incarnation - 1),
+                    "rc": c.rc,
+                    "max_restarts": c.spec.max_restarts,
+                }
+        return out
+
+    def alive_count(self):
+        return sum(1 for c in self._children.values()
+                   if c.state == "running" and c.proc.poll() is None)
+
+
+if __name__ == "__main__":     # tiny smoke: supervise `sleep`, kill it
+    sup = ReplicaSupervisor(backoff_base=0.1)
+    sup.add(ReplicaSpec("demo", [sys.executable, "-c",
+                                 "import time; time.sleep(60)"],
+                        max_restarts=1))
+    sup._children["demo"].proc.kill()
+    time.sleep(0.2)
+    print(sup.poll())
+    sup.stop()

@@ -42,8 +42,16 @@ Actions and their points:
     skips it.
 
 Every spec accepts ``rank=R`` (matched against ``MXNET_WORKER_RANK``,
-default 0) and ``count=K`` (max number of firings; ``kill`` and
-``conn_drop`` default to 1, everything else unlimited).
+default 0), ``count=K`` (max number of firings; ``kill`` and
+``conn_drop`` default to 1, everything else unlimited), and ``skip=N``
+(ignore the first N matching occurrences before firing — e.g.
+``kill@serve=decode_step:skip=6`` SIGKILLs a serving replica exactly 7
+sampled tokens into a decode session, the deterministic mid-generation
+death the fleet cursor-migration tests rely on).
+
+The serving replicas expose two injection points on their hot paths:
+``@serve=predict_batch`` (once per dispatched micro-batch) and
+``@serve=decode_step`` (once per live decode step).
 
 ``tools/launch.py`` clears ``MXNET_FAULT_INJECT`` for restarted worker
 incarnations, so an injected kill is a *first-run* event and the
@@ -80,7 +88,8 @@ class InjectedConnDrop(ConnectionError):
 
 
 class _Spec:
-    __slots__ = ("action", "point", "match", "kwargs", "budget", "raw")
+    __slots__ = ("action", "point", "match", "kwargs", "budget", "skip",
+                 "raw")
 
     def __init__(self, action, point, match, kwargs, raw):
         self.action = action
@@ -88,6 +97,7 @@ class _Spec:
         self.match = match
         self.kwargs = kwargs
         self.raw = raw
+        self.skip = int(kwargs.get("skip", 0))
         if "count" in kwargs:
             self.budget = int(kwargs["count"])
         elif action in ("kill", "conn_drop"):
@@ -166,6 +176,9 @@ def reset():
 
 def _consume(spec):
     with _lock:
+        if spec.skip > 0:
+            spec.skip -= 1
+            return False
         if spec.budget == 0:
             return False
         if spec.budget > 0:

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..config import flags
+from ..parallel import faultinject
 from .. import perfmodel
 from .. import profiler
 from ..serving import GenerateModel, load_artifact
@@ -631,6 +632,12 @@ class GenerateSession:
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
             return 0
+        # deterministic kill point for cursor-migration drills: fires
+        # once per LIVE decode step (warmup calls _decode directly and
+        # bypasses it), so "kill@serve=decode_step:skip=N" dies exactly
+        # N+1 sampled tokens into a session — mid-generation, KV pages
+        # and all
+        faultinject.fire("serve", op="decode_step", active=len(active))
         nxt, self.cache.k, self.cache.v = self._decode(
             jnp.asarray(self._cur[:, None]), jnp.asarray(self._positions),
             jnp.asarray(self._block), jnp.asarray(self._temps),

@@ -21,7 +21,20 @@ Protocol:
                         telemetry registry when the client asks for it
                         (Accept: text/plain — what Prometheus sends — or
                         ?format=prometheus); docs/observability.md
-  GET  /healthz      -> {"status": "ok"|"draining"|"closed"}
+  GET  /healthz      -> {"status": "ok"|"draining"|"closed", ...}
+                        (combined legacy probe, kept for bare serve/
+                        users; the split probes below are what the
+                        fleet router and orchestrators use)
+  GET  /livez        -> 200 {"alive": true} while the process serves
+                        HTTP at all — draining/warming replicas are
+                        LIVE (don't restart them), just not ready
+  GET  /readyz       -> 200 {"ready": true} only when the server
+                        should receive traffic; 503 with a reason
+                        ("warming"|"draining"|"closed") otherwise
+  GET  /info         -> static identity: mode, model name/version,
+                        artifact identity (sha256/format_version),
+                        inputs (predict) or decode spec (generate) —
+                        what a fleet registration is made of
 
 Errors: 400 bad input, 429 queue full (with Retry-After), 503 closed,
 504 deadline exceeded, 500 execution failure.
@@ -39,6 +52,34 @@ from .admission import (DeadlineExceeded, Evicted, ServerBusy,
                         ServerClosed)
 
 __all__ = ["serve_http", "HttpFrontEnd"]
+
+
+def _server_info(srv):
+    """The static identity half of a fleet registration: what this
+    process serves (mode/model/version/artifact identity) and its wire
+    geometry (inputs or decode spec)."""
+    info = {
+        "mode": srv.mode,
+        "model": getattr(srv, "model_name", None),
+        "version": getattr(srv, "model_version", None),
+        "identity": getattr(srv, "identity", None),
+        "ready": srv.ready,
+        "reason": srv.not_ready_reason(),
+    }
+    if srv.mode == "generate":
+        spec = srv.session.spec
+        info["generate"] = {
+            "vocab": spec.vocab,
+            "max_prompt_len": spec.max_prompt_len,
+            "max_context": spec.max_context,
+            "max_slots": spec.max_slots,
+            "page_size": spec.page_size,
+        }
+    else:
+        info["inputs"] = srv.model.meta["inputs"]
+        info["buckets"] = list(srv.buckets)
+        info["dtypes"] = list(srv._cache.dtypes)
+    return info
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -81,10 +122,25 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(200, srv.metrics())
         elif path == "/healthz":
+            # legacy combined probe: same "status" shape as ever, plus
+            # the readiness split for callers that want both in one GET
             status = ("closed" if srv.closed
                       else "draining" if srv.draining else "ok")
+            reason = srv.not_ready_reason()
             self._reply(200 if status == "ok" else 503,
-                        {"status": status})
+                        {"status": status, "ready": reason is None,
+                         "reason": reason})
+        elif path == "/livez":
+            # liveness != readiness: a draining or warming replica is
+            # alive (do NOT restart it) — only a closed server is not
+            self._reply(200 if not srv.closed else 503,
+                        {"alive": not srv.closed})
+        elif path == "/readyz":
+            reason = srv.not_ready_reason()
+            self._reply(200 if reason is None else 503,
+                        {"ready": reason is None, "reason": reason})
+        elif path == "/info":
+            self._reply(200, _server_info(srv))
         else:
             self._reply(404, {"error": "no such endpoint %r" % self.path})
 

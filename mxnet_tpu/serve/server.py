@@ -36,6 +36,7 @@ import jax
 
 from ..base import MXNetError
 from ..config import flags
+from ..parallel import faultinject
 from .. import profiler
 from ..serving import CompiledModel, GenerateModel, load_artifact
 from .admission import (AdmissionQueue, DeadlineExceeded, Request,
@@ -101,12 +102,16 @@ class Server:
             self.mode = "generate"
             self.model = model
             self.config = config
+            self._warming = False
+            self._warm_thread = None
             self.session = GenerateSession(model, config=config,
                                            auto_start=auto_start)
             self.metrics_ = self.session.metrics_
             return
         self.mode = "predict"
         self.session = None
+        self._warming = False
+        self._warm_thread = None
         if config is None:
             config = ServeConfig(**overrides)
         elif overrides:
@@ -157,6 +162,66 @@ class Server:
                                             daemon=True)
             self._thread.start()
         return self
+
+    def warmup_async(self):
+        """Compile/warm the serving path in a background thread while
+        the HTTP listener is already accepting: the replica registers
+        with the fleet immediately, reports not-ready (reason
+        "warming") until compiles finish, then flips ready — so a
+        router never sends traffic into a cold compile. Predict mode
+        builds + warms every (bucket, dtype) engine; generate mode
+        warms prefill/decode/commit then starts the scheduler."""
+        if self._warm_thread is not None and self._warm_thread.is_alive():
+            return self._warm_thread
+        self._warming = True
+
+        def _warm():
+            try:
+                if self.mode == "generate":
+                    try:
+                        self.session.warmup()
+                    finally:
+                        self.session.start()
+                else:
+                    self.start()   # batcher can queue while we compile
+                    self._cache.warmup = True
+                    for dtype in list(self._cache.dtypes):
+                        for b in self.buckets:
+                            self._cache.engine(b, dtype)
+            except Exception:
+                # a warmup failure must not wedge the replica in
+                # "warming" forever; the first real request surfaces it
+                pass
+            finally:
+                self._warming = False
+
+        self._warm_thread = threading.Thread(target=_warm,
+                                             name="mxtpu-serve-warmup",
+                                             daemon=True)
+        self._warm_thread.start()
+        return self._warm_thread
+
+    @property
+    def warming(self):
+        return self._warming
+
+    def not_ready_reason(self):
+        """None when this server should receive traffic; else the
+        reason string the readiness probe / fleet heartbeat reports:
+        "closed", "draining", or "warming". Liveness != readiness — a
+        draining or warming replica is alive but must be out of
+        rotation (see /readyz in serve/http.py)."""
+        if self.closed:
+            return "closed"
+        if self.draining:
+            return "draining"
+        if self._warming:
+            return "warming"
+        return None
+
+    @property
+    def ready(self):
+        return self.not_ready_reason() is None
 
     @property
     def draining(self):
@@ -348,6 +413,10 @@ class Server:
         bucket = pick_bucket(self.buckets, rows)
         # take() caps at the largest bucket, so bucket is never None
         try:
+            # deterministic kill/raise point for fleet fault drills:
+            # fires per DISPATCHED batch (warmup bypasses it), so
+            # "kill@serve=predict_batch:skip=N" dies at real batch N+1
+            faultinject.fire("serve", op="predict_batch", bucket=bucket)
             import jax.numpy as jnp
             if len(live) == 1:
                 stacked = list(live[0].arrays)
@@ -393,6 +462,57 @@ class Server:
             if self._queue.closed and self._queue.pending_count() == 0:
                 break
 
+    # -- cost model ---------------------------------------------------------
+    def estimate_row_s(self):
+        """Estimated seconds per served row: observed device throughput
+        once the server has history, else the perfmodel memory-roofline
+        floor over one row's input bytes — the same capability tables
+        decode's admission control uses, so the fleet router's
+        least-loaded policy scores every replica with ONE cost model,
+        not a router-side heuristic."""
+        self._require_mode("predict", "estimate_row_s")
+        obs = self.metrics_.throughput_rows_per_s()
+        if obs > 0:
+            return 1.0 / obs
+        from .. import perfmodel
+        bytes_row = 0
+        for s in self.model.meta["inputs"]:
+            n = 1
+            for d in s["shape"][1:]:
+                n *= int(d)
+            bytes_row += n * _np.dtype(s["dtype"]).itemsize
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            kind = perfmodel.DEFAULT_DEVICE_KIND
+        return max(perfmodel.roofline_seconds(0.0, 2.0 * bytes_row, kind),
+                   1e-7)
+
+    def load_status(self):
+        """The live half of a fleet heartbeat: readiness (+reason) and
+        the perfmodel-derived load summary (``load_s`` = estimated
+        seconds of queued work, ``unit_s`` = marginal seconds per
+        additional request) the router's least-loaded policy scores
+        on."""
+        reason = self.not_ready_reason()
+        if self.mode == "generate":
+            sess = self.session
+            load = {
+                "load_s": round(sess._retry_after(), 6),
+                "unit_s": round(sess.estimate_step_s()
+                                / max(1, sess.spec.max_slots), 9),
+                "queue_depth": len(sess._pending),
+            }
+        else:
+            pending = self._queue.pending_count()
+            unit = self.estimate_row_s()
+            load = {
+                "load_s": round(pending * unit, 6),
+                "unit_s": round(unit, 9),
+                "queue_depth": pending,
+            }
+        return {"ready": reason is None, "reason": reason, "load": load}
+
     # -- observability ------------------------------------------------------
     def metrics(self):
         """JSON-able snapshot: request counters, queue depth, per-bucket
@@ -402,10 +522,14 @@ class Server:
         if self.mode == "generate":
             snap = self.session.metrics()
             snap["mode"] = "generate"
+            snap["ready"] = self.ready
+            snap["not_ready_reason"] = self.not_ready_reason()
             return snap
         snap = self.metrics_.snapshot(engine_stats=self._cache.stats())
         snap["mode"] = "predict"
         snap["buckets_configured"] = list(self.buckets)
         snap["status"] = ("closed" if self.closed
                           else "draining" if self.draining else "ok")
+        snap["ready"] = self.ready
+        snap["not_ready_reason"] = self.not_ready_reason()
         return snap

@@ -45,7 +45,7 @@ from . import hlo_stats as _hlo_stats
 from .kernels import tier as _kernels_tier
 
 __all__ = ["export_compiled", "CompiledModel", "export_generate",
-           "GenerateModel", "load_artifact"]
+           "GenerateModel", "load_artifact", "artifact_identity"]
 
 _MAGIC = b"MXTPUAOT"
 
@@ -571,3 +571,25 @@ def load_artifact(path, **kw):
     kind = _artifact_kind(path, meta)
     cls = GenerateModel if kind == "generate" else CompiledModel
     return cls.load(path, **kw)
+
+
+def artifact_identity(path):
+    """Content identity of an ``.mxtpu`` artifact, without loading it:
+    the sha256 of the whole file plus kind/format_version/platforms.
+    This is what a fleet replica registers under — a blue/green traffic
+    split is a statement about *artifacts*, and two replicas claiming
+    the same (model, version) with different hashes is a deployment
+    bug the registry makes visible."""
+    import hashlib
+    meta, _ = _read_artifact(path)
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return {
+        "sha256": h.hexdigest(),
+        "kind": _artifact_kind(path, meta),
+        "format_version": _effective_format_version(meta),
+        "platforms": meta.get("platforms", []),
+        "quantized": _effective_format_version(meta) == 4,
+    }

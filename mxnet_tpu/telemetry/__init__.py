@@ -10,6 +10,8 @@ The pieces (each its own module, all stdlib-only and import-light):
   and the per-window JSONL snapshot stream.
 * ``recorder`` — bounded flight recorder dumped to a postmortem JSON on
   SIGTERM / unhandled exception / faultinject kill.
+* ``federate`` — merges per-replica expositions under ``replica=<id>``
+  labels for the fleet router's single ``/metrics`` scrape.
 
 The one entry point producers on the training path use is
 ``publish_window``: called by ``Module.fit`` at K-step window
@@ -23,7 +25,7 @@ from __future__ import annotations
 
 import time
 
-from mxnet_tpu.telemetry import exporters, prom, recorder
+from mxnet_tpu.telemetry import exporters, federate, prom, recorder
 from mxnet_tpu.telemetry.prom import parse_exposition
 from mxnet_tpu.telemetry.recorder import FlightRecorder, flight_recorder
 from mxnet_tpu.telemetry.registry import (
@@ -35,7 +37,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "FlightRecorder",
     "counter", "gauge", "histogram", "snapshot", "default_registry",
     "set_run_info", "run_info", "flight_recorder", "prometheus_text",
-    "parse_exposition", "publish_window", "exporters", "prom", "recorder",
+    "parse_exposition", "publish_window", "exporters", "federate", "prom",
+    "recorder",
 ]
 
 _jsonl = None

@@ -1,0 +1,108 @@
+"""Federate per-replica Prometheus expositions into one scrape.
+
+The fleet router exposes ONE ``/metrics`` endpoint for the whole
+fleet: it scrapes each replica's exposition (the same text
+``serve/http.py`` serves) and merges them here, injecting a
+``replica="<id>"`` label into every sample so per-replica series stay
+distinguishable after the merge — the standard Prometheus federation
+shape, hand-rolled on the `prom` module's own regexes (stdlib-only,
+round-trippable through ``prom.parse_exposition``; the fleet smoke
+test asserts exactly that).
+
+Merge rules:
+* one ``# TYPE``/``# HELP`` per family (first writer wins — replicas
+  of the same build agree anyway);
+* histogram children (``_bucket``/``_sum``/``_count``) stay adjacent
+  to their parent family;
+* a replica text that fails the strict parse is skipped and reported,
+  never merged half-way (a sick replica must not poison the fleet
+  scrape).
+"""
+from __future__ import annotations
+
+from . import prom
+
+__all__ = ["label_exposition", "merge_expositions"]
+
+
+def _family_of(name, typed):
+    """Histogram children group under their parent family name."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            stem = name[: -len(suffix)]
+            if typed.get(stem) == "histogram":
+                return stem
+    return name
+
+
+def label_exposition(text, label, value):
+    """Inject ``label="value"`` into every sample line of ``text``.
+
+    Returns ``(families, typed)`` where ``families`` is an ordered dict
+    ``{family: {"meta": [comment lines], "samples": [lines]}}`` — the
+    intermediate the merge works on. Raises ``ValueError`` on malformed
+    input (same strictness as ``prom.parse_exposition``)."""
+    esc = (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+           .replace('"', '\\"'))
+    pair = '%s="%s"' % (label, esc)
+    families = {}
+    typed = {}
+
+    def fam(name):
+        return families.setdefault(name, {"meta": [], "samples": []})
+
+    for raw in text.split("\n"):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if len(parts) != 2:
+                raise ValueError("bad TYPE line: %r" % raw)
+            typed[parts[0]] = parts[1]
+            fam(parts[0])["meta"].append(line)
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            fam(parts[0])["meta"].append(line)
+            continue
+        if line.startswith("#"):
+            continue
+        m = prom._SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError("bad sample line: %r" % raw)
+        name = m.group("name")
+        body = m.group("labels")
+        value_part = m.group("value")
+        if m.group("ts"):
+            value_part += " " + m.group("ts")
+        inner = pair if not body else pair + "," + body
+        labeled = "%s{%s} %s" % (name, inner, value_part)
+        fam(_family_of(name, typed))["samples"].append(labeled)
+    return families, typed
+
+
+def merge_expositions(sources, label="replica"):
+    """Merge ``[(id, exposition_text), ...]`` into one exposition with
+    ``label="<id>"`` on every sample. Returns ``(text, skipped)`` where
+    ``skipped`` lists ``(id, error)`` for sources that failed the
+    strict parse."""
+    merged = {}          # family -> {"meta": [...], "samples": [...]}
+    order = []
+    skipped = []
+    for sid, text in sources:
+        try:
+            families, _ = label_exposition(text, label, sid)
+        except ValueError as e:
+            skipped.append((sid, str(e)))
+            continue
+        for name, data in families.items():
+            if name not in merged:
+                merged[name] = {"meta": list(data["meta"]), "samples": []}
+                order.append(name)
+            merged[name]["samples"].extend(data["samples"])
+    lines = []
+    for name in order:
+        lines.extend(merged[name]["meta"])
+        lines.extend(merged[name]["samples"])
+    return "\n".join(lines) + "\n", skipped

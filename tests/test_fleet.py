@@ -1,0 +1,760 @@
+"""Fleet tier (mxnet_tpu.fleet): router, registry, supervisor backoff,
+metrics federation — chip-free.
+
+The acceptance properties: (1) a router over CPU replica subprocesses
+spreads predict traffic least-loaded, honors blue/green splits, and
+auto-rolls-back a canary on an over-budget accuracy delta with zero
+dropped in-flight requests; (2) a decode session whose owner replica is
+killed mid-hop is resumed on a survivor via its cursor and the stitched
+token tail is BITWISE identical to an uninterrupted single-replica run;
+(3) the federated /metrics exposition round-trips through the strict
+``prom.parse_exposition`` with per-replica labels.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import mxnet_tpu as mx
+import numpy as np
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fleet import (NoReplica, ReplicaRegistry, Router,
+                             backoff_delay, route_http)
+from mxnet_tpu.serve import decode_model as dm
+from mxnet_tpu import serving
+from mxnet_tpu.telemetry import federate, prom
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GEN_SPEC = dm.DecoderSpec(vocab=61, dim=32, num_heads=4, num_layers=2,
+                          max_prompt_len=8, page_size=4,
+                          max_pages_per_slot=8, max_slots=4, num_pages=33)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10.0, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _get_json(url, timeout=10.0):
+    code, body = _get(url, timeout=timeout)
+    return code, json.loads(body or "{}")
+
+
+def _post(url, payload, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def _register(registry, rid, *, model="m", version="0", mode="predict",
+              ready=True, load=None, spec=None, static=False):
+    return registry.register({
+        "id": rid, "url": "http://%s.invalid" % rid, "model": model,
+        "version": version, "mode": mode, "ready": ready,
+        "load": load or {}, "spec": spec, "static": static})
+
+
+# ---------------------------------------------------------------------------
+# backoff_delay: the one restart schedule (launcher + supervisor)
+# ---------------------------------------------------------------------------
+
+class _FixedRng:
+    def __init__(self, frac):
+        self.frac = frac
+
+    def uniform(self, lo, hi):
+        return lo + (hi - lo) * self.frac
+
+
+def test_backoff_delay_exponential_cap_and_jitter():
+    assert backoff_delay(0, base=0.5, cap=30.0, jitter=0.0) == 0.5
+    assert backoff_delay(3, base=0.5, cap=30.0, jitter=0.0) == 4.0
+    # capped: 2**10 * 1.0 >> 30
+    assert backoff_delay(10, base=1.0, cap=30.0, jitter=0.0) == 30.0
+    # jitter spans [1-j, 1+j] around the raw delay
+    lo = backoff_delay(2, base=1.0, cap=30.0, jitter=0.5, rng=_FixedRng(0.0))
+    hi = backoff_delay(2, base=1.0, cap=30.0, jitter=0.5, rng=_FixedRng(1.0))
+    assert lo == pytest.approx(2.0)
+    assert hi == pytest.approx(6.0)
+    for _ in range(20):
+        d = backoff_delay(2, base=1.0, cap=30.0, jitter=0.5)
+        assert 2.0 <= d <= 6.0
+
+
+def test_launcher_shares_supervisor_backoff():
+    # tools/launch.py loads backoff_delay from fleet/supervisor.py by
+    # file path (no package import); same schedule, not a private copy
+    import tools.launch as launch
+    assert (launch._backoff_delay(4, base=0.25, cap=30.0, jitter=0.0)
+            == backoff_delay(4, base=0.25, cap=30.0, jitter=0.0))
+
+
+# ---------------------------------------------------------------------------
+# registry: heartbeat liveness, sweep, static seeds, draining
+# ---------------------------------------------------------------------------
+
+def test_registry_sweep_marks_stale_dead_and_heartbeat_revives():
+    reg = ReplicaRegistry(heartbeat_timeout_s=0.2)
+    _register(reg, "a")
+    assert reg.is_routable("a")
+    time.sleep(0.3)
+    assert reg.sweep() == ["a"]
+    rep = reg.get("a")
+    assert rep.dead and not rep.ready
+    assert "no heartbeat" in rep.dead_reason
+    # a heartbeat from the "dead" is a liveness correction
+    assert reg.heartbeat("a", ready=True) is True
+    assert not reg.get("a").dead
+    assert reg.is_routable("a")
+    # unknown id: announcer re-registers on False
+    assert reg.heartbeat("ghost") is False
+
+
+def test_registry_static_seed_exempt_from_sweep():
+    reg = ReplicaRegistry(heartbeat_timeout_s=0.1)
+    _register(reg, "s", static=True)
+    time.sleep(0.25)
+    assert reg.sweep() == []
+    assert reg.is_routable("s")
+    # but a proxy failure still kills it
+    reg.mark_dead("s", "proxy failed")
+    assert not reg.is_routable("s")
+
+
+def test_registry_draining_and_reregistration_reset():
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    _register(reg, "a")
+    reg.set_draining("a")
+    assert not reg.is_routable("a")
+    assert reg.snapshot()["counts"]["draining"] == 1
+    reg.mark_dead("a", "boom")
+    # supervised restart reuses the id: registration resets death state
+    _register(reg, "a")
+    rep = reg.get("a")
+    assert not rep.dead and not rep.draining and rep.ready
+    assert reg.is_routable("a")
+
+
+def test_registry_routable_filters_and_score():
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    _register(reg, "a", load={"load_s": 0.5, "unit_s": 0.1})
+    _register(reg, "b", version="1", mode="generate")
+    _register(reg, "c", ready=False)
+    assert {r.id for r in reg.routable()} == {"a", "b"}
+    assert [r.id for r in reg.routable(mode="generate")] == ["b"]
+    assert [r.id for r in reg.routable(version="1")] == ["b"]
+    rep = reg.get("a")
+    reg.note_inflight("a", +1)
+    reg.note_inflight("a", +1)
+    assert rep.score() == pytest.approx(0.5 + 2 * 0.1)
+    assert rep.served == 2
+    reg.note_inflight("a", -1)
+    assert rep.inflight == 1 and rep.served == 2
+
+
+# ---------------------------------------------------------------------------
+# router core (stubbed transport): least-loaded, retry, hops, migration
+# ---------------------------------------------------------------------------
+
+def test_route_predict_least_loaded_then_retries_on_death(monkeypatch):
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, retry_limit=2)
+    _register(reg, "a", load={"load_s": 0.0, "unit_s": 0.01})
+    _register(reg, "b", load={"load_s": 1.0, "unit_s": 0.01})
+    calls = []
+
+    def fake_call(url, payload, timeout_s):
+        calls.append(url)
+        if "//a" in url:
+            raise ConnectionError("injected death")
+        return 200, {"outputs": [[1.0]]}, {}
+
+    monkeypatch.setattr(router, "_call", fake_call)
+    code, out, _ = router.route_predict({"inputs": {"data": [[0.0]]}})
+    assert code == 200
+    assert out["replica"] == "b" and out["version"] == "0"
+    # least-loaded went to a first, then the retry excluded the corpse
+    assert ["//a" in u for u in calls] == [True, False]
+    assert reg.get("a").dead
+    assert "proxy failed" in reg.get("a").dead_reason
+
+
+def test_route_predict_no_replica_is_503():
+    router = Router(registry=ReplicaRegistry(heartbeat_timeout_s=60.0))
+    code, out, _ = router.route_predict({"inputs": {"data": [[0.0]]}})
+    assert code == 503
+    assert "no ready" in out["error"]
+
+
+def test_route_generate_hop_chunking_caps_at_prefill_window(monkeypatch):
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, hop_tokens=4)
+    _register(reg, "g", mode="generate",
+              spec={"vocab": 61, "max_prompt_len": 8, "max_context": 32})
+    bodies = []
+
+    def fake_call(url, payload, timeout_s):
+        bodies.append(payload)
+        n = payload["max_new_tokens"]
+        base = len(payload["prompt"])
+        return 200, {"tokens": list(range(base, base + n)),
+                     "finish_reason": "length", "ttft_ms": 1.0}, {}
+
+    monkeypatch.setattr(router, "_call", fake_call)
+    code, out, _ = router.route_generate(
+        {"prompt": [5, 9, 13], "max_new_tokens": 17})
+    assert code == 200
+    # hop 1 forwards 4 tokens (3+4 <= max_prompt_len=8); after it the
+    # resume prompt is 7 tokens, so another 4-token hop would leave an
+    # inadmissible 11-token resume point — the rest goes in ONE
+    # unsplittable final hop
+    assert [b["max_new_tokens"] for b in bodies] == [4, 13]
+    assert [len(b["prompt"]) for b in bodies] == [3, 7]
+    assert len(out["tokens"]) == 17
+    assert out["hops"] == 2 and out["migrations"] == 0
+    assert out["replicas"] == ["g"]
+
+
+def test_route_generate_migrates_on_owner_death(monkeypatch):
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, hop_tokens=4)
+    _register(reg, "a", mode="generate",
+              load={"load_s": 0.0, "unit_s": 0.0})
+    _register(reg, "b", mode="generate",
+              load={"load_s": 9.0, "unit_s": 0.0})
+
+    def fake_call(url, payload, timeout_s):
+        if "//a" in url and len(payload["prompt"]) > 3:
+            raise ConnectionError("injected mid-session death")
+        n = payload["max_new_tokens"]
+        base = len(payload["prompt"])
+        return 200, {"tokens": list(range(base, base + n)),
+                     "finish_reason": "length", "ttft_ms": 1.0}, {}
+
+    monkeypatch.setattr(router, "_call", fake_call)
+    code, out, _ = router.route_generate(
+        {"prompt": [1, 2, 3], "max_new_tokens": 10})
+    assert code == 200
+    # no spec registered -> no prefill cap -> pure 4/4/2 chunking; the
+    # owner dies before hop 2 and the session moves to the survivor
+    assert len(out["tokens"]) == 10
+    assert out["hops"] == 3
+    assert out["migrations"] == 1
+    assert out["replicas"] == ["a", "b"]
+    assert reg.get("a").dead
+    # the fake regenerates deterministically from the resume prompt, so
+    # the stitched stream equals what "b" alone would have produced
+    assert out["tokens"] == list(range(3, 13))
+
+
+def test_route_generate_banks_eviction_cursor(monkeypatch):
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, hop_tokens=0)
+    _register(reg, "g", mode="generate")
+    state = {"evicted": False}
+
+    def fake_call(url, payload, timeout_s):
+        base = len(payload["prompt"])
+        if not state["evicted"]:
+            state["evicted"] = True
+            got = [base, base + 1]
+            return 429, {"tokens": got, "retry_after_s": 0.0,
+                         "cursor": {"prompt": payload["prompt"],
+                                    "generated": got,
+                                    "resume_prompt":
+                                        payload["prompt"] + got,
+                                    "remaining_tokens": 4}}, {}
+        n = payload["max_new_tokens"]
+        return 200, {"tokens": list(range(base, base + n)),
+                     "finish_reason": "length", "ttft_ms": 1.0}, {}
+
+    monkeypatch.setattr(router, "_call", fake_call)
+    code, out, _ = router.route_generate(
+        {"prompt": [1, 2], "max_new_tokens": 6})
+    assert code == 200
+    assert len(out["tokens"]) == 6
+    assert out["tokens"][:2] == [2, 3]          # banked eviction partial
+    assert out["tokens"][2:] == [4, 5, 6, 7]    # resumed from the cursor
+    assert out["migrations"] == 0               # same replica resumed it
+
+
+def test_route_generate_no_replica_returns_resumable_partial():
+    router = Router(registry=ReplicaRegistry(heartbeat_timeout_s=60.0))
+    code, out, headers = router.route_generate(
+        {"prompt": [1, 2, 3], "max_new_tokens": 5})
+    assert code == 429
+    # the partial carries a PR-9-shaped cursor so the client can resubmit
+    assert out["cursor"]["resume_prompt"] == [1, 2, 3]
+    assert out["cursor"]["remaining_tokens"] == 5
+    assert "Retry-After" in headers
+
+
+# ---------------------------------------------------------------------------
+# blue/green splits + canary auto-rollback
+# ---------------------------------------------------------------------------
+
+def test_split_pins_version_and_promote_flips(monkeypatch):
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg)
+    _register(reg, "r1", version="v1")
+    _register(reg, "r2", version="v2")
+    hit = []
+    monkeypatch.setattr(
+        router, "_call",
+        lambda url, payload, t: (hit.append(url) or
+                                 (200, {"outputs": []}, {})))
+    router.set_split("m", {"v2": 1.0})
+    for _ in range(5):
+        code, out, _ = router.route_predict({"inputs": {"data": [[0.0]]}})
+        assert code == 200 and out["version"] == "v2"
+    assert all("//r2" in u for u in hit)
+    out = router.promote("m", "v1")
+    assert out["split"] == {"v1": 1.0}
+    hit.clear()
+    code, out, _ = router.route_predict({"inputs": {"data": [[0.0]]}})
+    assert out["version"] == "v1"
+    with pytest.raises(MXNetError):
+        router.set_split("m", {"v1": -0.5})
+    with pytest.raises(MXNetError):
+        router.set_split("m", {"v1": 0.0})
+
+
+def test_canary_rollback_on_over_budget_delta_drains_canary():
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg)
+    _register(reg, "blue", version="f32")
+    _register(reg, "cn", version="int8")
+    router.set_split("m", {"f32": 1.0})
+    c = router.start_canary("m", "int8", split=0.25, budget=0.01)
+    assert c["state"] == "active" and c["baseline"] == {"f32": 1.0}
+    assert router.splits["m"] == pytest.approx(
+        {"f32": 0.75, "int8": 0.25})
+    # within budget: nothing happens
+    out = router.report_canary("m", 0.004)
+    assert out == {"state": "active", "action": "none",
+                   "delta": 0.004, "budget": 0.01}
+    # the PR-10 accuracy-probe delta blows the budget: auto-rollback
+    out = router.report_canary("m", 0.05)
+    assert out["state"] == "rolled_back" and out["action"] == "rollback"
+    assert out["drained_replicas"] == ["cn"]
+    assert router.splits["m"] == {"f32": 1.0}
+    assert reg.get("cn").draining          # in-flight finish; no new traffic
+    assert not reg.get("blue").draining
+    snap = router.fleet_snapshot()
+    assert snap["canaries"]["m"]["state"] == "rolled_back"
+    assert "exceeds budget" in snap["canaries"]["m"]["reason"]
+    # a dead canary can't take more reports
+    with pytest.raises(MXNetError):
+        router.report_canary("m", 0.0)
+
+
+def test_canary_requires_baseline_and_sane_split():
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg)
+    with pytest.raises(MXNetError):
+        router.start_canary("m", "int8", split=1.5)
+    with pytest.raises(MXNetError):
+        # no other version registered to canary against
+        router.start_canary("m", "int8", split=0.1)
+
+
+def test_split_is_intent_fallback_only_when_nothing_else_ready():
+    # a rolled-back canary (weight 0 via absence) must not come back
+    # just because the preferred version died — unless NOTHING else is
+    # ready (availability beats policy)
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg)
+    _register(reg, "r1", version="v1")
+    _register(reg, "r2", version="v2")
+    router.set_split("m", {"v1": 1.0})
+    reg.mark_dead("r1", "boom")
+    rep = router._pick(model="m", mode="predict")
+    assert rep.id == "r2"
+
+
+# ---------------------------------------------------------------------------
+# metrics federation
+# ---------------------------------------------------------------------------
+
+_EXPO_A = (
+    "# HELP serve_requests Requests.\n"
+    "# TYPE serve_requests counter\n"
+    'serve_requests{outcome="ok"} 3\n'
+    "# TYPE serve_latency_ms histogram\n"
+    'serve_latency_ms_bucket{le="1"} 1\n'
+    'serve_latency_ms_bucket{le="+Inf"} 2\n'
+    "serve_latency_ms_sum 3.5\n"
+    "serve_latency_ms_count 2\n")
+
+_EXPO_B = (
+    "# TYPE serve_requests counter\n"
+    "serve_requests 5\n")
+
+
+def test_federate_merge_round_trips_through_strict_parse():
+    text, skipped = federate.merge_expositions(
+        [("r1", _EXPO_A), ("r2", _EXPO_B),
+         ("sick", "not { a valid exposition\n")])
+    # a sick replica is skipped whole, never merged half-way
+    assert [sid for sid, _ in skipped] == ["sick"]
+    parsed = prom.parse_exposition(text)
+    req = parsed["serve_requests"]
+    assert req["type"] == "counter"
+    assert {lab["replica"] for lab, _ in req["samples"]} == {"r1", "r2"}
+    # r1's own label survived next to the injected replica label
+    assert ({"replica": "r1", "outcome": "ok"}, 3.0) in req["samples"]
+    assert ({"replica": "r2"}, 5.0) in req["samples"]
+    # histogram children grouped under the parent family, labels intact
+    hist = parsed["serve_latency_ms"]
+    assert hist["type"] == "histogram"
+    assert ({"replica": "r1", "le": "+Inf"}, 2.0) in hist["samples"]
+    # one TYPE line per family after the merge
+    assert text.count("# TYPE serve_requests counter") == 1
+
+
+def test_federate_escapes_label_values():
+    text, skipped = federate.merge_expositions(
+        [('r"1\\x', "# TYPE c counter\nc 1\n")])
+    assert not skipped
+    parsed = prom.parse_exposition(text)
+    assert parsed["c"]["samples"] == [({"replica": 'r"1\\x'}, 1.0)]
+
+
+# ---------------------------------------------------------------------------
+# fault injection plumbing the fleet drill leans on
+# ---------------------------------------------------------------------------
+
+def test_faultinject_skip_counts_matching_events(monkeypatch):
+    from mxnet_tpu.parallel import faultinject
+    monkeypatch.setenv("MXNET_FAULT_INJECT",
+                       "raise@call=fleet_unit:skip=2")
+    faultinject.reset()
+    try:
+        faultinject.fire("call", op="fleet_unit")    # skip 2 -> 1
+        faultinject.fire("call", op="other")         # no match: untouched
+        faultinject.fire("call", op="fleet_unit")    # skip 1 -> 0
+        with pytest.raises(faultinject.InjectedFault):
+            faultinject.fire("call", op="fleet_unit")
+    finally:
+        faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# router HTTP surface (no replicas needed)
+# ---------------------------------------------------------------------------
+
+def test_router_http_probes_and_admin():
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg)
+    front = route_http(router, "127.0.0.1", 0)
+    url = front.address
+    try:
+        code, out = _get_json(url + "/livez")
+        assert code == 200 and out == {"alive": True}
+        code, out = _get_json(url + "/readyz")
+        assert code == 503 and out["ready"] is False
+        code, out = _get_json(url + "/healthz")
+        assert code == 503 and out["status"] == "no_ready_replicas"
+        code, out = _post(url + "/fleet/register",
+                          {"id": "a", "url": "http://a.invalid",
+                           "model": "m", "version": "0",
+                           "mode": "predict", "ready": True})
+        assert code == 200 and out == {"registered": "a"}
+        code, out = _get_json(url + "/readyz")
+        assert code == 200 and out["ready"] is True
+        code, out = _post(url + "/fleet/heartbeat",
+                          {"id": "a", "ready": True,
+                           "load": {"load_s": 0.25, "unit_s": 0.1}})
+        assert code == 200 and out == {"known": True}
+        code, out = _post(url + "/fleet/heartbeat", {"id": "nope"})
+        assert code == 200 and out == {"known": False}
+        code, out = _get_json(url + "/fleet")
+        assert code == 200
+        assert out["counts"] == {"total": 1, "ready": 1, "dead": 0,
+                                 "draining": 0}
+        assert out["replicas"][0]["load"] == {"load_s": 0.25,
+                                              "unit_s": 0.1}
+        code, out = _post(url + "/admin/split",
+                          {"model": "m", "weights": {"0": 3.0}})
+        assert code == 200 and out["split"] == {"0": 1.0}
+        code, out = _post(url + "/admin/split",
+                          {"model": "m", "weights": {"0": -1.0}})
+        assert code == 400
+        code, out = _post(url + "/admin/drain", {"id": "a"})
+        assert code == 200 and out["draining"] is True
+        code, out = _get_json(url + "/readyz")
+        assert code == 503
+        code, out = _post(url + "/fleet/deregister", {"id": "a"})
+        assert code == 200
+        code, out = _get_json(url + "/fleet")
+        assert out["counts"]["total"] == 0
+        code, _ = _get_json(url + "/no/such")
+        assert code == 404
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 fleet smoke: router + 2 CPU replica subprocesses
+# ---------------------------------------------------------------------------
+
+def _replica_env(**extra):
+    env = os.environ.copy()
+    # replicas are plain single-device CPU processes: drop the test
+    # harness's 8-virtual-device XLA_FLAGS and any inherited injection
+    for k in ("XLA_FLAGS", "MXNET_FAULT_INJECT", "MXNET_TELEMETRY_DIR"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_FLEET_HEARTBEAT_S"] = "0.2"
+    env.update(extra)
+    return env
+
+
+def _spawn_replica(tmp_path, art_path, router_url, rid, version,
+                   extra_args=(), extra_env=None):
+    argv = [sys.executable, os.path.join(ROOT, "tools", "serve.py"),
+            "--artifact", art_path, "--port", "0",
+            "--register", router_url, "--replica-id", rid,
+            "--model-name", "m", "--model-version", version]
+    argv += list(extra_args)
+    log = open(os.path.join(str(tmp_path), "%s.log" % rid), "w")
+    proc = subprocess.Popen(argv, cwd=ROOT,
+                            env=_replica_env(**(extra_env or {})),
+                            stdout=log, stderr=subprocess.STDOUT)
+    proc._mx_log = log
+    return proc
+
+
+def _stop_all(front, procs):
+    front.stop()
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+        p._mx_log.close()
+
+
+def _wait_routable(registry, want, tmp_path, timeout_s=240.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if len(registry.routable()) >= want:
+            return
+        time.sleep(0.1)
+    logs = {os.path.basename(p): open(p).read()[-2000:]
+            for p in glob.glob(os.path.join(str(tmp_path), "*.log"))}
+    raise AssertionError("replicas never became routable: %r\nlogs: %r"
+                         % (registry.snapshot(), logs))
+
+
+@pytest.fixture(scope="module")
+def predict_art(tmp_path_factory):
+    """A tiny dynamic-batch FC artifact for predict replicas."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(7)
+    shapes, _, _ = net.infer_shape(data=(2, 6))
+    args = {n: mx.nd.array(rng.uniform(-0.3, 0.3, s).astype("f4"))
+            for n, s in zip(net.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    path = str(tmp_path_factory.mktemp("fleet_art") / "m.mxtpu")
+    meta = mx.serving.export_compiled(net, args, {}, {"data": (None, 6)},
+                                      path)
+    assert meta["dynamic_batch"] is True
+    return path
+
+
+@pytest.fixture(scope="module")
+def gen_art(tmp_path_factory):
+    params = dm.init_params(GEN_SPEC, seed=0)
+    path = str(tmp_path_factory.mktemp("fleet_gen") / "m.gen.mxtpu")
+    meta = serving.export_generate(params, GEN_SPEC, path)
+    assert meta["format_version"] == 3
+    return {"path": path, "params": params}
+
+
+def test_fleet_smoke_router_two_replicas(predict_art, tmp_path):
+    registry = ReplicaRegistry(heartbeat_timeout_s=3.0)
+    router = Router(registry=registry)
+    front = route_http(router, "127.0.0.1", 0)
+    url = front.address
+    procs = []
+    try:
+        procs.append(_spawn_replica(tmp_path, predict_art, url, "r1", "v1",
+                                    extra_args=("--buckets", "1,4")))
+        procs.append(_spawn_replica(tmp_path, predict_art, url, "r2", "v2",
+                                    extra_args=("--buckets", "1,4")))
+        _wait_routable(registry, 2, tmp_path)
+
+        # the replica side of satellite (a): split probes live alongside
+        # the legacy combined /healthz
+        rep_url = registry.get("r1").url
+        code, out = _get_json(rep_url + "/livez")
+        assert code == 200 and out == {"alive": True}
+        code, out = _get_json(rep_url + "/readyz")
+        assert code == 200 and out["ready"] is True
+        code, out = _get_json(rep_url + "/healthz")
+        assert code == 200
+        assert out["status"] == "ok" and out["ready"] is True
+        code, out = _get_json(rep_url + "/info")
+        assert out["model"] == "m" and out["version"] == "v1"
+        assert out["identity"]
+
+        # least-loaded routing spreads a cold fleet over both replicas
+        from tools.serve_loadgen import measure
+        res = measure(url, concurrency=4, requests=24, shape=(1, 6),
+                      retries=2)
+        assert res["completed"] == 24
+        assert set(res["per_replica"]) == {"r1", "r2"}
+
+        # federated /metrics parses strictly, with per-replica labels
+        code, text = _get(url + "/metrics?format=prometheus",
+                          headers={"Accept": "text/plain"})
+        assert code == 200
+        parsed = prom.parse_exposition(text)
+        labels = {lab.get("replica")
+                  for fam in parsed.values()
+                  for lab, _ in fam["samples"]}
+        assert {"router", "r1", "r2"} <= labels
+        assert "mxtpu_fleet_requests_total" in parsed
+
+        # blue/green: pin v2, then canary v1 and roll it back
+        code, out = _post(url + "/admin/split",
+                          {"model": "m", "weights": {"v2": 1.0}})
+        assert code == 200
+        for _ in range(4):
+            code, out = _post(url + "/v1/predict",
+                              {"inputs": {"data": [[0.0] * 6]}})
+            assert code == 200 and out["version"] == "v2"
+
+        code, out = _post(url + "/admin/canary",
+                          {"model": "m", "version": "v1",
+                           "split": 0.5, "budget": 0.01})
+        assert code == 200 and out["state"] == "active"
+
+        # keep load running THROUGH the rollback: zero dropped in-flight
+        bg = {}
+
+        def _bg():
+            bg["res"] = measure(url, concurrency=4, requests=40,
+                                shape=(1, 6), retries=4)
+
+        t = threading.Thread(target=_bg)
+        t.start()
+        time.sleep(0.2)
+        code, out = _post(url + "/admin/canary/report",
+                          {"model": "m", "delta": 0.25})
+        assert code == 200 and out["state"] == "rolled_back"
+        assert out["drained_replicas"] == ["r1"]
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert bg["res"]["completed"] == 40
+        assert bg["res"]["errors"] == 0
+
+        # post-rollback traffic is v2-only; the drained canary finished
+        # its in-flight work but takes no new requests
+        for _ in range(4):
+            code, out = _post(url + "/v1/predict",
+                              {"inputs": {"data": [[0.0] * 6]}})
+            assert code == 200 and out["version"] == "v2"
+        assert registry.get("r1").draining
+        snap = router.fleet_snapshot()
+        assert snap["canaries"]["m"]["state"] == "rolled_back"
+    finally:
+        _stop_all(front, procs)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 cursor migration: kill the owner mid-hop, stitch bitwise
+# ---------------------------------------------------------------------------
+
+def test_cursor_migration_stitches_bitwise_tail(gen_art, tmp_path):
+    prompt, max_new, temp, seed = [5, 9, 13], 17, 0.7, 11
+    ref = [int(t) for t in dm.reference_generate(
+        gen_art["params"], GEN_SPEC, prompt, max_new,
+        temperature=temp, seed=seed)]
+
+    registry = ReplicaRegistry(heartbeat_timeout_s=3.0)
+    router = Router(registry=registry, hop_tokens=4)
+    front = route_http(router, "127.0.0.1", 0)
+    url = front.address
+    tele = str(tmp_path / "tele")
+    os.makedirs(tele)
+    procs = []
+    try:
+        # gA owns the session and is armed to die mid-generation: hop 1
+        # (4 tokens) consumes 3 decode steps of the skip budget; the
+        # unsplittable final hop burns the remaining 3 and the 7th
+        # decode-step event SIGKILLs the process with its KV pages
+        procs.append(_spawn_replica(
+            tmp_path, gen_art["path"], url, "gA", "vA",
+            extra_env={
+                "MXNET_FAULT_INJECT": "kill@serve=decode_step:skip=6",
+                "MXNET_TELEMETRY_DIR": tele}))
+        procs.append(_spawn_replica(tmp_path, gen_art["path"], url,
+                                    "gB", "vB"))
+        _wait_routable(registry, 2, tmp_path)
+        # pin the session's first hops onto the victim
+        router.set_split("m", {"vA": 1.0})
+
+        code, out = _post(url + "/v1/generate",
+                          {"model": "m", "prompt": prompt,
+                           "max_new_tokens": max_new,
+                           "temperature": temp, "seed": seed},
+                          timeout=300)
+        assert code == 200, out
+        # position-keyed sampling: the tail regenerated on the survivor
+        # stitches BITWISE onto the banked hop-1 tokens
+        assert out["tokens"] == ref
+        assert out["finish_reason"] == "length"
+        assert out["migrations"] >= 1
+        assert out["replicas"] == ["gA", "gB"]
+        assert registry.get("gA").dead
+        assert "proxy failed" in registry.get("gA").dead_reason
+
+        # the kill left a flight-recorder postmortem naming the injection
+        pms = glob.glob(os.path.join(tele, "postmortem_rank*_*.json"))
+        assert pms, os.listdir(tele)
+        rec = json.loads(open(pms[0]).read())
+        assert rec["reason"].startswith("faultinject:")
+
+        # the fleet keeps serving: a fresh session runs wholly on the
+        # survivor (the vA-only split is intent, not a suicide pact) and
+        # still matches the single-process reference
+        code, out = _post(url + "/v1/generate",
+                          {"model": "m", "prompt": [2, 3],
+                           "max_new_tokens": 6, "temperature": 0.0,
+                           "seed": 0},
+                          timeout=300)
+        assert code == 200, out
+        assert out["replicas"] == ["gB"] and out["migrations"] == 0
+        assert out["tokens"] == [int(t) for t in dm.reference_generate(
+            gen_art["params"], GEN_SPEC, [2, 3], 6)]
+    finally:
+        _stop_all(front, procs)

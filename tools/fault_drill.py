@@ -18,6 +18,17 @@ suitable for a nightly cron next to bench.py.
 Usage::
 
     python tools/fault_drill.py [--kill-step N] [-n WORKERS] [--keep]
+
+``--fleet`` runs the SERVING drill instead: a router
+(``tools/route.py``) over 3 predict + 2 generate CPU replicas, one of
+each armed with a deterministic mid-load kill
+(``kill@serve=predict_batch:skip=K`` / ``kill@serve=decode_step:skip=K``).
+PASS iff, under mixed predict+generate load, every attempted request
+still completes (goodput degrades toward ~(N-1)/N, never to zero), the
+killed decode sessions finish on the survivor via the router's held
+cursor (migrations >= 1), both victims leave parseable flight-recorder
+postmortems, and the supervised predict victim restarts clean and
+re-registers.
 """
 import argparse
 import json
@@ -29,6 +40,8 @@ import tempfile
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+ROUTE = os.path.join(ROOT, "tools", "route.py")
+SERVE = os.path.join(ROOT, "tools", "serve.py")
 WORKER = os.path.join(ROOT, "tests", "fault_resume_worker.py")
 
 
@@ -48,16 +61,290 @@ def _run(tag, dump, extra_args, extra_env, verbose):
     return r
 
 
+def _build_fleet_artifacts(predict_path, gen_path):
+    """Tiny CPU artifacts for the fleet drill: a 6->4 FC predict net and
+    the standard small decoder. Returns the decoder spec (the loadgen
+    needs vocab/max_prompt_len/max_context for HTTP mode)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.serve import decode_model as dm
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(7)
+    shapes, _, _ = net.infer_shape(data=(2, 6))
+    args = {n: mx.nd.array(rng.uniform(-0.3, 0.3, s).astype("f4"))
+            for n, s in zip(net.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    mx.serving.export_compiled(net, args, {}, {"data": (None, 6)},
+                               predict_path)
+    spec = dm.DecoderSpec(vocab=61, dim=32, num_heads=4, num_layers=2,
+                          max_prompt_len=8, page_size=4,
+                          max_pages_per_slot=8, max_slots=4, num_pages=33)
+    serving.export_generate(dm.init_params(spec, seed=0), spec, gen_path)
+    return spec
+
+
+def _fleet_get(url, path, timeout_s=5.0):
+    import urllib.request
+    with urllib.request.urlopen(url.rstrip("/") + path,
+                                timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+def _wait_ready(router_url, want, timeout_s=180.0, allow_dead=None):
+    """Poll the router's /fleet until ``want`` replicas are ready."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    snap = {}
+    while time.monotonic() < deadline:
+        try:
+            snap = _fleet_get(router_url, "/fleet")
+        except Exception:
+            snap = {}
+        counts = snap.get("counts", {})
+        if counts.get("ready", 0) >= want:
+            return snap
+        time.sleep(0.3)
+    raise RuntimeError("fleet never reached %d ready replicas "
+                       "(last counts: %s)" % (want, snap.get("counts")))
+
+
+def fleet_drill(args):
+    """The serving leg: router + supervised replicas, deterministic
+    mid-load kills, goodput/migration/postmortem assertions."""
+    import glob
+    import threading
+    import time
+
+    sys.path.insert(0, ROOT)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import serve_loadgen
+
+    # skip=N: the victim ignores its first N matching fire points, so
+    # the kill lands mid-phase-B by construction — phase A (45 predict
+    # requests over 3 replicas, no generate traffic) cannot reach it
+    PREDICT_SKIP = 35
+    DECODE_SKIP = 20
+    A_REQUESTS, B_REQUESTS = 45, 210
+    GEN_REQUESTS = 10
+
+    work = tempfile.mkdtemp(prefix="mxtpu_fleet_drill_")
+    telem = os.path.join(work, "telemetry")
+    os.makedirs(telem, exist_ok=True)
+    ok = False
+    router = None
+    sup = None
+    try:
+        predict_art = os.path.join(work, "predict.mxtpu")
+        gen_art = os.path.join(work, "generate.mxtpu")
+        print("fault_drill: [fleet] building artifacts...")
+        spec = _build_fleet_artifacts(predict_art, gen_art)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_FAULT_INJECT", None)
+        env.pop("MXNET_TELEMETRY_DIR", None)
+        env["MXNET_FLEET_HEARTBEAT_S"] = "0.3"
+        env["MXNET_FLEET_HEARTBEAT_TIMEOUT_S"] = "1.5"
+
+        router = subprocess.Popen(
+            [sys.executable, ROUTE, "--port", "0", "--hop-tokens", "4",
+             "--heartbeat-timeout-s", "1.5"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=ROOT)
+        router_url = json.loads(router.stdout.readline())["url"]
+        print("fault_drill: [fleet] router at %s" % router_url)
+
+        from mxnet_tpu.fleet import ReplicaSpec, ReplicaSupervisor
+        sup = ReplicaSupervisor(backoff_base=0.2, backoff_cap=1.0)
+
+        def spec_for(rid, art, extra_env=None, max_restarts=0):
+            e = dict(env)
+            e.update(extra_env or {})
+            argv = [sys.executable, SERVE, "--artifact", art,
+                    "--port", "0", "--register", router_url,
+                    "--replica-id", rid]
+            if art is predict_art:
+                # bucket 1 only: every request is its own dispatched
+                # batch, so skip=N counts REQUESTS — the kill point
+                # stays deterministic under coalescing
+                argv += ["--buckets", "1"]
+            return ReplicaSpec(
+                rid, argv, env=e, cwd=ROOT, max_restarts=max_restarts,
+                log_path=os.path.join(work, rid + ".log"))
+
+        # predict victim restarts once (clean env), decode victim stays
+        # down so the migrated sessions MUST finish on the survivor
+        sup.add(spec_for("p0", predict_art, {
+            "MXNET_FAULT_INJECT":
+                "kill@serve=predict_batch:skip=%d" % PREDICT_SKIP,
+            "MXNET_TELEMETRY_DIR": telem}, max_restarts=1))
+        sup.add(spec_for("p1", predict_art))
+        sup.add(spec_for("p2", predict_art))
+        sup.add(spec_for("g0", gen_art, {
+            "MXNET_FAULT_INJECT":
+                "kill@serve=decode_step:skip=%d" % DECODE_SKIP,
+            "MXNET_TELEMETRY_DIR": telem}, max_restarts=0))
+        sup.add(spec_for("g1", gen_art))
+        sup.start(interval_s=0.2)
+
+        print("fault_drill: [fleet] waiting for 5 ready replicas...")
+        _wait_ready(router_url, 5)
+
+        # phase A: predict-only baseline; small enough that the armed
+        # victims survive it (assert they did)
+        res_a = serve_loadgen.measure(router_url, concurrency=6,
+                                      requests=A_REQUESTS, retries=2,
+                                      shape=(1, 6))
+        snap = _fleet_get(router_url, "/fleet")
+        dead = [r["id"] for r in snap["replicas"] if r["dead"]]
+        if res_a["completed"] != A_REQUESTS or dead:
+            print("fault_drill: FAIL — baseline phase lost requests "
+                  "(%d/%d) or replicas (%s)"
+                  % (res_a["completed"], A_REQUESTS, dead))
+            return 1
+        print("fault_drill: [fleet] baseline goodput %.1f qps over %s"
+              % (res_a["goodput_qps"], res_a.get("per_replica")))
+
+        # phase B: mixed load; both victims die mid-phase
+        res_b = {}
+        res_g = {}
+
+        def predict_load():
+            res_b.update(serve_loadgen.measure(
+                router_url, concurrency=8, requests=B_REQUESTS,
+                retries=4, shape=(1, 6)))
+
+        def generate_load():
+            res_g.update(serve_loadgen.measure_generate(
+                router_url, users=3, requests=GEN_REQUESTS,
+                prompt_len=4, prompt_dist="fixed", max_new=10,
+                output_dist="fixed", temperature=0.7, seed=11,
+                retries=4, resume_evicted=3, vocab=spec.vocab,
+                max_prompt_len=spec.max_prompt_len,
+                max_context=spec.max_context))
+
+        threads = [threading.Thread(target=predict_load),
+                   threading.Thread(target=generate_load)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        print("fault_drill: [fleet] mixed phase took %.1fs"
+              % (time.monotonic() - t0))
+
+        failures = []
+        if res_b.get("completed") != B_REQUESTS:
+            failures.append("predict lost requests under the kill: %s"
+                            % {k: res_b.get(k) for k in
+                               ("attempted", "completed", "rejected",
+                                "expired", "errors")})
+        ratio = ((res_b.get("goodput_qps") or 0.0)
+                 / max(res_a["goodput_qps"], 1e-9))
+        if ratio < 0.15:
+            failures.append("predict goodput collapsed: %.1f -> %.1f qps"
+                            % (res_a["goodput_qps"],
+                               res_b.get("goodput_qps") or 0.0))
+        if len(res_b.get("per_replica") or {}) < 2:
+            failures.append("predict traffic did not spread: %s"
+                            % res_b.get("per_replica"))
+        if res_g.get("completed") != GEN_REQUESTS:
+            failures.append("generate sessions lost under the kill: %s"
+                            % {k: res_g.get(k) for k in
+                               ("attempted", "completed", "evicted",
+                                "rejected", "errors")})
+        moved = (res_g.get("migrations") or 0) \
+            + (res_g.get("resumed_sessions") or 0)
+        if moved < 1:
+            failures.append("no decode session crossed replicas "
+                            "(migrations=%s resumed=%s)"
+                            % (res_g.get("migrations"),
+                               res_g.get("resumed_sessions")))
+
+        # the victims must actually have died (and left postmortems)
+        snap = _fleet_get(router_url, "/fleet")
+        by_id = {r["id"]: r for r in snap["replicas"]}
+        if not by_id.get("g0", {}).get("dead"):
+            failures.append("decode victim g0 is not dead — the "
+                            "injected kill never fired")
+        pms = sorted(glob.glob(os.path.join(telem,
+                                            "postmortem_rank*_*.json")))
+        if len(pms) < 2:
+            failures.append("expected 2 victim postmortems, found %d"
+                            % len(pms))
+        for pm in pms:
+            with open(pm) as f:
+                post = json.load(f)
+            if not post.get("reason", "").startswith("faultinject:"):
+                failures.append("postmortem %s has unexpected reason %r"
+                                % (os.path.basename(pm),
+                                   post.get("reason")))
+
+        # recovery: the supervisor restarts p0 with MXNET_FAULT_INJECT
+        # cleared; it re-registers under the same id and goes ready
+        try:
+            _wait_ready(router_url, 4, timeout_s=120.0)
+            snap = _fleet_get(router_url, "/fleet")
+            p0 = {r["id"]: r for r in snap["replicas"]}.get("p0", {})
+            if p0.get("dead") or not p0.get("ready"):
+                failures.append("restarted p0 never re-registered ready "
+                                "(%s)" % p0)
+        except RuntimeError as e:
+            failures.append(str(e))
+
+        if failures:
+            for f in failures:
+                print("fault_drill: FAIL — %s" % f)
+            return 1
+        print("fault_drill: [fleet] PASS — goodput %.1f -> %.1f qps "
+              "(x%.2f, 1 of 3 predict replicas killed), %d/%d decode "
+              "sessions done (migrations=%d resumed=%d, post-migration "
+              "%.1f tok/s), %d postmortems parsed, p0 restarted clean"
+              % (res_a["goodput_qps"], res_b["goodput_qps"], ratio,
+                 res_g["completed"], GEN_REQUESTS,
+                 res_g.get("migrations") or 0,
+                 res_g.get("resumed_sessions") or 0,
+                 res_g.get("post_migration_tokens_per_s") or 0.0,
+                 len(pms)))
+        ok = True
+        return 0
+    finally:
+        if sup is not None:
+            sup.stop(wait_s=15.0)
+        if router is not None:
+            router.terminate()
+            try:
+                router.wait(10)
+            except subprocess.TimeoutExpired:
+                router.kill()
+        if args.keep or not ok:
+            print("fault_drill: scratch kept at %s" % work)
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("-n", "--num-workers", type=int, default=2)
     ap.add_argument("--kill-step", type=int, default=3,
                     help="global step at which rank 0 is SIGKILLed")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the serving-fleet drill (router + replica "
+                         "kills) instead of the training drill")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch directory for forensics")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="stream worker output even on success")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return fleet_drill(args)
 
     work = tempfile.mkdtemp(prefix="mxtpu_fault_drill_")
     base_dump = os.path.join(work, "baseline.npz")

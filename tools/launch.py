@@ -51,6 +51,25 @@ import tempfile
 import threading
 
 
+def _load_backoff():
+    """The one restart schedule, shared with the serving fleet
+    supervisor. Loaded from mxnet_tpu/fleet/supervisor.py by file path
+    — that module is stdlib-only, while importing the mxnet_tpu
+    *package* would pull jax into the launcher process."""
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "mxnet_tpu", "fleet", "supervisor.py")
+    spec = importlib.util.spec_from_file_location(
+        "_mxtpu_fleet_supervisor", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.backoff_delay
+
+
+_backoff_delay = _load_backoff()
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -295,8 +314,8 @@ def main(argv=None):
                          "resumed": attempt > 0})
         if rc == 0 or rc == 130 or attempt >= args.max_restarts:
             break
-        delay = min(30.0, args.restart_backoff * (2 ** attempt)) \
-            * random.uniform(0.5, 1.5)
+        delay = _backoff_delay(attempt, base=args.restart_backoff,
+                               cap=30.0, jitter=0.5, rng=random)
         sys.stderr.write(
             "launch.py: restarting the group (attempt %d/%d) in %.1fs; "
             "workers will resume from %s\n"

@@ -1,0 +1,115 @@
+"""Run a fleet router in front of ``tools/serve.py`` replicas.
+
+    python tools/route.py --port 8090 [--verbose]
+
+Replicas self-register: start each ``tools/serve.py`` with
+``--register http://127.0.0.1:8090`` and it appears in the rotation as
+soon as its warmup finishes (push registration + heartbeats; nothing to
+configure here). ``--replicas url1,url2`` additionally seeds the
+registry from running non-fleet servers by scraping their ``/info``;
+static seeds send no heartbeats, so they are exempt from the staleness
+sweep and trusted until a proxied request to them fails.
+
+Endpoints (see mxnet_tpu/fleet/router.py):
+    POST /v1/predict             least-loaded over ready replicas
+    POST /v1/generate            session-affine, cursor-migrated hops
+    POST /fleet/register|heartbeat|deregister      (replica-facing)
+    POST /admin/split|promote|canary|canary/report|drain
+    GET  /fleet                  registry + splits + canaries snapshot
+    GET  /metrics                federated Prometheus exposition
+                                 (?format=prometheus / Accept: text/plain)
+                                 or the JSON fleet snapshot
+    GET  /healthz /readyz /livez
+
+The router never runs model code or touches a device — replicas own
+the accelerators. SIGINT/SIGTERM stops the listener; replicas keep
+serving and re-register with the next router incarnation on their own.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _seed_static(router, urls):
+    """Best-effort: register already-running servers by their /info."""
+    seeded = []
+    for url in urls:
+        url = url.rstrip("/")
+        try:
+            with urllib.request.urlopen(url + "/info", timeout=3.0) as r:
+                info = json.loads(r.read().decode())
+        except Exception as e:
+            print("route: cannot seed %s: %s" % (url, e), file=sys.stderr)
+            continue
+        rid = "static-%s" % url.split("//", 1)[-1].replace(":", "-")
+        router.registry.register({
+            "id": rid, "url": url,
+            "model": info.get("model") or "default",
+            "version": info.get("version") or "0",
+            "mode": info.get("mode", "predict"),
+            "identity": info.get("identity"),
+            "ready": bool(info.get("ready", True)),
+            "reason": info.get("reason"),
+            "spec": info.get("generate"),
+            "static": True,   # no heartbeats — exempt from the sweep
+        })
+        seeded.append(rid)
+    return seeded
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8090)
+    p.add_argument("--replicas", default=None,
+                   help="comma-separated replica base URLs to seed "
+                        "statically from their /info (replicas started "
+                        "with --register need nothing here)")
+    p.add_argument("--hop-tokens", type=int, default=None,
+                   help="max_new_tokens per forwarded generate hop "
+                        "(default MXNET_FLEET_HOP_TOKENS); 0 forwards "
+                        "the whole budget in one hop")
+    p.add_argument("--heartbeat-timeout-s", type=float, default=None,
+                   help="seconds without a heartbeat before a replica "
+                        "is declared dead "
+                        "(default MXNET_FLEET_HEARTBEAT_TIMEOUT_S)")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+
+    from mxnet_tpu.fleet import ReplicaRegistry, Router, route_http
+
+    registry = ReplicaRegistry(heartbeat_timeout_s=args.heartbeat_timeout_s)
+    router = Router(registry=registry, hop_tokens=args.hop_tokens)
+    seeded = []
+    if args.replicas:
+        seeded = _seed_static(
+            router, [u for u in args.replicas.split(",") if u.strip()])
+    front = route_http(router, args.host, args.port, verbose=args.verbose)
+    banner = {"routing": True, "url": front.address,
+              "replicas": seeded,
+              "hop_tokens": router.hop_tokens,
+              "heartbeat_timeout_s": registry.heartbeat_timeout_s}
+    print(json.dumps(banner), flush=True)
+
+    done = threading.Event()
+
+    def _shutdown(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGINT, _shutdown)
+    signal.signal(signal.SIGTERM, _shutdown)
+    done.wait()
+    front.stop()
+    print(json.dumps(router.fleet_snapshot()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,20 +5,35 @@ micro-batching and admission control.
         [--buckets 1,8,32] [--batch-timeout-ms 2] [--queue-depth 256] \
         [--timeout-ms 1000] [--no-warmup] [--verbose]
 
+Fleet mode (a replica behind ``tools/route.py``):
+
+    python tools/serve.py --artifact model.mxtpu --port 0 \
+        --register http://router:8090 --model-name resnet --model-version v1
+
+``--register`` makes this process a fleet replica: it announces itself
+to the router (id, url, (model, version), artifact identity), heartbeats
+readiness + a perfmodel-derived load summary every
+``MXNET_FLEET_HEARTBEAT_S``, and deregisters before draining so the
+router migrates traffic with zero drops. Registration implies
+``--warm-async``: the listener comes up immediately and the replica
+reports not-ready ("warming") until engine compiles finish.
+
 Endpoints (see mxnet_tpu/serve/http.py):
     POST /v1/predict   {"inputs": {"data": [[...]]}}     (predict mode)
     POST /v1/generate  {"prompt": [ids], ...}            (generate mode)
     GET  /metrics      per-bucket p50/p95/p99, occupancy, padding waste
                        (generate mode: tokens/s, TTFT/TPOT, page occ.)
-    GET  /healthz
+    GET  /healthz      combined legacy probe
+    GET  /livez        liveness    GET /readyz  readiness (+reason)
+    GET  /info         artifact identity / wire geometry
 
 The artifact kind picks the mode: a format_version-3 generate artifact
 (serving.export_generate) starts the continuous-batching decode engine;
 anything else starts the predict micro-batcher.
 
-SIGINT/SIGTERM triggers a graceful drain: the listener stops accepting,
-every admitted request finishes, then the final metrics snapshot is
-printed to stdout.
+SIGINT/SIGTERM triggers a graceful drain: deregister from the fleet
+(if registered), stop accepting, finish every admitted request, then
+print the final metrics snapshot to stdout.
 """
 from __future__ import annotations
 
@@ -53,6 +68,20 @@ def main():
                    help="generate mode: default completion budget when "
                         "the request does not set one")
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--register", default=None, metavar="ROUTER_URL",
+                   help="fleet mode: register with this tools/route.py "
+                        "router and heartbeat readiness + load")
+    p.add_argument("--replica-id", default=None,
+                   help="fleet replica id (default host-pid)")
+    p.add_argument("--model-name", default="default",
+                   help="model this replica serves, for routing and "
+                        "traffic splits")
+    p.add_argument("--model-version", default="0",
+                   help="artifact version, for blue/green + canarying")
+    p.add_argument("--warm-async", action="store_true",
+                   help="start the HTTP listener before engine warmup; "
+                        "/readyz reports 'warming' until compiles "
+                        "finish (implied by --register)")
     p.add_argument("--platform", default=None, choices=[None, "cpu"],
                    help="pin jax to this backend before loading")
     p.add_argument("--verbose", action="store_true")
@@ -64,8 +93,11 @@ def main():
 
     from mxnet_tpu.serve import (GenerateConfig, ServeConfig, Server,
                                  serve_http)
-    from mxnet_tpu.serving import GenerateModel, load_artifact
+    from mxnet_tpu.serving import (GenerateModel, artifact_identity,
+                                   load_artifact)
 
+    warm_async = args.warm_async or bool(args.register)
+    identity = artifact_identity(args.artifact)
     model = load_artifact(args.artifact)
     if isinstance(model, GenerateModel):
         cfg = GenerateConfig(
@@ -73,7 +105,7 @@ def main():
             timeout_ms=args.timeout_ms,
             drain_tokens=args.drain_tokens,
             max_new_tokens=args.max_new_tokens,
-            warmup=False if args.no_warmup else None)
+            warmup=False if (args.no_warmup or warm_async) else None)
     else:
         cfg = ServeConfig(
             buckets=args.buckets,
@@ -81,11 +113,17 @@ def main():
             queue_depth=args.queue_depth,
             timeout_ms=args.timeout_ms,
             cache_engines=args.cache_engines,
-            warmup=False if args.no_warmup else None)
-    server = Server(model, config=cfg)
+            warmup=False if (args.no_warmup or warm_async) else None)
+    server = Server(model, config=cfg, auto_start=not warm_async)
+    server.model_name = args.model_name
+    server.model_version = args.model_version
+    server.identity = identity
+    if warm_async:
+        server.warmup_async()
     front = serve_http(server, args.host, args.port, verbose=args.verbose)
     banner = {"serving": args.artifact, "mode": server.mode,
-              "url": front.address}
+              "url": front.address, "model": args.model_name,
+              "version": args.model_version}
     if server.mode == "generate":
         spec = server.session.spec
         banner["slots"] = spec.max_slots
@@ -93,6 +131,29 @@ def main():
         banner["page_size"] = spec.page_size
     else:
         banner["buckets"] = list(server.buckets)
+
+    announcer = None
+    if args.register:
+        import socket
+        from mxnet_tpu.fleet import ReplicaAnnouncer
+        rid = args.replica_id or ("%s-%d" % (socket.gethostname(),
+                                             os.getpid()))
+        info = {"id": rid, "url": front.address,
+                "model": args.model_name, "version": args.model_version,
+                "mode": server.mode, "identity": identity,
+                "pid": os.getpid()}
+        if server.mode == "generate":
+            # the router chunks generate hops; it needs the prefill
+            # window to know where resume points stop being admissible
+            sp = server.session.spec
+            info["spec"] = {"vocab": sp.vocab,
+                            "max_prompt_len": sp.max_prompt_len,
+                            "max_context": sp.max_context}
+        announcer = ReplicaAnnouncer(args.register, info,
+                                     server.load_status)
+        announcer.start()
+        banner["replica_id"] = rid
+        banner["router"] = args.register
     print(json.dumps(banner), flush=True)
 
     done = threading.Event()
@@ -104,6 +165,11 @@ def main():
     signal.signal(signal.SIGTERM, _shutdown)
     done.wait()
     print("draining...", file=sys.stderr, flush=True)
+    if announcer is not None:
+        # leave rotation BEFORE draining: the router re-routes new
+        # traffic (and migrates decode sessions via their cursors)
+        # while this process finishes what it already admitted
+        announcer.stop(deregister=True)
     front.stop(drain=True)
     print(json.dumps(server.metrics()), flush=True)
 

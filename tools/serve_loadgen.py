@@ -20,6 +20,16 @@ workers at a replacement server mid-run).
 artifacts): closed-loop users with per-request prompt/output lengths
 drawn from fixed/uniform/longtail distributions, reporting TTFT/TPOT
 percentiles and tokens/s goodput. Importable as ``measure_generate``.
+
+``--router http://...`` drives a ``tools/route.py`` fleet front end
+instead of a single replica: same closed loop, but the report adds the
+per-replica request distribution (from the ``replica`` field the router
+stamps on every response), migration counts, and — for ``--generate`` —
+the goodput of sessions that survived a replica death or eviction
+mid-decode (``post_migration_tokens_per_s``). Evictions that surface as
+429-with-cursor are resubmitted from ``cursor["resume_prompt"]`` after
+the Retry-After hint (``--resume-evicted`` bounds how many times), so a
+killed replica costs latency, not the session.
 """
 from __future__ import annotations
 
@@ -67,18 +77,18 @@ def _http_call(url, payload, timeout_s):
         headers={"Content-Type": "application/json"})
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
-            json.loads(r.read().decode())
-            return "ok", None
+            return "ok", None, json.loads(r.read().decode())
     except urllib.error.HTTPError as e:
         if e.code == 429:
-            return "rejected", float(e.headers.get("Retry-After", 0.05))
+            return ("rejected",
+                    float(e.headers.get("Retry-After", 0.05)), None)
         if e.code == 504:
-            return "expired", None
+            return "expired", None, None
         if e.code == 503:
-            return "closed", None
-        return "error", None
+            return "closed", None, None
+        return "error", None, None
     except Exception:
-        return "error", None
+        return "error", None, None
 
 
 def measure(target, concurrency=8, requests=256, qps=None, rows=1,
@@ -123,6 +133,7 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
 
     counters = {"completed": 0, "rejected": 0, "expired": 0, "errors": 0}
     latencies = []
+    per_replica = {}     # replica id -> completed count (router mode)
     lock = threading.Lock()
     next_idx = [0]
     pace = (concurrency / qps) if qps else 0.0   # per-worker inter-arrival
@@ -138,14 +149,14 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
                 next_idx[0] += 1
             feed = feeds[i % len(feeds)]
             t0 = time.monotonic()
-            outcome = "error"
+            outcome, body = "error", None
             for attempt in range(retries + 1):
                 if is_url:
                     payload = {"inputs": {n: v.tolist()
                                           for n, v in feed.items()}}
                     if timeout_ms:
                         payload["timeout_ms"] = timeout_ms
-                    outcome, retry_after = _http_call(
+                    outcome, retry_after, body = _http_call(
                         target, payload,
                         timeout_s=(timeout_ms or 30000) / 1e3 + 5)
                     if outcome == "ok":
@@ -185,6 +196,9 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
                 if outcome == "ok":
                     counters["completed"] += 1
                     latencies.append(dt_ms)
+                    rid = (body or {}).get("replica")
+                    if rid:
+                        per_replica[rid] = per_replica.get(rid, 0) + 1
                 elif outcome in ("rejected", "closed"):
                     counters["rejected"] += 1
                 elif outcome == "expired":
@@ -231,6 +245,8 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
         },
         "histogram": {"edges_ms": _HIST_EDGES_MS, "counts": hist},
     }
+    if per_replica:
+        out["per_replica"] = dict(sorted(per_replica.items()))
     if not is_url and get_server is not None:
         try:
             out["server_metrics"] = get_server().metrics()
@@ -365,11 +381,60 @@ def _http_generate(url, payload, timeout_s):
         return "error", None, None
 
 
+def _http_generate_session(url, prompt, budget, temperature, seed,
+                           timeout_ms, retries, resume_evicted):
+    """One logical generation over HTTP: admission-reject retries plus
+    bounded cursor resubmission. An eviction's partial tokens are
+    banked and the session continues from ``cursor["resume_prompt"]``
+    (same seed — position-keyed sampling keeps the tail identical to an
+    uninterrupted run). Returns (outcome, merged out dict, resumes)."""
+    tokens = []
+    cur_prompt = list(prompt)
+    remaining = int(budget)
+    resumes = rejects = 0
+    out = None
+    while True:
+        if remaining <= 0:
+            return "ok", {"tokens": tokens, "finish_reason": "length"}, \
+                resumes
+        payload = {"prompt": cur_prompt, "max_new_tokens": remaining,
+                   "temperature": temperature, "seed": seed}
+        if timeout_ms:
+            payload["timeout_ms"] = timeout_ms
+        outcome, out, retry_after = _http_generate(
+            url, payload, timeout_s=(timeout_ms or 60000) / 1e3 + 30)
+        if outcome == "ok":
+            out = dict(out or {})
+            out["tokens"] = tokens + list(out.get("tokens") or [])
+            return "ok", out, resumes
+        if outcome == "evicted":
+            got = list((out or {}).get("tokens") or [])
+            cursor = (out or {}).get("cursor") or {}
+            if resumes >= resume_evicted \
+                    or not cursor.get("resume_prompt"):
+                out = dict(out or {})
+                out["tokens"] = tokens + got
+                return "evicted", out, resumes
+            tokens += got
+            cur_prompt = list(cursor["resume_prompt"])
+            remaining = int(cursor.get("remaining_tokens")
+                            or (budget - len(tokens)))
+            resumes += 1
+            time.sleep(min(retry_after or 0.05, 0.5))
+            continue
+        if outcome in ("rejected", "closed") and rejects < retries:
+            rejects += 1
+            time.sleep(retry_after or 0.05)
+            continue
+        return outcome, out, resumes
+
+
 def measure_generate(target, users=4, requests=64, prompt_len=8,
                      prompt_dist="longtail", max_new=16,
                      output_dist="longtail", temperature=0.0,
                      timeout_ms=None, retries=0, seed=0, vocab=None,
-                     max_prompt_len=None, max_context=None):
+                     max_prompt_len=None, max_context=None,
+                     resume_evicted=0):
     """Closed-loop generation benchmark: ``users`` workers, each
     submitting its next prompt the moment the previous completion lands.
     Prompt/output lengths are drawn per-request from the configured
@@ -378,9 +443,16 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
     that actually matter for autoregressive decode.
 
     ``target``: a generate-mode Server, a GenerateSession, an artifact
-    path, or an ``http://`` URL of a running generate server. HTTP mode
-    needs ``vocab``/``max_prompt_len``/``max_context`` since the spec is
-    not visible through the wire.
+    path, or an ``http://`` URL of a running generate server or fleet
+    router. HTTP mode needs ``vocab``/``max_prompt_len``/``max_context``
+    since the spec is not visible through the wire.
+
+    ``resume_evicted``: HTTP mode — how many times a 429-with-cursor
+    (an eviction, or a router that ran out of replicas mid-session) is
+    resubmitted from ``cursor["resume_prompt"]`` after the Retry-After
+    hint. Banked partial tokens count toward the session either way;
+    with resumes the session completes across replicas instead of
+    surfacing the eviction to the caller.
     """
     import numpy as np
 
@@ -422,6 +494,10 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
     ttfts, tpots, latencies = [], [], []
     tokens_ok = [0]
     tokens_partial = [0]
+    per_replica = {}          # replica -> completions it finished
+    migrations_total = [0]    # router-reported mid-session owner moves
+    resumed_sessions = [0]    # sessions completed via cursor resubmit
+    migrated = {"tokens": 0, "wall_s": 0.0}   # post-migration goodput
     lock = threading.Lock()
     next_idx = [0]
 
@@ -435,22 +511,13 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
                 i = next_idx[0]
                 next_idx[0] += 1
             t0 = time.monotonic()
-            outcome, out = "error", None
+            outcome, out, resumes = "error", None, 0
             for attempt in range(retries + 1):
                 if is_url:
-                    payload = {"prompt": prompts[i],
-                               "max_new_tokens": int(olens[i]),
-                               "temperature": temperature,
-                               "seed": int(seed + i)}
-                    if timeout_ms:
-                        payload["timeout_ms"] = timeout_ms
-                    outcome, out, retry_after = _http_generate(
-                        target, payload,
-                        timeout_s=(timeout_ms or 60000) / 1e3 + 30)
-                    if outcome in ("rejected", "closed") \
-                            and attempt < retries:
-                        time.sleep(retry_after or 0.05)
-                        continue
+                    outcome, out, resumes = _http_generate_session(
+                        target, prompts[i], int(olens[i]), temperature,
+                        int(seed + i), timeout_ms, retries,
+                        resume_evicted)
                     break
                 try:
                     out = session.generate(
@@ -485,11 +552,24 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
                 if outcome == "ok":
                     counters["completed"] += 1
                     latencies.append(dt_ms)
-                    tokens_ok[0] += len(out.get("tokens", []))
+                    ntok = len(out.get("tokens", []))
+                    tokens_ok[0] += ntok
                     if out.get("ttft_ms") is not None:
                         ttfts.append(out["ttft_ms"])
                     if out.get("tpot_ms") is not None:
                         tpots.append(out["tpot_ms"])
+                    rid = out.get("replica")
+                    if rid:
+                        per_replica[rid] = per_replica.get(rid, 0) + 1
+                    mig = int(out.get("migrations") or 0)
+                    migrations_total[0] += mig
+                    if resumes:
+                        resumed_sessions[0] += 1
+                    if mig or resumes:
+                        # sessions that crossed replicas: their goodput
+                        # is the ~1/N-degradation evidence
+                        migrated["tokens"] += ntok
+                        migrated["wall_s"] += dt_ms / 1e3
                 elif outcome == "evicted":
                     counters["evicted"] += 1
                     tokens_partial[0] += len((out or {}).get("tokens", []))
@@ -533,6 +613,14 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
         "tpot_ms": _pct(tpots),
         "latency_ms": _pct(latencies),
     }
+    if is_url:
+        out["migrations"] = migrations_total[0]
+        out["resumed_sessions"] = resumed_sessions[0]
+        out["post_migration_tokens_per_s"] = (
+            round(migrated["tokens"] / migrated["wall_s"], 2)
+            if migrated["wall_s"] > 0 else None)
+    if per_replica:
+        out["per_replica"] = dict(sorted(per_replica.items()))
     if session is not None:
         try:
             out["server_metrics"] = session.metrics()
@@ -546,6 +634,11 @@ def main():
     g = p.add_mutually_exclusive_group(required=True)
     g.add_argument("--artifact", help="serve in-process from this artifact")
     g.add_argument("--url", help="drive a running tools/serve.py endpoint")
+    g.add_argument("--router",
+                   help="drive a tools/route.py fleet front end: same "
+                        "protocol as --url plus per-replica request "
+                        "distribution, migration counts, and cursor "
+                        "resubmission across replica deaths")
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--requests", type=int, default=256)
     p.add_argument("--qps", type=float, default=None,
@@ -557,6 +650,10 @@ def main():
                         "(HTTP mode)")
     p.add_argument("--timeout-ms", type=float, default=None)
     p.add_argument("--retries", type=int, default=0)
+    p.add_argument("--resume-evicted", type=int, default=None,
+                   help="--generate over HTTP: max cursor resubmissions "
+                        "per session after a 429-with-cursor (default 2 "
+                        "in --router mode, 0 against a bare replica)")
     p.add_argument("--buckets", default=None)
     p.add_argument("--generate", action="store_true",
                    help="generation workload (generate-mode artifact / "
@@ -596,8 +693,12 @@ def main():
                         "/metrics exposition, assert it parses, and "
                         "embed a summary (HTTP mode only)")
     args = p.parse_args()
-    if args.scrape_metrics and not args.url:
-        p.error("--scrape-metrics needs --url (HTTP mode)")
+    url = args.url or args.router
+    if args.scrape_metrics and not url:
+        p.error("--scrape-metrics needs --url or --router (HTTP mode)")
+    resume_evicted = args.resume_evicted
+    if resume_evicted is None:
+        resume_evicted = 2 if args.router else 0
 
     if args.platform == "cpu":
         import jax
@@ -628,8 +729,8 @@ def main():
                 f.write(line)
         return
 
-    if args.url:
-        target = args.url
+    if url:
+        target = url
         shape = tuple(int(x) for x in args.shape.split(",")) \
             if args.shape else None
     else:
@@ -648,16 +749,17 @@ def main():
             temperature=args.temperature, timeout_ms=args.timeout_ms,
             retries=args.retries, seed=args.seed, vocab=args.vocab,
             max_prompt_len=args.max_prompt_len,
-            max_context=args.max_context)
+            max_context=args.max_context,
+            resume_evicted=resume_evicted)
     else:
         res = measure(target, concurrency=args.concurrency,
                       requests=args.requests, qps=args.qps, rows=args.rows,
                       timeout_ms=args.timeout_ms, shape=shape,
                       retries=args.retries)
-    if not args.url:
+    if not url:
         target.close(drain=True)
     if args.scrape_metrics:
-        res["prometheus"] = scrape_prometheus(args.url)
+        res["prometheus"] = scrape_prometheus(url)
         assert res["prometheus"]["families"] > 0, \
             "/metrics exposition parsed but held no metric families"
     line = json.dumps(res)
