@@ -161,7 +161,11 @@ def _decode_leg(on_tpu):
     static mode (a group runs to its last straggler). The headline is
     the goodput ratio; decode STEP counts are reported too since they
     are the deterministic, load-independent form of the same ratio.
-    Also runs the MXL508 chip-free gate over the served decode step."""
+    A second pass runs the same workload through a speculative
+    (int8-draft, format_version-5) artifact with speculation on vs off
+    at matched distribution, reporting accepted-tokens/step, draft
+    acceptance rate, and the tokens/s/user + step-count speedups.
+    Runs the MXL508 and MXL510 chip-free gates over the served steps."""
     import tempfile
     import numpy as np
     from mxnet_tpu import serving
@@ -200,10 +204,10 @@ def _decode_leg(on_tpu):
             prompt = rng.randint(2, spec.vocab, size=plen).tolist()
             work.append((prompt, long_new if j == S - 1 else short_new))
 
-    def run_mode(continuous):
-        sess = GenerateSession(art, auto_start=False,
+    def run_mode(continuous, path=art, **skw):
+        sess = GenerateSession(path, auto_start=False,
                                continuous=continuous, timeout_ms=0,
-                               queue_depth=len(work) + 1)
+                               queue_depth=len(work) + 1, **skw)
         t1 = time.perf_counter()
         reqs = [sess.submit(p, max_new_tokens=n, temperature=0.0, seed=0)
                 for p, n in work]
@@ -219,30 +223,59 @@ def _decode_leg(on_tpu):
         tpots = sorted(o["tpot_ms"] for o in outs
                        if o["tpot_ms"] is not None)
         sess._publish_window(force=True)
-        steps = sess.metrics_.snapshot()["decode_steps"]
-        diags = sess.check_discipline() if continuous else []
+        snap = sess.metrics_.snapshot()
+        steps = snap["decode_steps"]
+        diags = (sess.check_discipline()
+                 + sess.check_speculative_discipline()) \
+            if continuous else []
         sess.close(drain=True)
 
         def pct(xs, q):
             return round(xs[min(len(xs) - 1,
                                 int(q / 100.0 * len(xs)))], 3) \
                 if xs else None
-        return {"tokens": toks, "wall_s": round(wall, 3),
-                "tokens_per_s": round(toks / wall, 1),
-                "decode_steps": steps,
-                "ttft_ms_p50": pct(ttfts, 50),
-                "ttft_ms_p99": pct(ttfts, 99),
-                "tpot_ms_p50": pct(tpots, 50),
-                "tpot_ms_p99": pct(tpots, 99)}, diags
+        res = {"tokens": toks, "wall_s": round(wall, 3),
+               "tokens_per_s": round(toks / wall, 1),
+               "decode_steps": steps,
+               "ttft_ms_p50": pct(ttfts, 50),
+               "ttft_ms_p99": pct(ttfts, 99),
+               "tpot_ms_p50": pct(tpots, 50),
+               "tpot_ms_p99": pct(tpots, 99)}
+        sp = snap.get("speculative")
+        if sp and sp.get("steps"):
+            res["accepted_tokens_per_step"] = sp["accepted_tokens_per_step"]
+            res["draft_acceptance_rate"] = sp["draft_acceptance_rate"]
+        return res, diags, [o["tokens"] for o in outs]
+
+    # speculative leg: the SAME workload through a format_version-5
+    # artifact bundling the int8 draft, speculation on vs off. Greedy
+    # decode makes the comparison matched-distribution by construction
+    # (the token streams are asserted identical); the step ratio is the
+    # deterministic, load-independent form of the tokens/s/user speedup.
+    draft = _dm.quantize_decoder_params(params)
+    art5 = tempfile.mktemp(suffix=".spec.mxtpu")
+    t0 = time.perf_counter()
+    # k=4 rather than the roofline suggestion: these bench models are
+    # far below the memory-bound regime the roofline models, and the
+    # headline (step-count ratio at matched distribution) needs a
+    # window deep enough for the acceptance tail to show
+    serving.export_generate(params, spec, art5, draft_params=draft,
+                            speculate_k=4)
+    export5_s = round(time.perf_counter() - t0, 2)
 
     try:
-        cont, diags = run_mode(True)
-        stat, _ = run_mode(False)
+        cont, diags, _ = run_mode(True)
+        stat, _, _ = run_mode(False)
+        spec_on, diags510, toks_on = run_mode(True, path=art5,
+                                              speculative=True)
+        spec_off, _, toks_off = run_mode(True, path=art5,
+                                         speculative=False)
     finally:
-        try:
-            os.unlink(art)
-        except OSError:
-            pass
+        for f in (art, art5):
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
     leg["continuous"] = cont
     leg["static"] = stat
     leg["speedup_tokens_per_s"] = round(
@@ -252,6 +285,17 @@ def _decode_leg(on_tpu):
         stat["decode_steps"] / float(cont["decode_steps"]), 2) \
         if cont["decode_steps"] else None
     leg["mxl508"] = "clean" if not diags else [str(d) for d in diags]
+    spec_on["export_s"] = export5_s
+    leg["speculative"] = spec_on
+    leg["speculative_baseline"] = spec_off
+    leg["speculative_matched"] = toks_on == toks_off
+    leg["speculative_speedup_tokens_per_s_user"] = round(
+        spec_on["tokens_per_s"] / spec_off["tokens_per_s"], 2) \
+        if spec_off["tokens_per_s"] else None
+    leg["speculative_speedup_steps"] = round(
+        spec_off["decode_steps"] / float(spec_on["decode_steps"]), 2) \
+        if spec_on["decode_steps"] else None
+    leg["mxl510"] = "clean" if not diags510 else [str(d) for d in diags510]
     return leg
 
 

@@ -25,7 +25,7 @@ __all__ = [
     "d2h_transfer_pass", "fusion_bytes_pass", "RecompileFingerprint",
     "collective_interleave_pass", "collective_overlap_report",
     "decode_cache_discipline_pass", "quant_dequant_budget_pass",
-    "metrics_from_text",
+    "speculative_dispatch_pass", "metrics_from_text",
 ]
 
 HLO_RULES = {r.id: r for r in [
@@ -65,6 +65,15 @@ HLO_RULES = {r.id: r for r in [
          "weights — the artifact shrank but the MXU speedup is gone; "
          "re-quantize with tools/quantize_model.py, see "
          "docs/quantization.md)"),
+    Rule("MXL510", "hlo-speculative-dispatch", "error",
+         "the speculative step must run the int8 draft and its f32 "
+         "verifier as ONE fused dispatch whose only host fetch is the "
+         "packed accept vector (a draft step that is not fused with "
+         "its verifier costs one extra d2h sync per speculative step, "
+         "erasing the drafted-token win) and must donate BOTH KV "
+         "caches — an undonated draft cache is copied every window, "
+         "doubling the dual-cache HBM cost (see docs/serving.md "
+         "speculative decoding)"),
     Rule("MXL507", "hlo-collective-interleave", "error",
          "the DDP step's gradient all-reduces must stay few (one fused "
          "collective per bucket — more means the GradReducer plan "
@@ -224,6 +233,56 @@ def decode_cache_discipline_pass(text, label, cache_params,
             "%d host-transfer op(s) inside the decode step (budget %d) "
             "— every one is a device sync per generated token"
             % (n, d2h_budget)))
+    return diags
+
+
+def speculative_dispatch_pass(text, label, cache_params=(5, 6, 7, 8),
+                              d2h_budget=0):
+    """MXL510: the fused speculative (draft+verify) step's discipline.
+
+    ``cache_params`` names the entry-parameter indices of BOTH paged KV
+    caches — the f32 verifier pair and the int8-draft pair (the fused
+    step donates argnums (5, 6, 7, 8)). The pass fails when any of the
+    four lacks a donation attr (an undonated draft cache is copied
+    every speculative window — the dual-cache design doubles KV bytes
+    already, a copy quadruples them) or when the program contains more
+    than ``d2h_budget`` host-transfer ops: the fused step's ONLY fetch
+    is the packed ``[n_accept, v_1..v_{k+1}]`` vector, and that happens
+    outside the compiled program. A draft step dispatched separately
+    from its verifier shows up here as the extra callback/outfeed it
+    needs to hand the proposals over — exactly the per-step sync the
+    fusion exists to avoid. Chip-free like every Layer-2 pass: lower
+    the served draft_verify jit under JAX_PLATFORMS=cpu and hand the
+    text in (GenerateSession.check_speculative_discipline does)."""
+    params = hlo_stats.entry_params(text)
+    diags = []
+    if not params:
+        return [_diag("MXL510", label,
+                      "no entry computation found — cannot verify KV "
+                      "cache donation on an empty module")]
+    missing = []
+    for idx in cache_params:
+        if idx >= len(params):
+            missing.append("arg%d (out of range, %d params)"
+                           % (idx, len(params)))
+        elif not params[idx]["donated"]:
+            p = params[idx]
+            missing.append("%s (%s, %.1f MiB)"
+                           % (p["name"], p["dtype"], p["bytes"] / 2**20))
+    if missing:
+        diags.append(_diag(
+            "MXL510", label,
+            "speculative KV cache buffer(s) not donated — the fused "
+            "draft+verify step copies the page store every window "
+            "(draft cache included): %s" % ", ".join(missing)))
+    n = d2h_count(text)
+    if n > d2h_budget:
+        diags.append(_diag(
+            "MXL510", label,
+            "%d host-transfer op(s) inside the speculative step "
+            "(budget %d) — the draft is not fused with its verifier: "
+            "every extra transfer is one device sync per speculative "
+            "window" % (n, d2h_budget)))
     return diags
 
 

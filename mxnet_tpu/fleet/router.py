@@ -280,6 +280,18 @@ class Router:
         stalls = 0            # consecutive zero-token hops
         ttft_ms = None
         max_failures = max(2, self.retry_limit) * 4
+        spec_w = 0            # token-weighted speculation aggregation
+        spec_atps = 0.0
+        spec_rate = 0.0
+
+        def _note_spec(out, got):
+            nonlocal spec_w, spec_atps, spec_rate
+            atps = out.get("accepted_tokens_per_step")
+            if atps is not None and got:
+                spec_w += len(got)
+                spec_atps += float(atps) * len(got)
+                spec_rate += float(out.get("draft_acceptance_rate")
+                                   or 0.0) * len(got)
 
         def _partial(status, err, retry_after=0.1):
             self._c_requests.inc(kind="generate", outcome="partial")
@@ -308,14 +320,32 @@ class Router:
                 return _partial(429, "fleet: request deadline reached "
                                      "mid-generation")
             n = min(remaining, hop) if hop > 0 else remaining
+            ctx = int(owner.spec.get("max_context") or 0)
+            if ctx and len(cur_prompt) + remaining > ctx:
+                # definitive, not retryable: prompt + budget exceeds the
+                # paged-cache geometry on every replica of this artifact
+                # (len(cur_prompt) + remaining is invariant across hops
+                # and eviction cursors, so this fires on the first hop)
+                self._c_requests.inc(kind="generate", outcome="error")
+                return 400, {
+                    "error": "fleet: prompt %d + max_new_tokens %d "
+                             "exceeds the artifact's max_context %d"
+                             % (len(prompt),
+                                int(payload.get("max_new_tokens") or 64),
+                                ctx)}, {}
             cap = int(owner.spec.get("max_prompt_len") or 0)
-            if n < remaining and cap and len(cur_prompt) + n > cap:
+            if (n < remaining and cap and len(cur_prompt) + n > cap
+                    and not owner.spec.get("chunked_prefill")):
                 # a resume point is prompt+generated, and it must fit
                 # the artifact's prefill window to be resubmittable (the
                 # same bound gates PR-9 eviction cursors). Once the
                 # post-hop prompt would exceed max_prompt_len there is
                 # nothing to migrate to, so stop chunking and forward
-                # the whole remaining budget in one final hop.
+                # the whole remaining budget in one final hop. Replicas
+                # that register chunked_prefill stream long resume
+                # prompts through fixed-shape chunks up to max_context,
+                # so for them the hop cap stays lifted and long decodes
+                # remain migratable end to end.
                 n = remaining
             body = {"prompt": cur_prompt, "max_new_tokens": int(n),
                     "temperature": temperature, "seed": seed}
@@ -353,6 +383,7 @@ class Router:
                 cur_prompt = cur_prompt + got
                 if ttft_ms is None:
                     ttft_ms = out.get("ttft_ms")
+                _note_spec(out, got)
                 stalls = stalls + 1 if not got else 0
                 if out.get("finish_reason") == "stop":
                     finish = "stop"
@@ -368,6 +399,7 @@ class Router:
                 tokens.extend(got)
                 remaining -= len(got)
                 cur_prompt = [int(t) for t in out["cursor"]["resume_prompt"]]
+                _note_spec(out, got)
                 stalls = stalls + 1 if not got else 0
                 if stalls >= 3:
                     return _partial(429, "fleet: generation stalled "
@@ -391,7 +423,7 @@ class Router:
         self._c_requests.inc(kind="generate", outcome="ok")
         lat_ms = (time.monotonic() - t0) * 1e3
         n_gen = len(tokens)
-        return 200, {
+        out = {
             "tokens": tokens,
             "finish_reason": finish,
             "ttft_ms": ttft_ms,
@@ -404,7 +436,11 @@ class Router:
             "replicas": replicas_used,
             "replica": replicas_used[-1] if replicas_used else None,
             "version": owner_version,
-        }, {}
+        }
+        if spec_w:
+            out["accepted_tokens_per_step"] = round(spec_atps / spec_w, 4)
+            out["draft_acceptance_rate"] = round(spec_rate / spec_w, 4)
+        return 200, out, {}
 
     # -- blue/green + canary ------------------------------------------------
     def set_split(self, model, weights):

@@ -66,7 +66,7 @@ class GenerateConfig:
     def __init__(self, queue_depth=None, timeout_ms=None,
                  drain_tokens=None, drain_timeout_s=None,
                  window_steps=None, max_new_tokens=64, continuous=True,
-                 warmup=None):
+                 warmup=None, speculative=None):
         self.queue_depth = (flags.serve_queue_depth if queue_depth is None
                             else int(queue_depth))
         self.timeout_ms = (flags.serve_timeout_ms if timeout_ms is None
@@ -81,6 +81,10 @@ class GenerateConfig:
         self.max_new_tokens = int(max_new_tokens)
         self.continuous = bool(continuous)
         self.warmup = warmup
+        # None = auto (speculate iff the artifact bundles a draft);
+        # True = require the draft (load error otherwise); False = force
+        # plain one-token decode even on a speculative artifact
+        self.speculative = speculative
 
 
 class GenerateRequest:
@@ -171,7 +175,8 @@ class PagedKVCache:
 
 
 class _Slot:
-    __slots__ = ("req", "pages", "gen", "t_first", "drain_cap")
+    __slots__ = ("req", "pages", "gen", "t_first", "drain_cap",
+                 "spec_steps", "accepted")
 
     def __init__(self, req, pages):
         self.req = req
@@ -179,6 +184,8 @@ class _Slot:
         self.gen = []            # every sampled token, first included
         self.t_first = None      # wall stamp of the first token
         self.drain_cap = None    # len(gen) bound once draining
+        self.spec_steps = 0      # fused draft+verify dispatches consumed
+        self.accepted = 0        # draft tokens accepted (emitted - steps)
 
 
 class GenerateSession:
@@ -219,7 +226,35 @@ class GenerateSession:
                                       warmup=config.warmup)
         self._decode = model.decode_jit()
         self._commit = model.commit_jit()
+        # v5 capabilities: chunked prefill (long prompts) and the fused
+        # int8-draft speculative step. config.speculative: None = auto.
+        self.chunked = model.has_chunk_prefill
+        want = config.speculative
+        if want and not model.speculative:
+            raise MXNetError(
+                "GenerateSession: speculative=True but the artifact "
+                "bundles no draft modules; re-export with "
+                "export_generate(..., draft_params=quantize_decoder_"
+                "params(params)) or drop speculative=")
+        self.speculative = (model.speculative if want is None
+                            else bool(want))
+        self.speculate_k = model.speculate_k if self.speculative else 0
+        self._chunk_prefill = (model.chunk_prefill_jit()
+                               if self.chunked else None)
+        if self.speculative:
+            self._draft_verify = model.draft_verify_jit()
+            self._draft_chunk_prefill = model.draft_chunk_prefill_jit()
+        else:
+            self._draft_verify = None
+            self._draft_chunk_prefill = None
         self.cache = PagedKVCache(spec)
+        # the draft cache mirrors the verifier cache's geometry and
+        # SHARES its page accounting (same block tables, same page ids,
+        # allocated once) — only the device tensors are doubled
+        if self.speculative:
+            shape = (spec.num_layers, spec.cache_rows, spec.dim)
+            self._draft_k = jnp.zeros(shape, _np.float32)
+            self._draft_v = jnp.zeros(shape, _np.float32)
         self.metrics_ = DecodeMetrics()
         S = spec.max_slots
         self._slots = [None] * S
@@ -238,6 +273,9 @@ class GenerateSession:
         # telemetry window accumulators (host scalars only)
         self._win_steps = 0
         self._win_tokens = 0
+        self._win_spec_steps = 0
+        self._win_drafted = 0
+        self._win_accepted = 0
         self._win_t0 = time.monotonic()
         try:
             self._device_kind = jax.devices()[0].device_kind
@@ -324,6 +362,24 @@ class GenerateSession:
             jnp.asarray(self._cur[:, None]), jnp.asarray(self._positions),
             jnp.asarray(self._block), jnp.asarray(self._temps),
             jnp.asarray(self._seeds), self.cache.k, self.cache.v)
+        if self._chunk_prefill is not None:
+            chunk_args = (jnp.zeros(spec.max_prompt_len, _np.int32),
+                          jnp.asarray(0, _np.int32),
+                          jnp.asarray(0, _np.int32),
+                          jnp.zeros(spec.max_pages_per_slot, _np.int32),
+                          jnp.asarray(0.0, _np.float32),
+                          jnp.asarray(0, _np.int32))
+            _nxt, self.cache.k, self.cache.v = self._chunk_prefill(
+                *chunk_args, self.cache.k, self.cache.v)
+        if self.speculative:
+            _nxt, self._draft_k, self._draft_v = self._draft_chunk_prefill(
+                *chunk_args, self._draft_k, self._draft_v)
+            (_packed, self.cache.k, self.cache.v, self._draft_k,
+             self._draft_v) = self._draft_verify(
+                jnp.asarray(self._cur[:, None]),
+                jnp.asarray(self._positions), jnp.asarray(self._block),
+                jnp.asarray(self._temps), jnp.asarray(self._seeds),
+                self.cache.k, self.cache.v, self._draft_k, self._draft_v)
         self.cache.k.block_until_ready()
         return self
 
@@ -391,11 +447,18 @@ class GenerateSession:
             max_new_tokens = self.config.max_new_tokens
         max_new_tokens = max(1, int(max_new_tokens))
         prompt = [int(t) for t in prompt]
-        if not 1 <= len(prompt) <= spec.max_prompt_len:
+        # chunked prefill (format_version 5) streams prompts longer than
+        # max_prompt_len through fixed-shape chunks; without it the
+        # prefill pad length is a hard cap
+        cap = (spec.max_context if self.chunked else spec.max_prompt_len)
+        if not 1 <= len(prompt) <= cap:
             raise MXNetError(
                 "generate: prompt length %d outside [1, %d] (the "
-                "artifact's max_prompt_len)" % (len(prompt),
-                                                spec.max_prompt_len))
+                "artifact's %s)"
+                % (len(prompt), cap,
+                   "max_context — even chunked prefill cannot exceed "
+                   "the paged-cache geometry" if self.chunked
+                   else "max_prompt_len"))
         if len(prompt) + max_new_tokens > spec.max_context:
             raise MXNetError(
                 "generate: prompt %d + max_new_tokens %d exceeds "
@@ -506,13 +569,23 @@ class GenerateSession:
         if slot.t_first is not None and len(slot.gen) > 1:
             tpot = (now - slot.t_first) * 1e3 / (len(slot.gen) - 1)
         self.metrics_.note_complete(tpot_ms=tpot)
-        req._complete({
+        out = {
             "tokens": list(slot.gen),
             "finish_reason": reason,
             "ttft_ms": req.ttft_ms,
             "tpot_ms": tpot,
             "latency_ms": (now - req.t_submit) * 1e3,
-        })
+        }
+        if self.speculative and slot.spec_steps:
+            # per-request speculation health, from the same host counts
+            # the window gauges publish (zero extra syncs)
+            out["accepted_tokens_per_step"] = round(
+                (slot.accepted + slot.spec_steps)
+                / float(slot.spec_steps), 4)
+            out["draft_acceptance_rate"] = round(
+                slot.accepted
+                / float(slot.spec_steps * max(1, self.speculate_k)), 4)
+        req._complete(out)
 
     def _evict_expired(self):
         now = time.monotonic()
@@ -558,8 +631,14 @@ class GenerateSession:
                         "serve: deadline passed %.1fms before prefill"
                         % ((now - req.deadline) * 1e3)))
                     continue
+                # the speculative window writes up to speculate_k rows
+                # past the final emitted position — reserve pages for
+                # them so a full cache cannot make the fused step spill
+                # into another sequence's pages (capped at max_context:
+                # past-the-end writes route to scratch in-program)
                 need = self.cache.pages_needed(
-                    len(req.prompt) + req.max_new_tokens)
+                    min(len(req.prompt) + req.max_new_tokens
+                        + self.speculate_k, self.spec.max_context))
                 if need > self.cache.free_pages:
                     break
                 self._pending.popleft()
@@ -572,61 +651,130 @@ class GenerateSession:
         group = self._take_admissible()
         if not group:
             return 0
-        g = len(group)
         P = spec.max_prompt_len
-        # host-side pad to the FIXED slot count: every prefill dispatch
-        # has identical shapes (no per-group-size device concatenate /
-        # slice programs), rows past g are inert scratch work
-        S = spec.max_slots
-        tokens = _np.zeros((S, P), _np.int32)
-        lengths = _np.zeros(S, _np.int32)
-        temps = _np.zeros(S, _np.float32)
-        seeds = _np.zeros(S, _np.int32)
-        for j, (_, req, _pages) in enumerate(group):
-            lengths[j] = len(req.prompt)
-            tokens[j, :len(req.prompt)] = req.prompt
-            temps[j] = req.temperature
-            seeds[j] = req.seed
-        # through the bucketed engine_cache (single bucket = max_slots);
-        # outputs stay on device
-        first, k_rows, v_rows = self.model.prefill(tokens, lengths, temps,
-                                                   seeds)
-        # the ONE d2h for this prefill group: the first sampled tokens
-        first_host = _np.asarray(jax.device_get(first))
-        profiler.record_host_sync("d2h", first_host.nbytes)
-        self.metrics_.note_prefill(g)
-        t_now = time.monotonic()
-        for j, (i, req, pages) in enumerate(group):
-            plen = len(req.prompt)
-            page_ids = _np.zeros(spec.prompt_pages, _np.int32)
-            n_prompt_pages = self.cache.pages_needed(plen)
-            page_ids[:n_prompt_pages] = pages[:n_prompt_pages]
-            self.cache.k, self.cache.v = self._commit(
-                self.cache.k, self.cache.v, k_rows[j], v_rows[j],
-                jnp.asarray(page_ids), jnp.asarray(plen, _np.int32))
-            tok = int(first_host[j])
-            req.ttft_ms = (t_now - req.t_submit) * 1e3
-            self.metrics_.note_ttft(req.ttft_ms)
-            slot = _Slot(req, pages)
-            slot.gen.append(tok)
-            slot.t_first = t_now
-            self._slots[i] = slot
-            self._win_tokens += 1
-            if self._draining:
-                slot.drain_cap = len(slot.gen) + self._drain_budget
-            if spec.eos_id >= 0 and tok == spec.eos_id:
-                self._finish(i, "stop")
-            elif req.max_new_tokens <= 1:
-                self._finish(i, "length")
-            else:
-                row = _np.zeros(spec.max_pages_per_slot, _np.int32)
-                row[:len(pages)] = pages
-                self._block[i, :] = row
-                self._positions[i] = plen   # where `tok` will be written
-                self._temps[i] = req.temperature
-                self._seeds[i] = req.seed
-                self._cur[i] = tok
-        return g
+        short = [e for e in group if len(e[1].prompt) <= P]
+        # prompts past the prefill pad stream through chunk_prefill
+        # (submit() only lets them in on a chunk-capable artifact)
+        long = [e for e in group if len(e[1].prompt) > P]
+        if short:
+            g = len(short)
+            # host-side pad to the FIXED slot count: every prefill
+            # dispatch has identical shapes (no per-group-size device
+            # concatenate / slice programs), rows past g are inert
+            # scratch work
+            S = spec.max_slots
+            tokens = _np.zeros((S, P), _np.int32)
+            lengths = _np.zeros(S, _np.int32)
+            temps = _np.zeros(S, _np.float32)
+            seeds = _np.zeros(S, _np.int32)
+            for j, (_, req, _pages) in enumerate(short):
+                lengths[j] = len(req.prompt)
+                tokens[j, :len(req.prompt)] = req.prompt
+                temps[j] = req.temperature
+                seeds[j] = req.seed
+            # through the bucketed engine_cache (single bucket =
+            # max_slots); outputs stay on device
+            first, k_rows, v_rows = self.model.prefill(tokens, lengths,
+                                                       temps, seeds)
+            # the ONE d2h for this prefill group: the first sampled tokens
+            first_host = _np.asarray(jax.device_get(first))
+            profiler.record_host_sync("d2h", first_host.nbytes)
+            self.metrics_.note_prefill(g)
+            t_now = time.monotonic()
+            for j, (i, req, pages) in enumerate(short):
+                plen = len(req.prompt)
+                page_ids = _np.zeros(spec.prompt_pages, _np.int32)
+                n_prompt_pages = self.cache.pages_needed(plen)
+                page_ids[:n_prompt_pages] = pages[:n_prompt_pages]
+                self.cache.k, self.cache.v = self._commit(
+                    self.cache.k, self.cache.v, k_rows[j], v_rows[j],
+                    jnp.asarray(page_ids), jnp.asarray(plen, _np.int32))
+                self._activate(i, req, pages, int(first_host[j]), t_now,
+                               need_draft=True)
+        for (i, req, pages) in long:
+            self._admit_chunked(i, req, pages)
+        return len(group)
+
+    def _admit_chunked(self, i, req, pages):
+        """Stream one long prompt through fixed-shape ``chunk_prefill``
+        dispatches straight into the paged cache (the draft cache rides
+        the same loop when speculating). ONE d2h for the whole prompt:
+        the FINAL chunk's sampled token — earlier chunks' samples stay
+        on device, unread."""
+        spec = self.spec
+        P = spec.max_prompt_len
+        plen = len(req.prompt)
+        row = _np.zeros(spec.max_pages_per_slot, _np.int32)
+        row[:len(pages)] = pages
+        bt = jnp.asarray(row)
+        nxt = None
+        for start in range(0, plen, P):
+            chunk = req.prompt[start:start + P]
+            toks = _np.zeros(P, _np.int32)
+            toks[:len(chunk)] = chunk
+            args = (jnp.asarray(toks), jnp.asarray(start, _np.int32),
+                    jnp.asarray(len(chunk), _np.int32), bt,
+                    jnp.asarray(req.temperature, _np.float32),
+                    jnp.asarray(req.seed, _np.int32))
+            nxt, self.cache.k, self.cache.v = self._chunk_prefill(
+                *args, self.cache.k, self.cache.v)
+            if self.speculative:
+                _d, self._draft_k, self._draft_v = \
+                    self._draft_chunk_prefill(*args, self._draft_k,
+                                              self._draft_v)
+        tok = int(jax.device_get(nxt))
+        profiler.record_host_sync("d2h", 4)
+        self.metrics_.note_prefill(1)
+        self._activate(i, req, pages, tok, time.monotonic(),
+                       need_draft=False)
+
+    def _activate(self, i, req, pages, tok, t_now, need_draft):
+        """Post-prefill slot activation shared by the batched and
+        chunked paths: record TTFT, seat the slot, then either finish
+        immediately or arm the decode-step host state (and, on a
+        speculative engine, populate the draft cache — the chunked path
+        already did that inside its own loop)."""
+        spec = self.spec
+        req.ttft_ms = (t_now - req.t_submit) * 1e3
+        self.metrics_.note_ttft(req.ttft_ms)
+        slot = _Slot(req, pages)
+        slot.gen.append(tok)
+        slot.t_first = t_now
+        self._slots[i] = slot
+        self._win_tokens += 1
+        if self._draining:
+            slot.drain_cap = len(slot.gen) + self._drain_budget
+        if spec.eos_id >= 0 and tok == spec.eos_id:
+            self._finish(i, "stop")
+        elif req.max_new_tokens <= 1:
+            self._finish(i, "length")
+        else:
+            row = _np.zeros(spec.max_pages_per_slot, _np.int32)
+            row[:len(pages)] = pages
+            self._block[i, :] = row
+            self._positions[i] = len(req.prompt)  # where `tok` lands
+            self._temps[i] = req.temperature
+            self._seeds[i] = req.seed
+            self._cur[i] = tok
+            if self.speculative and need_draft:
+                self._draft_prefill_chunks(req, row)
+
+    def _draft_prefill_chunks(self, req, block_row):
+        """Populate the DRAFT cache with the prompt's int8 K/V rows via
+        draft_chunk_prefill (no d2h — the sampled tokens are dropped on
+        device; the verifier's prefill decides the first token)."""
+        P = self.spec.max_prompt_len
+        bt = jnp.asarray(block_row)
+        for start in range(0, len(req.prompt), P):
+            chunk = req.prompt[start:start + P]
+            toks = _np.zeros(P, _np.int32)
+            toks[:len(chunk)] = chunk
+            _nxt, self._draft_k, self._draft_v = self._draft_chunk_prefill(
+                jnp.asarray(toks), jnp.asarray(start, _np.int32),
+                jnp.asarray(len(chunk), _np.int32), bt,
+                jnp.asarray(req.temperature, _np.float32),
+                jnp.asarray(req.seed, _np.int32),
+                self._draft_k, self._draft_v)
 
     def _step(self):
         active = [i for i, s in enumerate(self._slots) if s is not None]
@@ -635,9 +783,12 @@ class GenerateSession:
         # deterministic kill point for cursor-migration drills: fires
         # once per LIVE decode step (warmup calls _decode directly and
         # bypasses it), so "kill@serve=decode_step:skip=N" dies exactly
-        # N+1 sampled tokens into a session — mid-generation, KV pages
-        # and all
+        # N+1 dispatches into a session — mid-generation, KV pages and
+        # all (speculative engines keep the same op name: a drill tuned
+        # against a plain server still lands mid-window here)
         faultinject.fire("serve", op="decode_step", active=len(active))
+        if self.speculative:
+            return self._step_speculative(active)
         nxt, self.cache.k, self.cache.v = self._decode(
             jnp.asarray(self._cur[:, None]), jnp.asarray(self._positions),
             jnp.asarray(self._block), jnp.asarray(self._temps),
@@ -662,6 +813,55 @@ class GenerateSession:
             self._publish_window()
         return 1
 
+    def _step_speculative(self, active):
+        """One fused draft+verify dispatch for every live slot. The ONE
+        d2h is the packed ``(S, k+2)`` i32 array ``[n_accept, v_1..
+        v_{k+1}]``; everything after it is host accounting. Every
+        emitted token is the verifier's position-keyed sample, so the
+        stream is bitwise what plain decode would have produced — the
+        draft only sets the pace."""
+        (packed, self.cache.k, self.cache.v, self._draft_k,
+         self._draft_v) = self._draft_verify(
+            jnp.asarray(self._cur[:, None]), jnp.asarray(self._positions),
+            jnp.asarray(self._block), jnp.asarray(self._temps),
+            jnp.asarray(self._seeds),
+            self.cache.k, self.cache.v, self._draft_k, self._draft_v)
+        host = _np.asarray(jax.device_get(packed))
+        profiler.record_host_sync("d2h", host.nbytes)
+        spec = self.spec
+        for i in active:
+            slot = self._slots[i]
+            row = host[i]
+            n_accept = int(row[0])
+            cand = [int(t) for t in row[1:2 + n_accept]]
+            budget = slot.req.max_new_tokens - len(slot.gen)
+            emitted = []
+            stop = None
+            for t in cand:
+                emitted.append(t)
+                if spec.eos_id >= 0 and t == spec.eos_id:
+                    stop = "stop"
+                    break
+                if len(emitted) >= budget:
+                    break
+            slot.gen.extend(emitted)
+            self._positions[i] += len(emitted)
+            self._cur[i] = emitted[-1]
+            self._win_tokens += len(emitted)
+            slot.spec_steps += 1
+            slot.accepted += len(emitted) - 1
+            self._win_spec_steps += 1
+            self._win_drafted += self.speculate_k
+            self._win_accepted += len(emitted) - 1
+            if stop is not None:
+                self._finish(i, stop)
+            elif len(slot.gen) >= slot.req.max_new_tokens:
+                self._finish(i, "length")
+        self._win_steps += 1
+        if self._win_steps >= max(1, self.config.window_steps):
+            self._publish_window()
+        return 1
+
     def _publish_window(self, force=False):
         if not force and self._win_steps == 0:
             return
@@ -671,9 +871,15 @@ class GenerateSession:
             window_s=max(now - self._win_t0, 1e-9),
             tokens=self._win_tokens,
             active_slots=sum(1 for s in self._slots if s is not None),
-            page_occupancy=self.cache.occupancy())
+            page_occupancy=self.cache.occupancy(),
+            spec_steps=self._win_spec_steps,
+            drafted=self._win_drafted,
+            accepted=self._win_accepted)
         self._win_steps = 0
         self._win_tokens = 0
+        self._win_spec_steps = 0
+        self._win_drafted = 0
+        self._win_accepted = 0
         self._win_t0 = now
 
     # -- chip-free discipline gate (MXL508) --------------------------------
@@ -703,6 +909,42 @@ class GenerateSession:
             self.decode_lowered_text(), "decode_step",
             cache_params=self._CACHE_ARGNUMS, d2h_budget=d2h_budget)
 
+    # -- chip-free discipline gate (MXL510) --------------------------------
+    _DRAFT_CACHE_ARGNUMS = (5, 6, 7, 8)
+
+    def draft_verify_lowered_text(self):
+        """StableHLO text of the fused draft+verify step exactly as this
+        session compiles it (same jit, all four cache buffers donated)
+        — chip-free under JAX_PLATFORMS=cpu."""
+        if not self.speculative:
+            raise MXNetError("draft_verify_lowered_text: this session "
+                             "is not speculative (no draft modules)")
+        spec = self.spec
+        S, MP = spec.max_slots, spec.max_pages_per_slot
+        pages = jax.ShapeDtypeStruct(
+            (spec.num_layers, spec.cache_rows, spec.dim), _np.float32)
+        args = (jax.ShapeDtypeStruct((S, 1), _np.int32),
+                jax.ShapeDtypeStruct((S,), _np.int32),
+                jax.ShapeDtypeStruct((S, MP), _np.int32),
+                jax.ShapeDtypeStruct((S,), _np.float32),
+                jax.ShapeDtypeStruct((S,), _np.int32),
+                pages, pages, pages, pages)
+        return self._draft_verify.lower(*args).as_text()
+
+    def check_speculative_discipline(self, d2h_budget=0):
+        """Run the MXL510 pass over the fused speculative step's
+        lowering: draft AND verifier cache buffers donated, at most
+        ``d2h_budget`` host-transfer ops in the whole fused program
+        (draft not fused with its verifier shows up as extra d2h).
+        Returns [] on a non-speculative session — nothing to gate."""
+        if not self.speculative:
+            return []
+        from ..analysis import hlo_passes
+        return hlo_passes.speculative_dispatch_pass(
+            self.draft_verify_lowered_text(), "draft_verify",
+            cache_params=self._DRAFT_CACHE_ARGNUMS,
+            d2h_budget=d2h_budget)
+
     # -- observability -----------------------------------------------------
     def metrics(self):
         snap = self.metrics_.snapshot()
@@ -719,6 +961,8 @@ class GenerateSession:
             "page_size": self.spec.page_size,
         }
         snap["estimated_step_s"] = self.estimate_step_s()
+        if self.speculative:
+            snap["speculative"]["k"] = self.speculate_k
         snap["engines"] = (self.model.prefill.engine_cache.stats()
                            if self.model.prefill.engine_cache else None)
         snap["status"] = ("closed" if self.closed

@@ -43,6 +43,8 @@ from ..base import MXNetError
 
 __all__ = ["DecoderSpec", "init_params", "params_from_gluon",
            "make_prefill", "make_decode", "make_commit",
+           "make_chunk_prefill", "make_draft_verify",
+           "quantize_decoder_params", "suggest_speculation_depth",
            "reference_generate"]
 
 _LN_EPS = 1e-5   # gluon nn.LayerNorm default
@@ -218,6 +220,71 @@ def _mlp(h, p, i):
     return h + _dense(x, p["l%d_mlp2_w" % i], p["l%d_mlp2_b" % i])
 
 
+# Dense weights eligible for int8 draft quantization. Embeddings, LayerNorms
+# and biases stay f32: they are a rounding-error fraction of the bytes and
+# the per-row math (LN, sampling keys) must stay bit-identical to the f32
+# reference so the acceptance rule compares like with like.
+_QUANT_SUFFIXES = ("qkv_w", "proj_w", "mlp1_w", "mlp2_w")
+
+
+def quantize_decoder_params(params, eps=1e-8):
+    """Per-output-channel symmetric int8 quantization of the decoder's
+    dense weights (gluon layout: W is (out, in), quantized along rows).
+
+    Returns a new param dict where every eligible ``<name>`` is replaced
+    by ``<name>_q`` (int8, same shape) + ``<name>_deq`` (f32 (out,),
+    the per-channel dequant scale 1/wsc); everything else passes through
+    f32. The quantized dict drives the int8 DRAFT model of
+    :func:`make_draft_verify` — same architecture, ~4x fewer weight
+    bytes, so a draft step is ~4x cheaper on the memory-bound decode
+    roofline (see :func:`suggest_speculation_depth`)."""
+    out = {}
+    for name, w in params.items():
+        if name.endswith(_QUANT_SUFFIXES) or name == "head_w":
+            w = _np.asarray(w, _np.float32)
+            amax = _np.maximum(_np.abs(w).max(axis=1), eps)
+            wsc = (127.0 / amax).astype(_np.float32)       # (out,)
+            wq = _np.clip(_np.round(w * wsc[:, None]), -127, 127)
+            out[name + "_q"] = wq.astype(_np.int8)
+            out[name + "_deq"] = (1.0 / wsc).astype(_np.float32)
+        else:
+            out[name] = _np.asarray(w)
+    return out
+
+
+def _dense_int8(x, wq, deq, b):
+    """int8 dense with PER-ROW dynamic activation quantization.
+
+    Each activation row is scaled independently (row max -> 127), the
+    dot accumulates in int32 (``preferred_element_type`` — the MXU
+    int8 path, same lowering as ops/quant_serve.py), and the epilogue
+    folds both scales back in f32. Row-wise independence preserves the
+    bitwise-parity contract: a slot's math never depends on batchmates.
+    """
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    ascale = 127.0 / jnp.maximum(amax, 1e-8)
+    xq = jnp.clip(jnp.round(x * ascale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, wq,
+                              (((x.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (deq / ascale) + b
+
+
+def _dense_p(p, x, w, b):
+    """Dense through whichever precision the param dict carries:
+    ``<w>_q``/``<w>_deq`` (a :func:`quantize_decoder_params` dict) takes
+    the int8 path, plain ``<w>`` the f32 one."""
+    if (w + "_q") in p:
+        return _dense_int8(x, p[w + "_q"], p[w + "_deq"], p[b])
+    return _dense(x, p[w], p[b])
+
+
+def _mlp_p(h, p, i):
+    x = _ln(h, p["l%d_ln2_g" % i], p["l%d_ln2_b" % i])
+    x = jax.nn.relu(_dense_p(p, x, "l%d_mlp1_w" % i, "l%d_mlp1_b" % i))
+    return h + _dense_p(p, x, "l%d_mlp2_w" % i, "l%d_mlp2_b" % i)
+
+
 def _sample(logits, temps, seeds, counters):
     """Per-row on-device sampling. The key is a pure function of the
     request's seed and the POSITION the sampled token will occupy, so a
@@ -382,6 +449,259 @@ def make_commit(spec):
         return k_pages, v_pages
 
     return commit
+
+
+# -- chunked prefill (long prompts through the paged cache) -----------------
+
+def make_chunk_prefill(params, spec):
+    """One fixed-shape prompt CHUNK for a single sequence: write the
+    chunk's K/V rows straight into the sequence's pages, attend over
+    everything committed so far (earlier chunks included, via the block
+    table), and sample the token that follows the prompt.
+
+    (tokens[P] i32, start () i32, n () i32, block_table[MP] i32,
+     temp () f32, seed () i32, k_pages[L,R,C] f32, v_pages[L,R,C] f32)
+    -> (next_token () i32, k_pages, v_pages)
+
+    The chunk covers positions ``start .. start+P-1``; rows at chunk
+    offsets >= ``n`` (padding) and any position >= ``max_context`` are
+    routed to scratch page 0. ``next_token`` is sampled at position
+    ``start + n`` from the query row ``n - 1`` — only the FINAL chunk's
+    token is meaningful (earlier chunks' samples are garbage the host
+    never fetches; the d2h budget stays one fetch per prompt however
+    many chunks stream through). The caller donates the page buffers
+    (argnums 6, 7). Works over an f32 params dict or a
+    :func:`quantize_decoder_params` dict — the draft cache of a
+    speculative session is populated by the int8 variant of this same
+    program so draft prefill KV matches draft decode KV.
+    """
+    spec.validate()
+    P, MP, page = spec.max_prompt_len, spec.max_pages_per_slot, spec.page_size
+    C, H, Dh, L, V = (spec.dim, spec.num_heads, spec.head_dim,
+                      spec.num_layers, spec.vocab)
+    ctx = spec.max_context
+    scale = 1.0 / math.sqrt(Dh)
+    p = {k: jnp.asarray(v) for k, v in params.items()}
+
+    def chunk_prefill(tokens, start, n, block_table, temp, seed,
+                      k_pages, v_pages):
+        tok = jnp.clip(tokens.astype(jnp.int32), 0, V - 1)
+        start = start.astype(jnp.int32)
+        n = n.astype(jnp.int32)
+        bt = block_table.astype(jnp.int32)
+        pos = start + jnp.arange(P)                              # (P,)
+        h = (jnp.take(p["tok_w"], tok, axis=0)
+             + jnp.take(p["pos_w"], jnp.clip(pos, 0, ctx - 1), axis=0))
+        widx = (jnp.take(bt, jnp.clip(pos // page, 0, MP - 1)) * page
+                + pos % page)
+        widx = jnp.where((jnp.arange(P) < n) & (pos < ctx), widx, 0)
+        ctx_idx = (bt[:, None] * page
+                   + jnp.arange(page)[None, :]).reshape(ctx)
+        att = jnp.arange(ctx)[None, :] <= pos[:, None]           # (P, ctx)
+        for i in range(L):
+            x = _ln(h, p["l%d_ln1_g" % i], p["l%d_ln1_b" % i])
+            qkv = _dense_p(p, x, "l%d_qkv_w" % i, "l%d_qkv_b" % i)
+            q, k, v = jnp.split(qkv, 3, axis=-1)                 # (P, C)
+            k_pages = k_pages.at[i, widx].set(k)
+            v_pages = v_pages.at[i, widx].set(v)
+            kh = jnp.take(k_pages[i], ctx_idx, axis=0).reshape(ctx, H, Dh)
+            vh = jnp.take(v_pages[i], ctx_idx, axis=0).reshape(ctx, H, Dh)
+            qh = q.reshape(P, H, Dh)
+            s = jnp.einsum("qhd,thd->hqt", qh, kh) * scale
+            s = jnp.where(att[None], s, _NEG_INF)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("hqt,thd->qhd", w, vh).reshape(P, C)
+            h = h + _dense_p(p, o, "l%d_proj_w" % i, "l%d_proj_b" % i)
+            h = _mlp_p(h, p, i)
+        hf = _ln(h, p["lnf_g"], p["lnf_b"])
+        last = jnp.take(hf, jnp.clip(n - 1, 0, P - 1), axis=0)
+        logits = _dense_p(p, last[None], "head_w", "head_b")
+        nxt = _sample(logits, jnp.reshape(temp, (1,)),
+                      jnp.reshape(seed, (1,)),
+                      jnp.reshape(start + n, (1,)))[0]
+        return nxt, k_pages, v_pages
+
+    return chunk_prefill
+
+
+# -- speculative decode (int8 draft + f32 verify, one dispatch) -------------
+
+def make_draft_verify(params, draft_params, spec, k):
+    """One fused SPECULATIVE step for every slot: ``k`` sequential int8
+    draft token-steps over the draft KV cache, ONE f32 verifier pass
+    over the (k+1)-token window, and the acceptance rule — a single
+    dispatch whose only host fetch is one packed i32 array.
+
+    (tokens[S,1] i32, positions[S] i32, block_tables[S,MP] i32,
+     temps[S] f32, seeds[S] i32,
+     k_pages[L,R,C] f32, v_pages[L,R,C] f32,          # verifier cache
+     dk_pages[L,R,C] f32, dv_pages[L,R,C] f32)        # draft cache
+    -> (packed[S, k+2] i32, k_pages, v_pages, dk_pages, dv_pages)
+
+    ``packed[s] = [n_accept, v_1, ..., v_{k+1}]`` where ``v_j`` is the
+    VERIFIER's position-keyed sample for position ``pos+j`` and
+    ``n_accept`` counts the draft proposals that match it from the
+    left. The emitted tokens are ``v_1 .. v_{n_accept+1}`` (the last
+    one is the standard bonus/correction token).
+
+    Acceptance is DETERMINISTIC COUPLING of the rejection rule: the
+    sampling key is a pure function of (seed, position) — fold_in twice,
+    exactly :func:`_sample` — so the verifier's sample at a position IS
+    the token target-only decode would emit there, at any temperature
+    (greedy included: temp<=0 degrades to argmax agreement, the textbook
+    rule). Every emitted token therefore equals the target-only token
+    for its position bitwise, and the sampled stream matches the target
+    distribution exactly; the draft only decides HOW MANY positions one
+    dispatch advances.
+
+    Cache discipline: the draft writes rows pos..pos+k-1 (draft cache),
+    the verifier rows pos..pos+k (its own cache). No rollback pass
+    exists — rejected speculative rows are dead weight that the NEXT
+    step's window (starting at pos + n_accept + 1 <= pos + k + 1)
+    provably overwrites before any query can attend to them, and the
+    position mask (-1e30 before softmax) zeroes whatever scratch a
+    query could see beyond its own position. Writes that would land
+    past ``max_context`` go to scratch page 0. The caller donates ALL
+    FOUR page buffers (argnums 5-8) — MXL508 gates the verifier pair,
+    MXL510 the draft pair.
+    """
+    spec.validate()
+    if not 1 <= k <= spec.max_prompt_len:
+        raise MXNetError("make_draft_verify: speculation depth %d outside "
+                         "[1, max_prompt_len=%d]" % (k, spec.max_prompt_len))
+    S, MP, page = spec.max_slots, spec.max_pages_per_slot, spec.page_size
+    C, H, Dh, L, V = (spec.dim, spec.num_heads, spec.head_dim,
+                      spec.num_layers, spec.vocab)
+    ctx = spec.max_context
+    W = k + 1
+    scale = 1.0 / math.sqrt(Dh)
+    p = {n: jnp.asarray(v) for n, v in params.items()}
+    dp = {n: jnp.asarray(v) for n, v in draft_params.items()}
+
+    def draft_step(cur, dpos, bt, ctx_idx, temps, seeds, dk_pages, dv_pages):
+        """One int8 single-token step over the draft cache; returns the
+        proposal sampled at position dpos+1."""
+        h = (jnp.take(dp["tok_w"], jnp.clip(cur, 0, V - 1), axis=0)
+             + jnp.take(dp["pos_w"], jnp.clip(dpos, 0, ctx - 1), axis=0))
+        widx = (bt[jnp.arange(S), jnp.clip(dpos // page, 0, MP - 1)] * page
+                + dpos % page)
+        widx = jnp.where(dpos < ctx, widx, 0)
+        att = jnp.arange(ctx)[None, :] <= dpos[:, None]
+        for i in range(L):
+            x = _ln(h, dp["l%d_ln1_g" % i], dp["l%d_ln1_b" % i])
+            qkv = _dense_p(dp, x, "l%d_qkv_w" % i, "l%d_qkv_b" % i)
+            q, kk, vv = jnp.split(qkv, 3, axis=-1)
+            dk_pages = dk_pages.at[i, widx].set(kk)
+            dv_pages = dv_pages.at[i, widx].set(vv)
+            kh = _gather_rows(dk_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
+            vh = _gather_rows(dv_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
+            qh = q.reshape(S, H, Dh)
+            s = jnp.einsum("shd,sthd->sht", qh, kh) * scale
+            s = jnp.where(att[:, None, :], s, _NEG_INF)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("sht,sthd->shd", w, vh).reshape(S, C)
+            h = h + _dense_p(dp, o, "l%d_proj_w" % i, "l%d_proj_b" % i)
+            h = _mlp_p(h, dp, i)
+        logits = _dense_p(dp, _ln(h, dp["lnf_g"], dp["lnf_b"]),
+                          "head_w", "head_b")
+        prop = _sample(logits, temps, seeds, dpos + 1)
+        return prop, dk_pages, dv_pages
+
+    def draft_verify(tokens, positions, block_tables, temps, seeds,
+                     k_pages, v_pages, dk_pages, dv_pages):
+        cur = tokens[:, 0].astype(jnp.int32)
+        positions = positions.astype(jnp.int32)
+        bt = block_tables.astype(jnp.int32)
+        seeds = seeds.astype(jnp.int32)
+        ctx_idx = (bt[:, :, None] * page
+                   + jnp.arange(page)[None, None, :]).reshape(S, ctx)
+
+        # -- k int8 draft steps (sequential by construction) ------------
+        props = []
+        tok = cur
+        for j in range(k):
+            prop, dk_pages, dv_pages = draft_step(
+                tok, positions + j, bt, ctx_idx, temps, seeds,
+                dk_pages, dv_pages)
+            props.append(prop)
+            tok = prop
+        props = jnp.stack(props, axis=1)                         # (S, k)
+
+        # -- one f32 verifier pass over the (k+1)-token window ----------
+        win = jnp.concatenate([cur[:, None], props], axis=1)     # (S, W)
+        wpos = positions[:, None] + jnp.arange(W)[None, :]       # (S, W)
+        h = (jnp.take(p["tok_w"], jnp.clip(win, 0, V - 1), axis=0)
+             + jnp.take(p["pos_w"], jnp.clip(wpos, 0, ctx - 1), axis=0))
+        widx = (jnp.take_along_axis(bt, jnp.clip(wpos // page, 0, MP - 1),
+                                    axis=1) * page + wpos % page)
+        widx = jnp.where(wpos < ctx, widx, 0)                    # (S, W)
+        att = jnp.arange(ctx)[None, None, :] <= wpos[:, :, None]  # (S,W,ctx)
+        for i in range(L):
+            x = _ln(h, p["l%d_ln1_g" % i], p["l%d_ln1_b" % i])
+            qkv = _dense(x, p["l%d_qkv_w" % i], p["l%d_qkv_b" % i])
+            q, kk, vv = jnp.split(qkv, 3, axis=-1)               # (S, W, C)
+            k_pages = k_pages.at[i, widx].set(kk)
+            v_pages = v_pages.at[i, widx].set(vv)
+            kh = _gather_rows(k_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
+            vh = _gather_rows(v_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
+            qh = q.reshape(S, W, H, Dh)
+            s = jnp.einsum("swhd,sthd->shwt", qh, kh) * scale
+            s = jnp.where(att[:, None], s, _NEG_INF)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("shwt,sthd->swhd", w, vh).reshape(S, W, C)
+            h = h + _dense(o, p["l%d_proj_w" % i], p["l%d_proj_b" % i])
+            h = _mlp(h, p, i)
+        logits = _dense(_ln(h, p["lnf_g"], p["lnf_b"]),
+                        p["head_w"], p["head_b"])                # (S, W, V)
+        vs = _sample(logits.reshape(S * W, V),
+                     jnp.repeat(temps, W), jnp.repeat(seeds, W),
+                     (wpos + 1).reshape(S * W)).reshape(S, W)
+
+        # -- acceptance: leading proposals that equal the verifier ------
+        match = (props == vs[:, :k]).astype(jnp.int32)           # (S, k)
+        n_accept = jnp.cumprod(match, axis=1).sum(axis=1)        # (S,)
+        packed = jnp.concatenate([n_accept[:, None], vs],
+                                 axis=1).astype(jnp.int32)       # (S, k+2)
+        return packed, k_pages, v_pages, dk_pages, dv_pages
+
+    return draft_verify
+
+
+def suggest_speculation_depth(spec, device_kind=None, max_k=8,
+                              acceptance=0.8):
+    """Roofline-derived speculation depth (no hard-coded k).
+
+    Models one decode step of each engine on the target chip via
+    :func:`mxnet_tpu.perfmodel.roofline_seconds` — decode is weight-
+    bandwidth bound, so the int8 draft moves ~1/4 the bytes and the
+    (k+1)-wide verifier amortizes one weight read over k+1 tokens —
+    then picks the k maximizing expected emitted tokens per second
+    under a geometric acceptance model E[k] = (1-a^(k+1))/(1-a)
+    (the learned-TPU-cost-model idea of PAPERS.md arxiv 2008.01040,
+    computed analytically from the artifact geometry instead of a
+    measurement)."""
+    spec.validate()
+    from .. import perfmodel
+    kind = device_kind or perfmodel.DEFAULT_DEVICE_KIND
+    L, C, V = spec.num_layers, spec.dim, spec.vocab
+    S, ctx = spec.max_slots, spec.max_context
+    n_par = float(12 * L * C * C + 2 * V * C + ctx * C)
+    kv_bytes = 2.0 * L * ctx * C * 4 * S     # worst-case pages gathered
+    a = min(max(acceptance, 1e-3), 0.999)
+    t_draft = perfmodel.roofline_seconds(2.0 * n_par * S,
+                                         n_par + kv_bytes, kind)
+
+    def t_verify(width):
+        return perfmodel.roofline_seconds(2.0 * n_par * S * width,
+                                          4.0 * n_par + kv_bytes, kind)
+
+    best_k, best_rate = 1, 0.0
+    for kk in range(1, max(1, int(max_k)) + 1):
+        expected = (1.0 - a ** (kk + 1)) / (1.0 - a)
+        rate = expected / (kk * t_draft + t_verify(kk + 1))
+        if rate > best_rate:
+            best_k, best_rate = kk, rate
+    return best_k
 
 
 # -- dense reference (tests) ------------------------------------------------

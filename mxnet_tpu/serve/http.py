@@ -74,7 +74,11 @@ def _server_info(srv):
             "max_context": spec.max_context,
             "max_slots": spec.max_slots,
             "page_size": spec.page_size,
+            "chunked_prefill": srv.session.chunked,
+            "speculative": srv.session.speculative,
         }
+        if srv.session.speculative:
+            info["generate"]["speculate_k"] = srv.session.speculate_k
     else:
         info["inputs"] = srv.model.meta["inputs"]
         info["buckets"] = list(srv.buckets)

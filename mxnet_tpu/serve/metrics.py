@@ -278,6 +278,11 @@ class DecodeMetrics:
         self.prefill_rows = 0
         self.decode_steps = 0
         self.tokens = 0
+        # speculative decoding (all host-held: the scheduler unpacks the
+        # fused step's single d2h and counts here — zero extra syncs)
+        self.spec_steps = 0
+        self.drafted = 0
+        self.accepted = 0
         self.ttft_ms = deque(maxlen=_SAMPLE_CAP)
         self.tpot_ms = deque(maxlen=_SAMPLE_CAP)
         self._t_start = time.monotonic()
@@ -300,6 +305,13 @@ class DecodeMetrics:
             "decode/ttft_ms", "time to first token (admission+prefill)")
         self._tm_tpot = _telemetry.histogram(
             "decode/tpot_ms", "per-output-token latency after the first")
+        self._tm_accepted_per_step = _telemetry.gauge(
+            "decode/accepted_tokens_per_step", "tokens emitted per fused "
+            "draft+verify dispatch over the last window (1.0 = the "
+            "verifier rejected every draft, i.e. plain-decode pace)")
+        self._tm_acceptance = _telemetry.gauge(
+            "decode/draft_acceptance_rate", "fraction of drafted tokens "
+            "the verifier accepted over the last window")
 
     # -- host-side event hooks (no device arrays anywhere below) ----------
     def note_submit(self, n=1):
@@ -336,17 +348,34 @@ class DecodeMetrics:
         self._tm_evictions.inc()
 
     def publish_window(self, *, steps, window_s, tokens, active_slots,
-                       page_occupancy):
-        """One decode window's registry publish, from host-held values."""
+                       page_occupancy, spec_steps=0, drafted=0,
+                       accepted=0):
+        """One decode window's registry publish, from host-held values.
+
+        ``spec_steps``/``drafted``/``accepted`` describe the window's
+        fused speculative dispatches: how many ran, how many draft
+        tokens they proposed (spec_steps * k) and how many the verifier
+        accepted. They are 0 on a non-speculative engine and the gauges
+        are then left untouched."""
         with self._lock:
             self.decode_steps += steps
             self.tokens += tokens
+            self.spec_steps += spec_steps
+            self.drafted += drafted
+            self.accepted += accepted
         self._tm_steps.inc(steps)
         self._tm_tokens.inc(tokens)
         if window_s > 0:
             self._tm_tokens_per_s.set(tokens / window_s)
         self._tm_active.set(active_slots)
         self._tm_occupancy.set(page_occupancy)
+        if spec_steps > 0:
+            # each fused dispatch emits its accepted prefix + the
+            # verifier's correction/bonus token
+            self._tm_accepted_per_step.set(
+                (accepted + spec_steps) / float(spec_steps))
+            if drafted > 0:
+                self._tm_acceptance.set(accepted / float(drafted))
 
     def snapshot(self):
         with self._lock:
@@ -379,5 +408,17 @@ class DecodeMetrics:
                     "p50": percentile(tpot, 50),
                     "p95": percentile(tpot, 95),
                     "p99": percentile(tpot, 99),
+                },
+                "speculative": {
+                    "steps": self.spec_steps,
+                    "drafted": self.drafted,
+                    "accepted": self.accepted,
+                    "accepted_tokens_per_step": round(
+                        (self.accepted + self.spec_steps)
+                        / float(self.spec_steps), 4)
+                        if self.spec_steps else None,
+                    "draft_acceptance_rate": round(
+                        self.accepted / float(self.drafted), 4)
+                        if self.drafted else None,
                 },
             }

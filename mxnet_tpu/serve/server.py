@@ -79,7 +79,7 @@ class Server:
     """
 
     def __init__(self, model, config=None, auto_start=True, quantized=None,
-                 **overrides):
+                 draft=None, **overrides):
         if not isinstance(model, (CompiledModel, GenerateModel)):
             model = load_artifact(model)
         if isinstance(model, GenerateModel):
@@ -99,6 +99,15 @@ class Server:
                 raise MXNetError(
                     "Server: a generate artifact takes a GenerateConfig "
                     "(continuous-batching knobs), not ServeConfig")
+            if draft is not None:
+                # --draft wiring: 'auto' speculates iff the artifact
+                # bundles draft modules, 'on' requires them, 'off'
+                # forces plain one-token decode
+                if draft not in ("auto", "on", "off"):
+                    raise MXNetError("Server: draft= must be 'auto', "
+                                     "'on' or 'off' (got %r)" % (draft,))
+                config.speculative = {"auto": None, "on": True,
+                                      "off": False}[draft]
             self.mode = "generate"
             self.model = model
             self.config = config
@@ -109,6 +118,9 @@ class Server:
             self.metrics_ = self.session.metrics_
             return
         self.mode = "predict"
+        if draft is not None:
+            raise MXNetError("Server: draft= is a generate-mode option; "
+                             "predict artifacts have no draft model")
         self.session = None
         self._warming = False
         self._warm_thread = None

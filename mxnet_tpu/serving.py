@@ -26,7 +26,9 @@ Surface:
   * export_generate(params, spec, path) — continuous-batching decode
     artifact (format_version 3): THREE modules (prefill / decode step /
     KV commit) plus the paged-cache spec, serving
-    :class:`mxnet_tpu.serve.GenerateSession`.
+    :class:`mxnet_tpu.serve.GenerateSession`. With chunked=True /
+    draft_params= it becomes format_version 5: + chunk_prefill (long
+    prompts) and optionally the int8 draft modules (speculative decode).
   * GenerateModel.load(path) / load_artifact(path) — version dispatch.
   * tools/compile_model.py — checkpoint pair -> artifact CLI.
 """
@@ -63,6 +65,11 @@ _FORMAT_DISPATCH = {
     2: ("predict", "CompiledModel"),
     3: ("generate", "GenerateModel"),
     4: ("predict", "CompiledModel"),
+    # 5 = generate + chunked prefill, optionally bundling the int8 draft
+    # modules for speculative decoding (export_generate draft_params=);
+    # a v5 artifact WITHOUT the draft modules is a plain chunk-capable
+    # engine — the speculative path degrades gracefully, never the load.
+    5: ("generate", "GenerateModel"),
 }
 
 
@@ -424,7 +431,8 @@ def _kernel_tier_meta(exps):
     return meta
 
 
-def export_generate(params, spec, path, platforms=None, dtype="float32"):
+def export_generate(params, spec, path, platforms=None, dtype="float32",
+                    draft_params=None, speculate_k=None, chunked=None):
     """Freeze a decoder (weights + :class:`~mxnet_tpu.serve.decode_model.
     DecoderSpec` geometry) into a generate-capable artifact.
 
@@ -436,15 +444,35 @@ def export_generate(params, spec, path, platforms=None, dtype="float32"):
       over the paged KV cache (the caller donates the page buffers);
     * ``commit``  — prompt-KV scatter into freshly allocated pages.
 
+    With ``chunked=True`` (implied by ``draft_params``) the artifact is
+    format_version 5 and adds ``chunk_prefill`` — a single-sequence
+    fixed-shape prompt chunk straight into the paged cache, so prompts
+    longer than ``max_prompt_len`` stream through instead of being
+    rejected. ``draft_params`` (a
+    :func:`~mxnet_tpu.serve.decode_model.quantize_decoder_params` dict
+    of the SAME architecture, normally the int8 twin of ``params``)
+    additionally bundles ``draft_chunk_prefill`` + ``draft_verify`` —
+    the fused speculative step drafting ``speculate_k`` tokens per
+    dispatch (default: the
+    :func:`~mxnet_tpu.serve.decode_model.suggest_speculation_depth`
+    roofline pick). A v5 artifact without draft modules loads and
+    serves as a plain chunk-capable engine.
+
     Cache capacity (``spec.num_pages``) is BAKED into the decode/commit
     shapes — the TensorRT-profile trade: one artifact, one KV budget.
     Donation is NOT recorded in the modules; the serve side re-jits with
-    ``donate_argnums`` (GenerateSession) and the MXL508 gate checks the
-    lowering it actually runs.
+    ``donate_argnums`` (GenerateSession) and the MXL508/MXL510 gates
+    check the lowerings it actually runs.
     """
     from jax import export as _export
     from .serve import decode_model as _dm
     spec = _dm.DecoderSpec(*spec).validate()
+    if chunked is None:
+        chunked = draft_params is not None
+    if draft_params is not None and not chunked:
+        raise MXNetError("export_generate: a speculative artifact needs "
+                         "chunked prefill (the draft cache is populated "
+                         "through it); drop chunked=False")
     kw = {}
     if platforms is not None:
         kw["platforms"] = [p.lower() for p in platforms]
@@ -466,10 +494,35 @@ def export_generate(params, spec, path, platforms=None, dtype="float32"):
         pages, pages, SDS((L, P, C), f32), SDS((L, P, C), f32),
         SDS((spec.prompt_pages,), i32), SDS((), i32))
 
-    blobs = [exp.serialize() for exp in (prefill_exp, decode_exp,
-                                         commit_exp)]
+    exps = [("prefill", prefill_exp), ("decode", decode_exp),
+            ("commit", commit_exp)]
+    gen_meta = {"spec": spec._asdict(), "dtype": str(f32)}
+    if chunked:
+        chunk_args = (SDS((P,), i32), SDS((), i32), SDS((), i32),
+                      SDS((MP,), i32), SDS((), f32), SDS((), i32),
+                      pages, pages)
+        exps.append(("chunk_prefill", _export.export(
+            jax.jit(_dm.make_chunk_prefill(params, spec)), **kw)(
+                *chunk_args)))
+    if draft_params is not None:
+        k = speculate_k
+        if k is None:
+            k = _dm.suggest_speculation_depth(spec)
+        k = max(1, min(int(k), spec.max_prompt_len))
+        exps.append(("draft_chunk_prefill", _export.export(
+            jax.jit(_dm.make_chunk_prefill(draft_params, spec)), **kw)(
+                *chunk_args)))
+        exps.append(("draft_verify", _export.export(
+            jax.jit(_dm.make_draft_verify(params, draft_params, spec, k)),
+            **kw)(
+                SDS((S, 1), i32), SDS((S,), i32), SDS((S, MP), i32),
+                SDS((S,), f32), SDS((S,), i32),
+                pages, pages, pages, pages)))
+        gen_meta["speculate_k"] = k
+
+    blobs = [exp.serialize() for _, exp in exps]
     meta = {
-        "format_version": 3,
+        "format_version": 5 if chunked else 3,
         "platforms": list(prefill_exp.platforms),
         "dynamic_batch": True,
         # the prefill signature, v2-shaped so BucketedEngineCache serves
@@ -482,13 +535,11 @@ def export_generate(params, spec, path, platforms=None, dtype="float32"):
         ],
         "num_outputs": 3,
         "modules": [
-            {"name": "prefill", "bytes": len(blobs[0])},
-            {"name": "decode", "bytes": len(blobs[1])},
-            {"name": "commit", "bytes": len(blobs[2])},
+            {"name": name, "bytes": len(blob)}
+            for (name, _), blob in zip(exps, blobs)
         ],
-        "generate": {"spec": spec._asdict(), "dtype": str(f32)},
-        "kernel_tier": _kernel_tier_meta((prefill_exp, decode_exp,
-                                          commit_exp)),
+        "generate": gen_meta,
+        "kernel_tier": _kernel_tier_meta([exp for _, exp in exps]),
     }
     mjson = json.dumps(meta).encode()
     with open(path, "wb") as f:
@@ -506,18 +557,47 @@ class GenerateModel:
     deserialized decode/commit modules and the cache spec. Execution
     lives in :class:`mxnet_tpu.serve.GenerateSession`."""
 
-    def __init__(self, prefill, decode_exp, commit_exp, meta):
+    def __init__(self, prefill, decode_exp, commit_exp, meta, extras=None):
         self.prefill = prefill            # CompiledModel (dynamic batch)
         self.decode_exp = decode_exp
         self.commit_exp = commit_exp
         self.meta = meta
+        extras = extras or {}
+        # v5 optionals; a plain v3 artifact just leaves them None and
+        # every capability check below degrades gracefully
+        self.chunk_prefill_exp = extras.get("chunk_prefill")
+        self.draft_chunk_prefill_exp = extras.get("draft_chunk_prefill")
+        self.draft_verify_exp = extras.get("draft_verify")
         self._decode_jit = None
         self._commit_jit = None
+        self._chunk_prefill_jit = None
+        self._draft_chunk_prefill_jit = None
+        self._draft_verify_jit = None
 
     @property
     def spec(self):
         from .serve.decode_model import DecoderSpec
         return DecoderSpec(**self.meta["generate"]["spec"])
+
+    @property
+    def has_chunk_prefill(self):
+        """Prompts longer than max_prompt_len stream through fixed-shape
+        chunks (format_version 5)."""
+        return self.chunk_prefill_exp is not None
+
+    @property
+    def speculative(self):
+        """The artifact bundles the int8 draft modules — the session can
+        run the fused draft+verify step instead of one-token decode."""
+        return (self.draft_verify_exp is not None
+                and self.draft_chunk_prefill_exp is not None)
+
+    @property
+    def speculate_k(self):
+        """Draft depth baked into the draft_verify module (0 when the
+        artifact carries no draft)."""
+        return int(self.meta["generate"].get("speculate_k", 0)
+                   if self.speculative else 0)
 
     # The jitted step/commit are cached on the MODEL, not the session:
     # every GenerateSession over one loaded artifact shares the same
@@ -534,6 +614,25 @@ class GenerateModel:
             self._commit_jit = jax.jit(self.commit_exp.call,
                                        donate_argnums=(0, 1))
         return self._commit_jit
+
+    def chunk_prefill_jit(self):
+        if self._chunk_prefill_jit is None:
+            self._chunk_prefill_jit = jax.jit(
+                self.chunk_prefill_exp.call, donate_argnums=(6, 7))
+        return self._chunk_prefill_jit
+
+    def draft_chunk_prefill_jit(self):
+        if self._draft_chunk_prefill_jit is None:
+            self._draft_chunk_prefill_jit = jax.jit(
+                self.draft_chunk_prefill_exp.call, donate_argnums=(6, 7))
+        return self._draft_chunk_prefill_jit
+
+    def draft_verify_jit(self):
+        if self._draft_verify_jit is None:
+            self._draft_verify_jit = jax.jit(
+                self.draft_verify_exp.call,
+                donate_argnums=(5, 6, 7, 8))
+        return self._draft_verify_jit
 
     @classmethod
     def load(cls, path, allow_platform_mismatch=False):
@@ -559,7 +658,10 @@ class GenerateModel:
             raise MXNetError("generate artifact %r is missing module(s) "
                              "%s" % (path, sorted(missing)))
         prefill = CompiledModel(exps["prefill"], meta)
-        return cls(prefill, exps["decode"], exps["commit"], meta)
+        extras = {name: exp for name, exp in exps.items()
+                  if name not in ("prefill", "decode", "commit")}
+        return cls(prefill, exps["decode"], exps["commit"], meta,
+                   extras=extras)
 
 
 def load_artifact(path, **kw):

@@ -236,6 +236,74 @@ def test_route_generate_hop_chunking_caps_at_prefill_window(monkeypatch):
     assert out["replicas"] == ["g"]
 
 
+def test_route_generate_hop_cap_lifted_for_chunked_prefill(monkeypatch):
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, hop_tokens=4)
+    _register(reg, "g", mode="generate",
+              spec={"vocab": 61, "max_prompt_len": 8, "max_context": 32,
+                    "chunked_prefill": True})
+    bodies = []
+
+    def fake_call(url, payload, timeout_s):
+        bodies.append(payload)
+        n = payload["max_new_tokens"]
+        base = len(payload["prompt"])
+        return 200, {"tokens": list(range(base, base + n)),
+                     "finish_reason": "length", "ttft_ms": 1.0}, {}
+
+    monkeypatch.setattr(router, "_call", fake_call)
+    code, out, _ = router.route_generate(
+        {"prompt": [5, 9, 13], "max_new_tokens": 17})
+    assert code == 200
+    # the replica streams long resume prompts through chunked prefill,
+    # so the unsplittable-final-hop fallback never triggers: pure
+    # 4/4/4/4/1 chunking with resume prompts growing past max_prompt_len
+    assert [b["max_new_tokens"] for b in bodies] == [4, 4, 4, 4, 1]
+    assert [len(b["prompt"]) for b in bodies] == [3, 7, 11, 15, 19]
+    assert len(out["tokens"]) == 17
+    assert out["hops"] == 5
+
+
+def test_route_generate_400_when_budget_exceeds_max_context():
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, hop_tokens=4)
+    _register(reg, "g", mode="generate",
+              spec={"vocab": 61, "max_prompt_len": 8, "max_context": 32,
+                    "chunked_prefill": True})
+    code, out, _ = router.route_generate(
+        {"prompt": list(range(2, 22)), "max_new_tokens": 20})
+    assert code == 400
+    assert "max_context" in out["error"]
+
+
+def test_route_generate_aggregates_speculation_fields(monkeypatch):
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, hop_tokens=4)
+    _register(reg, "g", mode="generate",
+              spec={"vocab": 61, "max_prompt_len": 8, "max_context": 32,
+                    "chunked_prefill": True, "speculative": True})
+    rates = iter([(3.0, 0.9), (2.0, 0.5), (1.0, 0.1)])
+
+    def fake_call(url, payload, timeout_s):
+        n = payload["max_new_tokens"]
+        base = len(payload["prompt"])
+        atps, rate = next(rates)
+        return 200, {"tokens": list(range(base, base + n)),
+                     "finish_reason": "length", "ttft_ms": 1.0,
+                     "accepted_tokens_per_step": atps,
+                     "draft_acceptance_rate": rate}, {}
+
+    monkeypatch.setattr(router, "_call", fake_call)
+    code, out, _ = router.route_generate(
+        {"prompt": [1, 2], "max_new_tokens": 10})
+    assert code == 200 and out["hops"] == 3
+    # token-weighted across 4/4/2-token hops
+    assert out["accepted_tokens_per_step"] == round(
+        (3.0 * 4 + 2.0 * 4 + 1.0 * 2) / 10, 4)
+    assert out["draft_acceptance_rate"] == round(
+        (0.9 * 4 + 0.5 * 4 + 0.1 * 2) / 10, 4)
+
+
 def test_route_generate_migrates_on_owner_death(monkeypatch):
     reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
     router = Router(registry=reg, hop_tokens=4)
@@ -693,6 +761,73 @@ def test_fleet_smoke_router_two_replicas(predict_art, tmp_path):
 # ---------------------------------------------------------------------------
 # tier-1 cursor migration: kill the owner mid-hop, stitch bitwise
 # ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_gen_art(tmp_path_factory):
+    params = dm.init_params(GEN_SPEC, seed=0)
+    path = str(tmp_path_factory.mktemp("fleet_spec") / "m.spec.mxtpu")
+    meta = serving.export_generate(
+        params, GEN_SPEC, path,
+        draft_params=dm.quantize_decoder_params(params), speculate_k=3)
+    assert meta["format_version"] == 5
+    return {"path": path, "params": params}
+
+
+def test_speculative_cursor_migration_stitches_bitwise_tail(spec_gen_art,
+                                                            tmp_path):
+    """Kill-mid-hop against SPECULATIVE replicas, with the hop-chunk
+    cap lifted: resume prompts grow past max_prompt_len and stream
+    through chunked prefill on the survivor, the kill lands between
+    fused draft+verify windows (same decode_step op the drill targets
+    on a plain server), and the stitched stream is BITWISE the
+    uninterrupted single-process reference."""
+    prompt, max_new, temp, seed = [5, 9, 13], 17, 0.7, 11
+    ref = [int(t) for t in dm.reference_generate(
+        spec_gen_art["params"], GEN_SPEC, prompt, max_new,
+        temperature=temp, seed=seed)]
+
+    registry = ReplicaRegistry(heartbeat_timeout_s=3.0)
+    router = Router(registry=registry, hop_tokens=4)
+    front = route_http(router, "127.0.0.1", 0)
+    url = front.address
+    procs = []
+    try:
+        # skip=3: hop 1 takes at most 3 fused dispatches (prefill emits
+        # the first token, each window >= 1 more), so gA survives it and
+        # dies on a later hop — mid-session, KV pages, draft cache and
+        # all
+        procs.append(_spawn_replica(
+            tmp_path, spec_gen_art["path"], url, "gA", "vA",
+            extra_env={
+                "MXNET_FAULT_INJECT": "kill@serve=decode_step:skip=3"}))
+        procs.append(_spawn_replica(tmp_path, spec_gen_art["path"], url,
+                                    "gB", "vB"))
+        _wait_routable(registry, 2, tmp_path)
+        # both replicas registered the lifted-cap capabilities
+        for rid in ("gA", "gB"):
+            sp = registry.get(rid).spec
+            assert sp["chunked_prefill"] and sp["speculative"]
+        router.set_split("m", {"vA": 1.0})
+
+        code, out = _post(url + "/v1/generate",
+                          {"model": "m", "prompt": prompt,
+                           "max_new_tokens": max_new,
+                           "temperature": temp, "seed": seed},
+                          timeout=300)
+        assert code == 200, out
+        assert out["tokens"] == ref
+        assert out["finish_reason"] == "length"
+        assert out["migrations"] >= 1
+        assert out["replicas"] == ["gA", "gB"]
+        # the lifted cap kept chunking instead of one unsplittable
+        # final hop: at least the 4/4/4/4/1 schedule (+ death retries)
+        assert out["hops"] >= 5
+        # speculation stats aggregated across the surviving hops
+        assert out["accepted_tokens_per_step"] >= 1.0
+        assert registry.get("gA").dead
+    finally:
+        _stop_all(front, procs)
+
 
 def test_cursor_migration_stitches_bitwise_tail(gen_art, tmp_path):
     prompt, max_new, temp, seed = [5, 9, 13], 17, 0.7, 11

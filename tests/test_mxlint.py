@@ -533,6 +533,26 @@ class TestHloPasses:
         assert len(leak) == 1 and leak[0].rule == "MXL508"
         assert "host-transfer" in leak[0].message
 
+    def test_speculative_dispatch_catches_and_passes(self, lowerings):
+        # MXL510 fixture pair rides the same programs as MXL508: what
+        # changes is the contract — ALL cache params (verifier + draft
+        # pairs) donated, zero host transfers in the FUSED program.
+        # fused + donated: clean
+        assert hlo_passes.speculative_dispatch_pass(
+            lowerings["donated"], "draft_verify",
+            cache_params=(0, 1)) == []
+        # undonated draft/verifier KV: the page stores copy every window
+        bad = hlo_passes.speculative_dispatch_pass(
+            lowerings["undonated"], "draft_verify", cache_params=(0, 1))
+        assert len(bad) == 1 and bad[0].rule == "MXL510"
+        assert "not donated" in bad[0].message
+        # a host callback inside the step: the tell of a draft
+        # dispatched separately from its verifier (extra d2h per window)
+        leak = hlo_passes.speculative_dispatch_pass(
+            lowerings["callback"], "draft_verify", cache_params=())
+        assert len(leak) == 1 and leak[0].rule == "MXL510"
+        assert "not fused with its verifier" in leak[0].message
+
     # MXL509 fixtures: hand-written StableHLO in the shape the quantized
     # serving ops lower to. GOOD: f32 activations quantize (f32->i8), an
     # int8 dot accumulates in i32, and the only upcast is the i32

@@ -475,6 +475,183 @@ def test_loadgen_generate_mode_accounting(gm):
     assert res["server_metrics"]["requests"]["completed"] >= 9
 
 
+# ---------------------------------------------------------------------------
+# speculative decoding + chunked prefill (format_version 5)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_art(tmp_path_factory, params):
+    path = str(tmp_path_factory.mktemp("spec") / "m.spec.mxtpu")
+    meta = serving.export_generate(
+        params, SPEC, path,
+        draft_params=dm.quantize_decoder_params(params), speculate_k=3)
+    assert meta["format_version"] == 5
+    return path
+
+
+@pytest.fixture(scope="module")
+def sgm(spec_art):
+    m = serving.load_artifact(spec_art)
+    assert isinstance(m, serving.GenerateModel)
+    assert m.speculative and m.has_chunk_prefill
+    assert m.speculate_k == 3
+    return m
+
+
+def test_speculative_greedy_and_sampled_bitwise_equal_reference(sgm,
+                                                                params):
+    """The speculative acceptance property: the draft only sets the
+    PACE. Every emitted token is the verifier's position-keyed sample,
+    so greedy output is bitwise the target-only stream and sampled
+    output IS the target distribution's draw for that (seed, position)
+    — asserted as bitwise equality against the dense reference, which
+    is strictly stronger than a distributional test."""
+    sess = _session(sgm)
+    assert sess.speculative and sess.speculate_k == 3
+    work = WORK + [(p, n, 0.8, 40 + i)
+                   for i, (p, n, _, _) in enumerate(WORK)]
+    reqs = [sess.submit(p, max_new_tokens=n, temperature=t, seed=s)
+            for p, n, t, s in work]
+    outs = _drive(sess, reqs)
+    sess.close(drain=True)
+    for (p, n, t, s), o in zip(work, outs):
+        assert o["tokens"] == _ref(params, p, n, temperature=t, seed=s)
+        # per-request draft stats ride the result dict
+        assert o["accepted_tokens_per_step"] >= 1.0
+        assert 0.0 <= o["draft_acceptance_rate"] <= 1.0
+
+
+def test_speculative_off_is_graceful_fallback(sgm, gm, params):
+    # a v5 artifact serves as a plain engine on request...
+    sess = _session(sgm, speculative=False)
+    assert not sess.speculative and sess.chunked
+    out = _drive(sess, [sess.submit([5, 9, 13], max_new_tokens=8)])[0]
+    sess.close(drain=True)
+    assert out["tokens"] == _ref(params, [5, 9, 13], 8)
+    assert "accepted_tokens_per_step" not in out
+    # ...but a v3 artifact cannot be forced speculative
+    with pytest.raises(MXNetError, match="draft"):
+        _session(gm, speculative=True)
+
+
+def test_chunked_prefill_long_prompt_bitwise_direct(sgm, params):
+    """Prompts past max_prompt_len stream through fixed-shape chunks
+    instead of being rejected — and the continuation is bitwise the
+    dense reference's, speculating or not."""
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(2, SPEC.vocab, size=n).tolist()
+               for n in (9, 14, 20)]     # all > max_prompt_len == 8
+    for speculative in (None, False):
+        sess = _session(sgm, speculative=speculative)
+        reqs = [sess.submit(p, max_new_tokens=6, temperature=0.7,
+                            seed=3) for p in prompts]
+        outs = _drive(sess, reqs)
+        sess.close(drain=True)
+        for p, o in zip(prompts, outs):
+            assert o["tokens"] == _ref(params, p, 6, temperature=0.7,
+                                       seed=3), speculative
+
+
+def test_chunked_prompt_validation_keeps_max_context_cap(sgm):
+    sess = _session(sgm)
+    # admissible now: longer than max_prompt_len, inside max_context
+    sess.submit(list(range(2, 2 + SPEC.max_prompt_len + 2)),
+                max_new_tokens=2)
+    with pytest.raises(MXNetError, match="max_context"):
+        sess.submit([5] * (SPEC.max_context + 1), max_new_tokens=1)
+    with pytest.raises(MXNetError, match="max_context"):
+        sess.submit([5] * (SPEC.max_context - 2), max_new_tokens=8)
+    sess.close(drain=False)
+
+
+def test_speculative_sync_budget_one_d2h_per_fused_step(sgm):
+    """PR-9's sync discipline survives speculation AND chunked prefill:
+    ONE packed d2h per fused draft+verify dispatch, ONE per prefill
+    batch, ONE per long prompt (its final chunk) — pinned by the
+    profiler's transfer counters, not by reading the code."""
+    sess = _session(sgm)
+    rng = np.random.RandomState(3)
+    long_prompt = rng.randint(2, SPEC.vocab, size=13).tolist()
+    profiler.reset_sync_counters()
+    reqs = [sess.submit(p, max_new_tokens=n) for p, n, _, _ in WORK[:3]]
+    reqs.append(sess.submit(long_prompt, max_new_tokens=9))
+    _drive(sess, reqs)
+    d2h = profiler.sync_counters()["d2h"]
+    prefills = sess.metrics_.prefill_batches
+    sess._publish_window(force=True)
+    snap = sess.metrics_.snapshot()
+    steps = snap["decode_steps"]
+    assert prefills >= 2 and steps >= 1   # batched group + chunked admit
+    assert d2h == steps + prefills, (d2h, steps, prefills)
+    # speculation actually engaged, and the gauges were host-computed
+    # (speculative steps are per-SLOT consumptions: >= the dispatch
+    # count whenever more than one sequence rides a fused window)
+    sp = snap["speculative"]
+    assert sp["steps"] >= steps and sp["accepted_tokens_per_step"] >= 1.0
+    sess.close(drain=True)
+
+
+def test_eviction_mid_speculation_resumes_bitwise(sgm, gm, params):
+    """Cursor semantics under speculation: an eviction lands between
+    fused windows, gen[] holds only committed verifier tokens, so the
+    cursor resumes bitwise — on a speculative server or a plain one."""
+    prompt = [5, 9, 13]
+    full = _ref(params, prompt, 24)
+    sess = _session(sgm, drain_tokens=2)
+    req = sess.submit(prompt, max_new_tokens=24)
+    sess.run_round()          # prefill + first fused window
+    sess.run_round()
+    sess.close(drain=True)    # bounded drain, then evict with cursor
+    with pytest.raises(Evicted) as ei:
+        req.result(timeout=0.1)
+    exc = ei.value
+    n_got = len(exc.tokens)
+    assert 0 < n_got < 24
+    assert exc.tokens == full[:n_got]
+    assert exc.cursor["resume_prompt"] == prompt + exc.tokens
+    remaining = exc.cursor["remaining_tokens"]
+    assert remaining == 24 - n_got
+    # resume on a fresh SPECULATIVE session and on a PLAIN v3 session:
+    # both stitch to the uninterrupted stream (position-keyed sampling)
+    for model in (sgm, gm):
+        if len(exc.cursor["resume_prompt"]) > SPEC.max_prompt_len \
+                and model is gm:
+            continue          # v3 has no chunked prefill for long resumes
+        sess2 = _session(model)
+        out = _drive(sess2, [sess2.submit(exc.cursor["resume_prompt"],
+                                          max_new_tokens=remaining)])[0]
+        sess2.close(drain=True)
+        assert exc.tokens + out["tokens"] == full
+
+
+def test_mxl510_gate_clean_on_served_speculative_step(sgm, gm):
+    sess = _session(sgm)
+    assert sess.check_speculative_discipline() == []
+    text = sess.draft_verify_lowered_text()
+    sess.close(drain=False)
+    from mxnet_tpu import hlo_stats
+    entry = hlo_stats.entry_params(text)
+    # all FOUR page stores — verifier and draft K/V — donated
+    for p in (5, 6, 7, 8):
+        assert entry[p]["donated"], p
+    # a non-speculative session has nothing to gate
+    plain = _session(gm)
+    assert plain.check_speculative_discipline() == []
+    with pytest.raises(MXNetError, match="not speculative"):
+        plain.draft_verify_lowered_text()
+    plain.close(drain=False)
+
+
+def test_v5_artifact_round_trip_and_version_dispatch(spec_art):
+    m = serving.load_artifact(spec_art)
+    assert m.meta["format_version"] == 5
+    assert sorted(mod["name"] for mod in m.meta["modules"]) == \
+        ["chunk_prefill", "commit", "decode", "draft_chunk_prefill",
+         "draft_verify", "prefill"]
+    assert m.meta["generate"]["speculate_k"] == 3
+    assert m.spec == SPEC
+
+
 def test_gluon_converter_matches_decode_model_structure(params):
     """params_from_gluon pulls weights off the example GPT; the family
     contract is that the extracted dict drops into make_prefill/decode.

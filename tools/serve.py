@@ -27,9 +27,12 @@ Endpoints (see mxnet_tpu/serve/http.py):
     GET  /livez        liveness    GET /readyz  readiness (+reason)
     GET  /info         artifact identity / wire geometry
 
-The artifact kind picks the mode: a format_version-3 generate artifact
-(serving.export_generate) starts the continuous-batching decode engine;
-anything else starts the predict micro-batcher.
+The artifact kind picks the mode: a generate artifact
+(serving.export_generate, format_version 3 or 5) starts the
+continuous-batching decode engine; anything else starts the predict
+micro-batcher. A format_version-5 artifact bundles chunked prefill and
+(optionally) an int8 draft model — ``--draft auto|on|off`` controls
+speculative decoding against the bundled draft.
 
 SIGINT/SIGTERM triggers a graceful drain: deregister from the fleet
 (if registered), stop accepting, finish every admitted request, then
@@ -67,6 +70,11 @@ def main():
     p.add_argument("--max-new-tokens", type=int, default=64,
                    help="generate mode: default completion budget when "
                         "the request does not set one")
+    p.add_argument("--draft", default="auto", choices=["auto", "on", "off"],
+                   help="generate mode: speculative decoding with the "
+                        "artifact's bundled int8 draft model. auto "
+                        "speculates iff the artifact has one, on "
+                        "requires it, off forces plain decode")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--register", default=None, metavar="ROUTER_URL",
                    help="fleet mode: register with this tools/route.py "
@@ -105,6 +113,8 @@ def main():
             timeout_ms=args.timeout_ms,
             drain_tokens=args.drain_tokens,
             max_new_tokens=args.max_new_tokens,
+            speculative={"auto": None, "on": True,
+                         "off": False}[args.draft],
             warmup=False if (args.no_warmup or warm_async) else None)
     else:
         cfg = ServeConfig(
@@ -129,6 +139,10 @@ def main():
         banner["slots"] = spec.max_slots
         banner["kv_pages"] = server.session.cache.total_pages
         banner["page_size"] = spec.page_size
+        banner["chunked_prefill"] = server.session.chunked
+        banner["speculative"] = server.session.speculative
+        if server.session.speculative:
+            banner["speculate_k"] = server.session.speculate_k
     else:
         banner["buckets"] = list(server.buckets)
 
@@ -148,7 +162,9 @@ def main():
             sp = server.session.spec
             info["spec"] = {"vocab": sp.vocab,
                             "max_prompt_len": sp.max_prompt_len,
-                            "max_context": sp.max_context}
+                            "max_context": sp.max_context,
+                            "chunked_prefill": server.session.chunked,
+                            "speculative": server.session.speculative}
         announcer = ReplicaAnnouncer(args.register, info,
                                      server.load_status)
         announcer.start()

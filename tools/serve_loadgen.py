@@ -19,7 +19,9 @@ workers at a replacement server mid-run).
 ``--generate`` switches to the generation workload (generate-mode
 artifacts): closed-loop users with per-request prompt/output lengths
 drawn from fixed/uniform/longtail distributions, reporting TTFT/TPOT
-percentiles and tokens/s goodput. Importable as ``measure_generate``.
+percentiles and tokens/s goodput — plus, against a speculative server,
+the token-weighted ``accepted_tokens_per_step`` and draft acceptance
+rate under ``"speculation"``. Importable as ``measure_generate``.
 
 ``--router http://...`` drives a ``tools/route.py`` fleet front end
 instead of a single replica: same closed loop, but the report adds the
@@ -466,7 +468,7 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
             session = target.session
             if session is None:
                 raise ValueError("measure_generate needs a generate-mode "
-                                 "server (a format_version-3 artifact)")
+                                 "server (a format_version 3/5 artifact)")
         elif isinstance(target, GenerateSession):
             session = target
         else:
@@ -495,6 +497,7 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
     tokens_ok = [0]
     tokens_partial = [0]
     per_replica = {}          # replica -> completions it finished
+    spec_agg = {"w": 0, "atps": 0.0, "rate": 0.0}   # token-weighted
     migrations_total = [0]    # router-reported mid-session owner moves
     resumed_sessions = [0]    # sessions completed via cursor resubmit
     migrated = {"tokens": 0, "wall_s": 0.0}   # post-migration goodput
@@ -561,6 +564,15 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
                     rid = out.get("replica")
                     if rid:
                         per_replica[rid] = per_replica.get(rid, 0) + 1
+                    atps = out.get("accepted_tokens_per_step")
+                    if atps is not None and ntok:
+                        # speculative servers stamp per-request draft
+                        # stats on the response; aggregate them weighted
+                        # by tokens so long completions dominate
+                        spec_agg["w"] += ntok
+                        spec_agg["atps"] += float(atps) * ntok
+                        spec_agg["rate"] += float(
+                            out.get("draft_acceptance_rate") or 0.0) * ntok
                     mig = int(out.get("migrations") or 0)
                     migrations_total[0] += mig
                     if resumes:
@@ -613,6 +625,13 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
         "tpot_ms": _pct(tpots),
         "latency_ms": _pct(latencies),
     }
+    if spec_agg["w"]:
+        out["speculation"] = {
+            "accepted_tokens_per_step": round(
+                spec_agg["atps"] / spec_agg["w"], 4),
+            "draft_acceptance_rate": round(
+                spec_agg["rate"] / spec_agg["w"], 4),
+        }
     if is_url:
         out["migrations"] = migrations_total[0]
         out["resumed_sessions"] = resumed_sessions[0]
