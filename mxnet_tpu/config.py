@@ -405,6 +405,37 @@ register_flag("fleet_proxy_timeout_s", "MXNET_FLEET_PROXY_TIMEOUT_S",
               "(requests with their own timeout_ms get that + margin "
               "instead). A hop that exceeds it counts as a replica "
               "failure and is retried on a survivor.")
+register_flag("fleet_journal_sync_every", "MXNET_FLEET_JOURNAL_SYNC_EVERY",
+              int, 8,
+              "Fleet write-ahead journal group commit: fsync after this "
+              "many appended records (epoch/registration records always "
+              "sync immediately). Losing the unsynced tail only costs "
+              "resumed sessions a few regenerated-bitwise tokens, so "
+              "the hot hop path pays a buffered write, not a disk "
+              "round-trip. 1 = fsync every record.")
+register_flag("fleet_journal_compact_every",
+              "MXNET_FLEET_JOURNAL_COMPACT_EVERY", int, 512,
+              "Auto-compact the fleet journal (snapshot + truncate, "
+              "checkpoint.py's temp+fsync+rename discipline) after this "
+              "many records since the last compaction, bounding replay "
+              "to O(snapshot) + one segment.")
+register_flag("fleet_lease_interval_s", "MXNET_FLEET_LEASE_INTERVAL_S",
+              float, 0.5,
+              "How often the primary router refreshes its lease file in "
+              "the journal directory. The standby calls the primary "
+              "dead only after the lease *content* stops changing for "
+              "MXNET_FLEET_LEASE_TIMEOUT_S of monotonic time.")
+register_flag("fleet_lease_timeout_s", "MXNET_FLEET_LEASE_TIMEOUT_S",
+              float, 3.0,
+              "Standby promotion threshold: monotonic seconds without "
+              "an observed lease change before the standby replays the "
+              "journal, bumps the fencing epoch, and takes over the "
+              "primary's address. Must comfortably exceed "
+              "MXNET_FLEET_LEASE_INTERVAL_S.")
+register_flag("fleet_standby_poll_s", "MXNET_FLEET_STANDBY_POLL_S",
+              float, 0.2,
+              "How often a --standby router tails the journal and "
+              "checks the primary's lease.")
 register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
               "Training-side telemetry HTTP listener port "
               "(mxnet_tpu.telemetry.exporters): serves /metrics "

@@ -10,11 +10,20 @@ capped-jittered-backoff restart discipline ``tools/launch.py`` gives
 training workers. Blue/green multi-version hosting and int8 canary
 auto-rollback ride on the registry's ``(model, version)`` identity.
 
+The router itself is highly available: a :class:`FleetJournal`
+write-ahead logs every registry mutation and generate hop cursor, a
+warm standby (``tools/route.py --standby``) tails it and promotes on
+lease expiry, and fencing epochs (:mod:`mxnet_tpu.fleet.fencing`) keep
+a revived stale primary from split-braining the fleet.
+
 Entry points: ``tools/route.py`` (router CLI), ``tools/serve.py
 --register`` (replica side). docs/fleet.md is the operator tour.
 """
 from __future__ import annotations
 
+from . import fencing
+from .journal import (FleetJournal, FleetState, JournalTailer,
+                      LeaseMonitor)
 from .registry import Replica, ReplicaAnnouncer, ReplicaRegistry
 from .router import (NoReplica, Router, RouterHTTPFrontEnd,
                      route_http)
@@ -24,4 +33,6 @@ __all__ = [
     "Replica", "ReplicaAnnouncer", "ReplicaRegistry",
     "NoReplica", "Router", "RouterHTTPFrontEnd", "route_http",
     "ReplicaSpec", "ReplicaSupervisor", "backoff_delay",
+    "FleetJournal", "FleetState", "JournalTailer", "LeaseMonitor",
+    "fencing",
 ]

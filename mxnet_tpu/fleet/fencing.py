@@ -1,0 +1,54 @@
+"""Fencing epochs: split-brain protection for router failover.
+
+Every router incarnation that owns the fleet journal gets a
+monotonically increasing **epoch** (replayed-max + 1). The router
+stamps it into registration/heartbeat replies and into every request
+body it forwards (``"fleet_epoch"``); replicas track the highest epoch
+they have ever observed here and *reject* anything below it with a
+409. So when a SIGKILLed primary is revived while the standby already
+promoted, the zombie's forwarded writes bounce off every replica and
+its registration offers are ignored by the announcer — it can serve
+stale answers to nobody.
+
+Process-global on purpose: one serving process talks to one fleet, and
+the fence must hold across every front-end thread. Stdlib-only.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["observe", "current", "is_stale", "reset"]
+
+_lock = threading.Lock()
+_epoch = 0
+
+
+def observe(epoch):
+    """Record an observed epoch. Returns True when ``epoch`` is
+    current-or-newer (and advances the fence), False when it is stale —
+    the caller must reject the write that carried it."""
+    global _epoch
+    if epoch is None:
+        return True         # pre-HA routers carry no epoch: not fenced
+    e = int(epoch)
+    with _lock:
+        if e < _epoch:
+            return False
+        _epoch = e
+        return True
+
+
+def current():
+    with _lock:
+        return _epoch
+
+
+def is_stale(epoch):
+    return epoch is not None and int(epoch) < current()
+
+
+def reset():
+    """Test hook: forget the fence (a fresh process observes from 0)."""
+    global _epoch
+    with _lock:
+        _epoch = 0

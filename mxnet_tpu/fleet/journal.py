@@ -1,0 +1,504 @@
+"""Write-ahead fleet journal: the router's durability layer.
+
+The registry and every in-flight generate cursor used to live only in
+the router process's memory (ROADMAP item-1 residual) — a router crash
+dropped all sessions and cold-restarted the fleet. This module makes
+the fleet *control plane* as recoverable as the data plane already is
+(PR-2 checkpoints, PR-9 eviction cursors, PR-11 replica migration):
+
+* **Append-only CRC-framed log.** Every registry mutation (register /
+  heartbeat-derived readiness flips / drain / split / canary verdict)
+  and every generate-session hop cursor is one record: an 8-byte
+  ``<II`` header (payload length, crc32) followed by a JSON payload
+  ``{"seq", "kind", "data"}``. Appends are fsync-batched (group commit
+  every ``MXNET_FLEET_JOURNAL_SYNC_EVERY`` records; rare critical
+  records pass ``sync=True``) so the hot decode path pays a buffered
+  write, not a disk round-trip, per hop.
+* **Snapshot + compaction.** ``compact(state)`` writes the full
+  :class:`FleetState` as ``snap-<seq>.json`` with ``checkpoint.py``'s
+  temp+fsync+rename discipline, rotates to a fresh ``wal-<n>.log``
+  segment, and deletes everything older — restart replay is
+  O(snapshot), not O(history).
+* **Tolerant replay.** :func:`replay` loads the newest *valid*
+  snapshot, then applies records in global order. A truncated tail
+  record (SIGKILL mid-append) or a CRC mismatch stops that segment's
+  scan without losing the prefix; records with ``seq <=`` the already
+  applied sequence are skipped, so replaying twice — or replaying a
+  snapshot plus the pre-compaction log — is idempotent.
+* **Lease + tailing** for the warm standby: the primary touches
+  ``lease.json`` every ``MXNET_FLEET_LEASE_INTERVAL_S``; the standby's
+  :class:`JournalTailer` keeps a warm :class:`FleetState` and its
+  :class:`LeaseMonitor` measures staleness as *monotonic time since
+  the lease content last changed* — an NTP step can't trigger (or
+  mask) a failover, the same reason the registry sweep is monotonic.
+
+Losing the last few *unsynced* hop cursors is safe by construction:
+resuming from an older cursor just regenerates more tokens, and
+position-keyed sampling makes the stitched tail bitwise-equal either
+way. What the journal must never lose silently is ordering, which the
+monotone ``seq`` gives.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+
+from ..checkpoint import atomic_replace
+from .. import telemetry
+
+__all__ = ["FleetJournal", "FleetState", "JournalTailer", "LeaseMonitor",
+           "replay", "read_segment", "write_lease", "read_lease",
+           "release_lease", "lease_holder_alive"]
+
+_FRAME = struct.Struct("<II")           # payload length, crc32(payload)
+_SEG_PREFIX, _SEG_SUFFIX = "wal-", ".log"
+_SNAP_PREFIX, _SNAP_SUFFIX = "snap-", ".json"
+_LEASE = "lease.json"
+
+
+# ---------------------------------------------------------------------------
+# state reducer
+# ---------------------------------------------------------------------------
+
+class FleetState:
+    """The replayable fleet control-plane state: everything a freshly
+    promoted router needs to route as if it were the crashed one.
+
+    ``apply`` is a pure-ish reducer over journal records; it skips any
+    record whose ``seq`` is not beyond ``applied_seq``, which is what
+    makes double replay (and snapshot+tail replay) idempotent."""
+
+    def __init__(self):
+        self.applied_seq = 0
+        self.epoch = 0               # highest fencing epoch journaled
+        self.address = None          # last primary's bound URL
+        self.replicas = {}           # rid -> registration info + state
+        self.splits = {}             # model -> {version: weight}
+        self.canaries = {}           # model -> canary record (no deltas)
+        self.sessions = {}           # sid -> hop cursor record
+
+    def apply(self, seq, kind, data):
+        """Apply one record; returns False for stale (already-applied)
+        sequence numbers."""
+        seq = int(seq)
+        if seq <= self.applied_seq:
+            return False
+        self.applied_seq = seq
+        if kind == "epoch":
+            self.epoch = max(self.epoch, int(data.get("epoch", 0)))
+            if data.get("address"):
+                self.address = data["address"]
+        elif kind == "register":
+            self.replicas[str(data["id"])] = dict(data)
+        elif kind == "state":
+            rep = self.replicas.get(str(data.get("id")))
+            if rep is not None:
+                rep.update({k: v for k, v in data.items() if k != "id"})
+        elif kind == "deregister":
+            self.replicas.pop(str(data.get("id")), None)
+        elif kind == "split":
+            if data.get("weights"):
+                self.splits[str(data["model"])] = dict(data["weights"])
+            else:
+                self.splits.pop(str(data.get("model")), None)
+        elif kind == "canary":
+            if data.get("record"):
+                self.canaries[str(data["model"])] = dict(data["record"])
+            else:
+                self.canaries.pop(str(data.get("model")), None)
+        elif kind == "session":
+            self.sessions[str(data["sid"])] = dict(data)
+        elif kind == "session_done":
+            self.sessions.pop(str(data.get("sid")), None)
+        # unknown kinds are skipped, not fatal: an older standby may
+        # tail a newer primary's journal during a rolling upgrade
+        return True
+
+    def to_dict(self):
+        return {
+            "applied_seq": self.applied_seq,
+            "epoch": self.epoch,
+            "address": self.address,
+            "replicas": {r: dict(v) for r, v in self.replicas.items()},
+            "splits": {m: dict(w) for m, w in self.splits.items()},
+            "canaries": {m: dict(c) for m, c in self.canaries.items()},
+            "sessions": {s: dict(v) for s, v in self.sessions.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        st = cls()
+        st.applied_seq = int(d.get("applied_seq", 0))
+        st.epoch = int(d.get("epoch", 0))
+        st.address = d.get("address")
+        st.replicas = {str(r): dict(v)
+                       for r, v in (d.get("replicas") or {}).items()}
+        st.splits = {str(m): dict(w)
+                     for m, w in (d.get("splits") or {}).items()}
+        st.canaries = {str(m): dict(c)
+                       for m, c in (d.get("canaries") or {}).items()}
+        st.sessions = {str(s): dict(v)
+                       for s, v in (d.get("sessions") or {}).items()}
+        return st
+
+
+# ---------------------------------------------------------------------------
+# segment + snapshot file layout
+# ---------------------------------------------------------------------------
+
+def _segments(dir_):
+    """Segment paths sorted by their rotation number (global record
+    order: the journal only ever appends to the newest segment)."""
+    out = []
+    try:
+        names = os.listdir(dir_)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX):
+            try:
+                n = int(name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)])
+            except ValueError:
+                continue
+            out.append((n, os.path.join(dir_, name)))
+    return sorted(out)
+
+
+def _snapshots(dir_):
+    out = []
+    try:
+        names = os.listdir(dir_)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith(_SNAP_PREFIX) and name.endswith(_SNAP_SUFFIX):
+            try:
+                n = int(name[len(_SNAP_PREFIX):-len(_SNAP_SUFFIX)])
+            except ValueError:
+                continue
+            out.append((n, os.path.join(dir_, name)))
+    return sorted(out)
+
+
+def read_segment(path, offset=0):
+    """Read complete, CRC-valid records from ``path`` starting at byte
+    ``offset``. Returns ``(records, new_offset, clean)`` where records
+    are ``(seq, kind, data)`` tuples and ``new_offset`` points just past
+    the last *good* record — a torn tail (short header/payload) or a
+    CRC mismatch stops the scan there without losing the prefix, and a
+    tailer retrying from ``new_offset`` picks the record up if its
+    remaining bytes arrive later. ``clean`` is False when the scan
+    stopped early."""
+    records = []
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return records, offset, True
+    with f:
+        f.seek(offset)
+        pos = offset
+        while True:
+            header = f.read(_FRAME.size)
+            if len(header) < _FRAME.size:
+                return records, pos, len(header) == 0
+            length, crc = _FRAME.unpack(header)
+            payload = f.read(length)
+            if len(payload) < length:
+                return records, pos, False          # torn tail
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                return records, pos, False          # corrupt record
+            try:
+                rec = json.loads(payload.decode("utf-8"))
+                records.append((int(rec["seq"]), str(rec["kind"]),
+                                rec.get("data") or {}))
+            except (ValueError, KeyError, TypeError):
+                return records, pos, False
+            pos += _FRAME.size + length
+
+
+def replay(dir_):
+    """Rebuild the :class:`FleetState` from ``dir_``: newest loadable
+    snapshot first, then every record (from every surviving segment, in
+    order) with ``seq`` beyond it. Returns ``(state, stats)``."""
+    state = FleetState()
+    stats = {"snapshot_seq": 0, "segments": 0, "records": 0,
+             "stale_records": 0, "torn_segments": 0}
+    for _, snap_path in reversed(_snapshots(dir_)):
+        try:
+            with open(snap_path) as f:
+                state = FleetState.from_dict(json.load(f))
+            stats["snapshot_seq"] = state.applied_seq
+            break
+        except (OSError, ValueError, KeyError, TypeError):
+            continue       # half-written pre-atomic_replace leftovers
+    for _, seg_path in _segments(dir_):
+        stats["segments"] += 1
+        records, _, clean = read_segment(seg_path)
+        if not clean:
+            stats["torn_segments"] += 1
+        for seq, kind, data in records:
+            if state.apply(seq, kind, data):
+                stats["records"] += 1
+            else:
+                stats["stale_records"] += 1
+    return state, stats
+
+
+# ---------------------------------------------------------------------------
+# the journal (writer side)
+# ---------------------------------------------------------------------------
+
+class FleetJournal:
+    """Append-only writer over a journal directory.
+
+    One instance per *primary* router. ``start_seq`` continues the
+    sequence numbering from a replayed state; every open rotates to a
+    fresh segment so an old incarnation's torn tail is never appended
+    through."""
+
+    def __init__(self, dir_, start_seq=0, sync_every=None):
+        if sync_every is None:
+            from ..config import flags
+            sync_every = flags.fleet_journal_sync_every
+        self.dir = os.fspath(dir_)
+        os.makedirs(self.dir, exist_ok=True)
+        self.sync_every = max(1, int(sync_every))
+        self._lock = threading.Lock()
+        self._seq = int(start_seq)
+        self._unsynced = 0
+        self.records_since_compact = 0
+        segs = _segments(self.dir)
+        seg_no = (segs[-1][0] + 1) if segs else 1
+        self._seg_path = os.path.join(
+            self.dir, "%s%08d%s" % (_SEG_PREFIX, seg_no, _SEG_SUFFIX))
+        self._f = open(self._seg_path, "ab", buffering=0)
+        reg = telemetry.default_registry()
+        self._c_records = reg.counter(
+            "fleet/journal_records", "Records appended to the fleet "
+            "write-ahead journal, by kind.")
+        self._c_bytes = reg.counter(
+            "fleet/journal_bytes", "Bytes appended to the fleet journal.")
+        self._c_fsyncs = reg.counter(
+            "fleet/journal_fsyncs", "Journal fsync batches (group "
+            "commits + explicit syncs).")
+        self._c_compactions = reg.counter(
+            "fleet/journal_compactions",
+            "Snapshot+truncate compactions of the fleet journal.")
+
+    @property
+    def seq(self):
+        with self._lock:
+            return self._seq
+
+    def append(self, kind, data, sync=False):
+        """Append one record; returns its sequence number. ``sync``
+        forces an immediate fsync (epoch records, registrations);
+        otherwise the fsync is batched every ``sync_every`` appends."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            payload = json.dumps(
+                {"seq": seq, "kind": kind, "data": data},
+                sort_keys=True).encode("utf-8")
+            self._f.write(_FRAME.pack(
+                len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload)
+            self._unsynced += 1
+            self.records_since_compact += 1
+            if sync or self._unsynced >= self.sync_every:
+                self._fsync_locked()
+        self._c_records.inc(kind=kind)
+        self._c_bytes.inc(_FRAME.size + len(payload))
+        return seq
+
+    def _fsync_locked(self):
+        os.fsync(self._f.fileno())
+        self._unsynced = 0
+        self._c_fsyncs.inc()
+
+    def sync(self):
+        """Flush the current group commit to disk."""
+        with self._lock:
+            if self._unsynced:
+                self._fsync_locked()
+
+    def compact(self, state):
+        """Durably snapshot ``state`` and truncate history: fsync the
+        log, write ``snap-<seq>.json`` (temp + fsync + rename — the
+        checkpoint.py discipline), rotate to a fresh segment, delete
+        older segments and snapshots. Replay after this is O(snapshot)
+        plus whatever lands in the new segment."""
+        if isinstance(state, FleetState):
+            state = state.to_dict()
+        with self._lock:
+            self._fsync_locked()
+            seq = self._seq
+            state = dict(state, applied_seq=seq)
+            snap_path = os.path.join(
+                self.dir, "%s%016d%s" % (_SNAP_PREFIX, seq, _SNAP_SUFFIX))
+            with atomic_replace(snap_path) as tmp:
+                with open(tmp, "w") as f:
+                    json.dump(state, f, sort_keys=True)
+            old_f, old_seg = self._f, self._seg_path
+            segs = _segments(self.dir)
+            seg_no = (segs[-1][0] + 1) if segs else 1
+            self._seg_path = os.path.join(
+                self.dir, "%s%08d%s" % (_SEG_PREFIX, seg_no, _SEG_SUFFIX))
+            self._f = open(self._seg_path, "ab", buffering=0)
+            old_f.close()
+            for _, p in segs:
+                if p != self._seg_path:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            for _, p in _snapshots(self.dir):
+                if p != snap_path:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            self.records_since_compact = 0
+        self._c_compactions.inc()
+        return snap_path
+
+    def stats(self):
+        with self._lock:
+            return {"dir": self.dir, "seq": self._seq,
+                    "segment": os.path.basename(self._seg_path),
+                    "unsynced": self._unsynced,
+                    "records_since_compact": self.records_since_compact,
+                    "sync_every": self.sync_every}
+
+    def close(self):
+        with self._lock:
+            try:
+                self._fsync_locked()
+            except (OSError, ValueError):
+                pass
+            self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# tailer (standby side)
+# ---------------------------------------------------------------------------
+
+class JournalTailer:
+    """Incrementally replays a journal directory someone else writes:
+    the warm standby's view of the fleet. Remembers a byte offset per
+    segment so each poll reads only new bytes; a torn tail simply stops
+    that segment's scan until more bytes arrive (the primary may be
+    mid-append), and a newer snapshot (compaction) is adopted whenever
+    it is ahead of what was already applied."""
+
+    def __init__(self, dir_):
+        self.dir = os.fspath(dir_)
+        self.state = FleetState()
+        self._offsets = {}
+
+    def poll(self):
+        """Apply everything new; returns the number of records applied."""
+        applied = 0
+        for snap_seq, snap_path in reversed(_snapshots(self.dir)):
+            if snap_seq <= self.state.applied_seq:
+                break
+            try:
+                with open(snap_path) as f:
+                    self.state = FleetState.from_dict(json.load(f))
+                self._offsets.clear()
+                applied += 1
+                break
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        live = set()
+        for _, seg_path in _segments(self.dir):
+            live.add(seg_path)
+            off = self._offsets.get(seg_path, 0)
+            records, new_off, _clean = read_segment(seg_path, off)
+            self._offsets[seg_path] = new_off
+            for seq, kind, data in records:
+                if self.state.apply(seq, kind, data):
+                    applied += 1
+        for path in list(self._offsets):
+            if path not in live:
+                del self._offsets[path]         # compacted away
+        return applied
+
+
+# ---------------------------------------------------------------------------
+# lease (primary liveness signal for the standby)
+# ---------------------------------------------------------------------------
+
+def _lease_path(dir_):
+    return os.path.join(os.fspath(dir_), _LEASE)
+
+
+def write_lease(dir_, payload):
+    """Refresh the primary's lease: the payload plus a monotone beat
+    counter, written via rename so readers never see a torn file. No
+    fsync — the lease is a liveness signal, not durable state; what
+    matters is that its *content changes* while the primary lives."""
+    path = _lease_path(dir_)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    data = dict(payload)
+    data["beat"] = data.get("beat", 0)
+    with open(tmp, "w") as f:
+        json.dump(data, f, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def read_lease(dir_):
+    try:
+        with open(_lease_path(dir_), "rb") as f:
+            raw = f.read()
+        return json.loads(raw.decode("utf-8")), raw
+    except (OSError, ValueError):
+        return None, None
+
+
+def release_lease(dir_):
+    try:
+        os.unlink(_lease_path(dir_))
+        return True
+    except OSError:
+        return False
+
+
+def lease_holder_alive(dir_, wait_s):
+    """Startup guard for a would-be primary: sample the lease twice
+    ``wait_s`` apart and call the holder alive iff the content changed
+    (a live primary beats every MXNET_FLEET_LEASE_INTERVAL_S). Content
+    comparison, not mtime-vs-wall-clock — immune to NTP steps and to
+    stale lease files left by a SIGKILLed primary."""
+    first, raw0 = read_lease(dir_)
+    if first is None:
+        return False
+    time.sleep(max(0.0, float(wait_s)))
+    _second, raw1 = read_lease(dir_)
+    return raw1 is not None and raw1 != raw0
+
+
+class LeaseMonitor:
+    """Standby-side lease staleness: monotonic seconds since the lease
+    content was last *observed to change*. A missing lease counts as
+    unchanged (the clock keeps running), so a primary that dies before
+    its first beat still fails over."""
+
+    def __init__(self, dir_):
+        self.dir = os.fspath(dir_)
+        self._last_raw = read_lease(self.dir)[1]
+        self._changed_at = time.monotonic()
+
+    def age_s(self):
+        raw = read_lease(self.dir)[1]
+        if raw is not None and raw != self._last_raw:
+            self._last_raw = raw
+            self._changed_at = time.monotonic()
+        return time.monotonic() - self._changed_at
+
+    def expired(self, timeout_s):
+        return self.age_s() > float(timeout_s)

@@ -40,7 +40,7 @@ class Replica:
                  "inflight", "served", "static", "spec")
 
     def __init__(self, rid, url, model, version, mode, identity=None,
-                 pid=None):
+                 pid=None, now=None):
         self.id = str(rid)
         self.url = str(url).rstrip("/")
         self.model = str(model)
@@ -48,7 +48,7 @@ class Replica:
         self.mode = str(mode)          # "predict" | "generate"
         self.identity = identity or {}
         self.pid = pid
-        now = time.monotonic()
+        now = time.monotonic() if now is None else now
         self.registered_at = now
         self.last_heartbeat = now
         self.ready = False             # as reported by the replica
@@ -85,17 +85,55 @@ class Replica:
             "heartbeat_age_s": round(now - self.last_heartbeat, 3),
         }
 
+    def to_info(self):
+        """The registration-shaped dict the fleet journal records and
+        :meth:`ReplicaRegistry.restore` consumes — everything needed to
+        rebuild this entry in a promoted router."""
+        return {
+            "id": self.id, "url": self.url, "model": self.model,
+            "version": self.version, "mode": self.mode,
+            "identity": self.identity, "pid": self.pid,
+            "ready": self.ready, "reason": self.reason,
+            "dead": self.dead, "dead_reason": self.dead_reason,
+            "draining": self.draining, "static": self.static,
+            "spec": self.spec, "load": self.load,
+        }
+
 
 class ReplicaRegistry:
-    """Thread-safe replica table with heartbeat-staleness sweeping."""
+    """Thread-safe replica table with heartbeat-staleness sweeping.
 
-    def __init__(self, heartbeat_timeout_s=None):
+    Liveness bookkeeping is **monotonic by contract**: every timestamp
+    comes from ``clock`` (default ``time.monotonic``), never the wall
+    clock, so an NTP step cannot mass-expire a healthy fleet — the
+    unit tests pin this with a patched clock. ``on_mutation(kind,
+    data)``, when set (the router wires it to the fleet journal),
+    observes every durable state change: registrations, readiness
+    flips, deaths, drains, deregistrations."""
+
+    def __init__(self, heartbeat_timeout_s=None, clock=None):
         if heartbeat_timeout_s is None:
             from ..config import flags
             heartbeat_timeout_s = flags.fleet_heartbeat_timeout_s
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._clock = clock if clock is not None else time.monotonic
         self._lock = threading.Lock()
         self._replicas = {}
+        self.on_mutation = None
+
+    def _notify(self, kind, data):
+        # called with self._lock held so journal records preserve
+        # mutation order; a plain buffered file append, never a device
+        # sync or a join. A broken journal must not break routing.
+        cb = self.on_mutation
+        if cb is None:
+            return
+        try:
+            cb(kind, data)
+        except Exception as e:
+            import sys
+            print("fleet registry: mutation hook failed: %s" % e,
+                  file=sys.stderr)
 
     # -- replica-driven lifecycle ------------------------------------------
     def register(self, info):
@@ -109,14 +147,40 @@ class ReplicaRegistry:
                           info.get("version", "0"),
                           info.get("mode", "predict"),
                           identity=info.get("identity"),
-                          pid=info.get("pid"))
+                          pid=info.get("pid"), now=self._clock())
             rep.ready = bool(info.get("ready", False))
             rep.reason = info.get("reason")
             rep.load = dict(info.get("load") or {})
             rep.static = bool(info.get("static", False))
             rep.spec = dict(info.get("spec") or {})
             self._replicas[rid] = rep
+            self._notify("register", rep.to_info())
         return rep
+
+    def restore(self, infos):
+        """Rebuild the table from journal-replayed ``to_info()`` dicts
+        WITHOUT emitting mutations (replay must not re-journal itself).
+        Restored replicas get a fresh heartbeat stamp: live ones beat
+        again within MXNET_FLEET_HEARTBEAT_S, ones that died with the
+        old router age out through the normal sweep."""
+        now = self._clock()
+        with self._lock:
+            for info in infos:
+                rep = Replica(info["id"], info["url"],
+                              info.get("model", "default"),
+                              info.get("version", "0"),
+                              info.get("mode", "predict"),
+                              identity=info.get("identity"),
+                              pid=info.get("pid"), now=now)
+                rep.ready = bool(info.get("ready", False))
+                rep.reason = info.get("reason")
+                rep.load = dict(info.get("load") or {})
+                rep.static = bool(info.get("static", False))
+                rep.spec = dict(info.get("spec") or {})
+                rep.draining = bool(info.get("draining", False))
+                rep.dead = bool(info.get("dead", False))
+                rep.dead_reason = info.get("dead_reason")
+                self._replicas[rep.id] = rep
 
     def heartbeat(self, rid, ready=None, reason=None, load=None):
         """Refresh liveness + readiness; returns False for an unknown id
@@ -126,7 +190,8 @@ class ReplicaRegistry:
             rep = self._replicas.get(str(rid))
             if rep is None:
                 return False
-            rep.last_heartbeat = time.monotonic()
+            rep.last_heartbeat = self._clock()
+            was = (rep.dead, rep.ready)
             if rep.dead:
                 # a heartbeat from the "dead" is a liveness correction
                 # (e.g. a transient proxy failure marked it dead)
@@ -138,11 +203,21 @@ class ReplicaRegistry:
                 rep.reason = reason
             if load is not None:
                 rep.load = dict(load)
+            if (rep.dead, rep.ready) != was:
+                # journal readiness FLIPS, not every beat: load updates
+                # are re-announced within a heartbeat interval anyway
+                self._notify("state", {
+                    "id": rep.id, "ready": rep.ready,
+                    "reason": rep.reason, "dead": rep.dead,
+                    "dead_reason": rep.dead_reason})
             return True
 
     def deregister(self, rid):
         with self._lock:
-            return self._replicas.pop(str(rid), None) is not None
+            gone = self._replicas.pop(str(rid), None) is not None
+            if gone:
+                self._notify("deregister", {"id": str(rid)})
+            return gone
 
     # -- router-driven state -----------------------------------------------
     def mark_dead(self, rid, why):
@@ -152,6 +227,9 @@ class ReplicaRegistry:
                 rep.dead = True
                 rep.dead_reason = str(why)
                 rep.ready = False
+                self._notify("state", {
+                    "id": rep.id, "ready": False, "dead": True,
+                    "dead_reason": rep.dead_reason})
 
     def mark_not_ready(self, rid, why):
         """Soft pull (a 503 from the data path): out of rotation until
@@ -161,6 +239,8 @@ class ReplicaRegistry:
             if rep is not None:
                 rep.ready = False
                 rep.reason = str(why)
+                self._notify("state", {
+                    "id": rep.id, "ready": False, "reason": rep.reason})
 
     def set_draining(self, rid, draining=True):
         with self._lock:
@@ -168,6 +248,8 @@ class ReplicaRegistry:
             if rep is None:
                 return False
             rep.draining = bool(draining)
+            self._notify("state", {"id": rep.id,
+                                   "draining": rep.draining})
             return True
 
     def note_inflight(self, rid, delta):
@@ -181,8 +263,11 @@ class ReplicaRegistry:
     def sweep(self, now=None):
         """Mark replicas with stale heartbeats dead; returns the newly
         dead ids. Called lazily from every routing decision — no
-        background thread needed."""
-        now = time.monotonic() if now is None else now
+        background thread needed. Staleness is measured on the
+        registry's monotonic clock end to end (heartbeat stamps AND
+        ``now``), so a wall-clock/NTP step can neither expire a healthy
+        fleet nor keep a dead one alive."""
+        now = self._clock() if now is None else now
         newly = []
         with self._lock:
             for rep in self._replicas.values():
@@ -195,6 +280,9 @@ class ReplicaRegistry:
                                        "%.1fs)" % (now - rep.last_heartbeat,
                                                    self.heartbeat_timeout_s))
                     newly.append(rep.id)
+                    self._notify("state", {
+                        "id": rep.id, "ready": False, "dead": True,
+                        "dead_reason": rep.dead_reason})
         return newly
 
     # -- queries ------------------------------------------------------------
@@ -245,7 +333,7 @@ class ReplicaRegistry:
         return out
 
     def snapshot(self):
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             reps = [r.snapshot(now) for r in self._replicas.values()]
         reps.sort(key=lambda r: r["id"])
@@ -278,7 +366,15 @@ class ReplicaAnnouncer:
     mode/identity/pid); ``status_fn()`` returns the live part each beat:
     ``{"ready": bool, "reason": str|None, "load": {...}}``. Failures are
     absorbed (a router restart must not kill a healthy replica); an
-    unknown-id heartbeat answer triggers re-registration."""
+    unknown-id heartbeat answer triggers re-registration.
+
+    **Epoch fencing** (router HA): register/heartbeat replies carry the
+    router's fencing epoch; the announcer feeds it to
+    :mod:`mxnet_tpu.fleet.fencing`. A revived stale primary answering
+    "unknown id, re-register" with an epoch below the highest ever
+    observed is *refused* — this replica belongs to the promoted
+    router's fleet now, and adopting the zombie would split-brain the
+    registry (``stale_router_rejections`` counts the refusals)."""
 
     def __init__(self, router_url, info, status_fn, interval_s=None):
         if interval_s is None:
@@ -292,18 +388,32 @@ class ReplicaAnnouncer:
         self._stop = threading.Event()
         self._thread = None
         self.registered = threading.Event()
+        self.stale_router_rejections = 0
+
+    def _observe_epoch(self, out):
+        """Feed a reply's epoch to the fence; False = stale router."""
+        epoch = out.get("epoch")
+        if epoch is None:
+            return True
+        from . import fencing
+        if fencing.observe(epoch):
+            return True
+        self.stale_router_rejections += 1
+        return False
 
     def _register_once(self):
         payload = dict(self.info)
         payload.update(self.status_fn())
-        _post_json(self.router_url + "/fleet/register", payload)
+        out = _post_json(self.router_url + "/fleet/register", payload)
+        self._observe_epoch(out)
         self.registered.set()
 
     def _beat_once(self):
         status = self.status_fn()
         out = _post_json(self.router_url + "/fleet/heartbeat",
                          {"id": self.info["id"], **status})
-        if not out.get("known", True):
+        current = self._observe_epoch(out)
+        if not out.get("known", True) and current:
             self._register_once()
 
     def _loop(self):

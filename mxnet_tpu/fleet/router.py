@@ -31,6 +31,14 @@ client pointed at a replica yesterday points at the router today:
 * ``GET /metrics`` — federation: every live replica's exposition
   merged under ``replica="<id>"`` labels plus the router's own
   ``fleet/*`` series (``telemetry/federate.py``).
+* **HA** (``fleet/journal.py``): with a journal attached, every
+  registry mutation and per-session hop cursor is write-ahead logged;
+  ``Router.from_journal`` rebuilds a crashed primary's state — a warm
+  standby (``tools/route.py --standby``) or supervised restart adopts
+  the orphaned generate sessions at their last cursor and finishes
+  them bitwise. Fencing epochs (``fleet/fencing.py``) ride every
+  forwarded body and control-plane reply so a revived stale primary
+  cannot split-brain the fleet.
 
 Import-light by design (stdlib + config + telemetry): the router never
 runs model code or touches a device — replicas own the accelerators;
@@ -38,6 +46,7 @@ the router holds only cursors, counters, and the registry.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import random
 import threading
@@ -67,7 +76,8 @@ class Router:
     and in-process tests share one code path."""
 
     def __init__(self, registry=None, hop_tokens=None, retry_limit=None,
-                 proxy_timeout_s=None, rng=None):
+                 proxy_timeout_s=None, rng=None, journal=None,
+                 epoch=None):
         self.registry = registry or ReplicaRegistry()
         self.hop_tokens = (flags.fleet_hop_tokens if hop_tokens is None
                            else int(hop_tokens))
@@ -80,6 +90,11 @@ class Router:
         self._lock = threading.Lock()
         self.splits = {}     # model -> {version: weight} (normalized)
         self.canaries = {}   # model -> canary record dict
+        self.journal = None  # FleetJournal once attach_journal() wires it
+        self.epoch = None if epoch is None else int(epoch)
+        self.address = None  # bound URL, once announce() learns it
+        self.replay_stats = None
+        self._sessions = {}  # sid -> journal-backed generate hop cursor
         reg = telemetry.default_registry()
         self._c_requests = reg.counter(
             "fleet/requests", "Requests routed, by kind and outcome.")
@@ -96,6 +111,177 @@ class Router:
             "fleet/canary_rollbacks", "Canaries auto-rolled back.")
         self._g_ready = reg.gauge(
             "fleet/replicas_ready", "Replicas currently in rotation.")
+        self._c_failover = reg.counter(
+            "fleet/failover_count", "Router incarnations that took over "
+            "a non-empty fleet journal (standby promotion or supervised "
+            "restart replay).")
+        self._c_resumed = reg.counter(
+            "fleet/failover_resumed_sessions", "Orphaned generate "
+            "sessions adopted from journaled hop cursors after a "
+            "router failover.")
+        self._g_replay = reg.gauge(
+            "fleet/replay_ms", "Duration of the last fleet journal "
+            "replay (ms).")
+        self._g_epoch = reg.gauge(
+            "fleet/epoch", "This router's fencing epoch.")
+        if journal is not None:
+            self.attach_journal(journal)
+
+    # -- HA: journal + fencing epochs ---------------------------------------
+    def attach_journal(self, journal):
+        """Make this router the journal's primary: registry mutations
+        and session cursors flow into it from now on. Assigns epoch 1
+        for a fresh journal; :meth:`from_journal` passes replayed-max+1
+        via the constructor before calling this."""
+        self.journal = journal
+        if self.epoch is None:
+            self.epoch = 1
+        self._g_epoch.set(self.epoch)
+        self.registry.on_mutation = self._journal_append
+
+    def _journal_append(self, kind, data, sync=False):
+        if self.journal is not None:
+            # registrations and epoch claims are rare and structural:
+            # always durable. Hop cursors ride the group commit.
+            sync = sync or kind in ("register", "deregister", "epoch")
+            self.journal.append(kind, data, sync=sync)
+
+    def announce(self, address):
+        """Journal this incarnation's epoch claim + bound address (the
+        record a standby reads to know where to take over)."""
+        self.address = str(address)
+        if self.epoch is not None:
+            self._journal_append(
+                "epoch", {"epoch": self.epoch, "address": self.address})
+
+    @classmethod
+    def from_journal(cls, journal_dir, registry=None, sync_every=None,
+                     **kw):
+        """Build a router by replaying ``journal_dir``: restores the
+        replica table, splits, canaries, and every in-flight generate
+        session (as adoptable orphans), claims epoch replayed-max+1,
+        and starts appending to a fresh segment. This is both the
+        standby-promotion and the supervised-restart path."""
+        from . import journal as journal_mod
+        t0 = time.monotonic()
+        state, stats = journal_mod.replay(journal_dir)
+        router = cls(registry=registry, epoch=state.epoch + 1, **kw)
+        router._restore_state(state)
+        router.attach_journal(journal_mod.FleetJournal(
+            journal_dir, start_seq=state.applied_seq,
+            sync_every=sync_every))
+        # make the epoch claim durable NOW (fsynced): a revived stale
+        # primary replaying later must see it and stand down. announce()
+        # re-records it with the freshly bound address; until then the
+        # predecessor's address is inherited for tailing standbys.
+        router.address = state.address
+        router._journal_append("epoch", {"epoch": router.epoch,
+                                         "address": router.address})
+        replay_ms = round((time.monotonic() - t0) * 1e3, 3)
+        router._g_replay.set(replay_ms)
+        if state.applied_seq > 0:
+            router._c_failover.inc()
+        router.replay_stats = dict(
+            stats, replay_ms=replay_ms, epoch=router.epoch,
+            replicas=len(state.replicas),
+            resumed_sessions=len(state.sessions))
+        return router
+
+    def _restore_state(self, state):
+        self.registry.restore(state.replicas.values())
+        with self._lock:
+            self.splits = {m: dict(w) for m, w in state.splits.items()}
+            self.canaries = {m: dict(c)
+                             for m, c in state.canaries.items()}
+            # orphan=True: adoptable by the retried client request with
+            # the matching session id — never double-run concurrently
+            self._sessions = {sid: dict(s, orphan=True)
+                              for sid, s in state.sessions.items()}
+
+    def export_state(self):
+        """The current control-plane state as a :class:`FleetState`
+        (what SIGTERM compaction snapshots)."""
+        from .journal import FleetState
+        st = FleetState()
+        st.epoch = self.epoch or 0
+        st.address = self.address
+        if self.journal is not None:
+            st.applied_seq = self.journal.seq
+        st.replicas = {r.id: r.to_info()
+                       for r in self.registry.replicas()}
+        with self._lock:
+            st.splits = {m: dict(w) for m, w in self.splits.items()}
+            st.canaries = {m: dict(c) for m, c in self.canaries.items()}
+            st.sessions = {sid: {k: v for k, v in s.items()
+                                 if k != "orphan"}
+                           for sid, s in self._sessions.items()}
+        return st
+
+    def _stamp_epoch(self, body):
+        if self.epoch is not None:
+            body["fleet_epoch"] = self.epoch
+        return body
+
+    # -- HA: durable generate-session cursors -------------------------------
+    @staticmethod
+    def _session_id(payload):
+        """Stable id for one logical generation. Explicit
+        ``session_id`` wins; otherwise the request parameters hash —
+        so the *identical* retried request a client sends when the
+        primary died before answering maps onto the journaled orphan."""
+        sid = payload.get("session_id")
+        if sid:
+            return str(sid)
+        key = json.dumps(
+            [payload.get("model"), payload.get("version"),
+             [int(t) for t in payload.get("prompt") or []],
+             int(payload.get("max_new_tokens") or 64),
+             payload.get("temperature", 0.0), payload.get("seed", 0)],
+            sort_keys=True)
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def _adopt_session(self, sid):
+        """Claim a journal-replayed orphan for this request thread;
+        returns its cursor dict or None."""
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is not None and s.get("orphan"):
+                s = dict(s, orphan=False)
+                self._sessions[sid] = s
+                return s
+        return None
+
+    def _checkpoint_session(self, sid, payload, tokens, cur_prompt,
+                            remaining):
+        """After every hop that made progress: the newest resume point,
+        in memory and in the journal (group-committed — losing the
+        unsynced tail only means resuming from an older cursor, which
+        position-keyed sampling regenerates bitwise)."""
+        if self.journal is None:
+            return
+        rec = {"sid": sid,
+               "model": payload.get("model"),
+               "prompt": [int(t) for t in payload.get("prompt") or []],
+               "tokens": list(tokens),
+               "resume_prompt": list(cur_prompt),
+               "remaining": int(remaining),
+               "max_new_tokens": int(payload.get("max_new_tokens")
+                                     or 64),
+               "temperature": payload.get("temperature", 0.0),
+               "seed": payload.get("seed", 0)}
+        with self._lock:
+            self._sessions[sid] = dict(rec, orphan=False)
+        self._journal_append("session", rec)
+
+    def _finish_session(self, sid):
+        """The client got a definitive answer (final tokens or a
+        partial WITH its cursor): the router's durable copy is done."""
+        if self.journal is None:
+            return
+        with self._lock:
+            known = self._sessions.pop(sid, None) is not None
+        if known:
+            self._journal_append("session_done", {"sid": sid})
 
     # -- proxy plumbing -----------------------------------------------------
     def _call(self, url, payload, timeout_s):
@@ -195,6 +381,7 @@ class Router:
         version = payload.get("version")
         body = {k: v for k, v in payload.items()
                 if k not in ("model", "version")}
+        self._stamp_epoch(body)
         timeout_s = self.proxy_timeout_s
         if payload.get("timeout_ms"):
             timeout_s = payload["timeout_ms"] / 1e3 + 5.0
@@ -270,6 +457,19 @@ class Router:
         t0 = time.monotonic()
         tokens = []
         cur_prompt = [int(t) for t in prompt]
+        sid = self._session_id(payload)
+        adopted = self._adopt_session(sid)
+        if adopted is not None:
+            # this exact request was in flight when the previous router
+            # incarnation died: resume from its journaled hop cursor
+            # instead of re-running the prefix (either way the tokens
+            # are bitwise-identical; this way they are cheaper)
+            tokens = [int(t) for t in adopted.get("tokens") or []]
+            if adopted.get("resume_prompt"):
+                cur_prompt = [int(t) for t in adopted["resume_prompt"]]
+            if adopted.get("remaining") is not None:
+                remaining = int(adopted["remaining"])
+            self._c_resumed.inc()
         finish = "length"
         owner = None
         owner_version = None
@@ -295,6 +495,9 @@ class Router:
 
         def _partial(status, err, retry_after=0.1):
             self._c_requests.inc(kind="generate", outcome="partial")
+            # the client receives the cursor: durability hands over to
+            # its resubmission, the journal copy would only shadow it
+            self._finish_session(sid)
             return status, {
                 "error": err, "tokens": tokens,
                 "cursor": self._partial_cursor(prompt, tokens, remaining),
@@ -327,6 +530,7 @@ class Router:
                 # (len(cur_prompt) + remaining is invariant across hops
                 # and eviction cursors, so this fires on the first hop)
                 self._c_requests.inc(kind="generate", outcome="error")
+                self._finish_session(sid)
                 return 400, {
                     "error": "fleet: prompt %d + max_new_tokens %d "
                              "exceeds the artifact's max_context %d"
@@ -349,6 +553,7 @@ class Router:
                 n = remaining
             body = {"prompt": cur_prompt, "max_new_tokens": int(n),
                     "temperature": temperature, "seed": seed}
+            self._stamp_epoch(body)
             timeout_s = self.proxy_timeout_s
             if deadline is not None:
                 budget_ms = max(1.0, (deadline - time.monotonic()) * 1e3)
@@ -388,6 +593,9 @@ class Router:
                 if out.get("finish_reason") == "stop":
                     finish = "stop"
                     break
+                if got:
+                    self._checkpoint_session(sid, payload, tokens,
+                                             cur_prompt, remaining)
                 if stalls >= 3:
                     return _partial(429, "fleet: generation stalled "
                                          "(3 empty hops)")
@@ -399,6 +607,9 @@ class Router:
                 tokens.extend(got)
                 remaining -= len(got)
                 cur_prompt = [int(t) for t in out["cursor"]["resume_prompt"]]
+                if got:
+                    self._checkpoint_session(sid, payload, tokens,
+                                             cur_prompt, remaining)
                 _note_spec(out, got)
                 stalls = stalls + 1 if not got else 0
                 if stalls >= 3:
@@ -419,8 +630,10 @@ class Router:
                 continue
             # 400/500/504: definitive — propagate the replica's answer
             self._c_requests.inc(kind="generate", outcome="error")
+            self._finish_session(sid)
             return status, out, {}
         self._c_requests.inc(kind="generate", outcome="ok")
+        self._finish_session(sid)
         lat_ms = (time.monotonic() - t0) * 1e3
         n_gen = len(tokens)
         out = {
@@ -459,11 +672,15 @@ class Router:
         with self._lock:
             self.splits[str(model)] = {v: w / total
                                        for v, w in clean.items()}
+        self._journal_append("split", {"model": str(model),
+                                       "weights": self.splits[str(model)]})
         return dict(self.splits[str(model)])
 
     def clear_split(self, model):
         with self._lock:
             self.splits.pop(str(model), None)
+        self._journal_append("split", {"model": str(model),
+                                       "weights": None})
 
     def promote(self, model, version):
         """Blue/green flip: 100% of ``model`` traffic to ``version``.
@@ -476,6 +693,13 @@ class Router:
             c = self.canaries.get(model)
             if c is not None and c["version"] == version:
                 c["state"] = "promoted"
+            c_rec = ({k: v for k, v in c.items() if k != "deltas"}
+                     if c is not None else None)
+        self._journal_append("split", {"model": model,
+                                       "weights": {version: 1.0}})
+        if c_rec is not None:
+            self._journal_append("canary", {"model": model,
+                                            "record": c_rec})
         return {"model": model, "split": {version: 1.0}}
 
     def start_canary(self, model, version, split=0.1, budget=None):
@@ -508,6 +732,12 @@ class Router:
                 "budget": float(budget), "baseline": baseline,
                 "deltas": [], "state": "active", "reason": None,
             }
+            self._journal_append("split", {"model": model,
+                                           "weights": dict(mixed)})
+            self._journal_append("canary", {
+                "model": model,
+                "record": {k: v for k, v in self.canaries[model].items()
+                           if k != "deltas"}})
             return dict(self.canaries[model], deltas=[])
 
     def report_canary(self, model, delta, version=None):
@@ -543,6 +773,12 @@ class Router:
                                   if v != c["version"]} or c["baseline"]
             canary_version = c["version"]
             budget = c["budget"]
+            self._journal_append("split", {"model": model,
+                                           "weights": self.splits[model]})
+            self._journal_append("canary", {
+                "model": model,
+                "record": {k: v for k, v in c.items()
+                           if k != "deltas"}})
         self._c_rollbacks.inc()
         drained = []
         for rep in self.registry.live_replicas():
@@ -577,10 +813,22 @@ class Router:
             splits = {m: dict(s) for m, s in self.splits.items()}
             canaries = {m: {k: v for k, v in c.items() if k != "deltas"}
                         for m, c in self.canaries.items()}
+            sessions = {
+                "open": sum(1 for s in self._sessions.values()
+                            if not s.get("orphan")),
+                "orphaned": sum(1 for s in self._sessions.values()
+                                if s.get("orphan")),
+            }
         snap = self.registry.snapshot()
         snap["splits"] = splits
         snap["canaries"] = canaries
         snap["models"] = self.registry.models()
+        snap["epoch"] = self.epoch
+        snap["sessions"] = sessions
+        if self.journal is not None:
+            snap["journal"] = self.journal.stats()
+        if self.replay_stats is not None:
+            snap["replay"] = dict(self.replay_stats)
         return snap
 
 
@@ -661,16 +909,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._reply(code, out, headers)
             elif self.path == "/fleet/register":
                 rep = router.registry.register(payload)
-                self._reply(200, {"registered": rep.id})
+                # the epoch rides every control-plane reply (when this
+                # router is journaled): replicas learn the fence
+                # passively and reject stale writers
+                out = {"registered": rep.id}
+                if router.epoch is not None:
+                    out["epoch"] = router.epoch
+                self._reply(200, out)
             elif self.path == "/fleet/heartbeat":
                 known = router.registry.heartbeat(
                     payload.get("id"), ready=payload.get("ready"),
                     reason=payload.get("reason"),
                     load=payload.get("load"))
-                self._reply(200, {"known": known})
+                out = {"known": known}
+                if router.epoch is not None:
+                    out["epoch"] = router.epoch
+                self._reply(200, out)
             elif self.path == "/fleet/deregister":
                 router.registry.deregister(payload.get("id"))
-                self._reply(200, {"deregistered": True})
+                out = {"deregistered": True}
+                if router.epoch is not None:
+                    out["epoch"] = router.epoch
+                self._reply(200, out)
             elif self.path == "/admin/split":
                 split = router.set_split(payload["model"],
                                          payload["weights"])

@@ -208,6 +208,20 @@ class ReplicaSupervisor:
             self._thread.start()
         return self
 
+    def kill(self, replica_id, sig=signal.SIGKILL):
+        """Fault-drill helper: signal a child WITHOUT marking it
+        stopped, so :meth:`poll` observes the death as an event and
+        (budget permitting) restarts it — exactly what an external
+        kill looks like. Returns the signalled pid or None."""
+        child = self._children[replica_id]
+        if child.proc is not None and child.proc.poll() is None:
+            try:
+                child.proc.send_signal(sig)
+                return child.proc.pid
+            except OSError:
+                pass
+        return None
+
     # -- shutdown -----------------------------------------------------------
     def stop(self, replica_id=None, sig=signal.SIGTERM, wait_s=10.0):
         """Signal children (default SIGTERM — replicas drain gracefully)

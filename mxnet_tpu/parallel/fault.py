@@ -26,7 +26,15 @@ _DEFAULT_INTERVAL = 1.0
 _lock = threading.Lock()
 _thread = None
 _stop_evt = None
-_started_at = None
+_started_at = None          # monotonic; see dead_nodes
+# Liveness bookkeeping for dead_nodes: (dir, rank) -> [stat signature,
+# monotonic stamp of the last observed change]. Staleness is judged on
+# the MONOTONIC clock from the moment *this* process last saw the file
+# change — a wall-clock step (NTP slew, manual date set) between polls
+# can no longer mass-kill a healthy fleet. The wall/mtime delta is
+# trusted exactly once, at first sight of a file, so a tracker that
+# starts late still detects an already-stale heartbeat immediately.
+_obs = {}
 
 
 def heartbeat_dir():
@@ -62,7 +70,7 @@ def start(rank, dir_=None, interval=None):
         os.makedirs(dir_, exist_ok=True)
         interval = interval or _interval()
         _stop_evt = threading.Event()
-        _started_at = time.time()
+        _started_at = time.monotonic()
         path = _hb_path(dir_, rank)
 
         def beat(evt=_stop_evt):
@@ -93,28 +101,45 @@ def stop():
         if _stop_evt is not None:
             _stop_evt.set()
         _stop_evt = None
+        _obs.clear()
     if t is not None and t.is_alive():
         t.join(timeout=10.0)
 
 
 def dead_nodes(num_workers, timeout=60.0, dir_=None):
-    """Ranks considered dead: heartbeat file stale by > ``timeout``
-    seconds, or never written although the group has been up longer than
-    ``timeout`` (startup grace period)."""
+    """Ranks considered dead: heartbeat file unchanged for > ``timeout``
+    seconds of MONOTONIC time since this process last saw it change, or
+    never written although the group has been up longer than ``timeout``
+    (startup grace period).  Wall-clock enters the verdict only at first
+    sight of a file (how stale was it when we arrived?); after that a
+    rank stays alive iff its heartbeat keeps changing, so an NTP step or
+    operator ``date`` call between polls cannot mass-kill the fleet."""
     dir_ = dir_ or heartbeat_dir()
     if dir_ is None or not os.path.isdir(dir_):
         return []
-    now = time.time()
-    up_since = _started_at if _started_at is not None else now
+    mono_now = time.monotonic()
+    up_since = _started_at if _started_at is not None else mono_now
     dead = []
     for r in range(num_workers):
         path = _hb_path(dir_, r)
         try:
-            mtime = os.stat(path).st_mtime
+            st = os.stat(path)
         except OSError:
-            if now - up_since > timeout:
+            _obs.pop((dir_, r), None)   # reappearance = fresh sighting
+            if mono_now - up_since > timeout:
                 dead.append(r)
             continue
-        if now - mtime > timeout:
+        sig = (st.st_mtime_ns, st.st_size)
+        rec = _obs.get((dir_, r))
+        if rec is None:
+            # first sighting: trust the wall/mtime delta once, so an
+            # already-stale file is dead immediately (a future mtime —
+            # writer clock ahead of ours — clamps to "fresh")
+            age = max(0.0, time.time() - st.st_mtime)
+            rec = _obs[(dir_, r)] = [sig, mono_now - age]
+        elif rec[0] != sig:
+            rec[0] = sig
+            rec[1] = mono_now
+        if mono_now - rec[1] > timeout:
             dead.append(r)
     return dead

@@ -48,6 +48,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from ..base import MXNetError
+from ..fleet import fencing as _fencing
 from .admission import (DeadlineExceeded, Evicted, ServerBusy,
                         ServerClosed)
 
@@ -66,6 +67,7 @@ def _server_info(srv):
         "ready": srv.ready,
         "reason": srv.not_ready_reason(),
     }
+    info["fleet_epoch"] = _fencing.current()
     if srv.mode == "generate":
         spec = srv.session.spec
         info["generate"] = {
@@ -102,6 +104,22 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _fence(self, payload):
+        """Epoch fence: a request stamped with a ``fleet_epoch`` older
+        than the newest this replica has observed comes from a revived
+        stale router (docs/fleet.md "failover"). 409 it — the client
+        retries against the promoted primary. Unstamped requests (bare
+        serve/ users, no fleet) always pass."""
+        epoch = payload.pop("fleet_epoch", None)
+        if _fencing.observe(epoch):
+            return True
+        self._reply(409, {
+            "error": "stale fleet epoch %r (current %d): request came "
+                     "through a demoted router" % (epoch,
+                                                   _fencing.current()),
+            "fleet_epoch": _fencing.current()})
+        return False
 
     def _reply_raw(self, code, body, content_type):
         data = body.encode("utf-8") if isinstance(body, str) else body
@@ -159,6 +177,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n).decode() or "{}")
+            if not self._fence(payload):
+                return
             inputs = payload.get("inputs")
             if not isinstance(inputs, dict):
                 raise MXNetError('body must be {"inputs": {name: array}}')
@@ -203,6 +223,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n).decode() or "{}")
+            if not self._fence(payload):
+                return
             prompt = payload.get("prompt")
             if not isinstance(prompt, list) or not prompt:
                 raise MXNetError(

@@ -33,6 +33,30 @@ def test_heartbeat_tracker_unit(tmp_path, monkeypatch):
         fault.stop()
 
 
+def test_dead_nodes_survive_wall_clock_step(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_HEARTBEAT_DIR", d)
+    p = os.path.join(d, "hb_0")
+    with open(p, "w") as f:
+        f.write("0 0")
+    try:
+        # first sighting: the wall/mtime delta is trusted once — a
+        # fresh file is alive
+        assert fault.dead_nodes(1, timeout=5.0) == []
+        # a 1000s wall-clock step (NTP slew, operator `date`) between
+        # polls must NOT mass-kill: liveness is monotonic time since
+        # the last OBSERVED change, not wall-vs-mtime
+        real = time.time
+        monkeypatch.setattr(time, "time", lambda: real() + 1000.0)
+        assert fault.dead_nodes(1, timeout=5.0) == []
+        # a genuinely unchanged heartbeat still ages out on the
+        # monotonic clock (rewind the cached observation stamp)
+        fault._obs[(d, 0)][1] -= 6.0
+        assert fault.dead_nodes(1, timeout=5.0) == [0]
+    finally:
+        fault._obs.pop((d, 0), None)
+
+
 def test_heartbeat_no_dir_is_noop(monkeypatch):
     monkeypatch.delenv("MXNET_HEARTBEAT_DIR", raising=False)
     assert not fault.start(0)

@@ -29,6 +29,17 @@ killed decode sessions finish on the survivor via the router's held
 cursor (migrations >= 1), both victims leave parseable flight-recorder
 postmortems, and the supervised predict victim restarts clean and
 re-registers.
+
+``--router-ha`` drills the ROUTER's own death: a journaled primary
+(``tools/route.py --journal``) plus a warm standby over 2 predict + 2
+generate replicas; the primary is SIGKILLed mid-load. PASS iff the
+standby promotes onto the same address from the write-ahead journal,
+all 10 in-flight generate sessions finish with token tails BITWISE
+identical to an uninterrupted reference run, zero in-flight predicts
+are dropped (clients ride the failover with backoff retries), replicas
+409 a write stamped with the dead primary's fencing epoch, and a
+revived old primary refuses startup against the live lease. Runs
+nightly next to ``--fleet``.
 """
 import argparse
 import json
@@ -329,6 +340,291 @@ def fleet_drill(args):
             shutil.rmtree(work, ignore_errors=True)
 
 
+def router_ha_drill(args):
+    """The router-HA leg: primary router (journaled) + warm standby +
+    4 replicas. SIGKILL the primary mid-load; the standby must promote
+    onto the same address, resume every in-flight generate session from
+    its journaled hop cursor (bitwise-identical tokens), ride every
+    in-flight predict through client-side conn retries, and fence out
+    the dead primary's epoch."""
+    import socket
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    sys.path.insert(0, ROOT)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import serve_loadgen
+
+    GEN_SESSIONS = 10
+    PREDICT_REQUESTS = 240
+    MAX_NEW, TEMP = 12, 0.7
+
+    work = tempfile.mkdtemp(prefix="mxtpu_router_ha_drill_")
+    jdir = os.path.join(work, "journal")
+    os.makedirs(jdir, exist_ok=True)
+    ok = False
+    primary = standby = revived = None
+    sup = None
+    try:
+        predict_art = os.path.join(work, "predict.mxtpu")
+        gen_art = os.path.join(work, "generate.mxtpu")
+        print("fault_drill: [router-ha] building artifacts...")
+        spec = _build_fleet_artifacts(predict_art, gen_art)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_FAULT_INJECT", None)
+        env.pop("MXNET_TELEMETRY_DIR", None)
+        env["MXNET_FLEET_HEARTBEAT_S"] = "0.3"
+        env["MXNET_FLEET_HEARTBEAT_TIMEOUT_S"] = "1.5"
+        env["MXNET_FLEET_JOURNAL_SYNC_EVERY"] = "4"
+
+        # both router incarnations must serve the SAME address, so pick
+        # a free port up front instead of --port 0
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        router_url = "http://127.0.0.1:%d" % port
+
+        route_ha = ["--journal", jdir, "--hop-tokens", "4",
+                    "--heartbeat-timeout-s", "1.5",
+                    "--lease-interval-s", "0.25",
+                    "--lease-timeout-s", "1.2"]
+        primary = subprocess.Popen(
+            [sys.executable, ROUTE, "--port", str(port)] + route_ha,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=ROOT)
+        banner = json.loads(primary.stdout.readline())
+        old_epoch = banner["epoch"]
+        print("fault_drill: [router-ha] primary at %s (epoch %d)"
+              % (router_url, old_epoch))
+        standby = subprocess.Popen(
+            [sys.executable, ROUTE, "--standby", "--port", str(port)]
+            + route_ha,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=ROOT)
+        json.loads(standby.stdout.readline())   # standby banner
+
+        from mxnet_tpu.fleet import ReplicaSpec, ReplicaSupervisor
+        sup = ReplicaSupervisor(backoff_base=0.2, backoff_cap=1.0)
+
+        def spec_for(rid, art):
+            argv = [sys.executable, SERVE, "--artifact", art,
+                    "--port", "0", "--register", router_url,
+                    "--replica-id", rid]
+            if art is predict_art:
+                argv += ["--buckets", "1"]
+            return ReplicaSpec(rid, argv, env=dict(env), cwd=ROOT,
+                               max_restarts=0,
+                               log_path=os.path.join(work, rid + ".log"))
+
+        for rid, art in (("p0", predict_art), ("p1", predict_art),
+                         ("g0", gen_art), ("g1", gen_art)):
+            sup.add(spec_for(rid, art))
+        sup.start(interval_s=0.2)
+        print("fault_drill: [router-ha] waiting for 4 ready replicas...")
+        _wait_ready(router_url, 4)
+
+        # reference pass: the 10 sessions uninterrupted. Position-keyed
+        # sampling makes each (prompt, seed) deterministic on any
+        # replica, so these tails are what the failover run must equal.
+        import numpy as np
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(2, spec.vocab, size=4).tolist()
+                   for _ in range(GEN_SESSIONS)]
+        reference = []
+        for i, prompt in enumerate(prompts):
+            outc, out, _, _ = serve_loadgen._http_generate_session(
+                router_url, prompt, MAX_NEW, TEMP, 100 + i, None,
+                retries=4, resume_evicted=5, conn_retries=2)
+            if outc != "ok":
+                print("fault_drill: FAIL — reference session %d did "
+                      "not complete (%s)" % (i, outc))
+                return 1
+            reference.append(list(out["tokens"]))
+
+        # mixed load: predict storm + the same 10 sessions; primary is
+        # SIGKILLed once the phase is demonstrably mid-flight
+        res_p = {}
+        gen_results = [None] * GEN_SESSIONS
+        next_gen = [0]
+        glock = threading.Lock()
+        gen_done = threading.Event()
+
+        def predict_load():
+            # waves, so predicts stay in flight across the whole phase
+            # (kill, outage, promotion) instead of finishing in its
+            # first few hundred milliseconds on a fast machine
+            agg = {"attempted": 0, "completed": 0, "rejected": 0,
+                   "expired": 0, "errors": 0, "failovers_ridden": 0}
+            while True:
+                r = serve_loadgen.measure(
+                    router_url, concurrency=6, requests=60,
+                    retries=4, conn_retries=10, shape=(1, 6))
+                for k in agg:
+                    agg[k] += int(r.get(k) or 0)
+                if gen_done.is_set() and \
+                        agg["attempted"] >= PREDICT_REQUESTS:
+                    break
+            res_p.update(agg)
+
+        def generate_load():
+            while True:
+                with glock:
+                    if next_gen[0] >= GEN_SESSIONS:
+                        return
+                    i = next_gen[0]
+                    next_gen[0] += 1
+                gen_results[i] = serve_loadgen._http_generate_session(
+                    router_url, prompts[i], MAX_NEW, TEMP, 100 + i,
+                    None, retries=6, resume_evicted=5, conn_retries=10)
+
+        gen_threads = [threading.Thread(target=generate_load)
+                       for _ in range(3)]
+        pred_thread = threading.Thread(target=predict_load)
+        t0 = time.monotonic()
+        pred_thread.start()
+        for t in gen_threads:
+            t.start()
+        # kill only once ≥4 sessions have been dispatched (the 3
+        # worker threads then necessarily hold in-flight hops) — a
+        # fixed sleep raced the whole load to completion before the
+        # kill on fast machines
+        while next_gen[0] < 4 and time.monotonic() - t0 < 60:
+            time.sleep(0.01)
+        primary.kill()           # SIGKILL: no drain, no final compact
+        t_kill = time.monotonic()
+        print("fault_drill: [router-ha] primary SIGKILLed at +%.2fs "
+              "(%d sessions dispatched)" % (t_kill - t0, next_gen[0]))
+        for t in gen_threads:
+            t.join(600)
+        gen_done.set()
+        pred_thread.join(600)
+        print("fault_drill: [router-ha] mixed phase took %.1fs"
+              % (time.monotonic() - t0))
+
+        failures = []
+        done = sum(1 for r in gen_results
+                   if r is not None and r[0] == "ok")
+        bitwise = sum(1 for i, r in enumerate(gen_results)
+                      if r is not None and r[0] == "ok"
+                      and list(r[1]["tokens"]) == reference[i])
+        if done != GEN_SESSIONS:
+            failures.append("generate sessions lost across the "
+                            "failover: %d/%d completed"
+                            % (done, GEN_SESSIONS))
+        elif bitwise != GEN_SESSIONS:
+            failures.append("resumed sessions diverged: only %d/%d "
+                            "bitwise-identical to the uninterrupted "
+                            "reference" % (bitwise, GEN_SESSIONS))
+        if not res_p or res_p.get("completed") != res_p.get("attempted") \
+                or (res_p.get("attempted") or 0) < PREDICT_REQUESTS:
+            failures.append("predict dropped in-flight requests: %s"
+                            % {k: res_p.get(k) for k in
+                               ("attempted", "completed", "rejected",
+                                "expired", "errors")})
+        rode = (res_p.get("failovers_ridden") or 0) + \
+            sum(1 for r in gen_results if r is not None and r[3])
+        if rode < 1:
+            failures.append("nothing rode the failover — the kill "
+                            "missed the load window")
+
+        # the standby must have promoted with a bumped fencing epoch
+        # (allow it the lease timeout + replay; the load threads may
+        # have outrun it only marginally)
+        snap, last_err = {}, None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                snap = _fleet_get(router_url, "/fleet")
+                if (snap.get("epoch") or 0) > old_epoch:
+                    break
+            except Exception as e:
+                last_err = e
+            time.sleep(0.25)
+        if not snap:
+            failures.append("no router answering after the kill: %s"
+                            % last_err)
+        new_epoch = snap.get("epoch")
+        if not new_epoch or new_epoch <= old_epoch:
+            failures.append("promoted epoch did not advance (%s -> %s)"
+                            % (old_epoch, new_epoch))
+        if "journal" not in snap or "replay" not in snap:
+            failures.append("promoted router reports no journal/replay "
+                            "stats: %s" % sorted(snap))
+
+        # a write stamped with the dead primary's epoch must be 409'd
+        # by the replicas (the revived-stale-primary proof)
+        ready_predict = [r for r in snap.get("replicas", [])
+                         if r.get("ready") and r.get("mode") == "predict"]
+        if not ready_predict:
+            failures.append("no ready predict replica to fence-test")
+        else:
+            body = json.dumps({
+                "inputs": {"data": [[0.0] * 6]},
+                "fleet_epoch": old_epoch}).encode()
+            req = urllib.request.Request(
+                ready_predict[0]["url"].rstrip("/") + "/v1/predict",
+                data=body, headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10.0):
+                    code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+            if code != 409:
+                failures.append("replica accepted a stale-epoch write "
+                                "(HTTP %d, wanted 409)" % code)
+
+        # a revived old primary must refuse to start while the promoted
+        # router holds the lease (startup guard, exit code 2)
+        revived = subprocess.Popen(
+            [sys.executable, ROUTE, "--port", "0"] + route_ha,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env, cwd=ROOT)
+        try:
+            rc = revived.wait(30)
+        except subprocess.TimeoutExpired:
+            revived.kill()
+            rc = None
+        if rc != 2:
+            failures.append("revived stale primary did not refuse "
+                            "startup (rc=%s, wanted 2)" % rc)
+
+        if failures:
+            for f in failures:
+                print("fault_drill: FAIL — %s" % f)
+            return 1
+        print("fault_drill: [router-ha] PASS — %d/%d sessions bitwise "
+              "across the failover, %d/%d predicts (failovers ridden: "
+              "%d), epoch %s -> %s, stale write 409'd, revived primary "
+              "fenced out (replay: %s)"
+              % (bitwise, GEN_SESSIONS, res_p["completed"],
+                 PREDICT_REQUESTS, rode, old_epoch, new_epoch,
+                 snap.get("replay")))
+        ok = True
+        return 0
+    finally:
+        if sup is not None:
+            sup.stop(wait_s=15.0)
+        for proc in (primary, standby, revived):
+            if proc is None:
+                continue
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if args.keep or not ok:
+            print("fault_drill: scratch kept at %s" % work)
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("-n", "--num-workers", type=int, default=2)
@@ -337,6 +633,10 @@ def main(argv=None):
     ap.add_argument("--fleet", action="store_true",
                     help="run the serving-fleet drill (router + replica "
                          "kills) instead of the training drill")
+    ap.add_argument("--router-ha", action="store_true",
+                    help="run the router-HA drill: SIGKILL the primary "
+                         "router mid-load, the warm standby promotes "
+                         "from the journal, sessions finish bitwise")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch directory for forensics")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -345,6 +645,8 @@ def main(argv=None):
 
     if args.fleet:
         return fleet_drill(args)
+    if args.router_ha:
+        return router_ha_drill(args)
 
     work = tempfile.mkdtemp(prefix="mxtpu_fault_drill_")
     base_dump = os.path.join(work, "baseline.npz")

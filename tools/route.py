@@ -1,6 +1,8 @@
 """Run a fleet router in front of ``tools/serve.py`` replicas.
 
     python tools/route.py --port 8090 [--verbose]
+    python tools/route.py --port 8090 --journal /var/lib/mxtpu/fleet
+    python tools/route.py --standby --journal /var/lib/mxtpu/fleet
 
 Replicas self-register: start each ``tools/serve.py`` with
 ``--register http://127.0.0.1:8090`` and it appears in the rotation as
@@ -9,6 +11,18 @@ configure here). ``--replicas url1,url2`` additionally seeds the
 registry from running non-fleet servers by scraping their ``/info``;
 static seeds send no heartbeats, so they are exempt from the staleness
 sweep and trusted until a proxied request to them fails.
+
+High availability (``--journal DIR``): the router write-ahead logs
+every registry mutation and generate hop cursor into DIR
+(mxnet_tpu/fleet/journal.py) and refreshes a lease file there. A
+second ``route.py --standby --journal DIR`` process tails the journal;
+when the lease content stops changing for ``--lease-timeout-s``
+monotonic seconds it replays the journal, claims the next fencing
+epoch, rebinds the primary's address, and resumes every in-flight
+generate session from its last durable hop cursor. A revived stale
+primary is fenced out twice over: its startup lease guard refuses to
+run while a live holder exists (exit 2 unless ``--force-primary``),
+and replicas 409 any request it stamps with its old epoch.
 
 Endpoints (see mxnet_tpu/fleet/router.py):
     POST /v1/predict             least-loaded over ready replicas
@@ -22,7 +36,9 @@ Endpoints (see mxnet_tpu/fleet/router.py):
     GET  /healthz /readyz /livez
 
 The router never runs model code or touches a device — replicas own
-the accelerators. SIGINT/SIGTERM stops the listener; replicas keep
+the accelerators. SIGINT/SIGTERM stops the listener; with a journal it
+then compacts (fsync + snapshot) so the successor replays O(snapshot),
+releases the lease, and dumps the final fleet snapshot. Replicas keep
 serving and re-register with the next router incarnation on their own.
 """
 from __future__ import annotations
@@ -31,8 +47,10 @@ import argparse
 import json
 import os
 import signal
+import socket
 import sys
 import threading
+import urllib.parse
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -65,6 +83,93 @@ def _seed_static(router, urls):
     return seeded
 
 
+def _lease_loop(router, jdir, interval_s, compact_every, stop_evt):
+    """Primary-side lease heartbeat + journal auto-compaction. The
+    lease payload changes every beat (the counter), so the standby's
+    content-change monitor keeps seeing progress without either side
+    comparing wall clocks."""
+    from mxnet_tpu.fleet.journal import write_lease
+    beat = 0
+    while not stop_evt.is_set():
+        beat += 1
+        try:
+            write_lease(jdir, {"epoch": router.epoch, "pid": os.getpid(),
+                               "url": router.address, "beat": beat})
+        except OSError as e:
+            print("route: lease write failed: %s" % e, file=sys.stderr)
+        jr = router.journal
+        if (jr is not None and compact_every > 0
+                and jr.records_since_compact >= compact_every):
+            try:
+                jr.compact(router.export_state())
+            except OSError as e:
+                print("route: compaction failed: %s" % e, file=sys.stderr)
+        stop_evt.wait(interval_s)
+
+
+def _build_router(args, jdir):
+    from mxnet_tpu.fleet import ReplicaRegistry, Router
+    registry = ReplicaRegistry(
+        heartbeat_timeout_s=args.heartbeat_timeout_s)
+    if jdir is None:
+        return Router(registry=registry, hop_tokens=args.hop_tokens)
+    return Router.from_journal(jdir, registry=registry,
+                               hop_tokens=args.hop_tokens)
+
+
+def _standby_wait(args, jdir, lease_timeout_s, poll_s, done):
+    """Tail the journal until the primary's lease goes stale, then
+    promote: full re-replay (the tailer is only a warm cache — the
+    replay is what fixes the true durable seq), epoch bump, rebind.
+    Returns (router, front) or (None, None) if interrupted."""
+    from mxnet_tpu.fleet import route_http
+    from mxnet_tpu.fleet.journal import JournalTailer, LeaseMonitor
+    tailer = JournalTailer(jdir)
+    monitor = LeaseMonitor(jdir)
+    print(json.dumps({"standby": True, "journal": jdir,
+                      "lease_timeout_s": lease_timeout_s}), flush=True)
+    while not done.is_set():
+        tailer.poll()
+        if monitor.expired(lease_timeout_s):
+            # where to take over: the address the dead primary
+            # journaled (replicas + clients point there); CLI fallback
+            addr = tailer.state.address
+            if addr:
+                u = urllib.parse.urlsplit(addr)
+                host, port = u.hostname or args.host, u.port or args.port
+            else:
+                host, port = args.host, args.port
+            # cheap probe before paying a replay: a wedged-but-alive
+            # primary still owns the socket — connect succeeds, so
+            # keep waiting instead of replaying once per poll
+            try:
+                socket.create_connection((host, port), 0.25).close()
+                done.wait(poll_s)
+                continue
+            except OSError:
+                pass        # nothing listening — take over
+            router = _build_router(args, jdir)
+            try:
+                front = route_http(router, host, port,
+                                   verbose=args.verbose)
+            except OSError as e:
+                # EADDRINUSE: the primary's socket is still bound —
+                # it may merely be wedged, not dead. Keep waiting.
+                print("route: standby cannot bind %s:%d (%s); waiting"
+                      % (host, port, e), file=sys.stderr)
+                router.journal.close()
+                done.wait(poll_s)
+                continue
+            router.announce(front.address)
+            print(json.dumps({"promoted": True, "epoch": router.epoch,
+                              "url": front.address,
+                              "replay": router.replay_stats}),
+                  flush=True)
+            return router, front
+        done.wait(poll_s)
+    return None, None
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
@@ -81,23 +186,40 @@ def main():
                    help="seconds without a heartbeat before a replica "
                         "is declared dead "
                         "(default MXNET_FLEET_HEARTBEAT_TIMEOUT_S)")
+    p.add_argument("--journal", default=None, metavar="DIR",
+                   help="write-ahead journal directory: replay on "
+                        "start, log every mutation, refresh a lease "
+                        "(enables HA; docs/fleet.md)")
+    p.add_argument("--standby", action="store_true",
+                   help="warm standby: tail --journal and promote when "
+                        "the primary's lease expires")
+    p.add_argument("--lease-interval-s", type=float, default=None,
+                   help="primary lease refresh period "
+                        "(default MXNET_FLEET_LEASE_INTERVAL_S)")
+    p.add_argument("--lease-timeout-s", type=float, default=None,
+                   help="standby promotion threshold "
+                        "(default MXNET_FLEET_LEASE_TIMEOUT_S)")
+    p.add_argument("--force-primary", action="store_true",
+                   help="skip the live-lease startup guard (operator "
+                        "override after verifying the old primary is "
+                        "really gone)")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
-    from mxnet_tpu.fleet import ReplicaRegistry, Router, route_http
+    from mxnet_tpu.config import flags
+    from mxnet_tpu.fleet import route_http
+    from mxnet_tpu.fleet.journal import (lease_holder_alive,
+                                         release_lease)
 
-    registry = ReplicaRegistry(heartbeat_timeout_s=args.heartbeat_timeout_s)
-    router = Router(registry=registry, hop_tokens=args.hop_tokens)
-    seeded = []
-    if args.replicas:
-        seeded = _seed_static(
-            router, [u for u in args.replicas.split(",") if u.strip()])
-    front = route_http(router, args.host, args.port, verbose=args.verbose)
-    banner = {"routing": True, "url": front.address,
-              "replicas": seeded,
-              "hop_tokens": router.hop_tokens,
-              "heartbeat_timeout_s": registry.heartbeat_timeout_s}
-    print(json.dumps(banner), flush=True)
+    jdir = args.journal
+    if args.standby and jdir is None:
+        p.error("--standby requires --journal DIR")
+    lease_interval_s = (args.lease_interval_s
+                        if args.lease_interval_s is not None
+                        else flags.fleet_lease_interval_s)
+    lease_timeout_s = (args.lease_timeout_s
+                       if args.lease_timeout_s is not None
+                       else flags.fleet_lease_timeout_s)
 
     done = threading.Event()
 
@@ -106,8 +228,66 @@ def main():
 
     signal.signal(signal.SIGINT, _shutdown)
     signal.signal(signal.SIGTERM, _shutdown)
+
+    if args.standby:
+        router, front = _standby_wait(args, jdir, lease_timeout_s,
+                                      flags.fleet_standby_poll_s, done)
+        if router is None:       # interrupted while still standby
+            return
+        seeded = []
+    else:
+        if jdir is not None and not args.force_primary and \
+                lease_holder_alive(jdir, wait_s=1.5 * lease_interval_s):
+            print(json.dumps({
+                "error": "journal %r has a live lease holder — another "
+                         "primary is running (use --force-primary to "
+                         "override)" % jdir}), flush=True)
+            sys.exit(2)
+        router = _build_router(args, jdir)
+        front = route_http(router, args.host, args.port,
+                           verbose=args.verbose)
+        router.announce(front.address)
+        seeded = []
+        if args.replicas:
+            seeded = _seed_static(
+                router, [u for u in args.replicas.split(",")
+                         if u.strip()])
+        banner = {"routing": True, "url": front.address,
+                  "replicas": seeded,
+                  "hop_tokens": router.hop_tokens,
+                  "heartbeat_timeout_s":
+                      router.registry.heartbeat_timeout_s}
+        if jdir is not None:
+            banner["journal"] = jdir
+            banner["epoch"] = router.epoch
+            banner["replay"] = router.replay_stats
+        print(json.dumps(banner), flush=True)
+
+    lease_stop = threading.Event()
+    lease_thread = None
+    if jdir is not None:
+        lease_thread = threading.Thread(
+            target=_lease_loop,
+            args=(router, jdir, lease_interval_s,
+                  flags.fleet_journal_compact_every, lease_stop),
+            name="mxtpu-route-lease", daemon=True)
+        lease_thread.start()
+
     done.wait()
     front.stop()
+    if lease_thread is not None:
+        lease_stop.set()
+        lease_thread.join(5.0)
+    if router.journal is not None:
+        # successor replays O(snapshot): fsync the tail, then snapshot
+        # + truncate via checkpoint.py's temp+fsync+rename
+        try:
+            router.journal.compact(router.export_state())
+        except OSError as e:
+            print("route: final compaction failed: %s" % e,
+                  file=sys.stderr)
+        router.journal.close()
+        release_lease(jdir)
     print(json.dumps(router.fleet_snapshot()), flush=True)
 
 

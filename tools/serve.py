@@ -16,7 +16,11 @@ readiness + a perfmodel-derived load summary every
 ``MXNET_FLEET_HEARTBEAT_S``, and deregisters before draining so the
 router migrates traffic with zero drops. Registration implies
 ``--warm-async``: the listener comes up immediately and the replica
-reports not-ready ("warming") until engine compiles finish.
+reports not-ready ("warming") until engine compiles finish. A replica
+also tracks the fleet's fencing epoch (router replies + request
+stamps): requests carrying an older epoch are 409'd and the announcer
+refuses to re-register with a demoted router — how a revived stale
+primary is kept from split-braining an HA fleet (docs/fleet.md).
 
 Endpoints (see mxnet_tpu/serve/http.py):
     POST /v1/predict   {"inputs": {"data": [[...]]}}     (predict mode)
@@ -187,7 +191,13 @@ def main():
         # while this process finishes what it already admitted
         announcer.stop(deregister=True)
     front.stop(drain=True)
-    print(json.dumps(server.metrics()), flush=True)
+    final = server.metrics()
+    if announcer is not None:
+        from mxnet_tpu.fleet import fencing
+        final["fleet_epoch"] = fencing.current()
+        final["stale_router_rejections"] = \
+            announcer.stale_router_rejections
+    print(json.dumps(final), flush=True)
 
 
 if __name__ == "__main__":

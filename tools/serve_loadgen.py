@@ -89,12 +89,17 @@ def _http_call(url, payload, timeout_s):
         if e.code == 503:
             return "closed", None, None
         return "error", None, None
+    except (urllib.error.URLError, ConnectionError, OSError):
+        # connection-level (refused/reset/unreachable): the far end is
+        # between incarnations — retryable for idempotent requests
+        return "conn", None, None
     except Exception:
         return "error", None, None
 
 
 def measure(target, concurrency=8, requests=256, qps=None, rows=1,
-            timeout_ms=None, shape=None, retries=0, seed=0, dtype=None):
+            timeout_ms=None, shape=None, retries=0, seed=0, dtype=None,
+            conn_retries=0):
     """Run the closed loop; returns the result dict (see module doc).
 
     ``retries``: how many times a rejected (429/ServerBusy) or
@@ -106,6 +111,13 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
     ``dtype``: route every request to that engine family of a
     multi-dtype server ("int8" for the quantized engines); None serves
     the primary model. Local-server mode only.
+
+    ``conn_retries``: HTTP mode — how many times a connection-level
+    failure (refused/reset: the router is between incarnations during
+    an HA failover) is retried with the fleet's capped jittered
+    backoff before counting as an error. Predict is idempotent, so
+    riding a failover is safe; the report counts requests that saw a
+    connection failure and still completed as ``failovers_ridden``.
     """
     import numpy as np
 
@@ -136,11 +148,13 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
     counters = {"completed": 0, "rejected": 0, "expired": 0, "errors": 0}
     latencies = []
     per_replica = {}     # replica id -> completed count (router mode)
+    failovers_ridden = [0]   # saw a conn failure, still completed
     lock = threading.Lock()
     next_idx = [0]
     pace = (concurrency / qps) if qps else 0.0   # per-worker inter-arrival
 
     def worker(wid):
+        from mxnet_tpu.fleet.supervisor import backoff_delay
         from mxnet_tpu.serve import (DeadlineExceeded, ServerBusy,
                                      ServerClosed)
         while True:
@@ -152,22 +166,34 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
             feed = feeds[i % len(feeds)]
             t0 = time.monotonic()
             outcome, body = "error", None
-            for attempt in range(retries + 1):
-                if is_url:
-                    payload = {"inputs": {n: v.tolist()
-                                          for n, v in feed.items()}}
-                    if timeout_ms:
-                        payload["timeout_ms"] = timeout_ms
-                    outcome, retry_after, body = _http_call(
-                        target, payload,
-                        timeout_s=(timeout_ms or 30000) / 1e3 + 5)
-                    if outcome == "ok":
-                        break
-                    if outcome in ("rejected", "closed") \
-                            and attempt < retries:
-                        time.sleep(retry_after or 0.05)
-                        continue
+            rode_conn = False
+            admit_attempt = conn_attempt = 0
+            while is_url:
+                payload = {"inputs": {n: v.tolist()
+                                      for n, v in feed.items()}}
+                if timeout_ms:
+                    payload["timeout_ms"] = timeout_ms
+                outcome, retry_after, body = _http_call(
+                    target, payload,
+                    timeout_s=(timeout_ms or 30000) / 1e3 + 5)
+                if outcome == "ok":
                     break
+                if outcome == "conn" and conn_attempt < conn_retries:
+                    # router mid-failover: back off (jittered — a
+                    # thundering herd on the fresh primary helps no
+                    # one) and resubmit the idempotent request
+                    rode_conn = True
+                    time.sleep(backoff_delay(conn_attempt, base=0.25,
+                                             cap=2.0))
+                    conn_attempt += 1
+                    continue
+                if outcome in ("rejected", "closed") \
+                        and admit_attempt < retries:
+                    admit_attempt += 1
+                    time.sleep(retry_after or 0.05)
+                    continue
+                break
+            for attempt in range(0 if is_url else retries + 1):
                 try:
                     req = get_server().submit(timeout_ms=timeout_ms,
                                               dtype=dtype, **feed)
@@ -198,6 +224,8 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
                 if outcome == "ok":
                     counters["completed"] += 1
                     latencies.append(dt_ms)
+                    if rode_conn:
+                        failovers_ridden[0] += 1
                     rid = (body or {}).get("replica")
                     if rid:
                         per_replica[rid] = per_replica.get(rid, 0) + 1
@@ -247,6 +275,8 @@ def measure(target, concurrency=8, requests=256, qps=None, rows=1,
         },
         "histogram": {"edges_ms": _HIST_EDGES_MS, "counts": hist},
     }
+    if is_url:
+        out["failovers_ridden"] = failovers_ridden[0]
     if per_replica:
         out["per_replica"] = dict(sorted(per_replica.items()))
     if not is_url and get_server is not None:
@@ -379,26 +409,35 @@ def _http_generate(url, payload, timeout_s):
         if e.code == 503:
             return "closed", None, retry
         return "error", None, None
+    except (urllib.error.URLError, ConnectionError, OSError):
+        return "conn", None, None
     except Exception:
         return "error", None, None
 
 
 def _http_generate_session(url, prompt, budget, temperature, seed,
-                           timeout_ms, retries, resume_evicted):
+                           timeout_ms, retries, resume_evicted,
+                           conn_retries=0):
     """One logical generation over HTTP: admission-reject retries plus
     bounded cursor resubmission. An eviction's partial tokens are
     banked and the session continues from ``cursor["resume_prompt"]``
     (same seed — position-keyed sampling keeps the tail identical to an
-    uninterrupted run). Returns (outcome, merged out dict, resumes)."""
+    uninterrupted run). A connection-level failure (the router is
+    between incarnations mid-failover) is retried with the fleet's
+    jittered backoff; the resubmitted request hashes to the same
+    session id on the promoted router, which adopts the journaled hop
+    cursor — so the tokens still come back bitwise-identical. Returns
+    (outcome, merged out dict, resumes, rode_failover)."""
     tokens = []
     cur_prompt = list(prompt)
     remaining = int(budget)
-    resumes = rejects = 0
+    resumes = rejects = conn_attempt = 0
+    rode = False
     out = None
     while True:
         if remaining <= 0:
             return "ok", {"tokens": tokens, "finish_reason": "length"}, \
-                resumes
+                resumes, rode
         payload = {"prompt": cur_prompt, "max_new_tokens": remaining,
                    "temperature": temperature, "seed": seed}
         if timeout_ms:
@@ -408,7 +447,15 @@ def _http_generate_session(url, prompt, budget, temperature, seed,
         if outcome == "ok":
             out = dict(out or {})
             out["tokens"] = tokens + list(out.get("tokens") or [])
-            return "ok", out, resumes
+            return "ok", out, resumes, rode
+        if outcome == "conn":
+            if conn_attempt >= conn_retries:
+                return "error", out, resumes, rode
+            from mxnet_tpu.fleet.supervisor import backoff_delay
+            rode = True
+            time.sleep(backoff_delay(conn_attempt, base=0.25, cap=2.0))
+            conn_attempt += 1
+            continue
         if outcome == "evicted":
             got = list((out or {}).get("tokens") or [])
             cursor = (out or {}).get("cursor") or {}
@@ -416,7 +463,7 @@ def _http_generate_session(url, prompt, budget, temperature, seed,
                     or not cursor.get("resume_prompt"):
                 out = dict(out or {})
                 out["tokens"] = tokens + got
-                return "evicted", out, resumes
+                return "evicted", out, resumes, rode
             tokens += got
             cur_prompt = list(cursor["resume_prompt"])
             remaining = int(cursor.get("remaining_tokens")
@@ -428,7 +475,7 @@ def _http_generate_session(url, prompt, budget, temperature, seed,
             rejects += 1
             time.sleep(retry_after or 0.05)
             continue
-        return outcome, out, resumes
+        return outcome, out, resumes, rode
 
 
 def measure_generate(target, users=4, requests=64, prompt_len=8,
@@ -436,7 +483,7 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
                      output_dist="longtail", temperature=0.0,
                      timeout_ms=None, retries=0, seed=0, vocab=None,
                      max_prompt_len=None, max_context=None,
-                     resume_evicted=0):
+                     resume_evicted=0, conn_retries=0):
     """Closed-loop generation benchmark: ``users`` workers, each
     submitting its next prompt the moment the previous completion lands.
     Prompt/output lengths are drawn per-request from the configured
@@ -455,6 +502,11 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
     hint. Banked partial tokens count toward the session either way;
     with resumes the session completes across replicas instead of
     surfacing the eviction to the caller.
+
+    ``conn_retries``: HTTP mode — connection-level retry budget per
+    request (router failover riding; see :func:`measure`). Sessions
+    that saw a connection failure and still completed are reported as
+    ``failovers_ridden``.
     """
     import numpy as np
 
@@ -500,6 +552,7 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
     spec_agg = {"w": 0, "atps": 0.0, "rate": 0.0}   # token-weighted
     migrations_total = [0]    # router-reported mid-session owner moves
     resumed_sessions = [0]    # sessions completed via cursor resubmit
+    failovers_ridden = [0]    # sessions that rode a router failover
     migrated = {"tokens": 0, "wall_s": 0.0}   # post-migration goodput
     lock = threading.Lock()
     next_idx = [0]
@@ -514,13 +567,15 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
                 i = next_idx[0]
                 next_idx[0] += 1
             t0 = time.monotonic()
-            outcome, out, resumes = "error", None, 0
+            outcome, out, resumes, rode = "error", None, 0, False
             for attempt in range(retries + 1):
                 if is_url:
-                    outcome, out, resumes = _http_generate_session(
-                        target, prompts[i], int(olens[i]), temperature,
-                        int(seed + i), timeout_ms, retries,
-                        resume_evicted)
+                    outcome, out, resumes, rode = \
+                        _http_generate_session(
+                            target, prompts[i], int(olens[i]),
+                            temperature, int(seed + i), timeout_ms,
+                            retries, resume_evicted,
+                            conn_retries=conn_retries)
                     break
                 try:
                     out = session.generate(
@@ -577,6 +632,8 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
                     migrations_total[0] += mig
                     if resumes:
                         resumed_sessions[0] += 1
+                    if rode:
+                        failovers_ridden[0] += 1
                     if mig or resumes:
                         # sessions that crossed replicas: their goodput
                         # is the ~1/N-degradation evidence
@@ -635,6 +692,7 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
     if is_url:
         out["migrations"] = migrations_total[0]
         out["resumed_sessions"] = resumed_sessions[0]
+        out["failovers_ridden"] = failovers_ridden[0]
         out["post_migration_tokens_per_s"] = (
             round(migrated["tokens"] / migrated["wall_s"], 2)
             if migrated["wall_s"] > 0 else None)
@@ -673,6 +731,11 @@ def main():
                    help="--generate over HTTP: max cursor resubmissions "
                         "per session after a 429-with-cursor (default 2 "
                         "in --router mode, 0 against a bare replica)")
+    p.add_argument("--conn-retries", type=int, default=None,
+                   help="HTTP mode: connection-refused/reset retry "
+                        "budget per request with capped jittered "
+                        "backoff — rides a router HA failover (default "
+                        "6 in --router mode, 0 against a bare replica)")
     p.add_argument("--buckets", default=None)
     p.add_argument("--generate", action="store_true",
                    help="generation workload (generate-mode artifact / "
@@ -718,6 +781,9 @@ def main():
     resume_evicted = args.resume_evicted
     if resume_evicted is None:
         resume_evicted = 2 if args.router else 0
+    conn_retries = args.conn_retries
+    if conn_retries is None:
+        conn_retries = 6 if args.router else 0
 
     if args.platform == "cpu":
         import jax
@@ -769,12 +835,12 @@ def main():
             retries=args.retries, seed=args.seed, vocab=args.vocab,
             max_prompt_len=args.max_prompt_len,
             max_context=args.max_context,
-            resume_evicted=resume_evicted)
+            resume_evicted=resume_evicted, conn_retries=conn_retries)
     else:
         res = measure(target, concurrency=args.concurrency,
                       requests=args.requests, qps=args.qps, rows=args.rows,
                       timeout_ms=args.timeout_ms, shape=shape,
-                      retries=args.retries)
+                      retries=args.retries, conn_retries=conn_retries)
     if not url:
         target.close(drain=True)
     if args.scrape_metrics:
