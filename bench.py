@@ -150,6 +150,117 @@ def _secondary_legs(out, on_tpu):
             out["decode"] = _decode_leg(on_tpu)
         except Exception as e:
             out["decode"] = "failed: %s" % e
+    # recommender leg: two-tower step time over the hot-row cache, the
+    # sparse-vs-densified DDP comm ratio, and /v1/recommend goodput on
+    # Zipf traffic (BENCH_RECO=0 skips)
+    if os.environ.get("BENCH_RECO", "1") == "1":
+        try:
+            out["recommend"] = _reco_leg(on_tpu)
+        except Exception as e:
+            out["recommend"] = "failed: %s" % e
+
+
+def _reco_leg(on_tpu):
+    """The PR-15 embedding subsystem end to end: train a pure-embedding
+    two-tower model through the hot-row cache + spill store, report the
+    per-step time and cache counters, the STATIC sparse-vs-densified
+    gradient-exchange ratio (parallel/ddp.py sparse bucket kind — the
+    >=10x headline), then export the towers as a format_version-6
+    artifact and drive ``/v1/recommend`` with the Zipf closed loop.
+    Runs the MXL511 chip-free gate over the served lookup."""
+    import tempfile
+    from functools import partial as _partial
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.embed import HotRowCache, SpillStore
+    from mxnet_tpu.embed.serve import export_recommend
+    from mxnet_tpu.parallel.ddp import SparseBucket
+    from mxnet_tpu.serve import Server
+    from tools.serve_loadgen import measure_recommend
+
+    if on_tpu:
+        U, I, D, B, steps, cap = 65536, 4096, 64, 512, 30, 8192
+    else:
+        U, I, D, B, steps, cap = 2048, 1024, 16, 128, 12, 384
+    rng = np.random.RandomState(0)
+    u_ids = ((rng.zipf(1.3, size=(steps, B)) - 1) % U).astype("int64")
+    i_ids = rng.randint(0, I, size=(steps, B)).astype("int64")
+    ratings = rng.randn(steps, B).astype("f4")
+    lr = np.float32(0.1)
+
+    store_u = SpillStore(U, D, seed=1)
+    store_i = SpillStore(I, D, seed=2)
+    cache_u = HotRowCache(store_u, cap)
+    cache_i = HotRowCache(store_i, min(cap, I))
+
+    @_partial(jax.jit, donate_argnums=(0, 1))
+    def step(u_buf, i_buf, us, isl, r):
+        uv, iv = u_buf[us], i_buf[isl]
+        err = (uv * iv).sum(-1) - r
+        d = (2.0 / r.shape[0]) * err
+        gu = jnp.zeros_like(u_buf).at[us].add(d[:, None] * iv)
+        gi = jnp.zeros_like(i_buf).at[isl].add(d[:, None] * uv)
+        return u_buf - lr * gu, i_buf - lr * gi, (err ** 2).sum()
+
+    # warm (compile + first fills), then time
+    us, isl = cache_u.ensure(u_ids[0]), cache_i.ensure(i_ids[0])
+    cache_u.buf, cache_i.buf, L = step(cache_u.buf, cache_i.buf, us,
+                                       isl, jnp.asarray(ratings[0]))
+    jax.block_until_ready(L)
+    t0 = time.perf_counter()
+    for s in range(1, steps):
+        us, isl = cache_u.ensure(u_ids[s]), cache_i.ensure(i_ids[s])
+        cache_u.buf, cache_i.buf, L = step(
+            cache_u.buf, cache_i.buf, us, isl, jnp.asarray(ratings[s]))
+        cache_u.note_updated(u_ids[s])
+        cache_i.note_updated(i_ids[s])
+    jax.block_until_ready(L)
+    step_ms = (time.perf_counter() - t0) * 1e3 / (steps - 1)
+
+    # static sparse-DDP exchange plan at a 4-rank mesh: what one step
+    # moves coalesced (touched rows) vs densified (the whole table)
+    ranks = 4
+    plan = [SparseBucket("user", B // ranks, D, U),
+            SparseBucket("item", B // ranks, D, I)]
+    sparse_b = sum(sb.comm_bytes(ranks) for sb in plan)
+    dense_b = sum(sb.densified_bytes() for sb in plan)
+
+    cache_u.flush()
+    cache_i.flush()
+    art = tempfile.mktemp(suffix=".reco.mxtpu")
+    export_recommend(store_u.peek(np.arange(U)),
+                     store_i.peek(np.arange(I)), art,
+                     max_ids=64, k=10)
+    try:
+        srv = Server(art, queue_depth=64)
+        load = measure_recommend(
+            srv, concurrency=8 if on_tpu else 4,
+            requests=256 if on_tpu else 64, mean_ids=8, zipf=1.3)
+        diags = srv.engine.check_discipline()
+        srv.close(drain=True)
+    finally:
+        try:
+            os.unlink(art)
+        except OSError:
+            pass
+    return {
+        "platform": "tpu" if on_tpu else "cpu_smoke",
+        "table": "%dx%d + %dx%d" % (U, D, I, D),
+        "cache_rows": cap,
+        "train_step_ms": round(step_ms, 3),
+        "train_cache": {k: cache_u.stats()[k] for k in
+                        ("hit_rate", "evictions", "spill_bytes",
+                         "upload_bytes")},
+        "sparse_comm_bytes": sparse_b,
+        "densified_comm_bytes": dense_b,
+        "sparse_compression": round(dense_b / float(sparse_b), 1),
+        "recommend_goodput_qps": load["goodput_qps"],
+        "recommend_p50_ms": load["latency_ms"]["p50"],
+        "recommend_p99_ms": load["latency_ms"]["p99"],
+        "serve_cache_hit_rate": load.get("cache_hit_rate"),
+        "mxl511": "clean" if not diags else [str(d) for d in diags],
+    }
 
 
 def _decode_leg(on_tpu):

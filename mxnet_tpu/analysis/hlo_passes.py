@@ -25,7 +25,8 @@ __all__ = [
     "d2h_transfer_pass", "fusion_bytes_pass", "RecompileFingerprint",
     "collective_interleave_pass", "collective_overlap_report",
     "decode_cache_discipline_pass", "quant_dequant_budget_pass",
-    "speculative_dispatch_pass", "metrics_from_text",
+    "speculative_dispatch_pass", "embedding_lookup_discipline_pass",
+    "metrics_from_text",
 ]
 
 HLO_RULES = {r.id: r for r in [
@@ -74,6 +75,14 @@ HLO_RULES = {r.id: r for r in [
          "caches — an undonated draft cache is copied every window, "
          "doubling the dual-cache HBM cost (see docs/serving.md "
          "speculative decoding)"),
+    Rule("MXL511", "hlo-embedding-lookup-discipline", "error",
+         "the served embedding lookup must update the hot-row cache "
+         "buffer IN PLACE (donate it to the jit — an undonated cache "
+         "is copied per request batch, doubling device memory for the "
+         "resident rows) and contain zero device->host ops: hit/miss/"
+         "spill accounting lives on HOST (HotRowCache counters) and "
+         "the only fetch is the top-k result, outside the program "
+         "(see docs/embeddings.md serving discipline)"),
     Rule("MXL507", "hlo-collective-interleave", "error",
          "the DDP step's gradient all-reduces must stay few (one fused "
          "collective per bucket — more means the GradReducer plan "
@@ -232,6 +241,52 @@ def decode_cache_discipline_pass(text, label, cache_params,
             "MXL508", label,
             "%d host-transfer op(s) inside the decode step (budget %d) "
             "— every one is a device sync per generated token"
+            % (n, d2h_budget)))
+    return diags
+
+
+def embedding_lookup_discipline_pass(text, label, cache_params=(0,),
+                                     d2h_budget=0):
+    """MXL511: the recommend leg's served-lookup discipline.
+
+    ``cache_params`` names the entry-parameter indices holding the
+    hot-row cache buffer (RecommendEngine donates argnum 0). The pass
+    fails when any of those buffers lacks a donation attr
+    (``jax.buffer_donor`` / ``tf.aliasing_output``) — an undonated
+    cache is copied on every request batch — or when the program
+    contains more than ``d2h_budget`` host-transfer ops: cache
+    hit/miss/spill accounting is host-held (zero extra d2h per step),
+    and the single top-k fetch happens outside the compiled program.
+    Chip-free like every Layer-2 pass: lower under JAX_PLATFORMS=cpu
+    and hand the text in."""
+    params = hlo_stats.entry_params(text)
+    diags = []
+    if not params:
+        return [_diag("MXL511", label,
+                      "no entry computation found — cannot verify "
+                      "hot-row cache donation on an empty module")]
+    missing = []
+    for idx in cache_params:
+        if idx >= len(params):
+            missing.append("arg%d (out of range, %d params)"
+                           % (idx, len(params)))
+        elif not params[idx]["donated"]:
+            p = params[idx]
+            missing.append("%s (%s, %.1f MiB)"
+                           % (p["name"], p["dtype"], p["bytes"] / 2**20))
+    if missing:
+        diags.append(_diag(
+            "MXL511", label,
+            "hot-row cache buffer(s) not donated — the served lookup "
+            "copies the resident rows every batch: %s"
+            % ", ".join(missing)))
+    n = d2h_count(text)
+    if n > d2h_budget:
+        diags.append(_diag(
+            "MXL511", label,
+            "%d host-transfer op(s) inside the served lookup (budget "
+            "%d) — hit/miss/spill accounting must stay host-held and "
+            "the top-k fetch happens outside the program"
             % (n, d2h_budget)))
     return diags
 

@@ -368,6 +368,27 @@ register_flag("serve_decode_window", "MXNET_SERVE_DECODE_WINDOW", int, 16,
               "kv_page_occupancy, active_slots and eviction counts every "
               "this many decode steps — all from host-held scheduler "
               "state, zero extra device->host transfers.")
+register_flag("embed_cache_rows", "MXNET_EMBED_CACHE_ROWS", int, 1024,
+              "Device-resident hot-row capacity of the embedding cache "
+              "(embed/cache.py): the served/trained table keeps this "
+              "many rows on device and spills the cold tail to the host "
+              "store. Size it above the per-step working set; the "
+              "embed/cache_hit_rate gauge tells you when it is too "
+              "small (docs/embeddings.md cache sizing).")
+register_flag("embed_host_budget_mb", "MXNET_EMBED_HOST_BUDGET_MB",
+              float, 0.0,
+              "Host-memory budget (MiB) for the embedding spill store. "
+              "0 (default) = unbounded. When set, the store raises "
+              "instead of silently growing past it — the logical table "
+              "may exceed this budget only as long as the TOUCHED cold "
+              "tail stays inside it.")
+register_flag("serve_max_gathers", "MXNET_SERVE_MAX_GATHERS", int, 65536,
+              "Admission cap for the /v1/recommend queue in pending "
+              "GATHER units (one unit = one embedding row fetched). "
+              "Recommend requests are ragged — two requests in the same "
+              "batch bucket can differ 100x in rows touched — so the "
+              "queue bills and rejects on gather counts, not request "
+              "counts (serve/admission.py + perfmodel).")
 register_flag("quant_accuracy_budget", "MXNET_QUANT_ACCURACY_BUDGET",
               float, 0.005,
               "Per-bucket accuracy-delta budget for int8 serving: the "

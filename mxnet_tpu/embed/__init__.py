@@ -1,0 +1,25 @@
+"""Sharded embedding subsystem: mesh-partitioned tables, hot-row
+device cache with host spill, and the recommend serving leg.
+
+The recommender workload (ROADMAP item 4) is defined by three
+asymmetries the dense training stack has no answer for: tables bigger
+than one chip's HBM (row-shard them across the ``dp``/``tp`` mesh —
+:mod:`.table`), hot-key skew (keep the hot rows device-resident and
+spill the cold tail to host — :mod:`.cache`), and gradients touching a
+few thousand of millions of rows (exchange contributions, not tables —
+the sparse bucket kind in :mod:`mxnet_tpu.parallel.ddp`). The serving
+half (:mod:`.serve`) packages a trained two-tower retrieval head as a
+format_version-6 ``.mxtpu`` artifact whose user table is *not* baked
+into the program: it streams through the hot-row cache, which is what
+``/v1/recommend`` (serve/http.py) runs and mxlint MXL511 disciplines.
+
+docs/embeddings.md is the user guide.
+"""
+from __future__ import annotations
+
+from .table import (ShardedEmbedding, sharded_lookup, local_gather,
+                    row_init)
+from .cache import HotRowCache, SpillStore
+
+__all__ = ["ShardedEmbedding", "sharded_lookup", "local_gather",
+           "row_init", "HotRowCache", "SpillStore"]

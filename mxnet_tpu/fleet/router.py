@@ -375,8 +375,12 @@ class Router:
         # `served` tie-breaks into round-robin on a cold fleet
         return min(cands, key=lambda r: (r.score(), r.served, r.id))
 
-    # -- predict path -------------------------------------------------------
-    def route_predict(self, payload):
+    # -- simple proxy paths (predict, recommend) ----------------------------
+    def _route_simple(self, payload, mode, path):
+        """Single-shot proxy with least-loaded pick + failover retry:
+        the shared shape of every request/response leg whose state
+        lives entirely in one replica call (predict rows, recommend
+        id-lists — unlike generate, which hop-chunks a cursor)."""
         model = payload.get("model")
         version = payload.get("version")
         body = {k: v for k, v in payload.items()
@@ -389,23 +393,23 @@ class Router:
         last = None
         for attempt in range(self.retry_limit + 1):
             try:
-                rep = self._pick(model, version, "predict", exclude=tried)
+                rep = self._pick(model, version, mode, exclude=tried)
             except NoReplica as e:
                 if last is not None:
-                    self._c_requests.inc(kind="predict", outcome="rejected")
+                    self._c_requests.inc(kind=mode, outcome="rejected")
                     return last
-                self._c_requests.inc(kind="predict", outcome="no_replica")
+                self._c_requests.inc(kind=mode, outcome="no_replica")
                 return 503, {"error": str(e)}, {}
             tried.add(rep.id)
             if attempt > 0:
-                self._c_retries.inc(kind="predict")
+                self._c_retries.inc(kind=mode)
             self.registry.note_inflight(rep.id, +1)
             try:
                 status, out, headers = self._call(
-                    rep.url + "/v1/predict", body, timeout_s)
+                    rep.url + path, body, timeout_s)
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 self.registry.mark_dead(
-                    rep.id, "predict proxy failed: %s" % e)
+                    rep.id, "%s proxy failed: %s" % (mode, e))
                 self._c_deaths.inc()
                 continue
             finally:
@@ -413,7 +417,7 @@ class Router:
             if status == 200:
                 out["replica"] = rep.id
                 out["version"] = rep.version
-                self._c_requests.inc(kind="predict", outcome="ok")
+                self._c_requests.inc(kind=mode, outcome="ok")
                 return 200, out, {}
             if status in (429, 503):
                 # busy/draining: remember the hint, try the next-best
@@ -425,11 +429,22 @@ class Router:
                 last = (status, out, extra)
                 continue
             # 400/500/504: the replica answered definitively
-            self._c_requests.inc(kind="predict", outcome="error")
+            self._c_requests.inc(kind=mode, outcome="error")
             return status, out, {}
-        self._c_requests.inc(kind="predict", outcome="rejected")
+        self._c_requests.inc(kind=mode, outcome="rejected")
         return last or (503, {"error": "fleet: every replica rejected "
                                        "this request"}, {})
+
+    def route_predict(self, payload):
+        return self._route_simple(payload, "predict", "/v1/predict")
+
+    def route_recommend(self, payload):
+        """Recommend requests are ragged and billed in gather units by
+        the replica's admission queue; the router needs no new policy —
+        least-loaded already scores the heartbeat ``load_s`` that
+        recommend replicas derive from pending gathers x per-gather
+        roofline."""
+        return self._route_simple(payload, "recommend", "/v1/recommend")
 
     # -- generate path ------------------------------------------------------
     def _partial_cursor(self, prompt, tokens, remaining):
@@ -907,6 +922,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
             elif self.path in ("/v1/generate", "/generate"):
                 code, out, headers = router.route_generate(payload)
                 self._reply(code, out, headers)
+            elif self.path in ("/v1/recommend", "/recommend"):
+                code, out, headers = router.route_recommend(payload)
+                self._reply(code, out, headers)
             elif self.path == "/fleet/register":
                 rep = router.registry.register(payload)
                 # the epoch rides every control-plane reply (when this
@@ -985,7 +1003,10 @@ class RouterHTTPFrontEnd:
         return self
 
     def stop(self):
-        self.httpd.shutdown()
+        # shutdown() blocks forever unless serve_forever is running, so a
+        # never-started front end only needs its listen socket closed.
+        if self._thread is not None:
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(5.0)

@@ -156,14 +156,19 @@ def gather_pages(table, idx, *, interpret=None):
     ``table`` is one layer's flat page store ``(rows, dim)``; ``idx`` is
     the block-table expansion ``(max_slots, max_context)`` of flat row
     ids (serve/decode_model.py). Same numerics contract as
-    ``jnp.take(table, idx, axis=0)`` — the scalar-prefetch kernel is
-    bit-identical to it, so the bitwise-parity guarantee of the decode
-    engine is tier-independent. Falls back to ``jnp.take`` whenever the
-    tier is off or the guard declines (non-lane-aligned dim, dtype)."""
+    ``jnp.take(table, idx, axis=0, mode="clip")`` — the scalar-prefetch
+    kernel pre-clips its ids, so the fallback must clip too (jnp.take's
+    default "fill" mode would turn an out-of-range id into NaN rows on
+    the fallback path only, a tier-dependent numerics split; the
+    embedding OOB parity test in tests/test_embed.py pins this). The
+    kernel is bit-identical to the clipped take, so the bitwise-parity
+    guarantee of the decode engine is tier-independent. Falls back to
+    ``jnp.take`` whenever the tier is off or the guard declines
+    (non-lane-aligned dim, dtype)."""
     reason = eligible(table.shape, table.dtype, idx.shape, idx.dtype)
     go, cfg = tier.should_dispatch(
         OP_NAME, shape_key_shapes(table.shape, idx.shape), table.dtype,
         guard_reason=reason)
     if go:
         return take_rows(table, idx, config=cfg, interpret=interpret)
-    return jnp.take(table, idx.astype(jnp.int32), axis=0)
+    return jnp.take(table, idx.astype(jnp.int32), axis=0, mode="clip")

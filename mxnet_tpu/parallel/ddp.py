@@ -40,9 +40,9 @@ import numpy as _np
 from .. import perfmodel as _perfmodel
 from ..config import flags
 
-__all__ = ["Bucket", "GradReducer", "enabled", "choose_bucket_bytes",
-           "partition_buckets", "process_mesh", "estimate_overlap_ms",
-           "to_global", "from_global"]
+__all__ = ["Bucket", "SparseBucket", "GradReducer", "enabled",
+           "choose_bucket_bytes", "partition_buckets", "process_mesh",
+           "estimate_overlap_ms", "to_global", "from_global"]
 
 # A collective launch costs ~_LAUNCH_OVERHEAD_S on the host/ICI; size each
 # bucket so that cost stays below _LAUNCH_FRACTION of its transfer time.
@@ -97,6 +97,73 @@ class Bucket:
             len(self.keys), self.dtype.name, self.nbytes)
 
 
+class SparseBucket:
+    """One embedding gradient's sparse exchange plan.
+
+    The dense path would all-reduce the full ``(rows, dim)`` gradient —
+    almost entirely zeros when one step touches a few hundred of
+    millions of rows. The sparse kind exchanges CONTRIBUTIONS instead:
+    each rank all-gathers its ``(ids, values)`` pair (``length`` batch
+    positions, duplicates included) and every rank coalesces the global
+    set locally with a stable-sorted-id scatter-add. Comm volume is
+    ``axis_size * length * (4 + dim*itemsize)`` vs ``rows*dim*itemsize``
+    densified — orders of magnitude on real tables (the
+    gradient-compression slot of PAPER.md capability 5).
+
+    Determinism is the point, not a side effect: all_gather concatenates
+    in rank order and the sort is STABLE, so each row's contributions
+    fold in (rank, batch-position) order — bitwise-identical to the
+    left fold a 1-rank dense VJP scatter-add performs over the same
+    global batch. tests/test_embed.py pins both properties (>=10x bytes
+    and bitwise-equal updates vs the 1-rank oracle)."""
+
+    __slots__ = ("key", "length", "dim", "rows", "dtype")
+
+    def __init__(self, key, length, dim, rows, dtype="float32"):
+        self.key = key
+        self.length = int(length)   # per-rank contribution count
+        self.dim = int(dim)
+        self.rows = int(rows)       # dense rows the grad densifies to
+        self.dtype = _np.dtype(dtype)
+
+    def comm_bytes(self, axis_size):
+        """Gathered volume per device: ids (int32) + values."""
+        return (self.length * axis_size
+                * (4 + self.dim * self.dtype.itemsize))
+
+    def densified_bytes(self):
+        """What the dense bucket path would move for this grad."""
+        return self.rows * self.dim * self.dtype.itemsize
+
+    def __repr__(self):
+        return ("SparseBucket(%r, L=%d, dim=%d, rows=%d)"
+                % (self.key, self.length, self.dim, self.rows))
+
+
+def coalesce_sparse_grad(ids, values, rows, axis_name=None):
+    """Reduce one sparse gradient to its dense ``(rows, dim)`` form.
+
+    ``ids``/``values`` are this rank's raw per-position contributions
+    (any leading shape; flattened here). With ``axis_name`` (inside
+    shard_map) the contributions are first all-gathered in rank order;
+    the coalesce is then a stable sort by id + scatter-add — the
+    sorted-id reduction order that makes the result independent of
+    sharding, bit for bit. Traced, differentiable-free (gradient of a
+    gradient is out of scope)."""
+    import jax
+    import jax.numpy as jnp
+    dim = values.shape[-1]
+    ids = ids.astype(jnp.int32).reshape(-1)
+    values = values.reshape(-1, dim)
+    if axis_name is not None:
+        ids = jax.lax.all_gather(ids, axis_name, tiled=True)
+        values = jax.lax.all_gather(values, axis_name, tiled=True)
+    ids = jnp.clip(ids, 0, rows - 1)
+    order = jnp.argsort(ids, stable=True)
+    return (jnp.zeros((rows, dim), values.dtype)
+            .at[ids[order]].add(values[order]))
+
+
 def partition_buckets(entries, bucket_bytes=None, reverse=True):
     """Partition ``(key, shape, dtype)`` entries into size-bounded,
     dtype-homogeneous buckets.
@@ -139,7 +206,7 @@ class GradReducer:
     """
 
     def __init__(self, entries, axis_name=None, bucket_bytes=None,
-                 axis_size=None, device_kind=None):
+                 axis_size=None, device_kind=None, sparse=None):
         self.axis_name = axis_name or flags.ddp_axis
         self.bucket_bytes = int(
             bucket_bytes or choose_bucket_bytes(device_kind))
@@ -147,14 +214,41 @@ class GradReducer:
         self.comm_bytes = sum(b.nbytes for b in self.buckets)
         self.axis_size = axis_size
         self._device_kind = device_kind
+        # sparse bucket kind: {key: SparseBucket} — these keys travel as
+        # (ids, values) contribution pairs, never as dense tensors
+        self.sparse = {}
+        for sb in (sparse or ()):
+            if not isinstance(sb, SparseBucket):
+                sb = SparseBucket(*sb)
+            self.sparse[sb.key] = sb
+        self.sparse_comm_bytes = sum(
+            sb.comm_bytes(self.axis_size or 1)
+            for sb in self.sparse.values())
+        self.sparse_densified_bytes = sum(
+            sb.densified_bytes() for sb in self.sparse.values())
 
     def reduce(self, grads):
         """Sum a ``{name: grad}`` dict over ``axis_name``, one fused psum
         per bucket, in reverse-production order. Traced; returns a dict
-        with the same keys."""
+        with the same keys.
+
+        Keys registered as sparse carry ``(ids, values)`` contribution
+        pairs instead of dense arrays; they are exchanged with
+        all_gather and coalesced in sorted-id order
+        (:func:`coalesce_sparse_grad`) — the returned dict holds their
+        DENSE ``(rows, dim)`` form, so optimizers downstream are
+        oblivious to how the grad traveled."""
         import jax
         import jax.numpy as jnp
         out = {}
+        for key, sb in self.sparse.items():
+            if key not in grads:
+                continue
+            ids, values = grads[key]
+            out[key] = coalesce_sparse_grad(
+                ids, values, sb.rows,
+                axis_name=self.axis_name if (self.axis_size or 1) > 1
+                else None)
         for b in self.buckets:
             if len(b.keys) == 1:
                 k = b.keys[0]
@@ -171,13 +265,22 @@ class GradReducer:
     def stats(self):
         """Host-held summary for telemetry/bench (zero device syncs)."""
         sizes = [b.nbytes for b in self.buckets]
-        return {
+        out = {
             "buckets": len(self.buckets),
             "bucket_bytes": sizes,
             "comm_bytes": self.comm_bytes,
             "overlap_ms": estimate_overlap_ms(
                 sizes, self.axis_size or 1, self._device_kind),
         }
+        if self.sparse:
+            out["sparse_buckets"] = len(self.sparse)
+            out["sparse_comm_bytes"] = self.sparse_comm_bytes
+            out["sparse_densified_bytes"] = self.sparse_densified_bytes
+            if self.sparse_comm_bytes:
+                out["sparse_compression"] = round(
+                    self.sparse_densified_bytes
+                    / self.sparse_comm_bytes, 3)
+        return out
 
 
 def estimate_overlap_ms(bucket_nbytes, axis_size, device_kind=None):

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 __all__ = ["PEAK_FLOPS", "HBM_GBPS", "ICI_GBPS", "peak_flops",
            "hbm_bytes_per_s", "interconnect_bytes_per_s", "mfu",
-           "roofline_seconds",
+           "roofline_seconds", "recommend_request_seconds",
            "RESNET50_TRAIN_FLOPS_PER_IMG", "DEFAULT_DEVICE_KIND"]
 
 # fwd+bwd ~= 3x fwd MACs * 2 flops/MAC (ResNet-50 @ 224: 4.089 GMACs fwd)
@@ -85,3 +85,21 @@ def roofline_seconds(flops: float, bytes_moved: float,
     bytes_moved = max(0.0, float(bytes_moved))
     return max(flops / peak_flops(device_kind),
                bytes_moved / hbm_bytes_per_s(device_kind))
+
+
+def recommend_request_seconds(gathers: int, dim: int, corpus_rows: int,
+                              dtype_bytes: int = 4,
+                              device_kind: str = DEFAULT_DEVICE_KIND
+                              ) -> float:
+    """Roofline floor for ONE recommend request, charged by its GATHER
+    count — the unit the `/v1/recommend` admission queue bills in
+    (serve/admission.py), because two requests in the same batch bucket
+    can differ 100x in embedding rows touched. Two terms through the
+    same capability tables everything else uses: the lookup's HBM
+    traffic (each gathered row is a random-access ``dim`` stripe read)
+    and the corpus scoring matmul (``2 * corpus_rows * dim`` flops per
+    request)."""
+    gathers = max(1, int(gathers))
+    lookup_bytes = gathers * int(dim) * int(dtype_bytes)
+    score_flops = 2.0 * int(corpus_rows) * int(dim)
+    return roofline_seconds(score_flops, lookup_bytes, device_kind)

@@ -64,15 +64,20 @@ class Request:
     """
 
     __slots__ = ("arrays", "rows", "deadline", "dtype", "t_submit",
-                 "bucket", "_event", "_result", "_error")
+                 "bucket", "units", "_event", "_result", "_error")
 
-    def __init__(self, arrays, rows, deadline=None, dtype=None):
+    def __init__(self, arrays, rows, deadline=None, dtype=None, units=None):
         self.arrays = arrays          # tuple of device arrays, one/input
         self.rows = rows
         self.deadline = deadline      # absolute time.monotonic(), or None
         self.dtype = dtype            # engine dtype route ("f32"/"int8");
         self.t_submit = time.monotonic()  # None -> server primary
         self.bucket = None
+        # admission cost units. Predict bills per row (units == rows);
+        # recommend bills per GATHER — the rows of a ragged request say
+        # nothing about the embedding rows it touches, and the queue's
+        # unit cap + retry-after must charge the real device work
+        self.units = int(rows if units is None else units)
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -111,8 +116,13 @@ class AdmissionQueue:
     max-batch/max-latency coalescing policy.
     """
 
-    def __init__(self, depth, retry_after_fn=None):
+    def __init__(self, depth, retry_after_fn=None, max_units=None):
         self.depth = int(depth)
+        # optional COST cap alongside the count cap: pending admission
+        # units (predict: rows; recommend: gathers) may not exceed
+        # max_units — a queue of 10 requests can hide 100x the device
+        # work of another queue of 10, and the cap must see that
+        self.max_units = None if max_units is None else int(max_units)
         self._retry_after_fn = retry_after_fn
         self._q = []
         self._cond = threading.Condition()
@@ -130,23 +140,40 @@ class AdmissionQueue:
         with self._cond:
             return sum(r.rows for r in self._q)
 
+    def pending_units(self):
+        with self._cond:
+            return sum(r.units for r in self._q)
+
+    def _retry_hint(self):
+        retry = 0.05
+        if self._retry_after_fn is not None:
+            try:
+                retry = max(0.001, float(self._retry_after_fn(self)))
+            except Exception:
+                pass
+        return retry
+
     def submit(self, req):
         with self._cond:
             if self._closed:
                 raise ServerClosed(
                     "serve: server is shut down; no new requests")
             if self.depth > 0 and len(self._q) >= self.depth:
-                retry = 0.05
-                if self._retry_after_fn is not None:
-                    try:
-                        retry = max(0.001,
-                                    float(self._retry_after_fn(self)))
-                    except Exception:
-                        pass
+                retry = self._retry_hint()
                 raise ServerBusy(
                     "serve: admission queue full (%d queued, depth %d); "
                     "retry after %.3fs" % (len(self._q), self.depth,
                                            retry),
+                    retry_after=retry)
+            if (self.max_units is not None
+                    and sum(r.units for r in self._q) + req.units
+                    > self.max_units):
+                retry = self._retry_hint()
+                raise ServerBusy(
+                    "serve: admission cost cap hit (%d pending + %d "
+                    "requested > %d units); retry after %.3fs"
+                    % (sum(r.units for r in self._q), req.units,
+                       self.max_units, retry),
                     retry_after=retry)
             self._q.append(req)
             self._cond.notify()

@@ -16,6 +16,12 @@ Protocol:
                      as 429 with the partial tokens, a resumable
                      "cursor" whose resume_prompt continues the
                      generation on resubmit, and a Retry-After hint)
+  POST /v1/recommend {"ids": [history ids], "k": opt, "timeout_ms": opt}
+                  -> {"items": [...], "scores": [...], "latency_ms": f,
+                      "gathers": n}
+                     (recommend-mode servers only; admission bills the
+                     request's GATHER count — a 429 here means the
+                     pending gather units hit MXNET_SERVE_MAX_GATHERS)
   GET  /metrics      -> the Server.metrics() snapshot (JSON, default) or
                         the Prometheus text exposition of the run-wide
                         telemetry registry when the client asks for it
@@ -81,6 +87,17 @@ def _server_info(srv):
         }
         if srv.session.speculative:
             info["generate"]["speculate_k"] = srv.session.speculate_k
+    elif srv.mode == "recommend":
+        eng = srv.engine
+        info["recommend"] = {
+            "rows": eng.rows,
+            "dim": eng.dim,
+            "items": eng.items,
+            "max_ids": eng.max_ids,
+            "k": eng.k,
+            "cache_capacity": eng.cache.capacity,
+        }
+        info["buckets"] = list(srv.buckets)
     else:
         info["inputs"] = srv.model.meta["inputs"]
         info["buckets"] = list(srv.buckets)
@@ -171,6 +188,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path in ("/v1/generate", "/generate"):
             self._do_generate(srv)
             return
+        if self.path in ("/v1/recommend", "/recommend"):
+            self._do_recommend(srv)
+            return
         if self.path not in ("/v1/predict", "/predict"):
             self._reply(404, {"error": "no such endpoint %r" % self.path})
             return
@@ -218,6 +238,56 @@ class _Handler(BaseHTTPRequestHandler):
                           "latency_ms": round(
                               (time.monotonic() - req.t_submit) * 1e3, 3),
                           "bucket": req.bucket})
+
+    def _do_recommend(self, srv):
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n).decode() or "{}")
+            if not self._fence(payload):
+                return
+            ids = payload.get("ids")
+            if not isinstance(ids, list) or not ids:
+                raise MXNetError(
+                    'body must be {"ids": [history ids], ...}')
+            req = srv.submit_recommend(
+                ids, timeout_ms=payload.get("timeout_ms"))
+        except ServerBusy as e:
+            self._reply(429, {"error": str(e),
+                              "retry_after_s": e.retry_after},
+                        {"Retry-After": "%.3f" % e.retry_after})
+            return
+        except ServerClosed as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except (MXNetError, ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        import time
+        try:
+            budget = (None if req.deadline is None
+                      else max(0.001, req.deadline - time.monotonic())
+                      + 1.0)
+            scores, items = req.result(timeout=budget)
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except ServerClosed as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except MXNetError as e:
+            self._reply(500, {"error": str(e)})
+            return
+        # a request-level k smaller than the engine's compiled k is a
+        # host-side slice of the already-fetched top-k
+        k = payload.get("k")
+        if isinstance(k, int) and 0 < k < len(items):
+            scores, items = scores[:k], items[:k]
+        self._reply(200, {
+            "items": [int(i) for i in items],
+            "scores": [float(s) for s in scores],
+            "latency_ms": round(
+                (time.monotonic() - req.t_submit) * 1e3, 3),
+            "gathers": req.units})
 
     def _do_generate(self, srv):
         try:
@@ -298,7 +368,10 @@ class HttpFrontEnd:
     def stop(self, drain=True):
         """Stop accepting connections, then gracefully drain the model
         server (every admitted request finishes)."""
-        self.httpd.shutdown()
+        # shutdown() blocks forever unless serve_forever is running, so a
+        # never-started front end only needs its listen socket closed.
+        if self._thread is not None:
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(5.0)

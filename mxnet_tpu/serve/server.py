@@ -41,6 +41,7 @@ from .. import profiler
 from ..serving import CompiledModel, GenerateModel, load_artifact
 from .admission import (AdmissionQueue, DeadlineExceeded, Request,
                         ServerClosed)
+from ..embed.serve import RecommendEngine, RecommendModel
 from .decode import GenerateConfig, GenerateSession
 from .engine_cache import check_buckets, pick_bucket
 from .metrics import ServeMetrics
@@ -80,8 +81,16 @@ class Server:
 
     def __init__(self, model, config=None, auto_start=True, quantized=None,
                  draft=None, **overrides):
-        if not isinstance(model, (CompiledModel, GenerateModel)):
+        if not isinstance(model, (CompiledModel, GenerateModel,
+                                  RecommendModel, RecommendEngine)):
             model = load_artifact(model)
+        if isinstance(model, (RecommendModel, RecommendEngine)):
+            if quantized is not None or draft is not None:
+                raise MXNetError(
+                    "Server: quantized=/draft= do not apply to "
+                    "recommend artifacts")
+            self._init_recommend(model, config, auto_start, overrides)
+            return
         if isinstance(model, GenerateModel):
             if quantized is not None:
                 raise MXNetError("Server: quantized= is a predict-mode "
@@ -163,6 +172,41 @@ class Server:
         if auto_start:
             self.start()
 
+    def _init_recommend(self, model, config, auto_start, overrides):
+        """Recommend mode: the micro-batcher machinery (queue, window,
+        drain, metrics) is shared with predict, but requests are ragged
+        id lists billed in GATHER units and dispatch runs the embed
+        subsystem's cache-backed engine instead of an AOT executable."""
+        self.mode = "recommend"
+        self.session = None
+        self._warming = False
+        self._warm_thread = None
+        if config is None:
+            config = ServeConfig(**overrides)
+        elif overrides:
+            raise MXNetError("Server: pass either config or kwargs, "
+                             "not both")
+        if isinstance(model, RecommendModel):
+            model = model.engine()
+        self.engine = model
+        self.model = model.model
+        self.config = config
+        self.buckets = model.buckets
+        self.metrics_ = ServeMetrics()
+        # the queue bills gathers, not requests: retry-after is pending
+        # gather units times the per-gather roofline, and the cost cap
+        # (MXNET_SERVE_MAX_GATHERS) rejects on the same unit
+        self._queue = AdmissionQueue(
+            config.queue_depth,
+            retry_after_fn=lambda q: (q.pending_units()
+                                      * self.engine.gather_unit_s()),
+            max_units=flags.serve_max_gathers)
+        self._thread = None
+        self._closing = False
+        self._closed = threading.Event()
+        if auto_start:
+            self.start()
+
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         if self.mode == "generate":
@@ -194,6 +238,9 @@ class Server:
                         self.session.warmup()
                     finally:
                         self.session.start()
+                elif self.mode == "recommend":
+                    self.start()
+                    self.engine.warm()
                 else:
                     self.start()   # batcher can queue while we compile
                     self._cache.warmup = True
@@ -294,9 +341,12 @@ class Server:
     # -- request path -------------------------------------------------------
     def _require_mode(self, mode, what):
         if self.mode != mode:
-            other = ("submit_generate()/generate() or POST /v1/generate"
-                     if self.mode == "generate"
-                     else "submit()/predict() or POST /v1/predict")
+            other = {
+                "generate": "submit_generate()/generate() or "
+                            "POST /v1/generate",
+                "recommend": "submit_recommend()/recommend() or "
+                             "POST /v1/recommend",
+            }.get(self.mode, "submit()/predict() or POST /v1/predict")
             raise MXNetError(
                 "Server.%s: this server holds a %s artifact; use %s"
                 % (what, self.mode, other))
@@ -381,6 +431,39 @@ class Server:
                   else max(0.001, req.deadline - time.monotonic()) + 1.0)
         return req.result(timeout=budget)
 
+    def submit_recommend(self, ids, timeout_ms=None):
+        """Admit one recommend request (ragged id list); never blocks.
+        The request is billed in GATHER units — ``len(ids)`` after the
+        engine's ``max_ids`` truncation — so the admission cost cap
+        (``MXNET_SERVE_MAX_GATHERS``) and the retry-after hint charge
+        the device work a ragged request really costs."""
+        self._require_mode("recommend", "submit_recommend")
+        arr = _np.asarray(list(ids), dtype=_np.int64).reshape(-1)
+        gathers = max(1, min(arr.size, self.engine.max_ids))
+        if timeout_ms is None:
+            timeout_ms = self.config.timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms and timeout_ms > 0 else None)
+        req = Request((arr,), 1, deadline, units=gathers)
+        try:
+            self._queue.submit(req)
+        except ServerClosed:
+            raise
+        except Exception:
+            self.metrics_.note_reject()
+            raise
+        self.metrics_.note_submit(1)
+        self.metrics_.set_queue_depth(self._queue.pending_count())
+        return req
+
+    def recommend(self, ids, timeout_ms=None):
+        """Blocking convenience: submit_recommend + result. Returns
+        (scores, item_ids) host arrays of length ``k``."""
+        req = self.submit_recommend(ids, timeout_ms=timeout_ms)
+        budget = (None if req.deadline is None
+                  else max(0.001, req.deadline - time.monotonic()) + 1.0)
+        return req.result(timeout=budget)
+
     # -- batcher ------------------------------------------------------------
     def run_once(self, block=True):
         """One coalescing round: take a window's worth of requests, drop
@@ -408,6 +491,9 @@ class Server:
             else:
                 live.append(r)
         if not live:
+            return len(reqs)
+        if self.mode == "recommend":
+            self._dispatch_recommend(live)
             return len(reqs)
         # one padded device batch PER DTYPE GROUP (f32 and int8 requests
         # coexist in a window but run on different engines); each group
@@ -459,6 +545,31 @@ class Server:
             off += r.rows
             self.metrics_.note_request_done(
                 bucket, (t_done - r.t_submit) * 1e3, dtype=dtype)
+
+    def _dispatch_recommend(self, live):
+        rows = len(live)
+        bucket = pick_bucket(self.buckets, rows)
+        try:
+            faultinject.fire("serve", op="recommend_batch", bucket=bucket)
+            t0 = time.perf_counter()
+            # the engine does the plan/upload, ONE device dispatch, and
+            # ONE d2h (+ record_host_sync) for the whole batch
+            scores, items = self.engine.recommend_batch(
+                [r.arrays[0] for r in live], bucket=bucket)
+            exec_ms = (time.perf_counter() - t0) * 1e3
+        except Exception as e:
+            self.metrics_.note_error(len(live))
+            err = e if isinstance(e, MXNetError) else MXNetError(str(e))
+            for r in live:
+                r._fail(err)
+            return
+        self.metrics_.note_batch(bucket, rows, bucket - rows, exec_ms)
+        t_done = time.monotonic()
+        for j, r in enumerate(live):
+            r.bucket = bucket
+            r._complete((scores[j], items[j]))
+            self.metrics_.note_request_done(
+                bucket, (t_done - r.t_submit) * 1e3)
 
     def _loop(self):
         while True:
@@ -515,6 +626,15 @@ class Server:
                                 / max(1, sess.spec.max_slots), 9),
                 "queue_depth": len(sess._pending),
             }
+        elif self.mode == "recommend":
+            # billed in gather units: load_s = pending gathers x the
+            # per-gather roofline (see RecommendEngine.gather_unit_s)
+            unit = self.engine.gather_unit_s()
+            load = {
+                "load_s": round(self._queue.pending_units() * unit, 6),
+                "unit_s": round(unit, 9),
+                "queue_depth": self._queue.pending_count(),
+            }
         else:
             pending = self._queue.pending_count()
             unit = self.estimate_row_s()
@@ -534,6 +654,16 @@ class Server:
         if self.mode == "generate":
             snap = self.session.metrics()
             snap["mode"] = "generate"
+            snap["ready"] = self.ready
+            snap["not_ready_reason"] = self.not_ready_reason()
+            return snap
+        if self.mode == "recommend":
+            snap = self.metrics_.snapshot()
+            snap["mode"] = "recommend"
+            snap["embed"] = self.engine.stats()
+            snap["buckets_configured"] = list(self.buckets)
+            snap["status"] = ("closed" if self.closed
+                              else "draining" if self.draining else "ok")
             snap["ready"] = self.ready
             snap["not_ready_reason"] = self.not_ready_reason()
             return snap

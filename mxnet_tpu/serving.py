@@ -70,6 +70,11 @@ _FORMAT_DISPATCH = {
     # a v5 artifact WITHOUT the draft modules is a plain chunk-capable
     # engine — the speculative path degrades gracefully, never the load.
     5: ("generate", "GenerateModel"),
+    # 6 = recommend: a two-tower retrieval head whose user table ships
+    # as DATA (npz payload), not as a baked program constant — the
+    # serving engine streams it through the embed/ hot-row cache
+    # (embed/serve.py export_recommend / RecommendModel).
+    6: ("recommend", "RecommendModel"),
 }
 
 
@@ -668,9 +673,13 @@ def load_artifact(path, **kw):
     """Open any ``.mxtpu`` artifact through the format-version dispatch
     table: :class:`CompiledModel` for predict artifacts (format_version
     2, and 4 for int8-quantized), :class:`GenerateModel` for generate
-    artifacts (format_version 3)."""
+    artifacts (format_version 3/5), and the embed subsystem's
+    ``RecommendModel`` for recommend artifacts (format_version 6)."""
     meta, _ = _read_artifact(path)
     kind = _artifact_kind(path, meta)
+    if kind == "recommend":
+        from .embed.serve import RecommendModel
+        return RecommendModel.load(path, **kw)
     cls = GenerateModel if kind == "generate" else CompiledModel
     return cls.load(path, **kw)
 

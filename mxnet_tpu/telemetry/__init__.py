@@ -76,7 +76,8 @@ def _live_mfu(steps, window_s):
 
 
 def publish_window(*, steps, window_s, examples=None, engine_depth=None,
-                   global_step=None, source="train", ddp=None):
+                   global_step=None, source="train", ddp=None,
+                   embed=None):
     """Publish one K-step window's worth of training telemetry.
 
     Everything passed in (and everything read here) is already host
@@ -89,7 +90,16 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
     ``ddp`` (optional) is the Module's host-held bucketed-all-reduce
     summary for the window — ``{"buckets", "comm_bytes", "overlap_ms"}``
     from the GradReducer's STATIC plan (parallel/ddp.py), never a device
-    read.
+    read; with a sparse bucket kind it also carries
+    ``sparse_comm_bytes`` (coalesced unique-row exchange) so dashboards
+    can track the sparse-vs-densified win.
+
+    ``embed`` (optional) is the HotRowCache's host-held counter view
+    for the window — ``{"hit_rate", "spill_bytes"}`` where
+    ``spill_bytes`` is the WINDOW'S DELTA (the cache's counter is
+    cumulative; subtract the previous window's value before passing).
+    embed/cache.py keeps every counter on host, so this too is zero
+    extra device traffic.
     """
     from mxnet_tpu import profiler
 
@@ -128,6 +138,21 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
         gauge("ddp/overlap_ms",
               "model-estimated collective ms hidden under backward").set(
                   ddp.get("overlap_ms", 0.0))
+        if "sparse_comm_bytes" in ddp:
+            counter("ddp/sparse_comm_bytes",
+                    "coalesced sparse-gradient bytes exchanged (touched "
+                    "rows only, vs the densified table)").inc(
+                        ddp.get("sparse_comm_bytes", 0))
+
+    if embed:
+        gauge("embed/cache_hit_rate",
+              "hot-row cache hit rate over the cache's lifetime "
+              "(host-held counters, no device read)").set(
+                  embed.get("hit_rate", 0.0))
+        counter("embed/spill_bytes",
+                "bytes spilled from the device hot-row cache to the "
+                "host store (dirty evictions)").inc(
+                    embed.get("spill_bytes", 0))
 
     sync = profiler.sync_counters()
     for key in ("d2h", "wait", "depth_wait", "d2h_bytes", "total"):
@@ -141,6 +166,8 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
               "mfu": mfu, "sync": dict(sync)}
     if ddp:
         record["ddp"] = dict(ddp)
+    if embed:
+        record["embed"] = dict(embed)
 
     jsonl = _ensure_exporters()
     rec = flight_recorder()

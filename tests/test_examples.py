@@ -109,3 +109,15 @@ def test_train_imagenet_benchmark_mode():
 def test_train_rcnn_example():
     r = _run("train_rcnn.py", ["--epochs", "3"])
     assert "Faster R-CNN training OK" in r.stdout
+
+
+def test_train_twotower_example():
+    # small run of the PR-15 fleet drill: dense vs 2x2-mesh vs
+    # cache+spill must agree BITWISE (the script asserts it; the
+    # "user=True item=True" lines are the receipts)
+    r = _run("train_twotower.py",
+             ["--users", "128", "--items", "48", "--dim", "8",
+              "--batch-size", "16", "--steps", "12", "--capacity", "40",
+              "--window", "6"])
+    assert "bitwise cache-vs-mesh: user=True item=True" in r.stdout
+    assert "two-tower OK" in r.stdout

@@ -553,6 +553,25 @@ class TestHloPasses:
         assert len(leak) == 1 and leak[0].rule == "MXL510"
         assert "not fused with its verifier" in leak[0].message
 
+    def test_embedding_lookup_discipline_catches_and_passes(
+            self, lowerings):
+        # MXL511 fixture pair rides the same programs as MXL508: the
+        # "cache" param here plays the hot-row embedding buffer the
+        # RecommendEngine donates (argnum 0).
+        assert hlo_passes.embedding_lookup_discipline_pass(
+            lowerings["donated"], "recommend", cache_params=(0, 1)) == []
+        # undonated hot-row buffer: the resident rows copy per batch
+        bad = hlo_passes.embedding_lookup_discipline_pass(
+            lowerings["undonated"], "recommend", cache_params=(0, 1))
+        assert len(bad) == 1 and bad[0].rule == "MXL511"
+        assert "not donated" in bad[0].message
+        # a host callback inside the served lookup: hit/miss accounting
+        # must stay host-held (HotRowCache counters), zero extra d2h
+        leak = hlo_passes.embedding_lookup_discipline_pass(
+            lowerings["callback"], "recommend", cache_params=())
+        assert len(leak) == 1 and leak[0].rule == "MXL511"
+        assert "host-transfer" in leak[0].message
+
     # MXL509 fixtures: hand-written StableHLO in the shape the quantized
     # serving ops lower to. GOOD: f32 activations quantize (f32->i8), an
     # int8 dot accumulates in i32, and the only upcast is the i32

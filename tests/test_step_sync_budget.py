@@ -205,6 +205,45 @@ def test_ddp_window_stats_add_no_d2h():
     assert reg.get("ddp/overlap_ms").value() == stats["overlap_ms"]
 
 
+def test_embed_window_stats_add_no_d2h():
+    """The embedding telemetry contract (PR 15): ``embed/cache_hit_rate``
+    and ``embed/spill_bytes`` come from the HotRowCache's HOST-HELD
+    counters (embed/cache.py never reads the device to account), and
+    ``ddp/sparse_comm_bytes`` from the SparseBucket STATIC plan — so a
+    window publish carrying all three performs ZERO device->host
+    transfers beyond what training itself already paid."""
+    from mxnet_tpu.embed import HotRowCache, SpillStore
+    from mxnet_tpu.parallel.ddp import SparseBucket
+
+    store = SpillStore(64, 8, seed=3)
+    cache = HotRowCache(store, 16)
+    # touch enough distinct rows to force dirty evictions -> spill d2h,
+    # all PAID here, before the window boundary being measured
+    for lo in (0, 12, 24, 36):
+        ids = np.arange(lo, lo + 12, dtype=np.int64)
+        cache.ensure(ids)
+        cache.note_updated(ids)
+    assert cache.stats()["spill_bytes"] > 0
+
+    sb = SparseBucket("emb_user", 32, 8, 64)
+    spill_before = 0  # window delta: first window since cache creation
+    profiler.reset_sync_counters()
+    stats = cache.stats()
+    telemetry.publish_window(
+        steps=K, window_s=0.1, examples=16 * K, global_step=K,
+        ddp={"buckets": 1, "comm_bytes": 0, "overlap_ms": 0.0,
+             "sparse_comm_bytes": sb.comm_bytes(4)},
+        embed={"hit_rate": stats["hit_rate"],
+               "spill_bytes": stats["spill_bytes"] - spill_before})
+    counters = profiler.sync_counters()
+    assert counters["d2h"] == 0 and counters["d2h_bytes"] == 0, counters
+
+    reg = telemetry.default_registry()
+    assert reg.get("embed/cache_hit_rate").value() == stats["hit_rate"]
+    assert reg.get("embed/spill_bytes").value() >= stats["spill_bytes"]
+    assert reg.get("ddp/sparse_comm_bytes").value() >= sb.comm_bytes(4)
+
+
 def test_counters_shape():
     profiler.reset_sync_counters()
     c = profiler.sync_counters()

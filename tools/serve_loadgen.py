@@ -706,6 +706,222 @@ def measure_generate(target, users=4, requests=64, prompt_len=8,
     return out
 
 
+def _http_recommend(url, payload, timeout_s):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/recommend",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return "ok", None, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        if e.code == 429:
+            return ("rejected",
+                    float(e.headers.get("Retry-After", 0.05)), None)
+        if e.code == 504:
+            return "expired", None, None
+        if e.code == 503:
+            return "closed", None, None
+        return "error", None, None
+    except (urllib.error.URLError, ConnectionError, OSError):
+        return "conn", None, None
+    except Exception:
+        return "error", None, None
+
+
+def measure_recommend(target, concurrency=8, requests=256, mean_ids=8,
+                      zipf=1.3, rows=None, timeout_ms=None, retries=0,
+                      seed=0, conn_retries=0):
+    """Closed-loop recommend benchmark: ragged Zipf-skewed id-list
+    requests (the traffic shape the hot-row cache exists for), p50/p99
+    latency + goodput, and the server's cache hit rate after the run.
+
+    ``target``: a recommend-mode Server, a format_version-6 artifact
+    path, or an ``http://`` URL (replica or fleet router — router mode
+    adds the per-replica request distribution). ``rows`` bounds the
+    sampled ids; in-process it defaults to the engine's user-table
+    rows, over HTTP it is read from ``GET /info``.
+    """
+    import numpy as np
+
+    is_url = isinstance(target, str) and target.startswith("http")
+    server = None
+    max_ids = 64
+    if not is_url:
+        from mxnet_tpu.serve import Server
+        if isinstance(target, str):
+            target = Server(target)
+        server = target
+        if server.mode != "recommend":
+            raise ValueError("measure_recommend needs a recommend-mode "
+                             "server (a format_version-6 artifact)")
+        rows = rows or server.engine.rows
+        max_ids = server.engine.max_ids
+    elif rows is None:
+        import urllib.request
+        with urllib.request.urlopen(target.rstrip("/") + "/info",
+                                    timeout=10) as r:
+            info = json.loads(r.read().decode())
+        reco = info.get("recommend") or {}
+        rows = reco.get("rows")
+        max_ids = reco.get("max_ids") or max_ids
+        if not rows:
+            raise ValueError("target's /info has no recommend section; "
+                             "pass rows= explicitly")
+
+    rng = np.random.RandomState(seed)
+    # ragged lengths (geometric around the mean) and Zipf-skewed ids:
+    # the head rows take most lookups, which is what gives the hot-row
+    # cache its hit rate
+    lens = _sample_lengths(rng, requests, mean_ids, "longtail",
+                           1, max_ids)
+    id_lists = [((rng.zipf(zipf, size=int(lens[i])) - 1) % rows)
+                .astype("int64").tolist() for i in range(requests)]
+
+    counters = {"completed": 0, "rejected": 0, "expired": 0, "errors": 0}
+    latencies = []
+    gathers_done = [0]
+    per_replica = {}
+    failovers_ridden = [0]
+    lock = threading.Lock()
+    next_idx = [0]
+
+    def worker(wid):
+        from mxnet_tpu.fleet.supervisor import backoff_delay
+        from mxnet_tpu.serve import (DeadlineExceeded, ServerBusy,
+                                     ServerClosed)
+        while True:
+            with lock:
+                if next_idx[0] >= requests:
+                    return
+                i = next_idx[0]
+                next_idx[0] += 1
+            ids = id_lists[i]
+            t0 = time.monotonic()
+            outcome, body = "error", None
+            rode_conn = False
+            admit_attempt = conn_attempt = 0
+            while is_url:
+                payload = {"ids": ids}
+                if timeout_ms:
+                    payload["timeout_ms"] = timeout_ms
+                outcome, retry_after, body = _http_recommend(
+                    target, payload,
+                    timeout_s=(timeout_ms or 30000) / 1e3 + 5)
+                if outcome == "ok":
+                    break
+                if outcome == "conn" and conn_attempt < conn_retries:
+                    rode_conn = True
+                    time.sleep(backoff_delay(conn_attempt, base=0.25,
+                                             cap=2.0))
+                    conn_attempt += 1
+                    continue
+                if outcome in ("rejected", "closed") \
+                        and admit_attempt < retries:
+                    admit_attempt += 1
+                    time.sleep(retry_after or 0.05)
+                    continue
+                break
+            for attempt in range(0 if is_url else retries + 1):
+                try:
+                    req = server.submit_recommend(ids,
+                                                  timeout_ms=timeout_ms)
+                    budget = ((timeout_ms or 30000) / 1e3) + 5
+                    req.result(timeout=budget)
+                    body = {"gathers": req.units}
+                    outcome = "ok"
+                    break
+                except ServerBusy as e:
+                    outcome = "rejected"
+                    if attempt < retries:
+                        time.sleep(e.retry_after)
+                        continue
+                    break
+                except ServerClosed:
+                    outcome = "closed"
+                    if attempt < retries:
+                        time.sleep(0.05)
+                        continue
+                    break
+                except DeadlineExceeded:
+                    outcome = "expired"
+                    break
+                except Exception:
+                    outcome = "error"
+                    break
+            dt_ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                if outcome == "ok":
+                    counters["completed"] += 1
+                    latencies.append(dt_ms)
+                    gathers_done[0] += int((body or {}).get("gathers")
+                                           or len(ids))
+                    if rode_conn:
+                        failovers_ridden[0] += 1
+                    rid = (body or {}).get("replica")
+                    if rid:
+                        per_replica[rid] = per_replica.get(rid, 0) + 1
+                elif outcome in ("rejected", "closed"):
+                    counters["rejected"] += 1
+                elif outcome == "expired":
+                    counters["expired"] += 1
+                else:
+                    counters["errors"] += 1
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t_start
+
+    from mxnet_tpu.serve import percentile
+    out = {
+        "attempted": requests,
+        **counters,
+        "wall_s": round(wall_s, 3),
+        "goodput_qps": round(counters["completed"] / wall_s, 2)
+                       if wall_s > 0 else None,
+        "gathers_per_s": round(gathers_done[0] / wall_s, 1)
+                         if wall_s > 0 else None,
+        "concurrency": concurrency,
+        "ids_per_request": {"mean": float(lens.mean()),
+                            "max": int(lens.max()), "zipf_a": zipf},
+        "latency_ms": {
+            "p50": percentile(latencies, 50),
+            "p95": percentile(latencies, 95),
+            "p99": percentile(latencies, 99),
+            "mean": (sum(latencies) / len(latencies)) if latencies
+                    else None,
+            "max": max(latencies) if latencies else None,
+        },
+    }
+    if is_url:
+        out["failovers_ridden"] = failovers_ridden[0]
+    if per_replica:
+        out["per_replica"] = dict(sorted(per_replica.items()))
+    if server is not None:
+        st = server.engine.stats()
+        out["cache_hit_rate"] = st["hit_rate"]
+        out["embed"] = st
+    elif not per_replica:
+        # bare replica over HTTP: the hit rate lives in its /metrics
+        try:
+            import urllib.request
+            with urllib.request.urlopen(
+                    target.rstrip("/") + "/metrics", timeout=10) as r:
+                snap = json.loads(r.read().decode())
+            out["cache_hit_rate"] = (snap.get("embed") or {}).get(
+                "hit_rate")
+        except Exception:
+            pass
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     g = p.add_mutually_exclusive_group(required=True)
@@ -741,6 +957,17 @@ def main():
                    help="generation workload (generate-mode artifact / "
                         "server): closed-loop users, sampled prompt/"
                         "output lengths, TTFT/TPOT + tokens/s goodput")
+    p.add_argument("--recommend", action="store_true",
+                   help="recommend workload (format_version-6 artifact "
+                        "/ server): ragged Zipf id-list requests, "
+                        "p50/p99 + cache hit rate")
+    p.add_argument("--mean-ids", type=int, default=8,
+                   help="mean history length per request (--recommend)")
+    p.add_argument("--zipf", type=float, default=1.3,
+                   help="Zipf skew of sampled row ids (--recommend)")
+    p.add_argument("--reco-rows", type=int, default=None,
+                   help="user-table row bound for sampled ids "
+                        "(--recommend; default: engine rows or /info)")
     p.add_argument("--prompt-len", type=int, default=8,
                    help="mean prompt length (--generate)")
     p.add_argument("--prompt-dist", default="longtail",
@@ -820,13 +1047,20 @@ def main():
             if args.shape else None
     else:
         from mxnet_tpu.serve import Server
-        if args.generate:
+        if args.generate or args.recommend:
             target = Server(args.artifact)
         else:
             target = Server(args.artifact, buckets=args.buckets)
         shape = None
 
-    if args.generate:
+    if args.recommend:
+        res = measure_recommend(
+            target, concurrency=args.concurrency,
+            requests=args.requests, mean_ids=args.mean_ids,
+            zipf=args.zipf, rows=args.reco_rows,
+            timeout_ms=args.timeout_ms, retries=args.retries,
+            seed=args.seed, conn_retries=conn_retries)
+    elif args.generate:
         res = measure_generate(
             target, users=args.concurrency, requests=args.requests,
             prompt_len=args.prompt_len, prompt_dist=args.prompt_dist,
