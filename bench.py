@@ -158,6 +158,18 @@ def _secondary_legs(out, on_tpu):
             out["recommend"] = _reco_leg(on_tpu)
         except Exception as e:
             out["recommend"] = "failed: %s" % e
+    # flash-attention kernel leg: chip-free tile pick + TPU-export custom
+    # call census every round, wall microbench only on the chip
+    # (BENCH_ATTN=0 skips)
+    if os.environ.get("BENCH_ATTN", "1") == "1":
+        try:
+            out["attention"] = _attention_leg(on_tpu)
+            kt = out.get("kernel_tier")
+            if isinstance(kt, dict) and isinstance(out["attention"], dict):
+                kt["flash_attn_custom_calls"] = \
+                    out["attention"].get("census")
+        except Exception as e:
+            out["attention"] = "failed: %s" % e
 
 
 def _reco_leg(on_tpu):
@@ -263,6 +275,97 @@ def _reco_leg(on_tpu):
     }
 
 
+def _attention_leg(on_tpu):
+    """Flash-attention kernel family microbench (kernels/attention.py).
+
+    Chip-free on every round: the tuner's cost model picks the tile
+    config for the benched shapes, and a TPU-platform ``jax.export``
+    under ``tier.force_compiled()`` proves the custom calls survive
+    into the cross-compiled program (``mxk_flash_attn`` /
+    ``mxk_flash_attn_paged`` census — the numbers
+    tests/test_attention_kernel.py pins). Wall timing of kernel vs the
+    dense reference runs only on the chip: the CPU interpreter's wall
+    time says nothing about Mosaic."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import export as _export
+    from mxnet_tpu import hlo_stats
+    from mxnet_tpu.kernels import attention as _attn
+    from mxnet_tpu.kernels import tier as _tier
+    from mxnet_tpu.tune import tuner as _tuner
+
+    if on_tpu:
+        B, H, T, D = 4, 8, 1024, 64
+        S, W, MP, page = 8, 4, 8, 16
+    else:
+        B, H, T, D = 1, 2, 128, 16
+        S, W, MP, page = 2, 2, 2, 8
+    leg = {"platform": "tpu" if on_tpu else "cpu_smoke",
+           "train_shape": [B, H, T, D],
+           "paged_geometry": {"slots": S, "window": W, "pages_per_slot": MP,
+                              "page_size": page}}
+
+    # chip-free tile pick for the benched shapes (docs/tuning.md): same
+    # ranking tools/autotune.py --chip-free would commit
+    shapes = _attn.shape_key_shapes((B, H, T, D), (B, H, T, D))
+    res = _tuner.tune("flash_attn", shapes, "float32", chip_free=True)
+    leg["config"] = dict(res["best"]["config"])
+    leg["model_score_us"] = round(res["best"]["score_us"], 2)
+    pshapes = _attn.paged_shape_key_shapes((S, W, H * D), H, page, (S, MP))
+    pres = _tuner.tune("flash_attn_paged", pshapes, "float32",
+                       chip_free=True)
+    leg["paged_config"] = dict(pres["best"]["config"])
+    leg["paged_model_score_us"] = round(pres["best"]["score_us"], 2)
+
+    # TPU-platform export census under force_compiled: the kernels must
+    # reach the lowered program even when exported from a chip-free host
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("f4"))
+    census = {}
+    with _tier.force_compiled():
+        exp = _export.export(
+            jax.jit(lambda a, b_, c: _attn.flash_attention(
+                a, b_, c, causal=True)), platforms=["tpu"])(q, q, q)
+        for name, n in hlo_stats.pallas_kernel_names(
+                exp.mlir_module()).items():
+            census[name] = census.get(name, 0) + n
+        kv = jnp.zeros(((S * MP + 1) * page, H * D), jnp.float32)
+        pq = jnp.asarray(rng.randn(S, W, H * D).astype("f4"))
+        bt = jnp.asarray(
+            (1 + np.arange(S * MP, dtype=np.int32)).reshape(S, MP))
+        pos = jnp.full((S,), page * MP - W, jnp.int32)
+        pexp = _export.export(
+            jax.jit(lambda a, kp, vp, b_, p_: _attn.paged_attention(
+                a, kp, vp, b_, p_, heads=H, page_size=page)),
+            platforms=["tpu"])(pq, kv, kv, bt, pos)
+        for name, n in hlo_stats.pallas_kernel_names(
+                pexp.mlir_module()).items():
+            census[name] = census.get(name, 0) + n
+    leg["census"] = census
+
+    if on_tpu:
+        def _time_us(fn, *args, iters=10):
+            out = jax.block_until_ready(fn(*args))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = fn(*args)
+                jax.block_until_ready(out)
+                best = min(best, (time.perf_counter() - t0) * 1e6 / iters)
+            return best
+        kern = jax.jit(lambda a, b_, c: _attn.flash_attention(
+            a, b_, c, causal=True, config=leg["config"]))
+        ref = jax.jit(lambda a, b_, c: _attn.reference_attention(
+            a, b_, c, causal=True))
+        leg["kernel_us"] = round(_time_us(kern, q, q, q), 1)
+        leg["reference_us"] = round(_time_us(ref, q, q, q), 1)
+        leg["speedup"] = round(leg["reference_us"]
+                               / max(leg["kernel_us"], 1e-9), 2)
+    return leg
+
+
 def _decode_leg(on_tpu):
     """Autoregressive decode through the continuous-batching engine
     (serve/decode.py): export ONE generate artifact, then run the same
@@ -339,6 +442,12 @@ def _decode_leg(on_tpu):
         diags = (sess.check_discipline()
                  + sess.check_speculative_discipline()) \
             if continuous else []
+        mxl512 = None
+        if continuous:
+            from mxnet_tpu.kernels import tier as _ktier
+            if _ktier.tier() != "off":
+                a = sess.check_attention_discipline()
+                mxl512 = "clean" if not a else [str(d) for d in a]
         sess.close(drain=True)
 
         def pct(xs, q):
@@ -356,6 +465,8 @@ def _decode_leg(on_tpu):
         if sp and sp.get("steps"):
             res["accepted_tokens_per_step"] = sp["accepted_tokens_per_step"]
             res["draft_acceptance_rate"] = sp["draft_acceptance_rate"]
+        if mxl512 is not None:
+            res["mxl512"] = mxl512
         return res, diags, [o["tokens"] for o in outs]
 
     # speculative leg: the SAME workload through a format_version-5
@@ -377,6 +488,31 @@ def _decode_leg(on_tpu):
     try:
         cont, diags, _ = run_mode(True)
         stat, _, _ = run_mode(False)
+        # kernel on/off re-emit: the SAME continuous workload with the
+        # Pallas attention tier forced auto vs off. The tier is resolved
+        # when the decode module is LOWERED, so each arm exports its own
+        # artifact under the override. Greedy decode pins the token
+        # streams bitwise-equal (the kernel parity bar); the wall ratio
+        # on a CPU round is the chip-free (interpreter) form of the
+        # number — only the on-chip ratio is a performance claim.
+        from mxnet_tpu.config import flags as _flags
+        prev_tier = _flags.kernel_tier
+        arts = {"auto": tempfile.mktemp(suffix=".kon.mxtpu"),
+                "off": tempfile.mktemp(suffix=".koff.mxtpu")}
+        try:
+            _flags.set("kernel_tier", "auto")
+            serving.export_generate(params, spec, arts["auto"])
+            kern_on, _, ktoks_on = run_mode(True, path=arts["auto"])
+            _flags.set("kernel_tier", "off")
+            serving.export_generate(params, spec, arts["off"])
+            kern_off, _, ktoks_off = run_mode(True, path=arts["off"])
+        finally:
+            _flags.set("kernel_tier", prev_tier)
+            for f in arts.values():
+                try:
+                    os.unlink(f)
+                except OSError:
+                    pass
         spec_on, diags510, toks_on = run_mode(True, path=art5,
                                               speculative=True)
         spec_off, _, toks_off = run_mode(True, path=art5,
@@ -396,6 +532,16 @@ def _decode_leg(on_tpu):
         stat["decode_steps"] / float(cont["decode_steps"]), 2) \
         if cont["decode_steps"] else None
     leg["mxl508"] = "clean" if not diags else [str(d) for d in diags]
+    leg["kernel_on"] = kern_on
+    leg["kernel_off"] = kern_off
+    leg["kernel_tokens_matched"] = ktoks_on == ktoks_off
+    leg["kernel_wall_ratio"] = round(
+        kern_off["wall_s"] / kern_on["wall_s"], 2) \
+        if kern_on["wall_s"] else None
+    # the perfmodel policy's chosen depth next to the measured
+    # acceptance, so the suggest_speculation_depth heuristic is
+    # auditable against what the chip actually accepted
+    spec_on["policy_k"] = _dm.suggest_speculation_depth(spec)
     spec_on["export_s"] = export5_s
     leg["speculative"] = spec_on
     leg["speculative_baseline"] = spec_off

@@ -26,7 +26,7 @@ __all__ = [
     "collective_interleave_pass", "collective_overlap_report",
     "decode_cache_discipline_pass", "quant_dequant_budget_pass",
     "speculative_dispatch_pass", "embedding_lookup_discipline_pass",
-    "metrics_from_text",
+    "attention_fusion_pass", "metrics_from_text",
 ]
 
 HLO_RULES = {r.id: r for r in [
@@ -83,6 +83,15 @@ HLO_RULES = {r.id: r for r in [
          "spill accounting lives on HOST (HotRowCache counters) and "
          "the only fetch is the top-k result, outside the program "
          "(see docs/embeddings.md serving discipline)"),
+    Rule("MXL512", "hlo-attention-fusion", "error",
+         "the attention score matrix must never be materialized: an "
+         "exponential over a context-width f32 tensor means softmax ran "
+         "over the full (seq, ctx) score block in HBM instead of inside "
+         "the flash kernel's online-softmax tiles (MXNET_KERNEL_TIER="
+         "auto dispatches mxk_flash_attn*; check tier.stats()['fallback'] "
+         "for the guard that bounced it, see docs/tuning.md flash "
+         "attention) — and the step's d2h budget is unchanged: fusing "
+         "attention must not add host syncs"),
     Rule("MXL507", "hlo-collective-interleave", "error",
          "the DDP step's gradient all-reduces must stay few (one fused "
          "collective per bucket — more means the GradReducer plan "
@@ -338,6 +347,52 @@ def speculative_dispatch_pass(text, label, cache_params=(5, 6, 7, 8),
             "(budget %d) — the draft is not fused with its verifier: "
             "every extra transfer is one device sync per speculative "
             "window" % (n, d2h_budget)))
+    return diags
+
+
+# naive-softmax signature: stablehlo.exponential whose f32 result's last
+# (lane) dim spans the attention context. The flash kernel's exps live in
+# (block_q, block_k) / (width, page) tiles — far below any real ctx — and
+# sampling's Gumbel trick is log-of-uniform, not exp, so neither
+# false-positives.
+_EXP_F32_RE = _re.compile(
+    r"stablehlo\.exponential\s[^:]*:\s*tensor<(\d+(?:x\d+)*)xf32>")
+
+
+def attention_fusion_pass(text, label, ctx, d2h_budget=0):
+    """MXL512: the attention-fusion discipline over lowered text.
+
+    ``ctx`` is the program's attention context width (max_prompt_len for
+    a training step, pages*page_size for a served decode step). The pass
+    fails when the module materializes a full-width score softmax — any
+    ``stablehlo.exponential`` producing an f32 tensor whose last dim is
+    at least ``ctx`` is the naive ``softmax(q @ k^T)`` over an (S, ctx)
+    score block that the flash kernel exists to keep out of HBM — or
+    when the program carries more than ``d2h_budget`` host-transfer ops
+    (fusing attention must leave the step's sync budget untouched: the
+    MXL508/MXL510 one-fetch contract still holds). Chip-free like every
+    Layer-2 pass: lower under JAX_PLATFORMS=cpu and hand the text in
+    (GenerateSession.check_attention_discipline does)."""
+    diags = []
+    floor = max(int(ctx), 2)
+    wide = collections.Counter()
+    for m in _EXP_F32_RE.finditer(text):
+        dims = [int(d) for d in m.group(1).split("x")]
+        if dims[-1] >= floor:
+            wide["%sxf32" % m.group(1)] += 1
+    if wide:
+        diags.append(_diag(
+            "MXL512", label,
+            "%d full-context softmax exponential(s) — the (seq, ctx) "
+            "attention score block is materialized in f32 instead of "
+            "streamed through the flash kernel's online-softmax tiles "
+            "(ctx=%d): %s" % (sum(wide.values()), floor, dict(wide))))
+    n = d2h_count(text)
+    if n > d2h_budget:
+        diags.append(_diag(
+            "MXL512", label,
+            "%d host-transfer op(s) (budget %d) — attention fusion must "
+            "not add device syncs to the step" % (n, d2h_budget)))
     return diags
 
 

@@ -23,6 +23,8 @@ KERNEL_OPS = {
     "scale_bias_act": "mxnet_tpu.kernels.mlp",
     "take_rows": "mxnet_tpu.kernels.take",
     "int8_dequant": "mxnet_tpu.kernels.int8_dequant",
+    "flash_attn": "mxnet_tpu.kernels.attention",
+    "flash_attn_paged": "mxnet_tpu.kernels.attention",
 }
 
 

@@ -1,0 +1,493 @@
+"""Flash-attention Pallas kernel family for the kernel tier.
+
+One tiled online-softmax core (the ``ops/pallas_flash.py`` recurrence:
+running max / denominator / f32 accumulator in VMEM scratch, masked
+scores forced to -1e30 BEFORE the max so excluded rows contribute an
+exact 0.0) behind two tier ops:
+
+* ``flash_attn`` — training/prefill causal self-attention over dense
+  ``(B, H, T, D)`` tensors. Grid ``(B*H, q_blocks, kv_blocks)`` with the
+  KV stream innermost; causal block pruning skips fully-future KV tiles.
+  ``jax.custom_vjp`` whose backward differentiates the pure-JAX dense
+  :func:`reference_attention` — gradients are bit-identical to the
+  reference by construction (the recompute-in-backward profile).
+* ``flash_attn_paged`` — serving-side attention that consumes the paged
+  KV cache's block table DIRECTLY: the ``(S, MP)`` table and the ``(S,)``
+  positions are scalar-prefetched to SMEM, and each KV ``BlockSpec``
+  index_map reads ``bt_ref[s, pi]`` so the grid DMAs exactly the pages a
+  slot may attend to — the ``(S, max_context, C)`` gathered-context
+  tensor of the naive path never exists. One kernel serves the decode
+  step (window=1), the chunked-prefill chunk (window=P over one slot),
+  the int8 draft token-step, and the speculative verifier's (k+1)-token
+  window; masking is positional (``t_pos <= q_pos``), which subsumes the
+  engine's ``att`` masks at all four sites.
+
+Both follow the PR-6 tier contract: interpreter-runnable on CPU (the
+same program text exports/runs chip-free), Mosaic via
+``tier.force_compiled()`` for TPU-platform export, strict shape/dtype
+eligibility guards whose reasons land in ``tier.record_fallback``, f32
+accumulation over bf16 inputs, and kernel names (``mxk_flash_attn``,
+``mxk_flash_attn_paged``) visible in lowered HLO for the bench census.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import tier
+
+__all__ = ["flash_attention", "attend_or_none", "reference_attention",
+           "paged_attention", "paged_attend_or_none",
+           "eligible", "paged_eligible",
+           "shape_key_shapes", "paged_shape_key_shapes",
+           "default_config_for", "OP_NAME", "PAGED_OP_NAME",
+           "DEFAULT_CONFIG", "PAGED_DEFAULT_CONFIG"]
+
+OP_NAME = "flash_attn"
+PAGED_OP_NAME = "flash_attn_paged"
+DEFAULT_CONFIG = {"block_q": 128, "block_k": 128}
+PAGED_DEFAULT_CONFIG = {"block_h": 1}
+
+_NEG_INF = -1e30
+_SUPPORTED = (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+
+
+def _paged_block_h(heads, head_dim):
+    """Widest Mosaic-valid head block. The paged kernel's KV/q lane dim
+    is ``block_h * head_dim``, and the Mosaic TPU lowering requires a
+    block's lane dim to be 128-aligned or equal to the array's full
+    feature width — so the only always-valid fallback is the full head
+    count (lane dim == dim)."""
+    for bh in (8, 4, 2, 1):
+        if heads % bh == 0 and (bh * head_dim) % 128 == 0:
+            return bh
+    return heads
+
+
+def default_config_for(op, shapes=None):
+    """Per-op heuristic default (two tier ops share this module, so the
+    single-``DEFAULT_CONFIG`` convention of the other kernels is not
+    enough; ``tune.space.default_config`` consults this hook)."""
+    if op == PAGED_OP_NAME:
+        cfg = dict(PAGED_DEFAULT_CONFIG)
+        if shapes:
+            cfg["block_h"] = _paged_block_h(shapes[0][2], shapes[0][3])
+        return cfg
+    return dict(DEFAULT_CONFIG)
+
+
+# ------------------------------------------------------------- reference
+
+def reference_attention(q, k, v, causal=True):
+    """Dense pure-JAX attention over (B, H, T, D): the numerics oracle.
+
+    f32 score/softmax math regardless of input dtype, masked scores an
+    exact -1e30 before the max — the convention every consumer of the
+    kernel family already relies on. Cross-length causal masks with the
+    diagonal offset ``tk - tq`` (blockwise_attention's alignment)."""
+    dtype = q.dtype
+    tq, tk = q.shape[2], k.shape[2]
+    scale = 1.0 / math.sqrt(q.shape[3])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = jnp.arange(tq)[:, None]
+        kpos = jnp.arange(tk)[None, :]
+        s = jnp.where(kpos <= qpos + (tk - tq), s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w,
+                      v.astype(jnp.float32)).astype(dtype)
+
+
+# ------------------------------------------------- training kernel (dense)
+
+def _train_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  block_q, block_k, seq_q, seq_k, causal, sm_scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal block pruning: a KV tile entirely in this Q tile's future
+    # contributes nothing — skip its compute and DMA result use
+    if causal:
+        visible = ki * block_k <= (qi + 1) * block_q - 1 + (seq_k - seq_q)
+    else:
+        visible = True
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # (bq, d)
+        bq = q.shape[0]
+        k_blk = k_ref[0]                                   # (bk, d)
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
+        kv_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = kv_pos < seq_k                              # tail padding
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            mask &= kv_pos <= q_pos + (seq_k - seq_q)
+        s = jnp.where(mask, s, _NEG_INF)
+        m = m_scr[:]
+        l = l_scr[:]
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        m_scr[:] = m_new
+        l_scr[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        o_ref[0] = (acc_scr[:]
+                    / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _call(q, k, v, cfg):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(cfg.block_q, tq)
+    block_k = min(cfg.block_k, tk)
+
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+
+    bh = b * h
+    qp = qp.reshape(bh, tq + pad_q, d)
+    kp = kp.reshape(bh, tk + pad_k, d)
+    vp = vp.reshape(bh, tk + pad_k, d)
+    n_q = (tq + pad_q) // block_q
+    n_k = (tk + pad_k) // block_k
+
+    kernel = functools.partial(
+        _train_kernel, block_q=block_q, block_k=block_k, seq_q=tq,
+        seq_k=tk, causal=cfg.causal, sm_scale=sm_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, ki: (bi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bi, qi, ki: (bi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq + pad_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+        name="mxk_flash_attn",
+    )(qp, kp, vp)
+    out = out.reshape(b, h, tq + pad_q, d)
+    return out[:, :, :tq] if pad_q else out
+
+
+class _Cfg(NamedTuple):
+    block_q: int
+    block_k: int
+    causal: bool
+    interpret: bool
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused(q, k, v, cfg):
+    return _call(q, k, v, cfg)
+
+
+def _fused_fwd(q, k, v, cfg):
+    return _fused(q, k, v, cfg), (q, k, v)
+
+
+def _fused_bwd(cfg, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: reference_attention(a, b, c, causal=cfg.causal),
+        q, k, v)
+    return vjp(g)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def _static_dims(*shapes):
+    for shape in shapes:
+        for dim in shape:
+            if not isinstance(dim, (int,)):
+                return False
+    return True
+
+
+def eligible(q_shape, k_shape, v_shape, dtype, causal=True):
+    """Strict guard for the dense training variant; None when
+    dispatchable, else the human-readable fallback reason."""
+    if len(q_shape) != 4 or len(k_shape) != 4 or len(v_shape) != 4:
+        return "q/k/v must be (B, H, T, D) 4-D, got %d/%d/%d-D" % (
+            len(q_shape), len(k_shape), len(v_shape))
+    if not _static_dims(q_shape, k_shape, v_shape):
+        return "symbolic dimension (jax.export shape polymorphism) — " \
+            "Pallas grids need concrete sizes"
+    if jnp.dtype(dtype) not in _SUPPORTED:
+        return "dtype must be f32 or bf16, got %s" % jnp.dtype(dtype)
+    if tuple(k_shape) != tuple(v_shape):
+        return "k/v shapes differ: %s vs %s" % (k_shape, v_shape)
+    if q_shape[0] != k_shape[0] or q_shape[1] != k_shape[1] \
+            or q_shape[3] != k_shape[3]:
+        return "q %s and kv %s disagree on batch/heads/head_dim" % (
+            tuple(q_shape), tuple(k_shape))
+    tq, tk = q_shape[2], k_shape[2]
+    if tq < 1 or tk < 1:
+        return "empty sequence"
+    if causal and tq != tk:
+        return "causal cross-length (tq=%d != tk=%d) not served by the " \
+            "tier: fully-masked rows would take the kernel's zeros " \
+            "convention, not the reference softmax" % (tq, tk)
+    if q_shape[3] > 512:
+        return "head_dim %d exceeds the 512 VMEM plan" % q_shape[3]
+    return None
+
+
+def shape_key_shapes(q_shape, k_shape):
+    """Tuner key: (B*H, T, D) for the q and kv streams."""
+    b, h, tq, d = q_shape
+    return ((b * h, tq, d), (b * h, k_shape[2], d))
+
+
+def flash_attention(q, k, v, *, causal=True, config=None, interpret=None):
+    """Tiled flash attention over (B, H, T, D); raises on guard failure
+    (call-sites consult :func:`eligible`/:func:`attend_or_none`)."""
+    reason = eligible(q.shape, k.shape, v.shape, q.dtype, causal=causal)
+    if reason is not None:
+        raise ValueError("flash_attn guard: %s" % reason)
+    cfgd = dict(DEFAULT_CONFIG)
+    cfgd.update(config or {})
+    if interpret is None:
+        interpret = tier.resolve_interpret()
+    cfg = _Cfg(int(cfgd["block_q"]), int(cfgd["block_k"]),
+               bool(causal), bool(interpret))
+    return _fused(q, k, v, cfg)
+
+
+def attend_or_none(q, k, v, *, causal=True, interpret=None):
+    """Tier-dispatched attention: the fused kernel when the policy and
+    the guard allow, None when the caller must keep its pure-JAX path
+    (the per-site fallback reason is recorded either way)."""
+    reason = eligible(q.shape, k.shape, v.shape, q.dtype, causal=causal)
+    go, cfg = tier.should_dispatch(
+        OP_NAME, shape_key_shapes(q.shape, k.shape) if reason is None
+        else ((1, 1, 1), (1, 1, 1)),
+        q.dtype, guard_reason=reason)
+    if not go:
+        return None
+    return flash_attention(q, k, v, causal=causal, config=cfg,
+                           interpret=interpret)
+
+
+# --------------------------------------------------- paged kernel (serving)
+
+def _paged_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, block_h, head_dim, page,
+                  width, sm_scale):
+    del bt_ref  # consumed by the KV index_maps (the page gather)
+    s_id = pl.program_id(0)
+    pi = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(pi == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # logical positions: this KV tile holds rows pi*page .. pi*page+page-1
+    # of the slot's context; query row w sits at position pos[s] + w
+    t_pos = pi * page + jax.lax.broadcasted_iota(
+        jnp.int32, (width, page), 1)
+    q_pos = pos_ref[s_id] + jax.lax.broadcasted_iota(
+        jnp.int32, (width, page), 0)
+    mask = t_pos <= q_pos                                  # (W, page)
+
+    for j in range(block_h):
+        cols = slice(j * head_dim, (j + 1) * head_dim)
+        q = q_ref[0, :, cols].astype(jnp.float32) * sm_scale  # (W, Dh)
+        k_blk = k_ref[:, cols]                             # (page, Dh)
+        v_blk = v_ref[:, cols]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (W, page)
+        s = jnp.where(mask, s, _NEG_INF)
+        m = m_scr[:, j:j + 1]
+        l = l_scr[:, j:j + 1]
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        m_scr[:, j:j + 1] = m_new
+        l_scr[:, j:j + 1] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:, cols] = acc_scr[:, cols] * corr + jax.lax.dot_general(
+            p, v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(pi == n_pages - 1)
+    def _():
+        for j in range(block_h):
+            cols = slice(j * head_dim, (j + 1) * head_dim)
+            o_ref[0, :, cols] = (
+                acc_scr[:, cols]
+                / jnp.maximum(l_scr[:, j:j + 1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_call(q, k_pages, v_pages, block_tables, positions, *,
+                heads, page_size, block_h, interpret):
+    S, W, C = q.shape
+    Dh = C // heads
+    MP = block_tables.shape[1]
+    lanes = block_h * Dh
+    grid = (S, heads // block_h, MP)
+    kernel = functools.partial(
+        _paged_kernel, block_h=block_h, head_dim=Dh, page=page_size,
+        width=W, sm_scale=1.0 / math.sqrt(Dh))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, W, lanes),
+                             lambda s, hj, pi, bt, pos: (s, 0, hj)),
+                # THE page gather: the block index IS the block-table
+                # entry, so each grid step DMAs page bt[s, pi] straight
+                # from the flat (rows, C) store — no (S, ctx, C) tensor
+                pl.BlockSpec((page_size, lanes),
+                             lambda s, hj, pi, bt, pos: (bt[s, pi], hj)),
+                pl.BlockSpec((page_size, lanes),
+                             lambda s, hj, pi, bt, pos: (bt[s, pi], hj)),
+            ],
+            out_specs=pl.BlockSpec((1, W, lanes),
+                                   lambda s, hj, pi, bt, pos: (s, 0, hj)),
+            scratch_shapes=[
+                pltpu.VMEM((W, block_h), jnp.float32),
+                pltpu.VMEM((W, block_h), jnp.float32),
+                pltpu.VMEM((W, lanes), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, W, C), q.dtype),
+        interpret=interpret,
+        name="mxk_flash_attn_paged",
+    )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_eligible(q_shape, pages_shape, bt_shape, pos_shape, dtype,
+                   heads, page_size):
+    """Strict guard for the paged serving variant; None when
+    dispatchable, else the fallback reason."""
+    if len(q_shape) != 3:
+        return "q must be (slots, window, dim) 3-D, got %d-D" % \
+            len(q_shape)
+    if len(pages_shape) != 2:
+        return "page store must be (rows, dim) 2-D, got %d-D" % \
+            len(pages_shape)
+    if not _static_dims(q_shape, pages_shape, bt_shape, pos_shape):
+        return "symbolic dimension (jax.export shape polymorphism) — " \
+            "Pallas grids need concrete sizes"
+    if jnp.dtype(dtype) not in _SUPPORTED:
+        return "dtype must be f32 or bf16, got %s" % jnp.dtype(dtype)
+    S, W, C = q_shape
+    if heads < 1 or C % heads != 0:
+        return "dim %d not divisible by heads %d" % (C, heads)
+    if pages_shape[1] != C:
+        return "page store dim %d != q dim %d" % (pages_shape[1], C)
+    if page_size < 8 or page_size % 8 != 0:
+        return "page_size %d not sublane-aligned (multiple of 8)" % \
+            page_size
+    if pages_shape[0] % page_size != 0:
+        return "page store rows %d not a whole number of %d-row pages" % \
+            (pages_shape[0], page_size)
+    if len(bt_shape) != 2 or bt_shape[0] != S:
+        return "block table must be (slots, max_pages), got %s" % \
+            (tuple(bt_shape),)
+    if len(pos_shape) != 1 or pos_shape[0] != S:
+        return "positions must be (slots,), got %s" % (tuple(pos_shape),)
+    if W < 1:
+        return "empty query window"
+    return None
+
+
+def paged_shape_key_shapes(q_shape, heads, page_size, bt_shape):
+    """Tuner key: (slots, window, heads, head_dim) + (pages/slot, page)."""
+    S, W, C = q_shape
+    return ((S, W, heads, C // heads), (bt_shape[1], page_size))
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, positions, *,
+                    heads, page_size, config=None, interpret=None):
+    """Paged-KV flash attention: (S, W, C) queries over the flat
+    (rows, C) page store through the (S, MP) block table. Query row
+    ``w`` of slot ``s`` attends logical positions ``<= positions[s]+w``
+    (the decode/verify/chunk mask family). Raises on guard failure."""
+    reason = paged_eligible(q.shape, k_pages.shape, block_tables.shape,
+                            positions.shape, q.dtype, heads, page_size)
+    if reason is not None:
+        raise ValueError("flash_attn_paged guard: %s" % reason)
+    cfgd = dict(PAGED_DEFAULT_CONFIG)
+    cfgd.update(config or {})
+    if interpret is None:
+        interpret = tier.resolve_interpret()
+    head_dim = q.shape[2] // heads
+    block_h = int(cfgd.get("block_h", 1))
+    if (block_h < 1 or heads % block_h != 0
+            or ((block_h * head_dim) % 128 != 0 and block_h != heads)):
+        block_h = _paged_block_h(heads, head_dim)
+    return _paged_call(q, k_pages, v_pages, block_tables, positions,
+                       heads=heads, page_size=page_size, block_h=block_h,
+                       interpret=bool(interpret))
+
+
+def paged_attend_or_none(q, k_pages, v_pages, block_tables, positions, *,
+                         heads, page_size, interpret=None):
+    """Tier-dispatched paged attention; None = keep the gather+softmax
+    fallback (reason recorded per site)."""
+    reason = paged_eligible(q.shape, k_pages.shape, block_tables.shape,
+                            positions.shape, q.dtype, heads, page_size)
+    go, cfg = tier.should_dispatch(
+        PAGED_OP_NAME,
+        paged_shape_key_shapes(q.shape, heads, page_size,
+                               block_tables.shape)
+        if reason is None else ((1, 1, 1, 1), (1, 8)),
+        q.dtype, guard_reason=reason)
+    if not go:
+        return None
+    return paged_attention(q, k_pages, v_pages, block_tables, positions,
+                           heads=heads, page_size=page_size, config=cfg,
+                           interpret=interpret)

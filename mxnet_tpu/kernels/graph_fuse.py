@@ -12,6 +12,11 @@ rewrites for ``executor._graph_eval_fn``:
   - ``BatchNorm -> broadcast_add(residual) -> Activation(relu)``
   - ``FullyConnected(+bias) -> Activation(relu) | LeakyReLU(gelu)``
   - ``broadcast_mul(x, scale) -> broadcast_add(+bias) -> LeakyReLU(gelu)``
+  - ``batch_dot(q, k, transpose_b) [-> _mul_scalar] -> softmax ->
+    batch_dot(·, v)`` — the naive attention spelling, fused to the
+    flash-attention kernel (kernels/attention.py) when the scalar is
+    the 1/sqrt(d) softmax scale; the (T, T) score tensor and its
+    softmax are the deferred interiors that never materialize
 
   guarded by single-use edges (nothing else may observe the interior
   values). Interior nodes become *deferred*: the executor skips them and
@@ -38,12 +43,57 @@ _MUL_OPS = ("broadcast_mul", "elemwise_mul")
 
 
 class _Plan(NamedTuple):
-    kind: str          # 'bn_act' | 'fc_act' | 'scale_bias_act'
-    act: str           # 'relu' | 'gelu'
-    base: object       # BatchNorm / FullyConnected / broadcast_mul node
-    mid: object        # interior add node or None
-    res_entry: object  # (node, out_idx) residual entry or None
+    kind: str          # 'bn_act' | 'fc_act' | 'scale_bias_act' | 'flash_attn'
+    act: str           # 'relu' | 'gelu' ('' for flash_attn)
+    base: object       # BatchNorm / FC / broadcast_mul / inner batch_dot
+    mid: object        # interior add / _mul_scalar node or None
+    res_entry: object  # (node, out_idx) residual or V entry, or None
     deferred: tuple    # node ids the executor must skip
+
+
+def _flag(val):
+    """Truthiness of a symbol param that may arrive as bool or string."""
+    if isinstance(val, str):
+        return val.lower() in ("1", "true")
+    return bool(val)
+
+
+def _match_flash_attn(uses, node):
+    """Anchor on the output batch_dot of the naive attention spelling:
+    ``batch_dot(softmax([scale *] batch_dot(q, k, transpose_b=True)), v)``
+    with every interior value single-use. Returns a _Plan or None; the
+    scale (and 1/sqrt(d) check) is trace-time work — shapes are unknown
+    here."""
+    if node.op.name != "batch_dot" or len(node.inputs) != 2:
+        return None
+    if _flag(node.params.get("transpose_a")) \
+            or _flag(node.params.get("transpose_b")):
+        return None
+    sm, sm_oi = node.inputs[0]
+    if sm.is_variable or sm_oi != 0 or sm.op.name != "softmax" \
+            or not _sole_use(uses, node, sm):
+        return None
+    if int(sm.params.get("axis", -1)) != -1:
+        return None
+    inner, in_oi = sm.inputs[0]
+    if inner.is_variable or in_oi != 0 or not _sole_use(uses, sm, inner):
+        return None
+    mid = None
+    if inner.op.name == "_mul_scalar":
+        mid = inner
+        nxt, nxt_oi = inner.inputs[0]
+        if nxt.is_variable or nxt_oi != 0 \
+                or not _sole_use(uses, mid, nxt):
+            return None
+        inner = nxt
+    if inner.op.name != "batch_dot" or len(inner.inputs) != 2:
+        return None
+    if _flag(inner.params.get("transpose_a")) \
+            or not _flag(inner.params.get("transpose_b")):
+        return None
+    deferred = ((id(inner),) + (() if mid is None else (id(mid),))
+                + (id(sm),))
+    return _Plan("flash_attn", "", inner, mid, node.inputs[1], deferred)
 
 
 def _act_kind(node):
@@ -94,6 +144,11 @@ def plan(nodes, entries):
     deferred = set()
     for node in nodes:
         if node.is_variable:
+            continue
+        fa = _match_flash_attn(uses, node)
+        if fa is not None:
+            plans[id(node)] = fa
+            deferred.update(fa.deferred)
             continue
         act = _act_kind(node)
         if act is None or not node.inputs:
@@ -228,10 +283,44 @@ def _eval_scale_bias_act(p, read):
     return None
 
 
+def _eval_flash_attn(p, read):
+    import math
+
+    from . import attention as _attn
+    q = read(*p.base.inputs[0])
+    k = read(*p.base.inputs[1])
+    v = read(*p.res_entry)
+    scale = 1.0 if p.mid is None \
+        else float(p.mid.params.get("scalar", 1.0))
+    want = 1.0 / math.sqrt(q.shape[-1])
+    if abs(scale - want) > 1e-6 * want:
+        tier.record_fallback(_attn.OP_NAME,
+                             "softmax scale %g is not 1/sqrt(d)=%g"
+                             % (scale, want))
+        return None
+    if q.ndim == 3:
+        # (B*H, T, D) spelling: run as single-head (B*H, 1, T, D)
+        out = _attn.attend_or_none(q[:, None], k[:, None], v[:, None],
+                                   causal=False)
+        return None if out is None else out[:, 0]
+    if q.ndim == 4:
+        return _attn.attend_or_none(q, k, v, causal=False)
+    tier.record_fallback(_attn.OP_NAME,
+                         "batch_dot operands are %d-D, need 3/4-D"
+                         % q.ndim)
+    return None
+
+
 def try_eval(p, node, read, values, route_aux, training):
     """Trace-time attempt at one planned fusion. True -> the act node's
     value is stored (and BN aux updates routed); False -> the executor
     must evaluate the pattern unfused (forcing the deferred thunks)."""
+    if p.kind == "flash_attn":
+        out = _eval_flash_attn(p, read)
+        if out is None:
+            return False
+        values[id(node)] = out
+        return True
     if p.kind == "bn_act":
         fused = _eval_bn_act(p, read, training)
         if fused is None:

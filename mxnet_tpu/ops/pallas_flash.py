@@ -187,5 +187,20 @@ from .registry import register as _register  # noqa: E402
 @_register("_contrib_FlashAttention")
 def _contrib_flash_attention(q, k, v, *, causal=False, block_q=128,
                              block_k=128):
-    """(B, H, T, D) flash attention as a registered op (pallas on TPU)."""
+    """(B, H, T, D) flash attention as a registered op (pallas on TPU).
+
+    Tier-aware: under ``MXNET_KERNEL_TIER=safe|auto`` the call dispatches
+    to the kernel-tier attention (kernels/attention.py — the
+    ``mxk_flash_attn`` HLO name the bench census counts, tuning-cache
+    tile configs, and a ``custom_vjp`` backward exact against the dense
+    reference), so the gluon GPT's hybridized train step picks up the
+    tuned kernel with zero model changes. With the tier off (the
+    default) it lowers this module's kernel with the caller's explicit
+    block sizes, unchanged — eligibility rejections (e.g. causal
+    cross-length) take the same legacy path and the reason lands in
+    ``tier.stats()['fallback']``."""
+    from ..kernels import attention as _attn
+    out = _attn.attend_or_none(q, k, v, causal=bool(causal))
+    if out is not None:
+        return out
     return flash_attention(q, k, v, block_q, block_k, bool(causal))

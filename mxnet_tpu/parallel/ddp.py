@@ -268,6 +268,12 @@ class GradReducer:
         out = {
             "buckets": len(self.buckets),
             "bucket_bytes": sizes,
+            # the interconnect-table policy value this reducer planned
+            # against (MXNET_DDP_BUCKET_MB override included) — lets
+            # dashboards and tests cross-check the plan against the ICI
+            # table without re-deriving it
+            "bucket_bytes_model": choose_bucket_bytes(self._device_kind),
+            "bucket_bytes_plan": self.bucket_bytes,
             "comm_bytes": self.comm_bytes,
             "overlap_ms": estimate_overlap_ms(
                 sizes, self.axis_size or 1, self._device_kind),

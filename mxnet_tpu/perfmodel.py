@@ -11,6 +11,7 @@ from __future__ import annotations
 __all__ = ["PEAK_FLOPS", "HBM_GBPS", "ICI_GBPS", "peak_flops",
            "hbm_bytes_per_s", "interconnect_bytes_per_s", "mfu",
            "roofline_seconds", "recommend_request_seconds",
+           "speculation_depth",
            "RESNET50_TRAIN_FLOPS_PER_IMG", "DEFAULT_DEVICE_KIND"]
 
 # fwd+bwd ~= 3x fwd MACs * 2 flops/MAC (ResNet-50 @ 224: 4.089 GMACs fwd)
@@ -85,6 +86,35 @@ def roofline_seconds(flops: float, bytes_moved: float,
     bytes_moved = max(0.0, float(bytes_moved))
     return max(flops / peak_flops(device_kind),
                bytes_moved / hbm_bytes_per_s(device_kind))
+
+
+def speculation_depth(t_draft: float, t_verify, max_k: int = 8,
+                      acceptance: float = 0.8) -> int:
+    """Optimal speculation depth for a draft/verify decode pipeline.
+
+    Pure math over two step costs — no spec, no jax — so it is
+    property-testable chip-free: ``t_draft`` is one draft token-step's
+    seconds, ``t_verify`` either a constant verifier cost or a callable
+    ``width -> seconds`` (the verifier amortizes one weight read over
+    ``k+1`` tokens, so its cost grows sub-linearly in width). Under a
+    geometric acceptance model a step of depth k emits
+    ``E[k] = (1 - a^(k+1)) / (1 - a)`` expected tokens and costs
+    ``k * t_draft + t_verify(k+1)``; the returned k maximizes the rate,
+    breaking exact ties toward the SHALLOWER depth (less speculative
+    cache churn for the same throughput). Monotone by construction:
+    cheaper drafts relative to the verifier never decrease k, and the
+    result clamps to ``[1, max_k]`` (callers pass the speculative-window
+    capacity of their artifact as ``max_k``)."""
+    a = min(max(float(acceptance), 1e-3), 0.999)
+    t_draft = max(float(t_draft), 1e-30)
+    tv = t_verify if callable(t_verify) else (lambda _w, _c=float(t_verify): _c)
+    best_k, best_rate = 1, 0.0
+    for kk in range(1, max(1, int(max_k)) + 1):
+        expected = (1.0 - a ** (kk + 1)) / (1.0 - a)
+        rate = expected / (kk * t_draft + max(float(tv(kk + 1)), 1e-30))
+        if rate > best_rate:
+            best_k, best_rate = kk, rate
+    return best_k
 
 
 def recommend_request_seconds(gathers: int, dim: int, corpus_rows: int,

@@ -945,6 +945,21 @@ class GenerateSession:
             cache_params=self._DRAFT_CACHE_ARGNUMS,
             d2h_budget=d2h_budget)
 
+    # -- chip-free discipline gate (MXL512) --------------------------------
+    def check_attention_discipline(self, d2h_budget=0):
+        """Run the MXL512 pass over the decode step's lowering: the
+        per-token attention must stream through the flash kernel's
+        online-softmax tiles — an f32 exponential spanning the full
+        per-slot context (pages * page_size) means the (S, ctx) score
+        block is materialized in HBM — and the step's host-sync budget
+        is unchanged (the MXL508 one-fetch contract still holds).
+        Returns the diagnostics list ([] = clean)."""
+        from ..analysis import hlo_passes
+        ctx = self.spec.max_pages_per_slot * self.spec.page_size
+        return hlo_passes.attention_fusion_pass(
+            self.decode_lowered_text(), "decode_step", ctx,
+            d2h_budget=d2h_budget)
+
     # -- observability -----------------------------------------------------
     def metrics(self):
         snap = self.metrics_.snapshot()

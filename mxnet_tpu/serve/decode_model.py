@@ -334,10 +334,13 @@ def make_prefill(params, spec):
             qh = q.reshape(b, P, H, Dh)
             kh = k.reshape(b, P, H, Dh)
             vh = v.reshape(b, P, H, Dh)
-            s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
-            s = jnp.where(mask[:, None], s, _NEG_INF)
-            w = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhqk,bkhd->bqhd", w, vh).reshape(b, P, C)
+            oh = _dense_attend(qh, kh, vh)
+            if oh is None:
+                s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+                s = jnp.where(mask[:, None], s, _NEG_INF)
+                w = jax.nn.softmax(s, axis=-1)
+                oh = jnp.einsum("bhqk,bkhd->bqhd", w, vh)
+            o = oh.reshape(b, P, C)
             h = h + _dense(o, p["l%d_proj_w" % i], p["l%d_proj_b" % i])
             h = _mlp(h, p, i)
         hf = _ln(h, p["lnf_g"], p["lnf_b"])
@@ -361,6 +364,39 @@ def _gather_rows(table, idx):
     (kernels/take.py) when the tier allows; jnp.take otherwise."""
     from ..kernels import take as _take
     return _take.gather_pages(table, idx)
+
+
+def _paged_attend(q, k_tbl, v_tbl, bt, pos, *, heads, page_size):
+    """Tier-dispatched paged flash attention over the block table.
+
+    q is (S, W, C) — W query tokens per slot, row ``w`` of slot ``s`` at
+    logical position ``pos[s] + w`` (the decode/verify/chunk mask family:
+    ``t <= pos + w``, masked scores an exact -1e30 before the max, same
+    convention as the naive path). Returns (S, W, C), or None when the
+    tier policy or the kernel's eligibility guard keeps the site on its
+    gather + dense-softmax fallback — in which case the per-site reason
+    is already in ``tier.stats()['fallback']``. The kernel path never
+    materializes the (S, ctx, C) gathered context NOR the (S, ctx) f32
+    score tensor (the MXL512 discipline): pages are DMA'd inside the
+    kernel grid via the scalar-prefetched block table."""
+    from ..kernels import attention as _attn
+    return _attn.paged_attend_or_none(q, k_tbl, v_tbl, bt, pos,
+                                      heads=heads, page_size=page_size)
+
+
+def _dense_attend(qh, kh, vh):
+    """Tier-dispatched dense causal attention for prefill: (b, T, H, Dh)
+    heads-interior layout in, same layout out, or None on fallback.
+    Prefill's ``causal & valid`` mask equals plain causal on every row a
+    consumer reads (row ``r < length`` attends columns ``<= r``, all
+    valid; rows ``>= length`` are garbage-but-unread: commit scratches
+    their K/V and sampling reads row ``length-1``), so the kernel serves
+    the site with its causal mask alone."""
+    from ..kernels import attention as _attn
+    o = _attn.attend_or_none(qh.transpose(0, 2, 1, 3),
+                             kh.transpose(0, 2, 1, 3),
+                             vh.transpose(0, 2, 1, 3), causal=True)
+    return None if o is None else o.transpose(0, 2, 1, 3)
 
 
 def make_decode(params, spec):
@@ -406,15 +442,20 @@ def make_decode(params, spec):
             q, k, v = jnp.split(qkv, 3, axis=-1)                # (S, C)
             k_pages = k_pages.at[i, write_idx].set(k)
             v_pages = v_pages.at[i, write_idx].set(v)
-            k_ctx = _gather_rows(k_pages[i], ctx_idx)           # (S,ctx,C)
-            v_ctx = _gather_rows(v_pages[i], ctx_idx)
-            qh = q.reshape(S, H, Dh)
-            kh = k_ctx.reshape(S, ctx, H, Dh)
-            vh = v_ctx.reshape(S, ctx, H, Dh)
-            s = jnp.einsum("shd,sthd->sht", qh, kh) * scale
-            s = jnp.where(att[:, None, :], s, _NEG_INF)
-            w = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("sht,sthd->shd", w, vh).reshape(S, C)
+            o3 = _paged_attend(q[:, None, :], k_pages[i], v_pages[i],
+                               bt, positions, heads=H, page_size=page)
+            if o3 is not None:
+                o = o3[:, 0, :]                                 # (S, C)
+            else:
+                k_ctx = _gather_rows(k_pages[i], ctx_idx)       # (S,ctx,C)
+                v_ctx = _gather_rows(v_pages[i], ctx_idx)
+                qh = q.reshape(S, H, Dh)
+                kh = k_ctx.reshape(S, ctx, H, Dh)
+                vh = v_ctx.reshape(S, ctx, H, Dh)
+                s = jnp.einsum("shd,sthd->sht", qh, kh) * scale
+                s = jnp.where(att[:, None, :], s, _NEG_INF)
+                w = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("sht,sthd->shd", w, vh).reshape(S, C)
             h = h + _dense(o, p["l%d_proj_w" % i], p["l%d_proj_b" % i])
             h = _mlp(h, p, i)
         logits = _dense(_ln(h, p["lnf_g"], p["lnf_b"]),
@@ -504,13 +545,21 @@ def make_chunk_prefill(params, spec):
             q, k, v = jnp.split(qkv, 3, axis=-1)                 # (P, C)
             k_pages = k_pages.at[i, widx].set(k)
             v_pages = v_pages.at[i, widx].set(v)
-            kh = jnp.take(k_pages[i], ctx_idx, axis=0).reshape(ctx, H, Dh)
-            vh = jnp.take(v_pages[i], ctx_idx, axis=0).reshape(ctx, H, Dh)
-            qh = q.reshape(P, H, Dh)
-            s = jnp.einsum("qhd,thd->hqt", qh, kh) * scale
-            s = jnp.where(att[None], s, _NEG_INF)
-            w = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("hqt,thd->qhd", w, vh).reshape(P, C)
+            o3 = _paged_attend(q[None], k_pages[i], v_pages[i],
+                               bt[None], jnp.reshape(start, (1,)),
+                               heads=H, page_size=page)
+            if o3 is not None:
+                o = o3[0]                                        # (P, C)
+            else:
+                kh = jnp.take(k_pages[i], ctx_idx,
+                              axis=0).reshape(ctx, H, Dh)
+                vh = jnp.take(v_pages[i], ctx_idx,
+                              axis=0).reshape(ctx, H, Dh)
+                qh = q.reshape(P, H, Dh)
+                s = jnp.einsum("qhd,thd->hqt", qh, kh) * scale
+                s = jnp.where(att[None], s, _NEG_INF)
+                w = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("hqt,thd->qhd", w, vh).reshape(P, C)
             h = h + _dense_p(p, o, "l%d_proj_w" % i, "l%d_proj_b" % i)
             h = _mlp_p(h, p, i)
         hf = _ln(h, p["lnf_g"], p["lnf_b"])
@@ -593,13 +642,20 @@ def make_draft_verify(params, draft_params, spec, k):
             q, kk, vv = jnp.split(qkv, 3, axis=-1)
             dk_pages = dk_pages.at[i, widx].set(kk)
             dv_pages = dv_pages.at[i, widx].set(vv)
-            kh = _gather_rows(dk_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
-            vh = _gather_rows(dv_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
-            qh = q.reshape(S, H, Dh)
-            s = jnp.einsum("shd,sthd->sht", qh, kh) * scale
-            s = jnp.where(att[:, None, :], s, _NEG_INF)
-            w = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("sht,sthd->shd", w, vh).reshape(S, C)
+            o3 = _paged_attend(q[:, None, :], dk_pages[i], dv_pages[i],
+                               bt, dpos, heads=H, page_size=page)
+            if o3 is not None:
+                o = o3[:, 0, :]                                  # (S, C)
+            else:
+                kh = _gather_rows(dk_pages[i],
+                                  ctx_idx).reshape(S, ctx, H, Dh)
+                vh = _gather_rows(dv_pages[i],
+                                  ctx_idx).reshape(S, ctx, H, Dh)
+                qh = q.reshape(S, H, Dh)
+                s = jnp.einsum("shd,sthd->sht", qh, kh) * scale
+                s = jnp.where(att[:, None, :], s, _NEG_INF)
+                w = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("sht,sthd->shd", w, vh).reshape(S, C)
             h = h + _dense_p(dp, o, "l%d_proj_w" % i, "l%d_proj_b" % i)
             h = _mlp_p(h, dp, i)
         logits = _dense_p(dp, _ln(h, dp["lnf_g"], dp["lnf_b"]),
@@ -642,13 +698,20 @@ def make_draft_verify(params, draft_params, spec, k):
             q, kk, vv = jnp.split(qkv, 3, axis=-1)               # (S, W, C)
             k_pages = k_pages.at[i, widx].set(kk)
             v_pages = v_pages.at[i, widx].set(vv)
-            kh = _gather_rows(k_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
-            vh = _gather_rows(v_pages[i], ctx_idx).reshape(S, ctx, H, Dh)
-            qh = q.reshape(S, W, H, Dh)
-            s = jnp.einsum("swhd,sthd->shwt", qh, kh) * scale
-            s = jnp.where(att[:, None], s, _NEG_INF)
-            w = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("shwt,sthd->swhd", w, vh).reshape(S, W, C)
+            o3 = _paged_attend(q, k_pages[i], v_pages[i],
+                               bt, positions, heads=H, page_size=page)
+            if o3 is not None:
+                o = o3                                           # (S, W, C)
+            else:
+                kh = _gather_rows(k_pages[i],
+                                  ctx_idx).reshape(S, ctx, H, Dh)
+                vh = _gather_rows(v_pages[i],
+                                  ctx_idx).reshape(S, ctx, H, Dh)
+                qh = q.reshape(S, W, H, Dh)
+                s = jnp.einsum("swhd,sthd->shwt", qh, kh) * scale
+                s = jnp.where(att[:, None], s, _NEG_INF)
+                w = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("shwt,sthd->swhd", w, vh).reshape(S, W, C)
             h = h + _dense(o, p["l%d_proj_w" % i], p["l%d_proj_b" % i])
             h = _mlp(h, p, i)
         logits = _dense(_ln(h, p["lnf_g"], p["lnf_b"]),
@@ -668,40 +731,42 @@ def make_draft_verify(params, draft_params, spec, k):
 
 
 def suggest_speculation_depth(spec, device_kind=None, max_k=8,
-                              acceptance=0.8):
+                              acceptance=0.8, draft_bytes_ratio=0.25):
     """Roofline-derived speculation depth (no hard-coded k).
 
     Models one decode step of each engine on the target chip via
     :func:`mxnet_tpu.perfmodel.roofline_seconds` — decode is weight-
-    bandwidth bound, so the int8 draft moves ~1/4 the bytes and the
-    (k+1)-wide verifier amortizes one weight read over k+1 tokens —
-    then picks the k maximizing expected emitted tokens per second
-    under a geometric acceptance model E[k] = (1-a^(k+1))/(1-a)
-    (the learned-TPU-cost-model idea of PAPERS.md arxiv 2008.01040,
-    computed analytically from the artifact geometry instead of a
-    measurement)."""
+    bandwidth bound, so the int8 draft moves ``draft_bytes_ratio`` of
+    the verifier's weight bytes (1/4 for int8-over-f32, the default)
+    and the (k+1)-wide verifier amortizes one weight read over k+1
+    tokens — then hands the two step costs to the pure-math policy
+    :func:`mxnet_tpu.perfmodel.speculation_depth`, which picks the k
+    maximizing expected emitted tokens per second under a geometric
+    acceptance model E[k] = (1-a^(k+1))/(1-a) (the learned-TPU-cost-
+    model idea of PAPERS.md arxiv 2008.01040, computed analytically
+    from the artifact geometry instead of a measurement). The result
+    clamps to the artifact's speculative window: make_draft_verify
+    rejects k > max_prompt_len, so the policy never suggests a depth
+    the cache geometry cannot carry."""
     spec.validate()
     from .. import perfmodel
     kind = device_kind or perfmodel.DEFAULT_DEVICE_KIND
     L, C, V = spec.num_layers, spec.dim, spec.vocab
     S, ctx = spec.max_slots, spec.max_context
     n_par = float(12 * L * C * C + 2 * V * C + ctx * C)
+    verify_w_bytes = 4.0 * n_par             # f32 weight read
     kv_bytes = 2.0 * L * ctx * C * 4 * S     # worst-case pages gathered
-    a = min(max(acceptance, 1e-3), 0.999)
-    t_draft = perfmodel.roofline_seconds(2.0 * n_par * S,
-                                         n_par + kv_bytes, kind)
+    ratio = min(max(float(draft_bytes_ratio), 1e-3), 1.0)
+    t_draft = perfmodel.roofline_seconds(
+        2.0 * n_par * S, ratio * verify_w_bytes + kv_bytes, kind)
 
     def t_verify(width):
         return perfmodel.roofline_seconds(2.0 * n_par * S * width,
-                                          4.0 * n_par + kv_bytes, kind)
+                                          verify_w_bytes + kv_bytes, kind)
 
-    best_k, best_rate = 1, 0.0
-    for kk in range(1, max(1, int(max_k)) + 1):
-        expected = (1.0 - a ** (kk + 1)) / (1.0 - a)
-        rate = expected / (kk * t_draft + t_verify(kk + 1))
-        if rate > best_rate:
-            best_k, best_rate = kk, rate
-    return best_k
+    window = max(1, min(int(max_k), spec.max_prompt_len))
+    return perfmodel.speculation_depth(t_draft, t_verify, max_k=window,
+                                       acceptance=acceptance)
 
 
 # -- dense reference (tests) ------------------------------------------------

@@ -138,6 +138,11 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
         gauge("ddp/overlap_ms",
               "model-estimated collective ms hidden under backward").set(
                   ddp.get("overlap_ms", 0.0))
+        if "bucket_bytes_model" in ddp:
+            gauge("ddp/bucket_bytes_model",
+                  "interconnect-table bucket size the GradReducer "
+                  "planned against (choose_bucket_bytes)").set(
+                      ddp.get("bucket_bytes_model", 0))
         if "sparse_comm_bytes" in ddp:
             counter("ddp/sparse_comm_bytes",
                     "coalesced sparse-gradient bytes exchanged (touched "

@@ -24,7 +24,16 @@ __all__ = ["FEATURE_NAMES", "features", "LinearCostModel",
            "default_model", "save_weights", "default_weights_path"]
 
 FEATURE_NAMES = ("hbm_time_us", "flop_time_us", "grid_overhead_us",
-                 "misalign", "waste", "vmem_frac")
+                 "misalign", "waste", "vmem_frac",
+                 # fusion-structure features (attention family): static
+                 # bytes/flops cannot separate two tilings of the SAME
+                 # computation, so these capture what the fusion actually
+                 # changes — elementwise online-softmax work off the MXU,
+                 # DMA issue count, and lane/sublane tile padding. Exactly
+                 # 0.0 for the pre-existing elementwise/gather ops, so
+                 # their committed rankings (and the reproduction test
+                 # over tools/kernel_tuning.json) are untouched.
+                 "vpu_time_us", "dma_steps", "tile_waste")
 
 
 def _dtype_bytes(dtype):
@@ -77,8 +86,52 @@ def features(op, shapes, dtype, config,
         vmem = 2 * bd * b
         misalign = 1 if bd % 128 != 0 else 0
         waste = Dp / float(max(D, 1)) - 1.0
+    elif op == "flash_attn":
+        (BH, Tq, D), (_BH2, Tk, _D2) = shapes[:2]
+        bq, bk = config["block_q"], config["block_k"]
+        Tqp, Tkp = _pad(Tq, bq), _pad(Tk, bk)
+        n_q, n_k = Tqp // bq, Tkp // bk
+        # KV tiles are re-streamed once per q block (the flash trade:
+        # no (T, T) score tensor in HBM, more KV reads)
+        hbm_bytes = BH * (2 * Tqp * D + 2 * n_q * Tkp * D) * b
+        score_elems = float(BH) * Tqp * Tkp
+        flops = 4.0 * score_elems * D              # QK^T + PV on the MXU
+        vpu_ops = 12.0 * score_elems               # exp/max/sum/correct
+        grid = BH * n_q * n_k
+        dma = 2.0 * grid                           # one k + one v tile/step
+        vmem = (2 * bq * D + 2 * bk * D) * b \
+            + (2 * bq + bq * D) * 4 + bq * bk * 4
+        misalign = (bq % 8 != 0) + (bk % 128 != 0)
+        waste = score_elems / float(max(Tq * Tk * BH, 1)) - 1.0
+        tile_w = (_pad(bk, 128) / float(bk) - 1.0) \
+            + (_pad(bq, 8) / float(bq) - 1.0) \
+            + (_pad(D, 128) / float(D) - 1.0)
+    elif op == "flash_attn_paged":
+        (S, W, H, Dh), (MP, page) = shapes[:2]
+        bh = config["block_h"]
+        lanes = bh * Dh
+        heads_grid = max(1, H // max(bh, 1))
+        grid = S * heads_grid * MP
+        ctx = MP * page
+        # q/out DMA'd once per (slot, head-block); k/v pages every step.
+        # Total page bytes are bh-invariant — the knob moves DMA count
+        # and lane fill, which is exactly what the new features carry.
+        hbm_bytes = (2 * S * heads_grid * W * lanes
+                     + 2 * grid * page * lanes) * b
+        score_elems = float(S) * W * H * ctx
+        flops = 4.0 * score_elems * Dh
+        vpu_ops = 12.0 * score_elems
+        dma = 2.0 * grid
+        vmem = (2 * W * lanes + 2 * page * lanes) * b \
+            + (2 * W * bh + W * lanes) * 4
+        misalign = (lanes % 128 != 0) + (page % 8 != 0)
+        waste = 0.0
+        tile_w = (_pad(lanes, 128) / float(lanes) - 1.0) \
+            + (_pad(W, 8) / float(W) - 1.0)
     else:
         raise KeyError("no cost features for op %r" % (op,))
+    if op not in ("flash_attn", "flash_attn_paged"):
+        vpu_ops, dma, tile_w = 0.0, 0.0, 0.0
     return {
         "hbm_time_us": 1e6 * hbm_bytes / hbm_bytes_per_s(device_kind),
         "flop_time_us": 1e6 * flops / peak_flops(device_kind),
@@ -86,6 +139,11 @@ def features(op, shapes, dtype, config,
         "misalign": float(misalign),
         "waste": max(0.0, waste),
         "vmem_frac": vmem / float(VMEM_BYTES),
+        # VPU throughput ~ an eighth of the MXU peak: elementwise
+        # online-softmax work that bytes/flops features cannot see
+        "vpu_time_us": 1e6 * vpu_ops / (peak_flops(device_kind) / 8.0),
+        "dma_steps": float(dma),
+        "tile_waste": max(0.0, float(tile_w)),
     }
 
 
@@ -109,6 +167,11 @@ class LinearCostModel:
         "misalign": 50.0,
         "waste": 30.0,
         "vmem_frac": 5.0,
+        # fusion-structure terms (0-valued features for the older ops,
+        # so their scores are bit-identical to the 6-feature model)
+        "vpu_time_us": 1.0,
+        "dma_steps": 0.02,     # ~20ns DMA issue cost per tile
+        "tile_waste": 10.0,
     }
 
     def predict(self, feat):
@@ -135,7 +198,10 @@ class LinearCostModel:
         return dict(self.weights)
 
 
-WEIGHTS_VERSION = 1
+# v2: FEATURE_NAMES grew the fusion-structure triple (vpu_time_us,
+# dma_steps, tile_waste); v1 weight files lack those columns and are
+# cleanly rejected by _load_weights (ship weights win)
+WEIGHTS_VERSION = 2
 _loaded_weights = (None, None, None)   # (path, mtime, weights | None)
 
 

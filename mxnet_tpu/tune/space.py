@@ -19,6 +19,7 @@ VMEM_BYTES = 8 * 1024 * 1024
 _BLOCK_R = (8, 16, 32, 64, 128, 256, 512)
 _BLOCK_S = (128, 256, 512, 1024, 2048)
 _BLOCK_D = (128, 256, 512, 1024)
+_BLOCK_A = (16, 32, 64, 128, 256, 512)   # attention q/kv tile rows
 
 
 def _dtype_bytes(dtype):
@@ -68,6 +69,34 @@ def space_for(op, shapes, dtype):
         for bd in _clamp_pow2ish(_BLOCK_D, D):
             if D % bd == 0 and 2 * bd * b <= VMEM_BYTES:
                 out.append({"block_d": bd})
+    elif op == "flash_attn":
+        # shapes = ((B*H, Tq, D), (B*H, Tk, D)); the knobs are the
+        # online-softmax tile: q rows resident per step x KV rows streamed
+        (BH, Tq, D) = shapes[0]
+        Tk = shapes[1][1]
+        for bq in _clamp_pow2ish(_BLOCK_A, Tq):
+            for bk in _clamp_pow2ish(_BLOCK_A, Tk):
+                # q + k + v + out tiles, plus f32 m/l/acc scratch
+                vmem = (2 * bq * D + 2 * bk * D) * b \
+                    + (2 * bq + bq * D) * 4 + bq * bk * 4
+                if vmem <= VMEM_BYTES:
+                    out.append({"block_q": bq, "block_k": bk})
+    elif op == "flash_attn_paged":
+        # shapes = ((S, W, H, Dh), (MP, page)); one knob — heads fused
+        # per grid step (lane dim = block_h * Dh, DMAs get bigger and
+        # the grid smaller as it grows). Must divide H, and the lane dim
+        # must be Mosaic-valid: 128-aligned, or the full width (bh == H)
+        (S, W, H, Dh) = shapes[0]
+        (MP, page) = shapes[1]
+        cands = sorted({bh for bh in (1, 2, 4, 8, 16)
+                        if bh <= H and H % bh == 0
+                        and (bh * Dh) % 128 == 0} | {H})
+        for bh in cands:
+            lanes = bh * Dh
+            vmem = (2 * W * lanes + 2 * page * lanes) * b \
+                + (2 * W * bh + W * lanes) * 4
+            if vmem <= VMEM_BYTES:
+                out.append({"block_h": bh})
     else:
         raise KeyError("no tuning space for op %r" % (op,))
     if not out:
@@ -76,6 +105,12 @@ def space_for(op, shapes, dtype):
 
 
 def default_config(op, shapes, dtype):
-    """Heuristic config for untuned dispatch ('auto' tier cache miss)."""
+    """Heuristic config for untuned dispatch ('auto' tier cache miss).
+    Modules housing several tier ops expose ``default_config_for(op,
+    shapes)`` (kernels/attention.py); single-op modules keep the plain
+    ``DEFAULT_CONFIG`` attribute."""
     from .. import kernels
-    return dict(kernels.kernel_module(op).DEFAULT_CONFIG)
+    mod = kernels.kernel_module(op)
+    if hasattr(mod, "default_config_for"):
+        return dict(mod.default_config_for(op, shapes))
+    return dict(mod.DEFAULT_CONFIG)

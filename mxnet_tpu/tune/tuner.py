@@ -64,6 +64,27 @@ def _runner(op, shapes, dtype, config):
         fn = jax.jit(lambda a, i: mod._call(a, i, config["block_d"],
                                             False))
         args = (w, idx)
+    elif op == "flash_attn":
+        (BH, Tq, D) = shapes[0]
+        Tk = shapes[1][1]
+        q = jnp.zeros((BH, 1, Tq, D), jdt)
+        kv = jnp.zeros((BH, 1, Tk, D), jdt)
+        cfg = mod._Cfg(config["block_q"], config["block_k"],
+                       Tq == Tk, False)       # causal when self-attention
+        fn = jax.jit(lambda a, b_, c: mod._call(a, b_, c, cfg))
+        args = (q, kv, kv)
+    elif op == "flash_attn_paged":
+        (S, W, H, Dh) = shapes[0]
+        (MP, page) = shapes[1]
+        n_pages = S * MP + 1                  # page 0 = scratch, like serve
+        kv = jnp.zeros((n_pages * page, H * Dh), jdt)
+        q = jnp.zeros((S, W, H * Dh), jdt)
+        bt = (1 + jnp.arange(S * MP, dtype=jnp.int32)).reshape(S, MP)
+        pos = jnp.full((S,), MP * page - 1, jnp.int32)   # worst-case ctx
+        fn = jax.jit(lambda a, kp, vp, b_, p_: mod._paged_call(
+            a, kp, vp, b_, p_, heads=H, page_size=page,
+            block_h=config["block_h"], interpret=False))
+        args = (q, kv, kv, bt, pos)
     else:
         raise KeyError("no tuner runner for op %r" % (op,))
     return fn, args

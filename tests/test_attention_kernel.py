@@ -1,0 +1,584 @@
+"""Flash-attention kernel family (kernels/attention.py) — chip-free.
+
+The acceptance property mirrors the PR-6 tier contract: the fused
+kernels may change WALL TIME, never NUMBERS. Forward parity against the
+dense pure-JAX reference (f32-widened tolerance), backward grads
+bitwise-identical to the reference under the same cotangent, served
+decode token streams bitwise-equal with the tier auto vs off — greedy
+and sampled, speculation on and off, across an eviction/resume stitch —
+and the TPU-platform export census proving the kernels actually lower
+(mxk_flash_attn / mxk_flash_attn_paged custom calls) in the fused train
+step, the decode module, and the v5 draft/verify module.
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, hlo_stats, serving, sym
+from mxnet_tpu.kernels import attention as attn
+from mxnet_tpu.kernels import tier
+from mxnet_tpu.serve import Evicted, GenerateSession
+from mxnet_tpu.serve import decode_model as dm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _qkv(b=2, h=3, t=64, d=16, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(
+        (rng.randn(b, h, t, d) / np.sqrt(d)).astype(np.float32), dtype)
+    return mk(), mk(), mk()
+
+
+# ---------------------------------------------------------------------------
+# dense training kernel: forward parity, backward bitwise
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cfg", [{"block_q": 16, "block_k": 16},
+                                 {"block_q": 32, "block_k": 16},
+                                 {"block_q": 128, "block_k": 128}])
+def test_dense_forward_matches_reference_f32(cfg):
+    q, k, v = _qkv()
+    out = attn.flash_attention(q, k, v, causal=True, config=cfg)
+    ref = attn.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_dense_forward_uneven_tail():
+    # T=56 not a multiple of the 16-row blocks: the padding path, and
+    # the tail-mask convention (padded KV rows contribute exact zeros)
+    q, k, v = _qkv(t=56)
+    cfg = {"block_q": 16, "block_k": 16}
+    for causal in (True, False):
+        out = attn.flash_attention(q, k, v, causal=causal, config=cfg)
+        ref = attn.reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-6, atol=2e-6)
+
+
+def test_dense_forward_bf16_accumulates_f32():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = attn.flash_attention(q, k, v, causal=True,
+                               config={"block_q": 16, "block_k": 16})
+    assert out.dtype == jnp.bfloat16
+    ref = attn.reference_attention(q.astype(jnp.float32),
+                                   k.astype(jnp.float32),
+                                   v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_dense_backward_bitwise_equals_reference(causal):
+    """The custom_vjp differentiates reference_attention itself, so under
+    the SAME cotangent the grads are bit-identical, not merely close."""
+    q, k, v = _qkv(t=48, d=8, seed=3)
+    _, vjp_k = jax.vjp(
+        lambda a, b, c: attn.flash_attention(
+            a, b, c, causal=causal, config={"block_q": 16, "block_k": 16}),
+        q, k, v)
+    _, vjp_r = jax.vjp(
+        lambda a, b, c: attn.reference_attention(a, b, c, causal=causal),
+        q, k, v)
+    g = jnp.asarray(np.random.RandomState(9).randn(*q.shape), jnp.float32)
+    for gk, gr in zip(vjp_k(g), vjp_r(g)):
+        assert jnp.array_equal(gk, gr), "grad not bitwise"
+
+
+def test_dense_guard_reasons():
+    f32, i32 = jnp.float32, jnp.int32
+    ok = ((2, 3, 64, 16),) * 3
+    assert attn.eligible(*ok, f32) is None
+    assert "4-D" in attn.eligible((2, 64, 16), ok[1], ok[2], f32)
+    assert "dtype" in attn.eligible(*ok, i32)
+    assert "cross-length" in attn.eligible(
+        (2, 3, 32, 16), (2, 3, 64, 16), (2, 3, 64, 16), f32, causal=True)
+    # non-causal cross-length IS eligible (prefill-style windows)
+    assert attn.eligible((2, 3, 32, 16), (2, 3, 64, 16), (2, 3, 64, 16),
+                         f32, causal=False) is None
+    assert "head_dim" in attn.eligible(
+        (2, 3, 64, 1024), (2, 3, 64, 1024), (2, 3, 64, 1024), f32)
+    assert "disagree" in attn.eligible(
+        (2, 3, 64, 16), (2, 4, 64, 16), (2, 4, 64, 16), f32, causal=False)
+
+
+def test_attend_or_none_tier_policy_and_fallback_census():
+    q, k, v = _qkv(t=32, d=8)
+    with config.override(kernel_tier="off"):
+        tier.reset_stats()
+        assert attn.attend_or_none(q, k, v) is None
+    with config.override(kernel_tier="auto"):
+        tier.reset_stats()
+        out = attn.attend_or_none(q, k, v)
+        assert out is not None
+        # an ineligible call on the same tier records its reason per site
+        assert attn.attend_or_none(q.astype(jnp.int32), k.astype(jnp.int32),
+                                   v.astype(jnp.int32)) is None
+        st = tier.stats()
+    assert st["dispatch"].get("flash_attn") == 1
+    assert any(k_.startswith("flash_attn:") and "dtype" in k_
+               for k_ in st["fallback"]), st["fallback"]
+    ref = attn.reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# paged serving kernel: parity vs the naive gather+softmax reference
+# ---------------------------------------------------------------------------
+
+def _softmax(x):
+    m = x.max(axis=-1, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _paged_ref(q, kp, vp, bt, pos, heads, page):
+    """Naive paged attention: gather every page a slot may see, dense
+    softmax with the positional mask (-1e30 before the max)."""
+    S, W, C = q.shape
+    Dh = C // heads
+    MP = bt.shape[1]
+    ctx = MP * page
+    out = np.zeros((S, W, C), np.float32)
+    for s in range(S):
+        rows = (np.asarray(bt)[s][:, None] * page
+                + np.arange(page)[None, :]).reshape(-1)
+        k_ctx = np.asarray(kp)[rows].reshape(ctx, heads, Dh)
+        v_ctx = np.asarray(vp)[rows].reshape(ctx, heads, Dh)
+        qs = np.asarray(q)[s].reshape(W, heads, Dh)
+        t_pos = np.arange(ctx)[None, :]
+        q_pos = int(pos[s]) + np.arange(W)[:, None]
+        for h in range(heads):
+            s_mat = (qs[:, h] @ k_ctx[:, h].T) / math.sqrt(Dh)
+            s_mat = np.where(t_pos <= q_pos, s_mat, -1e30)
+            out[s, :, h * Dh:(h + 1) * Dh] = _softmax(s_mat) @ v_ctx[:, h]
+    return out
+
+
+def _paged_setup(S=3, W=5, heads=4, Dh=8, page=8, MP=4, seed=0):
+    rng = np.random.RandomState(seed)
+    C = heads * Dh
+    n_pages = S * MP + 1          # page 0 reserved like the real cache
+    kp = jnp.asarray(rng.randn(n_pages * page, C).astype(np.float32))
+    vp = jnp.asarray(rng.randn(n_pages * page, C).astype(np.float32))
+    q = jnp.asarray((rng.randn(S, W, C) / np.sqrt(Dh)).astype(np.float32))
+    bt = jnp.asarray(1 + np.arange(S * MP).reshape(S, MP), jnp.int32)
+    # ragged positions: slot 0 mid-page, others deeper into the table
+    pos = jnp.asarray([3 + (MP * page - W) * s // max(1, S - 1)
+                       for s in range(S)], jnp.int32)
+    return q, kp, vp, bt, pos
+
+
+@pytest.mark.parametrize("heads,Dh,block_h", [
+    (4, 8, 4),        # lanes == C: the always-valid full-width block
+    (2, 128, 1),      # 128-aligned lane dim, grid over head pairs
+    (2, 128, 2),
+])
+def test_paged_forward_matches_naive_reference(heads, Dh, block_h):
+    q, kp, vp, bt, pos = _paged_setup(heads=heads, Dh=Dh)
+    out = attn.paged_attention(q, kp, vp, bt, pos, heads=heads,
+                               page_size=8, config={"block_h": block_h})
+    ref = _paged_ref(q, kp, vp, bt, pos, heads, 8)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_window_one(edge_pos=0):
+    # the decode step shape: W=1, and a slot sitting at position 0 only
+    # sees its first token (everything else masked to an exact 0 weight)
+    q, kp, vp, bt, _ = _paged_setup(W=1)
+    pos = jnp.asarray([edge_pos, 7, 24], jnp.int32)
+    out = attn.paged_attention(q, kp, vp, bt, pos, heads=4, page_size=8)
+    ref = _paged_ref(q, kp, vp, bt, pos, 4, 8)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_invalid_block_h_self_heals():
+    # heads=4, Dh=8: lanes for block_h=2 is 16 — Mosaic-invalid, so the
+    # call must fall back to the full-width head block, not crash
+    q, kp, vp, bt, pos = _paged_setup()
+    out = attn.paged_attention(q, kp, vp, bt, pos, heads=4, page_size=8,
+                               config={"block_h": 2})
+    ref = _paged_ref(q, kp, vp, bt, pos, 4, 8)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_guard_reasons():
+    f32 = jnp.float32
+    q, pages, bt, pos = (3, 5, 32), (264, 32), (3, 4), (3,)
+    assert attn.paged_eligible(q, pages, bt, pos, f32, 4, 8) is None
+    assert "sublane" in attn.paged_eligible(q, pages, bt, pos, f32, 4, 4)
+    assert "3-D" in attn.paged_eligible((3, 5, 4, 8), pages, bt, pos,
+                                        f32, 4, 8)
+    assert "divisible by heads" in attn.paged_eligible(
+        q, pages, bt, pos, f32, 5, 8)
+    assert "whole number" in attn.paged_eligible(
+        q, (260, 32), bt, pos, f32, 4, 8)
+    assert "block table" in attn.paged_eligible(
+        q, pages, (2, 4), pos, f32, 4, 8)
+    assert "dtype" in attn.paged_eligible(q, pages, bt, pos,
+                                          jnp.int32, 4, 8)
+
+
+def test_paged_attend_or_none_records_page_size_fallback():
+    q, kp, vp, bt, pos = _paged_setup()
+    with config.override(kernel_tier="auto"):
+        tier.reset_stats()
+        assert attn.paged_attend_or_none(
+            q, kp, vp, bt, pos, heads=4, page_size=4) is None
+        out = attn.paged_attend_or_none(
+            q, kp, vp, bt, pos, heads=4, page_size=8)
+        st = tier.stats()
+    assert out is not None
+    assert st["dispatch"].get("flash_attn_paged") == 1
+    assert any(k.startswith("flash_attn_paged:") and "sublane" in k
+               for k in st["fallback"]), st["fallback"]
+
+
+# ---------------------------------------------------------------------------
+# served decode: tokens bitwise tier=auto vs tier=off
+# ---------------------------------------------------------------------------
+
+SPEC8 = dm.DecoderSpec(vocab=61, dim=32, num_heads=4, num_layers=2,
+                       max_prompt_len=8, page_size=8, max_pages_per_slot=6,
+                       max_slots=4, num_pages=25)
+
+WORK8 = [  # (prompt, max_new, temperature, seed) — greedy AND sampled
+    ([5, 9, 13], 12, 0.0, 0),
+    ([2, 3], 3, 0.0, 0),
+    ([4, 4, 4, 4, 6, 7], 8, 0.9, 11),
+    ([7], 2, 0.0, 0),
+    ([11, 60, 1, 2, 3], 16, 0.7, 5),
+    ([8, 8, 9], 5, 0.0, 0),
+]
+
+
+@pytest.fixture(scope="module")
+def params8():
+    return dm.init_params(SPEC8, seed=0)
+
+
+@pytest.fixture(scope="module")
+def tier_arts(tmp_path_factory, params8):
+    """One artifact per tier setting (the tier is resolved at export/
+    lowering time), plain and speculative."""
+    d = tmp_path_factory.mktemp("attn_arts")
+    draft = dm.quantize_decoder_params(params8)
+    arts = {}
+    for t in ("auto", "off"):
+        with config.override(kernel_tier=t):
+            plain = str(d / ("m_%s.gen.mxtpu" % t))
+            spec = str(d / ("m_%s.spec.mxtpu" % t))
+            serving.export_generate(params8, SPEC8, plain)
+            serving.export_generate(params8, SPEC8, spec,
+                                    draft_params=draft, speculate_k=3)
+            arts[t] = (plain, spec)
+    return arts
+
+
+def _drive(sess, reqs, cap=400):
+    rounds = 0
+    while not all(r.done() for r in reqs) and rounds < cap:
+        sess.run_round()
+        rounds += 1
+    assert all(r.done() for r in reqs), "scheduler stalled"
+    return [r.result(timeout=1.0) for r in reqs]
+
+
+def _serve_all(path, work, **kw):
+    with config.override(kernel_tier=kw.pop("tier")):
+        sess = GenerateSession(path, auto_start=False, timeout_ms=0, **kw)
+        reqs = [sess.submit(p, max_new_tokens=n, temperature=t, seed=s)
+                for p, n, t, s in work]
+        outs = _drive(sess, reqs)
+        sess.close(drain=True)
+    return [o["tokens"] for o in outs]
+
+
+def test_decode_tokens_bitwise_auto_vs_off(tier_arts):
+    on = _serve_all(tier_arts["auto"][0], WORK8, tier="auto")
+    off = _serve_all(tier_arts["off"][0], WORK8, tier="off")
+    assert on == off
+
+
+def test_decode_tokens_bitwise_speculative_auto_vs_off(tier_arts):
+    on = _serve_all(tier_arts["auto"][1], WORK8, tier="auto",
+                    speculative=True)
+    off = _serve_all(tier_arts["off"][1], WORK8, tier="off",
+                     speculative=True)
+    no_spec = _serve_all(tier_arts["off"][1], WORK8, tier="off",
+                         speculative=False)
+    assert on == off == no_spec
+
+
+def test_eviction_resume_stitches_bitwise_across_tiers(tier_arts):
+    """Cursor migration across the tier boundary: a request evicted from
+    a kernel-tier server resumes on a naive-path server (and vice versa)
+    with the stitched stream equal to the uninterrupted one."""
+    prompt, n = [5, 9, 13], 14
+    full = _serve_all(tier_arts["off"][0], [(prompt, n, 0.0, 0)],
+                      tier="off")[0]
+    for first, then in (("auto", "off"), ("off", "auto")):
+        with config.override(kernel_tier=first):
+            sess = GenerateSession(tier_arts[first][0], auto_start=False,
+                                   timeout_ms=0, drain_tokens=2)
+            req = sess.submit(prompt, max_new_tokens=n, temperature=0.0,
+                              seed=0)
+            for _ in range(2):   # few tokens: the resume prompt must
+                sess.run_round()  # still fit the v3 max_prompt_len
+            sess.close(drain=True)     # bounded drain -> evict + cursor
+        with pytest.raises(Evicted) as ei:
+            req.result(timeout=1.0)
+        exc = ei.value
+        assert exc.cursor["resume_prompt"] == prompt + exc.tokens
+        assert 0 < len(exc.tokens) < n
+        with config.override(kernel_tier=then):
+            sess2 = GenerateSession(tier_arts[then][0], auto_start=False,
+                                    timeout_ms=0)
+            tail = _drive(sess2, [sess2.submit(
+                exc.cursor["resume_prompt"],
+                max_new_tokens=n - len(exc.tokens), temperature=0.0,
+                seed=0)])[0]["tokens"]
+            sess2.close(drain=True)
+        assert exc.tokens + tail == full, (first, then)
+
+
+def test_decode_sync_budget_one_d2h_per_step_with_kernel(tier_arts):
+    """The kernel path must not add device syncs: still exactly one d2h
+    fetch per decode step plus one per prefill batch."""
+    from mxnet_tpu import profiler
+    with config.override(kernel_tier="auto"):
+        sess = GenerateSession(tier_arts["auto"][0], auto_start=False,
+                               timeout_ms=0)
+        reqs = [sess.submit(p, max_new_tokens=n, temperature=t, seed=s)
+                for p, n, t, s in WORK8[:4]]
+        before = profiler.sync_counters()["d2h"]
+        _drive(sess, reqs)
+        prefills = sess.metrics_.prefill_batches
+        sess._publish_window(force=True)
+        snap = sess.metrics_.snapshot()
+        after = profiler.sync_counters()["d2h"]
+        sess.close(drain=True)
+    steps = snap["decode_steps"]
+    assert prefills >= 1 and steps >= 1
+    assert after - before == steps + prefills, (after - before, steps,
+                                                prefills)
+
+
+def test_mxl512_clean_at_auto_fires_at_off(tier_arts):
+    for t, clean in (("auto", True), ("off", False)):
+        with config.override(kernel_tier=t):
+            sess = GenerateSession(tier_arts[t][0], auto_start=False,
+                                   timeout_ms=0)
+            diags = sess.check_attention_discipline()
+            # the cache-discipline and spec gates stay clean either way
+            assert sess.check_discipline() == []
+            sess.close(drain=True)
+        if clean:
+            assert diags == [], [str(d) for d in diags]
+        else:
+            assert diags and all(d.rule == "MXL512" for d in diags)
+            assert "softmax exponential" in str(diags[0])
+
+
+# ---------------------------------------------------------------------------
+# TPU-platform export census: the kernels actually lower via Mosaic
+# ---------------------------------------------------------------------------
+
+def _tpu_census(fn, *args):
+    from jax import export
+    with tier.force_compiled():
+        exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    return hlo_stats.pallas_kernel_names(exp.mlir_module())
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "cpu",
+                    reason="chip-free export census is CPU-host-defined")
+def test_export_census_decode_and_draft_verify_modules(params8):
+    SDS = jax.ShapeDtypeStruct
+    i32, f32 = jnp.int32, jnp.float32
+    S, MP = SPEC8.max_slots, SPEC8.max_pages_per_slot
+    L, C, R = SPEC8.num_layers, SPEC8.dim, SPEC8.cache_rows
+    pages = SDS((L, R, C), f32)
+    draft = dm.quantize_decoder_params(params8)
+    with config.override(kernel_tier="auto"):
+        tier.reset_stats()
+        dec = _tpu_census(
+            dm.make_decode(params8, SPEC8),
+            SDS((S, 1), i32), SDS((S,), i32), SDS((S, MP), i32),
+            SDS((S,), f32), SDS((S,), i32), pages, pages)
+        ver = _tpu_census(
+            dm.make_draft_verify(params8, draft, SPEC8, 3),
+            SDS((S, 1), i32), SDS((S,), i32), SDS((S, MP), i32),
+            SDS((S,), f32), SDS((S,), i32), pages, pages, pages, pages)
+        st = tier.stats()
+    # one paged kernel per layer in the decode step; the verifier runs
+    # target AND draft stacks (draft token-steps + (k+1)-window verify)
+    assert dec.get("mxk_flash_attn_paged", 0) == SPEC8.num_layers, dec
+    assert ver.get("mxk_flash_attn_paged", 0) > SPEC8.num_layers, ver
+    assert st["dispatch"].get("flash_attn_paged", 0) >= 2 * SPEC8.num_layers
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "cpu",
+                    reason="chip-free export census is CPU-host-defined")
+def test_export_census_artifact_meta_carries_kernels(tmp_path, params8):
+    with config.override(kernel_tier="auto"):
+        with tier.force_compiled():
+            meta = serving.export_generate(
+                params8, SPEC8, str(tmp_path / "m.gen.mxtpu"),
+                platforms=["tpu"])
+    kt = meta["kernel_tier"]
+    assert kt["tier"] == "auto" and "tuning_fingerprint" in kt
+    assert kt["pallas_kernels"].get("mxk_flash_attn_paged", 0) \
+        >= SPEC8.num_layers, kt
+
+
+# ---------------------------------------------------------------------------
+# graph fusion + fused train step: the GPT path picks the kernel up
+# ---------------------------------------------------------------------------
+
+def _naive_attn_bind(b=2, h=2, t=32, d=8, scale=None):
+    """The naive spelling graph_fuse matches: batch_dot(softmax(scale *
+    batch_dot(q, k, transpose_b=True)), v) over (B*H, T, D)."""
+    rng = np.random.RandomState(11)
+    q = sym.Variable("q")
+    k = sym.Variable("k")
+    v = sym.Variable("v")
+    s = sym.batch_dot(q, k, transpose_b=True) \
+        * (1.0 / math.sqrt(d) if scale is None else scale)
+    out = sym.batch_dot(sym.softmax(s, axis=-1), v)
+    args = {n: mx.nd.array(rng.randn(b * h, t, d).astype(np.float32))
+            for n in ("q", "k", "v")}
+    grads = {n: mx.nd.zeros(a.shape) for n, a in args.items()}
+    return out.bind(mx.cpu(), args, args_grad=grads)
+
+
+def test_graph_fuse_naive_attention_parity_and_dispatch():
+    def run(tier_val):
+        with config.override(kernel_tier=tier_val):
+            tier.reset_stats()
+            ex = _naive_attn_bind()
+            out = ex.forward(is_train=True)[0]
+            ex.backward(mx.nd.ones(out.shape))
+            st = dict(tier.stats()["dispatch"])
+        return ([out.asnumpy()] + [g.asnumpy() for g in ex.grad_arrays],
+                st)
+
+    off, _ = run("off")
+    auto, st = run("auto")
+    assert st.get("flash_attn", 0) >= 1, st
+    for a, b in zip(off, auto):
+        assert float(np.max(np.abs(a - b))) < 2e-5
+
+
+def test_graph_fuse_wrong_scale_falls_back():
+    with config.override(kernel_tier="auto"):
+        tier.reset_stats()
+        ex = _naive_attn_bind(scale=0.5)     # not 1/sqrt(d)
+        ex.forward(is_train=True)
+        st = tier.stats()
+    assert st["dispatch"].get("flash_attn", 0) == 0
+    assert any("1/sqrt(d)" in k for k in st["fallback"]), st["fallback"]
+
+
+def _gpt_attn_module(batch=4, seq=16, embed=32, heads=4):
+    """A miniature of the example GPT's attention block through the
+    Module fused train step (examples/train_transformer_lm.py spelling:
+    F.contrib.FlashAttention over head-split projections)."""
+    from mxnet_tpu.io import DataDesc
+    data = mx.sym.Variable("data")               # (B, T, C)
+    qkv = mx.sym.FullyConnected(data, num_hidden=3 * embed, flatten=False,
+                                name="attn_qkv")
+    qkv = mx.sym.reshape(qkv, shape=(0, 0, heads, 3, embed // heads))
+    qkv = mx.sym.transpose(qkv, axes=(3, 0, 2, 1, 4))  # (3, B, H, T, D)
+    q = mx.sym.squeeze(mx.sym.slice_axis(qkv, axis=0, begin=0, end=1),
+                       axis=0)
+    k = mx.sym.squeeze(mx.sym.slice_axis(qkv, axis=0, begin=1, end=2),
+                       axis=0)
+    v = mx.sym.squeeze(mx.sym.slice_axis(qkv, axis=0, begin=2, end=3),
+                       axis=0)
+    o = mx.sym.contrib.FlashAttention(q, k, v, causal=True)
+    o = mx.sym.transpose(o, axes=(0, 2, 1, 3))
+    o = mx.sym.reshape(o, shape=(0, 0, -3))
+    o = mx.sym.mean(o, axis=1)
+    o = mx.sym.FullyConnected(o, num_hidden=8, name="head")
+    net = mx.sym.SoftmaxOutput(o, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([DataDesc("data", (batch, seq, embed))],
+             [DataDesc("softmax_label", (batch,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    assert mod._fused is not None, "fused step did not engage"
+    return mod
+
+
+@pytest.mark.skipif(jax.devices()[0].platform != "cpu",
+                    reason="chip-free export census is CPU-host-defined")
+def test_export_census_fused_train_step_has_flash_attn():
+    from jax import export
+    with config.override(kernel_tier="auto"):
+        tier.reset_stats()
+        mod = _gpt_attn_module()
+        fused = mod._fused
+        ex = mod._exec
+        npar = len(fused.param_names)
+        params, rest = fused.split_args(ex._arg_vals())
+        args = (params, rest, ex._aux_vals(), mod._fused_opt_state, None,
+                jnp.zeros((npar,), jnp.float32),
+                jnp.zeros((npar,), jnp.float32),
+                np.float32(1.0), np.int32(1), jax.random.PRNGKey(0))
+        with tier.force_compiled():
+            exp = export.export(fused._jitted, platforms=["tpu"])(*args)
+        st = tier.stats()
+    kernels = hlo_stats.pallas_kernel_names(exp.mlir_module())
+    assert kernels.get("mxk_flash_attn", 0) >= 1, kernels
+    assert st["dispatch"].get("flash_attn", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: speculation-depth policy, property-tested chip-free
+# ---------------------------------------------------------------------------
+
+def test_speculation_depth_monotone_in_cost_ratio():
+    from mxnet_tpu import perfmodel
+    t_verify = 1.0
+    last = None
+    for t_draft in (2.0, 1.0, 0.5, 0.2, 0.1, 0.02, 0.005):
+        k = perfmodel.speculation_depth(t_draft, t_verify, max_k=8)
+        if last is not None:
+            assert k >= last, "k must not shrink as drafts get cheaper"
+        last = k
+    assert perfmodel.speculation_depth(1e-6, 1.0, max_k=8) == 8
+    assert perfmodel.speculation_depth(10.0, 1.0, max_k=8) == 1
+
+
+def test_speculation_depth_clamps_to_window():
+    from mxnet_tpu import perfmodel
+    for cap in (1, 2, 3, 5):
+        assert 1 <= perfmodel.speculation_depth(0.01, 1.0,
+                                                max_k=cap) <= cap
+
+
+def test_suggest_speculation_depth_respects_spec_window():
+    k = dm.suggest_speculation_depth(SPEC8)
+    assert 1 <= k <= min(8, SPEC8.max_prompt_len)
+    # the spec window is the binding cap: a tiny prompt window clamps it
+    tight = SPEC8._replace(max_prompt_len=2)
+    assert dm.suggest_speculation_depth(tight) <= 2
+
+
+def test_suggest_speculation_depth_monotone_in_draft_ratio():
+    last = None
+    for ratio in (1.0, 0.5, 0.25, 0.1, 0.02):
+        k = dm.suggest_speculation_depth(SPEC8, draft_bytes_ratio=ratio)
+        if last is not None:
+            assert k >= last, "cheaper draft must not shrink k"
+        last = k

@@ -200,3 +200,137 @@ def test_committed_cache_matches_a_fresh_chip_free_retune():
         if checked >= 3:                # bound tier-1 time
             break
     assert checked >= 1
+
+
+# ------------------------------------------------------- attention buckets
+
+def test_attention_space_is_bounded_and_vmem_feasible():
+    for op, shapes in [("flash_attn", ((128, 64, 16), (128, 64, 16))),
+                       ("flash_attn", ((32, 1024, 64), (32, 1024, 64))),
+                       ("flash_attn_paged", ((16, 1, 8, 32), (8, 16))),
+                       ("flash_attn_paged", ((8, 4, 8, 64), (8, 16)))]:
+        cands = space.space_for(op, shapes, "float32")
+        assert 0 < len(cands) <= 64, (op, len(cands))
+        for cfg in cands:
+            feat = cost_model.features(op, shapes, "float32", cfg, "v5e")
+            assert feat["vmem_frac"] <= 1.0, (op, cfg, feat)
+
+
+def test_paged_space_candidates_are_mosaic_valid():
+    """Every enumerated block_h must divide the head count AND give a
+    Mosaic-valid lane dim (128-aligned or the full feature width)."""
+    for (S, W, H, Dh) in [(16, 1, 8, 32), (8, 4, 8, 64), (4, 5, 2, 128),
+                          (3, 1, 4, 8)]:
+        for cfg in space.space_for("flash_attn_paged",
+                                   ((S, W, H, Dh), (8, 16)), "float32"):
+            bh = cfg["block_h"]
+            assert H % bh == 0, (H, Dh, cfg)
+            assert (bh * Dh) % 128 == 0 or bh == H, (H, Dh, cfg)
+
+
+def test_attention_default_config_consults_module_hook():
+    from mxnet_tpu.kernels import attention
+    assert space.default_config(
+        "flash_attn", ((128, 64, 16), (128, 64, 16)),
+        "float32") == attention.DEFAULT_CONFIG
+    # the paged default self-adapts block_h to a Mosaic-valid width
+    cfg = space.default_config("flash_attn_paged",
+                               ((16, 1, 8, 32), (8, 16)), "float32")
+    assert cfg["block_h"] == 8          # widest 128-aligned: 8*32 lanes
+    cfg = space.default_config("flash_attn_paged",
+                               ((3, 1, 4, 8), (4, 8)), "float32")
+    assert cfg["block_h"] == 4          # no 128-aligned divisor: full H
+
+
+def test_committed_attention_buckets_reproduce():
+    """The committed flash_attn / flash_attn_paged winners re-derive
+    chip-free — same determinism bar the bn_act buckets carry."""
+    cache = tcache.TuningCache.load(
+        os.path.join(REPO, "tools", "kernel_tuning.json"))
+    checked = {"flash_attn": 0, "flash_attn_paged": 0}
+    for key, entry in sorted(cache.entries.items()):
+        op = entry["op"]
+        if entry.get("source") != "model" or op not in checked \
+                or checked[op] >= 2:
+            continue
+        shapes = tuple(tuple(s) for s in entry["shapes"])
+        result = tuner.tune(op, shapes, entry["dtype"], chip_free=True)
+        assert result["best"]["config"] == entry["config"], key
+        checked[op] += 1
+    assert checked["flash_attn"] >= 1, "no committed flash_attn bucket"
+    assert checked["flash_attn_paged"] >= 1, \
+        "no committed flash_attn_paged bucket"
+
+
+# ----------------------------------------- recalibration fidelity (v2 model)
+
+def _attention_timing_rows():
+    """Synthetic measured rows whose ground truth is carried by the
+    fusion-structure features (vpu/dma/tile terms), with only a weak
+    bytes term — the regime static bytes/flops cannot rank."""
+    rows = []
+    for op, shapes in [("flash_attn", ((128, 64, 16), (128, 64, 16))),
+                       ("flash_attn", ((32, 1024, 64), (32, 1024, 64))),
+                       ("flash_attn_paged", ((16, 1, 8, 32), (8, 16)))]:
+        for cfg in space.space_for(op, shapes, "float32"):
+            feat = cost_model.features(op, shapes, "float32", cfg, "v5e")
+            t = (1.0 * feat["vpu_time_us"] + 0.05 * feat["dma_steps"]
+                 + 20.0 * feat["tile_waste"] + 0.2 * feat["hbm_time_us"])
+            rows.append({"op": op, "shapes": shapes, "dtype": "float32",
+                         "config": cfg, "features": feat, "time_us": t})
+    return rows
+
+
+def test_recalibrate_improves_concordance_beyond_bytes_flops():
+    """Satellite acceptance: when measured times carry signal the
+    bytes/flops terms cannot see, recalibration must IMPROVE pairwise
+    ranking concordance — the new fusion-structure columns are doing
+    real work, not just riding along."""
+    from mxnet_tpu.tune import timings
+    rows = _attention_timing_rows()
+    bytes_flops_only = cost_model.LinearCostModel(
+        {"vpu_time_us": 0.0, "dma_steps": 0.0, "tile_waste": 0.0})
+    _fitted, report = timings.recalibrate(rows,
+                                          base_model=bytes_flops_only)
+    before = report["before"]["pairwise"]
+    after = report["after"]["pairwise"]
+    assert before < 1.0, "construction must defeat the bytes/flops model"
+    assert after > before, (before, after)
+    assert after >= 0.99, after
+
+
+def test_new_features_are_zero_for_preexisting_ops():
+    """The v2 feature columns must not move the committed bn_act /
+    scale_bias_act / take_rows rankings: exactly 0.0 there."""
+    for op, shapes, cfg in [
+            ("bn_act", ((8192, 4096),), {"block_r": 64, "block_s": 512}),
+            ("scale_bias_act", ((2048, 4096),),
+             {"block_r": 64, "block_f": 512}),
+            ("take_rows", ((65536, 512), (8192,)), {"block_d": 512})]:
+        feat = cost_model.features(op, shapes, "float32", cfg, "v5e")
+        assert feat["vpu_time_us"] == 0.0, op
+        assert feat["dma_steps"] == 0.0, op
+        assert feat["tile_waste"] == 0.0, op
+
+
+def test_weights_round_trip_and_v1_rejection(tmp_path):
+    """save_weights -> default_model round-trips the v2 file; a v1-era
+    file (missing the fusion-structure columns) is cleanly rejected and
+    the ship weights win."""
+    path = str(tmp_path / "weights.json")
+    m = cost_model.LinearCostModel({"vpu_time_us": 7.5, "dma_steps": 0.5})
+    cost_model.save_weights(m, path)
+    raw = json.load(open(path))
+    assert raw["version"] == cost_model.WEIGHTS_VERSION
+    assert set(raw["weights"]) == set(cost_model.FEATURE_NAMES)
+    with config.override(kernel_cost_model=path):
+        loaded = cost_model.default_model()
+        assert loaded.weights == m.weights
+
+    stale = dict(raw, version=1)
+    del stale["weights"]["vpu_time_us"]
+    with open(path, "w") as f:
+        json.dump(stale, f)
+    with config.override(kernel_cost_model=path):
+        assert cost_model.default_model().weights == \
+            cost_model.LinearCostModel().weights

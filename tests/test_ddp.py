@@ -70,6 +70,49 @@ def test_choose_bucket_bytes_override_and_model():
         assert (1 << 20) <= b <= (64 << 20)
 
 
+def test_choose_bucket_bytes_tracks_interconnect_table():
+    """The auto-sized bucket is the ICI-table formula, clamped — pinned
+    per device kind so a table edit shows up as a policy change here."""
+    from mxnet_tpu import perfmodel
+    with config.override(ddp_bucket_mb=0.0):
+        for kind in ("TPU v5p", "TPU v4", "TPU v3", "TPU v2", "weird"):
+            bw = perfmodel.interconnect_bytes_per_s(kind)
+            want = int(min(max(bw * 20e-6 / 0.05, 1 << 20), 64 << 20))
+            assert ddp.choose_bucket_bytes(kind) == want
+        # fast ICI saturates the 64 MiB overlap ceiling; v2/v3 land
+        # mid-range where the launch-amortization formula is live
+        assert ddp.choose_bucket_bytes("TPU v5p") == 64 << 20
+        assert ddp.choose_bucket_bytes("TPU v3") == 32_800_000
+        assert ddp.choose_bucket_bytes("TPU v2") == 24_800_000
+
+
+def test_grad_reducer_stats_model_vs_plan():
+    """stats() must report both the ICI-table policy value (model) and
+    what this reducer actually used (plan), so dashboards can spot a
+    plan that drifted from policy."""
+    entries = [("w", (256, 256), np.float32), ("b", (256,), np.float32)]
+    with config.override(ddp_bucket_mb=0.0):
+        auto = ddp.GradReducer(entries, axis_name="dp",
+                               device_kind="TPU v3")
+        st = auto.stats()
+        assert st["bucket_bytes_model"] == ddp.choose_bucket_bytes("TPU v3")
+        assert st["bucket_bytes_plan"] == st["bucket_bytes_model"]
+        # an explicit bucket_bytes is the plan; the model stays on-table
+        pinned = ddp.GradReducer(entries, axis_name="dp",
+                                 bucket_bytes=4 << 20,
+                                 device_kind="TPU v3")
+        st = pinned.stats()
+        assert st["bucket_bytes_plan"] == 4 << 20
+        assert st["bucket_bytes_model"] == ddp.choose_bucket_bytes("TPU v3")
+    # MXNET_DDP_BUCKET_MB is an operator decision: it IS the policy,
+    # so model and plan agree under the override
+    with config.override(ddp_bucket_mb=2.0):
+        st = ddp.GradReducer(entries, axis_name="dp",
+                             device_kind="TPU v3").stats()
+        assert st["bucket_bytes_model"] == 2 << 20
+        assert st["bucket_bytes_plan"] == 2 << 20
+
+
 def test_estimate_overlap_excludes_last_bucket():
     assert ddp.estimate_overlap_ms([100, 100], 1) == 0.0       # no dp
     assert ddp.estimate_overlap_ms([100], 4) == 0.0            # one bucket
@@ -319,6 +362,21 @@ def test_publish_window_carries_ddp_stats():
     assert snap["ddp/buckets"]["samples"][0]["value"] == 3
     assert snap["ddp/overlap_ms"]["samples"][0]["value"] == 0.25
     assert snap["ddp/comm_bytes"]["samples"][0]["value"] >= 4096
+
+
+def test_publish_window_gauges_bucket_bytes_model():
+    from mxnet_tpu import telemetry
+    entries = [("w", (64, 64), np.float32)]
+    with config.override(ddp_bucket_mb=0.0):
+        st = ddp.GradReducer(entries, axis_name="dp",
+                             device_kind="TPU v3").stats()
+    rec = telemetry.publish_window(
+        steps=4, window_s=0.1, examples=128, global_step=41, ddp=st)
+    assert rec["ddp"]["bucket_bytes_model"] == \
+        ddp.choose_bucket_bytes("TPU v3")
+    snap = telemetry.snapshot()
+    assert snap["ddp/bucket_bytes_model"]["samples"][-1]["value"] == \
+        st["bucket_bytes_model"]
 
 
 # ------------------------------------------------------------ fleet runs

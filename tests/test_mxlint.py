@@ -572,6 +572,51 @@ class TestHloPasses:
         assert len(leak) == 1 and leak[0].rule == "MXL511"
         assert "host-transfer" in leak[0].message
 
+    # MXL512 fixtures: hand-written StableHLO around the pass's tell.
+    # BAD materializes the (seq, ctx) score softmax — an exponential
+    # whose f32 result spans the full context width in its last dim.
+    # GOOD is the flash kernel's footprint: exps over kernel tiles
+    # (last dim < ctx) plus the sampler's log-of-uniform Gumbel trick,
+    # neither of which may fire the rule.
+    _ATTN_BAD = (
+        'func.func public @main(%arg0: tensor<8x4x48xf32>) {\n'
+        '  %0 = stablehlo.exponential %arg0 : tensor<8x4x48xf32>\n'
+        '  %1 = stablehlo.exponential %arg0 : tensor<8x4x48xf32>\n'
+        '  return %1 : tensor<8x4x48xf32>\n'
+        '}\n')
+    _ATTN_GOOD = (
+        'func.func public @main(%arg0: tensor<16x16xf32>, '
+        '%arg1: tensor<8x4xf32>) {\n'
+        '  %0 = stablehlo.exponential %arg0 : tensor<16x16xf32>\n'
+        '  %1 = stablehlo.log %arg1 : tensor<8x4xf32>\n'
+        '  return %0 : tensor<16x16xf32>\n'
+        '}\n')
+
+    def test_attention_fusion_catches_and_passes(self):
+        # decode geometry: ctx = page_size * max_pages_per_slot = 48
+        bad = hlo_passes.attention_fusion_pass(
+            self._ATTN_BAD, "decode_step", ctx=48)
+        assert len(bad) == 1 and bad[0].rule == "MXL512"
+        assert "softmax exponential" in bad[0].message
+        assert "8x4x48xf32" in bad[0].message
+        # tile-width exps (16 < 48) and the Gumbel log: clean
+        assert hlo_passes.attention_fusion_pass(
+            self._ATTN_GOOD, "decode_step", ctx=48) == []
+        # the same tile exp IS the score block when ctx shrinks to it
+        tight = hlo_passes.attention_fusion_pass(
+            self._ATTN_GOOD, "decode_step", ctx=16)
+        assert len(tight) == 1 and tight[0].rule == "MXL512"
+
+    def test_attention_fusion_holds_sync_budget(self, lowerings):
+        # a host callback inside the step: fusing attention must not
+        # add device syncs (the MXL508 one-fetch contract still holds)
+        leak = hlo_passes.attention_fusion_pass(
+            lowerings["callback"], "decode_step", ctx=48)
+        assert len(leak) == 1 and leak[0].rule == "MXL512"
+        assert "must not add device syncs" in leak[0].message
+        assert hlo_passes.attention_fusion_pass(
+            lowerings["donated"], "decode_step", ctx=10 ** 6) == []
+
     # MXL509 fixtures: hand-written StableHLO in the shape the quantized
     # serving ops lower to. GOOD: f32 activations quantize (f32->i8), an
     # int8 dot accumulates in i32, and the only upcast is the i32

@@ -331,7 +331,8 @@ def _synthetic_rows(n_tasks=3, n_cfg=8, seed=7):
     rng = random.Random(seed)
     true_w = {"hbm_time_us": 1.7, "flop_time_us": 0.4,
               "grid_overhead_us": 3.0, "misalign": 120.0,
-              "waste": 5.0, "vmem_frac": 0.5}
+              "waste": 5.0, "vmem_frac": 0.5, "vpu_time_us": 0.9,
+              "dma_steps": 0.01, "tile_waste": 8.0}
     rows = []
     for t in range(n_tasks):
         for _ in range(n_cfg):
@@ -425,7 +426,9 @@ class TestRecalibration:
         assert "ranking agreement" in out and "pairwise" in out
         assert "->" in out            # before -> after rendering
         doc = json.load(open(model_out))
-        assert doc["version"] == 1 and "weights" in doc
+        from mxnet_tpu.tune import cost_model as cm
+        assert doc["version"] == cm.WEIGHTS_VERSION and "weights" in doc
+        assert set(doc["features"]) == set(cm.FEATURE_NAMES)
 
     def test_autotune_recalibrate_no_log_is_rc2(self, tmp_path, capsys):
         rc = autotune_cli.main(["--recalibrate", "--timings",
