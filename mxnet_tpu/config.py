@@ -456,7 +456,28 @@ register_flag("fleet_lease_timeout_s", "MXNET_FLEET_LEASE_TIMEOUT_S",
 register_flag("fleet_standby_poll_s", "MXNET_FLEET_STANDBY_POLL_S",
               float, 0.2,
               "How often a --standby router tails the journal and "
-              "checks the primary's lease.")
+              "checks the primary's lease. This is the CAP on the "
+              "tailer's capped-exponential idle backoff: a standby "
+              "polls immediately after applying records (catch-up "
+              "burst) and decays toward this interval while idle.")
+register_flag("fleet_journal_segment_mb", "MXNET_FLEET_JOURNAL_SEGMENT_MB",
+              int, 64,
+              "Rotate the fleet journal to a fresh wal-*.log segment "
+              "once the live one exceeds this many MiB (rotation also "
+              "happens at open and compaction). Bounds the unit of "
+              "cross-host replication and the blast radius of a torn "
+              "tail to one segment. 0 disables size-based rotation.")
+register_flag("fleet_repl_poll_s", "MXNET_FLEET_REPL_POLL_S",
+              float, 0.2,
+              "How often a replicating standby (route.py --standby "
+              "--replicate-from URL) pulls the primary's journal "
+              "manifest. Also the cap on its catch-up/idle backoff; "
+              "transient connection failures back off on the shared "
+              "supervisor.backoff_delay jittered schedule.")
+register_flag("fleet_repl_timeout_s", "MXNET_FLEET_REPL_TIMEOUT_S",
+              float, 5.0,
+              "Per-request HTTP timeout for journal replication "
+              "fetches (manifest, segment bytes, snapshot bootstrap).")
 register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
               "Training-side telemetry HTTP listener port "
               "(mxnet_tpu.telemetry.exporters): serves /metrics "

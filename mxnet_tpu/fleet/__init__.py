@@ -14,7 +14,16 @@ The router itself is highly available: a :class:`FleetJournal`
 write-ahead logs every registry mutation and generate hop cursor, a
 warm standby (``tools/route.py --standby``) tails it and promotes on
 lease expiry, and fencing epochs (:mod:`mxnet_tpu.fleet.fencing`) keep
-a revived stale primary from split-braining the fleet.
+a revived stale primary from split-braining the fleet. The journal no
+longer needs shared storage: a :class:`JournalReplicator` standby
+(``--standby --replicate-from URL``) streams snapshot + WAL segments
+over the primary's own HTTP front end into a local replica —
+CRC re-verified, epoch-fenced, seq-gap-resynced — and promotes from
+that even when the primary's disk dies with it. When the primary's
+*own* journal disk fails mid-flight, the router degrades instead of
+dying: control-plane mutations return 503 + Retry-After
+(:class:`JournalDegraded`) while routed traffic keeps flowing, and a
+recovered disk exits degraded mode without a restart.
 
 Entry points: ``tools/route.py`` (router CLI), ``tools/serve.py
 --register`` (replica side). docs/fleet.md is the operator tour.
@@ -25,14 +34,18 @@ from . import fencing
 from .journal import (FleetJournal, FleetState, JournalTailer,
                       LeaseMonitor)
 from .registry import Replica, ReplicaAnnouncer, ReplicaRegistry
-from .router import (NoReplica, Router, RouterHTTPFrontEnd,
-                     route_http)
+from .replicate import (JournalReplicator, ReplicationError,
+                        StaleSourceError)
+from .router import (JournalDegraded, NoReplica, Router,
+                     RouterHTTPFrontEnd, route_http)
 from .supervisor import ReplicaSpec, ReplicaSupervisor, backoff_delay
 
 __all__ = [
     "Replica", "ReplicaAnnouncer", "ReplicaRegistry",
-    "NoReplica", "Router", "RouterHTTPFrontEnd", "route_http",
+    "NoReplica", "JournalDegraded", "Router", "RouterHTTPFrontEnd",
+    "route_http",
     "ReplicaSpec", "ReplicaSupervisor", "backoff_delay",
     "FleetJournal", "FleetState", "JournalTailer", "LeaseMonitor",
+    "JournalReplicator", "ReplicationError", "StaleSourceError",
     "fencing",
 ]

@@ -60,6 +60,16 @@ _SNAP_PREFIX, _SNAP_SUFFIX = "snap-", ".json"
 _LEASE = "lease.json"
 
 
+def _fire_fault(op, **ctx):
+    """Storage fault hook (``enospc@journal=...`` / ``torn_write`` /
+    ``slow_fsync`` in parallel/faultinject.py). Gated on the env var so
+    a production router never pays the parallel-package import."""
+    if not os.environ.get("MXNET_FAULT_INJECT"):
+        return
+    from ..parallel import faultinject
+    faultinject.fire("journal", op=op, **ctx)
+
+
 # ---------------------------------------------------------------------------
 # state reducer
 # ---------------------------------------------------------------------------
@@ -260,16 +270,22 @@ class FleetJournal:
     fresh segment so an old incarnation's torn tail is never appended
     through."""
 
-    def __init__(self, dir_, start_seq=0, sync_every=None):
+    def __init__(self, dir_, start_seq=0, sync_every=None,
+                 segment_bytes=None):
+        from ..config import flags
         if sync_every is None:
-            from ..config import flags
             sync_every = flags.fleet_journal_sync_every
+        if segment_bytes is None:
+            segment_bytes = flags.fleet_journal_segment_mb * (1 << 20)
         self.dir = os.fspath(dir_)
         os.makedirs(self.dir, exist_ok=True)
         self.sync_every = max(1, int(sync_every))
+        self.segment_bytes = max(0, int(segment_bytes))
         self._lock = threading.Lock()
         self._seq = int(start_seq)
         self._unsynced = 0
+        self._seg_bytes = 0
+        self._dirty_tail = False
         self.records_since_compact = 0
         segs = _segments(self.dir)
         seg_no = (segs[-1][0] + 1) if segs else 1
@@ -288,6 +304,14 @@ class FleetJournal:
         self._c_compactions = reg.counter(
             "fleet/journal_compactions",
             "Snapshot+truncate compactions of the fleet journal.")
+        self._c_rotations = reg.counter(
+            "fleet/journal_rotations",
+            "Size-based segment rotations "
+            "(MXNET_FLEET_JOURNAL_SEGMENT_MB).")
+        self._c_write_errors = reg.counter(
+            "fleet/journal_write_errors",
+            "Failed journal writes/fsyncs (ENOSPC, torn writes, dead "
+            "disks) surfaced to the primary.")
 
     @property
     def seq(self):
@@ -297,27 +321,81 @@ class FleetJournal:
     def append(self, kind, data, sync=False):
         """Append one record; returns its sequence number. ``sync``
         forces an immediate fsync (epoch records, registrations);
-        otherwise the fsync is batched every ``sync_every`` appends."""
+        otherwise the fsync is batched every ``sync_every`` appends.
+
+        A failed write does NOT consume a sequence number (a burned
+        seq would read as a gap to replicating standbys) and marks the
+        tail dirty: the next append first truncates back to the last
+        whole record, so a torn frame is never appended through —
+        replay would stop at the garbage and silently drop everything
+        after it. Storage failures (real or injected) surface to the
+        caller as ``OSError``; the router turns that into degraded
+        mode rather than crashing the data plane."""
         with self._lock:
-            self._seq += 1
-            seq = self._seq
+            seq = self._seq + 1
             payload = json.dumps(
                 {"seq": seq, "kind": kind, "data": data},
                 sort_keys=True).encode("utf-8")
-            self._f.write(_FRAME.pack(
-                len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload)
+            frame = _FRAME.pack(
+                len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+            try:
+                if self._dirty_tail:
+                    self._f.truncate(self._seg_bytes)
+                    self._dirty_tail = False
+                _fire_fault("append", kind=kind, path=self._seg_path)
+                self._f.write(frame)
+            except OSError as e:
+                keep = getattr(e, "keep_bytes", None)
+                if keep is not None:
+                    # torn write: part of the frame reaches the disk
+                    try:
+                        self._f.write(
+                            frame[:max(0, min(keep, len(frame) - 1))])
+                    except OSError:
+                        pass
+                self._dirty_tail = True
+                self._c_write_errors.inc()
+                raise
+            self._seq = seq
+            self._seg_bytes += len(frame)
             self._unsynced += 1
             self.records_since_compact += 1
             if sync or self._unsynced >= self.sync_every:
                 self._fsync_locked()
+            if self.segment_bytes and self._seg_bytes >= self.segment_bytes:
+                try:
+                    self._rotate_locked()
+                except OSError:
+                    # rotation is a bound, not correctness: stay on the
+                    # oversized segment; the next group commit surfaces
+                    # the sick disk as a failed append
+                    self._c_write_errors.inc()
         self._c_records.inc(kind=kind)
-        self._c_bytes.inc(_FRAME.size + len(payload))
+        self._c_bytes.inc(len(frame))
         return seq
 
     def _fsync_locked(self):
+        _fire_fault("fsync", path=self._seg_path)
         os.fsync(self._f.fileno())
         self._unsynced = 0
         self._c_fsyncs.inc()
+
+    def _rotate_locked(self):
+        """Seal the live segment (fsync) and continue in a fresh one.
+        Size-based rotation bounds the unit of cross-host replication
+        and the blast radius of a torn tail to one segment."""
+        self._fsync_locked()
+        segs = _segments(self.dir)
+        seg_no = (segs[-1][0] + 1) if segs else 1
+        new_path = os.path.join(
+            self.dir, "%s%08d%s" % (_SEG_PREFIX, seg_no, _SEG_SUFFIX))
+        new_f = open(new_path, "ab", buffering=0)
+        old_f = self._f
+        self._f, self._seg_path = new_f, new_path
+        self._seg_bytes = 0
+        self._dirty_tail = False
+        old_f.close()
+        self._c_rotations.inc()
 
     def sync(self):
         """Flush the current group commit to disk."""
@@ -334,6 +412,7 @@ class FleetJournal:
         if isinstance(state, FleetState):
             state = state.to_dict()
         with self._lock:
+            _fire_fault("compact", path=self.dir)
             self._fsync_locked()
             seq = self._seq
             state = dict(state, applied_seq=seq)
@@ -348,6 +427,8 @@ class FleetJournal:
             self._seg_path = os.path.join(
                 self.dir, "%s%08d%s" % (_SEG_PREFIX, seg_no, _SEG_SUFFIX))
             self._f = open(self._seg_path, "ab", buffering=0)
+            self._seg_bytes = 0
+            self._dirty_tail = False
             old_f.close()
             for _, p in segs:
                 if p != self._seg_path:
@@ -369,9 +450,11 @@ class FleetJournal:
         with self._lock:
             return {"dir": self.dir, "seq": self._seq,
                     "segment": os.path.basename(self._seg_path),
+                    "segment_bytes": self._seg_bytes,
                     "unsynced": self._unsynced,
                     "records_since_compact": self.records_since_compact,
-                    "sync_every": self.sync_every}
+                    "sync_every": self.sync_every,
+                    "rotate_at_bytes": self.segment_bytes}
 
     def close(self):
         with self._lock:
@@ -392,16 +475,57 @@ class JournalTailer:
     segment so each poll reads only new bytes; a torn tail simply stops
     that segment's scan until more bytes arrive (the primary may be
     mid-append), and a newer snapshot (compaction) is adopted whenever
-    it is ahead of what was already applied."""
+    it is ahead of what was already applied. :meth:`next_delay_s`
+    paces the caller's poll loop: immediate re-poll after progress,
+    capped jittered exponential backoff while idle."""
 
-    def __init__(self, dir_):
+    def __init__(self, dir_, idle_base_s=0.01, idle_cap_s=None):
+        if idle_cap_s is None:
+            from ..config import flags
+            idle_cap_s = flags.fleet_standby_poll_s
         self.dir = os.fspath(dir_)
         self.state = FleetState()
+        self.idle_base_s = max(1e-4, float(idle_base_s))
+        self.idle_cap_s = max(self.idle_base_s, float(idle_cap_s))
         self._offsets = {}
+        self._empty_polls = 0
+        self._gap = False
+
+    def next_delay_s(self, rng=None):
+        """Suggested sleep before the next :meth:`poll`: 0 right after
+        a poll that applied records (catch-up burst — drain a backlog
+        at full speed), then capped jittered exponential backoff while
+        idle. An idle standby neither spins at the poll interval nor
+        lags a suddenly-busy primary by more than ``idle_cap_s``."""
+        if self._empty_polls == 0:
+            return 0.0
+        from .supervisor import backoff_delay
+        return min(self.idle_cap_s,
+                   backoff_delay(self._empty_polls - 1,
+                                 base=self.idle_base_s,
+                                 cap=self.idle_cap_s, jitter=0.25,
+                                 rng=rng))
 
     def poll(self):
-        """Apply everything new; returns the number of records applied."""
+        """Apply everything new; returns the number of records applied.
+
+        Gap-safe against a racing compaction: if a segment scan lands
+        past a compaction (its first new record's seq jumps beyond
+        ``applied_seq + 1`` because the records in between were folded
+        into a snapshot and their segments deleted mid-poll), nothing
+        is applied across the gap — the covering snapshot (compaction
+        writes it *before* deleting segments) is adopted on an
+        immediate second pass and the scan resumes contiguously."""
+        applied = self._poll_once()
+        if self._gap:
+            applied += self._poll_once()
+        self._empty_polls = 0 if applied else min(self._empty_polls + 1,
+                                                  32)
+        return applied
+
+    def _poll_once(self):
         applied = 0
+        self._gap = False
         for snap_seq, snap_path in reversed(_snapshots(self.dir)):
             if snap_seq <= self.state.applied_seq:
                 break
@@ -418,10 +542,21 @@ class JournalTailer:
             live.add(seg_path)
             off = self._offsets.get(seg_path, 0)
             records, new_off, _clean = read_segment(seg_path, off)
-            self._offsets[seg_path] = new_off
+            gap_here = False
             for seq, kind, data in records:
+                if self.state.applied_seq and \
+                        seq > self.state.applied_seq + 1:
+                    gap_here = True
+                    break
                 if self.state.apply(seq, kind, data):
                     applied += 1
+            if gap_here:
+                # records jumped past a compaction; keep the offset so
+                # this batch is re-scanned (idempotently) after the
+                # covering snapshot is adopted
+                self._gap = True
+            else:
+                self._offsets[seg_path] = new_off
         for path in list(self._offsets):
             if path not in live:
                 del self._offsets[path]         # compacted away

@@ -366,7 +366,12 @@ class ReplicaAnnouncer:
     mode/identity/pid); ``status_fn()`` returns the live part each beat:
     ``{"ready": bool, "reason": str|None, "load": {...}}``. Failures are
     absorbed (a router restart must not kill a healthy replica); an
-    unknown-id heartbeat answer triggers re-registration.
+    unknown-id heartbeat answer triggers re-registration. Transient
+    connection failures (refused/reset while a router restarts or
+    fails over) retry on the shared ``supervisor.backoff_delay``
+    jittered schedule — fast first retries so a replica rejoins the
+    promoted router well inside one heartbeat interval, capped at the
+    interval so a long outage costs no extra traffic.
 
     **Epoch fencing** (router HA): register/heartbeat replies carry the
     router's fencing epoch; the announcer feeds it to
@@ -389,6 +394,7 @@ class ReplicaAnnouncer:
         self._thread = None
         self.registered = threading.Event()
         self.stale_router_rejections = 0
+        self.conn_failures = 0       # consecutive, drives the backoff
 
     def _observe_epoch(self, out):
         """Feed a reply's epoch to the fence; False = stale router."""
@@ -417,16 +423,29 @@ class ReplicaAnnouncer:
             self._register_once()
 
     def _loop(self):
+        from .supervisor import backoff_delay
         while not self._stop.is_set():
+            wait = self.interval_s
             try:
                 if not self.registered.is_set():
                     self._register_once()
                 else:
                     self._beat_once()
+                self.conn_failures = 0
             except (urllib.error.URLError, ConnectionError, OSError,
                     ValueError):
-                pass      # router down/restarting; keep beating
-            self._wake.wait(self.interval_s)
+                # router down/restarting/failing over: don't give up —
+                # retry on the shared jittered restart schedule, fast
+                # at first (rejoin a promoted router inside one beat),
+                # capped at the heartbeat interval. The *stale-epoch*
+                # refusal is deliberate and NOT retried here: it lives
+                # in _observe_epoch, which simply never re-registers
+                # with a demoted router.
+                self.conn_failures += 1
+                wait = backoff_delay(self.conn_failures - 1,
+                                     base=min(0.05, self.interval_s),
+                                     cap=self.interval_s)
+            self._wake.wait(wait)
             self._wake.clear()
 
     def start(self):

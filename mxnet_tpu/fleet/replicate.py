@@ -1,0 +1,453 @@
+"""Cross-host WAL replication: stream the fleet journal to standbys.
+
+PR-14's router HA made failover bitwise — but only if the journal
+directory survives the primary, because the write-ahead log lived on
+exactly one disk (ROADMAP item 2's residual: "the WAL assumes shared
+or surviving storage"). This module closes that gap with a
+**pull-based replication tier**: a standby runs a
+:class:`JournalReplicator` that streams the primary's journal over the
+router's own HTTP front end into a *local* replica directory, so
+``tools/route.py --standby --replicate-from URL`` promotes from its
+own copy of the log even when the primary's machine (and disk) die
+together.
+
+Design points, in the order a cold standby meets them:
+
+* **Snapshot bootstrap.** The manifest (``GET /journal/manifest``)
+  names the newest compaction snapshot; a cold standby downloads it
+  first so it starts O(snapshot) behind, not O(history).
+* **Offset-resumed segment fetches.** Each poll fetches only the
+  bytes past the local copy's size (``GET /journal/segment?name=..&
+  offset=N``); a restarted standby re-verifies its local files and
+  resumes from where it left off.
+* **CRC re-verified on the receiving side.** Fetched bytes are
+  *appended then proven*: :func:`journal.read_segment` re-walks the
+  CRC32 framing locally, and anything past the last whole record —
+  an in-transit bit flip, a fetch that raced the primary mid-write —
+  is truncated off and re-fetched, never applied.
+* **Seq-gap detection with automatic full re-sync.** Records apply in
+  sequence; a gap (``seq > applied_seq + 1``) or a history regression
+  (source seq behind the replica's) means the local replica cannot be
+  patched record-by-record, so it is wiped and re-bootstrapped from
+  the source's snapshot + segments in the same poll.
+* **Epoch-stamped responses.** The manifest carries the serving
+  router's fencing epoch and every segment/snapshot response carries
+  ``X-Fleet-Epoch``; the replicator tracks the highest epoch it has
+  ever observed and refuses anything older — a demoted primary can
+  never feed a promoted standby (:class:`StaleSourceError`, counted
+  in ``fleet/repl_stale_rejects``).
+* **Jittered retry/backoff.** Transient connection failures back off
+  on the shared ``supervisor.backoff_delay`` schedule (the same one
+  the launcher, supervisor, and announcer use); a healthy catch-up
+  polls with zero delay (burst) and an idle replica decays to the
+  ``MXNET_FLEET_REPL_POLL_S`` cap.
+
+Liveness rides the same channel: the manifest embeds the primary's
+lease beat, so :meth:`JournalReplicator.expired` measures *monotonic
+time since the manifest content last changed* — the replicating
+standby needs no shared lease file, mirroring ``LeaseMonitor``'s
+NTP-proof content-change discipline.
+
+Observability: ``fleet/repl_lag_records`` (source seq minus replica
+seq — the headline gauge the disk-loss drill asserts in federated
+/metrics), ``fleet/repl_seq``, ``fleet/repl_bytes``,
+``fleet/repl_fetches``, ``fleet/repl_fetch_errors``,
+``fleet/repl_crc_rejects``, ``fleet/repl_stale_rejects``,
+``fleet/repl_resyncs``, ``fleet/repl_snapshots``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from ..base import MXNetError
+from ..checkpoint import atomic_replace
+from .. import telemetry
+from .journal import (FleetState, read_lease, read_segment, _segments,
+                      _snapshots)
+from .supervisor import backoff_delay
+
+__all__ = ["JournalReplicator", "ReplicationError", "StaleSourceError",
+           "build_manifest", "read_journal_file"]
+
+# one fetch is bounded so a huge segment can't balloon either side's
+# memory; the replicator's catch-up burst (zero-delay re-poll) drains
+# the rest immediately
+MAX_FETCH_BYTES = 8 << 20
+
+_NAME_RE = re.compile(r"^(wal-\d{8}\.log|snap-\d{16}\.json)$")
+
+EPOCH_HEADER = "X-Fleet-Epoch"
+
+
+class ReplicationError(MXNetError):
+    """Journal replication failed in a way retrying won't fix."""
+
+
+class StaleSourceError(ReplicationError):
+    """The source answered with a fencing epoch below the highest this
+    replicator has ever observed: it is a demoted primary and must not
+    feed us (its history may have diverged from the promoted one)."""
+
+
+# ---------------------------------------------------------------------------
+# primary side: manifest + bounded file reads (served by the router's
+# HTTP front end — fleet/router.py wires /journal/* to these)
+# ---------------------------------------------------------------------------
+
+def build_manifest(jdir, epoch, seq):
+    """The primary's replication manifest: fencing epoch, current seq,
+    live segments with sizes, the newest snapshot, and the lease beat
+    (the liveness signal, so replicating standbys need no shared lease
+    file)."""
+    segs = [{"name": os.path.basename(p), "size": os.path.getsize(p)}
+            for _, p in _segments(jdir) if os.path.exists(p)]
+    snap = None
+    snaps = _snapshots(jdir)
+    if snaps:
+        n, p = snaps[-1]
+        try:
+            snap = {"name": os.path.basename(p), "seq": int(n),
+                    "size": os.path.getsize(p)}
+        except OSError:
+            snap = None
+    lease, _ = read_lease(jdir)
+    return {"epoch": int(epoch or 0), "seq": int(seq or 0),
+            "segments": segs, "snapshot": snap,
+            "beat": (lease or {}).get("beat")}
+
+
+def read_journal_file(jdir, name, offset=0, max_bytes=MAX_FETCH_BYTES):
+    """Bounded read of one journal file for a replication fetch.
+    ``name`` must be a bare ``wal-*.log`` / ``snap-*.json`` basename
+    (no path traversal). Raises ``KeyError`` for anything else or a
+    missing file."""
+    if not _NAME_RE.match(name or ""):
+        raise KeyError("not a journal file: %r" % (name,))
+    path = os.path.join(os.fspath(jdir), name)
+    try:
+        with open(path, "rb") as f:
+            f.seek(max(0, int(offset)))
+            return f.read(max(0, int(max_bytes)))
+    except OSError:
+        raise KeyError("no such journal file: %r" % (name,))
+
+
+# ---------------------------------------------------------------------------
+# standby side
+# ---------------------------------------------------------------------------
+
+class JournalReplicator:
+    """Pulls a primary's journal into a local replica directory.
+
+    ``poll()`` runs one replication round (manifest, snapshot,
+    segment tails, verify, apply) and never raises on transient
+    failure — it counts the failure and lets :meth:`next_delay_s`
+    back off. The local directory is a valid journal directory at all
+    times: ``Router.from_journal(dir)`` on it is exactly the
+    promotion path, which is the whole point."""
+
+    def __init__(self, source_url, dir_, poll_s=None, timeout_s=None,
+                 retry_base=0.05, retry_cap=None, rng=None):
+        from ..config import flags
+        self.source_url = str(source_url).rstrip("/")
+        self.dir = os.fspath(dir_)
+        os.makedirs(self.dir, exist_ok=True)
+        self.poll_s = (flags.fleet_repl_poll_s if poll_s is None
+                       else float(poll_s))
+        self.timeout_s = (flags.fleet_repl_timeout_s if timeout_s is None
+                          else float(timeout_s))
+        self.retry_base = float(retry_base)
+        self.retry_cap = (max(4 * self.poll_s, 0.5) if retry_cap is None
+                          else float(retry_cap))
+        self._rng = rng
+        self.state = FleetState()
+        self._offsets = {}           # basename -> verified byte offset
+        self.max_epoch = 0
+        self.source_seq = 0
+        self.conn_failures = 0       # consecutive, drives the backoff
+        self._last_applied = 0
+        self._last_content = None
+        self._changed_at = time.monotonic()
+        reg = telemetry.default_registry()
+        self._g_lag = reg.gauge(
+            "fleet/repl_lag_records",
+            "Journal replication lag: source seq minus the replica's "
+            "applied seq.")
+        self._g_seq = reg.gauge(
+            "fleet/repl_seq", "Highest journal seq applied by this "
+            "replicating standby.")
+        self._c_bytes = reg.counter(
+            "fleet/repl_bytes", "Journal bytes streamed from the "
+            "replication source.")
+        self._c_fetches = reg.counter(
+            "fleet/repl_fetches", "Replication HTTP fetches "
+            "(manifest/segment/snapshot).")
+        self._c_fetch_errors = reg.counter(
+            "fleet/repl_fetch_errors", "Transient replication fetch "
+            "failures (retried with jittered backoff).")
+        self._c_crc_rejects = reg.counter(
+            "fleet/repl_crc_rejects", "Fetched segment bytes dropped "
+            "by the receiver-side CRC re-verification (truncated and "
+            "re-fetched, never applied).")
+        self._c_stale_rejects = reg.counter(
+            "fleet/repl_stale_rejects", "Replication responses refused "
+            "because the source's fencing epoch was below the highest "
+            "observed (demoted primary).")
+        self._c_resyncs = reg.counter(
+            "fleet/repl_resyncs", "Full re-syncs after a seq gap or "
+            "history regression (local replica wiped and "
+            "re-bootstrapped).")
+        self._c_snapshots = reg.counter(
+            "fleet/repl_snapshots", "Snapshot bootstraps/adoptions "
+            "fetched from the source.")
+        self._bootstrap_local()
+
+    # -- local resume -------------------------------------------------------
+    def _bootstrap_local(self):
+        """Re-verify whatever a previous incarnation already fetched:
+        adopt the newest local snapshot, walk every local segment's CRC
+        framing to rebuild verified offsets, truncate any unverified
+        tail (it will be re-fetched). This is what makes segment
+        fetches offset-*resumed* across standby restarts."""
+        for _snap_seq, path in reversed(_snapshots(self.dir)):
+            try:
+                with open(path) as f:
+                    self.state = FleetState.from_dict(json.load(f))
+                break
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        for _, path in _segments(self.dir):
+            records, off, clean = read_segment(path, 0)
+            for seq, kind, data in records:
+                self.state.apply(seq, kind, data)
+            self._offsets[os.path.basename(path)] = off
+            if not clean:
+                self._truncate(path, off)
+        self.max_epoch = self.state.epoch
+        self._g_seq.set(self.state.applied_seq)
+
+    @staticmethod
+    def _truncate(path, size):
+        try:
+            with open(path, "r+b") as f:
+                f.truncate(max(0, int(size)))
+        except OSError:
+            pass
+
+    # -- fetch plumbing -----------------------------------------------------
+    def _check_epoch(self, epoch):
+        if epoch is None:
+            return
+        epoch = int(epoch)
+        if epoch < self.max_epoch:
+            self._c_stale_rejects.inc()
+            raise StaleSourceError(
+                "replication source %s serves epoch %d but epoch %d "
+                "was already observed — demoted primary refused"
+                % (self.source_url, epoch, self.max_epoch))
+        self.max_epoch = epoch
+
+    def _get(self, path):
+        req = urllib.request.Request(self.source_url + path)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            data = r.read()
+            headers = dict(r.headers)
+        self._c_fetches.inc()
+        self._check_epoch(headers.get(EPOCH_HEADER))
+        return data
+
+    def _fetch_manifest(self):
+        man = json.loads(self._get("/journal/manifest").decode("utf-8"))
+        self._check_epoch(man.get("epoch"))
+        return man
+
+    def _fetch_file(self, kind, name, offset=0):
+        q = urllib.parse.urlencode({"name": name, "offset": int(offset)})
+        return self._get("/journal/%s?%s" % (kind, q))
+
+    # -- liveness (the standby's promotion trigger) -------------------------
+    def age_s(self):
+        """Monotonic seconds since the manifest content (epoch, seq,
+        lease beat) last changed — the replicating standby's analogue
+        of ``LeaseMonitor.age_s``. Fetch failures leave the clock
+        running, so a dead source ages out naturally."""
+        return time.monotonic() - self._changed_at
+
+    def expired(self, timeout_s):
+        return self.age_s() > float(timeout_s)
+
+    # -- the pull loop ------------------------------------------------------
+    def poll(self):
+        """One replication round; returns records applied. Transient
+        connection failures and stale-source refusals are absorbed
+        (counted; :meth:`next_delay_s` backs off / :meth:`expired`
+        eventually promotes)."""
+        applied = 0
+        try:
+            man = self._fetch_manifest()
+            self.conn_failures = 0
+            content = (man.get("epoch"), man.get("seq"), man.get("beat"))
+            if content != self._last_content:
+                self._last_content = content
+                self._changed_at = time.monotonic()
+            self.source_seq = int(man.get("seq") or 0)
+            applied = self._sync_once(man, allow_resync=True)
+        except StaleSourceError:
+            pass          # never apply; age_s() keeps growing
+        except (urllib.error.URLError, ConnectionError, OSError,
+                ValueError, KeyError) as e:
+            self.conn_failures += 1
+            self._c_fetch_errors.inc()
+            self._last_error = str(e)
+        self._last_applied = applied
+        self._g_lag.set(max(0, self.source_seq - self.state.applied_seq))
+        self._g_seq.set(self.state.applied_seq)
+        return applied
+
+    def next_delay_s(self):
+        """Pace for the caller's loop: jittered exponential backoff
+        while the source is unreachable, zero right after progress
+        (catch-up burst), the poll interval when idle and healthy."""
+        if self.conn_failures:
+            return min(self.retry_cap,
+                       backoff_delay(self.conn_failures - 1,
+                                     base=self.retry_base,
+                                     cap=self.retry_cap, rng=self._rng))
+        if self._last_applied:
+            return 0.0
+        return self.poll_s
+
+    def _resync(self):
+        """Wipe the local replica and start over: a seq gap or history
+        regression means record-by-record patching cannot reconverge
+        (the missing prefix is gone from the source's segments)."""
+        self._c_resyncs.inc()
+        for _, p in _segments(self.dir) + _snapshots(self.dir):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._offsets.clear()
+        self.state = FleetState()
+
+    def _adopt_snapshot(self, snap):
+        """Fetch/refresh the source's newest snapshot locally, adopt it
+        when it is ahead of the replica state. Returns True if the
+        local file is present and loadable (gates segment GC)."""
+        name = snap["name"]
+        path = os.path.join(self.dir, name)
+        want = int(snap.get("size") or 0)
+        have = os.path.getsize(path) if os.path.exists(path) else -1
+        if have != want:
+            data = self._fetch_file("snapshot", name)
+            state = FleetState.from_dict(json.loads(data.decode("utf-8")))
+            with atomic_replace(path) as tmp:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+            self._c_bytes.inc(len(data))
+            self._c_snapshots.inc()
+        else:
+            with open(path) as f:
+                state = FleetState.from_dict(json.load(f))
+        if state.applied_seq > self.state.applied_seq:
+            self.state = state
+        return True
+
+    def _sync_once(self, man, allow_resync):
+        applied = 0
+        if allow_resync and 0 < self.source_seq < self.state.applied_seq:
+            # the source's history is BEHIND us at the same (or newer)
+            # epoch: it restarted with a fresh journal — ours is a
+            # different history now
+            self._resync()
+            return self._sync_once(man, allow_resync=False)
+        snap_ok = False
+        snap = man.get("snapshot")
+        if snap and _NAME_RE.match(str(snap.get("name") or "")):
+            try:
+                snap_ok = self._adopt_snapshot(snap)
+            except (ValueError, KeyError, TypeError, OSError):
+                snap_ok = False   # half-written on the source; retry
+        remote = {}
+        for seg in man.get("segments") or []:
+            name = str(seg.get("name") or "")
+            if _NAME_RE.match(name):
+                remote[name] = int(seg.get("size") or 0)
+        for name, want in sorted(remote.items()):
+            path = os.path.join(self.dir, name)
+            have = os.path.getsize(path) if os.path.exists(path) else 0
+            if have < want:
+                data = self._fetch_file("segment", name, offset=have)
+                if data:
+                    with open(path, "ab") as f:
+                        f.write(data)
+                    self._c_bytes.inc(len(data))
+            # receiver-side CRC re-verification: only whole, checksummed
+            # records past the verified offset are applied
+            off = self._offsets.get(name, 0)
+            records, new_off, clean = read_segment(path, off)
+            gap = False
+            for seq, kind, data_ in records:
+                # a first record past seq 1 on a cold replica is a gap
+                # too: starting mid-history would silently drop the
+                # prefix (the snapshot bootstrap is the only legal way
+                # to skip ahead)
+                if seq > self.state.applied_seq + 1:
+                    gap = True
+                    break
+                if self.state.apply(seq, kind, data_):
+                    applied += 1
+            if gap:
+                if allow_resync:
+                    self._resync()
+                    return applied + self._sync_once(
+                        man, allow_resync=False)
+                break     # gap persists post-resync: wait for a snapshot
+            self._offsets[name] = new_off
+            if not clean:
+                # garbage past the last whole record — an in-transit
+                # flip or a fetch racing the primary mid-write: drop it
+                # so the next poll re-fetches from the good offset
+                size_now = (os.path.getsize(path)
+                            if os.path.exists(path) else 0)
+                if size_now > new_off:
+                    self._truncate(path, new_off)
+                    self._c_crc_rejects.inc()
+        # mirror the source's retention: segments it compacted away are
+        # deleted locally, but only once the covering snapshot is local
+        # (promotion replays this directory; never orphan the prefix)
+        if snap_ok:
+            for _, p in _segments(self.dir):
+                name = os.path.basename(p)
+                if name not in remote:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                    self._offsets.pop(name, None)
+            for _, p in _snapshots(self.dir):
+                if os.path.basename(p) != snap["name"]:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+        return applied
+
+    def stats(self):
+        return {
+            "source": self.source_url,
+            "dir": self.dir,
+            "applied_seq": self.state.applied_seq,
+            "source_seq": self.source_seq,
+            "lag_records": max(0,
+                               self.source_seq - self.state.applied_seq),
+            "max_epoch": self.max_epoch,
+            "conn_failures": self.conn_failures,
+            "age_s": round(self.age_s(), 3),
+        }

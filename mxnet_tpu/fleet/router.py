@@ -52,6 +52,7 @@ import random
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -61,11 +62,22 @@ from .. import telemetry
 from ..telemetry import federate
 from .registry import ReplicaRegistry
 
-__all__ = ["Router", "NoReplica", "RouterHTTPFrontEnd", "route_http"]
+__all__ = ["Router", "NoReplica", "JournalDegraded", "RouterHTTPFrontEnd",
+           "route_http"]
 
 
 class NoReplica(MXNetError):
     """No ready replica can take this request."""
+
+
+class JournalDegraded(MXNetError):
+    """The fleet journal is unwritable (disk full, dying, gone):
+    control-plane mutations cannot be made durable, so acknowledging
+    one could silently lose it on the next failover. The HTTP front
+    end maps this to 503 + ``Retry-After``; already-routed data-plane
+    traffic is unaffected."""
+
+    retry_after_s = 1.0
 
 
 class Router:
@@ -95,6 +107,8 @@ class Router:
         self.address = None  # bound URL, once announce() learns it
         self.replay_stats = None
         self._sessions = {}  # sid -> journal-backed generate hop cursor
+        self.journal_degraded = False   # journal unwritable (ENOSPC...)
+        self.degraded_reason = None
         reg = telemetry.default_registry()
         self._c_requests = reg.counter(
             "fleet/requests", "Requests routed, by kind and outcome.")
@@ -124,6 +138,10 @@ class Router:
             "replay (ms).")
         self._g_epoch = reg.gauge(
             "fleet/epoch", "This router's fencing epoch.")
+        self._g_degraded = reg.gauge(
+            "fleet/journal_degraded", "1 while the fleet journal is "
+            "unwritable: control-plane mutations are refused with 503, "
+            "data-plane routing continues.")
         if journal is not None:
             self.attach_journal(journal)
 
@@ -139,12 +157,97 @@ class Router:
         self._g_epoch.set(self.epoch)
         self.registry.on_mutation = self._journal_append
 
-    def _journal_append(self, kind, data, sync=False):
-        if self.journal is not None:
-            # registrations and epoch claims are rare and structural:
-            # always durable. Hop cursors ride the group commit.
-            sync = sync or kind in ("register", "deregister", "epoch")
+    def _journal_append(self, kind, data, sync=False, required=False):
+        if self.journal is None:
+            return
+        # registrations, epoch claims, and acked control mutations are
+        # rare and structural: always durable. Hop cursors ride the
+        # group commit.
+        sync = sync or kind in ("register", "deregister", "epoch",
+                                "split", "canary")
+        try:
             self.journal.append(kind, data, sync=sync)
+        except OSError as e:
+            # the journal is unwritable: degrade the control plane but
+            # keep routing — already-adopted sessions continue on their
+            # in-memory cursors, and losing durability only costs a
+            # resumed session some bitwise-regenerated tokens.
+            # ``required`` marks an acked-iff-durable control mutation:
+            # those refuse (503) instead of acking a record that would
+            # silently vanish on the next failover.
+            self._enter_degraded(e)
+            if required:
+                raise JournalDegraded(
+                    "fleet: journal unwritable (%s) — control-plane "
+                    "mutation not acknowledged; retry after the disk "
+                    "recovers" % e)
+
+    # -- HA: storage degradation (journal unwritable) -----------------------
+    def _enter_degraded(self, err):
+        first = not self.journal_degraded
+        self.journal_degraded = True
+        self.degraded_reason = str(err)
+        if first:
+            self._g_degraded.set(1)
+            telemetry.flight_recorder().record_event(
+                "journal_degraded", error=str(err))
+
+    def _exit_degraded(self):
+        if self.journal_degraded:
+            self.journal_degraded = False
+            self.degraded_reason = None
+            self._g_degraded.set(0)
+            telemetry.flight_recorder().record_event("journal_recovered")
+
+    def check_journal(self):
+        """Probe the journal with a *synced* no-op append; on success
+        exit degraded mode in place (no restart) and compact so every
+        mutation the journal missed while unwritable is recaptured in
+        the snapshot. Returns True when the journal is writable."""
+        if self.journal is None or not self.journal_degraded:
+            return True
+        try:
+            self.journal.append("noop", {"probe": True}, sync=True)
+            self.journal.compact(self.export_state())
+        except OSError as e:
+            self.degraded_reason = str(e)
+            return False
+        self._exit_degraded()
+        return True
+
+    def _require_journal_writable(self):
+        """Gate for control-plane mutations: while the journal is
+        unwritable they cannot be made durable, so acknowledging them
+        could lose them on failover — refuse with 503 + Retry-After
+        instead. Probes first, so a recovered disk exits degraded mode
+        on the next control attempt, no restart needed."""
+        if self.journal is not None and self.journal_degraded \
+                and not self.check_journal():
+            raise JournalDegraded(
+                "fleet: journal unwritable (%s) — control plane is "
+                "read-only until the disk recovers"
+                % self.degraded_reason)
+
+    # -- HA: journal replication (primary side) -----------------------------
+    def journal_manifest(self):
+        """The replication manifest a pulling standby polls; None when
+        no journal is attached."""
+        if self.journal is None:
+            return None
+        from .replicate import build_manifest
+        man = build_manifest(self.journal.dir, self.epoch,
+                             self.journal.seq)
+        man["degraded"] = self.journal_degraded
+        return man
+
+    def journal_read(self, name, offset=0):
+        """Bounded byte-range read of one journal file for a
+        replication fetch. Raises ``KeyError`` for anything that is
+        not a journal file of ours."""
+        if self.journal is None:
+            raise KeyError("no journal attached")
+        from .replicate import read_journal_file
+        return read_journal_file(self.journal.dir, name, offset)
 
     def announce(self, address):
         """Journal this incarnation's epoch claim + bound address (the
@@ -239,6 +342,11 @@ class Router:
              payload.get("temperature", 0.0), payload.get("seed", 0)],
             sort_keys=True)
         return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def _has_orphan(self, sid):
+        with self._lock:
+            s = self._sessions.get(sid)
+            return s is not None and bool(s.get("orphan"))
 
     def _adopt_session(self, sid):
         """Claim a journal-replayed orphan for this request thread;
@@ -473,6 +581,20 @@ class Router:
         tokens = []
         cur_prompt = [int(t) for t in prompt]
         sid = self._session_id(payload)
+        if self._has_orphan(sid) and self.journal_degraded \
+                and not self.check_journal():
+            # adopting an orphan claims exclusive ownership, and that
+            # claim's progress must be journalable before we run it —
+            # after another failover an un-checkpointed adopted session
+            # would replay from a stale cursor while the client already
+            # holds newer tokens. Requests WITHOUT an orphan are plain
+            # data plane and flow normally even while degraded.
+            self._c_requests.inc(kind="generate", outcome="degraded")
+            return 503, {"error": "fleet: journal degraded — session "
+                                  "adoption paused until the disk "
+                                  "recovers",
+                         "retry_after_s": JournalDegraded.retry_after_s}, \
+                {"Retry-After": "1"}
         adopted = self._adopt_session(sid)
         if adopted is not None:
             # this exact request was in flight when the previous router
@@ -674,6 +796,7 @@ class Router:
     def set_split(self, model, weights):
         """Set the version traffic split for ``model`` (weights are
         normalized; a missing version gets zero traffic)."""
+        self._require_journal_writable()
         clean = {}
         for v, w in dict(weights).items():
             w = float(w)
@@ -684,25 +807,33 @@ class Router:
         total = sum(clean.values())
         if total <= 0:
             raise MXNetError("fleet: split weights must sum > 0")
-        with self._lock:
-            self.splits[str(model)] = {v: w / total
-                                       for v, w in clean.items()}
+        norm = {v: w / total for v, w in clean.items()}
+        # WAL discipline: the record hits the disk before the split is
+        # live, so an acked split is always durable (the drill asserts
+        # acked control ops survive a primary disk death)
         self._journal_append("split", {"model": str(model),
-                                       "weights": self.splits[str(model)]})
-        return dict(self.splits[str(model)])
+                                       "weights": norm}, required=True)
+        with self._lock:
+            self.splits[str(model)] = norm
+        return dict(norm)
 
     def clear_split(self, model):
+        self._require_journal_writable()
+        self._journal_append("split", {"model": str(model),
+                                       "weights": None}, required=True)
         with self._lock:
             self.splits.pop(str(model), None)
-        self._journal_append("split", {"model": str(model),
-                                       "weights": None})
 
     def promote(self, model, version):
         """Blue/green flip: 100% of ``model`` traffic to ``version``.
         Old-version replicas stay registered (instant rollback path);
         their in-flight requests finish — the router just stops handing
         them new ones."""
+        self._require_journal_writable()
         model, version = str(model), str(version)
+        self._journal_append("split", {"model": model,
+                                       "weights": {version: 1.0}},
+                             required=True)
         with self._lock:
             self.splits[model] = {version: 1.0}
             c = self.canaries.get(model)
@@ -710,8 +841,6 @@ class Router:
                 c["state"] = "promoted"
             c_rec = ({k: v for k, v in c.items() if k != "deltas"}
                      if c is not None else None)
-        self._journal_append("split", {"model": model,
-                                       "weights": {version: 1.0}})
         if c_rec is not None:
             self._journal_append("canary", {"model": model,
                                             "record": c_rec})
@@ -722,6 +851,7 @@ class Router:
         previous split as the rollback baseline. ``budget`` defaults to
         the int8 accuracy budget flag — the PR-10 probe's top-1 delta
         is the rollback signal."""
+        self._require_journal_writable()
         model, version = str(model), str(version)
         split = float(split)
         if not 0.0 < split < 1.0:
@@ -748,7 +878,8 @@ class Router:
                 "deltas": [], "state": "active", "reason": None,
             }
             self._journal_append("split", {"model": model,
-                                           "weights": dict(mixed)})
+                                           "weights": dict(mixed)},
+                                 required=True)
             self._journal_append("canary", {
                 "model": model,
                 "record": {k: v for k, v in self.canaries[model].items()
@@ -763,6 +894,7 @@ class Router:
         are put in router-side draining — new traffic stops instantly,
         in-flight requests complete on the still-running processes, so
         nothing drops."""
+        self._require_journal_writable()
         model = str(model)
         with self._lock:
             c = self.canaries.get(model)
@@ -842,6 +974,9 @@ class Router:
         snap["sessions"] = sessions
         if self.journal is not None:
             snap["journal"] = self.journal.stats()
+            snap["journal_degraded"] = self.journal_degraded
+            if self.degraded_reason:
+                snap["journal_degraded_reason"] = self.degraded_reason
         if self.replay_stats is not None:
             snap["replay"] = dict(self.replay_stats)
         return snap
@@ -905,6 +1040,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         {"ready": ok, "replicas": snap["counts"]})
         elif path == "/livez":
             self._reply(200, {"alive": True})
+        elif path == "/journal/manifest":
+            man = router.journal_manifest()
+            if man is None:
+                self._reply(404, {"error": "no journal attached"})
+            else:
+                self._reply(200, man)
+        elif path in ("/journal/segment", "/journal/snapshot"):
+            q = urllib.parse.parse_qs(query)
+            name = (q.get("name") or [""])[0]
+            try:
+                offset = int((q.get("offset") or ["0"])[0])
+                data = router.journal_read(name, offset)
+            except (KeyError, ValueError) as e:
+                self._reply(404, {"error": str(e)})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            if router.epoch is not None:
+                # the fence rides every replication response: a pull
+                # from a demoted primary is detectable per fetch, not
+                # just per manifest poll
+                self.send_header("X-Fleet-Epoch", str(router.epoch))
+            self.end_headers()
+            self.wfile.write(data)
         else:
             self._reply(404, {"error": "no such endpoint %r" % self.path})
 
@@ -967,6 +1127,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     payload["model"], payload["delta"],
                     version=payload.get("version")))
             elif self.path == "/admin/drain":
+                router._require_journal_writable()
                 ok = router.registry.set_draining(
                     payload["id"], payload.get("draining", True))
                 self._reply(200 if ok else 404,
@@ -974,6 +1135,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
             else:
                 self._reply(404, {"error": "no such endpoint %r"
                                            % self.path})
+        except JournalDegraded as e:
+            # degraded control plane: not the client's fault and not
+            # permanent — 503 + Retry-After, distinct from the 400s
+            self._reply(503, {"error": str(e),
+                              "retry_after_s": e.retry_after_s},
+                        {"Retry-After":
+                         "%d" % max(1, round(e.retry_after_s))})
         except (MXNetError, KeyError, TypeError, ValueError) as e:
             self._reply(400, {"error": str(e)})
 

@@ -40,6 +40,20 @@ Actions and their points:
     Corrupt the just-committed snapshot for step ``N`` by truncating
     ``B`` bytes (default 64) off its data file — proves the CRC check
     skips it.
+``enospc@journal=OP``
+    The storage fault model's "disk full": raise ``OSError(ENOSPC)``
+    at a journal write site (``append``/``fsync``/``compact``).
+    Unlimited by default — a full disk stays full until the spec is
+    cleared, which is how the router's degraded-mode recovery
+    (exit-without-restart) is tested.
+``torn_write@journal=append[:bytes=B]``
+    Power-loss semantics: the journal persists only the first ``B``
+    bytes (default 6) of the record frame, then the append fails with
+    ``OSError(EIO)``. Proves replay/replication tolerate a torn tail
+    and that the writer repairs (truncates) it before appending again.
+``slow_fsync@journal=fsync[:secs=S]``
+    Sleep ``S`` seconds (default 0.05) inside the journal's fsync —
+    a dying-disk straggler for group-commit latency tests.
 
 Every spec accepts ``rank=R`` (matched against ``MXNET_WORKER_RANK``,
 default 0), ``count=K`` (max number of firings; ``kill`` and
@@ -60,6 +74,7 @@ the tests assert.
 """
 from __future__ import annotations
 
+import errno
 import logging
 import os
 import signal
@@ -67,15 +82,17 @@ import sys
 import threading
 import time
 
-__all__ = ["fire", "specs", "reset", "InjectedFault", "InjectedConnDrop"]
+__all__ = ["fire", "specs", "reset", "InjectedFault", "InjectedConnDrop",
+           "InjectedENOSPC", "InjectedTornWrite"]
 
 _log = logging.getLogger("mxnet_tpu.faultinject")
 
-_ACTIONS = ("kill", "delay", "conn_drop", "truncate", "raise")
+_ACTIONS = ("kill", "delay", "conn_drop", "truncate", "raise",
+            "enospc", "torn_write", "slow_fsync")
 
 # point name -> the ctx key its @-match compares against
 _POINT_MATCH_KEY = {"step": "step", "call": "op", "serve": "op",
-                    "ckpt": "step"}
+                    "ckpt": "step", "journal": "op"}
 
 
 class InjectedFault(RuntimeError):
@@ -85,6 +102,27 @@ class InjectedFault(RuntimeError):
 class InjectedConnDrop(ConnectionError):
     """Injected connection drop — handled exactly like a real peer
     failure by both ends of the async kvstore protocol."""
+
+
+class InjectedENOSPC(OSError):
+    """Injected disk-full: an ``OSError`` with ``errno.ENOSPC``, so
+    call sites that catch real storage failures catch this one the
+    same way."""
+
+    def __init__(self, point, raw):
+        super().__init__(errno.ENOSPC,
+                         "injected ENOSPC at %s (%r)" % (point, raw))
+
+
+class InjectedTornWrite(OSError):
+    """Injected torn write: the firing site must persist only the
+    first ``keep_bytes`` of the payload it was about to write, then
+    surface this as a failed write (``errno.EIO``)."""
+
+    def __init__(self, keep_bytes, point, raw):
+        super().__init__(errno.EIO,
+                         "injected torn write at %s (%r)" % (point, raw))
+        self.keep_bytes = int(keep_bytes)
 
 
 class _Spec:
@@ -100,9 +138,11 @@ class _Spec:
         self.skip = int(kwargs.get("skip", 0))
         if "count" in kwargs:
             self.budget = int(kwargs["count"])
-        elif action in ("kill", "conn_drop"):
+        elif action in ("kill", "conn_drop", "torn_write"):
             self.budget = 1
         else:
+            # enospc deliberately unlimited: a full disk stays full
+            # until the operator clears it (spec removed from the env)
             self.budget = -1  # unlimited
 
     def matches(self, ctx):
@@ -244,3 +284,10 @@ def _apply(sp, point, ctx):
                 f.truncate(max(0, size - nbytes))
     elif sp.action == "raise":
         raise InjectedFault("injected fault at %s (%r)" % (point, sp.raw))
+    elif sp.action == "enospc":
+        raise InjectedENOSPC(point, sp.raw)
+    elif sp.action == "torn_write":
+        raise InjectedTornWrite(int(sp.kwargs.get("bytes", 6)),
+                                point, sp.raw)
+    elif sp.action == "slow_fsync":
+        time.sleep(float(sp.kwargs.get("secs", 0.05)))

@@ -204,6 +204,250 @@ def test_lease_monitor_measures_content_change_not_wall_clock(tmp_path):
     assert mon.expired(3600.0) or mon.age_s() >= 0.0
 
 
+def test_segment_rotation_at_size_cap(tmp_path):
+    # satellite: segments rotate once the live one crosses the cap,
+    # not only at open/compaction — bounding the replication unit
+    j = FleetJournal(str(tmp_path), sync_every=1, segment_bytes=256)
+    for i in range(30):
+        j.append("state", {"id": "r%d" % i, "pad": "x" * 32})
+    j.close()
+    segs = _segments(str(tmp_path))
+    assert len(segs) > 1, "no size-based rotation happened"
+    # every sealed segment respects the cap (only the newest may be
+    # mid-fill); all records survive rotation, in order
+    for _, p in segs[:-1]:
+        assert os.path.getsize(p) >= 256
+    st, stats = replay(str(tmp_path))
+    assert st.applied_seq == 30
+    assert stats["records"] == 30 and stats["torn_segments"] == 0
+    # rotation disabled: one segment no matter the volume
+    j2 = FleetJournal(str(tmp_path / "flat"), sync_every=1,
+                      segment_bytes=0)
+    for i in range(30):
+        j2.append("state", {"id": "r%d" % i, "pad": "x" * 32})
+    j2.close()
+    assert len(_segments(str(tmp_path / "flat"))) == 1
+
+
+def test_tailer_idle_backoff_and_catchup_burst(tmp_path):
+    # satellite: no busy-polling — empty polls back off exponentially
+    # toward the cap, any progress snaps the delay back to zero
+    import random
+    j = FleetJournal(str(tmp_path), sync_every=1)
+    tailer = JournalTailer(str(tmp_path), idle_base_s=0.01,
+                           idle_cap_s=0.5)
+    rng = random.Random(3)
+    assert tailer.next_delay_s(rng=rng) == 0.0     # never slept yet
+    delays = []
+    for _ in range(10):
+        assert tailer.poll() == 0
+        delays.append(tailer.next_delay_s(rng=rng))
+    assert all(0.0 < d <= 0.5 for d in delays)
+    assert delays[-1] > delays[0]                  # grew toward the cap
+    assert max(delays) <= 0.5 + 1e-9               # capped
+    j.append("epoch", {"epoch": 1, "address": None})
+    assert tailer.poll() == 1
+    assert tailer.next_delay_s(rng=rng) == 0.0     # catch-up burst
+    j.close()
+
+
+def test_announcer_retries_transient_conn_failures(monkeypatch):
+    # satellite: conn-refused/reset while a router restarts is retried
+    # on the shared backoff schedule — the replica rejoins on its own
+    from mxnet_tpu.fleet import registry as registry_mod
+    from mxnet_tpu.fleet.registry import ReplicaAnnouncer
+    calls = []
+
+    def flaky_post(url, payload, timeout_s=None):
+        calls.append(url)
+        if len(calls) <= 2:
+            raise ConnectionRefusedError("router is between incarnations")
+        return {"registered": payload.get("id"), "epoch": 1}
+
+    monkeypatch.setattr(registry_mod, "_post_json", flaky_post)
+    ann = ReplicaAnnouncer("http://router:1", {"id": "r0", "url": "u",
+                                               "model": "m",
+                                               "version": "0",
+                                               "mode": "predict"},
+                           lambda: {"ready": True, "reason": None,
+                                    "load": {}}, interval_s=0.2)
+    ann.start()
+    try:
+        assert ann.registered.wait(10.0), \
+            "announcer never recovered from transient conn failures"
+    finally:
+        ann.stop(deregister=False)
+    assert len(calls) >= 3                 # 2 failures + the success
+    assert ann.conn_failures == 0          # reset on success
+    assert ann.stale_router_rejections == 0
+
+
+def test_announcer_backoff_schedule_is_shared(monkeypatch):
+    # the retry delays come from supervisor.backoff_delay (capped at
+    # the heartbeat interval), not an ad-hoc sleep
+    from mxnet_tpu.fleet import registry as registry_mod
+    from mxnet_tpu.fleet import supervisor as supervisor_mod
+    from mxnet_tpu.fleet.registry import ReplicaAnnouncer
+    waits = []
+    real_backoff = supervisor_mod.backoff_delay
+
+    def spy_backoff(attempt, **kw):
+        d = real_backoff(attempt, **kw)
+        waits.append((attempt, kw.get("base"), kw.get("cap"), d))
+        return d
+
+    def always_refused(url, payload, timeout_s=None):
+        raise ConnectionRefusedError("down")
+
+    monkeypatch.setattr(supervisor_mod, "backoff_delay", spy_backoff)
+    monkeypatch.setattr(registry_mod, "_post_json", always_refused)
+    ann = ReplicaAnnouncer("http://router:1", {"id": "r0", "url": "u",
+                                               "model": "m",
+                                               "version": "0",
+                                               "mode": "predict"},
+                           lambda: {"ready": True, "reason": None,
+                                    "load": {}}, interval_s=0.05)
+    ann.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while len(waits) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        ann.stop(deregister=False)
+    assert len(waits) >= 3
+    attempts = [w[0] for w in waits[:3]]
+    assert attempts == [0, 1, 2]           # consecutive-failure schedule
+    for _, base, cap, d in waits:
+        assert cap == pytest.approx(0.05)  # capped at the interval
+        # the schedule's jitter is ±50% around min(cap, base * 2^n)
+        assert 0.0 < d <= 0.05 * 1.5 + 1e-9
+    assert ann.conn_failures >= 3
+
+
+def test_tailer_adopts_snapshot_when_compaction_races_mid_poll(
+        tmp_path, monkeypatch):
+    # the exact race the randomized property test samples, forced
+    # deterministically: a compaction lands BETWEEN the tailer's
+    # snapshot check and its segment scan, so the scan sees only the
+    # fresh post-compaction segment (seq jumps past the records that
+    # were folded into the snapshot). Without gap detection the tailer
+    # applies across the jump and silently loses the folded records —
+    # the snapshot is behind applied_seq forever after.
+    from mxnet_tpu.fleet import journal as journal_mod
+    jdir = str(tmp_path)
+    j = FleetJournal(jdir, sync_every=1)
+    tailer = JournalTailer(jdir)
+    j.append("register", {"id": "early", "url": "u", "model": "m",
+                          "version": "0", "mode": "predict"})
+    assert tailer.poll() == 1
+    # a record the tailer has NOT yet seen, about to be compacted away
+    j.append("register", {"id": "mid", "url": "u", "model": "m",
+                          "version": "0", "mode": "predict"})
+
+    real_segments = journal_mod._segments
+    armed = [None]
+
+    def racing_segments(d):
+        fn, armed[0] = armed[0], None
+        if fn is not None:
+            fn()        # fires between _snapshots() and _segments()
+        return real_segments(d)
+
+    def inject():
+        st, _ = replay(jdir)
+        j.compact(st)          # "mid" now lives only in the snapshot
+        j.append("register", {"id": "late", "url": "u", "model": "m",
+                              "version": "0", "mode": "predict"})
+
+    monkeypatch.setattr(journal_mod, "_segments", racing_segments)
+    armed[0] = inject
+    tailer.poll()
+    assert tailer.state.applied_seq == j.seq
+    assert "mid" in tailer.state.replicas, \
+        "compaction race lost records: tailer jumped the seq gap " \
+        "instead of adopting the covering snapshot"
+    assert "late" in tailer.state.replicas
+
+
+def test_replay_never_gaps_or_doubles_under_compaction_race(
+        tmp_path, monkeypatch):
+    # satellite property test: a tailer polling WHILE the writer
+    # appends and compacts never applies a record out of contiguous
+    # seq order (gap = silently lost records, double-apply = corrupt
+    # reducer state) and converges to exactly what a clean replay says.
+    import random
+    from mxnet_tpu.fleet import journal as journal_mod
+
+    incarnations = []
+
+    class RecordingState(journal_mod.FleetState):
+        def __init__(self):
+            super().__init__()
+            self.seen = []               # (applied_seq_before, seq)
+            incarnations.append(self)
+
+        def apply(self, seq, kind, data):
+            before = self.applied_seq
+            ok = super().apply(seq, kind, data)
+            if ok:
+                self.seen.append((before, seq))
+            return ok
+
+    monkeypatch.setattr(journal_mod, "FleetState", RecordingState)
+
+    rng = random.Random(1234)
+    jdir = str(tmp_path)
+    j = FleetJournal(jdir, sync_every=1, segment_bytes=512)
+    tailer = JournalTailer(jdir, idle_base_s=1e-4, idle_cap_s=1e-3)
+    stop = threading.Event()
+    poll_error = []
+
+    def chase():
+        try:
+            while not stop.is_set():
+                tailer.poll()
+        except Exception as e:            # pragma: no cover - surfaced
+            poll_error.append(e)
+
+    t = threading.Thread(target=chase, daemon=True)
+    t.start()
+    state = journal_mod.FleetState.__mro__[1]()   # plain shadow state
+    total = 0
+    try:
+        for round_ in range(40):
+            for _ in range(rng.randint(1, 6)):
+                rec = {"id": "r%d" % rng.randint(0, 9), "url": "u",
+                       "model": "m", "version": "0", "mode": "predict",
+                       "pad": "x" * rng.randint(0, 40)}
+                seq = j.append("register", rec)
+                state.apply(seq, "register", rec)
+                total += 1
+            if rng.random() < 0.5:
+                # compact mid-chase: segments vanish under the tailer
+                j.compact(dict(state.to_dict(), applied_seq=j.seq))
+    finally:
+        j.sync()
+        deadline = time.monotonic() + 10.0
+        while (tailer.state.applied_seq < j.seq
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        stop.set()
+        t.join(5.0)
+        j.close()
+    assert not poll_error, poll_error
+    # (1) contiguity within every state incarnation: each applied seq
+    # extends the previous by exactly one (no gap, no double)
+    for st in incarnations:
+        for before, seq in st.seen:
+            assert seq == before + 1, \
+                "seq gap/double under compaction race: %d -> %d" \
+                % (before, seq)
+    # (2) convergence: the raced tailer ends bitwise at clean replay
+    final, _ = replay(jdir)
+    assert tailer.state.applied_seq == j.seq
+    assert tailer.state.to_dict() == final.to_dict()
+
+
 # ---------------------------------------------------------------------------
 # registry liveness: injectable clock (NTP-proof sweeps)
 # ---------------------------------------------------------------------------

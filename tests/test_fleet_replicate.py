@@ -1,0 +1,481 @@
+"""Journal replication + storage fault model (mxnet_tpu.fleet.replicate
++ router degraded mode) — chip-free.
+
+The acceptance properties: (1) a standby's JournalReplicator streams
+the primary's journal over the router's own HTTP front end into a
+local directory that ``Router.from_journal`` promotes from — snapshot
+bootstrap, offset-resumed fetches, receiver-side CRC re-verification
+(an in-transit bit flip is truncated and re-fetched, never applied),
+seq-gap auto re-sync, and an epoch fence so a demoted primary can
+never feed a promoted standby; (2) the storage fault model
+(``enospc``/``torn_write``/``slow_fsync`` at ``@journal`` points)
+drives the router into degraded mode where control-plane mutations
+503 with Retry-After while predict/generate traffic keeps flowing,
+and a recovered disk exits degraded mode with NO restart.
+"""
+import glob
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.fleet import (FleetJournal, JournalDegraded,
+                             JournalReplicator, ReplicaRegistry, Router,
+                             StaleSourceError, fencing, route_http)
+from mxnet_tpu.fleet.journal import replay
+from mxnet_tpu.fleet.replicate import read_journal_file
+from mxnet_tpu.parallel import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    fencing.reset()
+    monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+    faultinject.reset()
+    yield
+    fencing.reset()
+    faultinject.reset()
+
+
+def _register(registry, rid, *, model="m", version="0", mode="predict",
+              ready=True, load=None, spec=None):
+    return registry.register({
+        "id": rid, "url": "http://%s.invalid" % rid, "model": model,
+        "version": version, "mode": mode, "ready": ready,
+        "load": load or {}, "spec": spec})
+
+
+def _primary(tmp_path, name="pj", **jkw):
+    """A journaled router serving its journal over a real HTTP front."""
+    jkw.setdefault("sync_every", 1)
+    router = Router(registry=ReplicaRegistry(heartbeat_timeout_s=60.0))
+    router.attach_journal(FleetJournal(str(tmp_path / name), **jkw))
+    front = route_http(router, "127.0.0.1", 0)
+    router.announce(front.address)
+    return router, front
+
+
+def _post(url, payload, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode() or "{}"), \
+                dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}"), \
+            dict(e.headers)
+
+
+def _get_json(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode() or "{}")
+
+
+def _gauge_value(prom_text, name):
+    for line in prom_text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(None, 1)[-1])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# primary side: manifest + bounded reads
+# ---------------------------------------------------------------------------
+
+def test_manifest_and_bounded_reads(tmp_path):
+    router, front = _primary(tmp_path)
+    try:
+        _register(router.registry, "a")
+        man = router.journal_manifest()
+        assert man["epoch"] == 1
+        assert man["seq"] == router.journal.seq >= 2
+        assert man["degraded"] is False
+        assert [s["name"] for s in man["segments"]] == ["wal-00000001.log"]
+        size = man["segments"][0]["size"]
+        blob = router.journal_read("wal-00000001.log")
+        assert len(blob) == size
+        # offset-resumed read returns only the tail
+        tail = router.journal_read("wal-00000001.log", offset=size - 4)
+        assert tail == blob[-4:]
+        # name validation: traversal / non-journal files are KeyError,
+        # never opened
+        for bad in ("../secret", "lease.json", "/etc/passwd",
+                    "wal-1.log", "snap-x.json", ""):
+            with pytest.raises(KeyError):
+                read_journal_file(router.journal.dir, bad)
+        with pytest.raises(KeyError):
+            router.journal_read("wal-00000099.log")   # absent file
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# replicator: bootstrap, incremental follow, restart resume, promotion
+# ---------------------------------------------------------------------------
+
+def test_replicator_bootstraps_then_follows_incrementally(tmp_path):
+    router, front = _primary(tmp_path)
+    rdir = str(tmp_path / "replica")
+    try:
+        for rid in ("a", "b", "c"):
+            _register(router.registry, rid)
+        router.set_split("m", {"0": 1.0})
+        repl = JournalReplicator(front.address, rdir, poll_s=0.05)
+        n = repl.poll()
+        assert n == router.journal.seq     # epoch + 3 registers + split
+        assert repl.state.to_dict() == replay(router.journal.dir)[0].to_dict()
+        assert repl.max_epoch == 1         # epoch learned from the wire
+        assert repl.stats()["lag_records"] == 0
+        assert repl.next_delay_s() == 0.0  # catch-up burst after progress
+
+        # incremental: only the new records cross the wire
+        _register(router.registry, "d")
+        router.set_split("m", {"0": 0.5, "1": 0.5})
+        assert repl.poll() == 2
+        assert repl.state.splits["m"] == {"0": 0.5, "1": 0.5}
+        assert repl.poll() == 0            # nothing new
+        assert repl.next_delay_s() == pytest.approx(0.05)  # idle pace
+    finally:
+        front.stop()
+
+
+def test_replicator_resumes_offsets_across_restart_and_rotation(tmp_path):
+    # tiny segments force rotation mid-stream: the replica mirrors the
+    # multi-segment layout and a restarted replicator re-verifies its
+    # local files instead of re-fetching history
+    router, front = _primary(tmp_path, segment_bytes=256)
+    rdir = str(tmp_path / "replica")
+    try:
+        for i in range(12):
+            router.journal.append("noop", {"pad": "x" * 40, "i": i})
+        repl = JournalReplicator(front.address, rdir, poll_s=0.05)
+        repl.poll()
+        assert len(glob.glob(os.path.join(rdir, "wal-*.log"))) > 1
+        assert repl.state.applied_seq == router.journal.seq
+
+        repl2 = JournalReplicator(front.address, rdir, poll_s=0.05)
+        # local re-verification alone restores the state (no network)
+        assert repl2.state.applied_seq == repl.state.applied_seq
+        assert repl2.poll() == 0
+        assert repl2._offsets == repl._offsets
+
+        # the replica directory IS the promotion path
+        front.stop()
+        promoted = Router.from_journal(
+            rdir, registry=ReplicaRegistry(heartbeat_timeout_s=60.0))
+        assert promoted.epoch == router.epoch + 1
+        promoted.journal.close()
+    finally:
+        front.stop()
+
+
+def test_snapshot_bootstrap_skips_compacted_history(tmp_path):
+    router, front = _primary(tmp_path)
+    rdir = str(tmp_path / "replica")
+    try:
+        for rid in ("a", "b"):
+            _register(router.registry, rid)
+        router.set_split("m", {"0": 1.0})
+        router.journal.compact(router.export_state())
+        _register(router.registry, "late")
+        repl = JournalReplicator(front.address, rdir, poll_s=0.05)
+        repl.poll()
+        assert repl.state.applied_seq == router.journal.seq
+        assert set(repl.state.replicas) == {"a", "b", "late"}
+        assert glob.glob(os.path.join(rdir, "snap-*.json"))
+        # post-compaction segments only: the pre-snapshot history never
+        # crossed the wire
+        local_segs = sorted(os.path.basename(p) for p in
+                            glob.glob(os.path.join(rdir, "wal-*.log")))
+        remote_segs = sorted(s["name"] for s in
+                             router.journal_manifest()["segments"])
+        assert local_segs == remote_segs
+    finally:
+        front.stop()
+
+
+def test_seq_gap_on_cold_replica_triggers_resync_not_partial_state(
+        tmp_path, monkeypatch):
+    # a cold replica whose snapshot fetch fails must NOT start applying
+    # mid-history segments (silent prefix loss): the seq gap forces a
+    # re-sync, and the second pass adopts the snapshot
+    router, front = _primary(tmp_path)
+    rdir = str(tmp_path / "replica")
+    try:
+        for rid in ("a", "b"):
+            _register(router.registry, rid)
+        router.journal.compact(router.export_state())
+        _register(router.registry, "late")
+        repl = JournalReplicator(front.address, rdir, poll_s=0.05)
+        orig = repl._adopt_snapshot
+        failed = []
+
+        def flaky(snap):
+            if not failed:
+                failed.append(1)
+                raise OSError("half-written on the source")
+            return orig(snap)
+
+        monkeypatch.setattr(repl, "_adopt_snapshot", flaky)
+        repl.poll()
+        assert failed                       # the failure path ran
+        assert repl.state.applied_seq == router.journal.seq
+        assert set(repl.state.replicas) == {"a", "b", "late"}
+        assert repl.state.to_dict() == replay(rdir)[0].to_dict()
+    finally:
+        front.stop()
+
+
+def test_history_regression_wipes_and_resyncs(tmp_path):
+    # the source restarted with a FRESH journal (seq behind the
+    # replica): record-by-record patching cannot reconverge, so the
+    # replica wipes itself and re-bootstraps
+    router, front = _primary(tmp_path)
+    rdir = str(tmp_path / "replica")
+    try:
+        for i in range(6):
+            router.journal.append("noop", {"i": i})
+        repl = JournalReplicator(front.address, rdir, poll_s=0.05)
+        repl.poll()
+        assert repl.state.applied_seq == router.journal.seq > 3
+
+        fresh = FleetJournal(str(tmp_path / "fresh"), sync_every=1)
+        router.journal.close()
+        router.attach_journal(fresh)
+        router.announce(front.address)      # re-journal the epoch claim
+        repl.poll()
+        assert repl.state.applied_seq == fresh.seq < 6
+        assert repl.state.to_dict() == replay(rdir)[0].to_dict()
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# receiver-side CRC: an in-transit bit flip is refetched, never applied
+# ---------------------------------------------------------------------------
+
+def test_bit_flipped_segment_is_refetched_not_applied(tmp_path,
+                                                      monkeypatch):
+    router, front = _primary(tmp_path)
+    rdir = str(tmp_path / "replica")
+    try:
+        for rid in ("a", "b", "c"):
+            _register(router.registry, rid)
+        repl = JournalReplicator(front.address, rdir, poll_s=0.05)
+        orig = repl._fetch_file
+        flipped = []
+
+        def corrupt_once(kind, name, offset=0):
+            data = orig(kind, name, offset)
+            if kind == "segment" and not flipped and len(data) > 20:
+                flipped.append(name)
+                buf = bytearray(data)
+                buf[len(buf) // 2] ^= 0xFF
+                data = bytes(buf)
+            return data
+
+        monkeypatch.setattr(repl, "_fetch_file", corrupt_once)
+        repl.poll()
+        assert flipped
+        # the flip landed mid-stream: everything from the corrupt record
+        # on was truncated off, nothing garbage was applied
+        truth, _ = replay(router.journal.dir)
+        assert repl.state.applied_seq < truth.applied_seq
+        seg = os.path.join(rdir, flipped[0])
+        assert os.path.getsize(seg) < \
+            router.journal_manifest()["segments"][0]["size"]
+        for rec in repl.state.replicas.values():
+            assert rec["id"] in ("a", "b", "c")
+
+        # next poll re-fetches from the verified offset and converges
+        repl.poll()
+        assert repl.state.to_dict() == truth.to_dict()
+        assert repl.state.applied_seq == router.journal.seq
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# epoch fence: a demoted primary can never feed a promoted standby
+# ---------------------------------------------------------------------------
+
+def test_stale_primary_is_refused_by_promoted_standby(tmp_path):
+    router, front = _primary(tmp_path)    # serves epoch 1
+    rdir = str(tmp_path / "replica")
+    try:
+        _register(router.registry, "a")
+        repl = JournalReplicator(front.address, rdir, poll_s=0.05)
+        # the standby was promoted meanwhile: it has observed epoch 5
+        repl.max_epoch = 5
+        assert repl.poll() == 0
+        assert repl.state.applied_seq == 0          # nothing applied
+        assert repl.conn_failures == 0              # not a conn failure
+        assert repl.max_epoch == 5                  # never lowered
+        assert not glob.glob(os.path.join(rdir, "wal-*"))
+        with pytest.raises(StaleSourceError):
+            repl._check_epoch(4)
+        # a stale source never refreshes the liveness clock either: the
+        # standby's own promotion timer keeps running
+        time.sleep(0.05)
+        assert repl.age_s() > 0.04
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# storage fault model: enospc -> degraded control plane, flowing data
+# plane, restartless recovery (the ISSUE's pinned acceptance tests)
+# ---------------------------------------------------------------------------
+
+def test_enospc_degrades_control_plane_not_data_plane(tmp_path,
+                                                      monkeypatch):
+    router = Router(registry=ReplicaRegistry(heartbeat_timeout_s=60.0))
+    router.attach_journal(FleetJournal(str(tmp_path / "j"),
+                                       sync_every=1))
+    router.announce("http://127.0.0.1:0")
+    _register(router.registry, "p", load={"load_s": 0.0, "unit_s": 0.01})
+    _register(router.registry, "g", mode="generate",
+              spec={"vocab": 61, "max_prompt_len": 8, "max_context": 32})
+    router.set_split("m", {"0": 1.0})               # acked pre-fault
+
+    def fake_call(url, payload, timeout_s):
+        if "prompt" in payload:
+            base = len(payload["prompt"])
+            n = payload["max_new_tokens"]
+            return 200, {"tokens": list(range(base, base + n)),
+                         "finish_reason": "length", "ttft_ms": 1.0}, {}
+        return 200, {"outputs": [[1.0]]}, {}
+
+    monkeypatch.setattr(router, "_call", fake_call)
+
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "enospc@journal=append")
+    faultinject.reset()
+    # control-plane mutation: refused, NOT acked, NOT applied
+    with pytest.raises(JournalDegraded) as ei:
+        router.set_split("m", {"0": 0.5, "1": 0.5})
+    assert ei.value.retry_after_s > 0
+    assert router.journal_degraded is True
+    assert router.splits["m"] == {"0": 1.0}         # journal-first: no
+    snap = router.fleet_snapshot()                  # half-applied split
+    assert snap["journal_degraded"] is True
+    assert "ENOSPC" in snap["journal_degraded_reason"]
+    assert _gauge_value(telemetry.prometheus_text(),
+                        "mxtpu_fleet_journal_degraded") == 1.0
+
+    # data plane keeps flowing: predict AND generate (whose session
+    # cursors journal best-effort) both succeed while degraded
+    code, out, _ = router.route_predict({"inputs": {"data": [[0.0]]}})
+    assert code == 200 and out["replica"] == "p"
+    code, out, _ = router.route_generate({"prompt": [5, 9, 13],
+                                          "max_new_tokens": 4})
+    assert code == 200 and len(out["tokens"]) == 4
+    # registry liveness unaffected
+    router.registry.heartbeat("p", ready=True)
+    assert router.journal_degraded is True          # still degraded
+
+    # disk recovers: the next control attempt probes, compacts the
+    # missed mutations into a snapshot, and exits degraded mode with
+    # NO restart
+    monkeypatch.delenv("MXNET_FAULT_INJECT")
+    faultinject.reset()
+    out = router.set_split("m", {"0": 0.25, "1": 0.75})
+    assert out == {"0": 0.25, "1": 0.75}
+    assert router.journal_degraded is False
+    assert router.degraded_reason is None
+    assert _gauge_value(telemetry.prometheus_text(),
+                        "mxtpu_fleet_journal_degraded") == 0.0
+    # everything the journal missed while unwritable was recaptured:
+    # replay sees the recovery-compaction snapshot + the new split
+    router.journal.sync()
+    st, _ = replay(router.journal.dir)
+    assert st.splits["m"] == {"0": 0.25, "1": 0.75}
+    assert set(st.replicas) == {"p", "g"}
+    router.journal.close()
+
+
+def test_enospc_is_503_with_retry_after_over_http(tmp_path, monkeypatch):
+    router, front = _primary(tmp_path)
+    url = front.address
+    try:
+        code, _, _ = _post(url + "/fleet/register",
+                           {"id": "a", "url": "http://a.invalid",
+                            "model": "m", "version": "0",
+                            "mode": "predict", "ready": True})
+        assert code == 200
+        code, out, _ = _post(url + "/admin/split",
+                             {"model": "m", "weights": {"0": 1.0}})
+        assert code == 200
+
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "enospc@journal=append")
+        faultinject.reset()
+        code, out, headers = _post(url + "/admin/split",
+                                   {"model": "m", "weights": {"0": 2.0}})
+        assert code == 503
+        assert "journal" in out["error"]
+        assert int(headers["Retry-After"]) >= 1
+        code, _, headers = _post(url + "/admin/drain", {"id": "a"})
+        assert code == 503 and "Retry-After" in headers
+        # reads and the data-plane/registry legs still answer
+        code, snap = _get_json(url + "/fleet")
+        assert code == 200 and snap["journal_degraded"] is True
+        code, out, _ = _post(url + "/fleet/heartbeat",
+                             {"id": "a", "ready": True})
+        assert code == 200 and out["known"] is True
+
+        monkeypatch.delenv("MXNET_FAULT_INJECT")
+        faultinject.reset()
+        code, out, _ = _post(url + "/admin/split",
+                             {"model": "m", "weights": {"0": 2.0}})
+        assert code == 200                  # recovered, no restart
+        code, snap = _get_json(url + "/fleet")
+        assert snap["journal_degraded"] is False
+    finally:
+        front.stop()
+
+
+def test_torn_write_is_repaired_before_the_next_append(tmp_path,
+                                                       monkeypatch):
+    j = FleetJournal(str(tmp_path / "j"), sync_every=1)
+    j.append("noop", {"i": 1})
+    j.append("noop", {"i": 2})
+    size_before = os.path.getsize(j._seg_path)
+
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "torn_write@journal=append")
+    faultinject.reset()
+    with pytest.raises(OSError):
+        j.append("noop", {"i": 3})
+    # power-loss semantics: a frame prefix reached the disk
+    assert os.path.getsize(j._seg_path) > size_before
+    st, stats = replay(str(tmp_path / "j"))
+    assert st.applied_seq == 2 and stats["torn_segments"] == 1
+
+    monkeypatch.delenv("MXNET_FAULT_INJECT")
+    faultinject.reset()
+    # the writer truncates its dirty tail before appending through it,
+    # and the failed append never burned a seq (no replication gap)
+    seq = j.append("noop", {"i": 3})
+    assert seq == 3
+    st, stats = replay(str(tmp_path / "j"))
+    assert st.applied_seq == 3 and stats["torn_segments"] == 0
+    j.close()
+
+
+def test_slow_fsync_injects_group_commit_latency(tmp_path, monkeypatch):
+    j = FleetJournal(str(tmp_path / "j"), sync_every=1)
+    t0 = time.monotonic()
+    j.append("noop", {"i": 1})
+    fast = time.monotonic() - t0
+    monkeypatch.setenv("MXNET_FAULT_INJECT",
+                       "slow_fsync@journal=fsync:secs=0.15")
+    faultinject.reset()
+    t0 = time.monotonic()
+    j.append("noop", {"i": 2})
+    slow = time.monotonic() - t0
+    assert slow >= 0.14 > fast
+    j.close()

@@ -40,6 +40,18 @@ are dropped (clients ride the failover with backoff retries), replicas
 409 a write stamped with the dead primary's fencing epoch, and a
 revived old primary refuses startup against the live lease. Runs
 nightly next to ``--fleet``.
+
+``--disk-loss`` drills the primary's DISK death on top of its process
+death: the standby runs with ``--replicate-from`` (its own journal
+directory, fed purely over HTTP WAL replication — no shared storage),
+and mid-load the primary is SIGKILLed AND its journal directory
+deleted. PASS iff the standby promotes from its own replicated
+segments with a bumped epoch, all 10 in-flight generate sessions
+finish bitwise vs the uninterrupted reference, zero acknowledged
+control ops (pre-kill ``/admin/split`` acks) are lost, and
+``fleet/repl_lag_records`` is visible in the promoted router's
+federated /metrics. Emits a machine-parseable
+``fault_drill: [disk-loss] PASS {json}`` line.
 """
 import argparse
 import json
@@ -625,6 +637,332 @@ def router_ha_drill(args):
             shutil.rmtree(work, ignore_errors=True)
 
 
+def disk_loss_drill(args):
+    """The primary-disk-death leg: a journaled primary plus a
+    REPLICATING standby (``--replicate-from``, its own local journal
+    dir — no shared storage). Mid-load the primary is SIGKILLed AND its
+    journal directory deleted; the standby must promote from its own
+    replicated segments with a bumped epoch, every in-flight generate
+    session must finish bitwise vs an uninterrupted reference, zero
+    acknowledged control ops (splits acked pre-kill) may be lost, and
+    ``fleet/repl_lag_records`` must be visible in the promoted router's
+    federated /metrics."""
+    import socket
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    sys.path.insert(0, ROOT)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import serve_loadgen
+
+    GEN_SESSIONS = 10
+    PREDICT_REQUESTS = 240
+    MAX_NEW, TEMP = 12, 0.7
+
+    work = tempfile.mkdtemp(prefix="mxtpu_disk_loss_drill_")
+    jdir_primary = os.path.join(work, "journal_primary")
+    jdir_standby = os.path.join(work, "journal_standby")
+    os.makedirs(jdir_primary, exist_ok=True)
+    ok = False
+    primary = standby = None
+    sup = None
+    try:
+        predict_art = os.path.join(work, "predict.mxtpu")
+        gen_art = os.path.join(work, "generate.mxtpu")
+        print("fault_drill: [disk-loss] building artifacts...")
+        spec = _build_fleet_artifacts(predict_art, gen_art)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_FAULT_INJECT", None)
+        env.pop("MXNET_TELEMETRY_DIR", None)
+        env["MXNET_FLEET_HEARTBEAT_S"] = "0.3"
+        env["MXNET_FLEET_HEARTBEAT_TIMEOUT_S"] = "1.5"
+        env["MXNET_FLEET_JOURNAL_SYNC_EVERY"] = "4"
+        env["MXNET_FLEET_STANDBY_POLL_S"] = "0.1"   # replication cadence
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        router_url = "http://127.0.0.1:%d" % port
+
+        timing = ["--hop-tokens", "4", "--heartbeat-timeout-s", "1.5",
+                  "--lease-interval-s", "0.25", "--lease-timeout-s", "1.2"]
+        primary = subprocess.Popen(
+            [sys.executable, ROUTE, "--port", str(port),
+             "--journal", jdir_primary] + timing,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=ROOT)
+        banner = json.loads(primary.stdout.readline())
+        old_epoch = banner["epoch"]
+        print("fault_drill: [disk-loss] primary at %s (epoch %d, "
+              "journal %s)" % (router_url, old_epoch, jdir_primary))
+        # the standby shares NOTHING with the primary: own journal dir,
+        # fed purely over HTTP replication
+        standby = subprocess.Popen(
+            [sys.executable, ROUTE, "--standby", "--port", str(port),
+             "--journal", jdir_standby,
+             "--replicate-from", router_url] + timing,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=ROOT)
+        json.loads(standby.stdout.readline())   # standby banner
+
+        from mxnet_tpu.fleet import ReplicaSpec, ReplicaSupervisor
+        sup = ReplicaSupervisor(backoff_base=0.2, backoff_cap=1.0)
+
+        def spec_for(rid, art):
+            argv = [sys.executable, SERVE, "--artifact", art,
+                    "--port", "0", "--register", router_url,
+                    "--replica-id", rid]
+            if art is predict_art:
+                argv += ["--buckets", "1"]
+            return ReplicaSpec(rid, argv, env=dict(env), cwd=ROOT,
+                               max_restarts=0,
+                               log_path=os.path.join(work, rid + ".log"))
+
+        for rid, art in (("p0", predict_art), ("p1", predict_art),
+                         ("g0", gen_art), ("g1", gen_art)):
+            sup.add(spec_for(rid, art))
+        sup.start(interval_s=0.2)
+        print("fault_drill: [disk-loss] waiting for 4 ready replicas...")
+        snap0 = _wait_ready(router_url, 4)
+
+        # acknowledged control ops the failover must NOT lose: pin an
+        # explicit 100% split per model (acked 200 by the primary,
+        # journaled sync, replicated before the kill window opens)
+        acked_splits = {}
+        for model, versions in sorted(
+                (snap0.get("models") or {}).items()):
+            version = sorted(versions)[0]
+            body = json.dumps({"model": model,
+                               "weights": {version: 1.0}}).encode()
+            req = urllib.request.Request(
+                router_url + "/admin/split", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                out = json.loads(r.read().decode())
+            acked_splits[model] = out["split"]
+        if not acked_splits:
+            print("fault_drill: FAIL — no models registered to split")
+            return 1
+        print("fault_drill: [disk-loss] acked control ops: %s"
+              % acked_splits)
+
+        # uninterrupted reference tails (position-keyed sampling makes
+        # each (prompt, seed) deterministic on any replica). This also
+        # gives replication ample time to stream the acked splits.
+        import numpy as np
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(2, spec.vocab, size=4).tolist()
+                   for _ in range(GEN_SESSIONS)]
+        reference = []
+        for i, prompt in enumerate(prompts):
+            outc, out, _, _ = serve_loadgen._http_generate_session(
+                router_url, prompt, MAX_NEW, TEMP, 100 + i, None,
+                retries=4, resume_evicted=5, conn_retries=2)
+            if outc != "ok":
+                print("fault_drill: FAIL — reference session %d did "
+                      "not complete (%s)" % (i, outc))
+                return 1
+            reference.append(list(out["tokens"]))
+
+        res_p = {}
+        gen_results = [None] * GEN_SESSIONS
+        next_gen = [0]
+        glock = threading.Lock()
+        gen_done = threading.Event()
+
+        def predict_load():
+            agg = {"attempted": 0, "completed": 0, "rejected": 0,
+                   "expired": 0, "errors": 0, "failovers_ridden": 0}
+            while True:
+                r = serve_loadgen.measure(
+                    router_url, concurrency=6, requests=60,
+                    retries=4, conn_retries=10, shape=(1, 6))
+                for k in agg:
+                    agg[k] += int(r.get(k) or 0)
+                if gen_done.is_set() and \
+                        agg["attempted"] >= PREDICT_REQUESTS:
+                    break
+            res_p.update(agg)
+
+        def generate_load():
+            while True:
+                with glock:
+                    if next_gen[0] >= GEN_SESSIONS:
+                        return
+                    i = next_gen[0]
+                    next_gen[0] += 1
+                gen_results[i] = serve_loadgen._http_generate_session(
+                    router_url, prompts[i], MAX_NEW, TEMP, 100 + i,
+                    None, retries=6, resume_evicted=5, conn_retries=10)
+
+        gen_threads = [threading.Thread(target=generate_load)
+                       for _ in range(3)]
+        pred_thread = threading.Thread(target=predict_load)
+        t0 = time.monotonic()
+        pred_thread.start()
+        for t in gen_threads:
+            t.start()
+        while next_gen[0] < 4 and time.monotonic() - t0 < 60:
+            time.sleep(0.01)
+        # the disk-death moment: SIGKILL the primary AND delete its
+        # journal directory — the only surviving copy of the WAL is the
+        # standby's replica
+        primary.kill()
+        try:
+            primary.wait(15)
+        except subprocess.TimeoutExpired:
+            pass
+        shutil.rmtree(jdir_primary, ignore_errors=True)
+        t_kill = time.monotonic()
+        print("fault_drill: [disk-loss] primary SIGKILLed + journal "
+              "deleted at +%.2fs (%d sessions dispatched)"
+              % (t_kill - t0, next_gen[0]))
+        for t in gen_threads:
+            t.join(600)
+        gen_done.set()
+        pred_thread.join(600)
+        print("fault_drill: [disk-loss] mixed phase took %.1fs"
+              % (time.monotonic() - t0))
+
+        failures = []
+        done = sum(1 for r in gen_results
+                   if r is not None and r[0] == "ok")
+        bitwise = sum(1 for i, r in enumerate(gen_results)
+                      if r is not None and r[0] == "ok"
+                      and list(r[1]["tokens"]) == reference[i])
+        if done != GEN_SESSIONS:
+            failures.append("generate sessions lost across the "
+                            "failover: %d/%d completed"
+                            % (done, GEN_SESSIONS))
+        elif bitwise != GEN_SESSIONS:
+            failures.append("resumed sessions diverged: only %d/%d "
+                            "bitwise-identical to the uninterrupted "
+                            "reference" % (bitwise, GEN_SESSIONS))
+        if not res_p or res_p.get("completed") != res_p.get("attempted") \
+                or (res_p.get("attempted") or 0) < PREDICT_REQUESTS:
+            failures.append("predict dropped in-flight requests: %s"
+                            % {k: res_p.get(k) for k in
+                               ("attempted", "completed", "rejected",
+                                "expired", "errors")})
+
+        # the standby must have promoted FROM ITS OWN REPLICA with a
+        # bumped epoch (the primary's journal no longer exists)
+        snap, last_err = {}, None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                snap = _fleet_get(router_url, "/fleet")
+                if (snap.get("epoch") or 0) > old_epoch:
+                    break
+            except Exception as e:
+                last_err = e
+            time.sleep(0.25)
+        if not snap:
+            failures.append("no router answering after the disk loss: "
+                            "%s" % last_err)
+        new_epoch = snap.get("epoch")
+        if not new_epoch or new_epoch <= old_epoch:
+            failures.append("promoted epoch did not advance (%s -> %s)"
+                            % (old_epoch, new_epoch))
+        if "journal" not in snap or "replay" not in snap:
+            failures.append("promoted router reports no journal/replay "
+                            "stats: %s" % sorted(snap))
+        jstats = snap.get("journal") or {}
+        if jstats.get("dir") and jdir_primary in str(jstats.get("dir")):
+            failures.append("promoted router is serving from the DEAD "
+                            "primary's journal dir: %s" % jstats)
+
+        # zero acked control ops lost: every pre-kill split must be in
+        # the promoted router's control plane, bit-for-bit
+        got_splits = snap.get("splits") or {}
+        for model, weights in acked_splits.items():
+            if got_splits.get(model) != weights:
+                failures.append(
+                    "acked control op lost across the disk loss: "
+                    "split[%s] = %s, wanted %s"
+                    % (model, got_splits.get(model), weights))
+
+        # replication observability: the promoted router's federated
+        # exposition must carry the replication-lag gauge it tracked
+        # while it was the pulling standby
+        try:
+            req = urllib.request.Request(
+                router_url + "/metrics?format=prometheus",
+                headers={"Accept": "text/plain"})
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                metrics_text = r.read().decode()
+        except Exception as e:
+            metrics_text = ""
+            failures.append("cannot scrape federated /metrics: %s" % e)
+        if "mxtpu_fleet_repl_lag_records" not in metrics_text:
+            failures.append("fleet/repl_lag_records missing from the "
+                            "promoted router's federated /metrics")
+
+        # stale-epoch writes must still be fenced at the replicas
+        ready_predict = [r for r in snap.get("replicas", [])
+                         if r.get("ready") and r.get("mode") == "predict"]
+        if not ready_predict:
+            failures.append("no ready predict replica to fence-test")
+        else:
+            body = json.dumps({
+                "inputs": {"data": [[0.0] * 6]},
+                "fleet_epoch": old_epoch}).encode()
+            req = urllib.request.Request(
+                ready_predict[0]["url"].rstrip("/") + "/v1/predict",
+                data=body, headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10.0):
+                    code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+            if code != 409:
+                failures.append("replica accepted a stale-epoch write "
+                                "(HTTP %d, wanted 409)" % code)
+
+        if failures:
+            for f in failures:
+                print("fault_drill: FAIL — %s" % f)
+            return 1
+        result = {
+            "sessions_bitwise": bitwise,
+            "sessions_total": GEN_SESSIONS,
+            "predicts_completed": res_p.get("completed"),
+            "predicts_attempted": res_p.get("attempted"),
+            "epoch_old": old_epoch,
+            "epoch_new": new_epoch,
+            "acked_control_ops": len(acked_splits),
+            "acked_control_ops_lost": 0,
+            "repl_lag_metric_visible": True,
+            "stale_epoch_write_fenced": True,
+            "replay": snap.get("replay"),
+        }
+        print("fault_drill: [disk-loss] PASS " + json.dumps(result))
+        ok = True
+        return 0
+    finally:
+        if sup is not None:
+            sup.stop(wait_s=15.0)
+        for proc in (primary, standby):
+            if proc is None:
+                continue
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if args.keep or not ok:
+            print("fault_drill: scratch kept at %s" % work)
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("-n", "--num-workers", type=int, default=2)
@@ -637,6 +975,12 @@ def main(argv=None):
                     help="run the router-HA drill: SIGKILL the primary "
                          "router mid-load, the warm standby promotes "
                          "from the journal, sessions finish bitwise")
+    ap.add_argument("--disk-loss", action="store_true",
+                    help="run the primary-disk-death drill: SIGKILL the "
+                         "primary AND delete its journal dir mid-load; "
+                         "a --replicate-from standby promotes from its "
+                         "own replicated WAL, sessions finish bitwise, "
+                         "acked control ops survive")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch directory for forensics")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -647,6 +991,8 @@ def main(argv=None):
         return fleet_drill(args)
     if args.router_ha:
         return router_ha_drill(args)
+    if args.disk_loss:
+        return disk_loss_drill(args)
 
     work = tempfile.mkdtemp(prefix="mxtpu_fault_drill_")
     base_dump = os.path.join(work, "baseline.npz")

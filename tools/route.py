@@ -3,6 +3,8 @@
     python tools/route.py --port 8090 [--verbose]
     python tools/route.py --port 8090 --journal /var/lib/mxtpu/fleet
     python tools/route.py --standby --journal /var/lib/mxtpu/fleet
+    python tools/route.py --standby --journal /var/lib/mxtpu/replica \
+        --replicate-from http://primary:8090
 
 Replicas self-register: start each ``tools/serve.py`` with
 ``--register http://127.0.0.1:8090`` and it appears in the rotation as
@@ -24,6 +26,18 @@ primary is fenced out twice over: its startup lease guard refuses to
 run while a live holder exists (exit 2 unless ``--force-primary``),
 and replicas 409 any request it stamps with its old epoch.
 
+Shared storage is optional: ``--standby --replicate-from URL`` streams
+the primary's journal over its HTTP front end into the standby's own
+``--journal`` directory (snapshot bootstrap + offset-resumed segment
+fetches, CRC re-verified, epoch-fenced; mxnet_tpu/fleet/replicate.py)
+and promotes from that local replica when the primary's manifest goes
+stale — surviving the death of the primary's machine *and* disk. If
+the primary's own journal disk fails while it is serving, the router
+enters degraded mode instead of dying: control-plane mutations return
+503 + Retry-After, routed predict/generate traffic keeps flowing, and
+the lease loop's journal probe exits degraded mode automatically once
+the disk recovers — no restart.
+
 Endpoints (see mxnet_tpu/fleet/router.py):
     POST /v1/predict             least-loaded over ready replicas
     POST /v1/generate            session-affine, cursor-migrated hops
@@ -33,6 +47,9 @@ Endpoints (see mxnet_tpu/fleet/router.py):
     GET  /metrics                federated Prometheus exposition
                                  (?format=prometheus / Accept: text/plain)
                                  or the JSON fleet snapshot
+    GET  /journal/manifest|segment|snapshot    (replication-facing,
+                                 epoch-stamped; consumed by
+                                 --replicate-from standbys)
     GET  /healthz /readyz /livez
 
 The router never runs model code or touches a device — replicas own
@@ -97,8 +114,14 @@ def _lease_loop(router, jdir, interval_s, compact_every, stop_evt):
                                "url": router.address, "beat": beat})
         except OSError as e:
             print("route: lease write failed: %s" % e, file=sys.stderr)
+        # degraded-mode recovery: probe the journal each beat so a
+        # recovered disk exits degraded mode without a restart
+        if router.journal_degraded and router.check_journal():
+            print("route: journal recovered — leaving degraded mode",
+                  file=sys.stderr)
         jr = router.journal
         if (jr is not None and compact_every > 0
+                and not router.journal_degraded
                 and jr.records_since_compact >= compact_every):
             try:
                 jr.compact(router.export_state())
@@ -118,22 +141,46 @@ def _build_router(args, jdir):
 
 
 def _standby_wait(args, jdir, lease_timeout_s, poll_s, done):
-    """Tail the journal until the primary's lease goes stale, then
-    promote: full re-replay (the tailer is only a warm cache — the
-    replay is what fixes the true durable seq), epoch bump, rebind.
-    Returns (router, front) or (None, None) if interrupted."""
+    """Follow the primary until it goes stale, then promote: full
+    re-replay (the tailer/replicator is only a warm cache — the replay
+    is what fixes the true durable seq), epoch bump, rebind. With
+    ``--replicate-from`` the journal is streamed over HTTP into the
+    local ``jdir`` and staleness is the replicated manifest's content
+    (no shared lease file); otherwise the shared-directory tailer +
+    lease monitor. Returns (router, front) or (None, None) if
+    interrupted."""
     from mxnet_tpu.fleet import route_http
     from mxnet_tpu.fleet.journal import JournalTailer, LeaseMonitor
-    tailer = JournalTailer(jdir)
-    monitor = LeaseMonitor(jdir)
-    print(json.dumps({"standby": True, "journal": jdir,
-                      "lease_timeout_s": lease_timeout_s}), flush=True)
+    repl = tailer = monitor = None
+    banner = {"standby": True, "journal": jdir,
+              "lease_timeout_s": lease_timeout_s}
+    if getattr(args, "replicate_from", None):
+        from mxnet_tpu.fleet import JournalReplicator
+        repl = JournalReplicator(args.replicate_from, jdir,
+                                 poll_s=poll_s)
+        banner["replicate_from"] = repl.source_url
+    else:
+        tailer = JournalTailer(jdir, idle_cap_s=poll_s)
+        monitor = LeaseMonitor(jdir)
+    print(json.dumps(banner), flush=True)
     while not done.is_set():
-        tailer.poll()
-        if monitor.expired(lease_timeout_s):
+        if repl is not None:
+            repl.poll()
+            state = repl.state
+            stale = repl.expired(lease_timeout_s)
+            # backoff while the source is down, burst while catching
+            # up, the poll interval when idle (satellite: same shape
+            # as the tailer's capped idle backoff)
+            wait_s = max(0.01, repl.next_delay_s())
+        else:
+            tailer.poll()
+            state = tailer.state
+            stale = monitor.expired(lease_timeout_s)
+            wait_s = max(0.01, tailer.next_delay_s())
+        if stale:
             # where to take over: the address the dead primary
             # journaled (replicas + clients point there); CLI fallback
-            addr = tailer.state.address
+            addr = state.address
             if addr:
                 u = urllib.parse.urlsplit(addr)
                 host, port = u.hostname or args.host, u.port or args.port
@@ -161,12 +208,14 @@ def _standby_wait(args, jdir, lease_timeout_s, poll_s, done):
                 done.wait(poll_s)
                 continue
             router.announce(front.address)
-            print(json.dumps({"promoted": True, "epoch": router.epoch,
-                              "url": front.address,
-                              "replay": router.replay_stats}),
-                  flush=True)
+            info = {"promoted": True, "epoch": router.epoch,
+                    "url": front.address,
+                    "replay": router.replay_stats}
+            if repl is not None:
+                info["replication"] = repl.stats()
+            print(json.dumps(info), flush=True)
             return router, front
-        done.wait(poll_s)
+        done.wait(wait_s)
     return None, None
 
 
@@ -193,6 +242,12 @@ def main():
     p.add_argument("--standby", action="store_true",
                    help="warm standby: tail --journal and promote when "
                         "the primary's lease expires")
+    p.add_argument("--replicate-from", default=None, metavar="URL",
+                   help="with --standby: stream the primary's journal "
+                        "over its HTTP front end into the local "
+                        "--journal DIR instead of tailing a shared "
+                        "directory (promotes from the local replica "
+                        "even if the primary's disk dies with it)")
     p.add_argument("--lease-interval-s", type=float, default=None,
                    help="primary lease refresh period "
                         "(default MXNET_FLEET_LEASE_INTERVAL_S)")
@@ -214,6 +269,8 @@ def main():
     jdir = args.journal
     if args.standby and jdir is None:
         p.error("--standby requires --journal DIR")
+    if args.replicate_from and not args.standby:
+        p.error("--replicate-from requires --standby")
     lease_interval_s = (args.lease_interval_s
                         if args.lease_interval_s is not None
                         else flags.fleet_lease_interval_s)
