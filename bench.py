@@ -742,6 +742,54 @@ def _make_rec(n_images, side, path="/tmp/mxtpu_bench_%d_%d.rec"):
     return path
 
 
+def _data_leg(ctx, batch, n_images=512, side=144, shards=4):
+    """Streaming data tier throughput (docs/data.md): decode+augment
+    delivery rate of StreamingDataIter over a make_recordio-packed
+    synthetic shard set. Host-side only — batches are consumed, never
+    shipped to the device — so the number is pipeline rate, not link
+    rate."""
+    import numpy as np
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        from make_recordio import iter_synth_images, shard_paths, \
+            write_shards
+    finally:
+        sys.path.pop(0)
+    from mxnet_tpu.data import (ImageDecoder, ShardedRecordStream,
+                                StreamingDataIter)
+    prefix = "/tmp/mxtpu_bench_data/synth_%d_%d" % (n_images, side)
+    recs = shard_paths(prefix, shards)
+    if not all(os.path.exists(r) for r in recs):
+        recs = write_shards(
+            iter_synth_images(n_images, side=side), prefix, shards)
+    stream = ShardedRecordStream(recs, shuffle=True, seed=0)
+    it = StreamingDataIter(
+        stream, ImageDecoder((3, 128, 128), rand_crop=True,
+                             rand_mirror=True),
+        batch_size=batch, ctx=ctx)
+    try:
+        # warm epoch: thread spin-up + page cache, then the timed one
+        for _ in it:
+            pass
+        it.reset()
+        n = 0
+        t0 = time.perf_counter()
+        for b in it:
+            n += b.data[0].shape[0]
+        dt = time.perf_counter() - t0
+        depth = it.queue_depth() if hasattr(it, "queue_depth") else None
+        return {
+            "examples_per_s": round(n / dt, 1),
+            "records": stream.records_per_epoch(),
+            "shards": len(recs),
+            "decode_threads": it._nthreads,
+            "queue_depth": depth,
+        }
+    finally:
+        it.close()
+
+
 class _OneBatchIter:
     """Reference --benchmark 1 semantics: one device-resident batch,
     repeated; zero input-pipeline cost so the step program is what's
@@ -969,6 +1017,29 @@ def main():
     except Exception as e:
         kernel_tier_report = "failed: %s" % e
 
+    # ---- streaming data tier (BENCH_DATA=0 skips): decode+augment
+    # delivery rate of the sharded streaming pipeline (mxnet_tpu/data/,
+    # docs/data.md) over a make_recordio-packed synthetic set, plus the
+    # headline fit's input-stall telemetry. Host-side only — no extra
+    # device traffic — so it runs on CPU rounds too.
+    data_pipeline = None
+    if os.environ.get("BENCH_DATA", "1") == "1":
+        try:
+            data_pipeline = _data_leg(ctx, batch)
+        except Exception as e:
+            data_pipeline = "failed: %s" % e
+    # input-stall attribution of the benched fit (published by fit's
+    # window telemetry from host-held timers — docs/observability.md)
+    input_stall_ms = stall_frac = None
+    try:
+        from mxnet_tpu.telemetry import registry as _treg
+        g = _treg.default_registry().get("data/input_stall_ms")
+        input_stall_ms = g.value() if g is not None else None
+        g = _treg.default_registry().get("data/stall_frac")
+        stall_frac = g.value() if g is not None else None
+    except Exception:
+        pass
+
     # ---- real-data variant (OPT-IN: BENCH_RECORDIO=1): threaded RecordIO
     # pipeline feeding the same fused module (decode+augment+H2D overlapped
     # with training). Reported as extra fields: recordio_img_s and
@@ -1055,6 +1126,12 @@ def main():
         out["recordio_img_s"] = round(recordio_img_s, 2)
         out["recordio_input_only_img_s"] = round(input_only_img_s, 2)
         out["recordio_overlap"] = round(recordio_overlap, 3)
+    if data_pipeline is not None:
+        out["data_pipeline"] = data_pipeline
+    if input_stall_ms is not None:
+        out["input_stall_ms"] = round(float(input_stall_ms), 3)
+    if stall_frac is not None:
+        out["stall_frac"] = round(float(stall_frac), 4)
     # the other two BASELINE.json metrics (kvstore push/pull µs, Gluon
     # LSTM tokens/sec) ride along as extra fields; BENCH_EXTRA=0 skips
     if os.environ.get("BENCH_EXTRA", "1") == "1":

@@ -29,6 +29,12 @@ Per ``--window`` steps the trainer publishes host-held telemetry
 the trained towers as a format_version-6 ``.mxtpu`` recommend artifact
 (serve it: ``python -m mxnet_tpu.tools.serve --artifact out.mxtpu``,
 then ``POST /v1/recommend``).
+
+``--recordio PREFIX`` swaps the in-process generator for a streamed
+feed: the interactions come from a ``tools/make_recordio.py twotower``
+shard set via :class:`mxnet_tpu.data.ShardedRecordStream`
+(docs/data.md) into the same up-front arrays, so all three paths stay
+bitwise-comparable over streamed data too.
 """
 import argparse
 import os
@@ -65,6 +71,12 @@ def main():
     p.add_argument("--out", default=None,
                    help="write the trained towers as a recommend "
                         ".mxtpu artifact")
+    p.add_argument("--recordio", default=None, metavar="PREFIX",
+                   help="stream the (user, item, rating) interactions "
+                        "from a tools/make_recordio.py twotower shard "
+                        "set (PREFIX-00000.rec ...) instead of "
+                        "generating them in-process; --users/--items "
+                        "must cover the packed id range")
     p.add_argument("--devices", type=int, default=8)
     p.add_argument("--device", default=None)
     args = p.parse_args()
@@ -90,15 +102,54 @@ def main():
 
     U, I, D, B = args.users, args.items, args.dim, args.batch_size
     rng = np.random.RandomState(0)
-    # learnable signal: ratings from a hidden low-rank model
-    gt_u = rng.randn(U, 8).astype("f4") / np.sqrt(8)
-    gt_i = rng.randn(I, 8).astype("f4") / np.sqrt(8)
-    u_ids = zipf_ids(rng, args.steps * B, U, args.zipf).reshape(
-        args.steps, B)
-    i_ids = zipf_ids(rng, args.steps * B, I, args.zipf).reshape(
-        args.steps, B)
-    ratings = ((gt_u[u_ids] * gt_i[i_ids]).sum(-1)
-               + 0.01 * rng.randn(args.steps, B)).astype("f4")
+    if args.recordio:
+        # streaming feed (docs/data.md): fill the SAME up-front
+        # (steps, B) arrays all three paths consume from a
+        # make_recordio twotower shard set, so the cross-path bitwise
+        # checks hold unchanged for streamed interactions.
+        import glob
+
+        from mxnet_tpu import recordio as rio
+        from mxnet_tpu.data import ShardedRecordStream
+        recs = sorted(glob.glob(args.recordio + "-*.rec"))
+        if not recs:
+            raise SystemExit("no shards match %s-*.rec — pack one with "
+                             "tools/make_recordio.py twotower"
+                             % args.recordio)
+        stream = ShardedRecordStream(recs, shuffle=True, seed=0)
+        need = args.steps * B
+        triples = np.empty((need, 3), dtype="f4")
+        got = 0
+        while got < need:
+            before = got
+            for rec in stream:
+                _, payload = rio.unpack(rec)
+                triples[got] = np.frombuffer(payload, dtype="<f4", count=3)
+                got += 1
+                if got == need:
+                    break
+            if got == before:
+                raise SystemExit("empty recordio set: %r" % recs)
+            if got < need:
+                stream.next_epoch()   # set smaller than steps*B: reuse
+        u_ids = triples[:, 0].astype("int64").reshape(args.steps, B)
+        i_ids = triples[:, 1].astype("int64").reshape(args.steps, B)
+        if u_ids.max() >= U or i_ids.max() >= I:
+            raise SystemExit(
+                "packed ids exceed --users/--items (%d/%d): pass at "
+                "least --users %d --items %d"
+                % (U, I, int(u_ids.max()) + 1, int(i_ids.max()) + 1))
+        ratings = triples[:, 2].reshape(args.steps, B).copy()
+    else:
+        # learnable signal: ratings from a hidden low-rank model
+        gt_u = rng.randn(U, 8).astype("f4") / np.sqrt(8)
+        gt_i = rng.randn(I, 8).astype("f4") / np.sqrt(8)
+        u_ids = zipf_ids(rng, args.steps * B, U, args.zipf).reshape(
+            args.steps, B)
+        i_ids = zipf_ids(rng, args.steps * B, I, args.zipf).reshape(
+            args.steps, B)
+        ratings = ((gt_u[u_ids] * gt_i[i_ids]).sum(-1)
+                   + 0.01 * rng.randn(args.steps, B)).astype("f4")
     lr = np.float32(args.lr)
 
     # -- path 1/2: dense or mesh-sharded tables ----------------------------

@@ -423,6 +423,32 @@ def restore_module(module, state):
         _set_rng_blob(state["__rng__"])
 
 
+DATA_CURSOR_KEY = "__data_cursor__"
+
+
+def encode_cursor(cursor):
+    """Pack a data-iterator cursor dict (``StreamingDataIter.get_cursor``)
+    as canonical-JSON bytes for the module-state dict (rides the npz
+    ``__bytes_keys__`` path). None -> None (no cursor captured yet)."""
+    if cursor is None:
+        return None
+    return json.dumps(cursor, sort_keys=True).encode("utf-8")
+
+
+def cursor_from_state(state):
+    """Decode the data cursor a ``module_state`` snapshot carried, or
+    None (snapshot predates the streaming tier / iterator had no cursor).
+    ``restore_module`` ignores the key, so old restore paths are
+    unaffected."""
+    blob = state.get(DATA_CURSOR_KEY)
+    if blob is None:
+        return None
+    try:
+        return json.loads(bytes(blob).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
 def trainer_state(trainer):
     """Capture a gluon ``Trainer``'s full training state.
 

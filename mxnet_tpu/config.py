@@ -526,6 +526,24 @@ register_flag("kernel_cost_model", "MXNET_KERNEL_COST_MODEL", str, "",
               "set and valid, tune.cost_model.default_model() ranks "
               "with these weights instead of the shipped hand-rounded "
               "ones. Empty (default): shipped weights.")
+register_flag("data_staged_feed", "MXNET_DATA_STAGED_FEED", _parse_bool,
+              True,
+              "Let Module.fit stage each K-step window's stacked device "
+              "feed on a feeder thread (mxnet_tpu/data/feed.py), "
+              "double-buffered so the async H2D overlaps the in-flight "
+              "dispatch. Only data is staged — PRNG keys and optimizer "
+              "hypers stay on the main thread so bitwise kill/resume "
+              "holds. Off: the dispatch call builds its own stacked feed "
+              "(the pre-staging behaviour).")
+register_flag("data_feed_depth", "MXNET_DATA_FEED_DEPTH", int, 2,
+              "Staged windows in flight for the K-step device feed "
+              "(2 = classic double buffering). Each staged window holds "
+              "K stacked batches of device memory, so keep this small.")
+register_flag("data_decode_threads", "MXNET_DATA_DECODE_THREADS", int, 0,
+              "Decode/augment worker threads for StreamingDataIter "
+              "(mxnet_tpu/data/record_stream.py). 0 (default): fall back "
+              "to cpu_worker_nthreads, the same pool width "
+              "ImageRecordIter uses.")
 register_flag("test_device", "MXNET_TEST_DEVICE", str, "cpu",
               "Device type test_utils.default_context() returns (cpu|tpu) "
               "— the reference's env-switchable default_context (:53).")

@@ -1,0 +1,32 @@
+"""Streaming ingestion tier: sharded record streams feeding the K-step
+dispatch (docs/data.md).
+
+The pieces, bottom-up:
+
+* ``pipeline`` — the ONE bounded-queue backpressure/shutdown primitive
+  every prefetching producer in the repo shares
+  (:class:`PrefetchQueue`; also used by ``io.PrefetchingIter`` and
+  ``io.ImageRecordIter``).
+* ``record_stream`` — :class:`ShardedRecordStream` partitions a RecordIO
+  file set across dp ranks (every record exactly once per epoch per
+  fleet) with a resumable ``(epoch, shard, offset)`` cursor, and
+  :class:`StreamingDataIter` turns it into a ``DataIter`` with parallel
+  decode/augment and a bitwise kill/resume cursor that rides
+  ``CheckpointManager``.
+* ``feed`` — :class:`StagedKFeed`, the zero-stall K-step device feed:
+  double-buffers the next window's K batches into the stacked
+  device-resident layout ``FusedStep.run_k`` scans over, with the async
+  H2D overlapped against the in-flight dispatch.
+"""
+from __future__ import annotations
+
+from mxnet_tpu.data.pipeline import PrefetchQueue
+from mxnet_tpu.data.record_stream import (
+    ImageDecoder, RawTensorDecoder, ShardedRecordStream, StreamingDataIter,
+)
+from mxnet_tpu.data.feed import StagedKFeed, StagedWindow
+
+__all__ = [
+    "PrefetchQueue", "ShardedRecordStream", "StreamingDataIter",
+    "RawTensorDecoder", "ImageDecoder", "StagedKFeed", "StagedWindow",
+]

@@ -1,0 +1,117 @@
+"""The bounded-queue backpressure/shutdown primitive shared by every
+prefetching producer.
+
+``PrefetchingIter`` (io/io.py), ``ImageRecordIter``
+(io/image_record_iter.py), and the streaming tier's feeders all have the
+same shape: a producer thread pushes finished items into a bounded queue
+(the pipeline's backpressure), the consumer pops, and a reset/close must
+never deadlock against a producer blocked on a full queue. Before this
+module each iterator carried its own copy of that machinery; the copies
+had drifted (different drain loops, different sentinel delivery). One
+implementation, one contract:
+
+* ``put`` is bounded and keeps observing the stop flag — a plain
+  ``Queue.put`` can block forever on a full queue the consumer abandoned.
+* The ``None`` sentinel must ALWAYS arrive (unless stopped) — a dead
+  producer surfaces as ``StopIteration``/an error in the consumer, never
+  as a hang on ``get()``.
+* An ``Exception`` pushed through the queue propagates to the consumer's
+  ``get`` (async errors cross the thread boundary).
+* ``shutdown`` signals stop FIRST, then drains while joining, so a
+  producer blocked mid-``put`` can finish and observe the flag — the
+  mid-epoch-close race pinned by tests/test_data_stream.py.
+
+A queue instance belongs to ONE producer generation: reset creates a
+fresh ``PrefetchQueue`` after shutting the old one down, so a zombie
+producer can never feed stale items into the new generation's queue.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+__all__ = ["PrefetchQueue"]
+
+_PUT_POLL_S = 0.1
+
+
+class PrefetchQueue:
+    """Bounded producer/consumer queue with the shared shutdown protocol."""
+
+    def __init__(self, depth):
+        self._q = _queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- producer
+    def put(self, item):
+        """Bounded put that keeps observing the stop flag. Returns False
+        (item dropped) when the queue was stopped before the put landed —
+        the producer should exit."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=_PUT_POLL_S)
+                return True
+            except _queue.Full:
+                continue  # consumer will pop, or shutdown() will stop us
+        return False
+
+    def put_sentinel(self):
+        """Deliver the end-of-stream ``None`` sentinel (same bounded put —
+        a stopped queue has no consumer left to wake)."""
+        return self.put(None)
+
+    # ------------------------------------------------------------- consumer
+    def get(self, block=True, timeout=None):
+        """Pop one item. Raises ``StopIteration`` on the sentinel and
+        re-raises an exception the producer pushed."""
+        item = self._q.get(block=block, timeout=timeout)
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def get_raw(self):
+        """Blocking pop with NO sentinel/exception interpretation, for
+        consumers that need the reference iterator's own error surface
+        (ImageRecordIter wraps pipeline errors in MXNetError)."""
+        return self._q.get()
+
+    def qsize(self):
+        return self._q.qsize()
+
+    # ------------------------------------------------------------- shutdown
+    @property
+    def stopped(self):
+        return self._stop.is_set()
+
+    def stop(self):
+        self._stop.set()
+
+    def wait_stop(self, timeout):
+        """Producer-side backpressure sleep that wakes early on stop."""
+        return self._stop.wait(timeout)
+
+    def drain(self):
+        """Empty the queue without blocking (unblocks a producer stuck in
+        ``put``; its NEXT put observes the stop flag and returns False)."""
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+
+    def shutdown(self, thread, timeout=5.0):
+        """Signal stop, then drain-while-joining ``thread`` until it dies
+        or ``timeout`` elapses. Order matters: signal FIRST, so a producer
+        blocked on a full queue can finish its put and observe the flag.
+        Returns True when the thread is dead (or was never started)."""
+        self._stop.set()
+        if thread is None or not thread.is_alive():
+            return True
+        deadline = time.time() + timeout
+        while thread.is_alive() and time.time() < deadline:
+            self.drain()
+            thread.join(timeout=0.05)
+        return not thread.is_alive()

@@ -26,7 +26,6 @@ Without the native library the same graph runs on a ThreadPoolExecutor.
 from __future__ import annotations
 
 import os
-import queue as _queue
 import struct
 import threading
 
@@ -285,7 +284,6 @@ class ImageRecordIter:
         self._queue = None
         self._feeder = None
         self._err = None
-        self._stop = threading.Event()
         self._scheduled = 0          # commits pushed, _stage not finished
         self._sched_lock = threading.Lock()
         self.reset()
@@ -350,12 +348,9 @@ class ImageRecordIter:
                 self._arena.note_transfer(slot, d._data)
             l = _nd.array(label, ctx=self._ctx)
             batch = DataBatch(data=[d], label=[l], pad=0)
-            while not self._stop.is_set():
-                try:
-                    self._queue.put(batch, timeout=0.1)
-                    return
-                except _queue.Full:
-                    continue  # consumer will pop, or reset() will stop us
+            # bounded put observing stop: consumer will pop, or reset()'s
+            # shutdown will stop us (PrefetchQueue contract)
+            self._queue.put(batch)
         except BaseException as e:
             self._record_err(e)
         finally:
@@ -371,12 +366,7 @@ class ImageRecordIter:
             self._record_err(e)
         # the sentinel must ALWAYS arrive — a dead producer must surface as
         # an error in next(), never as a hang on queue.get()
-        while not self._stop.is_set():
-            try:
-                self._queue.put(None, timeout=0.1)
-                return
-            except _queue.Full:
-                continue
+        self._queue.put_sentinel()
 
     def _feed_epoch_inner(self):
         order = self._epoch_order()
@@ -385,7 +375,7 @@ class ImageRecordIter:
         P = self._nthreads
         shape = (self.label_width,) if self.label_width > 1 else ()
         for b in range(nbatch):
-            if self._stop.is_set() or self._err is not None:
+            if self._queue.stopped or self._err is not None:
                 return
             idxs = order[b * B:(b + 1) * B]
             data = self._arena.next() if self._arena is not None \
@@ -422,8 +412,8 @@ class ImageRecordIter:
                 # staged, let alone transferred.
                 while (self._queue.qsize() + self._scheduled
                        >= self._depth + 2
-                       and not self._stop.is_set()):
-                    self._stop.wait(0.002)
+                       and not self._queue.stopped):
+                    self._queue.wait_stop(0.002)
             else:
                 futs = [self._pool.submit(self._decode_part, idxs[lo:hi],
                                           data, label, lo, rngs[p])
@@ -453,9 +443,11 @@ class ImageRecordIter:
     def reset(self):
         self._drain()
         # bounded: its put() is the pipeline's backpressure (device
-        # prefetch depth — reference prefetch_buffer)
-        self._queue = _queue.Queue(maxsize=self._depth)
-        self._stop.clear()
+        # prefetch depth — reference prefetch_buffer). A fresh queue per
+        # feeder generation: a zombie producer from the previous epoch
+        # holds the OLD (stopped) queue and can never feed this one.
+        from ..data.pipeline import PrefetchQueue
+        self._queue = PrefetchQueue(self._depth)
         self._done = False
         self._err = None
         self._scheduled = 0   # drained: no commit can be outstanding
@@ -463,21 +455,19 @@ class ImageRecordIter:
         self._feeder.start()
 
     def _drain(self):
-        if self._feeder is not None and self._feeder.is_alive():
-            self._stop.set()
-            while True:  # unblock the producer, then join
-                try:
-                    self._queue.get_nowait()
-                except _queue.Empty:
-                    break
-            self._feeder.join(timeout=30)
+        if self._queue is not None:
+            # stop first, then drain-while-joining: a producer blocked on
+            # a full queue finishes its put and observes the flag
+            self._queue.shutdown(self._feeder, timeout=30.0)
         if self._engine is not None:
             self._engine.wait_all()
 
     def next(self):
         if self._done:
             raise StopIteration
-        batch = self._queue.get()
+        # raw pop: this iterator interprets the sentinel itself so errors
+        # surface wrapped in MXNetError (the reference's surface)
+        batch = self._queue.get_raw()
         if batch is None:
             self._done = True  # stay exhausted until reset()
             if self._err is not None:
@@ -493,6 +483,11 @@ class ImageRecordIter:
 
     def __iter__(self):
         return self
+
+    def queue_depth(self):
+        """Prefetch-queue occupancy (host metadata; feeds the
+        ``data/queue_depth`` telemetry gauge)."""
+        return self._queue.qsize() if self._queue is not None else 0
 
     def close(self):
         self._drain()

@@ -11,8 +11,6 @@ from __future__ import annotations
 
 import collections
 import threading
-import time
-import queue as _queue
 
 import numpy as _np
 
@@ -246,36 +244,27 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def _start(self):
-        # the worker closes over THIS generation's queue/stop-event rather
+        # the worker closes over THIS generation's PrefetchQueue rather
         # than reading self attributes: a reset() that swapped self._queue
         # while a previous worker was alive would otherwise let the zombie
-        # feed stale batches into the NEW queue (reset race)
-        q = self._queue = _queue.Queue(maxsize=self._depth)
-        stop = self._stop_event = threading.Event()
-
-        def put(item):
-            # bounded put that keeps observing the stop flag — a plain
-            # q.put() can block forever on a full queue the consumer
-            # abandoned at reset()
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except _queue.Full:
-                    continue
-            return False
+        # feed stale batches into the NEW queue (reset race). The bounded
+        # put / sentinel / shutdown contract lives in
+        # mxnet_tpu.data.pipeline (shared with ImageRecordIter and the
+        # streaming tier's feeders).
+        from ..data.pipeline import PrefetchQueue
+        pq = self._queue = PrefetchQueue(self._depth)
 
         def worker():
-            while not stop.is_set():
+            while not pq.stopped:
                 try:
                     batches = [i.next() for i in self.iters]
                 except StopIteration:
-                    put(None)
+                    pq.put_sentinel()
                     return
                 except Exception as e:  # propagate async errors to consumer
-                    put(e)
+                    pq.put(e)
                     return
-                if not put(batches):
+                if not pq.put(batches):
                     return
 
         self._thread = threading.Thread(target=worker, daemon=True)
@@ -300,30 +289,16 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        # order matters: signal FIRST, then drain-while-joining so a worker
-        # blocked on a full queue can finish its put and observe the stop
-        # flag, and only reset the inner iterators once the worker is dead
-        # (it may be mid-`i.next()` on them)
-        self._stop_event.set()
-        deadline = time.time() + 5
-        while self._thread.is_alive() and time.time() < deadline:
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except _queue.Empty:
-                pass
-            self._thread.join(timeout=0.05)
+        # only reset the inner iterators once the worker is dead (it may
+        # be mid-`i.next()` on them) — PrefetchQueue.shutdown signals
+        # stop first, then drains while joining
+        self._queue.shutdown(self._thread, timeout=5.0)
         for i in self.iters:
             i.reset()
         self._start()
 
     def next(self):
-        item = self._queue.get()
-        if item is None:
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
-        batches = item
+        batches = self._queue.get()
         if len(batches) == 1:
             return batches[0]
         return DataBatch(
@@ -337,6 +312,23 @@ class PrefetchingIter(DataIter):
             return True
         except StopIteration:
             return False
+
+    def queue_depth(self):
+        """Prefetch-queue occupancy (host metadata; feeds the
+        ``data/queue_depth`` telemetry gauge)."""
+        return self._queue.qsize()
+
+    def close(self):
+        """Stop the worker and release the queue (terminal — use
+        ``reset()`` to restart iteration)."""
+        if self._queue is not None:
+            self._queue.shutdown(self._thread, timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class LibSVMIter(DataIter):

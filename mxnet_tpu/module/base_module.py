@@ -215,6 +215,7 @@ class BaseModule:
             else _ckpt.CheckpointManager.from_env()
         global_step = 0
         resume_epoch, resume_nbatch = begin_epoch, 0
+        resume_cursor = None
         if ckpt is not None and _ckpt.CheckpointManager.should_resume():
             state, manifest = ckpt.restore_latest()
             mine = manifest["step"] if manifest is not None else -1
@@ -226,6 +227,7 @@ class BaseModule:
                 # mix different weight histories
                 state, manifest = ckpt.restore(step=common)
             if common >= 0 and state is not None:
+                resume_cursor = _ckpt.cursor_from_state(state)
                 _ckpt.restore_module(self, state)
                 global_step = manifest["step"]
                 resume_epoch = manifest["epoch"]
@@ -290,28 +292,78 @@ class BaseModule:
         _telem_every = max(1, int(_flags.steps_per_dispatch))
         _telem_acc = [0, 0]          # per-step path: (steps, examples)
 
+        # 5. streaming-tier window stats (docs/data.md): input stall (time
+        #    the loop blocked on the iterator / staged feed), H2D bytes
+        #    and feed-queue depth — all host-held values, zero extra
+        #    device->host syncs (tests/test_step_sync_budget.py)
+        _data_acc = [0.0, 0]         # (input_stall_ms, h2d_bytes)
+        _queue_depth = [getattr(train_data, "queue_depth", None)]
+        has_cursor = hasattr(train_data, "get_cursor") \
+            and hasattr(train_data, "seek")
+        data_cursor = [None]         # last CONSUMED batch's cursor
+
+        def _timed_next(it):
+            # blocking time on the iterator IS the loop's input stall
+            t0 = time.monotonic()
+            try:
+                return next(it)
+            finally:
+                _data_acc[0] += (time.monotonic() - t0) * 1000.0
+
         def _batch_examples(b):
             try:
                 return int(b.data[0].shape[0])   # host metadata, no sync
             except Exception:
                 return 0
 
+        def _batch_h2d_bytes(b):
+            # host-side metadata only (shape x itemsize); never touches
+            # device buffers
+            try:
+                n = 0
+                for arrs in (b.data, b.label or []):
+                    for a in arrs:
+                        k = 1
+                        for d in getattr(a, "shape", ()):
+                            k *= int(d)
+                        n += k * (getattr(getattr(a, "dtype", None),
+                                          "itemsize", 4) or 4)
+                return n
+            except Exception:
+                return 0
+
         def _telem_window(n_steps, examples, gstep):
             nonlocal _telem_t0
             now = time.monotonic()
+            data = {"input_stall_ms": _data_acc[0],
+                    "h2d_bytes": _data_acc[1]}
+            qd_fn = _queue_depth[0]
+            if qd_fn is not None:
+                try:
+                    data["queue_depth"] = qd_fn()
+                except Exception:
+                    pass
+            _data_acc[0], _data_acc[1] = 0.0, 0
             _telemetry.publish_window(
                 steps=n_steps, window_s=now - _telem_t0,
                 examples=examples or None,
                 engine_depth=len(depth_ctl._inflight),
                 global_step=gstep,
-                ddp=self._ddp_stats(n_steps))
+                ddp=self._ddp_stats(n_steps),
+                data=data)
             _telem_t0 = now
 
         def _snap_state():
             # quiesce first: a snapshot must capture a settled trajectory,
             # not buffers a still-running dispatch is about to donate away
             depth_ctl.quiesce()
-            return _ckpt.module_state(self)
+            state = _ckpt.module_state(self)
+            if data_cursor[0] is not None:
+                # the iterator's consumed-position cursor rides the
+                # snapshot so resume can seek instead of replaying batches
+                state[_ckpt.DATA_CURSOR_KEY] = \
+                    _ckpt.encode_cursor(data_cursor[0])
+            return state
 
         for epoch in range(max(begin_epoch, resume_epoch), num_epoch):
             tic = time.time()
@@ -320,64 +372,128 @@ class BaseModule:
             nbatch = 0
             data_iter = iter(train_data)
             if ckpt is not None and epoch == resume_epoch and resume_nbatch:
-                # re-align the (deterministic, unshuffled-or-reseeded)
-                # iterator with the checkpointed loop position: the first
-                # resume_nbatch batches were consumed before the snapshot
-                for _ in range(resume_nbatch):
-                    try:
-                        next(data_iter)
-                    except StopIteration:
-                        break
+                if resume_cursor is not None and has_cursor:
+                    # cursor seek: O(1) re-position to the exact
+                    # (epoch, shard, offset) the snapshot had consumed,
+                    # instead of the O(nbatch) batch-skip replay below
+                    train_data.seek(resume_cursor)
+                    data_iter = iter(train_data)
+                    data_cursor[0] = dict(resume_cursor)
+                else:
+                    # re-align the (deterministic, unshuffled-or-reseeded)
+                    # iterator with the checkpointed loop position: the
+                    # first resume_nbatch batches were consumed before the
+                    # snapshot
+                    for _ in range(resume_nbatch):
+                        try:
+                            next(data_iter)
+                        except StopIteration:
+                            break
                 nbatch = resume_nbatch
             if grouped:
                 # one dispatch per K batches; callbacks fire per batch
                 # (from THIS frame, so BatchEndParam.locals matches the
-                # per-step path) but only after the group's dispatch
-                group, end_of_batch = [], False
-                while not end_of_batch:
-                    try:
-                        group.append(next(data_iter))
-                    except StopIteration:
-                        end_of_batch = True
-                    if len(group) == steps_per_dispatch or \
-                            (end_of_batch and group):
-                        _fi.fire("step", step=global_step)
-                        if len(group) == steps_per_dispatch:
-                            self._fit_group(group, eval_metric)
-                            depth_ctl.admit(self._dispatch_handles())
+                # per-step path) but only after the group's dispatch.
+                # When the module exposes _stage_group, a StagedKFeed
+                # pre-builds each window's stacked device feed on a feeder
+                # thread (async H2D overlapped with the in-flight
+                # dispatch) — the zero-stall K-step feed, docs/data.md.
+                staged_feed = None
+                if _flags.data_staged_feed \
+                        and getattr(self, "_fused", None) is not None \
+                        and self.optimizer_initialized \
+                        and hasattr(self, "_stage_group"):
+                    from ..data.feed import StagedKFeed
+                    staged_feed = StagedKFeed(
+                        data_iter, steps_per_dispatch, self._stage_group,
+                        depth=max(2, int(_flags.data_feed_depth)),
+                        cursor_fn=(train_data.get_cursor if has_cursor
+                                   else None))
+                    _queue_depth[0] = staged_feed.queue_depth
+                try:
+                    group, end_of_batch = [], False
+                    staged, win_cursor = None, None
+                    while not end_of_batch:
+                        if staged_feed is not None:
+                            t0 = time.monotonic()
+                            try:
+                                win = staged_feed.next_window()
+                            except StopIteration:
+                                win = None
+                                end_of_batch = True
+                            _data_acc[0] += \
+                                (time.monotonic() - t0) * 1000.0
+                            if win is not None:
+                                group = list(win.batches)
+                                staged = win.staged
+                                win_cursor = win.cursor
+                                _data_acc[1] += win.h2d_bytes
+                                if len(group) < steps_per_dispatch:
+                                    end_of_batch = True  # tail window
                         else:
-                            # tail: per-step path — reuses/compiles the
-                            # single-step program instead of tracing a
-                            # second scan variant for the odd group size
-                            for b in group:
-                                self._fit_group([b], eval_metric)
+                            try:
+                                b = _timed_next(data_iter)
+                                group.append(b)
+                                _data_acc[1] += _batch_h2d_bytes(b)
+                            except StopIteration:
+                                end_of_batch = True
+                        if len(group) == steps_per_dispatch or \
+                                (end_of_batch and group):
+                            _fi.fire("step", step=global_step)
+                            if len(group) == steps_per_dispatch:
+                                if staged is not None:
+                                    self._fit_group(group, eval_metric,
+                                                    staged=staged)
+                                else:
+                                    self._fit_group(group, eval_metric)
                                 depth_ctl.admit(self._dispatch_handles())
-                        for data_batch in group:
-                            if batch_end_callback is not None:
-                                for cb in _as_list(batch_end_callback):
-                                    cb(BatchEndParam(
-                                        epoch=epoch, nbatch=nbatch,
-                                        eval_metric=eval_metric,
-                                        locals=locals()))
-                            nbatch += 1
-                        global_step += len(group)
-                        _telem_window(len(group),
-                                      sum(_batch_examples(b)
-                                          for b in group), global_step)
-                        if ckpt is not None:
-                            ckpt.maybe_save(_snap_state, global_step,
+                            else:
+                                # tail: per-step path — reuses/compiles
+                                # the single-step program instead of
+                                # tracing a second scan variant for the
+                                # odd group size
+                                for b in group:
+                                    self._fit_group([b], eval_metric)
+                                    depth_ctl.admit(
+                                        self._dispatch_handles())
+                            for data_batch in group:
+                                if batch_end_callback is not None:
+                                    for cb in _as_list(batch_end_callback):
+                                        cb(BatchEndParam(
                                             epoch=epoch, nbatch=nbatch,
-                                            meta=meta)
-                        group = []
+                                            eval_metric=eval_metric,
+                                            locals=locals()))
+                                nbatch += 1
+                            global_step += len(group)
+                            if win_cursor is not None:
+                                data_cursor[0] = win_cursor
+                            elif has_cursor and staged_feed is None:
+                                # fit is the only consumer here, so the
+                                # iterator cursor IS the consumed position
+                                data_cursor[0] = train_data.get_cursor()
+                            _telem_window(len(group),
+                                          sum(_batch_examples(b)
+                                              for b in group), global_step)
+                            if ckpt is not None:
+                                ckpt.maybe_save(_snap_state, global_step,
+                                                epoch=epoch, nbatch=nbatch,
+                                                meta=meta)
+                            group, staged, win_cursor = [], None, None
+                finally:
+                    if staged_feed is not None:
+                        staged_feed.close()
+                        _queue_depth[0] = getattr(train_data,
+                                                  "queue_depth", None)
             else:
                 end_of_batch = False
                 try:
-                    next_data_batch = next(data_iter)
+                    next_data_batch = _timed_next(data_iter)
                 except StopIteration:
                     # resume landed exactly on this epoch's end
                     end_of_batch = True
                 while not end_of_batch:
                     data_batch = next_data_batch
+                    _data_acc[1] += _batch_h2d_bytes(data_batch)
                     if monitor is not None:
                         monitor.tic()
                     # global_step steps have completed (and, on the save
@@ -392,8 +508,13 @@ class BaseModule:
                     # executor has no outputs yet
                     if eval_metric is not None:
                         self.update_metric(eval_metric, data_batch.label)
+                    if has_cursor:
+                        # capture BEFORE prefetching the next batch: the
+                        # cursor must reflect batches CONSUMED, not the
+                        # loop's read-ahead
+                        data_cursor[0] = train_data.get_cursor()
                     try:
-                        next_data_batch = next(data_iter)
+                        next_data_batch = _timed_next(data_iter)
                         self.prepare(next_data_batch,
                                      sparse_row_id_fn=sparse_row_id_fn)
                     except StopIteration:

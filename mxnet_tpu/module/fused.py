@@ -460,26 +460,14 @@ class FusedStep:
         new_args.update(new_params)
         return outs, new_args, new_aux, new_opt, new_met
 
-    def run_k(self, arg_vals, aux_vals, opt_state, feeds, keys,
-              met_state=None):
-        """K fused steps in ONE XLA program (`lax.scan` over stacked
-        batches) — see ``k_step`` in :meth:`_build`.
-
-        ``feeds`` is a list of K ``{input_name: jax value}`` dicts (the
-        per-step data/label feeds); ``keys`` a list of K PRNG keys. The
-        param/aux/opt-state (and metric-carry) buffers are DONATED; the
-        caller must commit the returned values immediately. Returns
-        ``(outs, new_params, new_aux, new_opt, new_met)`` where each
-        element of ``outs`` is stacked ``(K, ...)`` so callers can still
-        update metrics per sub-batch.
-
-        lr/wd are evaluated once per dispatch (a schedule moves in steps of
-        K); the optimizer update count still advances per inner step.
-        """
-        lr_vec, wd_vec, rescale, t = self.hyper_peek()
-        params, rest = self.split_args(arg_vals)
-        feed_names = frozenset(feeds[0])
-        static_rest = {k: v for k, v in rest.items() if k not in feed_names}
+    def stack_feeds(self, feeds):
+        """Cast + stack K per-step ``{input_name: jax value}`` feeds into
+        the ``(K, ...)`` device layout ``k_step`` scans over. Factored out
+        of :meth:`run_k` so the staged device feed
+        (mxnet_tpu/data/feed.py) can commit the NEXT window's buffer while
+        the current dispatch is still in flight; both paths run exactly
+        these ops in this order, so staged and unstaged windows are
+        bitwise-identical."""
         ex = self._exec
         cdt = self._compute_dtype
         stacked = {}
@@ -496,6 +484,36 @@ class FusedStep:
                 spec = P(None, "dp") if name in ex._batch_args else P()
                 arr = jax.device_put(arr, NamedSharding(ex._mesh, spec))
             stacked[name] = arr
+        return stacked
+
+    def run_k(self, arg_vals, aux_vals, opt_state, feeds, keys,
+              met_state=None):
+        """K fused steps in ONE XLA program (`lax.scan` over stacked
+        batches) — see ``k_step`` in :meth:`_build`.
+
+        ``feeds`` is a list of K ``{input_name: jax value}`` dicts (the
+        per-step data/label feeds), or ONE already-stacked
+        ``{input_name: (K, ...) array}`` dict from :meth:`stack_feeds`
+        (the staged device feed pre-commits it so dispatch never waits on
+        the H2D); ``keys`` a list of K PRNG keys. The param/aux/opt-state
+        (and metric-carry) buffers are DONATED; the caller must commit
+        the returned values immediately. Returns
+        ``(outs, new_params, new_aux, new_opt, new_met)`` where each
+        element of ``outs`` is stacked ``(K, ...)`` so callers can still
+        update metrics per sub-batch.
+
+        lr/wd are evaluated once per dispatch (a schedule moves in steps of
+        K); the optimizer update count still advances per inner step.
+        """
+        lr_vec, wd_vec, rescale, t = self.hyper_peek()
+        params, rest = self.split_args(arg_vals)
+        if isinstance(feeds, dict):
+            stacked = feeds
+        else:
+            stacked = self.stack_feeds(feeds)
+        feed_names = frozenset(stacked)
+        static_rest = {k: v for k, v in rest.items() if k not in feed_names}
+        ex = self._exec
         if self._ddp_mesh is not None:
             from jax.sharding import PartitionSpec as P
             from ..parallel import ddp as _ddp

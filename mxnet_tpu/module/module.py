@@ -460,11 +460,13 @@ class Module(BaseModule):
         self._commit_fused(outs, new_args, new_aux, new_opt,
                            new_met=new_met)
 
-    def _fit_group(self, data_batches, eval_metric=None):
+    def _fit_group(self, data_batches, eval_metric=None, staged=None):
         """fit's grouped entry (``steps_per_dispatch``): run the batches
         through :meth:`_fit_step_k`, then update ``eval_metric`` once per
         sub-batch from the stacked per-step outputs — metric semantics
-        identical to the per-step loop."""
+        identical to the per-step loop. ``staged`` is an optional
+        pre-built device feed from :meth:`_stage_group` (the zero-stall
+        staged K-step feed, mxnet_tpu/data/feed.py)."""
         if self._fused is None or not self.optimizer_initialized \
                 or len(data_batches) == 1:
             if len(data_batches) > 1 and \
@@ -480,7 +482,7 @@ class Module(BaseModule):
                     self.update_metric(eval_metric, b.label)
             return
         from ..ndarray.ndarray import NDArray
-        outs = self._fit_step_k(data_batches)
+        outs = self._fit_step_k(data_batches, staged=staged)
         if getattr(eval_metric, "_device_resident", False):
             return  # accumulated inside the scan body; nothing to replay
         if eval_metric is not None:
@@ -491,7 +493,37 @@ class Module(BaseModule):
                 self.update_metric(eval_metric, b.label)
             ex.outputs = last
 
-    def _fit_step_k(self, data_batches):
+    def _stage_group(self, data_batches):
+        """Stage one K-step window's device feed ahead of dispatch (the
+        ``stage_fn`` hook of :class:`mxnet_tpu.data.feed.StagedKFeed`).
+        Runs on the feeder thread while the previous window is still in
+        flight: per-batch cast via ``prepare_input`` then the SAME
+        cast/stack/commit ``run_k`` would apply (``stack_feeds``), so the
+        staged window is bitwise-identical to the unstaged path. Returns
+        ``(payload, h2d_bytes)``; the payload carries both the stacked
+        scan feed and the pre-cast last feed for the executor rebind.
+        Only reads executor metadata (dtypes/sharding) — thread-safe
+        against the main loop, which only commits donated outputs."""
+        ex = self._exec
+        place_each = ex._mesh is None
+        feeds = [{name: ex.prepare_input(name, arr, place=place_each)
+                  for name, arr in self._feed(b).items()}
+                 for b in data_batches]
+        nbytes = 0
+        for b in data_batches:
+            for arrs in (b.data, b.label or []):
+                for a in arrs:
+                    shape = getattr(a, "shape", ())
+                    n = 1
+                    for d in shape:
+                        n *= int(d)
+                    itemsize = getattr(
+                        getattr(a, "dtype", None), "itemsize", 4) or 4
+                    nbytes += n * itemsize
+        return {"stacked": self._fused.stack_feeds(feeds),
+                "last": feeds[-1]}, nbytes
+
+    def _fit_step_k(self, data_batches, staged=None):
         """K fit steps in ONE donating XLA dispatch (`FusedStep.run_k` —
         the train-loop-under-scan TPU idiom). Caller (:meth:`_fit_group`)
         guarantees the fused step is engaged and K > 1. Returns the
@@ -504,24 +536,33 @@ class Module(BaseModule):
             with _profiler.op_timer(
                     "Module::fused_fit_step_k", "symbolic",
                     lambda: [o._data for o in self._exec.outputs]):
-                return self._fit_step_k_impl(data_batches)
-        return self._fit_step_k_impl(data_batches)
+                return self._fit_step_k_impl(data_batches, staged=staged)
+        return self._fit_step_k_impl(data_batches, staged=staged)
 
-    def _fit_step_k_impl(self, data_batches):
+    def _fit_step_k_impl(self, data_batches, staged=None):
         from .. import random as _random
         ex = self._exec
-        # each feed value gets the SAME cast (+ placement) set_inputs
-        # applies (host iterator batches are cpu-committed; stacking them
-        # raw would hand the donating jit cpu feeds next to device params).
-        # Under a mesh, run_k re-commits the STACKED array to P(None, 'dp')
-        # anyway, so per-slice placement would be paid twice — skip it.
-        place_each = ex._mesh is None
-        feeds = [{name: ex.prepare_input(name, arr, place=place_each)
-                  for name, arr in self._feed(b).items()}
-                 for b in data_batches]
+        if staged is not None:
+            # pre-staged by _stage_group on the feeder thread; the stacked
+            # buffer is already cast + committed to the device layout
+            feeds = staged["stacked"]
+            last = staged["last"]
+            place_each = ex._mesh is None
+        else:
+            # each feed value gets the SAME cast (+ placement) set_inputs
+            # applies (host iterator batches are cpu-committed; stacking
+            # them raw would hand the donating jit cpu feeds next to
+            # device params). Under a mesh, run_k re-commits the STACKED
+            # array to P(None, 'dp') anyway, so per-slice placement would
+            # be paid twice — skip it.
+            place_each = ex._mesh is None
+            feeds = [{name: ex.prepare_input(name, arr, place=place_each)
+                      for name, arr in self._feed(b).items()}
+                     for b in data_batches]
+            last = feeds[-1]
         # keep the executor's input bindings current (shape checks, later
         # forward() calls) without re-casting/re-transferring the batch
-        for name, val in feeds[-1].items():
+        for name, val in last.items():
             ex.arg_dict[name]._rebind(
                 val if place_each else ex._place_input(val, name))
         keys = [_random.next_key() for _ in data_batches]

@@ -75,9 +75,34 @@ def _live_mfu(steps, window_s):
         return None
 
 
+def _stall_attribution(steps, window_s, stall_ms):
+    """Input-bound vs compute-bound for the window, host-side only.
+
+    With run-scoped flops available (``set_run_info(flops_per_step=...)``)
+    the perfmodel roofline gives the window's compute FLOOR; stall time
+    eating most of the slack above that floor means the chip was waiting
+    on data. Without flops, fall back to a plain stall-fraction
+    threshold. Returns (stall_frac, input_bound)."""
+    stall_s = max(0.0, float(stall_ms)) / 1e3
+    frac = min(1.0, stall_s / window_s)
+    info = run_info()
+    flops = info.get("flops_per_step")
+    if flops:
+        from mxnet_tpu import perfmodel
+        kind = info.get("device_kind") or perfmodel.DEFAULT_DEVICE_KIND
+        try:
+            floor = steps * perfmodel.roofline_seconds(
+                float(flops), 0.0, kind)
+        except Exception:
+            floor = 0.0
+        slack = max(0.0, window_s - floor)
+        return frac, bool(stall_s > 0.5 * slack and frac > 0.02)
+    return frac, bool(frac > 0.10)
+
+
 def publish_window(*, steps, window_s, examples=None, engine_depth=None,
                    global_step=None, source="train", ddp=None,
-                   embed=None):
+                   embed=None, data=None):
     """Publish one K-step window's worth of training telemetry.
 
     Everything passed in (and everything read here) is already host
@@ -100,6 +125,14 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
     cumulative; subtract the previous window's value before passing).
     embed/cache.py keeps every counter on host, so this too is zero
     extra device traffic.
+
+    ``data`` (optional) is fit's host-held input-pipeline summary for
+    the window — ``{"input_stall_ms", "h2d_bytes", "queue_depth"}``
+    (stall = wall-clock the loop spent blocked on the iterator / staged
+    feed; h2d_bytes from batch shape metadata; queue_depth from the
+    feeder's bounded queue). Publishes ``data/*`` gauges plus the
+    perfmodel-backed input-bound/compute-bound attribution
+    (``data/stall_frac``, ``data/input_bound`` — docs/data.md).
     """
     from mxnet_tpu import profiler
 
@@ -159,6 +192,34 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
                 "host store (dirty evictions)").inc(
                     embed.get("spill_bytes", 0))
 
+    if data:
+        stall_ms = float(data.get("input_stall_ms", 0.0))
+        gauge("data/input_stall_ms",
+              "wall-clock ms the fit loop spent blocked on the input "
+              "pipeline over the last window (host-held timer)").set(
+                  stall_ms)
+        if examples is not None and examples > 0:
+            gauge("data/examples_per_s",
+                  "input-pipeline delivery rate over the last window "
+                  "(examples the loop consumed / window seconds)").set(
+                      examples / window_s)
+        if "queue_depth" in data:
+            gauge("data/queue_depth",
+                  "prefetch/staged-feed queue occupancy at window end "
+                  "(0 with stalls = producer-bound)").set(
+                      data.get("queue_depth", 0))
+        counter("data/h2d_bytes",
+                "host->device input bytes fed to the step loop "
+                "(batch shape metadata, not a device read)").inc(
+                    data.get("h2d_bytes", 0))
+        frac, input_bound = _stall_attribution(steps, window_s, stall_ms)
+        gauge("data/stall_frac",
+              "fraction of the window spent input-stalled").set(frac)
+        gauge("data/input_bound",
+              "1 when the perfmodel attribution says the window was "
+              "input-bound (stall ate the roofline slack), else 0").set(
+                  1.0 if input_bound else 0.0)
+
     sync = profiler.sync_counters()
     for key in ("d2h", "wait", "depth_wait", "d2h_bytes", "total"):
         if key in sync:
@@ -173,6 +234,8 @@ def publish_window(*, steps, window_s, examples=None, engine_depth=None,
         record["ddp"] = dict(ddp)
     if embed:
         record["embed"] = dict(embed)
+    if data:
+        record["data"] = dict(data)
 
     jsonl = _ensure_exporters()
     rec = flight_recorder()

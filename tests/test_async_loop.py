@@ -283,7 +283,7 @@ def test_prefetch_reset_not_racy():
                 break
         assert seq == [float(i) for i in range(8)], seq
     finally:
-        pf._stop_event.set()
+        pf.close()
 
 
 def test_prefetch_reset_while_queue_full():
@@ -298,4 +298,4 @@ def test_prefetch_reset_while_queue_full():
     assert not old_worker.is_alive()
     b = pf.next()
     assert float(b.data[0].asnumpy()[0, 0]) == 0.0
-    pf._stop_event.set()
+    pf.close()

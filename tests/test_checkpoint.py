@@ -438,3 +438,148 @@ def test_fit_resume_bitwise_single_process(tmp_path, monkeypatch):
         np.testing.assert_array_equal(
             baseline[k], resumed[k],
             err_msg="param %r diverged across resume" % k)
+
+# ----------------------------------------------- mid-epoch cursor resume
+
+def _pack_stream_set(tmp_path):
+    """full_data's 32 (x, y) rows as a 2-shard raw-tensor RecordIO set."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        from make_recordio import write_shards
+    finally:
+        sys.path.pop(0)
+    from tests.dist_train_common import full_data
+    X, Y = full_data(1)
+    return write_shards(((float(Y[i]), X[i].tobytes())
+                         for i in range(len(X))),
+                        str(tmp_path / "stream" / "set"), 2)
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+def _stream_fit(recs, num_epoch, crash_at_nbatch=None, ckpt_dir=None):
+    """Fit the shared little net from a StreamingDataIter; optionally
+    "crash" (raise) mid-epoch-0 after ``crash_at_nbatch`` batches.
+    ``ckpt_dir`` checkpoints SYNCHRONOUSLY so the crash can't race an
+    in-flight async save (determinism for the manifest assertions).
+    Returns (params, delivered_batches, seeks)."""
+    from tests.dist_train_common import make_net, fixed_params
+    from mxnet_tpu.data import (RawTensorDecoder, ShardedRecordStream,
+                                StreamingDataIter)
+    mx.random.seed(99)
+    it = StreamingDataIter(ShardedRecordStream(recs, seed=11),
+                           RawTensorDecoder((8,)), batch_size=8)
+    delivered = [0]
+    orig_next = it.next
+
+    def counting_next():
+        b = orig_next()
+        delivered[0] += 1
+        return b
+    it.next = counting_next
+
+    cb = None
+    if crash_at_nbatch is not None:
+        def cb(param):
+            if param.epoch == 0 and param.nbatch == crash_at_nbatch:
+                raise _Boom()
+    sym = make_net()
+    mod = mx.mod.Module(sym)
+    ckpt = (CheckpointManager(ckpt_dir, async_save=False)
+            if ckpt_dir else None)
+    try:
+        mod.fit(it, num_epoch=num_epoch, kvstore="local", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "rescale_grad": 1.0 / 8},
+                arg_params=fixed_params(sym), initializer=None,
+                eval_metric=None, batch_end_callback=cb, checkpoint=ckpt)
+    finally:
+        it.close()
+    args, _ = mod.get_params()
+    return ({k: v.asnumpy() for k, v in args.items()}, delivered[0],
+            it.seeks)
+
+
+def test_fit_resume_cursor_seek_mid_epoch_bitwise(tmp_path, monkeypatch):
+    """Kill/resume THROUGH the data cursor: a streaming-fed fit killed
+    mid-epoch resumes by an O(1) ``seek`` to the checkpointed
+    (epoch, shard, offset) — no batch-skip replay — and still finishes
+    bitwise-identical to the uninterrupted run."""
+    monkeypatch.delenv("MXNET_CHECKPOINT_DIR", raising=False)
+    monkeypatch.delenv("MXNET_RESUME_DIR", raising=False)
+    recs = _pack_stream_set(tmp_path)
+
+    baseline, n_base, _ = _stream_fit(recs, 2)
+    assert n_base == 8  # 32 rows / batch 8 * 2 epochs
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    # crash after 3 batches; the newest snapshot is step 2 — MID epoch 0
+    with pytest.raises(_Boom):
+        _stream_fit(recs, 2, crash_at_nbatch=2, ckpt_dir=ckpt_dir)
+
+    # the snapshot really carries the cursor of the CONSUMED position
+    from mxnet_tpu import checkpoint as _ckpt
+    state, manifest = CheckpointManager(ckpt_dir).restore_latest()
+    assert manifest["nbatch"] == 2 and manifest["epoch"] == 0
+    cur = _ckpt.cursor_from_state(state)
+    assert cur is not None and cur["seed"] == 11
+
+    monkeypatch.setenv("MXNET_RESUME_DIR", ckpt_dir)
+    resumed, n_resumed, seeks = _stream_fit(recs, 2, ckpt_dir=ckpt_dir)
+    # seek, not replay: exactly the 6 remaining batches were delivered
+    # (batch-skip replay would have pulled 2 throwaway batches first)
+    assert seeks == 1
+    assert n_resumed == 8 - 2
+
+    assert sorted(baseline) == sorted(resumed)
+    for k in baseline:
+        np.testing.assert_array_equal(
+            baseline[k], resumed[k],
+            err_msg="param %r diverged across cursor resume" % k)
+
+
+def test_fit_resume_batch_skip_fallback_mid_epoch_bitwise(tmp_path,
+                                                          monkeypatch):
+    """The cursorless fallback stays: an NDArrayIter (no get_cursor/seek)
+    killed mid-epoch resumes through the O(steps) batch-skip replay and
+    is ALSO bitwise."""
+    from tests.dist_train_common import make_net, full_data, fixed_params
+    monkeypatch.delenv("MXNET_CHECKPOINT_DIR", raising=False)
+    monkeypatch.delenv("MXNET_RESUME_DIR", raising=False)
+
+    def fit_once(num_epoch, crash_at_nbatch=None):
+        mx.random.seed(99)
+        X, Y = full_data(1)
+        it = mx.io.NDArrayIter(X, Y, batch_size=8,
+                               label_name="softmax_label")
+        assert not hasattr(it, "get_cursor")  # exercises the skip path
+        cb = None
+        if crash_at_nbatch is not None:
+            def cb(param):
+                if param.epoch == 0 and param.nbatch == crash_at_nbatch:
+                    raise _Boom()
+        sym = make_net()
+        mod = mx.mod.Module(sym)
+        mod.fit(it, num_epoch=num_epoch, kvstore="local", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "rescale_grad": 1.0 / 8},
+                arg_params=fixed_params(sym), initializer=None,
+                eval_metric=None, batch_end_callback=cb)
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    baseline = fit_once(2)
+    ckpt_dir = str(tmp_path / "ckpt")
+    monkeypatch.setenv("MXNET_CHECKPOINT_DIR", ckpt_dir)
+    with pytest.raises(_Boom):
+        fit_once(2, crash_at_nbatch=2)
+    monkeypatch.setenv("MXNET_RESUME_DIR", ckpt_dir)
+    resumed = fit_once(2)
+    for k in baseline:
+        np.testing.assert_array_equal(
+            baseline[k], resumed[k],
+            err_msg="param %r diverged across batch-skip resume" % k)

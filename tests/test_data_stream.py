@@ -1,0 +1,288 @@
+"""Streaming ingestion tier (mxnet_tpu/data/ — docs/data.md), chip-free.
+
+The contracts under test:
+
+* **exactly-once** — across all dp ranks, one epoch of
+  ``ShardedRecordStream`` covers every record of the shard set exactly
+  once (no overlap, no gap), for even and uneven world sizes;
+* **determinism** — the per-epoch shuffle is a pure function of
+  ``(paths, seed, epoch)``: same seed → same order, next epoch →
+  same set, different order;
+* **parity** — a ``StreamingDataIter`` over raw-tensor records delivers
+  the packed rows bit-for-bit (the property that makes streaming-fed
+  ``fit`` bitwise-equal to an in-memory feed, pinned end-to-end in
+  test_step_sync_budget.py);
+* **resume** — a checkpointed cursor ``seek`` replays the remaining
+  batches bitwise, and a cursor from a different fleet shape fails
+  loudly;
+* **shutdown** — closing mid-epoch, with the feeder blocked on the
+  bounded queue's backpressure put, unblocks and joins the feeder (the
+  unified PrefetchQueue race ImageRecordIter and PrefetchingIter share);
+* **packer** — tools/make_recordio.py round-trips through the stream.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import recordio as rio
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.data import (PrefetchQueue, RawTensorDecoder,
+                            ShardedRecordStream, StreamingDataIter)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from make_recordio import (iter_synth_images, iter_twotower,  # noqa: E402
+                           shard_paths, write_shards)
+
+DIM = 5
+
+
+def _pack(tmp_path, n, shards, name="set"):
+    """n raw-tensor records; row i = [i, i+0.5, ...] so the payload
+    identifies the sample."""
+    rows = np.arange(n, dtype=np.float32)[:, None] \
+        + np.arange(DIM, dtype=np.float32)[None, :] / 2.0
+    samples = ((float(i), rows[i].tobytes()) for i in range(n))
+    recs = write_shards(samples, str(tmp_path / name), shards)
+    return recs, rows
+
+
+def _rec_id(rec):
+    _, payload = rio.unpack(rec)
+    return int(np.frombuffer(payload, np.float32)[0])
+
+
+# ------------------------------------------------------------- sharded reads
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_exactly_once_across_ranks(tmp_path, world):
+    # 53 is prime and not a multiple of anything in sight: uneven shard
+    # sizes AND uneven strides, the case where naive splits gap/overlap
+    recs, _ = _pack(tmp_path, 53, shards=3)
+    seen = []
+    for rank in range(world):
+        s = ShardedRecordStream(recs, rank=rank, world=world, seed=7)
+        ids = [_rec_id(r) for r in s]
+        assert len(ids) == s.records_per_epoch()
+        seen.append(ids)
+    flat = [i for ids in seen for i in ids]
+    assert len(flat) == 53                       # no record read twice
+    assert sorted(flat) == list(range(53))       # no record missed
+
+
+def test_shuffle_deterministic_and_epoch_reshuffle(tmp_path):
+    recs, _ = _pack(tmp_path, 40, shards=2)
+    a = ShardedRecordStream(recs, seed=3)
+    b = ShardedRecordStream(recs, seed=3)
+    order0 = [_rec_id(r) for r in a]
+    assert order0 == [_rec_id(r) for r in b]     # same seed, same order
+    a.next_epoch()
+    order1 = [_rec_id(r) for r in a]
+    assert sorted(order1) == sorted(order0)      # same set...
+    assert order1 != order0                      # ...new order
+    c = ShardedRecordStream(recs, seed=4)
+    assert [_rec_id(r) for r in c] != order0     # seed matters
+
+
+# ---------------------------------------------------------- streaming iter
+
+def test_streaming_iter_delivers_packed_rows(tmp_path):
+    recs, rows = _pack(tmp_path, 24, shards=2)
+    it = StreamingDataIter(ShardedRecordStream(recs, seed=1),
+                           RawTensorDecoder((DIM,)), batch_size=4)
+    try:
+        n = 0
+        for batch in it:
+            data = batch.data[0].asnumpy()
+            label = batch.label[0].asnumpy()
+            for j in range(4):
+                i = int(label[j])
+                assert data[j].tobytes() == rows[i].tobytes()  # bitwise
+            n += 1
+        assert n == it.num_batches == 24 // 4
+    finally:
+        it.close()
+
+
+def test_cursor_seek_resumes_bitwise(tmp_path):
+    recs, _ = _pack(tmp_path, 32, shards=2)
+
+    def run(it, count=None):
+        out = []
+        for batch in it:
+            out.append((batch.data[0].asnumpy().copy(),
+                        batch.label[0].asnumpy().copy()))
+            if count is not None and len(out) == count:
+                break
+        return out
+
+    ref = StreamingDataIter(ShardedRecordStream(recs, seed=2),
+                            RawTensorDecoder((DIM,)), batch_size=4)
+    try:
+        full = run(ref)
+    finally:
+        ref.close()
+
+    it = StreamingDataIter(ShardedRecordStream(recs, seed=2),
+                           RawTensorDecoder((DIM,)), batch_size=4)
+    try:
+        head = run(it, count=3)
+        import json
+        cur = json.loads(json.dumps(it.get_cursor()))  # survives a ckpt
+        # a FRESH iterator (new process after a kill) seeks to the cursor
+        it2 = StreamingDataIter(ShardedRecordStream(recs, seed=2),
+                                RawTensorDecoder((DIM,)), batch_size=4)
+        try:
+            it2.seek(cur)
+            assert it2.seeks == 1
+            tail = run(it2)
+        finally:
+            it2.close()
+    finally:
+        it.close()
+    assert len(head) + len(tail) == len(full)
+    for (d, l), (rd, rl) in zip(head + tail, full):
+        assert d.tobytes() == rd.tobytes()
+        assert l.tobytes() == rl.tobytes()
+
+
+def test_cursor_reflects_consumed_not_read_ahead(tmp_path):
+    recs, _ = _pack(tmp_path, 40, shards=2)
+    it = StreamingDataIter(ShardedRecordStream(recs, seed=0),
+                           RawTensorDecoder((DIM,)), batch_size=4,
+                           prefetch_depth=8)
+    try:
+        start = it.get_cursor()
+        next(it)
+        # give the feeder time to read far ahead of the consumer
+        import time
+        time.sleep(0.2)
+        cur = it.get_cursor()
+        consumed = ShardedRecordStream(recs, seed=0)
+        consumed.seek(cur)
+        assert consumed.records_consumed() == 4  # one batch, not depth*4
+        assert cur != start
+    finally:
+        it.close()
+
+
+def test_seek_rejects_foreign_fingerprint(tmp_path):
+    recs, _ = _pack(tmp_path, 20, shards=2)
+    s = ShardedRecordStream(recs, rank=0, world=2, seed=5)
+    cur = s.cursor()
+    other = ShardedRecordStream(recs, rank=1, world=2, seed=5)
+    with pytest.raises(MXNetError, match="fresh epoch"):
+        other.seek(cur)
+    reseeded = ShardedRecordStream(recs, rank=0, world=2, seed=6)
+    with pytest.raises(MXNetError, match="fresh epoch"):
+        reseeded.seek(cur)
+
+
+def test_mid_epoch_reset_loses_no_records(tmp_path):
+    recs, _ = _pack(tmp_path, 40, shards=2)
+    it = StreamingDataIter(ShardedRecordStream(recs, seed=1),
+                           RawTensorDecoder((DIM,)), batch_size=4,
+                           prefetch_depth=6)
+    try:
+        labels = [next(it).label[0].asnumpy().copy() for _ in range(2)]
+        it.reset()  # feeder has read ahead; those records must re-appear
+        replay = [b.label[0].asnumpy().copy() for b in it]
+        assert len(replay) == 10 - 2  # everything but the consumed two
+        got = sorted(int(v) for arr in replay for v in arr)
+        want = sorted(set(range(40))
+                      - {int(v) for arr in labels for v in arr})
+        assert got == want
+    finally:
+        it.close()
+
+
+# ------------------------------------------------------- shutdown semantics
+
+def test_mid_epoch_close_unblocks_blocked_feeder(tmp_path):
+    """The unified-queue race (io.PrefetchingIter, ImageRecordIter and
+    StreamingDataIter all ride PrefetchQueue): close while the feeder is
+    parked in the bounded put must stop it, not deadlock or leak."""
+    recs, _ = _pack(tmp_path, 80, shards=2)
+    for _ in range(5):
+        it = StreamingDataIter(ShardedRecordStream(recs, seed=1),
+                               RawTensorDecoder((DIM,)), batch_size=4,
+                               prefetch_depth=2)
+        next(it)  # consume one so the feeder is deep in the epoch
+        feeder = it._feeder
+        it.close()
+        assert not feeder.is_alive()
+        assert it._pq.stopped
+
+
+def test_prefetch_queue_shutdown_races_producer():
+    """Direct PrefetchQueue contract: a producer blocked on a FULL queue
+    is released by shutdown() and the thread joins."""
+    import threading
+    pq = PrefetchQueue(1)
+
+    def producer():
+        i = 0
+        while pq.put(i):
+            i += 1
+        pq.put_sentinel()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    assert pq.get() == 0          # let it fill the queue again
+    assert pq.shutdown(t, timeout=5.0)
+    assert not t.is_alive()
+
+
+# ----------------------------------------------------------------- packer
+
+def test_make_recordio_synth_images_roundtrip(tmp_path):
+    from make_recordio import main as mkrec_main
+    cv2 = pytest.importorskip("cv2")
+    prefix = str(tmp_path / "synth")
+    recs = mkrec_main(["synth-images", prefix, "--num-samples", "10",
+                       "--side", "8", "--num-shards", "3"])
+    assert recs == shard_paths(prefix, 3)
+    total = 0
+    for s in [ShardedRecordStream(recs, rank=r, world=2, shuffle=False)
+              for r in range(2)]:
+        for rec in s:
+            header, payload = rio.unpack(rec)
+            img = cv2.imdecode(np.frombuffer(payload, np.uint8),
+                               cv2.IMREAD_COLOR)
+            assert img.shape == (8, 8, 3)
+            assert 0 <= float(np.asarray(header.label).reshape(-1)[0]) < 10
+            total += 1
+    assert total == 10
+
+
+def test_make_recordio_twotower_decodes(tmp_path):
+    prefix = str(tmp_path / "inter")
+    recs = write_shards(
+        iter_twotower(30, users=6, items=4, seed=1), prefix, 2)
+    it = StreamingDataIter(ShardedRecordStream(recs, seed=0),
+                           RawTensorDecoder((3,)), batch_size=5)
+    try:
+        rows = np.concatenate([b.data[0].asnumpy() for b in it])
+    finally:
+        it.close()
+    assert rows.shape == (30, 3)
+    assert rows[:, 0].max() < 6 and rows[:, 1].max() < 4
+    # rating column mirrors the header label the packer wrote
+    assert np.isfinite(rows[:, 2]).all()
+
+
+def test_write_shards_multilabel(tmp_path):
+    samples = [(np.array([i, i + 1.0], np.float32),
+                np.float32([i]).tobytes()) for i in range(6)]
+    recs = write_shards(iter(samples), str(tmp_path / "ml"), 2)
+    seen = {}
+    for s in [ShardedRecordStream([p], shuffle=False) for p in recs]:
+        for rec in s:
+            header, payload = rio.unpack(rec)
+            i = int(np.frombuffer(payload, np.float32)[0])
+            seen[i] = np.asarray(header.label).reshape(-1)
+    assert sorted(seen) == list(range(6))
+    for i, lab in seen.items():
+        assert lab.tolist() == [i, i + 1.0]

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Build SHARDED RecordIO sets for the streaming ingestion tier
+(mxnet_tpu/data/ — docs/data.md).
+
+Where tools/im2rec.py packs ONE prefix.rec for the classic single-file
+readers, this packer writes ``prefix-00000.rec/.idx .. prefix-0000N``
+shard files sized for :class:`mxnet_tpu.data.ShardedRecordStream`'s
+file-level + within-file strided partitioning across dp ranks. Three
+subcommands:
+
+  # 1) pack an image folder (one label per leaf directory)
+  python tools/make_recordio.py images out/train path/to/images \
+      --num-shards 8 --resize 256 --quality 95
+
+  # 2) synthetic JPEG images (bench/tests: no dataset download)
+  python tools/make_recordio.py synth-images out/synth \
+      --num-samples 512 --side 64 --num-shards 4 --seed 0
+
+  # 3) synthetic two-tower interaction records (user, item, rating)
+  #    — the streaming feed for examples/train_twotower.py --recordio
+  python tools/make_recordio.py twotower out/inter \
+      --num-samples 4096 --users 1000 --items 2000 --zipf 1.1
+
+Records are fixed-layout: images carry JPEG payloads under an IRHeader
+whose label is the class id; twotower records carry a little-endian
+``float32[3] = (user_id, item_id, rating)`` payload decodable with
+``RawTensorDecoder((3,))``. Sample ``i`` lands in shard ``i % S`` so
+every shard sees an unbiased slice of the sample stream.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def shard_paths(out_prefix, num_shards):
+    """The ``prefix-%05d.rec`` path list a packer run produces (and a
+    ShardedRecordStream consumes)."""
+    return ["%s-%05d.rec" % (out_prefix, s) for s in range(num_shards)]
+
+
+def write_shards(samples, out_prefix, num_shards):
+    """Round-robin ``(label, payload_bytes)`` samples into ``num_shards``
+    indexed RecordIO files. Returns the .rec path list.
+
+    ``label`` may be a float or a 1-D float array (multi-label header).
+    """
+    from mxnet_tpu import recordio as rio
+    num_shards = max(1, int(num_shards))
+    d = os.path.dirname(out_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    recs = shard_paths(out_prefix, num_shards)
+    writers = [rio.MXIndexedRecordIO(p[:-4] + ".idx", p, "w") for p in recs]
+    counts = [0] * num_shards
+    try:
+        for i, (label, payload) in enumerate(samples):
+            s = i % num_shards
+            lab = np.asarray(label, dtype=np.float32).reshape(-1)
+            if lab.size == 1:
+                header = rio.IRHeader(0, float(lab[0]), i, 0)
+            else:
+                header = rio.IRHeader(lab.size, lab, i, 0)
+            writers[s].write_idx(counts[s], rio.pack(header, payload))
+            counts[s] += 1
+    finally:
+        for w in writers:
+            w.close()
+    return recs
+
+
+# --------------------------------------------------------------- generators
+
+def iter_image_folder(root, resize=0, quality=95, exts=(".jpg", ".jpeg",
+                                                        ".png")):
+    """Yield (label, jpeg_bytes) from an image folder — one label per
+    leaf directory, tools/im2rec.py's --recursive labeling."""
+    import cv2
+    cat = {}
+    for path, dirs, files in os.walk(root, followlinks=True):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            if os.path.splitext(fname)[1].lower() not in exts:
+                continue
+            img = cv2.imread(fpath, cv2.IMREAD_COLOR)
+            if img is None:
+                print("skipping unreadable image: %s" % fpath,
+                      file=sys.stderr)
+                continue
+            if resize:
+                h, w = img.shape[:2]
+                scale = float(resize) / min(h, w)
+                img = cv2.resize(img, (int(w * scale + 0.5),
+                                       int(h * scale + 0.5)))
+            ok, buf = cv2.imencode(
+                ".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, int(quality)])
+            if not ok:
+                continue
+            if path not in cat:
+                cat[path] = len(cat)
+            yield cat[path], buf.tobytes()
+
+
+def iter_synth_images(num_samples, side=64, num_classes=10, quality=80,
+                      seed=0):
+    """Yield (label, jpeg_bytes) synthetic images — bench/tests feedstock
+    with no dataset download."""
+    import cv2
+    rng = np.random.RandomState(seed)
+    for i in range(num_samples):
+        img = rng.randint(0, 255, size=(side, side, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(
+            ".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, int(quality)])
+        assert ok
+        yield i % num_classes, buf.tobytes()
+
+
+def iter_twotower(num_samples, users, items, dim=16, zipf=1.1, noise=0.01,
+                  seed=0):
+    """Yield (rating, float32[3] payload) synthetic two-tower interaction
+    records: Zipf-skewed (user, item) pairs rated by a hidden
+    factorization — the same generator shape as
+    examples/train_twotower.py, but streamed to disk."""
+    rng = np.random.RandomState(seed)
+    gt_u = (rng.randn(users, dim) / np.sqrt(dim)).astype(np.float32)
+    gt_i = (rng.randn(items, dim) / np.sqrt(dim)).astype(np.float32)
+
+    def zipf_ids(n, vocab):
+        if zipf <= 0:
+            return rng.randint(0, vocab, size=n)
+        ranks = np.arange(1, vocab + 1, dtype=np.float64)
+        p = ranks ** (-zipf)
+        p /= p.sum()
+        return rng.choice(vocab, size=n, p=p)
+
+    u_ids = zipf_ids(num_samples, users)
+    i_ids = zipf_ids(num_samples, items)
+    ratings = ((gt_u[u_ids] * gt_i[i_ids]).sum(-1)
+               + noise * rng.randn(num_samples)).astype(np.float32)
+    for u, it, r in zip(u_ids, i_ids, ratings):
+        rec = np.array([u, it, r], dtype=np.float32)
+        yield float(r), rec.tobytes()
+
+
+# ---------------------------------------------------------------------- CLI
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Pack sharded RecordIO sets for the streaming tier")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("images", help="pack an image folder")
+    p.add_argument("out_prefix")
+    p.add_argument("root")
+    p.add_argument("--num-shards", type=int, default=4)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+
+    p = sub.add_parser("synth-images", help="pack synthetic JPEG images")
+    p.add_argument("out_prefix")
+    p.add_argument("--num-samples", type=int, default=256)
+    p.add_argument("--side", type=int, default=64)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--num-shards", type=int, default=4)
+    p.add_argument("--quality", type=int, default=80)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser("twotower",
+                       help="pack synthetic two-tower interactions")
+    p.add_argument("out_prefix")
+    p.add_argument("--num-samples", type=int, default=4096)
+    p.add_argument("--users", type=int, default=1000)
+    p.add_argument("--items", type=int, default=2000)
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--zipf", type=float, default=1.1)
+    p.add_argument("--noise", type=float, default=0.01)
+    p.add_argument("--num-shards", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "images":
+        samples = iter_image_folder(args.root, resize=args.resize,
+                                    quality=args.quality)
+    elif args.cmd == "synth-images":
+        samples = iter_synth_images(args.num_samples, side=args.side,
+                                    num_classes=args.num_classes,
+                                    quality=args.quality, seed=args.seed)
+    else:
+        samples = iter_twotower(args.num_samples, users=args.users,
+                                items=args.items, dim=args.dim,
+                                zipf=args.zipf, noise=args.noise,
+                                seed=args.seed)
+    recs = write_shards(samples, args.out_prefix, args.num_shards)
+    from mxnet_tpu.data import ShardedRecordStream
+    total = ShardedRecordStream(recs, shuffle=False).records_per_epoch()
+    print("wrote %d records across %d shards: %s"
+          % (total, len(recs), ", ".join(os.path.basename(r) for r in recs)))
+    return recs
+
+
+if __name__ == "__main__":
+    main()
