@@ -50,7 +50,7 @@ import numpy as _np
 
 __all__ = ["CheckpointManager", "atomic_replace", "atomic_write_bytes",
            "module_state", "restore_module", "trainer_state",
-           "restore_trainer"]
+           "restore_trainer", "reshard_checkpoint"]
 
 _log = logging.getLogger("mxnet_tpu.checkpoint")
 
@@ -157,7 +157,7 @@ class CheckpointManager:
     """
 
     def __init__(self, directory, keep_n=None, save_every=None,
-                 async_save=True, per_rank=True):
+                 async_save=True, per_rank=True, rank=None, world=None):
         if keep_n is None:
             keep_n = int(os.environ.get("MXNET_CHECKPOINT_KEEP", "5"))
         if save_every is None:
@@ -166,11 +166,18 @@ class CheckpointManager:
         self.keep_n = max(2, int(keep_n))
         self.save_every = max(1, int(save_every))
         self.async_save = bool(async_save)
+        # rank/world normally come from the process-mesh runtime (or the
+        # launcher's env); explicit overrides let resharding tools write
+        # snapshots *for* ranks of a different world than their own
         from .parallel import dist as _dist
-        self._rank = _dist.rank() if _dist.initialized() else int(
-            os.environ.get("MXNET_WORKER_RANK", "0"))
-        self._world = _dist.num_workers() if _dist.initialized() else int(
-            os.environ.get("MXNET_NUM_WORKERS", "1"))
+        if rank is None:
+            rank = _dist.rank() if _dist.initialized() else int(
+                os.environ.get("MXNET_WORKER_RANK", "0"))
+        if world is None:
+            world = _dist.num_workers() if _dist.initialized() else int(
+                os.environ.get("MXNET_NUM_WORKERS", "1"))
+        self._rank = int(rank)
+        self._world = int(world)
         self.directory = (os.path.join(self.root, "rank_%d" % self._rank)
                           if per_rank else self.root)
         os.makedirs(self.directory, exist_ok=True)
@@ -251,6 +258,21 @@ class CheckpointManager:
 
     def _write(self, state, step, epoch, nbatch, meta):
         from .parallel import faultinject as _fi
+        meta = dict(meta or {})
+        if "layout" not in meta:
+            # every snapshot carries its layout manifest: the default is
+            # the inferred all-replicated (DDP) layout; sharded callers
+            # pass an explicit LayoutManifest dict via meta["layout"].
+            # This is what makes a checkpoint restorable at a DIFFERENT
+            # world size (restore_resharded / reshard_checkpoint).
+            try:
+                from .parallel import layout as _layout
+                meta["layout"] = _layout.infer_manifest(
+                    state, self._world).to_dict()
+            except Exception as e:
+                _log.warning("checkpoint: could not derive a layout "
+                             "manifest (%s); snapshot will only restore "
+                             "at world %d", e, self._world)
         blob = _encode_state(state)
         data_path = self._data_path(step)
         atomic_write_bytes(data_path, blob)
@@ -337,37 +359,213 @@ class CheckpointManager:
         return self.restore()
 
     def _load_one(self, step):
-        mpath = self._manifest_path(step)
+        return _load_snapshot(self.directory, step)
+
+    # -- cross-world restore (layout-manifest resharding) -------------------
+
+    def restore_resharded(self, step=None):
+        """Restore THIS rank's state from a checkpoint root written at
+        ANY world size. When the root's snapshots match ``self._world``
+        this is plain :meth:`restore`; otherwise every old rank's
+        snapshot at the newest common step is gathered per the layout
+        manifest embedded in the snapshot meta and re-sliced for this
+        rank of the current world (``docs/distributed.md``). A missing
+        or corrupt layout record falls back to the inferred
+        all-replicated (DDP) layout. Returns ``(state, manifest)`` or
+        ``(None, None)``."""
+        state, manifest = self.restore(step)
+        if manifest is not None and \
+                int(manifest.get("world", self._world)) == self._world:
+            return state, manifest
+        states, manifests, s = _load_rank_states(self.root, step)
+        if not states:
+            return state, manifest
+        from .parallel import layout as _layout
+        r0 = min(manifests)
+        man0 = manifests[r0]
+        old_world = int(man0.get("world", len(states)))
+        layout = _layout_of(man0, states[r0], old_world)
+        new_states, new_layout = _layout.reshard_states(
+            states, layout, self._world)
+        out_meta = dict(man0.get("meta") or {})
+        out_meta["layout"] = new_layout.to_dict()
+        out_meta["resharded_from"] = {"world": old_world, "step": s}
+        out = dict(man0, rank=self._rank, world=self._world,
+                   meta=out_meta)
+        return new_states.get(self._rank), out
+
+
+def _load_snapshot(directory, step):
+    """Read one committed snapshot from ``directory``; None when the
+    manifest is unreadable or the data fails its size/CRC check."""
+    mpath = os.path.join(directory, "ckpt-%d.json" % step)
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.loads(f.read().decode())
+    except (OSError, ValueError) as e:
+        _log.warning("checkpoint %s: unreadable manifest (%s); "
+                     "skipping", mpath, e)
+        return None
+    dpath = os.path.join(directory, manifest.get("data", ""))
+    try:
+        with open(dpath, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        _log.warning("checkpoint step %d: missing data file (%s); "
+                     "skipping", step, e)
+        return None
+    if len(blob) != manifest.get("size") or \
+            (zlib.crc32(blob) & 0xFFFFFFFF) != manifest.get("crc32"):
+        _log.warning(
+            "checkpoint step %d: CRC/size mismatch (have %d bytes, "
+            "crc %08x; manifest says %s/%s) — corrupt or truncated; "
+            "skipping", step, len(blob), zlib.crc32(blob) & 0xFFFFFFFF,
+            manifest.get("size"), manifest.get("crc32"))
+        return None
+    try:
+        state = _decode_state(blob)
+    except Exception as e:
+        _log.warning("checkpoint step %d: undecodable payload (%s); "
+                     "skipping", step, e)
+        return None
+    return state, manifest
+
+
+def _steps_in(directory):
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        if n.startswith("ckpt-") and n.endswith(".json"):
+            try:
+                out.append(int(n[5:-5]))
+            except ValueError:
+                continue
+    return out
+
+
+def _rank_dirs(root):
+    """{rank: path} of the per-rank snapshot subdirectories in a
+    checkpoint root."""
+    out = {}
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("rank_"):
+            try:
+                out[int(name[5:])] = os.path.join(root, name)
+            except ValueError:
+                continue
+    return out
+
+
+def _load_rank_states(root, step=None):
+    """Every rank's (state, manifest) at the newest step committed by
+    ALL ranks (optionally capped at ``step``). Returns
+    ``(states_by_rank, manifests_by_rank, step)`` — empty dicts when no
+    common valid step exists."""
+    dirs = _rank_dirs(root)
+    # a rank dir with no snapshots at all is a manager that was merely
+    # constructed (mkdir happens eagerly), never committed — e.g. the
+    # extra ranks of a *larger* new world probing this root. It holds
+    # no shard, so it must not veto the common-step intersection.
+    dirs = {r: d for r, d in dirs.items() if _steps_in(d)}
+    if not dirs:
+        return {}, {}, None
+    common = None
+    for d in dirs.values():
+        steps = set(_steps_in(d))
+        common = steps if common is None else (common & steps)
+    candidates = sorted((s for s in (common or ())
+                         if step is None or s <= step), reverse=True)
+    for s in candidates:
+        states, manifests = {}, {}
+        for r, d in sorted(dirs.items()):
+            got = _load_snapshot(d, s)
+            if got is None:
+                break
+            states[r], manifests[r] = got
+        else:
+            return states, manifests, s
+    return {}, {}, None
+
+
+def _layout_of(manifest, state, world):
+    """The :class:`~mxnet_tpu.parallel.layout.LayoutManifest` a snapshot
+    was written under, from its manifest meta — falling back to the
+    inferred all-replicated layout when the record is missing, corrupt,
+    or claims a different world than the rank directories on disk."""
+    from .parallel import layout as _layout
+    rec = (manifest.get("meta") or {}).get("layout")
+    if rec is not None:
         try:
-            with open(mpath, "rb") as f:
-                manifest = json.loads(f.read().decode())
-        except (OSError, ValueError) as e:
-            _log.warning("checkpoint %s: unreadable manifest (%s); "
-                         "skipping", mpath, e)
-            return None
-        dpath = os.path.join(self.directory, manifest.get("data", ""))
-        try:
-            with open(dpath, "rb") as f:
-                blob = f.read()
-        except OSError as e:
-            _log.warning("checkpoint step %d: missing data file (%s); "
-                         "skipping", step, e)
-            return None
-        if len(blob) != manifest.get("size") or \
-                (zlib.crc32(blob) & 0xFFFFFFFF) != manifest.get("crc32"):
-            _log.warning(
-                "checkpoint step %d: CRC/size mismatch (have %d bytes, "
-                "crc %08x; manifest says %s/%s) — corrupt or truncated; "
-                "skipping", step, len(blob), zlib.crc32(blob) & 0xFFFFFFFF,
-                manifest.get("size"), manifest.get("crc32"))
-            return None
-        try:
-            state = _decode_state(blob)
-        except Exception as e:
-            _log.warning("checkpoint step %d: undecodable payload (%s); "
-                         "skipping", step, e)
-            return None
-        return state, manifest
+            man = _layout.LayoutManifest.from_dict(rec)
+            if man.world == int(world):
+                return man
+            _log.warning("checkpoint: layout manifest claims world %d "
+                         "but %d rank snapshots exist; re-inferring",
+                         man.world, world)
+        except (ValueError, TypeError, KeyError) as e:
+            _log.warning("checkpoint: corrupt layout manifest (%s); "
+                         "falling back to the replicated layout", e)
+    return _layout.infer_manifest(state, world)
+
+
+def reshard_checkpoint(src_root, new_world, dst_root=None, step=None):
+    """Rewrite a multi-rank checkpoint root for a different world size:
+    gather every parameter from the per-rank snapshots' layout manifest,
+    re-slice for ``new_world`` ranks, and commit ``rank_0..rank_{W-1}``
+    snapshot directories under ``dst_root`` (default: a
+    ``<src_root>-w<N>`` sibling — never in place, because a shrink
+    would leave the surplus old-world rank dirs stale beside the new
+    ones and poison a later cross-rank gather) with the same atomic
+    data+manifest discipline.
+
+    Optimizer state and RNG chains ride along replicated; the data
+    cursor is dropped (a resharded resume starts a fresh pass — PR-18
+    cursors are (rank, world, seed)-fingerprinted). Returns a report
+    dict (``tools/reshard.py`` prints it as the one-line JSON)."""
+    new_world = int(new_world)
+    if new_world < 1:
+        raise ValueError("reshard_checkpoint: new_world must be >= 1")
+    from .parallel import layout as _layout
+    states, manifests, s = _load_rank_states(src_root, step)
+    if not states:
+        raise ValueError("reshard_checkpoint: no common committed step "
+                         "across rank dirs in %r" % src_root)
+    r0 = min(manifests)
+    man0 = manifests[r0]
+    old_world = int(man0.get("world", len(states)))
+    layout = _layout_of(man0, states[r0], old_world)
+    new_states, new_layout = _layout.reshard_states(states, layout,
+                                                    new_world)
+    dst_root = (os.fspath(dst_root) if dst_root
+                else "%s-w%d" % (os.fspath(src_root).rstrip("/"),
+                                 new_world))
+    meta = dict(man0.get("meta") or {})
+    meta["layout"] = new_layout.to_dict()
+    meta["resharded_from"] = {"world": old_world, "step": s}
+    for r, st in sorted(new_states.items()):
+        cm = CheckpointManager(dst_root, rank=r, world=new_world,
+                               async_save=False)
+        cm.save(st, s, epoch=int(man0.get("epoch", 0)),
+                nbatch=int(man0.get("nbatch", 0)), meta=meta,
+                blocking=True)
+    return {
+        "kind": "checkpoint",
+        "src": os.fspath(src_root),
+        "dst": dst_root,
+        "step": s,
+        "old_world": old_world,
+        "new_world": new_world,
+        "params": len([k for k in new_states[0]
+                       if not k.startswith("__")]),
+        "layout_fingerprint": new_layout.fingerprint(),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -345,6 +345,16 @@ register_flag("serve_timeout_ms", "MXNET_SERVE_TIMEOUT_MS", float, 1000.0,
               "Default per-request deadline. A request still queued when "
               "its deadline passes is expired (never dispatched); the "
               "caller gets DeadlineExceeded (HTTP 504). 0 = no deadline.")
+register_flag("serve_sim_batch_s", "MXNET_SERVE_SIM_BATCH_S", float, 0.0,
+              "Simulated device time per dispatched predict batch "
+              "(seconds), slept inside the timed dispatch window so it "
+              "shows up in exec_ms, throughput, and the heartbeat load "
+              "signal exactly like real device occupancy. For drills "
+              "and capacity rehearsals on hosts without an "
+              "accelerator, where a CPU stand-in model finishes in "
+              "microseconds: the sleep releases the GIL, so replica "
+              "scale-out shows real latency recovery even on a "
+              "single-core box. 0 (default) = off.")
 register_flag("serve_cache_engines", "MXNET_SERVE_CACHE_ENGINES", int, 8,
               "LRU capacity of the per-bucket executable cache: at most "
               "this many bucket engines stay resident per server. "
@@ -478,6 +488,55 @@ register_flag("fleet_repl_timeout_s", "MXNET_FLEET_REPL_TIMEOUT_S",
               float, 5.0,
               "Per-request HTTP timeout for journal replication "
               "fetches (manifest, segment bytes, snapshot bootstrap).")
+register_flag("autoscale_interval_s", "MXNET_AUTOSCALE_INTERVAL_S",
+              float, 2.0,
+              "Autoscaler evaluation cadence: every tick it reads the "
+              "fleet's federated demand signals (queue-seconds of work "
+              "per replica from the perfmodel-derived heartbeats) and "
+              "decides scale-up / scale-down / hold.")
+register_flag("autoscale_min_replicas", "MXNET_AUTOSCALE_MIN_REPLICAS",
+              int, 1,
+              "Floor on autoscaler-managed replicas per model: drain "
+              "decisions never take a model below this.")
+register_flag("autoscale_max_replicas", "MXNET_AUTOSCALE_MAX_REPLICAS",
+              int, 4,
+              "Ceiling on autoscaler-managed replicas per model: "
+              "launch decisions never take a model above this.")
+register_flag("autoscale_high_watermark_s",
+              "MXNET_AUTOSCALE_HIGH_WATERMARK_S", float, 1.0,
+              "Scale-up pressure threshold: mean queued work per "
+              "in-rotation replica (seconds, from heartbeat load_s) "
+              "above this for autoscale_breach_rounds consecutive "
+              "ticks is a scale-up candidate — still gated by the "
+              "perfmodel break-even test against "
+              "autoscale_startup_cost_s.")
+register_flag("autoscale_low_watermark_s",
+              "MXNET_AUTOSCALE_LOW_WATERMARK_S", float, 0.1,
+              "Scale-down idleness threshold: mean queued work per "
+              "in-rotation replica (seconds) below this for "
+              "autoscale_breach_rounds consecutive ticks drains the "
+              "least-loaded autoscaler-owned replica (graceful: "
+              "in-flight finishes, decode sessions migrate bitwise).")
+register_flag("autoscale_breach_rounds", "MXNET_AUTOSCALE_BREACH_ROUNDS",
+              int, 2,
+              "Hysteresis: how many consecutive ticks a watermark must "
+              "stay breached before the autoscaler acts. Absorbs "
+              "single-tick spikes without thrashing the fleet.")
+register_flag("autoscale_cooldown_s", "MXNET_AUTOSCALE_COOLDOWN_S",
+              float, 10.0,
+              "Minimum wall time between autoscaler actions on one "
+              "model (decisions during it journal as held:cooldown). "
+              "Must exceed replica warmup so the previous action's "
+              "effect is visible in the demand signal before the next "
+              "one.")
+register_flag("autoscale_startup_cost_s", "MXNET_AUTOSCALE_STARTUP_COST_S",
+              float, 2.0,
+              "Amortized cost of launching one replica (process spawn "
+              "+ artifact load + engine warmup). Scale-up is worth it "
+              "only when the projected per-replica queue-drain gain "
+              "beats this break-even — the perfmodel-derived guard "
+              "against scaling into a spike that ends before the new "
+              "replica is warm.")
 register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
               "Training-side telemetry HTTP listener port "
               "(mxnet_tpu.telemetry.exporters): serves /metrics "

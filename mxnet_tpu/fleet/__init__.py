@@ -25,12 +25,22 @@ dying: control-plane mutations return 503 + Retry-After
 (:class:`JournalDegraded`) while routed traffic keeps flowing, and a
 recovered disk exits degraded mode without a restart.
 
+The fleet is elastic: an :class:`Autoscaler` per model watches the
+registry's perfmodel-derived demand signals and asks the supervisor to
+launch or drain replicas under a hysteresis + cooldown + break-even
+policy, journaling every decision so a promoted standby inherits the
+scaling state (:mod:`mxnet_tpu.fleet.autoscale`). The router also
+records each replica's parameter-layout fingerprint
+(:mod:`mxnet_tpu.parallel.layout`) and refuses traffic splits that
+would mix layouts.
+
 Entry points: ``tools/route.py`` (router CLI), ``tools/serve.py
 --register`` (replica side). docs/fleet.md is the operator tour.
 """
 from __future__ import annotations
 
 from . import fencing
+from .autoscale import AutoscalePolicy, Autoscaler
 from .journal import (FleetJournal, FleetState, JournalTailer,
                       LeaseMonitor)
 from .registry import Replica, ReplicaAnnouncer, ReplicaRegistry
@@ -45,6 +55,7 @@ __all__ = [
     "NoReplica", "JournalDegraded", "Router", "RouterHTTPFrontEnd",
     "route_http",
     "ReplicaSpec", "ReplicaSupervisor", "backoff_delay",
+    "AutoscalePolicy", "Autoscaler",
     "FleetJournal", "FleetState", "JournalTailer", "LeaseMonitor",
     "JournalReplicator", "ReplicationError", "StaleSourceError",
     "fencing",

@@ -1,0 +1,393 @@
+"""Demand-driven autoscaling: the router watches its own federated
+load signals and resizes the fleet (ROADMAP item 3, layer 2).
+
+The demand signal is NOT a new heuristic: every replica's heartbeat
+already carries a perfmodel-derived load summary (``load_s`` = seconds
+of queued work, ``unit_s`` = marginal seconds per request,
+``queue_depth`` — the same ``perfmodel.roofline_seconds`` numbers the
+replica's own admission control uses). The autoscaler folds those into
+one pressure number — mean queue-seconds per in-rotation replica — and
+applies a deliberately boring control policy:
+
+* **Hysteresis**: a watermark must stay breached for
+  ``breach_rounds`` consecutive ticks before anything happens, so a
+  single-tick spike doesn't thrash the fleet.
+* **Cooldown**: after any action the scaler holds for ``cooldown_s``
+  (journaled as ``held:cooldown``), long enough for the action's
+  effect to show up in the demand signal.
+* **Break-even**: scale-up must pay for itself. With ``n`` replicas
+  sharing ``W`` queue-seconds, adding one drains
+  ``W/n - W/(n+1)`` seconds of per-replica backlog; if that gain is
+  below ``startup_cost_s`` (spawn + artifact load + warmup) the spike
+  will be over before the new replica is warm, so the scaler holds
+  (``held:break_even``).
+
+Actions ride the machinery earlier PRs built rather than inventing a
+parallel path: scale-up asks the :class:`ReplicaSupervisor` to launch
+a ``tools/serve.py --register`` process (PR-13); scale-down puts the
+victim in router-side draining — new traffic stops instantly,
+in-flight requests finish, decode sessions migrate bitwise via their
+eviction cursors (PR-9/PR-11) — and only then SIGTERMs the process
+(whose own graceful path deregisters and drains its front end). Every
+decision is journaled through the fleet WAL (PR-14) with the scaler's
+owned-replica set, so a promoted standby inherits scaling state and
+keeps managing the same processes' registrations.
+
+One :class:`Autoscaler` manages one model; run several against the
+same router/supervisor for a mixed fleet.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import telemetry
+from ..config import flags
+
+__all__ = ["AutoscalePolicy", "Autoscaler"]
+
+
+class AutoscalePolicy:
+    """Tunables for one scaler; defaults come from the
+    ``MXNET_AUTOSCALE_*`` flag registry (config.py)."""
+
+    def __init__(self, min_replicas=None, max_replicas=None,
+                 high_watermark_s=None, low_watermark_s=None,
+                 breach_rounds=None, cooldown_s=None,
+                 startup_cost_s=None, interval_s=None,
+                 launch_timeout_s=30.0):
+        def _f(v, flag):
+            return flag if v is None else v
+        self.min_replicas = int(_f(min_replicas,
+                                   flags.autoscale_min_replicas))
+        self.max_replicas = int(_f(max_replicas,
+                                   flags.autoscale_max_replicas))
+        self.high_watermark_s = float(_f(high_watermark_s,
+                                         flags.autoscale_high_watermark_s))
+        self.low_watermark_s = float(_f(low_watermark_s,
+                                        flags.autoscale_low_watermark_s))
+        self.breach_rounds = int(_f(breach_rounds,
+                                    flags.autoscale_breach_rounds))
+        self.cooldown_s = float(_f(cooldown_s,
+                                   flags.autoscale_cooldown_s))
+        self.startup_cost_s = float(_f(startup_cost_s,
+                                       flags.autoscale_startup_cost_s))
+        self.interval_s = float(_f(interval_s,
+                                   flags.autoscale_interval_s))
+        # a launched process that never registers stops counting as
+        # capacity after this long (crash loops must not wedge scaling)
+        self.launch_timeout_s = float(launch_timeout_s)
+        if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "autoscale: need 0 <= min_replicas <= max_replicas, "
+                "got %d..%d" % (self.min_replicas, self.max_replicas))
+
+    def to_dict(self):
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "high_watermark_s": self.high_watermark_s,
+            "low_watermark_s": self.low_watermark_s,
+            "breach_rounds": self.breach_rounds,
+            "cooldown_s": self.cooldown_s,
+            "startup_cost_s": self.startup_cost_s,
+            "interval_s": self.interval_s,
+        }
+
+
+class Autoscaler:
+    """One model's scaling loop.
+
+    ``spec_factory(replica_id)`` must return a
+    :class:`~mxnet_tpu.fleet.supervisor.ReplicaSpec` whose argv serves
+    the model and registers with this router (tools/route.py builds it
+    from an argv template). ``supervisor`` launches/stops those
+    processes; ``router`` supplies the registry (demand signal) and
+    the journal (durability). ``clock`` is injectable for tests."""
+
+    def __init__(self, router, supervisor, spec_factory, model,
+                 policy=None, scaler=None, clock=time.monotonic):
+        self.router = router
+        self.supervisor = supervisor
+        self.spec_factory = spec_factory
+        self.model = str(model)
+        self.policy = policy or AutoscalePolicy()
+        self.scaler = str(scaler or self.model)
+        self.clock = clock
+        self.owned = set()        # replica ids this scaler launched
+        self._pending = {}        # rid -> launch deadline (not yet registered)
+        self._draining = set()    # rids drained, waiting to go idle
+        self._breach_high = 0
+        self._breach_low = 0
+        self._last_action_t = None
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = None
+        reg = telemetry.default_registry()
+        self._c_up = reg.counter(
+            "autoscale/scale_up_total",
+            "Replica launches decided by the autoscaler.")
+        self._c_down = reg.counter(
+            "autoscale/scale_down_total",
+            "Replica drains decided by the autoscaler.")
+        self._c_held = reg.counter(
+            "autoscale/held_total",
+            "Autoscaler actions suppressed by cooldown or the "
+            "perfmodel break-even test.")
+        self._g_desired = reg.gauge(
+            "autoscale/desired_replicas",
+            "Replica count the autoscaler is currently steering "
+            "toward for its model.")
+        self._g_pressure = reg.gauge(
+            "autoscale/pressure_s",
+            "Mean queue-seconds of work per in-rotation replica "
+            "(the autoscaler's demand signal).")
+        self.restore()
+
+    # -- durability ----------------------------------------------------------
+    def restore(self):
+        """Inherit scaling state from a replayed journal (standby
+        promotion / supervised restart): the owned-replica set keeps
+        meaning 'this scaler may drain these'."""
+        st = getattr(self.router, "autoscale_state", {}) or {}
+        rec = st.get(self.scaler)
+        if rec:
+            self.owned = set(str(r) for r in rec.get("owned") or [])
+            last = rec.get("last") or {}
+            if isinstance(last.get("seq"), int):
+                self._seq = max(self._seq, int(last["seq"]))
+
+    def _journal(self, action, reason, **extra):
+        data = dict(scaler=self.scaler, model=self.model,
+                    action=action, reason=reason, seq=self._seq,
+                    owned=sorted(self.owned), **extra)
+        try:
+            self.router.record_autoscale(data)
+        except Exception:
+            # a degraded journal must not stop the control loop — the
+            # decision still happened, it is just less durable
+            pass
+        telemetry.flight_recorder().record_event(
+            "autoscale", scaler=self.scaler, model=self.model,
+            action=action, reason=reason, **{
+                k: v for k, v in extra.items()
+                if isinstance(v, (int, float, str, bool, type(None)))})
+        return data
+
+    # -- demand signal -------------------------------------------------------
+    def observe(self, now=None):
+        """Fold registry state into the tick's demand picture."""
+        now = self.clock() if now is None else now
+        reps = [r for r in self.router.registry.replicas()
+                if r.model == self.model and not r.dead]
+        for r in reps:
+            self._pending.pop(r.id, None)   # registered: launch landed
+        for rid, deadline in list(self._pending.items()):
+            if now > deadline:
+                self._pending.pop(rid)
+                self.owned.discard(rid)
+        in_rot = [r for r in reps if r.ready and not r.draining]
+        # registered but not (yet) ready: still warming its engines or
+        # soft-pulled by a 503 — capacity that exists, just not
+        # routable this tick. Counting it stops the floor check from
+        # launching a fresh replica every tick of a warmup window.
+        warming = [r for r in reps if not r.ready and not r.draining]
+        load_s = sum(float(r.load.get("load_s", 0.0) or 0.0)
+                     for r in in_rot)
+        queue = sum(int(r.load.get("queue_depth", 0) or 0)
+                    for r in in_rot)
+        n_cap = len(in_rot) + len(warming) + len(self._pending)
+        pressure = load_s / max(1, len(in_rot))
+        return {
+            "replicas": len(reps),
+            "in_rotation": len(in_rot),
+            "pending": len(self._pending),
+            "capacity": n_cap,
+            "load_s": round(load_s, 4),
+            "queue_depth": queue,
+            "pressure_s": round(pressure, 4),
+        }
+
+    # -- actions -------------------------------------------------------------
+    def _launch(self, now, reason, obs):
+        self._seq += 1
+        rid = "%s-as%d" % (self.scaler, self._seq)
+        spec = self.spec_factory(rid)
+        self.supervisor.add(spec, start=True)
+        self.owned.add(rid)
+        self._pending[rid] = now + self.policy.launch_timeout_s
+        self._last_action_t = now
+        self._breach_high = self._breach_low = 0
+        self._c_up.inc()
+        return self._journal("scale_up", reason, replica=rid,
+                             metrics=obs)
+
+    def _start_drain(self, now, reason, obs):
+        """Pick the least-loaded owned in-rotation replica and stop
+        routing to it; the process keeps running until idle."""
+        victims = [r for r in self.router.registry.replicas()
+                   if r.id in self.owned and not r.dead
+                   and not r.draining and r.ready]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda r: r.score())
+        self.router.registry.set_draining(victim.id, True)
+        self._draining.add(victim.id)
+        self._seq += 1
+        self._last_action_t = now
+        self._breach_high = self._breach_low = 0
+        self._c_down.inc()
+        return self._journal("scale_down", reason, replica=victim.id,
+                             metrics=obs)
+
+    def _reap_drained(self):
+        """SIGTERM drained replicas once idle (zero in-flight, empty
+        queue): the serve.py graceful path deregisters, drains its
+        front end, and exits; decode sessions already migrated via
+        their eviction cursors when draining pulled it from rotation."""
+        done = []
+        for rid in sorted(self._draining):
+            rep = self.router.registry.get(rid)
+            if rep is not None and not rep.dead:
+                busy = (rep.inflight > 0
+                        or int(rep.load.get("queue_depth", 0) or 0) > 0)
+                if busy:
+                    continue
+            try:
+                self.supervisor.stop(rid, wait_s=5.0)
+            except Exception:
+                pass
+            self._draining.discard(rid)
+            self.owned.discard(rid)
+            done.append(rid)
+            self._seq += 1
+            self._journal("drain_complete", "replica idle after drain",
+                          replica=rid)
+        return done
+
+    # -- the control loop ----------------------------------------------------
+    def step(self, now=None):
+        """One tick: observe, decide, maybe act. Returns the decision
+        dict (action in scale_up / scale_down / drain_complete /
+        held:* / steady)."""
+        now = self.clock() if now is None else now
+        reaped = self._reap_drained()
+        obs = self.observe(now)
+        self._g_pressure.set(obs["pressure_s"])
+        pol = self.policy
+
+        # floor: a model below min_replicas gets capacity NOW —
+        # no watermark, no cooldown, no break-even
+        if obs["capacity"] < pol.min_replicas:
+            return self._launch(now, "below min_replicas", obs)
+
+        pressure = obs["pressure_s"]
+        settled = (obs["pending"] == 0
+                   and obs["in_rotation"] == obs["capacity"])
+        if pressure > pol.high_watermark_s:
+            self._breach_high += 1
+            self._breach_low = 0
+        elif pressure < pol.low_watermark_s:
+            # low readings from an unsettled fleet (launch pending /
+            # replica warming) don't count toward a drain: the signal
+            # reflects capacity that hasn't materialized yet
+            if settled:
+                self._breach_low += 1
+            self._breach_high = 0
+        else:
+            self._breach_high = self._breach_low = 0
+
+        want_up = (self._breach_high >= pol.breach_rounds
+                   and obs["capacity"] < pol.max_replicas)
+        # scale-down only from a SETTLED fleet: while a launch is
+        # pending or a replica is warming, the low pressure reading is
+        # an artifact of capacity that hasn't materialized — draining a
+        # replica now (the warming one scores 0 and would be the
+        # victim) turns every spike into a launch/drain storm
+        want_down = (self._breach_low >= pol.breach_rounds
+                     and obs["capacity"] > pol.min_replicas
+                     and obs["pending"] == 0
+                     and obs["in_rotation"] == obs["capacity"]
+                     and bool(self.owned - self._draining))
+        self._g_desired.set(obs["capacity"]
+                            + (1 if want_up else 0)
+                            - (1 if want_down else 0))
+        if not (want_up or want_down):
+            if reaped:
+                return {"action": "drain_complete", "replicas": reaped}
+            return {"action": "steady", "metrics": obs}
+
+        in_cooldown = (self._last_action_t is not None
+                       and now - self._last_action_t < pol.cooldown_s)
+        if in_cooldown:
+            self._c_held.inc()
+            return self._journal(
+                "held:cooldown",
+                "action suppressed: %.1fs of %.1fs cooldown remain"
+                % (pol.cooldown_s - (now - self._last_action_t),
+                   pol.cooldown_s),
+                wanted="scale_up" if want_up else "scale_down",
+                metrics=obs)
+
+        if want_up:
+            # break-even: adding a replica drains W/n - W/(n+1)
+            # queue-seconds of per-replica backlog; below the startup
+            # cost the spike outruns the launch
+            n = max(1, obs["in_rotation"])
+            gain_s = obs["load_s"] / n - obs["load_s"] / (n + 1)
+            if gain_s <= pol.startup_cost_s:
+                self._c_held.inc()
+                return self._journal(
+                    "held:break_even",
+                    "projected drain gain %.2fs <= startup cost %.2fs"
+                    % (gain_s, pol.startup_cost_s),
+                    wanted="scale_up", metrics=obs)
+            return self._launch(
+                now, "pressure %.2fs > %.2fs for %d rounds; drain "
+                "gain %.2fs beats startup %.2fs"
+                % (pressure, pol.high_watermark_s, self._breach_high,
+                   gain_s, pol.startup_cost_s), obs)
+
+        return self._start_drain(
+            now, "pressure %.2fs < %.2fs for %d rounds"
+            % (pressure, pol.low_watermark_s, self._breach_low),
+            obs) or {"action": "steady", "metrics": obs}
+
+    # -- thread lifecycle ----------------------------------------------------
+    def start(self, interval_s=None):
+        interval_s = (self.policy.interval_s if interval_s is None
+                      else float(interval_s))
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.step()
+                except Exception:
+                    # one bad tick (registry race, spawn failure) must
+                    # not kill the scaling loop
+                    pass
+
+        self._thread = threading.Thread(
+            target=_loop, name="mxnet-autoscale-%s" % self.scaler,
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def snapshot(self):
+        return {
+            "scaler": self.scaler,
+            "model": self.model,
+            "owned": sorted(self.owned),
+            "draining": sorted(self._draining),
+            "pending": sorted(self._pending),
+            "policy": self.policy.to_dict(),
+        }

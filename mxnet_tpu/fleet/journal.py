@@ -90,6 +90,10 @@ class FleetState:
         self.splits = {}             # model -> {version: weight}
         self.canaries = {}           # model -> canary record (no deltas)
         self.sessions = {}           # sid -> hop cursor record
+        self.autoscale = {}          # scaler key -> {owned, last, ...}:
+                                     # a promoted standby inherits which
+                                     # replicas the autoscaler launched
+                                     # and where its policy left off
 
     def apply(self, seq, kind, data):
         """Apply one record; returns False for stale (already-applied)
@@ -124,6 +128,15 @@ class FleetState:
             self.sessions[str(data["sid"])] = dict(data)
         elif kind == "session_done":
             self.sessions.pop(str(data.get("sid")), None)
+        elif kind == "autoscale":
+            # one record per scaling decision; the reducer keeps the
+            # scaler's durable view (owned replica ids + last decision)
+            key = str(data.get("scaler") or "default")
+            rec = self.autoscale.setdefault(key, {})
+            if "owned" in data:
+                rec["owned"] = list(data["owned"] or [])
+            rec["last"] = {k: v for k, v in data.items()
+                           if k not in ("scaler", "owned")}
         # unknown kinds are skipped, not fatal: an older standby may
         # tail a newer primary's journal during a rolling upgrade
         return True
@@ -137,6 +150,8 @@ class FleetState:
             "splits": {m: dict(w) for m, w in self.splits.items()},
             "canaries": {m: dict(c) for m, c in self.canaries.items()},
             "sessions": {s: dict(v) for s, v in self.sessions.items()},
+            "autoscale": {k: dict(v)
+                          for k, v in self.autoscale.items()},
         }
 
     @classmethod
@@ -153,6 +168,8 @@ class FleetState:
                        for m, c in (d.get("canaries") or {}).items()}
         st.sessions = {str(s): dict(v)
                        for s, v in (d.get("sessions") or {}).items()}
+        st.autoscale = {str(k): dict(v)
+                        for k, v in (d.get("autoscale") or {}).items()}
         return st
 
 

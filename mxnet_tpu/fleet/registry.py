@@ -37,7 +37,7 @@ class Replica:
     __slots__ = ("id", "url", "model", "version", "mode", "identity",
                  "pid", "registered_at", "last_heartbeat", "ready",
                  "reason", "load", "dead", "dead_reason", "draining",
-                 "inflight", "served", "static", "spec")
+                 "inflight", "served", "static", "spec", "layout")
 
     def __init__(self, rid, url, model, version, mode, identity=None,
                  pid=None, now=None):
@@ -62,6 +62,10 @@ class Replica:
         self.static = False            # seeded, no heartbeats: never swept
         self.spec = {}                 # generate wire geometry (e.g.
                                        # max_prompt_len caps hop chunking)
+        self.layout = None             # artifact layout fingerprint
+                                       # ({"fingerprint", "mesh"}): the
+                                       # router refuses to split traffic
+                                       # across disagreeing layouts
 
     def score(self):
         """Least-loaded routing score: estimated seconds of queued work
@@ -82,6 +86,7 @@ class Replica:
             "dead": self.dead, "dead_reason": self.dead_reason,
             "draining": self.draining, "load": self.load,
             "inflight": self.inflight, "served": self.served,
+            "layout": self.layout,
             "heartbeat_age_s": round(now - self.last_heartbeat, 3),
         }
 
@@ -97,6 +102,7 @@ class Replica:
             "dead": self.dead, "dead_reason": self.dead_reason,
             "draining": self.draining, "static": self.static,
             "spec": self.spec, "load": self.load,
+            "layout": self.layout,
         }
 
 
@@ -135,6 +141,29 @@ class ReplicaRegistry:
             print("fleet registry: mutation hook failed: %s" % e,
                   file=sys.stderr)
 
+    def _publish_count(self):
+        """Publish ``fleet/replica_count`` (total registered, the
+        autoscaler's actual-vs-desired readback) and
+        ``fleet/replicas_in_rotation`` (ready, non-draining). Called
+        outside the lock on every membership/readiness change; a broken
+        telemetry registry must never break registration."""
+        try:
+            from .. import telemetry
+            with self._lock:
+                total = len(self._replicas)
+                ready = sum(1 for r in self._replicas.values()
+                            if r.ready and not r.dead and not r.draining)
+            telemetry.gauge(
+                "fleet/replica_count",
+                "Replicas currently registered with the router "
+                "(any state)").set(total)
+            telemetry.gauge(
+                "fleet/replicas_in_rotation",
+                "Registered replicas that are ready, alive, and not "
+                "draining").set(ready)
+        except Exception:
+            pass
+
     # -- replica-driven lifecycle ------------------------------------------
     def register(self, info):
         """Upsert from a registration payload (dict with id/url/model/
@@ -153,8 +182,10 @@ class ReplicaRegistry:
             rep.load = dict(info.get("load") or {})
             rep.static = bool(info.get("static", False))
             rep.spec = dict(info.get("spec") or {})
+            rep.layout = info.get("layout")
             self._replicas[rid] = rep
             self._notify("register", rep.to_info())
+        self._publish_count()
         return rep
 
     def restore(self, infos):
@@ -177,10 +208,12 @@ class ReplicaRegistry:
                 rep.load = dict(info.get("load") or {})
                 rep.static = bool(info.get("static", False))
                 rep.spec = dict(info.get("spec") or {})
+                rep.layout = info.get("layout")
                 rep.draining = bool(info.get("draining", False))
                 rep.dead = bool(info.get("dead", False))
                 rep.dead_reason = info.get("dead_reason")
                 self._replicas[rep.id] = rep
+        self._publish_count()
 
     def heartbeat(self, rid, ready=None, reason=None, load=None):
         """Refresh liveness + readiness; returns False for an unknown id
@@ -203,21 +236,26 @@ class ReplicaRegistry:
                 rep.reason = reason
             if load is not None:
                 rep.load = dict(load)
-            if (rep.dead, rep.ready) != was:
+            flipped = (rep.dead, rep.ready) != was
+            if flipped:
                 # journal readiness FLIPS, not every beat: load updates
                 # are re-announced within a heartbeat interval anyway
                 self._notify("state", {
                     "id": rep.id, "ready": rep.ready,
                     "reason": rep.reason, "dead": rep.dead,
                     "dead_reason": rep.dead_reason})
-            return True
+        if flipped:
+            self._publish_count()
+        return True
 
     def deregister(self, rid):
         with self._lock:
             gone = self._replicas.pop(str(rid), None) is not None
             if gone:
                 self._notify("deregister", {"id": str(rid)})
-            return gone
+        if gone:
+            self._publish_count()
+        return gone
 
     # -- router-driven state -----------------------------------------------
     def mark_dead(self, rid, why):
@@ -230,6 +268,7 @@ class ReplicaRegistry:
                 self._notify("state", {
                     "id": rep.id, "ready": False, "dead": True,
                     "dead_reason": rep.dead_reason})
+        self._publish_count()
 
     def mark_not_ready(self, rid, why):
         """Soft pull (a 503 from the data path): out of rotation until
@@ -250,7 +289,8 @@ class ReplicaRegistry:
             rep.draining = bool(draining)
             self._notify("state", {"id": rep.id,
                                    "draining": rep.draining})
-            return True
+        self._publish_count()
+        return True
 
     def note_inflight(self, rid, delta):
         with self._lock:
@@ -283,6 +323,8 @@ class ReplicaRegistry:
                     self._notify("state", {
                         "id": rep.id, "ready": False, "dead": True,
                         "dead_reason": rep.dead_reason})
+        if newly:
+            self._publish_count()
         return newly
 
     # -- queries ------------------------------------------------------------

@@ -107,6 +107,10 @@ class Router:
         self.address = None  # bound URL, once announce() learns it
         self.replay_stats = None
         self._sessions = {}  # sid -> journal-backed generate hop cursor
+        # scaler key -> {owned, last}: the autoscaler's durable view,
+        # journaled per decision so a promoted standby inherits which
+        # replicas were autoscaler-launched (Autoscaler.restore reads it)
+        self.autoscale_state = {}
         self.journal_degraded = False   # journal unwritable (ENOSPC...)
         self.degraded_reason = None
         reg = telemetry.default_registry()
@@ -300,6 +304,8 @@ class Router:
             # the matching session id — never double-run concurrently
             self._sessions = {sid: dict(s, orphan=True)
                               for sid, s in state.sessions.items()}
+            self.autoscale_state = {k: dict(v)
+                                    for k, v in state.autoscale.items()}
 
     def export_state(self):
         """The current control-plane state as a :class:`FleetState`
@@ -318,7 +324,25 @@ class Router:
             st.sessions = {sid: {k: v for k, v in s.items()
                                  if k != "orphan"}
                            for sid, s in self._sessions.items()}
+            st.autoscale = {k: dict(v)
+                            for k, v in self.autoscale_state.items()}
         return st
+
+    def record_autoscale(self, data, sync=True):
+        """Journal one autoscaling decision and fold it into the
+        in-memory scaler state with the same reducer
+        ``FleetState.apply`` uses — ``export_state()`` and
+        ``fleet_snapshot()`` reflect the decision immediately, and a
+        promoted standby replays it."""
+        data = dict(data)
+        key = str(data.get("scaler") or "default")
+        self._journal_append("autoscale", data, sync=sync)
+        with self._lock:
+            rec = self.autoscale_state.setdefault(key, {})
+            if "owned" in data:
+                rec["owned"] = list(data["owned"] or [])
+            rec["last"] = {k: v for k, v in data.items()
+                           if k not in ("scaler", "owned")}
 
     def _stamp_epoch(self, body):
         if self.epoch is not None:
@@ -793,6 +817,30 @@ class Router:
         return 200, out, {}
 
     # -- blue/green + canary ------------------------------------------------
+    def _refuse_mixed_layouts(self, model, versions):
+        """A hop cursor is only portable between replicas that agree
+        on the parameter layout (cache geometry bakes into the decode
+        shapes), so a split mixing layout fingerprints would strand
+        migrating sessions mid-generation — refuse it. Replicas that
+        registered no layout (predict artifacts, older serves) are
+        exempt: only *disagreeing known* fingerprints refuse."""
+        fps = {}
+        for rep in self.registry.live_replicas():
+            if rep.model != model or str(rep.version) not in versions:
+                continue
+            lay = getattr(rep, "layout", None)
+            fp = lay.get("fingerprint") if isinstance(lay, dict) else None
+            if fp:
+                fps.setdefault(str(fp), []).append(rep.id)
+        if len(fps) > 1:
+            detail = "; ".join("%s=%s" % (fp, ",".join(sorted(ids)))
+                               for fp, ids in sorted(fps.items()))
+            raise MXNetError(
+                "fleet: refusing split for model %r across mixed "
+                "parameter layouts (%s) — reshard the artifact "
+                "(tools/reshard.py) so every replica in the split "
+                "agrees on one layout fingerprint" % (model, detail))
+
     def set_split(self, model, weights):
         """Set the version traffic split for ``model`` (weights are
         normalized; a missing version gets zero traffic)."""
@@ -808,6 +856,7 @@ class Router:
         if total <= 0:
             raise MXNetError("fleet: split weights must sum > 0")
         norm = {v: w / total for v, w in clean.items()}
+        self._refuse_mixed_layouts(str(model), set(norm))
         # WAL discipline: the record hits the disk before the split is
         # live, so an acked split is always durable (the drill asserts
         # acked control ops survive a primary disk death)
@@ -871,6 +920,7 @@ class Router:
                 baseline = {v: 1.0 / len(versions) for v in versions}
             mixed = {v: w * (1.0 - split) for v, w in baseline.items()}
             mixed[version] = mixed.get(version, 0.0) + split
+            self._refuse_mixed_layouts(model, set(mixed))
             self.splits[model] = mixed
             self.canaries[model] = {
                 "model": model, "version": version, "split": split,
@@ -966,12 +1016,16 @@ class Router:
                 "orphaned": sum(1 for s in self._sessions.values()
                                 if s.get("orphan")),
             }
+            autoscale = {k: dict(v)
+                         for k, v in self.autoscale_state.items()}
         snap = self.registry.snapshot()
         snap["splits"] = splits
         snap["canaries"] = canaries
         snap["models"] = self.registry.models()
         snap["epoch"] = self.epoch
         snap["sessions"] = sessions
+        if autoscale:
+            snap["autoscale"] = autoscale
         if self.journal is not None:
             snap["journal"] = self.journal.stats()
             snap["journal_degraded"] = self.journal_degraded

@@ -14,6 +14,8 @@ from .moe import (moe_layer, init_moe_params, shard_moe_params,
 from .ring_attention import (blockwise_attention, ring_attention,
                              make_ring_attention, attention_reference)
 from ..ops.pallas_flash import flash_attention
+from .layout import LayoutManifest
 from . import ddp
 from . import dist
 from . import fault
+from . import layout

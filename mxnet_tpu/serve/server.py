@@ -525,6 +525,12 @@ class Server:
             outs = self._cache.run(bucket, stacked, rows, dtype=dtype)
             # ONE d2h for the whole response batch (PR 3 discipline)
             host = jax.device_get(outs)
+            sim_s = float(flags.serve_sim_batch_s)
+            if sim_s > 0.0:
+                # stand-in device occupancy for accelerator-less drill
+                # hosts; inside the timed window so the cost model and
+                # heartbeat load see it as real batch time
+                time.sleep(sim_s)
             exec_ms = (time.perf_counter() - t0) * 1e3
         except Exception as e:
             self.metrics_.note_error(len(live))

@@ -34,6 +34,7 @@ Surface:
 """
 from __future__ import annotations
 
+import io
 import json
 import struct
 
@@ -47,7 +48,8 @@ from . import hlo_stats as _hlo_stats
 from .kernels import tier as _kernels_tier
 
 __all__ = ["export_compiled", "CompiledModel", "export_generate",
-           "GenerateModel", "load_artifact", "artifact_identity"]
+           "GenerateModel", "load_artifact", "artifact_identity",
+           "load_bundled_params", "reshard_artifact", "artifact_layout"]
 
 _MAGIC = b"MXTPUAOT"
 
@@ -437,7 +439,8 @@ def _kernel_tier_meta(exps):
 
 
 def export_generate(params, spec, path, platforms=None, dtype="float32",
-                    draft_params=None, speculate_k=None, chunked=None):
+                    draft_params=None, speculate_k=None, chunked=None,
+                    bundle_params=True):
     """Freeze a decoder (weights + :class:`~mxnet_tpu.serve.decode_model.
     DecoderSpec` geometry) into a generate-capable artifact.
 
@@ -468,6 +471,19 @@ def export_generate(params, spec, path, platforms=None, dtype="float32",
     Donation is NOT recorded in the modules; the serve side re-jits with
     ``donate_argnums`` (GenerateSession) and the MXL508/MXL510 gates
     check the lowerings it actually runs.
+
+    ``bundle_params=True`` (the default) additionally appends the raw
+    decoder weights (and the int8 draft dict, when given) as npz data
+    payloads after the StableHLO modules — the same ship-data-not-
+    constants trick recommend (v6) artifacts use for the user table.
+    That is what makes the artifact RESHARDABLE: the baked constants in
+    the modules cannot be extracted, but :func:`reshard_artifact` can
+    re-stage the bundled weights under a different cache geometry
+    without going back to the training checkpoint. The artifact meta
+    also records a layout fingerprint
+    (:class:`mxnet_tpu.parallel.layout.LayoutManifest` over the weights
+    + the cache geometry as its mesh) that fleet replicas register so
+    the router can refuse mixed-layout splits.
     """
     from jax import export as _export
     from .serve import decode_model as _dm
@@ -526,6 +542,18 @@ def export_generate(params, spec, path, platforms=None, dtype="float32",
         gen_meta["speculate_k"] = k
 
     blobs = [exp.serialize() for _, exp in exps]
+    # data payloads ride AFTER the module blobs; loaders that only walk
+    # meta["modules"] (GenerateModel.load) never touch them
+    data_blobs = []
+    if bundle_params:
+        pblob = _params_npz_bytes(params)
+        gen_meta["params"] = {"bytes": len(pblob)}
+        data_blobs.append(pblob)
+        if draft_params is not None:
+            dblob = _params_npz_bytes(draft_params)
+            gen_meta["draft_params"] = {"bytes": len(dblob)}
+            data_blobs.append(dblob)
+    gen_meta["layout"] = _generate_layout(params, spec).to_dict()
     meta = {
         "format_version": 5 if chunked else 3,
         "platforms": list(prefill_exp.platforms),
@@ -553,7 +581,144 @@ def export_generate(params, spec, path, platforms=None, dtype="float32",
         f.write(mjson)
         for blob in blobs:
             f.write(blob)
+        for blob in data_blobs:
+            f.write(blob)
     return meta
+
+
+def _params_npz_bytes(params):
+    buf = io.BytesIO()
+    _np.savez(buf, **{k: _np.asarray(v) for k, v in params.items()})
+    return buf.getvalue()
+
+
+def _generate_layout(params, spec):
+    """The layout manifest a generate artifact is exported under: the
+    weights (replicated, world 1 — one artifact, one engine) with the
+    paged-cache geometry as the mesh, so the fingerprint changes exactly
+    when the inference mesh shape does."""
+    from .parallel import layout as _layout
+    shapes = {k: list(_np.shape(v)) for k, v in params.items()}
+    return _layout.LayoutManifest.replicated(shapes, 1, mesh={
+        "max_slots": spec.max_slots, "num_pages": spec.num_pages,
+        "page_size": spec.page_size,
+        "max_pages_per_slot": spec.max_pages_per_slot})
+
+
+def load_bundled_params(path):
+    """The raw decoder weights a generate artifact bundled at export
+    (``export_generate(..., bundle_params=True)``), as
+    ``(params, draft_params_or_None)`` numpy dicts. Raises for an
+    artifact exported without bundled weights — those are welded to
+    their mesh; re-export from the checkpoint to make them
+    reshardable."""
+    meta, payload = _read_artifact(path)
+    _require_kind(path, meta, "generate")
+    gen = meta.get("generate") or {}
+    rec = gen.get("params")
+    if not rec:
+        raise MXNetError(
+            "generate artifact %r does not bundle its weights, so it "
+            "cannot be resharded; re-export it with "
+            "export_generate(..., bundle_params=True) (the default "
+            "since layout manifests landed) or reshard the checkpoint "
+            "instead" % path)
+    off = sum(int(m["bytes"]) for m in meta.get("modules") or [])
+    blob = payload[off:off + int(rec["bytes"])]
+    with _np.load(io.BytesIO(blob)) as z:
+        params = {k: z[k] for k in z.files}
+    draft = None
+    drec = gen.get("draft_params")
+    if drec:
+        doff = off + int(rec["bytes"])
+        dblob = payload[doff:doff + int(drec["bytes"])]
+        with _np.load(io.BytesIO(dblob)) as z:
+            draft = {k: z[k] for k in z.files}
+    return params, draft
+
+
+def artifact_layout(path):
+    """The layout record of a ``.mxtpu`` artifact without loading its
+    modules: ``{"fingerprint", "mesh"}`` for generate artifacts that
+    carry one, else None (predict artifacts have no cache geometry to
+    disagree about)."""
+    meta, _ = _read_artifact(path)
+    rec = (meta.get("generate") or {}).get("layout")
+    if not rec:
+        return None
+    return {"fingerprint": rec.get("fingerprint"),
+            "mesh": dict(rec.get("mesh") or {})}
+
+
+def reshard_artifact(src, dst, max_slots=None, num_pages=None,
+                     max_pages_per_slot=None, page_size=None,
+                     platforms=None):
+    """Re-target a generate artifact to a DIFFERENT inference mesh
+    shape — new slot count / KV page budget — without touching the
+    training checkpoint: load the weights the artifact bundled, rebuild
+    the :class:`~mxnet_tpu.serve.decode_model.DecoderSpec` with the new
+    geometry, and re-run :func:`export_generate`. Draft modules and the
+    speculation depth are preserved when present.
+
+    Position-keyed sampling makes the resharded artifact serve tokens
+    BITWISE-equal to the original (the elastic-fleet gate): sampling
+    folds (seed, position), never slot, page, or batch geometry.
+
+    ``max_context`` may shrink or stay (the positional table has
+    exactly ``old max_context`` rows); growing it needs retraining, so
+    that is refused. Returns a report dict."""
+    from .serve import decode_model as _dm
+    meta, _ = _read_artifact(src)
+    _require_kind(src, meta, "generate")
+    params, draft = load_bundled_params(src)
+    old_spec = _dm.DecoderSpec(**meta["generate"]["spec"])
+    new_spec = old_spec._replace(**{
+        k: int(v) for k, v in [
+            ("max_slots", max_slots), ("num_pages", num_pages),
+            ("max_pages_per_slot", max_pages_per_slot),
+            ("page_size", page_size)]
+        if v is not None}).validate()
+    pos_rows = int(_np.shape(params["pos_w"])[0])
+    if new_spec.max_context > pos_rows:
+        raise MXNetError(
+            "reshard_artifact: new geometry wants max_context %d but "
+            "the bundled positional table has %d rows — an artifact's "
+            "context window can shrink or stay, not grow (re-train or "
+            "re-export from a larger checkpoint)"
+            % (new_spec.max_context, pos_rows))
+    chunked = any(m["name"] == "chunk_prefill"
+                  for m in meta.get("modules") or [])
+    speculate_k = meta["generate"].get("speculate_k")
+    if platforms is None:
+        platforms = meta.get("platforms")
+    new_meta = export_generate(
+        params, new_spec, dst, platforms=platforms,
+        dtype=meta["generate"].get("dtype", "float32"),
+        draft_params=draft, speculate_k=speculate_k, chunked=chunked,
+        bundle_params=True)
+    try:
+        from . import telemetry as _telemetry
+        _telemetry.counter(
+            "layout/reshards_total",
+            "State resharding operations (checkpoint or artifact)").inc()
+        _telemetry.flight_recorder().record_event(
+            "layout_reshard", kind="artifact",
+            fingerprint=new_meta["generate"]["layout"]["fingerprint"])
+    except Exception:
+        pass
+    return {
+        "kind": "artifact",
+        "src": src, "dst": dst,
+        "old_mesh": meta["generate"]["layout"]["mesh"]
+                    if meta["generate"].get("layout") else None,
+        "new_mesh": new_meta["generate"]["layout"]["mesh"],
+        "old_fingerprint": (meta["generate"].get("layout") or {}
+                            ).get("fingerprint"),
+        "new_fingerprint":
+            new_meta["generate"]["layout"]["fingerprint"],
+        "format_version": new_meta["format_version"],
+        "speculative": draft is not None,
+    }
 
 
 class GenerateModel:

@@ -1,0 +1,434 @@
+"""Demand-driven autoscaling (mxnet_tpu.fleet.autoscale) — chip-free.
+
+Acceptance properties: (1) the floor launches immediately, ungated by
+cooldown or break-even, and a warming replica counts as capacity so a
+slow warmup never triggers a launch storm; (2) scale-up needs a
+sustained high-watermark breach AND a break-even win; (3) scale-down
+drains (never kills) the least-loaded owned replica and reaps it only
+once idle; (4) cooldown suppresses actions and is journaled as
+``held:cooldown``; (5) every decision round-trips through the fleet
+WAL — ``FleetState`` folds them, a promoted router restores them, and
+a fresh ``Autoscaler`` inherits its owned set; (6) the router refuses
+a traffic split across mixed layout fingerprints.
+"""
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fleet import (AutoscalePolicy, Autoscaler, FleetJournal,
+                             ReplicaRegistry, Router, fencing)
+from mxnet_tpu.fleet.journal import FleetState, replay
+
+
+@pytest.fixture(autouse=True)
+def _fresh_epoch():
+    fencing.reset()
+    yield
+    fencing.reset()
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakeSupervisor:
+    """Records launch/stop calls; never spawns a process."""
+
+    def __init__(self):
+        self.added = []
+        self.stopped = []
+
+    def add(self, spec, start=True):
+        self.added.append(spec.replica_id
+                          if hasattr(spec, "replica_id") else spec)
+
+    def stop(self, replica_id=None, **kw):
+        self.stopped.append(replica_id)
+
+
+def _policy(**kw):
+    base = dict(min_replicas=1, max_replicas=3, high_watermark_s=1.0,
+                low_watermark_s=0.1, breach_rounds=2, cooldown_s=10.0,
+                startup_cost_s=0.5, interval_s=0.5)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def _register(registry, rid, *, model="m", ready=True, load=None,
+              layout=None, mode="predict"):
+    return registry.register({
+        "id": rid, "url": "http://%s.invalid" % rid, "model": model,
+        "version": "0", "mode": mode, "ready": ready,
+        "load": load or {}, "layout": layout})
+
+
+def _scaler(tmp_path=None, policy=None, clock=None, journal=False,
+            model="m"):
+    reg = ReplicaRegistry(heartbeat_timeout_s=3600.0,
+                          clock=clock or FakeClock())
+    router = Router(registry=reg)
+    if journal:
+        router.attach_journal(FleetJournal(str(tmp_path / "j"),
+                                           sync_every=1))
+    router.announce("http://127.0.0.1:0")
+    sup = FakeSupervisor()
+
+    def factory(rid):
+        from mxnet_tpu.fleet import ReplicaSpec
+        return ReplicaSpec(rid, ["true"])
+
+    sc = Autoscaler(router, sup, factory, model,
+                    policy=policy or _policy(),
+                    clock=clock or FakeClock())
+    return sc, router, sup
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+def test_policy_defaults_come_from_flags():
+    from mxnet_tpu.config import flags
+    pol = AutoscalePolicy()
+    assert pol.min_replicas == flags.autoscale_min_replicas
+    assert pol.max_replicas == flags.autoscale_max_replicas
+    assert pol.cooldown_s == flags.autoscale_cooldown_s
+    d = pol.to_dict()
+    assert d["high_watermark_s"] == flags.autoscale_high_watermark_s
+
+
+def test_policy_rejects_inverted_bounds():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=1)
+
+
+# ---------------------------------------------------------------------------
+# floor + warming capacity
+# ---------------------------------------------------------------------------
+
+def test_floor_launch_is_immediate_and_ungated():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock)
+    d = sc.step()
+    assert d["action"] == "scale_up"
+    assert d["reason"] == "below min_replicas"
+    assert sup.added == ["m-as1"]
+    assert "m-as1" in sc.owned
+
+
+def test_pending_launch_counts_as_capacity():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock)
+    sc.step()
+    # launch in flight: the floor must NOT double-launch
+    for _ in range(5):
+        clock.advance(0.5)
+        d = sc.step()
+        assert d["action"] == "steady", d
+    assert sup.added == ["m-as1"]
+
+
+def test_warming_replica_counts_as_capacity():
+    """A registered, ready=False replica is capacity-being-born; the
+    floor check must not storm launches through its warmup window."""
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock)
+    sc.step()
+    _register(router.registry, "m-as1", ready=False)   # warming
+    for _ in range(5):
+        clock.advance(0.5)
+        d = sc.step()
+        assert d["action"] == "steady", d
+    assert sup.added == ["m-as1"]
+
+
+def test_expired_launch_is_retried():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock)
+    sc.step()
+    clock.advance(sc.policy.launch_timeout_s + 1.0)    # never registered
+    d = sc.step()
+    assert d["action"] == "scale_up"
+    assert sup.added == ["m-as1", "m-as2"]
+    assert "m-as1" not in sc.owned
+
+
+# ---------------------------------------------------------------------------
+# scale-up: hysteresis + break-even
+# ---------------------------------------------------------------------------
+
+def _pressurize(router, rid="m-as1", load_s=5.0):
+    _register(router.registry, rid, ready=True,
+              load={"load_s": load_s, "queue_depth": 9, "unit_s": 0.1})
+
+
+def test_scale_up_needs_sustained_breach():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock,
+                              policy=_policy(cooldown_s=0.0))
+    sc.step()
+    _pressurize(router)
+    d = sc.step(clock.advance(0.5))            # breach round 1
+    assert d["action"] == "steady"
+    d = sc.step(clock.advance(0.5))            # breach round 2 -> act
+    assert d["action"] == "scale_up"
+    assert "beats startup" in d["reason"]
+    assert sup.added == ["m-as1", "m-as2"]
+
+
+def test_break_even_holds_marginal_gains():
+    clock = FakeClock()
+    sc, router, sup = _scaler(
+        clock=clock,
+        policy=_policy(cooldown_s=0.0, startup_cost_s=100.0))
+    sc.step()
+    _pressurize(router, load_s=5.0)    # gain 5/1 - 5/2 = 2.5s < 100s
+    sc.step(clock.advance(0.5))
+    d = sc.step(clock.advance(0.5))
+    assert d["action"] == "held:break_even"
+    assert d["wanted"] == "scale_up"
+    assert sup.added == ["m-as1"]
+
+
+def test_cooldown_suppresses_and_journals():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock)    # cooldown 10s
+    sc.step()                                  # floor launch (action t)
+    _pressurize(router)
+    sc.step(clock.advance(0.5))
+    d = sc.step(clock.advance(0.5))
+    assert d["action"] == "held:cooldown"
+    assert d["wanted"] == "scale_up"
+    # cooldown elapsed: the sustained breach may now act
+    d = sc.step(clock.advance(sc.policy.cooldown_s + 1.0))
+    assert d["action"] == "scale_up"
+
+
+def test_max_replicas_caps_scale_up():
+    clock = FakeClock()
+    sc, router, sup = _scaler(
+        clock=clock, policy=_policy(max_replicas=1, cooldown_s=0.0))
+    sc.step()
+    _pressurize(router)
+    for _ in range(4):
+        d = sc.step(clock.advance(0.5))
+        assert d["action"] == "steady", d
+    assert sup.added == ["m-as1"]
+
+
+# ---------------------------------------------------------------------------
+# scale-down: drain, then reap once idle
+# ---------------------------------------------------------------------------
+
+def _two_replica_fleet(clock):
+    sc, router, sup = _scaler(clock=clock,
+                              policy=_policy(cooldown_s=0.0))
+    sc.step()
+    _register(router.registry, "m-as1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0})
+    sc.owned.add("m-as2")
+    _register(router.registry, "m-as2", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0})
+    return sc, router, sup
+
+
+def test_scale_down_drains_least_loaded_then_reaps():
+    clock = FakeClock()
+    sc, router, sup = _two_replica_fleet(clock)
+    router.registry.heartbeat("m-as1", load={"load_s": 0.01,
+                                             "queue_depth": 1})
+    sc.step(clock.advance(0.5))                # low breach 1
+    d = sc.step(clock.advance(0.5))            # low breach 2 -> drain
+    assert d["action"] == "scale_down"
+    assert d["replica"] == "m-as2"             # the idle one
+    rep = router.registry.get("m-as2")
+    assert rep.draining
+    assert sup.stopped == []                   # drained, NOT killed
+    # still busy: one in-flight request defers the reap
+    router.registry.note_inflight("m-as2", +1)
+    sc.step(clock.advance(0.5))
+    assert sup.stopped == []
+    # idle now: reaped, ownership released
+    router.registry.note_inflight("m-as2", -1)
+    sc.step(clock.advance(0.5))
+    assert sup.stopped == ["m-as2"]
+    assert "m-as2" not in sc.owned
+
+
+def test_warming_replica_is_never_the_drain_victim():
+    """The launch/drain-storm regression: a freshly launched replica
+    reports no load (score 0) while warming, which made it the
+    least-loaded drain victim — the scaler killed every replica it
+    launched before it ever turned ready. Low-pressure readings from
+    an unsettled fleet must neither count toward the breach nor drain
+    a not-ready replica."""
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock,
+                              policy=_policy(cooldown_s=0.0))
+    sc.step()
+    _register(router.registry, "m-as1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0})
+    sc.owned.add("m-as2")
+    _register(router.registry, "m-as2", ready=False)   # warming
+    for _ in range(6):
+        d = sc.step(clock.advance(0.5))
+        assert d["action"] == "steady", d
+    assert not router.registry.get("m-as2").draining
+    assert sup.stopped == []
+    # once it settles, a sustained low breach may drain normally
+    router.registry.heartbeat("m-as2", ready=True,
+                              load={"load_s": 0.0, "queue_depth": 0})
+    d = sc.step(clock.advance(0.5))           # settled: breach 1 of 2
+    assert d["action"] == "steady"
+    d = sc.step(clock.advance(0.5))           # breach 2 -> drain
+    assert d["action"] == "scale_down"
+
+
+def test_scale_down_never_drops_below_min():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock,
+                              policy=_policy(cooldown_s=0.0))
+    sc.step()
+    _register(router.registry, "m-as1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0})
+    for _ in range(5):
+        d = sc.step(clock.advance(0.5))
+        assert d["action"] == "steady", d
+    assert not router.registry.get("m-as1").draining
+
+
+def test_scale_down_only_touches_owned_replicas():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock,
+                              policy=_policy(cooldown_s=0.0))
+    sc.step()
+    _register(router.registry, "m-as1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0})
+    # a second replica this scaler did NOT launch (operator-started)
+    _register(router.registry, "operator-1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0})
+    for _ in range(4):
+        sc.step(clock.advance(0.5))
+    # capacity > min and pressure is low, but the only candidates are
+    # owned — m-as1 (dropping it goes below min is fine: want_down
+    # checks capacity) — operator-1 must never be drained
+    assert not router.registry.get("operator-1").draining
+
+
+# ---------------------------------------------------------------------------
+# durability: WAL round-trip, restore, snapshot
+# ---------------------------------------------------------------------------
+
+def test_decisions_replay_through_the_wal(tmp_path):
+    clock = FakeClock()
+    sc, router, sup = _scaler(tmp_path, clock=clock, journal=True)
+    sc.step()                                  # scale_up journaled
+    st = replay(str(tmp_path / "j"))[0] if isinstance(
+        replay(str(tmp_path / "j")), tuple) else replay(
+            str(tmp_path / "j"))
+    # router-side reducer state matches the journal's
+    assert "m" in router.autoscale_state
+    rec = router.autoscale_state["m"]
+    assert rec["owned"] == ["m-as1"]
+    assert rec["last"]["action"] == "scale_up"
+
+
+def test_fleet_state_folds_autoscale_records():
+    st = FleetState()
+    st.apply(1, "autoscale", {"scaler": "m", "model": "m",
+                              "action": "scale_up", "seq": 1,
+                              "owned": ["m-as1"], "replica": "m-as1"})
+    st.apply(2, "autoscale", {"scaler": "m", "model": "m",
+                              "action": "held:cooldown", "seq": 2,
+                              "owned": ["m-as1"]})
+    assert st.autoscale["m"]["owned"] == ["m-as1"]
+    assert st.autoscale["m"]["last"]["action"] == "held:cooldown"
+    d = st.to_dict()
+    back = FleetState.from_dict(d)
+    assert back.autoscale == st.autoscale
+    # unknown kinds stay ignored (backward-safe journals)
+    back.apply(3, "a_future_kind", {"x": 1})
+
+
+def test_promoted_router_restores_scaler_state(tmp_path):
+    clock = FakeClock()
+    sc, router, sup = _scaler(tmp_path, clock=clock, journal=True)
+    sc.step()
+    router.journal.close()
+    promoted = Router.from_journal(str(tmp_path / "j"))
+    assert promoted.autoscale_state["m"]["owned"] == ["m-as1"]
+    snap = promoted.fleet_snapshot()
+    assert snap["autoscale"]["m"]["last"]["action"] == "scale_up"
+    # a fresh Autoscaler against the promoted router inherits its
+    # owned set (it may drain those replicas) and its sequence
+    sup2 = FakeSupervisor()
+    sc2 = Autoscaler(promoted, sup2, sc.spec_factory, "m",
+                     policy=_policy(), clock=clock)
+    assert sc2.owned == {"m-as1"}
+    assert sc2._seq >= 1
+
+
+def test_snapshot_shape():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock)
+    sc.step()
+    snap = sc.snapshot()
+    assert snap["scaler"] == "m"
+    assert snap["owned"] == ["m-as1"]
+    assert snap["pending"] == ["m-as1"]
+    assert snap["policy"]["min_replicas"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mixed-layout refusal
+# ---------------------------------------------------------------------------
+
+def _layout(fp):
+    return {"fingerprint": fp, "mesh": {"max_slots": 4}}
+
+
+def test_set_split_refuses_mixed_layouts():
+    reg = ReplicaRegistry(heartbeat_timeout_s=3600.0)
+    router = Router(registry=reg)
+    router.announce("http://127.0.0.1:0")
+    _register(reg, "a", model="g", mode="generate",
+              layout=_layout("aaaaaaaaaaaa"))
+    _register(reg, "b", model="g", mode="generate",
+              layout=_layout("bbbbbbbbbbbb"))
+    with pytest.raises(MXNetError, match="mixed parameter layouts"):
+        router.set_split("g", {"0": 1.0})
+
+
+def test_set_split_allows_agreeing_and_unknown_layouts():
+    reg = ReplicaRegistry(heartbeat_timeout_s=3600.0)
+    router = Router(registry=reg)
+    router.announce("http://127.0.0.1:0")
+    _register(reg, "a", model="g", mode="generate",
+              layout=_layout("aaaaaaaaaaaa"))
+    _register(reg, "b", model="g", mode="generate",
+              layout=_layout("aaaaaaaaaaaa"))
+    _register(reg, "c", model="g", mode="generate", layout=None)
+    router.set_split("g", {"0": 1.0})          # no raise
+    assert router.splits["g"] == {"0": 1.0}
+
+
+def test_start_canary_refuses_mixed_layouts():
+    reg = ReplicaRegistry(heartbeat_timeout_s=3600.0)
+    router = Router(registry=reg)
+    router.announce("http://127.0.0.1:0")
+    _register(reg, "a", model="g", mode="generate",
+              layout=_layout("aaaaaaaaaaaa"))
+    rep = reg.register({
+        "id": "b", "url": "http://b.invalid", "model": "g",
+        "version": "1", "mode": "generate", "ready": True,
+        "layout": _layout("bbbbbbbbbbbb")})
+    assert rep is not None
+    with pytest.raises(MXNetError, match="mixed parameter layouts"):
+        router.start_canary("g", "1", split=0.2)

@@ -583,3 +583,207 @@ def test_fit_resume_batch_skip_fallback_mid_epoch_bitwise(tmp_path,
         np.testing.assert_array_equal(
             baseline[k], resumed[k],
             err_msg="param %r diverged across batch-skip resume" % k)
+
+
+# ---------------------------------------------------- layout-manifest reshard
+
+def _save_world(root, world, state_fn, step=7, sharded=None, meta=None):
+    """Commit one step across ``world`` per-rank managers, the way a
+    real N-rank run does (each rank writes its own slice)."""
+    from mxnet_tpu.parallel.layout import LayoutManifest, shard_state
+    full = state_fn()
+    meta = dict(meta or {})
+    man = None
+    if sharded:
+        shapes = {k: list(np.shape(v)) for k, v in full.items()
+                  if not isinstance(v, (bytes, bytearray))}
+        man = LayoutManifest.build(shapes, world, sharded_axes=sharded)
+        meta["layout"] = man.to_dict()
+    for r in range(world):
+        cm = CheckpointManager(str(root), rank=r, world=world,
+                               async_save=False)
+        st = shard_state(full, man, r) if man is not None else dict(full)
+        cm.save(st, step, meta=meta, blocking=True)
+    return full
+
+
+def _demo_state(seed=3):
+    rng = np.random.RandomState(seed)
+    return {
+        "embed.weight": rng.randn(11, 4).astype(np.float32),
+        "dense.weight": rng.randn(4, 2).astype(np.float32),
+        "__opt__": b"opt-blob",
+        "__rng__": b"rng-blob",
+    }
+
+
+@pytest.mark.parametrize("new_world", [3, 5])
+def test_restore_resharded_across_world_sizes(tmp_path, new_world):
+    """Save at world 4, restore at N-k and N+k: every rank of the new
+    world sees exactly its manifest slice, blobs ride along."""
+    root = tmp_path / "ckpt"
+    full = _save_world(root, 4, _demo_state,
+                       sharded={"embed.weight": 0})
+    from mxnet_tpu.parallel.layout import LayoutManifest
+    gathered = {}
+    for r in range(new_world):
+        cm = CheckpointManager(str(root), rank=r, world=new_world,
+                               async_save=False)
+        state, manifest = cm.restore_resharded()
+        assert state is not None
+        assert manifest["world"] == new_world
+        assert manifest["meta"]["resharded_from"] == {"world": 4,
+                                                      "step": 7}
+        man = LayoutManifest.from_dict(manifest["meta"]["layout"])
+        start, stop = man.part_for("embed.weight", r)
+        np.testing.assert_array_equal(state["embed.weight"],
+                                      full["embed.weight"][start:stop])
+        np.testing.assert_array_equal(state["dense.weight"],
+                                      full["dense.weight"])
+        assert state["__opt__"] == b"opt-blob"
+        gathered[r] = state
+    # the union of the new shards is the old global state, bitwise
+    from mxnet_tpu.parallel.layout import gather_state
+    back = gather_state(gathered, man)
+    np.testing.assert_array_equal(back["embed.weight"],
+                                  full["embed.weight"])
+
+
+def test_restore_resharded_same_world_is_plain_restore(tmp_path):
+    root = tmp_path / "ckpt"
+    full = _save_world(root, 2, _demo_state)
+    cm = CheckpointManager(str(root), rank=1, world=2, async_save=False)
+    state, manifest = cm.restore_resharded()
+    assert "resharded_from" not in (manifest.get("meta") or {})
+    np.testing.assert_array_equal(state["dense.weight"],
+                                  full["dense.weight"])
+
+
+def test_restore_resharded_corrupt_layout_falls_back(tmp_path, caplog):
+    """A snapshot whose layout record is garbage still reshards: the
+    inferred all-replicated (DDP) layout is the fallback."""
+    import json as _json
+    root = tmp_path / "ckpt"
+    full = _save_world(root, 2, _demo_state)    # replicated layout
+    for r in range(2):
+        mpath = root / ("rank_%d" % r) / "ckpt-7.json"
+        man = _json.loads(mpath.read_text())
+        man["meta"]["layout"] = {"format": "mxtpu-layout",
+                                 "world": "NaN-garbage"}
+        mpath.write_text(_json.dumps(man))
+    cm = CheckpointManager(str(root), rank=0, world=3, async_save=False)
+    with caplog.at_level(logging.WARNING):
+        state, manifest = cm.restore_resharded()
+    assert state is not None
+    np.testing.assert_array_equal(state["embed.weight"],
+                                  full["embed.weight"])
+    assert any("layout" in r.message for r in caplog.records)
+
+
+def test_reshard_checkpoint_writes_sibling_root(tmp_path):
+    root = tmp_path / "ckpt"
+    full = _save_world(root, 4, _demo_state,
+                       sharded={"embed.weight": 0})
+    from mxnet_tpu.checkpoint import reshard_checkpoint
+    report = reshard_checkpoint(str(root), 3)
+    assert report["old_world"] == 4
+    assert report["new_world"] == 3
+    assert report["dst"] == str(root) + "-w3"
+    assert report["step"] == 7
+    # the destination restores natively at world 3
+    for r in range(3):
+        cm = CheckpointManager(report["dst"], rank=r, world=3,
+                               async_save=False)
+        state, manifest = cm.restore()
+        assert state is not None
+        assert manifest["world"] == 3
+    # and the source root is untouched (still 4 rank dirs)
+    from mxnet_tpu.checkpoint import _rank_dirs
+    assert sorted(_rank_dirs(str(root))) == [0, 1, 2, 3]
+
+
+def test_reshard_checkpoint_refuses_empty_root(tmp_path):
+    from mxnet_tpu.checkpoint import reshard_checkpoint
+    with pytest.raises(ValueError):
+        reshard_checkpoint(str(tmp_path / "nope"), 2)
+
+
+def test_kill_resume_bitwise_after_reshard(tmp_path):
+    """The elastic-resume contract end to end, in-process: a 4-rank
+    'run' checkpoints mid-training, the resume happens on 3 ranks via
+    manifest resharding, and the final params are bitwise-identical to
+    an uninterrupted reference run at the new world. The trainer is a
+    deterministic numpy loop with a row-sharded embedding (each rank
+    updates only its manifest slice) and a replicated dense layer
+    (DDP-style identical updates everywhere)."""
+    from mxnet_tpu.parallel.layout import (LayoutManifest, gather_state,
+                                           shard_state)
+
+    def init_full():
+        rng = np.random.RandomState(0)
+        return {
+            "embed.weight": rng.randn(10, 3).astype(np.float32),
+            "dense.weight": rng.randn(3, 3).astype(np.float32),
+        }
+
+    def manifest_for(full, world):
+        shapes = {k: list(v.shape) for k, v in full.items()}
+        return LayoutManifest.build(shapes, world,
+                                    sharded_axes={"embed.weight": 0})
+
+    def train_steps(shards, man, steps, start_step):
+        """Per-rank updates, deterministic in (step, global row id) —
+        world-size invariant by construction, like a fixed global
+        batch."""
+        for k in range(start_step, start_step + steps):
+            for r, st in shards.items():
+                lo, _hi = man.part_for("embed.weight", r)
+                emb = st["embed.weight"]
+                rows = np.arange(emb.shape[0], dtype=np.float32)
+                grad = np.outer(np.sin(rows + lo + k),
+                                np.ones(emb.shape[1],
+                                        dtype=np.float32))
+                st["embed.weight"] = emb - 0.01 * grad.astype(np.float32)
+                st["dense.weight"] = (st["dense.weight"]
+                                      - 0.01 * np.float32(np.cos(k)))
+        return shards
+
+    TOTAL, KILL = 6, 3
+
+    # reference: uninterrupted run at the NEW world (3 ranks)
+    full = init_full()
+    man3 = manifest_for(full, 3)
+    ref = {r: shard_state(full, man3, r) for r in range(3)}
+    train_steps(ref, man3, TOTAL, 0)
+    ref_full = gather_state(ref, man3)
+
+    # interrupted run: 4 ranks, killed after KILL steps (checkpoint
+    # committed), resumed at 3 ranks via restore_resharded
+    full = init_full()
+    man4 = manifest_for(full, 4)
+    shards = {r: shard_state(full, man4, r) for r in range(4)}
+    train_steps(shards, man4, KILL, 0)
+    root = tmp_path / "ckpt4"
+    for r in range(4):
+        cm = CheckpointManager(str(root), rank=r, world=4,
+                               async_save=False)
+        cm.save(shards[r], KILL,
+                meta={"layout": man4.to_dict()}, blocking=True)
+    # ranks die here; a 3-rank incarnation resumes
+    resumed = {}
+    for r in range(3):
+        cm = CheckpointManager(str(root), rank=r, world=3,
+                               async_save=False)
+        state, manifest = cm.restore_resharded()
+        assert manifest["meta"]["resharded_from"]["world"] == 4
+        resumed[r] = state
+    man3b = LayoutManifest.from_dict(
+        manifest["meta"]["layout"])
+    train_steps(resumed, man3b, TOTAL - KILL, KILL)
+    resumed_full = gather_state(resumed, man3b)
+
+    for k in ref_full:
+        np.testing.assert_array_equal(
+            ref_full[k], resumed_full[k],
+            err_msg="param %r diverged across the 4->3 elastic "
+                    "resume" % k)

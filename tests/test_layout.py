@@ -1,0 +1,200 @@
+"""Layout manifests (mxnet_tpu.parallel.layout): the versioned
+param -> shard map behind elastic resume and artifact resharding.
+
+Acceptance properties: (1) `partition` tiles any axis near-evenly and
+exactly; (2) a manifest round-trips through dict form with a stable
+fingerprint, and the fingerprint moves when world/mesh/entries move;
+(3) shard -> gather is the identity at any world; (4) `reshard_states`
+re-slices a sharded axis 4 -> 3 and 4 -> 6 bitwise, carries the
+replicated optimizer/RNG blobs, and drops the world-fingerprinted data
+cursors; (5) malformed manifests are refused with a clear error.
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel.layout import (LayoutManifest, gather_state,
+                                       infer_manifest, partition,
+                                       reshard_states, shard_state)
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "embed.weight": rng.randn(13, 4).astype(np.float32),
+        "dense.weight": rng.randn(4, 4).astype(np.float32),
+        "dense.bias": rng.randn(4).astype(np.float32),
+    }
+
+
+def _manifest(state, world, sharded=("embed.weight",)):
+    shapes = {k: list(v.shape) for k, v in state.items()}
+    return LayoutManifest.build(
+        shapes, world, sharded_axes={k: 0 for k in sharded})
+
+
+# ---------------------------------------------------------------------------
+# partition + manifest basics
+# ---------------------------------------------------------------------------
+
+def test_partition_covers_exactly():
+    for n in (1, 3, 7, 13, 64):
+        for world in (1, 2, 3, 5, 8):
+            parts = partition(n, world)
+            assert len(parts) == world
+            # contiguous, ordered, exact cover
+            cursor = 0
+            for start, stop in parts:
+                assert start == cursor
+                assert stop >= start
+                cursor = stop
+            assert cursor == n
+            sizes = [stop - start for start, stop in parts]
+            assert max(sizes) - min(sizes) <= 1
+
+
+def test_partition_refuses_world_zero():
+    with pytest.raises(ValueError):
+        partition(4, 0)
+
+
+def test_manifest_round_trip_and_fingerprint_stability():
+    st = _state()
+    m = _manifest(st, 4)
+    d = m.to_dict()
+    back = LayoutManifest.from_dict(d)
+    assert back.world == 4
+    assert back.fingerprint() == m.fingerprint()
+    assert back.to_dict() == d
+    # fingerprints are content-addressed: same inputs, same id
+    assert _manifest(_state(), 4).fingerprint() == m.fingerprint()
+
+
+def test_fingerprint_moves_with_world_mesh_and_entries():
+    st = _state()
+    base = _manifest(st, 4).fingerprint()
+    assert _manifest(st, 3).fingerprint() != base
+    shapes = {k: list(v.shape) for k, v in st.items()}
+    meshed = LayoutManifest.build(shapes, 4,
+                                  sharded_axes={"embed.weight": 0},
+                                  mesh={"max_slots": 8})
+    assert meshed.fingerprint() != base
+    fewer = {k: v for k, v in st.items() if k != "dense.bias"}
+    assert _manifest(fewer, 4).fingerprint() != base
+
+
+def test_infer_manifest_defaults_to_replicated():
+    st = _state()
+    st["__opt__"] = b"opaque"
+    m = infer_manifest(st, 3)
+    assert m.world == 3
+    assert "__opt__" not in m.entries        # blobs are not layout
+    for key in ("embed.weight", "dense.weight", "dense.bias"):
+        assert m.entries[key]["kind"] == "replicated"
+
+
+# ---------------------------------------------------------------------------
+# shard -> gather identity, resharding
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("world", [1, 2, 3, 5])
+def test_shard_then_gather_is_identity(world):
+    st = _state()
+    m = _manifest(st, world)
+    shards = {rank: shard_state(st, m, rank) for rank in range(world)}
+    back = gather_state(shards, m)
+    for k, v in st.items():
+        assert np.array_equal(back[k], v), k
+
+
+@pytest.mark.parametrize("new_world", [1, 3, 6])
+def test_reshard_states_bitwise(new_world):
+    st = _state()
+    old = _manifest(st, 4)
+    per_rank = {r: shard_state(st, old, r) for r in range(4)}
+    new_states, new_m = reshard_states(per_rank, old, new_world)
+    assert new_m.world == new_world
+    assert sorted(new_states) == list(range(new_world))
+    if new_world != 4:
+        assert new_m.fingerprint() != old.fingerprint()
+    back = gather_state(new_states, new_m)
+    for k, v in st.items():
+        assert np.array_equal(back[k], v), k
+
+
+def test_reshard_carries_blobs_and_drops_cursors():
+    st = _state()
+    old = _manifest(st, 2)
+    per_rank = {}
+    for r in range(2):
+        s = shard_state(st, old, r)
+        s["__opt__"] = b"\x07optstate"
+        s["__rng__"] = b"\x01\x02"
+        s["__data_cursor__"] = b"rank-fingerprinted"
+        per_rank[r] = s
+    new_states, _ = reshard_states(per_rank, old, 3)
+    for s in new_states.values():
+        # optimizer/RNG are world-invariant under DDP: carried to all
+        assert s["__opt__"] == b"\x07optstate"
+        assert s["__rng__"] == b"\x01\x02"
+        # cursors are (rank, world)-fingerprinted: a resharded run must
+        # rebuild them, never inherit a stale one
+        assert "__data_cursor__" not in s
+
+
+def test_gather_missing_rank_raises():
+    st = _state()
+    m = _manifest(st, 3)
+    shards = {r: shard_state(st, m, r) for r in (0, 2)}   # rank 1 gone
+    with pytest.raises(KeyError):
+        gather_state(shards, m)
+
+
+def test_part_for_and_shard_array():
+    st = _state()
+    m = _manifest(st, 3)
+    whole = st["embed.weight"]
+    rows = 0
+    for rank in range(3):
+        start, stop = m.part_for("embed.weight", rank)
+        piece = m.shard_array("embed.weight", rank, whole)
+        assert np.array_equal(piece, whole[start:stop])
+        rows += stop - start
+    assert rows == whole.shape[0]
+    # replicated keys span the whole leading axis
+    assert m.part_for("dense.bias", 2) == (0, 4)
+
+
+# ---------------------------------------------------------------------------
+# validation + telemetry
+# ---------------------------------------------------------------------------
+
+def test_validate_refuses_malformed_manifests():
+    st = _state()
+    m = _manifest(st, 2)
+    d = m.to_dict()
+    d["entries"]["embed.weight"]["kind"] = "diagonal"
+    with pytest.raises(ValueError):
+        LayoutManifest.from_dict(d)
+    d2 = m.to_dict()
+    # parts that no longer tile the axis
+    d2["entries"]["embed.weight"]["parts"][-1][2] -= 1
+    with pytest.raises(ValueError):
+        LayoutManifest.from_dict(d2)
+    with pytest.raises(ValueError):
+        LayoutManifest.from_dict({"format": "something-else"})
+
+
+def test_reshard_publishes_telemetry():
+    from mxnet_tpu import telemetry
+    st = _state()
+    m = _manifest(st, 2)
+    per_rank = {r: shard_state(st, m, r) for r in range(2)}
+    c = telemetry.counter("layout/reshards_total",
+                          "State resharding operations "
+                          "(checkpoint or artifact)")
+    before = c.value()
+    reshard_states(per_rank, m, 3)
+    assert c.value() == before + 1
+    g = telemetry.gauge("layout/last_world",
+                        "World size the last reshard targeted")
+    assert g.value() == 3

@@ -660,3 +660,61 @@ def test_gluon_converter_matches_decode_model_structure(params):
     assert set(params) == names
     for k, v in params.items():
         assert v.dtype == np.float32, k
+
+
+# ---------------------------------------------------------------------------
+# artifact resharding: re-target the inference mesh, tokens stay bitwise
+# ---------------------------------------------------------------------------
+
+def test_reshard_artifact_serves_bitwise_equal_tokens(art, tmp_path,
+                                                      params):
+    """`serving.reshard_artifact` re-targets a generate export to a
+    different decode mesh (slots / KV page pool) without touching any
+    checkpoint. Position-keyed sampling means the served tokens must be
+    bitwise-identical on the old and new mesh — cache geometry is a
+    throughput knob, never a numerics knob."""
+    dst = str(tmp_path / "resharded.gen.mxtpu")
+    old_layout = serving.artifact_layout(art)
+    assert old_layout is not None
+    report = serving.reshard_artifact(art, dst, max_slots=8,
+                                      num_pages=65)
+    assert report["new_mesh"]["max_slots"] == 8
+    assert report["new_mesh"]["num_pages"] == 65
+    new_layout = serving.artifact_layout(dst)
+    assert new_layout["fingerprint"] != old_layout["fingerprint"]
+
+    work = [([5, 9, 13], 12, 0.8, 100), ([2, 3], 6, 0.8, 101),
+            ([11, 60, 1, 2, 3], 10, 0.0, 0)]
+    src_srv, dst_srv = Server(art), Server(dst)
+    try:
+        for prompt, n, temp, seed in work:
+            a = src_srv.generate(prompt, max_new_tokens=n,
+                                 temperature=temp, seed=seed)
+            b = dst_srv.generate(prompt, max_new_tokens=n,
+                                 temperature=temp, seed=seed)
+            assert list(a["tokens"]) == list(b["tokens"]), \
+                "tokens diverged across the mesh reshard"
+    finally:
+        src_srv.close()
+        dst_srv.close()
+
+
+def test_reshard_artifact_refuses_context_growth(art, tmp_path):
+    """The positional sampling table has exactly the old max_context
+    rows; a mesh whose page budget would GROW max_context cannot be
+    served bitwise and must be refused."""
+    dst = str(tmp_path / "grown.gen.mxtpu")
+    with pytest.raises(MXNetError, match="max_context"):
+        serving.reshard_artifact(art, dst, page_size=16,
+                                 max_pages_per_slot=64)
+
+
+def test_reshard_artifact_needs_bundled_params(tmp_path, params):
+    path = str(tmp_path / "lean.gen.mxtpu")
+    serving.export_generate(params, SPEC, path, bundle_params=False)
+    # the layout record is still there (the mesh exists either way)…
+    assert serving.artifact_layout(path) is not None
+    # …but without bundled weights the artifact is welded to it
+    with pytest.raises(MXNetError, match="bundle"):
+        serving.reshard_artifact(path, str(tmp_path / "out.mxtpu"),
+                                 max_slots=8)

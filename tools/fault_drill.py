@@ -963,6 +963,352 @@ def disk_loss_drill(args):
             shutil.rmtree(work, ignore_errors=True)
 
 
+def autoscale_drill(args):
+    """The elastic-fleet leg: a journaled router running two
+    Autoscalers (predict + generate models), no replicas started by
+    hand. The scalers must launch the min-replica floor themselves, a
+    loadgen spike must scale predict 1->3 with p99 recovering after
+    the scale-out, the load drop must drain back to 1 with zero
+    dropped in-flight requests, long decode sessions must ride the
+    generate scaler's drain bitwise, and the journaled decisions must
+    replay into a restarted router's snapshot."""
+    import threading
+    import time
+
+    sys.path.insert(0, ROOT)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import socket
+
+    import serve_loadgen
+
+    GEN_SESSIONS = 10
+    MAX_NEW, TEMP = 20, 0.7
+
+    work = tempfile.mkdtemp(prefix="mxtpu_autoscale_drill_")
+    jdir = os.path.join(work, "journal")
+    logs = os.path.join(work, "logs")
+    os.makedirs(jdir, exist_ok=True)
+    os.makedirs(logs, exist_ok=True)
+    ok = False
+    router = revived = None
+    try:
+        predict_art = os.path.join(work, "predict.mxtpu")
+        gen_art = os.path.join(work, "generate.mxtpu")
+        print("fault_drill: [autoscale] building artifacts...")
+        spec = _build_fleet_artifacts(predict_art, gen_art)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_FAULT_INJECT", None)
+        env.pop("MXNET_TELEMETRY_DIR", None)
+        env["MXNET_FLEET_HEARTBEAT_S"] = "0.3"
+        env["MXNET_FLEET_HEARTBEAT_TIMEOUT_S"] = "2.5"
+        # the CPU stand-in model finishes in microseconds, so on a
+        # small drill host every replica shares the same saturated
+        # core and scale-out cannot move latency. Simulated device
+        # occupancy (a GIL-released sleep inside the timed dispatch
+        # window) makes predict service time accelerator-like: 3
+        # replicas really are 3x the capacity of 1
+        env["MXNET_SERVE_SIM_BATCH_S"] = "0.04"
+
+        # both router incarnations (failover-replay check) serve the
+        # same address, so pick a free port up front
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        router_url = "http://127.0.0.1:%d" % port
+
+        serve_argv = ("%s %s --artifact %%s --port 0 "
+                      "--register {register_url} "
+                      "--replica-id {replica_id} --model-name %%s"
+                      % (sys.executable, SERVE))
+        # watermarks sit on the perfmodel-derived load_s scale each
+        # mode reports: predict load_s is queue x observed row time
+        # (row time ~= the 40ms simulated batch under --buckets 1, so
+        # a 16-way closed-loop spike shows ~0.6s of queue per replica,
+        # ~0 when idle), generate load_s is the decode session queue's
+        # retry-after (real seconds). startup_cost is small: these
+        # replicas warm in seconds and the drill WANTS eager scale-out.
+        predict_scaler = {
+            "model": "pm", "min": 1, "max": 3,
+            "high_watermark_s": 0.15, "low_watermark_s": 0.02,
+            "breach_rounds": 2, "cooldown_s": 1.5,
+            "startup_cost_s": 0.01, "interval_s": 0.3,
+            "log_dir": logs,
+            "argv": serve_argv % (predict_art, "pm") + " --buckets 1",
+        }
+        gen_scaler = {
+            "model": "gm", "min": 1, "max": 2,
+            "high_watermark_s": 0.02, "low_watermark_s": 1e-4,
+            "breach_rounds": 2, "cooldown_s": 1.5,
+            "startup_cost_s": 1e-4, "interval_s": 0.3,
+            "log_dir": logs,
+            "argv": serve_argv % (gen_art, "gm"),
+        }
+        route_cmd = [sys.executable, ROUTE, "--port", str(port),
+                     "--journal", jdir, "--hop-tokens", "4",
+                     "--heartbeat-timeout-s", "2.5",
+                     "--autoscale", json.dumps(predict_scaler),
+                     "--autoscale", json.dumps(gen_scaler)]
+        router = subprocess.Popen(
+            route_cmd, stdout=subprocess.PIPE,
+            stderr=open(os.path.join(logs, "router.log"), "w"),
+            text=True, env=env, cwd=ROOT)
+        json.loads(router.stdout.readline())   # routing banner
+        json.loads(router.stdout.readline())   # autoscale banner
+        print("fault_drill: [autoscale] router at %s" % router_url)
+
+        def predict_counts(snap):
+            reps = [r for r in snap.get("replicas", [])
+                    if r.get("model") == "pm" and not r.get("dead")]
+            in_rot = [r for r in reps
+                      if r.get("ready") and not r.get("draining")]
+            return len(reps), len(in_rot)
+
+        # the scalers must bring up the min floor on their own
+        print("fault_drill: [autoscale] waiting for the min-replica "
+              "floor (1 predict + 1 generate)...")
+        _wait_ready(router_url, 2)
+        snap = _fleet_get(router_url, "/fleet")
+        if "autoscale" not in snap:
+            print("fault_drill: FAIL — /fleet has no autoscale section")
+            return 1
+
+        # reference decode pass: 10 uninterrupted sessions on the
+        # 1-replica fleet; position-keyed sampling makes these the
+        # bitwise target for the drain phase
+        import numpy as np
+        rng = np.random.RandomState(23)
+        prompts = [rng.randint(2, spec.vocab, size=4).tolist()
+                   for _ in range(GEN_SESSIONS)]
+        reference = []
+        for i, prompt in enumerate(prompts):
+            outc, out, _, _ = serve_loadgen._http_generate_session(
+                router_url, prompt, MAX_NEW, TEMP, 100 + i, None,
+                retries=4, resume_evicted=5, conn_retries=2)
+            if outc != "ok":
+                print("fault_drill: FAIL — reference session %d did "
+                      "not complete (%s)" % (i, outc))
+                return 1
+            reference.append(list(out["tokens"]))
+
+        # ---- spike: scale-out + p99 recovery -------------------------------
+        # waves of the loadgen spike profile's peak load until the
+        # scaler reaches 3 in-rotation predict replicas
+        print("fault_drill: [autoscale] spiking predict load...")
+        spike_stats = {"p99s": [], "completed": 0, "attempted": 0,
+                       "errors": 0}
+        spike_done = threading.Event()
+
+        def spike_load():
+            while not spike_done.is_set():
+                r = serve_loadgen.measure(
+                    router_url, concurrency=16, requests=160,
+                    retries=4, conn_retries=6, shape=(1, 6))
+                spike_stats["p99s"].append(
+                    (r.get("latency_ms") or {}).get("p99") or 0.0)
+                for k in ("completed", "attempted", "errors"):
+                    spike_stats[k] += int(r.get(k) or 0)
+
+        spike_thread = threading.Thread(target=spike_load)
+        t0 = time.monotonic()
+        spike_thread.start()
+        scaled = False
+        while time.monotonic() - t0 < 150.0:
+            try:
+                snap = _fleet_get(router_url, "/fleet")
+            except Exception:
+                snap = {}
+            _, in_rot = predict_counts(snap)
+            if in_rot >= 3:
+                scaled = True
+                break
+            time.sleep(0.3)
+        t_scaled = time.monotonic() - t0
+        if not scaled:
+            spike_done.set()
+            spike_thread.join(120)
+            print("fault_drill: FAIL — spike never scaled predict to 3 "
+                  "in-rotation replicas (last: %s)"
+                  % (snap.get("autoscale")))
+            return 1
+        print("fault_drill: [autoscale] 1->3 predict replicas at "
+              "+%.1fs" % t_scaled)
+        # the wave in flight at detection straddles both fleet sizes;
+        # recovery p99 comes from waves that START after scale-out, at
+        # the SAME 16-way offered load the 1-replica fleet peaked under
+        waves_at_scale = len(spike_stats["p99s"]) + 1
+        t_rec = time.monotonic()
+        while (len(spike_stats["p99s"]) < waves_at_scale + 2
+               and time.monotonic() - t_rec < 120.0):
+            time.sleep(0.5)
+        spike_done.set()
+        spike_thread.join(120)
+        p99_peak = max(spike_stats["p99s"][:waves_at_scale] or [0.0])
+        post = spike_stats["p99s"][waves_at_scale:]
+        p99_rec = min(post) if post else float("inf")
+        rec = {"errors": 0}
+        # deadline: the scaled-out p99 must land well under the
+        # single-replica spike peak (generous for CI-class CPUs)
+        deadline_ms = max(0.75 * p99_peak, 100.0)
+        failures = []
+        if p99_rec > deadline_ms:
+            failures.append(
+                "p99 did not recover after scale-out: %.1fms peak -> "
+                "%.1fms (deadline %.1fms)"
+                % (p99_peak, p99_rec, deadline_ms))
+        # rejections under the 1-replica overload are backpressure, not
+        # drops; hard errors are never acceptable
+        if spike_stats["errors"] or rec.get("errors"):
+            failures.append(
+                "spike saw hard errors: %s + recovery %s"
+                % (spike_stats, {k: rec.get(k) for k in
+                                 ("attempted", "completed", "errors")}))
+
+        # ---- decode sessions across the generate scaler ---------------------
+        # the 10 long sessions overload 1 gen replica (4 slots) ->
+        # scale-out to 2; as sessions finish, pressure drops -> the
+        # scaler drains one replica mid-flight and its sessions
+        # migrate via their cursors — bitwise
+        print("fault_drill: [autoscale] decode sessions through "
+              "scale-out + drain...")
+        gen_results = [None] * GEN_SESSIONS
+        next_gen = [0]
+        glock = threading.Lock()
+
+        def generate_load():
+            while True:
+                with glock:
+                    if next_gen[0] >= GEN_SESSIONS:
+                        return
+                    i = next_gen[0]
+                    next_gen[0] += 1
+                gen_results[i] = serve_loadgen._http_generate_session(
+                    router_url, prompts[i], MAX_NEW, TEMP, 100 + i,
+                    None, retries=6, resume_evicted=5, conn_retries=6)
+
+        gen_threads = [threading.Thread(target=generate_load)
+                       for _ in range(8)]
+        for t in gen_threads:
+            t.start()
+        for t in gen_threads:
+            t.join(600)
+        done = sum(1 for r in gen_results
+                   if r is not None and r[0] == "ok")
+        bitwise = sum(1 for i, r in enumerate(gen_results)
+                      if r is not None and r[0] == "ok"
+                      and list(r[1]["tokens"]) == reference[i])
+        if done != GEN_SESSIONS:
+            failures.append("decode sessions lost under autoscaling: "
+                            "%d/%d completed" % (done, GEN_SESSIONS))
+        elif bitwise != GEN_SESSIONS:
+            failures.append("decode sessions diverged: only %d/%d "
+                            "bitwise vs the 1-replica reference"
+                            % (bitwise, GEN_SESSIONS))
+
+        # ---- drain back to the floor ---------------------------------------
+        # idle fleet: both scalers must shed down to min under a light
+        # trickle that must not drop a single request
+        print("fault_drill: [autoscale] waiting for drain back to "
+              "1 predict replica...")
+        drained = False
+        trickle = {"attempted": 0, "completed": 0}
+        deadline = time.monotonic() + 150.0
+        while time.monotonic() < deadline:
+            r = serve_loadgen.measure(router_url, concurrency=1,
+                                      requests=6, retries=4,
+                                      conn_retries=6, shape=(1, 6))
+            trickle["attempted"] += int(r.get("attempted") or 0)
+            trickle["completed"] += int(r.get("completed") or 0)
+            snap = _fleet_get(router_url, "/fleet")
+            total, in_rot = predict_counts(snap)
+            if total == 1 and in_rot == 1:
+                drained = True
+                break
+            time.sleep(0.5)
+        if not drained:
+            failures.append("fleet never drained back to 1 predict "
+                            "replica (autoscale: %s)"
+                            % snap.get("autoscale"))
+        if trickle["completed"] != trickle["attempted"]:
+            failures.append("requests dropped during the drain: %s"
+                            % trickle)
+
+        # the journal must hold the decision trail
+        autoscale_snap = snap.get("autoscale") or {}
+        for scaler in ("pm", "gm"):
+            rec_s = autoscale_snap.get(scaler) or {}
+            if not rec_s.get("last"):
+                failures.append("no journaled decisions for scaler %r: "
+                                "%s" % (scaler, autoscale_snap))
+
+        # ---- failover: decisions replay from the journal --------------------
+        print("fault_drill: [autoscale] restarting the router from "
+              "the journal...")
+        router.terminate()      # graceful: final compact + lease release
+        try:
+            router.wait(30)
+        except subprocess.TimeoutExpired:
+            router.kill()
+            router.wait(10)
+        revived = subprocess.Popen(
+            [sys.executable, ROUTE, "--port", str(port),
+             "--journal", jdir, "--force-primary"],
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(logs, "router2.log"), "w"),
+            text=True, env=env, cwd=ROOT)
+        json.loads(revived.stdout.readline())
+        replay_snap = {}
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                replay_snap = _fleet_get(router_url, "/fleet")
+                break
+            except Exception:
+                time.sleep(0.25)
+        replayed = replay_snap.get("autoscale") or {}
+        for scaler in ("pm", "gm"):
+            rep_s = replayed.get(scaler) or {}
+            if not rep_s.get("last"):
+                failures.append("scaler %r state did not replay into "
+                                "the restarted router: %s"
+                                % (scaler, sorted(replayed)))
+
+        if failures:
+            for f in failures:
+                print("fault_drill: FAIL — %s" % f)
+            return 1
+        print("fault_drill: [autoscale] PASS %s" % json.dumps({
+            "scale_out_s": round(t_scaled, 1),
+            "p99_peak_ms": round(p99_peak, 1),
+            "p99_recovered_ms": round(p99_rec, 1),
+            "spike_completed": spike_stats["completed"]
+                               + rec.get("completed", 0),
+            "decode_bitwise": "%d/%d" % (bitwise, GEN_SESSIONS),
+            "drained_to": 1,
+            "decisions": {k: (v.get("last") or {}).get("action")
+                          for k, v in replayed.items()},
+        }))
+        ok = True
+        return 0
+    finally:
+        for proc in (router, revived):
+            if proc is None:
+                continue
+            proc.terminate()
+            try:
+                proc.wait(30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if args.keep or not ok:
+            print("fault_drill: scratch kept at %s" % work)
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("-n", "--num-workers", type=int, default=2)
@@ -981,6 +1327,14 @@ def main(argv=None):
                          "a --replicate-from standby promotes from its "
                          "own replicated WAL, sessions finish bitwise, "
                          "acked control ops survive")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the elastic-fleet drill: Autoscalers "
+                         "launch the replica floor, a loadgen spike "
+                         "scales predict 1->3 with p99 recovering, the "
+                         "load drop drains back to 1 with zero dropped "
+                         "in-flight requests, decode sessions ride the "
+                         "generate drain bitwise, and the journaled "
+                         "decisions replay after a router restart")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch directory for forensics")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -993,6 +1347,8 @@ def main(argv=None):
         return router_ha_drill(args)
     if args.disk_loss:
         return disk_loss_drill(args)
+    if args.autoscale:
+        return autoscale_drill(args)
 
     work = tempfile.mkdtemp(prefix="mxtpu_fault_drill_")
     base_dump = os.path.join(work, "baseline.npz")

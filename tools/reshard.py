@@ -1,0 +1,89 @@
+"""Reshard a checkpoint or a serving artifact to a new world/mesh.
+
+Checkpoint -> checkpoint (elastic resume: a run killed at world N
+resumes at world N-k or N+k, bitwise):
+
+    python tools/reshard.py --checkpoint /ckpt/run1 --world 3 \
+        [--dst /ckpt/run1-w3] [--step 1200]
+
+Artifact -> artifact (re-target a generate ``.mxtpu`` export to a
+different inference mesh without touching the checkpoint; served
+tokens stay bitwise-equal — sampling folds (seed, position), never
+cache geometry):
+
+    python tools/reshard.py --artifact model.mxtpu --dst model-8s.mxtpu \
+        --max-slots 8 --num-pages 65 [--page-size P] \
+        [--max-pages-per-slot K]
+
+Both paths go through the layout manifest
+(mxnet_tpu/parallel/layout.py): gather every parameter from the old
+layout, re-slice per the new one, stamp the new manifest + fingerprint.
+Prints a one-line JSON report; exit 0 on success.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--checkpoint", metavar="ROOT",
+                   help="CheckpointManager root (rank_* subdirs) to "
+                        "reshard to --world ranks")
+    g.add_argument("--artifact", metavar="SRC.mxtpu",
+                   help="generate artifact to re-target to a new "
+                        "inference mesh (needs bundled weights)")
+    p.add_argument("--world", type=int, default=None,
+                   help="target world size (checkpoint mode)")
+    p.add_argument("--dst", default=None,
+                   help="destination root/path (checkpoint default: "
+                        "<ROOT>-w<WORLD>; required for --artifact)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step to reshard (default: newest "
+                        "step committed by every rank)")
+    p.add_argument("--max-slots", type=int, default=None,
+                   help="new decode slot count (artifact mode)")
+    p.add_argument("--num-pages", type=int, default=None,
+                   help="new KV page-pool size (artifact mode)")
+    p.add_argument("--max-pages-per-slot", type=int, default=None,
+                   help="new per-slot page cap (artifact mode; "
+                        "page_size * max_pages_per_slot may shrink "
+                        "max_context but never grow it)")
+    p.add_argument("--page-size", type=int, default=None,
+                   help="new tokens-per-page (artifact mode)")
+    p.add_argument("--platform", default=None, choices=[None, "cpu"],
+                   help="force the re-export's compile platform "
+                        "(artifact mode)")
+    args = p.parse_args(argv)
+
+    if args.checkpoint:
+        if not args.world or args.world < 1:
+            p.error("--checkpoint needs --world N (>= 1)")
+        from mxnet_tpu.checkpoint import reshard_checkpoint
+        report = reshard_checkpoint(args.checkpoint, args.world,
+                                    dst_root=args.dst, step=args.step)
+    else:
+        if not args.dst:
+            p.error("--artifact needs --dst PATH")
+        if all(v is None for v in (args.max_slots, args.num_pages,
+                                   args.max_pages_per_slot,
+                                   args.page_size)):
+            p.error("--artifact needs at least one of --max-slots / "
+                    "--num-pages / --max-pages-per-slot / --page-size")
+        from mxnet_tpu.serving import reshard_artifact
+        report = reshard_artifact(
+            args.artifact, args.dst, max_slots=args.max_slots,
+            num_pages=args.num_pages,
+            max_pages_per_slot=args.max_pages_per_slot,
+            page_size=args.page_size, platforms=args.platform)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

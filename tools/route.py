@@ -100,6 +100,65 @@ def _seed_static(router, urls):
     return seeded
 
 
+def _parse_autoscale(spec):
+    """One ``--autoscale`` value -> config dict. Accepts
+    ``MODEL=ARGV_TEMPLATE`` or a JSON object
+    ``{"model": ..., "argv": ..., "min": 1, "max": 3, ...policy
+    overrides...}``. The argv template is shlex-split after
+    substituting ``{replica_id}`` and ``{register_url}``."""
+    spec = spec.strip()
+    if spec.startswith("{"):
+        cfg = json.loads(spec)
+        if not cfg.get("model") or not cfg.get("argv"):
+            raise ValueError(
+                "--autoscale JSON needs 'model' and 'argv' keys")
+        return cfg
+    model, sep, argv = spec.partition("=")
+    if not sep or not model.strip() or not argv.strip():
+        raise ValueError(
+            "--autoscale wants MODEL=ARGV_TEMPLATE or a JSON object, "
+            "got %r" % spec)
+    return {"model": model.strip(), "argv": argv}
+
+
+def _start_autoscalers(router, register_url, specs):
+    """Build the shared supervisor plus one Autoscaler per --autoscale
+    entry; returns (supervisor, scalers)."""
+    import shlex
+
+    from mxnet_tpu.fleet import (AutoscalePolicy, Autoscaler,
+                                 ReplicaSpec, ReplicaSupervisor)
+    sup = ReplicaSupervisor()
+    sup.start()
+    scalers = []
+    for cfg in specs:
+        model = str(cfg["model"])
+        argv_t = shlex.split(str(cfg["argv"]))
+        pol = AutoscalePolicy(
+            min_replicas=cfg.get("min"), max_replicas=cfg.get("max"),
+            high_watermark_s=cfg.get("high_watermark_s"),
+            low_watermark_s=cfg.get("low_watermark_s"),
+            breach_rounds=cfg.get("breach_rounds"),
+            cooldown_s=cfg.get("cooldown_s"),
+            startup_cost_s=cfg.get("startup_cost_s"),
+            interval_s=cfg.get("interval_s"))
+
+        log_dir = cfg.get("log_dir")
+
+        def factory(rid, _argv=argv_t, _model=model, _logs=log_dir):
+            argv = [a.format(replica_id=rid, register_url=register_url,
+                             model=_model) for a in _argv]
+            log_path = (os.path.join(_logs, rid + ".log")
+                        if _logs else None)
+            return ReplicaSpec(rid, argv, max_restarts=2,
+                               log_path=log_path)
+
+        scalers.append(Autoscaler(router, sup, factory, model,
+                                  policy=pol,
+                                  scaler=cfg.get("scaler")).start())
+    return sup, scalers
+
+
 def _lease_loop(router, jdir, interval_s, compact_every, stop_evt):
     """Primary-side lease heartbeat + journal auto-compaction. The
     lease payload changes every beat (the counter), so the standby's
@@ -254,6 +313,17 @@ def main():
     p.add_argument("--lease-timeout-s", type=float, default=None,
                    help="standby promotion threshold "
                         "(default MXNET_FLEET_LEASE_TIMEOUT_S)")
+    p.add_argument("--autoscale", action="append", default=None,
+                   metavar="SPEC",
+                   help="autoscale a model's replicas from demand: "
+                        "MODEL=ARGV_TEMPLATE (the tools/serve.py "
+                        "command to launch one replica; {replica_id} "
+                        "and {register_url} are substituted) or a JSON "
+                        "object with model/argv plus policy overrides "
+                        "(min, max, high_watermark_s, low_watermark_s, "
+                        "breach_rounds, cooldown_s, startup_cost_s, "
+                        "interval_s). Repeatable, one scaler per "
+                        "model; defaults come from MXNET_AUTOSCALE_*.")
     p.add_argument("--force-primary", action="store_true",
                    help="skip the live-lease startup guard (operator "
                         "override after verifying the old primary is "
@@ -320,6 +390,14 @@ def main():
             banner["replay"] = router.replay_stats
         print(json.dumps(banner), flush=True)
 
+    supervisor, scalers = None, []
+    if args.autoscale:
+        specs = [_parse_autoscale(s) for s in args.autoscale]
+        supervisor, scalers = _start_autoscalers(
+            router, front.address, specs)
+        print(json.dumps({"autoscale": [s.snapshot() for s in scalers]}),
+              flush=True)
+
     lease_stop = threading.Event()
     lease_thread = None
     if jdir is not None:
@@ -331,6 +409,13 @@ def main():
         lease_thread.start()
 
     done.wait()
+    # scalers first (no launches during teardown), then the owned
+    # replica processes (SIGTERM -> they deregister + drain while the
+    # front end is still up), then the listener itself
+    for s in scalers:
+        s.stop()
+    if supervisor is not None:
+        supervisor.stop()
     front.stop()
     if lease_thread is not None:
         lease_stop.set()

@@ -160,6 +160,13 @@ def main():
                 "model": args.model_name, "version": args.model_version,
                 "mode": server.mode, "identity": identity,
                 "pid": os.getpid()}
+        # layout fingerprint (parallel/layout.py): the router refuses
+        # traffic splits that mix disagreeing fingerprints — a hop
+        # cursor is only portable between layout-identical replicas.
+        # None for artifacts without layout metadata (predict, old
+        # exports); the router exempts those.
+        from mxnet_tpu.serving import artifact_layout
+        info["layout"] = artifact_layout(args.artifact)
         if server.mode == "generate":
             # the router chunks generate hops; it needs the prefill
             # window to know where resume points stop being admissible

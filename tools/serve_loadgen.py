@@ -731,6 +731,56 @@ def _http_recommend(url, payload, timeout_s):
         return "error", None, None
 
 
+#: Shaped-load profiles: (phase name, load fraction of the peak
+#: --concurrency/--requests). ``spike`` is the autoscale drill's shape
+#: (quiet -> slam -> quiet), ``ramp`` a capacity walk, ``diurnal`` a
+#: compressed day curve.
+PROFILE_PHASES = {
+    "spike": [("baseline", 0.25), ("spike", 1.0), ("recovery", 0.25)],
+    "ramp": [("r25", 0.25), ("r50", 0.5), ("r75", 0.75), ("r100", 1.0)],
+    "diurnal": [("night", 0.2), ("morning", 0.6), ("midday", 1.0),
+                ("evening", 0.6), ("late", 0.2)],
+}
+
+
+def measure_profile(profile, run_phase, peak_concurrency,
+                    peak_requests):
+    """Drive a shaped load profile: run each phase at its fraction of
+    the peak concurrency/request budget via ``run_phase(concurrency,
+    requests)`` (any of the measure_* closures) and report per-phase
+    goodput + latency percentiles — the evidence the autoscale drill
+    asserts on (did p99 recover after the scale-out?)."""
+    phases = []
+    for name, frac in PROFILE_PHASES[profile]:
+        conc = max(1, int(round(peak_concurrency * frac)))
+        reqs = max(conc, int(round(peak_requests * frac)))
+        r = run_phase(conc, reqs)
+        lat = r.get("latency_ms") or {}
+        phases.append({
+            "phase": name, "load_fraction": frac,
+            "concurrency": conc, "requests": reqs,
+            "goodput": (r.get("goodput_qps")
+                        if r.get("goodput_qps") is not None
+                        else r.get("tokens_per_s_goodput")),
+            "p50_ms": lat.get("p50"), "p99_ms": lat.get("p99"),
+            "completed": r.get("completed"),
+            "rejected": r.get("rejected"),
+            "expired": r.get("expired"),
+            "errors": r.get("errors"),
+            "wall_s": r.get("wall_s"),
+            "detail": r,
+        })
+    p99s = [p["p99_ms"] for p in phases if p["p99_ms"] is not None]
+    return {
+        "profile": profile,
+        "phases": phases,
+        "peak_p99_ms": max(p99s) if p99s else None,
+        "final_p99_ms": p99s[-1] if p99s else None,
+        "total_completed": sum(p["completed"] or 0 for p in phases),
+        "total_errors": sum(p["errors"] or 0 for p in phases),
+    }
+
+
 def measure_recommend(target, concurrency=8, requests=256, mean_ids=8,
                       zipf=1.3, rows=None, timeout_ms=None, retries=0,
                       seed=0, conn_retries=0):
@@ -995,6 +1045,13 @@ def main():
                         "probe; default synthetic from --seed")
     p.add_argument("--probe-examples", type=int, default=256)
     p.add_argument("--probe-batch", type=int, default=32)
+    p.add_argument("--profile", default=None,
+                   choices=sorted(PROFILE_PHASES),
+                   help="shaped load instead of one flat run: phases "
+                        "at fractions of the peak --concurrency/"
+                        "--requests, per-phase goodput + p50/p99 in "
+                        "the report (spike = the autoscale drill's "
+                        "quiet/slam/quiet shape)")
     p.add_argument("--platform", default=None, choices=[None, "cpu"])
     p.add_argument("--out", default=None, help="also write JSON here")
     p.add_argument("--scrape-metrics", action="store_true",
@@ -1054,27 +1111,39 @@ def main():
         shape = None
 
     if args.recommend:
-        res = measure_recommend(
-            target, concurrency=args.concurrency,
-            requests=args.requests, mean_ids=args.mean_ids,
-            zipf=args.zipf, rows=args.reco_rows,
-            timeout_ms=args.timeout_ms, retries=args.retries,
-            seed=args.seed, conn_retries=conn_retries)
+        def run_phase(conc, reqs):
+            return measure_recommend(
+                target, concurrency=conc, requests=reqs,
+                mean_ids=args.mean_ids, zipf=args.zipf,
+                rows=args.reco_rows, timeout_ms=args.timeout_ms,
+                retries=args.retries, seed=args.seed,
+                conn_retries=conn_retries)
     elif args.generate:
-        res = measure_generate(
-            target, users=args.concurrency, requests=args.requests,
-            prompt_len=args.prompt_len, prompt_dist=args.prompt_dist,
-            max_new=args.max_new, output_dist=args.output_dist,
-            temperature=args.temperature, timeout_ms=args.timeout_ms,
-            retries=args.retries, seed=args.seed, vocab=args.vocab,
-            max_prompt_len=args.max_prompt_len,
-            max_context=args.max_context,
-            resume_evicted=resume_evicted, conn_retries=conn_retries)
+        def run_phase(conc, reqs):
+            return measure_generate(
+                target, users=conc, requests=reqs,
+                prompt_len=args.prompt_len,
+                prompt_dist=args.prompt_dist, max_new=args.max_new,
+                output_dist=args.output_dist,
+                temperature=args.temperature,
+                timeout_ms=args.timeout_ms, retries=args.retries,
+                seed=args.seed, vocab=args.vocab,
+                max_prompt_len=args.max_prompt_len,
+                max_context=args.max_context,
+                resume_evicted=resume_evicted,
+                conn_retries=conn_retries)
     else:
-        res = measure(target, concurrency=args.concurrency,
-                      requests=args.requests, qps=args.qps, rows=args.rows,
-                      timeout_ms=args.timeout_ms, shape=shape,
-                      retries=args.retries, conn_retries=conn_retries)
+        def run_phase(conc, reqs):
+            return measure(target, concurrency=conc, requests=reqs,
+                           qps=args.qps, rows=args.rows,
+                           timeout_ms=args.timeout_ms, shape=shape,
+                           retries=args.retries,
+                           conn_retries=conn_retries)
+    if args.profile:
+        res = measure_profile(args.profile, run_phase,
+                              args.concurrency, args.requests)
+    else:
+        res = run_phase(args.concurrency, args.requests)
     if not url:
         target.close(drain=True)
     if args.scrape_metrics:
