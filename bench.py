@@ -992,6 +992,31 @@ def main():
     except Exception as e:
         mxlint_metrics = "failed: %s" % e
 
+    # Layer-3 concurrency census: how many MXL6xx findings the codebase
+    # carries right now, per rule (baselined debt INCLUDED — the lint
+    # gate tracks growth, the census tracks the absolute count so
+    # BENCH_*.json shows the debt being paid down across PRs)
+    try:
+        from mxnet_tpu.analysis import runner as _lint_runner
+        _res = _lint_runner.run(
+            ["mxnet_tpu"], baseline_path=None,
+            root=os.path.dirname(os.path.abspath(__file__)),
+            enabled=frozenset(["MXL601", "MXL602", "MXL603",
+                               "MXL604", "MXL605", "MXL606"]))
+        census = {}
+        for d in _res.diags:
+            census[d.rule] = census.get(d.rule, 0) + 1
+        census = dict(sorted(census.items()))
+        if isinstance(mxlint_metrics, dict):
+            mxlint_metrics["concurrency_census"] = census
+        else:
+            mxlint_metrics = {"step_hlo": mxlint_metrics,
+                              "concurrency_census": census}
+    except Exception as e:
+        census = "failed: %s" % e
+        if isinstance(mxlint_metrics, dict):
+            mxlint_metrics["concurrency_census"] = census
+
     # kernel-tier dispatch report: which ops the Pallas tier took over in
     # the traced program (counters accumulate from the module bind/trace
     # in this process), tuner hit/miss split, and the tuning-cache

@@ -1,0 +1,613 @@
+"""mxlint Layer-3a: fleet concurrency rules (MXL601/602/603).
+
+The control plane PRs 11-19 built is heavily threaded — router, WAL
+journal, replicator, autoscaler, supervisor, prefetch queues — and the
+Layer-1 lock rules (MXL401/402) only see raw ``threading`` idioms. This
+module adds the race-shaped checks those tiers actually need, still as
+pure ``ast`` analysis (no chip, no jax, import-light like the rest of
+``mxnet_tpu/analysis``):
+
+* **MXL601 unguarded-shared-write** — a per-class thread-escape race
+  detector. Thread entry points are discovered per module
+  (``threading.Thread(target=self.m)``, ``pool.submit(self.m, ...)``,
+  a ``run`` method on a ``Thread`` subclass, and ``do_*`` HTTP handler
+  methods);
+  each entry's reachable helper methods (taint through ``self.m()``
+  calls) form one *thread context*, and — when the class owns a
+  lock-like attribute — its public surface forms an external-caller
+  context. An attribute written outside construction and accessed from
+  two or more contexts, where any access lacks the owning lock, is a
+  data race (the supervisor's ``kill``/``stop``/``alive_count`` reads
+  of ``_children`` against the poller thread were exactly this).
+* **MXL602 blocking-under-fleet-lock** — MXL401 extended to the
+  fleet's own blocking primitives: ``os.fsync``, a journal append
+  (fsync-backed WAL write), a socket/HTTP fetch, or a ``sleep`` while
+  holding a lock stalls every thread contending it. The router's
+  canary paths journalling inside ``self._lock`` motivated the rule.
+* **MXL603 wall-clock-liveness** — ``time.time()`` flowing into a
+  liveness/lease/backoff/heartbeat-aging deadline. The fleet's
+  liveness is monotonic **by contract** (an NTP step must never
+  mass-expire a healthy fleet — see ReplicaRegistry); a wall-clock
+  deadline anywhere in that neighborhood is a latent mass-expiry.
+
+Diagnostics flow through the shared engine (``diagnostics.py``), so the
+baseline ratchet, CLI, and tier-1 gate treat these exactly like every
+other rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .diagnostics import Diagnostic
+from .rules_ast import Rule, _dotted, _last_seg, _LOCKISH
+
+__all__ = ["CONCURRENCY_RULES", "analyze_concurrency"]
+
+CONCURRENCY_RULES = {r.id: r for r in [
+    Rule("MXL601", "unguarded-shared-write", "error",
+         "this attribute is shared across thread contexts but some "
+         "access skips the owning lock; take the lock (snapshot under "
+         "it, compute outside) or confine the attribute to one thread"),
+    Rule("MXL602", "blocking-under-fleet-lock", "error",
+         "fsync/journal-append/socket/sleep while holding a lock stalls "
+         "every thread contending it; move the blocking call outside "
+         "the critical section (the set_split pattern: journal first, "
+         "then mutate under the lock)"),
+    Rule("MXL603", "wall-clock-liveness", "error",
+         "liveness/lease/backoff deadlines must use time.monotonic(): "
+         "an NTP step or operator `date` call must never mass-expire a "
+         "healthy fleet (wall clock is for log timestamps only)"),
+]}
+
+# -- MXL601 ------------------------------------------------------------------
+
+# attribute segments that never hold shared mutable state worth flagging
+_BORING_ATTRS = frozenset(["daemon", "name"])
+
+_HANDLER_METHOD = re.compile(r"^do_[A-Z]+$")
+
+# -- MXL602 ------------------------------------------------------------------
+
+_JOURNALISH = re.compile(r"(?i)(^|_)(journal|wal)($|_)")
+_SOCKISH = re.compile(r"(?i)(sock|conn)")
+_HTTP_HELPER = re.compile(r"(?i)(^|_)(post_json|get_json|http_post|"
+                          r"http_get|scrape)$")
+_SOCK_BLOCK_ATTRS = frozenset(["recv", "sendall", "sendto", "connect",
+                               "getresponse"])
+
+# -- MXL603 ------------------------------------------------------------------
+
+_DEADLINE_SEGS = frozenset(["deadline", "lease", "heartbeat", "hb",
+                            "liveness", "alive", "stale", "age",
+                            "backoff"])
+_LIVENESS_FN_SEGS = _DEADLINE_SEGS | frozenset(["sweep", "watchdog",
+                                                "expired"])
+
+
+def _segs(name):
+    return [s for s in str(name).lower().split("_") if s]
+
+
+def _deadlineish(name):
+    return any(s in _DEADLINE_SEGS or s.startswith("expir")
+               for s in _segs(_last_seg(name)))
+
+
+def _liveness_fn(name):
+    return any(s in _LIVENESS_FN_SEGS or s.startswith("expir")
+               for s in _segs(name))
+
+
+def _is_wall_clock(call):
+    """True for ``time.time()`` / ``_time.time()`` call nodes."""
+    name = _dotted(call.func)
+    return name is not None and (name == "time.time"
+                                 or name.endswith("time.time"))
+
+
+def _self_attr(node):
+    """'x' for a ``self.x`` Attribute node, else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _base_names(cls):
+    out = set()
+    for b in cls.bases:
+        name = _dotted(b)
+        if name:
+            out.add(_last_seg(name))
+    return out
+
+
+class _MethodInfo:
+    """Per-method facts for the per-class race analysis."""
+
+    __slots__ = ("node", "qual", "calls", "reads", "writes",
+                 "nested_entries")
+
+    def __init__(self, node, qual):
+        self.node = node
+        self.qual = qual
+        self.calls = []          # (callee_method_name, locked_at_site)
+        self.reads = []          # (attr, node, locked)
+        self.writes = []         # (attr, node, locked)
+        self.nested_entries = []  # names of self-methods a nested fn
+        #                           handed to Thread(target=...) calls
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Walks ONE method body tracking lexically held locks, recording
+    self-attribute accesses, self-method calls, and thread spawns."""
+
+    def __init__(self, info, lock_attrs):
+        self.info = info
+        self.lock_attrs = lock_attrs
+        self._locks = 0
+        self._nested = 0         # inside a nested def: separate context
+        self._parents = []
+
+    def visit(self, node):
+        self._parents.append(node)
+        try:
+            super().visit(node)
+        finally:
+            self._parents.pop()
+
+    def _parent(self):
+        return self._parents[-2] if len(self._parents) >= 2 else None
+
+    def _locked(self):
+        return self._locks > 0
+
+    def visit_With(self, node):
+        tokens = 0
+        for item in node.items:
+            name = _dotted(item.context_expr)
+            if name and _LOCKISH.search(_last_seg(name)):
+                tokens += 1
+        self._locks += tokens
+        self.generic_visit(node)
+        self._locks -= tokens
+
+    visit_AsyncWith = visit_With
+
+    def _spawn_targets(self, call):
+        """self-method names handed to Thread(target=...) / submit()."""
+        callee = _last_seg(_dotted(call.func) or "")
+        out = []
+        if callee == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr:
+                        out.append(attr)
+        elif callee == "submit" and call.args:
+            attr = _self_attr(call.args[0])
+            if attr:
+                out.append(attr)
+        return out
+
+    def visit_Call(self, node):
+        # thread spawn discovery (works nested too: the closure handed
+        # to Thread seeds the entry, see _Nested below)
+        self.info.nested_entries.extend(self._spawn_targets(node))
+        attr = _self_attr(node.func)
+        if attr is not None:
+            self.info.calls.append((attr, self._locked()))
+            # a self-method call reads the method attribute, which is
+            # never state: record it bare so it cannot count as racy
+            self._note(attr, node.func, write=False, bare=True)
+            for a in node.args:
+                self.visit(a)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            return
+        self.generic_visit(node)
+
+    def _bare_read(self, node):
+        """True when this Load is a plain scalar read (flag check,
+        arithmetic operand): atomic under the GIL, so not evidence of a
+        race. Compound uses — subscripting, chained attribute access,
+        iteration, escaping as a call argument — stay racy."""
+        parent = self._parent()
+        if isinstance(parent, (ast.Subscript, ast.Attribute, ast.Call)):
+            return False
+        if isinstance(parent, (ast.For, ast.comprehension)) \
+                and parent.iter is node:
+            return False
+        return True
+
+    def _note(self, attr, node, write, bare=False):
+        if _LOCKISH.search(attr) or attr in self.lock_attrs \
+                or attr in _BORING_ATTRS:
+            return
+        if write:
+            self.info.writes.append((attr, node, self._locked()))
+        else:
+            self.info.reads.append((attr, node, self._locked(), bare))
+
+    def visit_Attribute(self, node):
+        attr = _self_attr(node)
+        if attr is not None:
+            if isinstance(node.ctx, ast.Store):
+                self._note(attr, node, write=True)
+            else:
+                self._note(attr, node, write=False,
+                           bare=self._bare_read(node))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # self.x[k] = v mutates the shared container bound to x
+        attr = _self_attr(node.value)
+        if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._note(attr, node, write=True)
+            self.visit(node.slice)
+            return
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested defs (closures handed to Thread) are scanned in place:
+        # their self accesses belong to whatever context spawns them,
+        # which reachability resolves via nested_entries
+        self._nested += 1
+        self.generic_visit(node)
+        self._nested -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _collect_lock_attrs(cls):
+    """Attribute names on ``self`` bound to lock-like objects (or
+    lock-like names) anywhere in the class."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr and _LOCKISH.search(attr):
+                    out.add(attr)
+    return out
+
+
+def _reach(entries, calls_of):
+    """Transitive closure of self-calls from each entry method."""
+    seen = set()
+    work = [e for e in entries]
+    while work:
+        m = work.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        for callee, _ in calls_of.get(m, ()):
+            if callee not in seen:
+                work.append(callee)
+    return seen
+
+
+def _init_only_methods(methods, calls_of):
+    """Methods whose only in-class callers are __init__ (transitively):
+    they run before any thread starts, so their writes are construction,
+    not sharing."""
+    callers = {}
+    for name, info in methods.items():
+        for callee, _ in info.calls:
+            callers.setdefault(callee, set()).add(name)
+    init_only = set()
+    changed = True
+    while changed:
+        changed = False
+        for name in methods:
+            if name in init_only or name == "__init__":
+                continue
+            cs = callers.get(name)
+            if cs and all(c == "__init__" or c in init_only for c in cs):
+                init_only.add(name)
+                changed = True
+    return init_only | {"__init__"}
+
+
+def _always_locked_methods(methods):
+    """Methods every in-class call site of which holds a lock: their
+    bodies inherit the caller's critical section (the ``*_locked``
+    helper convention, resolved from call sites rather than names)."""
+    locked = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, _ in methods.items():
+            if name in locked:
+                continue
+            sites = []
+            for caller, info in methods.items():
+                for callee, is_locked in info.calls:
+                    if callee == name:
+                        sites.append(is_locked or caller in locked)
+            if sites and all(sites):
+                locked.add(name)
+                changed = True
+    return locked
+
+
+def _analyze_class_races(path, cls, emit):
+    methods = {}
+    for st in cls.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = "%s.%s" % (cls.name, st.name)
+            info = _MethodInfo(st, qual)
+            _MethodScanner(info, ()).generic_visit(st)
+            methods[st.name] = info
+    if not methods:
+        return
+    lock_attrs = _collect_lock_attrs(cls)
+    if lock_attrs:
+        # rescan with lock attrs excluded from the shared-state map
+        for name, info in methods.items():
+            info.calls, info.reads, info.writes = [], [], []
+            info.nested_entries = []
+            _MethodScanner(info, lock_attrs).generic_visit(info.node)
+
+    bases = _base_names(cls)
+    entries = set()
+    if "Thread" in bases and "run" in methods:
+        entries.add("run")
+    for name, info in methods.items():
+        if _HANDLER_METHOD.match(name):
+            entries.add(name)
+        for tgt in info.nested_entries:
+            if tgt in methods:
+                entries.add(tgt)
+    if not entries:
+        return
+
+    calls_of = {n: i.calls for n, i in methods.items()}
+    init_ctx = _init_only_methods(methods, calls_of)
+    locked_methods = _always_locked_methods(methods)
+
+    contexts = {}            # label -> set of method names
+    for e in sorted(entries):
+        contexts["thread:" + e] = _reach([e], calls_of)
+    if lock_attrs:
+        # the class knows it is shared (it owns a lock): its public
+        # surface is one more context, the external-caller one
+        public = [n for n in methods
+                  if not n.startswith("_") and n not in entries]
+        roots = [n for n in public
+                 if not any(n in r for r in contexts.values())]
+        if roots:
+            contexts["callers"] = _reach(roots, calls_of)
+
+    # attr -> {ctx: [(node, locked, is_write, bare)]}
+    access = {}
+    for label, reach in contexts.items():
+        for m in reach:
+            info = methods.get(m)
+            if info is None or m in init_ctx:
+                continue
+            inherits = m in locked_methods
+            for attr, node, locked, bare in info.reads:
+                access.setdefault(attr, {}).setdefault(label, []).append(
+                    (node, locked or inherits, False, bare))
+            for attr, node, locked in info.writes:
+                access.setdefault(attr, {}).setdefault(label, []).append(
+                    (node, locked or inherits, True, False))
+
+    for attr in sorted(access):
+        by_ctx = access[attr]
+        if len(by_ctx) < 2:
+            continue
+        if not any(w for recs in by_ctx.values()
+                   for _, _, w, _ in recs):
+            continue
+        # bare scalar reads are GIL-atomic and never racy evidence
+        unlocked = [(node, w, label)
+                    for label, recs in sorted(by_ctx.items())
+                    for node, locked, w, bare in recs
+                    if not locked and not bare]
+        locked_any = any(locked for recs in by_ctx.values()
+                         for _, locked, _, bare in recs if not bare)
+        # mixed discipline is the smell: some access takes the lock (so
+        # the class believes this attribute needs it) and some access
+        # skips it. Never-locked attributes are single-owner by
+        # convention (scheduler loops driven manually in tests) — noise,
+        # not races.
+        if not unlocked or not locked_any:
+            continue
+        unlocked.sort(key=lambda t: (not t[1], t[0].lineno, t[0].col_offset))
+        node, _, label = unlocked[0]
+        emit("MXL601", node, "%s.%s" % (cls.name, attr),
+             "self.%s is shared across %d thread contexts (%s) but this "
+             "access holds no lock"
+             % (attr, len(by_ctx), ", ".join(sorted(by_ctx))))
+
+
+# -- MXL602 / MXL603 visitor -------------------------------------------------
+
+class _FlowLinter(ast.NodeVisitor):
+    """Module-wide walk for the blocking-under-lock and wall-clock
+    rules (shares the Layer-1 lock-token idiom but keys on the fleet's
+    own blocking primitives)."""
+
+    def __init__(self, path, emit):
+        self.path = path
+        self.emit = emit
+        self._class = []
+        self._fn = []
+        self._locks_held = []
+        self._clock_seen = set()   # id() of time.time() calls handled
+
+    def _qual(self):
+        if self._fn:
+            return self._fn[-1]
+        return "<module>"
+
+    def visit_ClassDef(self, node):
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_fn(self, node):
+        outer = self._fn[-1] if self._fn else None
+        if outer:
+            qual = "%s.%s" % (outer, node.name)
+        elif self._class:
+            qual = "%s.%s" % (self._class[-1], node.name)
+        else:
+            qual = node.name
+        self._fn.append(qual)
+        self.generic_visit(node)
+        self._fn.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _lock_token(self, expr):
+        name = _dotted(expr)
+        if not name or not _LOCKISH.search(_last_seg(name)):
+            return None
+        if name.startswith("self.") and self._class:
+            return "%s.%s" % (self._class[-1], name[5:])
+        return name
+
+    def visit_With(self, node):
+        tokens = []
+        for item in node.items:
+            tok = self._lock_token(item.context_expr)
+            if tok:
+                self._locks_held.append(tok)
+                tokens.append(tok)
+        self.generic_visit(node)
+        for _ in tokens:
+            self._locks_held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- MXL602 --
+    def _blocking_primitive(self, node, callee, last):
+        if last == "fsync":
+            return "os.fsync"
+        if last == "_journal_append" or _JOURNALISH.search(last):
+            return "journal append (fsync-backed WAL write)"
+        if isinstance(node.func, ast.Attribute) and node.func.attr \
+                == "append":
+            recv = _last_seg(_dotted(node.func.value) or "")
+            if _JOURNALISH.search(recv):
+                return "%s.append() (fsync-backed WAL write)" % recv
+        if last in ("urlopen", "create_connection") \
+                or _HTTP_HELPER.search(last):
+            return "%s() (network round trip)" % last
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = _last_seg(_dotted(node.func.value) or "")
+            if attr in _SOCK_BLOCK_ATTRS and _SOCKISH.search(recv):
+                return "%s.%s() (socket I/O)" % (recv, attr)
+            if attr == "request" and _SOCKISH.search(recv):
+                return "%s.request() (socket I/O)" % recv
+            if attr == "sleep" and recv == "time":
+                return "time.sleep()"
+        return None
+
+    # -- MXL603 --
+    def _check_wall_clock(self, node):
+        parent_fn = _last_seg(self._qual())
+        if _liveness_fn(parent_fn):
+            self.emit("MXL603", node, self._qual(),
+                      "time.time() inside liveness path %r must be "
+                      "time.monotonic()" % parent_fn)
+            return True
+        return False
+
+    def visit_Assign(self, node):
+        for call in ast.walk(node.value):
+            if isinstance(call, ast.Call) and _is_wall_clock(call):
+                tgts = []
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            tgts.append(n.id)
+                        elif isinstance(n, ast.Attribute):
+                            tgts.append(n.attr)
+                        elif isinstance(n, ast.Subscript) and isinstance(
+                                n.slice, ast.Constant) and isinstance(
+                                n.slice.value, str):
+                            tgts.append(n.slice.value)
+                if any(_deadlineish(t) for t in tgts):
+                    self._clock_seen.add(id(call))
+                    self.emit(
+                        "MXL603", call, self._qual(),
+                        "wall-clock deadline %r: time.time() feeds a "
+                        "liveness/lease value"
+                        % next(t for t in tgts if _deadlineish(t)))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        calls = [c for c in ast.walk(node)
+                 if isinstance(c, ast.Call) and _is_wall_clock(c)]
+        if calls:
+            names = []
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name):
+                    names.append(n.id)
+                elif isinstance(n, ast.Attribute):
+                    names.append(n.attr)
+            if any(_deadlineish(x) for x in names):
+                for c in calls:
+                    self._clock_seen.add(id(c))
+                self.emit("MXL603", calls[0], self._qual(),
+                          "time.time() compared against %r: liveness "
+                          "deadlines must be monotonic"
+                          % next(x for x in names if _deadlineish(x)))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        callee = _dotted(node.func)
+        last = _last_seg(callee or "")
+        if self._locks_held:
+            what = self._blocking_primitive(node, callee, last)
+            if what:
+                self.emit("MXL602", node, self._qual(),
+                          "%s while holding %s blocks every thread "
+                          "contending that lock"
+                          % (what, ", ".join(self._locks_held)))
+        if _is_wall_clock(node) and id(node) not in self._clock_seen:
+            self._clock_seen.add(id(node))
+            self._check_wall_clock(node)
+        self.generic_visit(node)
+
+
+def analyze_concurrency(path, tree, enabled=None):
+    """Run MXL601/602/603 over one parsed module; returns Diagnostics
+    (un-indexed — the runner assigns occurrence indices)."""
+    want = set(CONCURRENCY_RULES)
+    if enabled is not None:
+        want &= set(enabled)
+    if not want:
+        return []
+    diags = []
+
+    def emit(rule_id, node, symbol, message):
+        if rule_id not in want:
+            return
+        r = CONCURRENCY_RULES[rule_id]
+        diags.append(Diagnostic(
+            rule_id, path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), r.severity, message,
+            hint=r.hint, symbol=symbol))
+
+    if "MXL602" in want or "MXL603" in want:
+        lint = _FlowLinter(path, emit)
+        if "MXL602" not in want:
+            lint._blocking_primitive = lambda *a: None
+        if "MXL603" not in want:
+            lint._check_wall_clock = lambda *a: False
+            lint.visit_Assign = lint.generic_visit
+            lint.visit_Compare = lint.generic_visit
+        lint.visit(tree)
+    if "MXL601" in want:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                _analyze_class_races(path, node, emit)
+    return diags

@@ -1,0 +1,377 @@
+"""mxlint Layer-3b: control-plane protocol invariants (MXL604/605/606).
+
+The fleet's failover story rests on three protocol invariants that no
+amount of lock hygiene can check:
+
+* **MXL604 journal-first** — a control-plane mutation that reaches
+  fleet state must hit the WAL *first*, and the append must be
+  ``required=True`` (or the method must gate on
+  ``_require_journal_writable()``): otherwise a crash between mutate
+  and append yields a standby that replays to a *different* state than
+  the primary served, and a degraded disk silently drops the write the
+  standby needed. The pass finds HTTP control routes (``/admin/*``,
+  ``/fleet/*``) in handler classes, takes the methods they call, and in
+  classes that journal directly it checks each such method: no
+  fleet-state store before the first journal append, and at least one
+  append ``required=True``.
+* **MXL605 epoch-fencing coverage** — every state-mutating control
+  route must check the epoch fence before applying (a demoted primary
+  or a stale operator script must get a 409, not a silent apply). A
+  fence call in the ``do_POST`` preamble (before the route dispatch)
+  covers every branch; otherwise each control branch needs its own
+  fence call, directly or via the handler method it delegates to.
+* **MXL606 nondeterministic-payload** — journaled (and
+  device-dispatched) record bodies must be deterministic: set
+  iteration (unless wrapped in ``sorted()``), ``random.*`` draws, and
+  ``time.time()`` stamps inside the payload make the WAL replay —
+  and therefore the standby — diverge bitwise from the primary.
+
+Pure ``ast``, import-light, same Diagnostic engine as every other rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .diagnostics import Diagnostic
+from .rules_ast import Rule, _dotted, _last_seg
+
+__all__ = ["FLEET_RULES", "analyze_fleet_rules"]
+
+FLEET_RULES = {r.id: r for r in [
+    Rule("MXL604", "journal-first", "error",
+         "journal before you mutate, and make the control append "
+         "required=True (the set_split pattern): a crash or degraded "
+         "disk between mutate and append forks primary and standby"),
+    Rule("MXL605", "unfenced-control-route", "error",
+         "check the epoch fence before applying control mutations "
+         "(fence in the do_POST preamble covers every route); a "
+         "demoted primary must get a 409, not a silent apply"),
+    Rule("MXL606", "nondeterministic-payload", "error",
+         "journaled/dispatched payloads must replay bitwise: wrap set "
+         "iteration in sorted(), move wall-clock stamps and random "
+         "draws out of the record body"),
+]}
+
+_CONTROL_PREFIXES = ("/admin", "/fleet")
+
+_STATE_SEG = re.compile(r"(?i)^(split|canar|session|epoch|registr|"
+                        r"autoscale|state|replica)")
+_MUTATOR_ATTRS = frozenset(["pop", "clear", "update", "add", "remove",
+                            "append", "extend", "setdefault"])
+
+_JOURNALISH = re.compile(r"(?i)(^|_)(journal|wal)($|_)")
+_FENCE_NAME = re.compile(r"(?i)fence|fencing")
+_RNGISH = re.compile(r"(?i)(^|_)(rng|random|rand)($|_)")
+_RANDOM_ATTRS = frozenset(["random", "randint", "choice", "shuffle",
+                           "sample", "randrange", "uniform"])
+
+
+def _stateish(attr):
+    return any(_STATE_SEG.match(s) for s in attr.lower().split("_") if s)
+
+
+def _self_attr(node):
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _str_consts(node):
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def _is_control_test(test):
+    return any(s.startswith(_CONTROL_PREFIXES) for s in _str_consts(test))
+
+
+def _flatten_branches(stmts):
+    """(test, body) pairs for every if/elif arm, recursively, across a
+    function body (the route-dispatch shape handlers use)."""
+    out = []
+    for st in stmts:
+        if isinstance(st, ast.If):
+            node = st
+            while True:
+                out.append((node.test, node.body))
+                out.extend(_flatten_branches(node.body))
+                if len(node.orelse) == 1 and isinstance(node.orelse[0],
+                                                        ast.If):
+                    node = node.orelse[0]
+                else:
+                    out.extend(_flatten_branches(node.orelse))
+                    break
+        elif isinstance(st, (ast.With, ast.Try, ast.For, ast.While)):
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(st, field, None) or []
+                if field == "handlers":
+                    for h in sub:
+                        out.extend(_flatten_branches(h.body))
+                else:
+                    out.extend(_flatten_branches(sub))
+    return out
+
+
+def _called_attrs(stmts):
+    """Last attribute names of every call in the given statements."""
+    out = set()
+    for st in stmts:
+        for n in ast.walk(st):
+            if isinstance(n, ast.Call):
+                name = _dotted(n.func)
+                if name:
+                    out.add(_last_seg(name))
+    return out
+
+
+def _is_fence_call(call):
+    name = _dotted(call.func)
+    if not name:
+        return False
+    if _FENCE_NAME.search(_last_seg(name)) or _FENCE_NAME.search(name):
+        return True
+    if isinstance(call.func, ast.Attribute) and call.func.attr in (
+            "observe", "is_stale"):
+        recv = _dotted(call.func.value) or ""
+        if _FENCE_NAME.search(recv) or "epoch" in recv.lower():
+            return True
+    return False
+
+
+def _handler_classes(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for st in node.body:
+                if isinstance(st, ast.FunctionDef) and st.name == "do_POST":
+                    yield node, st
+
+
+def _journal_append_sites(fn):
+    """(call, required, lineno) for every journal append in fn."""
+    out = []
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        name = _dotted(n.func) or ""
+        last = _last_seg(name)
+        is_append = last == "_journal_append"
+        if not is_append and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "append":
+            recv = _last_seg(_dotted(n.func.value) or "")
+            is_append = bool(_JOURNALISH.search(recv))
+        if is_append:
+            required = any(
+                kw.arg == "required" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in n.keywords)
+            out.append((n, required, n.lineno))
+    return out
+
+
+def _state_mutations(fn):
+    """(node, lineno, attr) for fleet-state stores in fn: assignment or
+    subscript store to self.<stateish>, or a mutating container call on
+    it."""
+    out = []
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for tgt in tgts:
+                base = tgt
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                attr = _self_attr(base)
+                if attr and _stateish(attr):
+                    out.append((n, n.lineno, attr))
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATOR_ATTRS:
+            attr = _self_attr(n.func.value)
+            if attr and _stateish(attr):
+                out.append((n, n.lineno, attr))
+    return out
+
+
+def _check_journal_first(path, tree, emit):
+    # 1. method names the control routes call, per module
+    control_methods = set()
+    for cls, do_post in _handler_classes(tree):
+        for test, body in _flatten_branches(do_post.body):
+            if _is_control_test(test):
+                control_methods.update(_called_attrs(body))
+    if not control_methods:
+        return
+    # 2. classes that journal directly: check their control methods
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {st.name: st for st in node.body
+                   if isinstance(st, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+        if not any(_journal_append_sites(fn) for fn in methods.values()):
+            continue
+        for name in sorted(control_methods & set(methods)):
+            fn = methods[name]
+            appends = _journal_append_sites(fn)
+            if not appends:
+                continue
+            qual = "%s.%s" % (node.name, name)
+            mutations = _state_mutations(fn)
+            first_append = min(ln for _, _, ln in appends)
+            early = [m for m in mutations if m[1] < first_append]
+            if early:
+                n, ln, attr = min(early, key=lambda m: m[1])
+                emit("MXL604", n, qual,
+                     "self.%s mutated before the journal append "
+                     "(journal-first: a crash here forks primary and "
+                     "standby)" % attr)
+            elif mutations and not any(req for _, req, _ in appends) \
+                    and "_require_journal_writable" not in _called_attrs(
+                        [fn]):
+                n, _, ln = appends[0]
+                emit("MXL604", n, qual,
+                     "control-plane journal append without "
+                     "required=True: a degraded disk silently drops "
+                     "the write the standby needs")
+
+
+def _check_fencing(path, tree, emit):
+    for cls, do_post in _handler_classes(tree):
+        branches = _flatten_branches(do_post.body)
+        # a branch whose own test calls the fence IS the fence gate
+        # (`if path.startswith(("/admin", ...)) and not self._fence(p)`),
+        # not a route to be checked
+        control = [(t, b) for t, b in branches
+                   if _is_control_test(t)
+                   and not any(isinstance(n, ast.Call)
+                               and _is_fence_call(n)
+                               for n in ast.walk(t))]
+        if not control:
+            continue
+        first_line = min(t.lineno for t, _ in control)
+        fence_lines = [n.lineno for n in ast.walk(do_post)
+                       if isinstance(n, ast.Call) and _is_fence_call(n)]
+        if any(ln < first_line for ln in fence_lines):
+            continue          # preamble fence covers every route
+        # methods on this handler class that fence internally
+        fencing_methods = set()
+        for st in cls.body:
+            if isinstance(st, ast.FunctionDef) and st is not do_post:
+                if any(isinstance(n, ast.Call) and _is_fence_call(n)
+                       for n in ast.walk(st)):
+                    fencing_methods.add(st.name)
+        for test, body in control:
+            end = max((n.lineno for st in body for n in ast.walk(st)
+                       if hasattr(n, "lineno")), default=test.lineno)
+            if any(test.lineno <= ln <= end for ln in fence_lines):
+                continue
+            if _called_attrs(body) & fencing_methods:
+                continue
+            route = next((s for s in _str_consts(test)
+                          if s.startswith(_CONTROL_PREFIXES)), "?")
+            emit("MXL605", test, "%s.do_POST" % cls.name,
+                 "control route %s applies a mutation without checking "
+                 "the epoch fence" % route)
+
+
+def _payload_nondeterminism(expr, fn):
+    """(node, what) nondeterminism findings inside a payload expression.
+    Resolves one level of local Name indirection within fn."""
+    findings = []
+    seen = set()
+
+    def resolve(name):
+        best = None
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in n.targets):
+                if best is None or n.lineno > best.lineno:
+                    best = n
+        return best.value if best is not None else None
+
+    def scan(node, depth):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            last = _last_seg(name)
+            if last == "sorted":
+                return            # sorted() normalizes whatever is below
+            if name == "time.time" or name.endswith(".time.time"):
+                findings.append((node, "time.time() stamp"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _RANDOM_ATTRS:
+                recv = _last_seg(_dotted(node.func.value) or "")
+                if _RNGISH.search(recv):
+                    findings.append((node, "%s.%s() draw"
+                                     % (recv, node.func.attr)))
+        elif isinstance(node, (ast.Set, ast.SetComp)):
+            findings.append((node, "set iteration"))
+        elif isinstance(node, ast.Name) and depth == 0:
+            val = resolve(node.id)
+            if val is not None:
+                scan(val, 1)
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child, depth)
+
+    scan(expr, 0)
+    return findings
+
+
+def _check_payload_determinism(path, tree, emit):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            name = _dotted(call.func) or ""
+            last = _last_seg(name)
+            is_journal = last == "_journal_append"
+            if not is_journal and isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "append":
+                recv = _last_seg(_dotted(call.func.value) or "")
+                is_journal = bool(_JOURNALISH.search(recv))
+            is_dispatch = last in ("device_put", "dispatch_payload")
+            if not (is_journal or is_dispatch):
+                continue
+            for arg in list(call.args) + [kw.value for kw in call.keywords
+                                          if kw.arg not in ("sync",
+                                                            "required")]:
+                for bad, what in _payload_nondeterminism(arg, node):
+                    emit("MXL606", bad, node.name,
+                         "%s inside a %s payload: the WAL replay (and "
+                         "the standby) diverges from what the primary "
+                         "served" % (what, "journaled" if is_journal
+                                     else "dispatched"))
+
+
+def analyze_fleet_rules(path, tree, enabled=None):
+    """Run MXL604/605/606 over one parsed module; returns Diagnostics
+    (un-indexed — the runner assigns occurrence indices)."""
+    want = set(FLEET_RULES)
+    if enabled is not None:
+        want &= set(enabled)
+    if not want:
+        return []
+    diags = []
+
+    def emit(rule_id, node, symbol, message):
+        if rule_id not in want:
+            return
+        r = FLEET_RULES[rule_id]
+        diags.append(Diagnostic(
+            rule_id, path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), r.severity, message,
+            hint=r.hint, symbol=symbol))
+
+    if "MXL604" in want:
+        _check_journal_first(path, tree, emit)
+    if "MXL605" in want:
+        _check_fencing(path, tree, emit)
+    if "MXL606" in want:
+        _check_payload_determinism(path, tree, emit)
+    return diags

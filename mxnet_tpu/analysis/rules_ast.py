@@ -776,10 +776,13 @@ class LockOrderCollector:
         return out
 
 
-def analyze_module(path, src, lock_collector=None, enabled=None):
+def analyze_module(path, src, lock_collector=None, enabled=None,
+                   tree=None):
     """Lint one file's source. Returns a list of Diagnostics (lock-order
-    findings come later, from the shared collector)."""
-    tree = ast.parse(src, filename=path)
+    findings come later, from the shared collector). ``tree`` lets the
+    runner parse once and share the AST with the Layer-3 passes."""
+    if tree is None:
+        tree = ast.parse(src, filename=path)
     linter = ModuleLinter(path, tree, src, lock_collector=lock_collector,
                           enabled=enabled)
     linter.visit(tree)

@@ -7,11 +7,14 @@ and ``io/io.py``.
 """
 from __future__ import annotations
 
+import ast
 import os
 import subprocess
 
 from . import baseline as baseline_mod
+from .concurrency import CONCURRENCY_RULES, analyze_concurrency
 from .diagnostics import Diagnostic, assign_indices
+from .fleet_rules import FLEET_RULES, analyze_fleet_rules
 from .rules_ast import (LockOrderCollector, RULES, analyze_module)
 from .rules_ast import Rule
 
@@ -30,10 +33,13 @@ _SKIP_DIRS = frozenset([
 
 
 def all_rules():
-    """{rule_id: Rule} across both layers (AST + HLO) plus MXL001."""
+    """{rule_id: Rule} across all layers (AST + HLO + concurrency +
+    control-plane invariants) plus MXL001."""
     from .hlo_passes import HLO_RULES
     out = dict(RULES)
     out.update(HLO_RULES)
+    out.update(CONCURRENCY_RULES)
+    out.update(FLEET_RULES)
     out[PARSE_RULE.id] = PARSE_RULE
     return out
 
@@ -98,15 +104,19 @@ def lint_sources(sources, enabled=None):
     locks = LockOrderCollector()
     for path in sorted(sources):
         try:
-            diags.extend(analyze_module(path, sources[path],
-                                        lock_collector=locks,
-                                        enabled=enabled))
+            tree = ast.parse(sources[path], filename=path)
         except SyntaxError as e:
             if enabled is None or PARSE_RULE.id in enabled:
                 diags.append(Diagnostic(
                     PARSE_RULE.id, path, e.lineno or 1, (e.offset or 1) - 1,
                     "error", "syntax error: %s" % e.msg,
                     hint=PARSE_RULE.hint))
+            continue
+        diags.extend(analyze_module(path, sources[path],
+                                    lock_collector=locks,
+                                    enabled=enabled, tree=tree))
+        diags.extend(analyze_concurrency(path, tree, enabled=enabled))
+        diags.extend(analyze_fleet_rules(path, tree, enabled=enabled))
     diags.extend(locks.diagnostics(enabled=enabled))
     return assign_indices(diags)
 

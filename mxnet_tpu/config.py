@@ -537,6 +537,21 @@ register_flag("autoscale_startup_cost_s", "MXNET_AUTOSCALE_STARTUP_COST_S",
               "beats this break-even — the perfmodel-derived guard "
               "against scaling into a spike that ends before the new "
               "replica is warm.")
+register_flag("autoscale_page_high_occupancy",
+              "MXNET_AUTOSCALE_PAGE_HIGH_OCCUPANCY", float, 0.85,
+              "Decode memory-pressure threshold: a fleet whose worst "
+              "replica reports kv_page_occupancy above this fraction "
+              "counts as a high-watermark breach even when "
+              "queue-seconds look calm — long contexts exhaust the KV "
+              "page pool well before load_s moves, and scale-out must "
+              "land before admission starts stalling on pages.")
+register_flag("autoscale_deadline_headroom",
+              "MXNET_AUTOSCALE_DEADLINE_HEADROOM", float, 1.0,
+              "Tail-latency pressure threshold: worst replica "
+              "p99_ms / deadline_ms (request timeout) above this "
+              "ratio counts as a high-watermark breach — p99 at the "
+              "deadline means the tail is about to turn into expiries, "
+              "a signal mean queue pressure cannot see.")
 register_flag("telemetry_port", "MXNET_TELEMETRY_PORT", int, 0,
               "Training-side telemetry HTTP listener port "
               "(mxnet_tpu.telemetry.exporters): serves /metrics "

@@ -110,8 +110,10 @@ class PrefetchQueue:
         self._stop.set()
         if thread is None or not thread.is_alive():
             return True
-        deadline = time.time() + timeout
-        while thread.is_alive() and time.time() < deadline:
+        # monotonic: an NTP step during shutdown must not turn the join
+        # budget into zero (or into hours)
+        deadline = time.monotonic() + timeout
+        while thread.is_alive() and time.monotonic() < deadline:
             self.drain()
             thread.join(timeout=0.05)
         return not thread.is_alive()

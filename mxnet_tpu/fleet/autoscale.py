@@ -55,7 +55,8 @@ class AutoscalePolicy:
                  high_watermark_s=None, low_watermark_s=None,
                  breach_rounds=None, cooldown_s=None,
                  startup_cost_s=None, interval_s=None,
-                 launch_timeout_s=30.0):
+                 launch_timeout_s=30.0, page_high_occupancy=None,
+                 deadline_headroom=None):
         def _f(v, flag):
             return flag if v is None else v
         self.min_replicas = int(_f(min_replicas,
@@ -74,6 +75,12 @@ class AutoscalePolicy:
                                        flags.autoscale_startup_cost_s))
         self.interval_s = float(_f(interval_s,
                                    flags.autoscale_interval_s))
+        # decode memory / tail-latency pressure: either signal hot
+        # counts as a high-watermark breach (see step())
+        self.page_high_occupancy = float(
+            _f(page_high_occupancy, flags.autoscale_page_high_occupancy))
+        self.deadline_headroom = float(
+            _f(deadline_headroom, flags.autoscale_deadline_headroom))
         # a launched process that never registers stops counting as
         # capacity after this long (crash loops must not wedge scaling)
         self.launch_timeout_s = float(launch_timeout_s)
@@ -92,6 +99,8 @@ class AutoscalePolicy:
             "cooldown_s": self.cooldown_s,
             "startup_cost_s": self.startup_cost_s,
             "interval_s": self.interval_s,
+            "page_high_occupancy": self.page_high_occupancy,
+            "deadline_headroom": self.deadline_headroom,
         }
 
 
@@ -142,6 +151,14 @@ class Autoscaler:
             "autoscale/pressure_s",
             "Mean queue-seconds of work per in-rotation replica "
             "(the autoscaler's demand signal).")
+        self._g_kv_occ = reg.gauge(
+            "autoscale/kv_page_occupancy",
+            "Worst in-rotation replica's KV page-pool occupancy "
+            "(decode memory-pressure scale-out signal).")
+        self._g_deadline = reg.gauge(
+            "autoscale/deadline_ratio",
+            "Worst in-rotation replica's p99 latency over its request "
+            "deadline (tail-pressure scale-out signal).")
         self.restore()
 
     # -- durability ----------------------------------------------------------
@@ -196,6 +213,18 @@ class Autoscaler:
                      for r in in_rot)
         queue = sum(int(r.load.get("queue_depth", 0) or 0)
                     for r in in_rot)
+        # worst-replica signals: page exhaustion and deadline pressure
+        # are per-replica cliffs, so the max (not the mean) is the
+        # demand picture — one page-starved replica is one replica
+        # about to stall admissions
+        kv_occ = max([float(r.load.get("kv_page_occupancy", 0.0) or 0.0)
+                      for r in in_rot] or [0.0])
+        deadline_ratio = 0.0
+        for r in in_rot:
+            p99 = float(r.load.get("p99_ms", 0.0) or 0.0)
+            deadline = float(r.load.get("deadline_ms", 0.0) or 0.0)
+            if p99 > 0 and deadline > 0:
+                deadline_ratio = max(deadline_ratio, p99 / deadline)
         n_cap = len(in_rot) + len(warming) + len(self._pending)
         pressure = load_s / max(1, len(in_rot))
         return {
@@ -206,6 +235,8 @@ class Autoscaler:
             "load_s": round(load_s, 4),
             "queue_depth": queue,
             "pressure_s": round(pressure, 4),
+            "kv_page_occupancy": round(kv_occ, 4),
+            "deadline_ratio": round(deadline_ratio, 4),
         }
 
     # -- actions -------------------------------------------------------------
@@ -274,6 +305,8 @@ class Autoscaler:
         reaped = self._reap_drained()
         obs = self.observe(now)
         self._g_pressure.set(obs["pressure_s"])
+        self._g_kv_occ.set(obs["kv_page_occupancy"])
+        self._g_deadline.set(obs["deadline_ratio"])
         pol = self.policy
 
         # floor: a model below min_replicas gets capacity NOW —
@@ -282,9 +315,17 @@ class Autoscaler:
             return self._launch(now, "below min_replicas", obs)
 
         pressure = obs["pressure_s"]
+        # page exhaustion / tail-vs-deadline are scale-out signals of
+        # their own: they breach the high watermark even while mean
+        # queue-seconds look calm (long contexts eat the KV pool, tail
+        # latency creeps to the deadline) — and a hot fleet never
+        # scales down
+        page_hot = obs["kv_page_occupancy"] > pol.page_high_occupancy
+        deadline_hot = obs["deadline_ratio"] > pol.deadline_headroom
+        hot = page_hot or deadline_hot
         settled = (obs["pending"] == 0
                    and obs["in_rotation"] == obs["capacity"])
-        if pressure > pol.high_watermark_s:
+        if pressure > pol.high_watermark_s or hot:
             self._breach_high += 1
             self._breach_low = 0
         elif pressure < pol.low_watermark_s:
@@ -330,6 +371,19 @@ class Autoscaler:
                 metrics=obs)
 
         if want_up:
+            if page_hot:
+                return self._launch(
+                    now, "kv page occupancy %.2f > %.2f for %d rounds "
+                    "(memory pressure bypasses the break-even test: "
+                    "waiting cannot free pages)"
+                    % (obs["kv_page_occupancy"], pol.page_high_occupancy,
+                       self._breach_high), obs)
+            if deadline_hot:
+                return self._launch(
+                    now, "p99/deadline %.2f > %.2f for %d rounds (tail "
+                    "about to expire requests; bypasses break-even)"
+                    % (obs["deadline_ratio"], pol.deadline_headroom,
+                       self._breach_high), obs)
             # break-even: adding a replica drains W/n - W/(n+1)
             # queue-seconds of per-replica backlog; below the startup
             # cost the spike outruns the launch

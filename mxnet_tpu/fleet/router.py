@@ -909,32 +909,36 @@ class Router:
             budget = flags.quant_accuracy_budget
         with self._lock:
             baseline = dict(self.splits.get(model) or {})
-            if not baseline:
-                versions = sorted(v for v in
-                                  self.registry.models().get(model, {})
-                                  if v != version)
-                if not versions:
-                    raise MXNetError(
-                        "fleet: no baseline version of %r to canary "
-                        "against" % model)
-                baseline = {v: 1.0 / len(versions) for v in versions}
-            mixed = {v: w * (1.0 - split) for v, w in baseline.items()}
-            mixed[version] = mixed.get(version, 0.0) + split
-            self._refuse_mixed_layouts(model, set(mixed))
+        if not baseline:
+            versions = sorted(v for v in
+                              self.registry.models().get(model, {})
+                              if v != version)
+            if not versions:
+                raise MXNetError(
+                    "fleet: no baseline version of %r to canary "
+                    "against" % model)
+            baseline = {v: 1.0 / len(versions) for v in versions}
+        mixed = {v: w * (1.0 - split) for v, w in baseline.items()}
+        mixed[version] = mixed.get(version, 0.0) + split
+        self._refuse_mixed_layouts(model, set(mixed))
+        record = {
+            "model": model, "version": version, "split": split,
+            "budget": float(budget), "baseline": baseline,
+            "state": "active", "reason": None,
+        }
+        # WAL discipline (the set_split pattern): both records hit the
+        # disk before the canary is live, and the fsync happens outside
+        # the routing lock so request threads never stall on it
+        self._journal_append("split", {"model": model,
+                                       "weights": dict(mixed)},
+                             required=True)
+        self._journal_append("canary", {"model": model,
+                                        "record": dict(record)},
+                             required=True)
+        with self._lock:
             self.splits[model] = mixed
-            self.canaries[model] = {
-                "model": model, "version": version, "split": split,
-                "budget": float(budget), "baseline": baseline,
-                "deltas": [], "state": "active", "reason": None,
-            }
-            self._journal_append("split", {"model": model,
-                                           "weights": dict(mixed)},
-                                 required=True)
-            self._journal_append("canary", {
-                "model": model,
-                "record": {k: v for k, v in self.canaries[model].items()
-                           if k != "deltas"}})
-            return dict(self.canaries[model], deltas=[])
+            self.canaries[model] = dict(record, deltas=[])
+        return dict(record, deltas=[])
 
     def report_canary(self, model, delta, version=None):
         """Feed one accuracy-probe delta (f32-vs-canary top-1 delta,
@@ -960,22 +964,33 @@ class Router:
             if abs(delta) <= c["budget"]:
                 return {"state": "active", "action": "none",
                         "delta": delta, "budget": c["budget"]}
-            # rollback: restore the baseline split; the canary version
-            # keeps weight 0 via absence from the split
-            c["state"] = "rolled_back"
+            # decide the rollback under the lock but apply nothing yet:
+            # the journal write comes first, and it must not run inside
+            # the routing lock (it fsyncs)
             reason = ("accuracy delta %.6f exceeds budget %.6f"
                       % (delta, c["budget"]))
-            c["reason"] = reason
-            self.splits[model] = {v: w for v, w in c["baseline"].items()
-                                  if v != c["version"]} or c["baseline"]
+            new_split = {v: w for v, w in c["baseline"].items()
+                         if v != c["version"]} or dict(c["baseline"])
             canary_version = c["version"]
             budget = c["budget"]
-            self._journal_append("split", {"model": model,
-                                           "weights": self.splits[model]})
-            self._journal_append("canary", {
-                "model": model,
-                "record": {k: v for k, v in c.items()
-                           if k != "deltas"}})
+            rec = {k: v for k, v in c.items() if k != "deltas"}
+            rec["state"] = "rolled_back"
+            rec["reason"] = reason
+        # journal-first, and required: a rollback ack must be durable
+        # (a crash after the ack replays to the rolled-back split)
+        self._journal_append("split", {"model": model,
+                                       "weights": dict(new_split)},
+                             required=True)
+        self._journal_append("canary", {"model": model, "record": rec},
+                             required=True)
+        with self._lock:
+            # revalidate: a concurrent promote/rollback between the two
+            # critical sections wins; never clobber its state
+            c2 = self.canaries.get(model)
+            if c2 is c and c2["state"] == "active":
+                c2["state"] = "rolled_back"
+                c2["reason"] = reason
+                self.splits[model] = new_split
         self._c_rollbacks.inc()
         drained = []
         for rep in self.registry.live_replicas():
@@ -1057,6 +1072,25 @@ class _RouterHandler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(n).decode() or "{}")
 
+    def _fence(self, payload):
+        """Epoch fence for control-plane writes: a caller that names a
+        ``fleet_epoch`` other than ours is acting on a stale view of
+        who the primary is (demoted router, old operator script) — 409,
+        never a silent apply. A payload without the field is accepted:
+        pre-fence clients keep working, they just don't get the
+        protection. Returns True when the request may proceed."""
+        claimed = payload.pop("fleet_epoch", None)
+        router = self.server.mx_router
+        if claimed is None or router.epoch is None:
+            return True
+        if int(claimed) != int(router.epoch):
+            self._reply(409, {"error": "stale fleet_epoch %s (current "
+                                       "epoch %s)" % (claimed,
+                                                      router.epoch),
+                              "epoch": router.epoch})
+            return False
+        return True
+
     def do_GET(self):
         router = self.server.mx_router
         path, _, query = self.path.partition("?")
@@ -1128,6 +1162,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
             payload = self._read_json()
         except ValueError as e:
             self._reply(400, {"error": "bad json: %s" % e})
+            return
+        # every control-plane mutation goes through the fence; the
+        # data-plane /v1 routes are fenced per-replica by serve/http
+        if self.path.startswith(("/fleet/", "/admin/")) \
+                and not self._fence(payload):
             return
         try:
             if self.path in ("/v1/predict", "/predict"):

@@ -213,7 +213,8 @@ class ReplicaSupervisor:
         stopped, so :meth:`poll` observes the death as an event and
         (budget permitting) restarts it — exactly what an external
         kill looks like. Returns the signalled pid or None."""
-        child = self._children[replica_id]
+        with self._lock:
+            child = self._children[replica_id]
         if child.proc is not None and child.proc.poll() is None:
             try:
                 child.proc.send_signal(sig)
@@ -227,11 +228,14 @@ class ReplicaSupervisor:
         """Signal children (default SIGTERM — replicas drain gracefully)
         and wait for exit; SIGKILL anything that overstays ``wait_s``.
         ``replica_id=None`` stops every child and the poller thread."""
-        if replica_id is None:
-            self._stop.set()
-            targets = list(self._children.values())
-        else:
-            targets = [self._children[replica_id]]
+        # snapshot under the lock (the poller mutates _children while
+        # it restarts children), signal/wait outside it
+        with self._lock:
+            if replica_id is None:
+                self._stop.set()
+                targets = list(self._children.values())
+            else:
+                targets = [self._children[replica_id]]
         for child in targets:
             child.state = "stopped"     # poll() must not restart it
             if child.proc is not None and child.proc.poll() is None:
@@ -274,7 +278,9 @@ class ReplicaSupervisor:
         return out
 
     def alive_count(self):
-        return sum(1 for c in self._children.values()
+        with self._lock:
+            children = list(self._children.values())
+        return sum(1 for c in children
                    if c.state == "running" and c.proc.poll() is None)
 
 

@@ -559,7 +559,10 @@ class GenerateSession:
             " resubmit cursor['resume_prompt'] to continue"
             % (why, len(slot.gen)), tokens=slot.gen,
             cursor=self._cursor(req, slot.gen),
-            retry_after=self._retry_after_unlocked()))
+            # _retry_after takes _cond for the _pending scan: submit()
+            # appends under it, and iterating a deque mid-append raises
+            # (_evict runs on the scheduler thread, never under _cond)
+            retry_after=self._retry_after()))
 
     def _finish(self, i, reason):
         slot = self._release_slot(i)

@@ -187,6 +187,15 @@ class ServeMetrics:
             return 0.05
         return max(0.005, pending_rows / rate)
 
+    def latency_p99(self):
+        """End-to-end p99 merged across every bucket/dtype (None until
+        there are samples) — the heartbeat's deadline-pressure signal."""
+        with self._lock:
+            lat = []
+            for st in self._buckets.values():
+                lat.extend(st.latency_ms)
+        return percentile(lat, 99)
+
     @staticmethod
     def _render(batches, rows, padded, lat, ex):
         total = rows + padded
@@ -326,6 +335,12 @@ class DecodeMetrics:
         with self._lock:
             self.prefill_batches += 1
             self.prefill_rows += rows
+
+    def ttft_p99(self):
+        """p99 time-to-first-token (None until there are samples) —
+        the generate-mode heartbeat's deadline-pressure signal."""
+        with self._lock:
+            return percentile(list(self.ttft_ms), 99)
 
     def note_ttft(self, ms):
         with self._lock:

@@ -631,6 +631,11 @@ class Server:
                 "unit_s": round(sess.estimate_step_s()
                                 / max(1, sess.spec.max_slots), 9),
                 "queue_depth": len(sess._pending),
+                # memory pressure: queue-seconds can look calm while the
+                # KV page pool is nearly exhausted (long contexts) — the
+                # autoscaler scales out on this before admission stalls
+                "kv_page_occupancy": round(sess.cache.occupancy(), 4),
+                "p99_ms": sess.metrics_.ttft_p99(),
             }
         elif self.mode == "recommend":
             # billed in gather units: load_s = pending gathers x the
@@ -640,6 +645,7 @@ class Server:
                 "load_s": round(self._queue.pending_units() * unit, 6),
                 "unit_s": round(unit, 9),
                 "queue_depth": self._queue.pending_count(),
+                "p99_ms": self.metrics_.latency_p99(),
             }
         else:
             pending = self._queue.pending_count()
@@ -648,7 +654,14 @@ class Server:
                 "load_s": round(pending * unit, 6),
                 "unit_s": round(unit, 9),
                 "queue_depth": pending,
+                "p99_ms": self.metrics_.latency_p99(),
             }
+        # the deadline the p99 is judged against (request timeout):
+        # p99/deadline > headroom means tail latency is about to turn
+        # into expiries — scale out even when mean pressure looks fine
+        timeout_ms = getattr(self.config, "timeout_ms", None)
+        if timeout_ms:
+            load["deadline_ms"] = float(timeout_ms)
         return {"ready": reason is None, "reason": reason, "load": load}
 
     # -- observability ------------------------------------------------------

@@ -224,6 +224,76 @@ def test_max_replicas_caps_scale_up():
 
 
 # ---------------------------------------------------------------------------
+# residual signals: KV page occupancy + p99-vs-deadline
+# ---------------------------------------------------------------------------
+
+def test_kv_page_occupancy_gates_scale_out_bypassing_break_even():
+    clock = FakeClock()
+    sc, router, sup = _scaler(
+        clock=clock,
+        policy=_policy(cooldown_s=0.0, startup_cost_s=100.0))
+    sc.step()                                  # floor launch m-as1
+    # queue-seconds calm, but the decode KV pool is nearly exhausted:
+    # waiting cannot free pages, so break-even must not hold this
+    _register(router.registry, "m-as1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0, "unit_s": 0.1,
+                    "kv_page_occupancy": 0.97})
+    d = sc.step(clock.advance(0.5))            # breach round 1
+    assert d["action"] == "steady"
+    d = sc.step(clock.advance(0.5))            # breach round 2 -> act
+    assert d["action"] == "scale_up"
+    assert "kv page occupancy" in d["reason"]
+    assert sup.added == ["m-as1", "m-as2"]
+
+
+def test_p99_vs_deadline_gates_scale_out():
+    clock = FakeClock()
+    sc, router, sup = _scaler(
+        clock=clock,
+        policy=_policy(cooldown_s=0.0, startup_cost_s=100.0))
+    sc.step()
+    # tail latency is past the request deadline while the mean load
+    # looks fine: requests are about to expire, add capacity
+    _register(router.registry, "m-as1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0, "unit_s": 0.1,
+                    "p99_ms": 600.0, "deadline_ms": 500.0})
+    sc.step(clock.advance(0.5))
+    d = sc.step(clock.advance(0.5))
+    assert d["action"] == "scale_up"
+    assert "p99/deadline" in d["reason"]
+    assert sup.added == ["m-as1", "m-as2"]
+
+
+def test_hot_fleet_never_scales_down():
+    clock = FakeClock()
+    sc, router, sup = _scaler(clock=clock,
+                              policy=_policy(cooldown_s=0.0,
+                                             max_replicas=2))
+    sc.step()
+    _register(router.registry, "m-as1", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0})
+    sc.owned.add("m-as2")
+    # idle by queue-seconds, but one replica's KV pool is nearly full:
+    # the hot signal routes to the high branch, so the low-watermark
+    # breach never accumulates
+    _register(router.registry, "m-as2", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0,
+                    "kv_page_occupancy": 0.95})
+    for _ in range(4):
+        d = sc.step(clock.advance(0.5))
+        assert d["action"] == "steady", d
+    assert sup.stopped == []
+    assert not router.registry.get("m-as2").draining
+    # occupancy recedes: the idle fleet may drain again
+    _register(router.registry, "m-as2", ready=True,
+              load={"load_s": 0.0, "queue_depth": 0,
+                    "kv_page_occupancy": 0.2})
+    sc.step(clock.advance(0.5))
+    d = sc.step(clock.advance(0.5))
+    assert d["action"] == "scale_down"
+
+
+# ---------------------------------------------------------------------------
 # scale-down: drain, then reap once idle
 # ---------------------------------------------------------------------------
 

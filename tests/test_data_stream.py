@@ -235,6 +235,38 @@ def test_prefetch_queue_shutdown_races_producer():
     assert not t.is_alive()
 
 
+def test_prefetch_shutdown_budget_survives_wall_clock_step(monkeypatch):
+    """The shutdown join budget is monotonic: an NTP step (or operator
+    `date`) mid-shutdown must neither zero the budget nor stretch it to
+    hours. A wall clock that jumps a billion seconds forward on every
+    read must not break the join."""
+    import threading
+    import time as real_time
+    from mxnet_tpu.data import pipeline as pipeline_mod
+
+    class JumpyClock:
+        def time(self):
+            return real_time.time() + 1e9   # NTP stepped, hard
+
+        def __getattr__(self, name):        # monotonic et al: real
+            return getattr(real_time, name)
+
+    monkeypatch.setattr(pipeline_mod, "time", JumpyClock())
+    pq = PrefetchQueue(1)
+
+    def producer():
+        i = 0
+        while pq.put(i):
+            i += 1
+        pq.put_sentinel()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    assert pq.get() == 0
+    assert pq.shutdown(t, timeout=5.0)
+    assert not t.is_alive()
+
+
 # ----------------------------------------------------------------- packer
 
 def test_make_recordio_synth_images_roundtrip(tmp_path):

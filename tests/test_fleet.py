@@ -447,6 +447,112 @@ def test_canary_requires_baseline_and_sane_split():
         router.start_canary("m", "int8", split=0.1)
 
 
+def test_canary_journals_first_outside_the_routing_lock(monkeypatch):
+    """WAL discipline on both canary paths: the (fsyncing) journal
+    append runs with the routing lock RELEASED and before any split or
+    canary state mutates, and every control append is required=True."""
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg)
+    _register(reg, "blue", version="f32")
+    _register(reg, "cn", version="int8")
+    router.set_split("m", {"f32": 1.0})
+    seen = []
+    orig = router._journal_append
+
+    def spy(kind, data, sync=False, required=False):
+        seen.append({"kind": kind, "required": required,
+                     "locked": router._lock.locked(),
+                     "split": dict(router.splits.get("m") or {}),
+                     "canary": (router.canaries.get("m") or {}).get(
+                         "state")})
+        return orig(kind, data, sync=sync, required=required)
+
+    monkeypatch.setattr(router, "_journal_append", spy)
+    router.start_canary("m", "int8", split=0.25, budget=0.01)
+    start = [s for s in seen if s["kind"] in ("split", "canary")]
+    assert len(start) == 2
+    for s in start:
+        assert s["required"] and not s["locked"]
+        # journal-first: live state untouched at append time
+        assert s["split"] == {"f32": 1.0} and s["canary"] is None
+
+    seen.clear()
+    out = router.report_canary("m", 0.05)     # over budget: rollback
+    assert out["state"] == "rolled_back"
+    rb = [s for s in seen if s["kind"] in ("split", "canary")]
+    assert len(rb) == 2
+    for s in rb:
+        assert s["required"] and not s["locked"]
+        assert s["split"] == pytest.approx({"f32": 0.75, "int8": 0.25})
+        assert s["canary"] == "active"
+    assert router.splits["m"] == {"f32": 1.0}
+
+
+def test_epoch_fence_rejects_stale_control_writes():
+    """A control POST naming a stale fleet_epoch gets a 409 (with the
+    current epoch in the body); the matching epoch and fence-less
+    legacy payloads go through; data-plane-free GETs are unaffected."""
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0)
+    router = Router(registry=reg, epoch=3)
+    front = route_http(router, "127.0.0.1", 0)
+    url = front.address
+    try:
+        code, out = _post(url + "/fleet/register",
+                          {"id": "a", "url": "http://a.invalid",
+                           "model": "m", "version": "0",
+                           "mode": "predict", "ready": True,
+                           "fleet_epoch": 2})
+        assert code == 409 and out["epoch"] == 3
+        assert "stale" in out["error"]
+        code, out = _get_json(url + "/readyz")
+        assert code == 503                    # the stale write never landed
+        code, out = _post(url + "/fleet/register",
+                          {"id": "a", "url": "http://a.invalid",
+                           "model": "m", "version": "0",
+                           "mode": "predict", "ready": True,
+                           "fleet_epoch": 3})
+        assert code == 200 and out["registered"] == "a"
+        code, out = _post(url + "/admin/split",
+                          {"model": "m", "weights": {"0": 1.0},
+                           "fleet_epoch": 1})
+        assert code == 409 and out["epoch"] == 3
+        # pre-fence client (no field): accepted, backward compatible
+        code, out = _post(url + "/admin/split",
+                          {"model": "m", "weights": {"0": 1.0}})
+        assert code == 200 and out["split"] == {"0": 1.0}
+    finally:
+        front.stop()
+
+
+def test_supervisor_snapshots_children_under_lock():
+    """kill/stop/alive_count/statuses must touch _children only under
+    the supervisor lock: the background poller mutates the dict while
+    restarting children, and iterating it mid-mutation raises."""
+    from mxnet_tpu.fleet import ReplicaSpec, ReplicaSupervisor
+    sup = ReplicaSupervisor(backoff_base=0.1)
+    sup.add(ReplicaSpec("a", ["true"]), start=False)
+
+    class Guarded(dict):
+        def __getitem__(self, k):
+            assert sup._lock.locked(), "unlocked _children[...] access"
+            return dict.__getitem__(self, k)
+
+        def values(self):
+            assert sup._lock.locked(), "unlocked _children.values()"
+            return dict.values(self)
+
+        def items(self):
+            assert sup._lock.locked(), "unlocked _children.items()"
+            return dict.items(self)
+
+    sup._children = Guarded(sup._children)
+    assert sup.kill("a") is None          # never spawned: no pid
+    sup.stop("a")
+    assert sup.alive_count() == 0
+    assert sup.statuses()["a"]["state"] == "stopped"
+    sup.stop()
+
+
 def test_split_is_intent_fallback_only_when_nothing_else_ready():
     # a rolled-back canary (weight 0 via absence) must not come back
     # just because the preferred version died — unless NOTHING else is

@@ -410,6 +410,284 @@ class TestStagedFeedRule:
         assert "MXL513" not in _rules(good)
 
 
+# ---------------------------------------------------------------- layer 3
+
+class TestUnguardedSharedWrite:
+    """MXL601: attribute shared across thread contexts, mixed lock
+    discipline."""
+
+    BAD = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.pending = []\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            with self._lock:\n"
+        "                self.pending.append(1)\n"
+        "    def drain(self):\n"
+        "        out = list(self.pending)\n"
+        "        self.pending = []\n"
+        "        return out\n")
+
+    def test_unlocked_caller_access_fires(self):
+        diags = [d for d in _diags(self.BAD) if d.rule == "MXL601"]
+        assert len(diags) == 1
+        assert diags[0].symbol == "Box.pending"
+
+    def test_locked_everywhere_passes(self):
+        good = self.BAD.replace(
+            "    def drain(self):\n"
+            "        out = list(self.pending)\n"
+            "        self.pending = []\n"
+            "        return out\n",
+            "    def drain(self):\n"
+            "        with self._lock:\n"
+            "            out = list(self.pending)\n"
+            "            self.pending = []\n"
+            "        return out\n")
+        assert "MXL601" not in _rules(good)
+
+    def test_single_owner_convention_passes(self):
+        # never-locked loop state driven from one thread: not a race
+        src = (
+            "import threading\n"
+            "class Loop:\n"
+            "    def __init__(self):\n"
+            "        self.steps = 0\n"
+            "        self._t = threading.Thread(target=self.run_loop)\n"
+            "    def run_loop(self):\n"
+            "        self.steps += 1\n")
+        assert "MXL601" not in _rules(src)
+
+
+class TestBlockingUnderFleetLock:
+    """MXL602: fsync / journal append / socket / sleep inside a
+    critical section."""
+
+    def test_fsync_under_lock_fires(self):
+        bad = (
+            "import os, threading\n"
+            "class Journal:\n"
+            "    def __init__(self, fh):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._fh = fh\n"
+            "    def append(self, rec):\n"
+            "        with self._lock:\n"
+            "            self._fh.write(rec)\n"
+            "            os.fsync(self._fh.fileno())\n")
+        assert "MXL602" in _rules(bad)
+
+    def test_fsync_outside_lock_passes(self):
+        good = (
+            "import os, threading\n"
+            "class Journal:\n"
+            "    def __init__(self, fh):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._fh = fh\n"
+            "    def append(self, rec):\n"
+            "        with self._lock:\n"
+            "            self._fh.write(rec)\n"
+            "        os.fsync(self._fh.fileno())\n")
+        assert "MXL602" not in _rules(good)
+
+    def test_journal_append_under_lock_fires(self):
+        bad = (
+            "class Router:\n"
+            "    def set_split(self, model, split):\n"
+            "        with self._lock:\n"
+            "            self._journal_append('split', {'m': model})\n"
+            "            self.table = split\n")
+        assert "MXL602" in _rules(bad)
+
+    def test_set_split_pattern_passes(self):
+        # journal first (outside the lock), then mutate under it
+        good = (
+            "class Router:\n"
+            "    def set_split(self, model, split):\n"
+            "        self._journal_append('split', {'m': model})\n"
+            "        with self._lock:\n"
+            "            self.table = split\n")
+        assert "MXL602" not in _rules(good)
+
+    def test_sleep_under_lock_fires(self):
+        bad = (
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def poke():\n"
+            "    with _lock:\n"
+            "        time.sleep(0.1)\n")
+        assert "MXL602" in _rules(bad)
+
+
+class TestWallClockLiveness:
+    """MXL603: time.time() feeding liveness/lease/backoff deadlines."""
+
+    def test_wall_clock_deadline_fires(self):
+        bad = (
+            "import time\n"
+            "def lease():\n"
+            "    deadline = time.time() + 5.0\n"
+            "    return deadline\n")
+        assert "MXL603" in _rules(bad)
+
+    def test_monotonic_deadline_passes(self):
+        good = (
+            "import time\n"
+            "def lease():\n"
+            "    deadline = time.monotonic() + 5.0\n"
+            "    return deadline\n")
+        assert "MXL603" not in _rules(good)
+
+    def test_wall_clock_lease_compare_fires(self):
+        bad = (
+            "import time\n"
+            "class Registry:\n"
+            "    def check(self, rec):\n"
+            "        return time.time() < rec.lease_expiry\n")
+        assert "MXL603" in _rules(bad)
+
+    def test_wall_clock_in_liveness_fn_fires(self):
+        bad = (
+            "import time\n"
+            "def sweep_dead(registry):\n"
+            "    now = time.time()\n"
+            "    return [r for r in registry if r.t < now]\n")
+        assert "MXL603" in _rules(bad)
+
+    def test_wall_clock_log_stamp_passes(self):
+        # wall clock is fine for log timestamps
+        good = (
+            "import time\n"
+            "def log_stamp():\n"
+            "    return time.time()\n")
+        assert "MXL603" not in _rules(good)
+
+
+class TestJournalFirst:
+    """MXL604: control-route mutations must journal first, required."""
+
+    HANDLER = (
+        "class Handler:\n"
+        "    def do_POST(self):\n"
+        "        payload = self._read_json()\n"
+        "        if self.path.startswith('/fleet/split'):\n"
+        "            self.router.set_split(payload['m'], payload['s'])\n")
+
+    def test_mutate_before_append_fires(self):
+        bad = (
+            "class Router:\n"
+            "    def _journal_append(self, kind, rec, required=False):\n"
+            "        self._journal.append((kind, rec))\n"
+            "    def set_split(self, model, split):\n"
+            "        self.splits[model] = split\n"
+            "        self._journal_append('split', {'m': model},\n"
+            "                             required=True)\n"
+            + self.HANDLER)
+        diags = [d for d in _diags(bad) if d.rule == "MXL604"]
+        assert diags and "mutated before" in diags[0].message
+
+    def test_append_without_required_fires(self):
+        bad = (
+            "class Router:\n"
+            "    def _journal_append(self, kind, rec, required=False):\n"
+            "        self._journal.append((kind, rec))\n"
+            "    def set_split(self, model, split):\n"
+            "        self._journal_append('split', {'m': model})\n"
+            "        self.splits[model] = split\n"
+            + self.HANDLER)
+        diags = [d for d in _diags(bad) if d.rule == "MXL604"]
+        assert diags and "required=True" in diags[0].message
+
+    def test_journal_first_required_passes(self):
+        good = (
+            "class Router:\n"
+            "    def _journal_append(self, kind, rec, required=False):\n"
+            "        self._journal.append((kind, rec))\n"
+            "    def set_split(self, model, split):\n"
+            "        self._journal_append('split', {'m': model},\n"
+            "                             required=True)\n"
+            "        with self._lock:\n"
+            "            self.splits[model] = split\n"
+            + self.HANDLER)
+        assert "MXL604" not in _rules(good)
+
+
+class TestEpochFencing:
+    """MXL605: state-mutating control routes must check the fence."""
+
+    ROUTES = (
+        "        if self.path.startswith('/fleet/split'):\n"
+        "            self.router.set_split(payload)\n"
+        "        elif self.path.startswith('/admin/drain'):\n"
+        "            self.router.drain()\n")
+
+    def test_unfenced_routes_fire(self):
+        bad = (
+            "class Handler:\n"
+            "    def do_POST(self):\n"
+            "        payload = self._read_json()\n"
+            + self.ROUTES)
+        diags = [d for d in _diags(bad) if d.rule == "MXL605"]
+        assert len(diags) == 2
+
+    def test_preamble_fence_covers_every_route(self):
+        good = (
+            "class Handler:\n"
+            "    def do_POST(self):\n"
+            "        payload = self._read_json()\n"
+            "        if self.path.startswith(('/fleet/', '/admin/')) \\\n"
+            "                and not self._fence(payload):\n"
+            "            return\n"
+            + self.ROUTES)
+        assert "MXL605" not in _rules(good)
+
+    def test_in_branch_fence_passes(self):
+        good = (
+            "class Handler:\n"
+            "    def do_POST(self):\n"
+            "        payload = self._read_json()\n"
+            "        if self.path.startswith('/fleet/split'):\n"
+            "            if not self._fence(payload):\n"
+            "                return\n"
+            "            self.router.set_split(payload)\n")
+        assert "MXL605" not in _rules(good)
+
+
+class TestPayloadDeterminism:
+    """MXL606: journaled/dispatched payloads must replay bitwise."""
+
+    def test_set_and_wall_clock_payload_fires(self):
+        bad = (
+            "import time\n"
+            "class Router:\n"
+            "    def record(self, replicas):\n"
+            "        rec = {'replicas': {r for r in replicas},\n"
+            "               'ts': time.time()}\n"
+            "        self._journal_append('epoch', rec, required=True)\n")
+        diags = [d for d in _diags(bad) if d.rule == "MXL606"]
+        assert len(diags) == 2
+
+    def test_sorted_payload_passes(self):
+        good = (
+            "class Router:\n"
+            "    def record(self, replicas, stamp):\n"
+            "        rec = {'replicas': sorted(replicas),\n"
+            "               'stamp': stamp}\n"
+            "        self._journal_append('epoch', rec, required=True)\n")
+        assert "MXL606" not in _rules(good)
+
+    def test_rng_draw_in_dispatch_fires(self):
+        bad = (
+            "import random\n"
+            "def dispatch(rng, payload):\n"
+            "    dispatch_payload({'jitter': rng.uniform(0, 1)})\n")
+        assert "MXL606" in _rules(bad)
+
+
 def test_parse_error_is_a_diagnostic_not_a_crash():
     diags = _diags("def broken(:\n")
     assert [d.rule for d in diags] == ["MXL001"]
@@ -808,6 +1086,21 @@ class TestBaselineRatchet:
         baseline_mod.update(bl, diags, allow_growth=True)
         assert len(baseline_mod.load(bl)) == 1
 
+    def test_layer3_growth_refused(self, tmp_path):
+        """New MXL6xx findings ride the same one-way ratchet."""
+        f = self._write(tmp_path, "mod.py", (
+            "import time\n"
+            "def lease():\n"
+            "    deadline = time.time() + 5.0\n"
+            "    return deadline\n"))
+        bl = str(tmp_path / "baseline.json")
+        baseline_mod.update(bl, [])            # seed an empty baseline
+        diags = lint_paths([f], root=str(tmp_path))
+        assert {d.rule for d in diags} == {"MXL603"}
+        with pytest.raises(baseline_mod.BaselineGrowthError):
+            baseline_mod.update(bl, diags)
+        assert baseline_mod.load(bl) == {}     # refusal wrote nothing
+
     def test_unsupported_baseline_format_raises(self, tmp_path):
         bl = tmp_path / "baseline.json"
         bl.write_text(json.dumps({"version": 99, "entries": {}}))
@@ -854,3 +1147,19 @@ class TestCli:
         rc = mxlint_cli.main(["--baseline-update", "--rule", "MXL101"])
         capsys.readouterr()
         assert rc == 2
+        rc = mxlint_cli.main(["--baseline-update", "--concurrency"])
+        capsys.readouterr()
+        assert rc == 2
+
+    def test_concurrency_scope_filters_layer1(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text(BAD_SRC +
+                       "import time\n"
+                       "def lease():\n"
+                       "    deadline = time.time() + 5.0\n"
+                       "    return deadline\n")
+        rc = mxlint_cli.main([str(mod), "--no-baseline", "--json",
+                              "--concurrency"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {d["rule"] for d in out["diagnostics"]} == {"MXL603"}

@@ -184,6 +184,33 @@ def test_mid_decode_eviction_frees_pages_admits_queued_and_leaves_survivors_bitw
     assert snap["requests"]["expired"] == 1
 
 
+def test_evict_computes_retry_after_under_the_queue_lock(gm, monkeypatch):
+    """Regression: _evict's retry-after must use the LOCKING
+    _retry_after. submit() appends to _pending under _cond, and
+    iterating a deque mid-append raises RuntimeError — the unlocked
+    variant is only safe from code already holding _cond."""
+    sess = _session(gm)
+    calls = []
+    orig = sess._retry_after
+    monkeypatch.setattr(sess, "_retry_after",
+                        lambda: (calls.append("locked"), orig())[1])
+
+    def boom():
+        raise AssertionError(
+            "_evict must not use _retry_after_unlocked: it scans "
+            "_pending without _cond while submit() appends under it")
+
+    monkeypatch.setattr(sess, "_retry_after_unlocked", boom)
+    req = sess.submit([5, 9, 13], max_new_tokens=8)
+    sess.run_round()
+    req.deadline = time.monotonic() - 1.0     # force a deadline evict
+    sess.run_round()
+    with pytest.raises(Evicted) as ei:
+        req.result(timeout=0.1)
+    assert calls and ei.value.retry_after > 0
+    sess.close(drain=True)
+
+
 def test_page_backpressure_holds_admission_until_pages_free(tmp_path,
                                                             params):
     # same geometry, starved page pool: 6 allocatable pages, so two

@@ -84,6 +84,33 @@ def _run(tag, dump, extra_args, extra_env, verbose):
     return r
 
 
+def _assert_mxl6_clean(subdirs):
+    """Pre-flight lint gate: the modules this drill is about to fault
+    must be clean under the Layer-3 concurrency/control-plane rules
+    (MXL601-606, modulo the committed baseline). A drill that injects
+    faults into code with a KNOWN un-triaged race or journal-ordering
+    bug produces noise, not evidence — fix or baseline the finding
+    first (tools/mxlint.py --concurrency)."""
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from mxnet_tpu.analysis import runner as lint_runner
+    res = lint_runner.run(
+        list(subdirs),
+        baseline_path=os.path.join(ROOT, "tools", "mxlint_baseline.json"),
+        enabled=frozenset(["MXL601", "MXL602", "MXL603",
+                           "MXL604", "MXL605", "MXL606"]),
+        root=ROOT)
+    if res.new:
+        for d in res.new:
+            print("fault_drill: %s" % d.format(), file=sys.stderr)
+        raise SystemExit(
+            "fault_drill: %d new MXL6xx finding(s) in %s — refusing to "
+            "inject faults into code with un-triaged concurrency/"
+            "control-plane bugs" % (len(res.new), ", ".join(subdirs)))
+    print("fault_drill: MXL6xx pre-flight clean (%s: %d baselined)"
+          % (", ".join(subdirs), len(res.baselined)))
+
+
 def _build_fleet_artifacts(predict_path, gen_path):
     """Tiny CPU artifacts for the fleet drill: a 6->4 FC predict net and
     the standard small decoder. Returns the decoder spec (the loadgen
@@ -146,6 +173,8 @@ def fleet_drill(args):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import serve_loadgen
+
+    _assert_mxl6_clean(["mxnet_tpu/fleet", "mxnet_tpu/serve"])
 
     # skip=N: the victim ignores its first N matching fire points, so
     # the kill lands mid-phase-B by construction — phase A (45 predict
@@ -369,6 +398,8 @@ def router_ha_drill(args):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import serve_loadgen
+
+    _assert_mxl6_clean(["mxnet_tpu/fleet", "mxnet_tpu/serve"])
 
     GEN_SESSIONS = 10
     PREDICT_REQUESTS = 240
@@ -657,6 +688,8 @@ def disk_loss_drill(args):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import serve_loadgen
+
+    _assert_mxl6_clean(["mxnet_tpu/fleet", "mxnet_tpu/serve"])
 
     GEN_SESSIONS = 10
     PREDICT_REQUESTS = 240
@@ -981,6 +1014,8 @@ def autoscale_drill(args):
     import socket
 
     import serve_loadgen
+
+    _assert_mxl6_clean(["mxnet_tpu/fleet", "mxnet_tpu/serve"])
 
     GEN_SESSIONS = 10
     MAX_NEW, TEMP = 20, 0.7

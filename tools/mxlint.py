@@ -6,6 +6,7 @@
     python tools/mxlint.py --changed            # only git-diffed files
     python tools/mxlint.py --json               # machine-readable output
     python tools/mxlint.py --rule MXL401        # one rule family
+    python tools/mxlint.py --concurrency        # Layer-3 only (MXL6xx)
     python tools/mxlint.py --baseline-update    # prune paid-off debt
     python tools/mxlint.py --list-rules         # rule catalog
 
@@ -31,6 +32,12 @@ from mxnet_tpu.analysis import runner                     # noqa: E402
 DEFAULT_PATHS = ["mxnet_tpu", "tools", "examples"]
 DEFAULT_BASELINE = os.path.join("tools", "mxlint_baseline.json")
 
+# the Layer-3 scope: concurrency races + control-plane invariants
+# (MXL001 rides along — an unparseable file can't be vouched for)
+CONCURRENCY_SCOPE = frozenset([
+    "MXL001", "MXL601", "MXL602", "MXL603", "MXL604", "MXL605", "MXL606",
+])
+
 
 def _parse_args(argv):
     ap = argparse.ArgumentParser(
@@ -52,6 +59,9 @@ def _parse_args(argv):
                          "(shrink-only unless --allow-growth)")
     ap.add_argument("--allow-growth", action="store_true",
                     help="let --baseline-update ADD entries")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run only the Layer-3 concurrency/control-plane "
+                         "rules (MXL601-606)")
     ap.add_argument("--changed", action="store_true",
                     help="lint only files in `git diff --name-only HEAD`")
     ap.add_argument("--list-rules", action="store_true",
@@ -81,6 +91,9 @@ def main(argv=None):
                   % ", ".join(bad), file=sys.stderr)
             return 2
         enabled = frozenset(args.rule)
+    if args.concurrency:
+        enabled = (enabled & CONCURRENCY_SCOPE if enabled is not None
+                   else CONCURRENCY_SCOPE)
 
     if args.changed:
         paths = runner.changed_files(root=_REPO)
@@ -109,11 +122,11 @@ def main(argv=None):
         return 2
 
     if args.baseline_update:
-        if args.rule or args.changed or args.paths:
+        if args.rule or args.changed or args.paths or args.concurrency:
             print("mxlint: --baseline-update requires a full default-"
-                  "scope run (no --rule/--changed/path args): a partial "
-                  "run would prune entries it never scanned",
-                  file=sys.stderr)
+                  "scope run (no --rule/--concurrency/--changed/path "
+                  "args): a partial run would prune entries it never "
+                  "scanned", file=sys.stderr)
             return 2
         try:
             entries = baseline_mod.update(args.baseline, result.diags,
@@ -127,7 +140,8 @@ def main(argv=None):
 
     # a filtered run (--rule/--changed/explicit subset) cannot see every
     # diagnostic, so absent baseline keys are not evidence of paid debt
-    full_scope = not (args.rule or args.changed or args.paths)
+    full_scope = not (args.rule or args.changed or args.paths
+                      or args.concurrency)
     stale = result.stale if full_scope else []
 
     if args.as_json:
